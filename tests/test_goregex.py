@@ -1,0 +1,83 @@
+"""Go->Python regex translation semantics."""
+
+import re
+
+import pytest
+
+from trivy_tpu.engine import goregex
+
+
+def test_midpattern_case_flag_scopes_to_rest_of_group():
+    # adobe-client-secret style: (p8e-)(?i)[a-z]{3}
+    p = goregex.compile_bytes(r"(p8e-)(?i)[a-z]{3}")
+    assert p.search(b"p8e-AbC")
+    assert not p.search(b"P8E-abc")  # prefix group is case-sensitive
+
+
+def test_midpattern_flag_inside_group_scopes_to_group_end():
+    # (LTAI)(?i)x : the (?i) applies inside the enclosing group only
+    p = goregex.compile_bytes(r"((LTAI)(?i)x)y")
+    assert p.search(b"LTAIXy")
+    assert not p.search(b"LTAIXY")  # trailing y outside group stays case-sensitive
+    assert not p.search(b"ltaixy")
+
+
+def test_dollar_is_end_of_text_without_multiline():
+    p = goregex.compile_bytes(r"abc$")
+    assert p.search(b"abc")
+    # Go: $ does NOT match before a trailing newline (unlike Python's $)
+    assert not p.search(b"abc\n")
+
+
+def test_dollar_with_multiline():
+    p = goregex.compile_bytes(r"(?m)abc$")
+    assert p.search(b"abc\ndef")
+    assert p.search(b"xyz\nabc\n")
+
+
+def test_whitespace_class_excludes_vertical_tab():
+    p = goregex.compile_bytes(r"a\sb")
+    assert p.search(b"a b")
+    assert p.search(b"a\tb")
+    assert not p.search(b"a\x0bb")  # RE2 \s has no \v
+    neg = goregex.compile_bytes(r"a\Sb")
+    assert neg.search(b"a\x0bb")
+    assert not neg.search(b"a b")
+
+
+def test_class_internal_escapes():
+    p = goregex.compile_bytes(r"[\s,;]+")
+    assert p.fullmatch(b" ,\t;")
+    assert not p.search(b"\x0b")
+    d = goregex.compile_bytes(r"[\d-]{3}")
+    assert d.fullmatch(b"1-2")
+
+
+def test_named_groups_preserved():
+    p = goregex.compile_bytes(r"(?P<secret>x+)y")
+    m = p.search(b"xxxy")
+    assert m and m.group("secret") == b"xxx"
+
+
+def test_alternation_and_bounded_repeats_roundtrip():
+    p = goregex.compile_bytes(r"(ghu|ghs)_[0-9a-zA-Z]{4}")
+    assert p.search(b"ghs_Ab12")
+    assert not p.search(b"ghx_Ab12")
+
+
+def test_unbalanced_raises():
+    with pytest.raises(goregex.GoRegexError):
+        goregex.go_to_python(r"a)b")
+
+
+def test_lookaround_rejected():
+    with pytest.raises(goregex.GoRegexError):
+        goregex.go_to_python(r"(?=x)")
+
+
+def test_builtin_corpus_all_compile():
+    from trivy_tpu.rules.builtin import BUILTIN_RULES
+
+    assert len(BUILTIN_RULES) == 86  # builtin-rules.go:95-823
+    for r in BUILTIN_RULES:
+        assert isinstance(r.regex, re.Pattern)

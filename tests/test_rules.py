@@ -1,0 +1,98 @@
+"""Rule model / config assembly semantics (scanner.go:272-359)."""
+
+import textwrap
+
+from trivy_tpu.rules import (
+    BUILTIN_ALLOW_RULES,
+    BUILTIN_RULES,
+    build_ruleset,
+    load_config,
+)
+
+
+def test_builtin_counts():
+    assert len(BUILTIN_RULES) == 86
+    assert len(BUILTIN_ALLOW_RULES) == 12
+    ids = [r.id for r in BUILTIN_RULES]
+    assert len(set(ids)) == 86
+    assert "aws-access-key-id" in ids
+    assert "dockerconfig-secret" in ids
+
+
+def test_default_ruleset_uses_builtins():
+    rs = build_ruleset(None)
+    assert len(rs.rules) == 86
+    assert len(rs.allow_rules) == 12
+    assert not rs.exclude_block.regexes
+
+
+def test_config_enable_disable(tmp_path):
+    cfg = tmp_path / "trivy-secret.yaml"
+    cfg.write_text(
+        textwrap.dedent(
+            """
+            enable-builtin-rules:
+              - aws-access-key-id
+              - github-pat
+            disable-rules:
+              - github-pat
+            disable-allow-rules:
+              - markdown
+            rules:
+              - id: my-rule
+                category: custom
+                title: My Rule
+                severity: critical
+                regex: myrule-[a-z]{8}
+                keywords: [myrule-]
+            allow-rules:
+              - id: my-allow
+                path: ^skipme/
+            """
+        )
+    )
+    conf = load_config(str(cfg))
+    rs = build_ruleset(conf)
+    ids = [r.id for r in rs.rules]
+    assert ids == ["aws-access-key-id", "my-rule"]
+    assert rs.rules[1].severity == "CRITICAL"  # normalized
+    allow_ids = [a.id for a in rs.allow_rules]
+    assert "markdown" not in allow_ids
+    assert "my-allow" in allow_ids
+
+
+def test_config_missing_file_returns_none(tmp_path):
+    assert load_config(str(tmp_path / "nope.yaml")) is None
+    assert load_config("") is None
+
+
+def test_custom_severity_normalization(tmp_path):
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text(
+        textwrap.dedent(
+            """
+            rules:
+              - id: weird
+                severity: catastrophic
+                regex: zzz
+            """
+        )
+    )
+    conf = load_config(str(cfg))
+    assert conf.custom_rules[0].severity == "UNKNOWN"
+
+
+def test_keyword_match_is_case_insensitive_contains():
+    rule = next(r for r in BUILTIN_RULES if r.id == "github-pat")
+    assert rule.match_keywords(b"xx GHP_abc yy")
+    assert rule.match_keywords(b"ghp_")
+    assert not rule.match_keywords(b"nothing here")
+
+
+def test_allow_path_rules():
+    rs = build_ruleset(None)
+    assert rs.allow_path("docs/readme.md")
+    assert rs.allow_path("a/test/file.py")
+    assert rs.allow_path("pkg/vendor/lib.go")
+    assert rs.allow_path("usr/share/doc/x")
+    assert not rs.allow_path("src/main.py")

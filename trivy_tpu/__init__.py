@@ -1,0 +1,20 @@
+"""trivy_tpu — a TPU-native security scanning framework.
+
+A ground-up re-design of the capabilities of Trivy (reference: /root/reference,
+pure Go) around a JAX/XLA/Pallas compute core.  The north-star component is the
+secret-scanning engine: Trivy's per-file, per-rule regex loop
+(pkg/fanal/secret/scanner.go:371) reformulated as a batched literal-sieve +
+union-NFA confirm pipeline running on a TPU device mesh, with byte-identical
+findings to the CPU path.
+
+Package layout:
+  trivy_tpu.ftypes      — result/report data model (mirrors pkg/fanal/types + pkg/types)
+  trivy_tpu.rules       — secret rule model, builtin corpus, YAML config loading
+  trivy_tpu.engine      — goregex translation, CPU oracle, NFA compiler, device engine
+  trivy_tpu.ops         — JAX/Pallas kernels (keyword sieve, NFA step)
+  trivy_tpu.parallel    — device-mesh sharding helpers
+  trivy_tpu.scanner     — walker, analyzer registry, scan orchestration
+  trivy_tpu.report      — report writers (json/table/...)
+"""
+
+__version__ = "0.1.0"

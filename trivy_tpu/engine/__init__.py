@@ -1,0 +1,1 @@
+"""Secret-scan engines: goregex translation, CPU oracle, NFA compiler, device engine."""

@@ -1,0 +1,242 @@
+"""Core result data model.
+
+Mirrors the reference's artifact/result types (pkg/fanal/types/secret.go:1-20,
+pkg/fanal/types/artifact.go, pkg/types/report.go:13, pkg/types/result.go) so
+findings serialize into the same JSON shape Trivy emits, while staying idiomatic
+Python dataclasses internally.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any
+
+
+class ResultClass(str, Enum):
+    """Mirrors pkg/types/result.go result classes."""
+
+    OS_PKGS = "os-pkgs"
+    LANG_PKGS = "lang-pkgs"
+    CONFIG = "config"
+    SECRET = "secret"
+    LICENSE = "license"
+    LICENSE_FILE = "license-file"
+    CUSTOM = "custom"
+
+
+class ArtifactType(str, Enum):
+    """Mirrors pkg/fanal/types/artifact.go ArtifactType."""
+
+    CONTAINER_IMAGE = "container_image"
+    FILESYSTEM = "filesystem"
+    REPOSITORY = "repository"
+    CYCLONEDX = "cyclonedx"
+    SPDX = "spdx"
+    VM = "vm"
+
+
+@dataclass
+class Line:
+    """One rendered source line (pkg/fanal/types/misconf.go Line)."""
+
+    number: int
+    content: str
+    is_cause: bool = False
+    annotation: str = ""
+    truncated: bool = False
+    highlighted: str = ""
+    first_cause: bool = False
+    last_cause: bool = False
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "Number": self.number,
+            "Content": self.content,
+            "IsCause": self.is_cause,
+            "Annotation": self.annotation,
+            "Truncated": self.truncated,
+            "Highlighted": self.highlighted,
+            "FirstCause": self.first_cause,
+            "LastCause": self.last_cause,
+        }
+
+
+@dataclass
+class Code:
+    """Context lines around a finding (pkg/fanal/types/misconf.go Code)."""
+
+    lines: list[Line] = field(default_factory=list)
+
+    def to_json(self) -> dict[str, Any]:
+        return {"Lines": [ln.to_json() for ln in self.lines] or None}
+
+
+@dataclass
+class Layer:
+    """Origin layer of a finding (pkg/fanal/types/artifact.go Layer)."""
+
+    digest: str = ""
+    diff_id: str = ""
+    created_by: str = ""
+
+    def empty(self) -> bool:
+        return not (self.digest or self.diff_id or self.created_by)
+
+    def to_json(self) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        if self.digest:
+            out["Digest"] = self.digest
+        if self.diff_id:
+            out["DiffID"] = self.diff_id
+        if self.created_by:
+            out["CreatedBy"] = self.created_by
+        return out
+
+
+@dataclass
+class SecretFinding:
+    """One secret match (pkg/fanal/types/secret.go:10-20)."""
+
+    rule_id: str
+    category: str
+    severity: str
+    title: str
+    start_line: int
+    end_line: int
+    code: Code
+    match: str
+    layer: Layer = field(default_factory=Layer)
+
+    def to_json(self) -> dict[str, Any]:
+        out = {
+            "RuleID": self.rule_id,
+            "Category": self.category,
+            "Severity": self.severity,
+            "Title": self.title,
+            "StartLine": self.start_line,
+            "EndLine": self.end_line,
+            "Code": self.code.to_json(),
+            "Match": self.match,
+        }
+        if not self.layer.empty():
+            out["Layer"] = self.layer.to_json()
+        return out
+
+    def sort_key(self) -> tuple[str, str]:
+        # Deterministic ordering used by the engine (scanner.go:441-446).
+        return (self.rule_id, self.match)
+
+
+@dataclass
+class Secret:
+    """Per-file secret scan result (pkg/fanal/types/secret.go:5-8)."""
+
+    file_path: str = ""
+    findings: list[SecretFinding] = field(default_factory=list)
+
+
+@dataclass
+class Result:
+    """One result block in a report (pkg/types/result.go Result)."""
+
+    target: str
+    result_class: ResultClass
+    result_type: str = ""
+    secrets: list[SecretFinding] = field(default_factory=list)
+    vulnerabilities: list[Any] = field(default_factory=list)
+    misconfigurations: list[Any] = field(default_factory=list)
+    licenses: list[Any] = field(default_factory=list)
+    packages: list[Any] = field(default_factory=list)
+
+    def is_empty(self) -> bool:
+        return not (
+            self.secrets
+            or self.vulnerabilities
+            or self.misconfigurations
+            or self.licenses
+        )
+
+    def to_json(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "Target": self.target,
+            "Class": self.result_class.value,
+        }
+        if self.result_type:
+            out["Type"] = self.result_type
+        if self.vulnerabilities:
+            out["Vulnerabilities"] = [
+                v.to_json() if hasattr(v, "to_json") else v
+                for v in self.vulnerabilities
+            ]
+        if self.misconfigurations:
+            out["Misconfigurations"] = [
+                m.to_json() if hasattr(m, "to_json") else m
+                for m in self.misconfigurations
+            ]
+        if self.secrets:
+            out["Secrets"] = [s.to_json() for s in self.secrets]
+        if self.licenses:
+            out["Licenses"] = [
+                l.to_json() if hasattr(l, "to_json") else l for l in self.licenses
+            ]
+        return out
+
+
+@dataclass
+class Metadata:
+    """Report metadata (pkg/types/report.go Metadata)."""
+
+    image_id: str = ""
+    diff_ids: list[str] = field(default_factory=list)
+    repo_tags: list[str] = field(default_factory=list)
+    repo_digests: list[str] = field(default_factory=list)
+    os_family: str = ""
+    os_name: str = ""
+
+    def to_json(self) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        if self.os_family:
+            out["OS"] = {"Family": self.os_family, "Name": self.os_name}
+        if self.image_id:
+            out["ImageID"] = self.image_id
+        if self.diff_ids:
+            out["DiffIDs"] = self.diff_ids
+        if self.repo_tags:
+            out["RepoTags"] = self.repo_tags
+        if self.repo_digests:
+            out["RepoDigests"] = self.repo_digests
+        return out
+
+
+SCHEMA_VERSION = 2  # pkg/types/report.go:11 SchemaVersion
+
+
+@dataclass
+class Report:
+    """Top-level scan report (pkg/types/report.go:13)."""
+
+    artifact_name: str
+    artifact_type: ArtifactType
+    results: list[Result] = field(default_factory=list)
+    metadata: Metadata = field(default_factory=Metadata)
+    schema_version: int = SCHEMA_VERSION
+    created_at: str = ""
+
+    def to_json(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "SchemaVersion": self.schema_version,
+        }
+        if self.created_at:
+            out["CreatedAt"] = self.created_at
+        out["ArtifactName"] = self.artifact_name
+        out["ArtifactType"] = self.artifact_type.value
+        out["Metadata"] = self.metadata.to_json()
+        if self.results:
+            out["Results"] = [r.to_json() for r in self.results]
+        return out
+
+
+def asdict_shallow(obj: Any) -> dict[str, Any]:
+    return {f.name: getattr(obj, f.name) for f in dataclasses.fields(obj)}

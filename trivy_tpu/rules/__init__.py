@@ -1,0 +1,12 @@
+"""Secret rule model, builtin corpus and YAML config loading."""
+
+from trivy_tpu.rules.model import (  # noqa: F401
+    AllowRule,
+    ExcludeBlock,
+    Rule,
+    SecretConfig,
+    RuleSet,
+    build_ruleset,
+    load_config,
+)
+from trivy_tpu.rules.builtin import BUILTIN_RULES, BUILTIN_ALLOW_RULES  # noqa: F401
