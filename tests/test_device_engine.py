@@ -1,0 +1,143 @@
+"""Device engine parity: TpuSecretEngine findings == oracle findings, exactly.
+
+Runs on the CPU backend (8 virtual devices via conftest); also exercises the
+sharded sieve over a Mesh.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from trivy_tpu.engine.device import TpuSecretEngine
+from trivy_tpu.engine.oracle import OracleScanner
+from trivy_tpu.scanner.packing import pack
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return TpuSecretEngine(tile_len=512)
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    return OracleScanner()
+
+
+def _gen_corpus(rng: random.Random, n_files: int) -> list[tuple[str, bytes]]:
+    up = "ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+    alnum = up + up.lower() + "0123456789"
+    hexl = "0123456789abcdef"
+
+    def pick(chars, n):
+        return "".join(rng.choice(chars) for _ in range(n)).encode()
+
+    fillers = [
+        b"import os\nvalue = compute()\n",
+        b"# config for service\nname: app\nreplicas: 3\n",
+        b"func main() { fmt.Println(42) }\n",
+        b"const data = { key: 'value', other: [1,2,3] };\n",
+    ]
+    secret_makers = [
+        lambda: b"ghp_" + pick(alnum, 36),
+        lambda: b'"AKIA' + pick(up + "0123456789", 16) + b'" ',
+        lambda: b"sk_live_" + pick("0123456789abcdefghij", 20),
+        lambda: b"SK" + pick(hexl, 32),
+        lambda: b"pul-" + pick(hexl, 40),
+        lambda: b"glpat-" + pick(alnum, 20),
+        lambda: b"hf_" + pick(alnum, 39),
+        lambda: b'facebook_secret = "' + pick(hexl, 32) + b'"',
+        lambda: b"xoxp-" + pick(alnum, 24),
+        lambda: b"rubygems_" + pick(hexl, 48),
+    ]
+    out = []
+    for i in range(n_files):
+        parts = [rng.choice(fillers) * rng.randint(1, 30)]
+        if rng.random() < 0.5:  # half the files contain secrets
+            for _ in range(rng.randint(1, 3)):
+                parts.append(b"x = " + rng.choice(secret_makers)() + b"\n")
+                parts.append(rng.choice(fillers) * rng.randint(0, 10))
+        rng.shuffle(parts)
+        out.append((f"src/file_{i}.py", b"".join(parts)))
+    return out
+
+
+def _findings_tuple(secret):
+    return [
+        (f.rule_id, f.severity, f.start_line, f.end_line, f.match)
+        for f in secret.findings
+    ]
+
+
+def test_batch_parity_with_oracle(engine, oracle):
+    rng = random.Random(1234)
+    corpus = _gen_corpus(rng, 60)
+    device_results = engine.scan_batch(corpus)
+    for (path, content), dev in zip(corpus, device_results):
+        ref = oracle.scan(path, content)
+        assert _findings_tuple(dev) == _findings_tuple(ref), path
+
+
+def test_large_file_tiling_parity(engine, oracle):
+    # File much larger than tile_len; secrets placed near tile boundaries.
+    rng = random.Random(5)
+    filler = b"0" * 505
+    tok = b"ghp_" + b"Zz" * 18
+    content = filler + tok + filler + b"\npul-" + b"ab" * 20 + b"\n" + filler
+    dev = engine.scan("big/file.txt", content)
+    ref = oracle.scan("big/file.txt", content)
+    assert _findings_tuple(dev) == _findings_tuple(ref)
+    assert len(dev.findings) == 2
+
+
+def test_secret_straddling_tile_boundary(oracle):
+    eng = TpuSecretEngine(tile_len=128)
+    # Position a token to straddle the 128-byte tile boundary.
+    for offset in (80, 100, 110, 120, 126):
+        content = b"A" * offset + b" ghp_" + b"Qq" * 18 + b" tail"
+        dev = eng.scan("x.py", content)
+        ref = oracle.scan("x.py", content)
+        assert _findings_tuple(dev) == _findings_tuple(ref), offset
+
+
+def test_empty_and_tiny_files(engine):
+    results = engine.scan_batch([("a.py", b""), ("b.py", b"xy"), ("c.py", b"\n\n")])
+    assert all(not r.findings for r in results)
+
+
+def test_allow_path_handled(engine, oracle):
+    tok = b"x = ghp_" + b"Ww" * 18
+    assert engine.scan("README.md", tok).findings == []
+    assert engine.scan("pkg/vendor/x.py", tok).findings == []
+    # `\/vendor\/` needs a leading slash: bare "vendor/..." is NOT suppressed
+    assert len(engine.scan("vendor/x.py", tok).findings) == 1
+
+
+def test_sharded_sieve_matches_unsharded():
+    import jax
+    from jax.sharding import Mesh
+
+    devices = np.array(jax.devices())
+    mesh = Mesh(devices, ("data",))
+    eng_mesh = TpuSecretEngine(tile_len=256, mesh=mesh)
+    eng_plain = TpuSecretEngine(tile_len=256)
+    rng = random.Random(9)
+    corpus = _gen_corpus(rng, 24)
+    a = eng_mesh.scan_batch(corpus)
+    b = eng_plain.scan_batch(corpus)
+    assert [_findings_tuple(x) for x in a] == [_findings_tuple(x) for x in b]
+
+
+def test_packing_roundtrip():
+    contents = [b"a" * 10, b"b" * 5000, b"", b"c" * 4096]
+    batch = pack(contents, tile_len=1024, overlap=16)
+    # every byte of every file must appear in some tile at the right offset
+    for fi, c in enumerate(contents):
+        tiles_of = np.flatnonzero(batch.tile_file == fi)
+        recovered = bytearray(len(c))
+        stride = 1024 - 16
+        for k, t in enumerate(tiles_of):
+            start = k * stride
+            chunk = bytes(batch.tiles[t])[: min(1024, len(c) - start)]
+            recovered[start : start + len(chunk)] = chunk
+        assert bytes(recovered) == c

@@ -143,7 +143,7 @@ def test_named_group_censors_only_group():
 def test_find_location_first_line():
     start_line, end_line, code, match_line = find_location(0, 3, b"abcdef\nsecond")
     assert start_line == 1 and end_line == 1
-    assert match_line == "abcdef"
+    assert match_line == b"abcdef"
 
 
 def test_severity_unknown_when_empty():

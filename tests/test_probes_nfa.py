@@ -1,0 +1,130 @@
+"""Probe sieve + union NFA: soundness against the oracle.
+
+The sieve and NFA are over-approximations: every rule the oracle matches MUST
+be flagged by the sieve (and the NFA); the reverse need not hold.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from trivy_tpu.engine.nfa import compile_rules, simulate
+from trivy_tpu.engine.oracle import OracleScanner
+from trivy_tpu.engine.probes import build_probe_set, candidate_rules, sieve_hits_numpy
+from trivy_tpu.rules import BUILTIN_RULES
+
+
+@pytest.fixture(scope="module")
+def pset():
+    return build_probe_set(BUILTIN_RULES)
+
+
+@pytest.fixture(scope="module")
+def nfa():
+    return compile_rules(BUILTIN_RULES)
+
+
+def _secret_samples(rng: random.Random) -> list[bytes]:
+    """Synthetic secrets for a spread of builtin rules."""
+    up = "ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+    alnum = up + up.lower() + "0123456789"
+    hexl = "0123456789abcdef"
+
+    def pick(chars, n):
+        return "".join(rng.choice(chars) for _ in range(n)).encode()
+
+    return [
+        b"ghp_" + pick(alnum, 36),
+        b"gho_" + pick(alnum, 36),
+        b"ghu_" + pick(alnum, 36),
+        b'"AKIA' + pick(up + "0123456789", 16) + b'" ',
+        b"xoxb-" + pick(alnum, 20),
+        b"sk_live_" + pick("0123456789abcdefghij", 20),
+        b"SK" + pick(hexl, 32),
+        b"dapi" + pick("abcdefgh01234567", 32),
+        b"pul-" + pick(hexl, 40),
+        b"rubygems_" + pick(hexl, 48),
+        b"shippo_live_" + pick(hexl, 40),
+        b"AGE-SECRET-KEY-1" + pick("QPZRY9X8GF2TVDW0S3JN54KHCE6MUA7L", 58),
+        b"hf_" + pick(alnum, 39),
+        b"glpat-" + pick(alnum, 20),
+        b" heroku_api_key = '"
+        + pick("0123456789ABCDEF", 8) + b"-" + pick("0123456789ABCDEF", 4) + b"-"
+        + pick("0123456789ABCDEF", 4) + b"-" + pick("0123456789ABCDEF", 4) + b"-"
+        + pick("0123456789ABCDEF", 12) + b"'",
+        b'facebook_token = "' + pick(hexl, 32) + b'"',
+        b"jwt = ey" + pick(alnum, 20) + b".ey" + pick(alnum, 24) + b"." + pick(alnum, 27),
+        b'npm_config = "npm_' + pick(alnum.lower() + "0123456789", 36) + b'"',
+    ]
+
+
+_FILLER = (
+    b"import os\nclass Config:\n    def load(self):\n        return os.environ\n"
+    b"# configuration values for the deployment pipeline\nvalue = compute(1, 2)\n"
+)
+
+
+def test_sieve_superset_of_oracle(pset):
+    rng = random.Random(42)
+    oracle = OracleScanner()
+    for trial, secret in enumerate(_secret_samples(rng)):
+        content = _FILLER + b"x = " + secret + b"\n" + _FILLER
+        res = oracle.scan("src/app.py", content)
+        matched_ids = {f.rule_id for f in res.findings}
+        hits = sieve_hits_numpy(content, pset)
+        cand_ids = {pset.plans[i].rule_id for i in candidate_rules(hits, pset)}
+        assert matched_ids <= cand_ids, (
+            f"trial {trial}: sieve missed {matched_ids - cand_ids} for {secret!r}"
+        )
+
+
+def test_nfa_superset_of_oracle(nfa):
+    rng = random.Random(7)
+    oracle = OracleScanner()
+    for trial, secret in enumerate(_secret_samples(rng)):
+        content = b"prefix " + secret + b" suffix\n"
+        res = oracle.scan("src/app.py", content)
+        matched_ids = {f.rule_id for f in res.findings}
+        ends = simulate(nfa, content)
+        nfa_ids = {nfa.rule_ids[i] for i in np.flatnonzero(ends)}
+        assert matched_ids <= nfa_ids, (
+            f"trial {trial}: NFA missed {matched_ids - nfa_ids} for {secret!r}"
+        )
+
+
+def test_sieve_benign_selectivity(pset):
+    benign = (
+        b"def handler(request):\n"
+        b"    api_key = settings.lookup('service')\n"
+        b"    return Response(request.data, status=200)\n"
+    ) * 30
+    hits = sieve_hits_numpy(benign, pset)
+    cands = candidate_rules(hits, pset)
+    # A couple of generic rules may pass; the bulk must be filtered out.
+    assert len(cands) <= 5, [pset.plans[i].rule_id for i in cands]
+
+
+def test_nfa_benign_no_flags(nfa):
+    benign = b"def main():\n    return fetch(key='name')\n" * 30
+    ends = simulate(nfa, benign)
+    assert not ends.any()
+
+
+def test_probe_classes_never_accept_nul(pset):
+    for p in pset.probes:
+        for bs in p.classes:
+            assert not bs & 1, "probe class accepts 0x00 padding byte"
+
+
+def test_every_rule_has_gate_or_anchor(pset):
+    for plan in pset.plans:
+        assert plan.gate_probe_ids or plan.anchor_conjuncts, plan.rule_id
+
+
+def test_tile_boundary_padding(pset):
+    # A match ending exactly at content end must still be sieved.
+    secret = b"ghp_" + b"q1" * 18
+    hits = sieve_hits_numpy(secret, pset)
+    ids = {pset.plans[i].rule_id for i in candidate_rules(hits, pset)}
+    assert "github-pat" in ids

@@ -205,14 +205,9 @@ def _translate(s: str, i: int, flags: frozenset[str]) -> tuple[str, int]:
 
 def go_to_python(pattern: str) -> str:
     """Translate a Go RE2 pattern into an equivalent Python re pattern (str form)."""
-    flags: frozenset[str] = frozenset()
-    fl = _parse_inline_flags(pattern, 0)
-    # A leading global "(?flags)" is valid at position 0 in Python too, but we
-    # normalize it into a scoped group so nested rewrites compose.
-    text, i = _translate(pattern, 0, flags)
+    text, i = _translate(pattern, 0, frozenset())
     if i != len(pattern):
         raise GoRegexError(f"unbalanced ')' at {i} in {pattern!r}")
-    del fl
     return text
 
 

@@ -112,7 +112,14 @@ class OracleScanner:
         return self.allow(match) or rule.allow(match)
 
     # -- scanner.go:371-452 --
-    def scan(self, file_path: str, content: bytes) -> Secret:
+    def scan(
+        self, file_path: str, content: bytes, rule_indices: list[int] | None = None
+    ) -> Secret:
+        """Scan content.  `rule_indices` optionally restricts the rule loop to a
+        subset (in original order); findings are identical to a full scan as
+        long as the subset contains every rule that actually matches — this is
+        how device-sieve candidates are confirmed without re-running all rules.
+        """
         if self.allow_path(file_path):
             return Secret(file_path=file_path)
 
@@ -121,7 +128,12 @@ class OracleScanner:
         global_excluded = _Blocks(content, self.ruleset.exclude_block.regexes)
         lowered = content.lower()  # shared keyword-prefilter buffer
 
-        for rule in self.ruleset.rules:
+        rules = (
+            self.ruleset.rules
+            if rule_indices is None
+            else [self.ruleset.rules[i] for i in rule_indices]
+        )
+        for rule in rules:
             if not rule.match_path(file_path):
                 continue
             if rule.allow_path(file_path):
@@ -159,15 +171,19 @@ def to_finding(rule: Rule, loc: Location, content: bytes) -> SecretFinding:
         category=rule.category,
         severity=rule.severity if rule.severity else "UNKNOWN",
         title=rule.title,
-        match=match_line,
+        match=match_line.decode("utf-8", errors="replace"),
+        match_bytes=match_line,
         start_line=start_line,
         end_line=end_line,
         code=code,
     )
 
 
-def find_location(start: int, end: int, content: bytes) -> tuple[int, int, Code, str]:
-    """scanner.go:481-537 — line numbers, truncated match line, context code."""
+def find_location(start: int, end: int, content: bytes) -> tuple[int, int, Code, bytes]:
+    """scanner.go:481-537 — line numbers, truncated match line, context code.
+
+    The match line is returned as raw bytes (Go keeps it as a string over the
+    original bytes); callers decode for display but sort on the bytes."""
     start_line_num = content.count(b"\n", 0, start)
 
     line_start = content.rfind(b"\n", 0, start)
@@ -183,7 +199,7 @@ def find_location(start: int, end: int, content: bytes) -> tuple[int, int, Code,
     if line_end - line_start > 100:
         line_start = 0 if start - 30 < 0 else start - 30
         line_end = len(content) if end + 20 > len(content) else end + 20
-    match_line = content[line_start:line_end].decode("utf-8", errors="replace")
+    match_line = content[line_start:line_end]
     end_line_num = start_line_num + content.count(b"\n", start, end)
 
     code = Code()

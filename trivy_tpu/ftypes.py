@@ -108,6 +108,10 @@ class SecretFinding:
     code: Code
     match: str
     layer: Layer = field(default_factory=Layer)
+    # Raw bytes of the match line, used only for Go-compatible bytewise sort
+    # ordering (Go sorts the raw string; decoding with errors="replace" first
+    # would collapse distinct invalid bytes).  Not serialized.
+    match_bytes: bytes = b""
 
     def to_json(self) -> dict[str, Any]:
         out = {
@@ -124,9 +128,10 @@ class SecretFinding:
             out["Layer"] = self.layer.to_json()
         return out
 
-    def sort_key(self) -> tuple[str, str]:
-        # Deterministic ordering used by the engine (scanner.go:441-446).
-        return (self.rule_id, self.match)
+    def sort_key(self) -> tuple[str, bytes]:
+        # Deterministic ordering used by the engine (scanner.go:441-446); Go
+        # compares the raw Match bytes.
+        return (self.rule_id, self.match_bytes or self.match.encode("utf-8", "replace"))
 
 
 @dataclass

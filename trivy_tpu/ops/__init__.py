@@ -1,0 +1,1 @@
+"""JAX/Pallas device ops: the packed shift-AND sieve and NFA state stepping."""

@@ -1,0 +1,1 @@
+"""Host-side scanning pipeline: walker, analyzers, packing, orchestration."""

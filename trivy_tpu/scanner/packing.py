@@ -1,0 +1,72 @@
+"""Blob packing: variable-length file contents -> fixed-shape device tiles.
+
+The TPU analogue of the reference's per-file goroutine fan-out
+(pkg/fanal/analyzer/analyzer.go:396-448): instead of N workers over N files,
+files are packed into a [T, tile_len] uint8 matrix whose rows are processed
+data-parallel.  Consecutive tiles of one file overlap by `overlap` bytes so a
+probe (length <= overlap) never straddles a tile boundary undetected; file
+tails are zero-padded (probe classes exclude 0x00, so padding can't fire).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+DEFAULT_TILE_LEN = 4096
+DEFAULT_OVERLAP = 16
+
+
+@dataclass
+class PackedBatch:
+    tiles: np.ndarray  # [T, tile_len] uint8
+    tile_file: np.ndarray  # [T] int32 — which input blob each tile came from
+    num_files: int
+
+    def file_hits(self, tile_hits: np.ndarray) -> np.ndarray:
+        """OR-combine per-tile hit bitmaps [T, Pw] into per-file bitmaps [F, Pw]."""
+        pw = tile_hits.shape[1]
+        out = np.zeros((self.num_files, pw), dtype=tile_hits.dtype)
+        real = self.tile_file >= 0
+        np.bitwise_or.at(out, self.tile_file[real], tile_hits[: len(self.tile_file)][real])
+        return out
+
+
+def _tile_counts(contents: list[bytes], tile_len: int, overlap: int) -> list[int]:
+    stride = tile_len - overlap
+    counts = []
+    for c in contents:
+        extra = max(len(c) + overlap - tile_len, 0)
+        counts.append(1 + (-(-extra // stride) if extra else 0))
+    return counts
+
+
+def count_tiles(contents: list[bytes], tile_len: int, overlap: int) -> int:
+    return sum(_tile_counts(contents, tile_len, overlap))
+
+
+def pack(
+    contents: list[bytes],
+    tile_len: int = DEFAULT_TILE_LEN,
+    overlap: int = DEFAULT_OVERLAP,
+    pad_tiles_to: int | None = None,
+) -> PackedBatch:
+    stride = tile_len - overlap
+    counts = _tile_counts(contents, tile_len, overlap)
+    total = sum(counts)
+    t_alloc = max(pad_tiles_to, total) if pad_tiles_to is not None else total
+    tiles = np.zeros((t_alloc, tile_len), dtype=np.uint8)
+    tile_file = np.full(t_alloc, -1, dtype=np.int32)
+
+    t = 0
+    for fi, c in enumerate(contents):
+        data = np.frombuffer(c, dtype=np.uint8)
+        for k in range(counts[fi]):
+            start = k * stride
+            chunk = data[start : start + tile_len]
+            tiles[t, : len(chunk)] = chunk
+            tile_file[t] = fi
+            t += 1
+
+    return PackedBatch(tiles=tiles, tile_file=tile_file, num_files=len(contents))
