@@ -1,0 +1,95 @@
+"""Benchmark: secret-scan throughput, device engine vs CPU oracle.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Corpus: synthetic source/config-like text files, hit-sparse (~1% of files
+contain a planted secret) — the shape of BASELINE.md config #3 (throughput on
+a hit-sparse monorepo, keyword-prefilter dominated).  Baseline is the CPU
+oracle engine (the faithful reimplementation of the reference's Go scan loop,
+pkg/fanal/secret/scanner.go:371) on the same corpus, measured on a subset and
+extrapolated.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+N_FILES = int(__import__("os").environ.get("BENCH_FILES", "4000"))
+FILE_LEN = int(__import__("os").environ.get("BENCH_FILE_LEN", "2048"))
+ORACLE_SUBSET = 200
+
+_WORDS = (
+    b"import os sys json yaml config server client request response data key value "
+    b"def class return self result error status http port host path file read write "
+    b"update delete create index table user name password token session cache log "
+).split()
+
+
+def make_corpus(n_files: int, file_len: int) -> list[tuple[str, bytes]]:
+    rng = np.random.RandomState(42)
+    corpus = []
+    for i in range(n_files):
+        words = [bytes(_WORDS[j]) for j in rng.randint(0, len(_WORDS), size=file_len // 6)]
+        body = b" ".join(words)[:file_len]
+        lines = [body[k : k + 64] for k in range(0, len(body), 64)]
+        blob = b"\n".join(lines)
+        if i % 100 == 0:  # 1% planted secrets
+            blob += b"\nAWS_ACCESS_KEY_ID=AKIAQ6FAKEKEY1234567\n"
+        corpus.append((f"src/mod{i // 100}/file{i}.py", blob))
+    return corpus
+
+
+def main() -> None:
+    from trivy_tpu.engine.device import TpuSecretEngine
+    from trivy_tpu.engine.oracle import OracleScanner
+
+    corpus = make_corpus(N_FILES, FILE_LEN)
+    total_bytes = sum(len(c) for _, c in corpus)
+
+    engine = TpuSecretEngine()
+    engine.warmup()  # compile all tile-bucket shapes outside the timed region
+
+    t0 = time.perf_counter()
+    results = engine.scan_batch(corpus)
+    device_s = time.perf_counter() - t0
+    n_findings = sum(len(r.findings) for r in results)
+
+    oracle = OracleScanner()
+    t0 = time.perf_counter()
+    oracle_results = [oracle.scan(p, c) for p, c in corpus[:ORACLE_SUBSET]]
+    oracle_s = (time.perf_counter() - t0) * (len(corpus) / ORACLE_SUBSET)
+
+    # Parity check on the subset (sanity, not part of the timing).
+    for i, ores in enumerate(oracle_results):
+        assert [f.to_json() for f in results[i].findings] == [
+            f.to_json() for f in ores.findings
+        ], f"parity mismatch on {corpus[i][0]}"
+
+    files_per_sec = len(corpus) / device_s
+    baseline_files_per_sec = len(corpus) / oracle_s
+
+    print(
+        json.dumps(
+            {
+                "metric": "secret_scan_files_per_sec",
+                "value": round(files_per_sec, 1),
+                "unit": "files/s",
+                "vs_baseline": round(files_per_sec / baseline_files_per_sec, 2),
+                "detail": {
+                    "files": len(corpus),
+                    "bytes": total_bytes,
+                    "mb_per_sec": round(total_bytes / device_s / 1e6, 1),
+                    "findings": n_findings,
+                    "device_s": round(device_s, 3),
+                    "oracle_files_per_sec": round(baseline_files_per_sec, 1),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

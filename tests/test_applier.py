@@ -1,0 +1,104 @@
+"""Layer-squash semantics (mirrors pkg/fanal/applier/docker_test.go patterns)."""
+
+from trivy_tpu.applier.apply import apply_layers
+from trivy_tpu.atypes import Application, BlobInfo, OS, Package, PackageInfo
+from trivy_tpu.ftypes import Code, Secret, SecretFinding
+
+
+def _finding(rule_id: str, sev: str = "CRITICAL") -> SecretFinding:
+    return SecretFinding(
+        rule_id=rule_id,
+        category="x",
+        severity=sev,
+        title="t",
+        start_line=1,
+        end_line=1,
+        code=Code(),
+        match="m",
+    )
+
+
+def test_os_merge_and_packages_overwrite():
+    layers = [
+        BlobInfo(
+            diff_id="sha256:l1",
+            os=OS(family="alpine", name="3.15"),
+            package_infos=[
+                PackageInfo(
+                    file_path="lib/apk/db/installed",
+                    packages=[Package(name="musl", version="1.2.2")],
+                )
+            ],
+        ),
+        BlobInfo(
+            diff_id="sha256:l2",
+            package_infos=[
+                PackageInfo(
+                    file_path="lib/apk/db/installed",
+                    packages=[Package(name="musl", version="1.2.3")],
+                )
+            ],
+        ),
+    ]
+    detail = apply_layers(layers)
+    assert detail.os.family == "alpine"
+    assert len(detail.packages) == 1
+    assert detail.packages[0].version == "1.2.3"  # upper layer wins
+
+
+def test_whiteout_removes_application():
+    layers = [
+        BlobInfo(
+            diff_id="sha256:l1",
+            applications=[
+                Application(app_type="npm", file_path="app/package-lock.json")
+            ],
+        ),
+        BlobInfo(diff_id="sha256:l2", whiteout_files=["app/package-lock.json"]),
+    ]
+    detail = apply_layers(layers)
+    assert detail.applications == []
+
+
+def test_opaque_dir_removes_subtree():
+    layers = [
+        BlobInfo(
+            diff_id="sha256:l1",
+            applications=[Application(app_type="npm", file_path="app/a/pkg.json")],
+        ),
+        BlobInfo(diff_id="sha256:l2", opaque_dirs=["app/"]),
+    ]
+    detail = apply_layers(layers)
+    assert detail.applications == []
+
+
+def test_secrets_survive_deletion_and_upper_layer_overwrites():
+    # docker.go:308-331: secrets persist across layers; same RuleID is
+    # overwritten by the upper layer.
+    layers = [
+        BlobInfo(
+            diff_id="sha256:l1",
+            secrets=[
+                Secret(
+                    file_path="/etc/secret.env",
+                    findings=[_finding("aws-access-key-id"), _finding("github-pat")],
+                )
+            ],
+        ),
+        BlobInfo(
+            diff_id="sha256:l2",
+            secrets=[
+                Secret(
+                    file_path="/etc/secret.env",
+                    findings=[_finding("aws-access-key-id", sev="HIGH")],
+                )
+            ],
+        ),
+    ]
+    detail = apply_layers(layers)
+    assert len(detail.secrets) == 1
+    findings = {f.rule_id: f for f in detail.secrets[0].findings}
+    assert set(findings) == {"aws-access-key-id", "github-pat"}
+    assert findings["aws-access-key-id"].severity == "HIGH"  # upper layer version
+    assert findings["aws-access-key-id"].layer.diff_id == "sha256:l2"
+    assert findings["github-pat"].layer.diff_id == "sha256:l1"

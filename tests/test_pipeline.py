@@ -1,0 +1,157 @@
+"""End-to-end fs-scan pipeline tests (the integration-test tier of SURVEY §4,
+run in-process like integration/integration_test.go does with commands.NewApp)."""
+
+import json
+import io
+
+import pytest
+
+from trivy_tpu.cli import main
+from trivy_tpu.commands.run import Options, run
+
+
+# NB: must not contain "example"/"test" — builtin allow rules suppress those
+# (builtin-allow-rules.go "examples" has a content regex, not just a path).
+AWS_KEY_FILE = b'AWS_ACCESS_KEY_ID=AKIAQ6FAKEKEY1234567\nregion = "us-east-1"\n'
+GITHUB_PAT = b"token = ghp_" + b"0123456789abcdefghij0123456789abcdef"[:36] + b"\n"
+
+
+@pytest.fixture
+def corpus(tmp_path):
+    (tmp_path / "aws.env").write_bytes(AWS_KEY_FILE)
+    (tmp_path / "clean.py").write_bytes(b"print('hello world, nothing here')\n")
+    (tmp_path / "sub").mkdir()
+    (tmp_path / "sub" / "gh.cfg").write_bytes(GITHUB_PAT)
+    (tmp_path / "node_modules").mkdir()
+    (tmp_path / "node_modules" / "leak.env").write_bytes(AWS_KEY_FILE)
+    (tmp_path / "img.png").write_bytes(AWS_KEY_FILE)  # skipped by extension
+    return tmp_path
+
+
+def _scan(tmp_path, corpus, backend="cpu", **kw):
+    out = tmp_path / f"report-{backend}.json"
+    opts = Options(
+        target=str(corpus),
+        scanners=["secret"],
+        format="json",
+        output=str(out),
+        secret_backend=backend,
+        **kw,
+    )
+    code = run(opts, "fs")
+    return code, json.loads(out.read_text())
+
+
+def test_fs_scan_finds_planted_secrets(tmp_path, corpus):
+    code, report = _scan(tmp_path, corpus)
+    assert code == 0
+    assert report["SchemaVersion"] == 2
+    assert report["ArtifactType"] == "filesystem"
+    targets = {r["Target"]: r for r in report["Results"]}
+    assert "aws.env" in targets
+    aws = targets["aws.env"]["Secrets"]
+    assert any(s["RuleID"] == "aws-access-key-id" for s in aws)
+    # censored match
+    assert any("****" in s["Match"] for s in aws)
+    # skip dirs and binary extensions honored
+    assert not any("node_modules" in t for t in targets)
+    assert "img.png" not in targets
+
+
+def test_tpu_and_cpu_backends_agree(tmp_path, corpus):
+    _, cpu_report = _scan(tmp_path, corpus, backend="cpu")
+    _, tpu_report = _scan(tmp_path, corpus, backend="tpu")
+    assert cpu_report["Results"] == tpu_report["Results"]
+
+
+def test_severity_filter(tmp_path, corpus):
+    _, report = _scan(tmp_path, corpus, severities=["LOW"])
+    assert not any(r.get("Secrets") for r in report.get("Results", []))
+
+
+def test_exit_code(tmp_path, corpus):
+    code, _ = _scan(tmp_path, corpus, exit_code=5)
+    assert code == 5
+
+    clean = tmp_path / "cleandir"
+    clean.mkdir()
+    (clean / "ok.txt").write_bytes(b"nothing secret here at all")
+    opts = Options(
+        target=str(clean), scanners=["secret"], format="json",
+        output=str(tmp_path / "clean.json"), exit_code=5, secret_backend="cpu",
+    )
+    assert run(opts, "fs") == 0
+
+
+def test_ignore_file(tmp_path, corpus):
+    ign = tmp_path / ".trivyignore"
+    ign.write_text("aws-access-key-id\n")
+    _, report = _scan(tmp_path, corpus, ignore_file=str(ign))
+    for r in report.get("Results", []):
+        assert not any(
+            s["RuleID"] == "aws-access-key-id" for s in r.get("Secrets", [])
+        )
+
+
+def test_table_and_sarif_writers(tmp_path, corpus):
+    from trivy_tpu.report.writer import write_report
+    from trivy_tpu.commands.convert import report_from_json
+
+    _, report_json = _scan(tmp_path, corpus)
+    report = report_from_json(report_json)
+
+    table_out = io.StringIO()
+    write_report(report, "table", table_out)
+    assert "aws-access-key-id" in table_out.getvalue()
+
+    sarif_out = io.StringIO()
+    write_report(report, "sarif", sarif_out)
+    sarif = json.loads(sarif_out.getvalue())
+    assert sarif["version"] == "2.1.0"
+    assert any(
+        r["ruleId"] == "secret:aws-access-key-id" for r in sarif["runs"][0]["results"]
+    )
+
+
+def test_cli_main_version(capsys):
+    assert main(["version"]) == 0
+    assert "trivy-tpu version" in capsys.readouterr().out
+
+
+def test_cli_fs_scan(tmp_path, corpus, capsys):
+    code = main(
+        [
+            "fs",
+            "--scanners",
+            "secret",
+            "--secret-backend",
+            "cpu",
+            "-f",
+            "json",
+            str(corpus),
+        ]
+    )
+    assert code == 0
+    report = json.loads(capsys.readouterr().out)
+    assert any(
+        s["RuleID"] == "aws-access-key-id"
+        for r in report["Results"]
+        for s in r.get("Secrets", [])
+    )
+
+
+def test_convert_roundtrip(tmp_path, corpus, capsys):
+    _, report_json = _scan(tmp_path, corpus)
+    path = tmp_path / "saved.json"
+    path.write_text(json.dumps(report_json))
+    assert main(["convert", "-f", "table", str(path)]) == 0
+    assert "aws-access-key-id" in capsys.readouterr().out
+
+
+def test_fs_cache_backend(tmp_path, corpus):
+    cache_dir = tmp_path / "cache"
+    _, report = _scan(
+        tmp_path, corpus, cache_backend="fs", cache_dir=str(cache_dir)
+    )
+    assert (cache_dir / "fanal" / "blob").iterdir()
+    assert any(r.get("Secrets") for r in report["Results"])

@@ -1,0 +1,48 @@
+"""Walker skip semantics (mirrors pkg/fanal/walker tests)."""
+
+import os
+
+from trivy_tpu.walker.fs import FSWalker, WalkOption, skip_path
+
+
+def test_skip_path_doublestar():
+    assert skip_path("a/b/.git", ["**/.git"])
+    assert skip_path(".git", ["**/.git"])
+    assert not skip_path("a/b/.github", ["**/.git"])
+    assert skip_path("proc", ["proc"])
+    assert not skip_path("a/proc", ["proc"])
+    assert skip_path("foo/bar.txt", ["foo/*.txt"])
+    assert not skip_path("foo/baz/bar.txt", ["foo/*.txt"])
+    assert skip_path("foo/baz/bar.txt", ["foo/**"])
+
+
+def test_walk_skips_and_yields(tmp_path):
+    (tmp_path / "keep.txt").write_bytes(b"hello world secret")
+    (tmp_path / ".git").mkdir()
+    (tmp_path / ".git" / "config").write_bytes(b"ref: main")
+    (tmp_path / "sub").mkdir()
+    (tmp_path / "sub" / "nested.py").write_bytes(b"x = 1")
+    os.symlink(tmp_path / "keep.txt", tmp_path / "link.txt")
+
+    entries = {e.path for e in FSWalker().walk(str(tmp_path))}
+    assert entries == {"keep.txt", "sub/nested.py"}  # .git skipped, symlink skipped
+
+
+def test_walk_skip_files_and_dirs(tmp_path):
+    (tmp_path / "a.txt").write_bytes(b"a")
+    (tmp_path / "b.txt").write_bytes(b"b")
+    (tmp_path / "vendor").mkdir()
+    (tmp_path / "vendor" / "c.txt").write_bytes(b"c")
+
+    opt = WalkOption(skip_files=["a.txt"], skip_dirs=["vendor"])
+    entries = {e.path for e in FSWalker(opt).walk(str(tmp_path))}
+    assert entries == {"b.txt"}
+
+
+def test_walk_single_file(tmp_path):
+    f = tmp_path / "one.env"
+    f.write_bytes(b"KEY=value")
+    entries = list(FSWalker().walk(str(f)))
+    assert len(entries) == 1
+    assert entries[0].path == "one.env"
+    assert entries[0].opener() == b"KEY=value"

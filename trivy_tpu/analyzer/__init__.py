@@ -1,0 +1,21 @@
+from trivy_tpu.analyzer.core import (
+    AnalysisInput,
+    AnalysisResult,
+    Analyzer,
+    AnalyzerGroup,
+    AnalyzerOptions,
+    BatchAnalyzer,
+    register_analyzer,
+    registered_analyzers,
+)
+
+__all__ = [
+    "AnalysisInput",
+    "AnalysisResult",
+    "Analyzer",
+    "AnalyzerGroup",
+    "AnalyzerOptions",
+    "BatchAnalyzer",
+    "register_analyzer",
+    "registered_analyzers",
+]

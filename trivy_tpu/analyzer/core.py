@@ -1,0 +1,241 @@
+"""Analyzer plugin layer: registry, group, batched dispatch.
+
+Mirrors pkg/fanal/analyzer/analyzer.go (registry :26-27, interfaces :71-83,
+group construction :315-370, AnalyzeFile fan-out :396-448, result merge :245)
+— with one deliberate architectural change: the reference dispatches a
+goroutine per (file × analyzer); here the group first *collects* the files
+each analyzer claims, then hands batch-capable analyzers (the device secret
+engine) the whole batch at once so the TPU sees large, padded, data-parallel
+input instead of file-at-a-time calls.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Iterable
+
+from trivy_tpu.ftypes import Secret
+from trivy_tpu.walker.fs import FileEntry
+
+# ---------------------------------------------------------------------------
+# Analyzer type constants (pkg/fanal/analyzer/const.go)
+# ---------------------------------------------------------------------------
+
+TYPE_SECRET = "secret"
+TYPE_LICENSE_FILE = "license-file"
+TYPE_OS_RELEASE = "os-release"
+TYPE_APK = "apk"
+TYPE_DPKG = "dpkg"
+TYPE_RPM = "rpm"
+
+
+@dataclass
+class AnalyzerOptions:
+    """analyzer.AnalyzerOptions (analyzer.go:55-66)."""
+
+    group: str = ""
+    disabled_analyzers: list[str] = field(default_factory=list)
+    secret_scanner_option: "SecretScannerOption" = None  # type: ignore[assignment]
+    file_patterns: dict[str, list[re.Pattern[str]]] = field(default_factory=dict)
+    parallel: int = 5
+
+    def __post_init__(self) -> None:
+        if self.secret_scanner_option is None:
+            self.secret_scanner_option = SecretScannerOption()
+
+
+@dataclass
+class SecretScannerOption:
+    """analyzer.SecretScannerOption."""
+
+    config_path: str = ""
+    backend: str = "tpu"  # "tpu" (device sieve) or "cpu" (oracle)
+
+
+@dataclass
+class AnalysisInput:
+    """analyzer.AnalysisInput (analyzer.go:128-134)."""
+
+    dir: str
+    file_path: str
+    size: int
+    mode: int
+    content: bytes
+
+
+@dataclass
+class AnalysisResult:
+    """analyzer.AnalysisResult (analyzer.go:152-184) — merge + canonical sort."""
+
+    os: object | None = None
+    package_infos: list = field(default_factory=list)
+    applications: list = field(default_factory=list)
+    secrets: list[Secret] = field(default_factory=list)
+    licenses: list = field(default_factory=list)
+    misconfigs: list = field(default_factory=list)
+    configs: list = field(default_factory=list)
+    system_installed_files: list[str] = field(default_factory=list)
+
+    def merge(self, other: "AnalysisResult | None") -> None:
+        """AnalysisResult.Merge (analyzer.go:245-313)."""
+        if other is None:
+            return
+        if other.os is not None:
+            self.os = _merge_os(self.os, other.os)
+        self.package_infos.extend(other.package_infos)
+        self.applications.extend(other.applications)
+        self.secrets.extend(other.secrets)
+        self.licenses.extend(other.licenses)
+        self.misconfigs.extend(other.misconfigs)
+        self.configs.extend(other.configs)
+        self.system_installed_files.extend(other.system_installed_files)
+
+    def sort(self) -> None:
+        """AnalysisResult.Sort (analyzer.go:186-243); secrets :219-229."""
+        self.package_infos.sort(key=lambda p: p.file_path)
+        self.applications.sort(key=lambda a: a.file_path)
+        for secret in self.secrets:
+            secret.findings.sort(
+                key=lambda f: (f.rule_id, f.start_line, f.end_line)
+            )
+        self.secrets.sort(key=lambda s: s.file_path)
+        self.licenses.sort(key=lambda l: getattr(l, "file_path", ""))
+        self.misconfigs.sort(key=lambda m: getattr(m, "file_path", ""))
+
+    def is_empty(self) -> bool:
+        return not (
+            self.os
+            or self.package_infos
+            or self.applications
+            or self.secrets
+            or self.licenses
+            or self.misconfigs
+            or self.configs
+            or self.system_installed_files
+        )
+
+
+def _merge_os(base, new):
+    """types.OS merge semantics (pkg/fanal/types/artifact.go OS.Merge)."""
+    if base is None:
+        return new
+    if new is None:
+        return base
+    merged = base
+    if getattr(new, "family", ""):
+        merged.family = new.family
+    if getattr(new, "name", ""):
+        merged.name = new.name
+    if getattr(new, "extended_support", False):
+        merged.extended_support = True
+    return merged
+
+
+class Analyzer:
+    """Per-file analyzer interface (analyzer.go:71-77)."""
+
+    def type(self) -> str:
+        raise NotImplementedError
+
+    def version(self) -> int:
+        raise NotImplementedError
+
+    def required(self, file_path: str, size: int, mode: int) -> bool:
+        raise NotImplementedError
+
+    def init(self, options: AnalyzerOptions) -> None:  # analyzer.Initializer
+        pass
+
+    def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
+        raise NotImplementedError
+
+
+class BatchAnalyzer(Analyzer):
+    """Batch-capable analyzer: receives every claimed file at once.
+
+    TPU-native extension point: the secret engine implements this so blobs are
+    packed/padded/tiled as one device batch instead of per-file calls.
+    """
+
+    def analyze_batch(self, inputs: list[AnalysisInput]) -> AnalysisResult | None:
+        raise NotImplementedError
+
+    def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
+        return self.analyze_batch([inp])
+
+
+_REGISTRY: list[Callable[[], Analyzer]] = []
+
+
+def register_analyzer(factory: Callable[[], Analyzer]) -> None:
+    """analyzer.RegisterAnalyzer (analyzer.go:93)."""
+    _REGISTRY.append(factory)
+
+
+def registered_analyzers() -> list[Callable[[], Analyzer]]:
+    return list(_REGISTRY)
+
+
+def _ensure_builtin_registered() -> None:
+    # Import modules whose import side-effect registers analyzers (mirrors the
+    # reference's `_ "…/analyzer/all"` blank imports).
+    from trivy_tpu.analyzer import secret as _secret  # noqa: F401
+
+
+class AnalyzerGroup:
+    """analyzer.AnalyzerGroup (analyzer.go:315-370, 396-448)."""
+
+    def __init__(self, options: AnalyzerOptions | None = None):
+        self.options = options or AnalyzerOptions()
+        _ensure_builtin_registered()
+        self.analyzers: list[Analyzer] = []
+        for factory in _REGISTRY:
+            a = factory()
+            if a.type() in self.options.disabled_analyzers:
+                continue
+            a.init(self.options)
+            self.analyzers.append(a)
+
+    def analyzer_versions(self) -> dict[str, int]:
+        """AnalyzerVersions (analyzer.go:372-381) — cache-key component."""
+        versions = {a.type(): a.version() for a in self.analyzers}
+        for t in self.options.disabled_analyzers:
+            versions.setdefault(t, 0)
+        return versions
+
+    def analyze_entries(self, dir: str, entries: Iterable[FileEntry]) -> AnalysisResult:
+        """Claim pass + batched dispatch (replaces AnalyzeFile fan-out)."""
+        claims: dict[int, list[FileEntry]] = {i: [] for i in range(len(self.analyzers))}
+        for entry in entries:
+            for i, a in enumerate(self.analyzers):
+                if a.required(entry.path, entry.size, entry.mode):
+                    claims[i].append(entry)
+
+        result = AnalysisResult()
+        for i, a in enumerate(self.analyzers):
+            batch = claims[i]
+            if not batch:
+                continue
+            inputs = []
+            for entry in batch:
+                try:
+                    content = entry.opener()
+                except OSError:
+                    continue  # per-file errors tolerated (analyzer.go:415-417)
+                inputs.append(
+                    AnalysisInput(
+                        dir=dir,
+                        file_path=entry.path,
+                        size=entry.size,
+                        mode=entry.mode,
+                        content=content,
+                    )
+                )
+            if isinstance(a, BatchAnalyzer):
+                result.merge(a.analyze_batch(inputs))
+            else:
+                for inp in inputs:
+                    result.merge(a.analyze(inp))
+        result.sort()
+        return result

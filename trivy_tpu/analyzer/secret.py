@@ -1,0 +1,134 @@
+"""Secret analyzer: pre-filter + adapter onto the device secret engine.
+
+Mirrors pkg/fanal/analyzer/secret/secret.go — skip lists (:28-42), Required
+gate (:115-153), binary sniff (utils.IsBinary, pkg/fanal/utils/utils.go:76-93),
+``\r`` stripping (:91), leading ``/`` for image-extracted files (:97-99) — but
+implements BatchAnalyzer so all claimed files of a walk board the device as one
+packed batch.
+"""
+
+from __future__ import annotations
+
+import os
+
+from trivy_tpu.analyzer.core import (
+    TYPE_SECRET,
+    AnalysisInput,
+    AnalysisResult,
+    AnalyzerOptions,
+    BatchAnalyzer,
+    register_analyzer,
+)
+from trivy_tpu.rules.model import load_config
+
+VERSION = 1
+
+# secret.go:28-42
+SKIP_FILES = {
+    "go.mod",
+    "go.sum",
+    "package-lock.json",
+    "yarn.lock",
+    "pnpm-lock.yaml",
+    "Pipfile.lock",
+    "Gemfile.lock",
+}
+SKIP_DIRS = {".git", "node_modules"}
+SKIP_EXTS = {
+    ".jpg", ".png", ".gif", ".doc", ".pdf", ".bin", ".svg", ".socket",
+    ".deb", ".rpm", ".zip", ".gz", ".gzip", ".tar", ".pyc",
+}
+
+
+def is_binary(head: bytes) -> bool:
+    """utils.IsBinary control-byte heuristic over the first 300 bytes
+    (pkg/fanal/utils/utils.go:76-93)."""
+    for b in head[:300]:
+        if b < 7 or b == 11 or (13 < b < 27) or (27 < b < 0x20) or b == 0x7F:
+            return True
+    return False
+
+
+class SecretAnalyzer(BatchAnalyzer):
+    """pkg/fanal/analyzer/secret/secret.go SecretAnalyzer."""
+
+    def __init__(self) -> None:
+        self._engine = None
+        self._config_path = ""
+        self._backend = "tpu"
+
+    def init(self, options: AnalyzerOptions) -> None:
+        self._config_path = options.secret_scanner_option.config_path
+        self._backend = options.secret_scanner_option.backend
+
+    @property
+    def engine(self):
+        if self._engine is None:
+            config = load_config(self._config_path)
+            if self._backend == "cpu":
+                from trivy_tpu.engine.oracle import OracleScanner
+
+                self._engine = OracleScanner(config=config)
+            else:
+                from trivy_tpu.engine.device import TpuSecretEngine
+
+                self._engine = TpuSecretEngine(config=config)
+        return self._engine
+
+    def type(self) -> str:
+        return TYPE_SECRET
+
+    def version(self) -> int:
+        return VERSION
+
+    def required(self, file_path: str, size: int, mode: int) -> bool:
+        """secret.go:115-153."""
+        if size < 10:
+            return False
+        dirname, fname = os.path.split(file_path)
+        if SKIP_DIRS.intersection(dirname.replace(os.sep, "/").split("/")):
+            return False
+        if fname in SKIP_FILES:
+            return False
+        if self._config_path and os.path.basename(self._config_path) == file_path:
+            return False
+        if os.path.splitext(fname)[1] in SKIP_EXTS:
+            return False
+        if self.engine_allow_path(file_path):
+            return False
+        return True
+
+    def engine_allow_path(self, file_path: str) -> bool:
+        eng = self.engine
+        ruleset = getattr(eng, "ruleset", None)
+        return bool(ruleset and ruleset.allow_path(file_path))
+
+    @staticmethod
+    def _effective_path(inp: AnalysisInput) -> str:
+        # Files extracted from images have no dir; they get a leading "/"
+        # (secret.go:94-99).
+        return inp.file_path if inp.dir else "/" + inp.file_path
+
+    def analyze_batch(self, inputs: list[AnalysisInput]) -> AnalysisResult | None:
+        items: list[tuple[str, bytes]] = []
+        for inp in inputs:
+            if is_binary(inp.content):
+                continue
+            content = inp.content.replace(b"\r", b"")
+            items.append((self._effective_path(inp), content))
+        if not items:
+            return None
+
+        eng = self.engine
+        if hasattr(eng, "scan_batch"):
+            results = eng.scan_batch(items)
+        else:
+            results = [eng.scan(p, c) for p, c in items]
+
+        secrets = [r for r in results if r.findings]
+        if not secrets:
+            return None
+        return AnalysisResult(secrets=secrets)
+
+
+register_analyzer(SecretAnalyzer)

@@ -1,0 +1,3 @@
+from trivy_tpu.applier.apply import Applier, apply_layers
+
+__all__ = ["Applier", "apply_layers"]

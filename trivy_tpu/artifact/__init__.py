@@ -1,0 +1,3 @@
+from trivy_tpu.artifact.local import LocalArtifact
+
+__all__ = ["LocalArtifact"]

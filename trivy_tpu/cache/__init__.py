@@ -1,0 +1,3 @@
+from trivy_tpu.cache.store import ArtifactCache, FSCache, MemoryCache
+
+__all__ = ["ArtifactCache", "FSCache", "MemoryCache"]

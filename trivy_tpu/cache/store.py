@@ -1,0 +1,147 @@
+"""Content-addressed scan cache.
+
+Mirrors pkg/fanal/cache/cache.go — the ArtifactCache (Put side) /
+LocalArtifactCache (Get side) interface pair and the checkpoint/resume role the
+cache plays in the reference (SURVEY §5): analysis results keyed by
+sha256(content + analyzer versions), so unchanged blobs are never re-analyzed
+(`MissingBlobs` diffing, pkg/fanal/artifact/image/image.go:113).
+
+Backends: in-memory dict and a JSON-files-on-disk store (the BoltDB FS cache
+analogue, pkg/fanal/cache/fs.go:17).  Both sides of the interface are one
+class here — the split only matters at the RPC seam, where RemoteCache
+implements the Put side over HTTP (trivy_tpu/rpc/).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable
+
+from trivy_tpu.atypes import BLOB_JSON_SCHEMA_VERSION, ArtifactInfo, BlobInfo
+
+SCHEMA_VERSION = 2  # cache.go schemaVersion
+
+
+class ArtifactCache:
+    """Interface: cache.ArtifactCache + cache.LocalArtifactCache."""
+
+    def put_artifact(self, artifact_id: str, info: ArtifactInfo) -> None:
+        raise NotImplementedError
+
+    def put_blob(self, blob_id: str, info: BlobInfo) -> None:
+        raise NotImplementedError
+
+    def get_artifact(self, artifact_id: str) -> ArtifactInfo | None:
+        raise NotImplementedError
+
+    def get_blob(self, blob_id: str) -> BlobInfo | None:
+        raise NotImplementedError
+
+    def missing_blobs(
+        self, artifact_id: str, blob_ids: Iterable[str]
+    ) -> tuple[bool, list[str]]:
+        """cache.MissingBlobs: (artifact missing?, missing blob ids)."""
+        missing = [b for b in blob_ids if self.get_blob(b) is None]
+        return self.get_artifact(artifact_id) is None, missing
+
+    def delete_blobs(self, blob_ids: Iterable[str]) -> None:
+        raise NotImplementedError
+
+    def clear(self) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class MemoryCache(ArtifactCache):
+    """cache.NewMemoryCache analogue; also the NopCache replacement for tests."""
+
+    def __init__(self) -> None:
+        self._artifacts: dict[str, ArtifactInfo] = {}
+        self._blobs: dict[str, BlobInfo] = {}
+
+    def put_artifact(self, artifact_id: str, info: ArtifactInfo) -> None:
+        self._artifacts[artifact_id] = info
+
+    def put_blob(self, blob_id: str, info: BlobInfo) -> None:
+        self._blobs[blob_id] = info
+
+    def get_artifact(self, artifact_id: str) -> ArtifactInfo | None:
+        return self._artifacts.get(artifact_id)
+
+    def get_blob(self, blob_id: str) -> BlobInfo | None:
+        return self._blobs.get(blob_id)
+
+    def delete_blobs(self, blob_ids: Iterable[str]) -> None:
+        for b in blob_ids:
+            self._blobs.pop(b, None)
+
+    def clear(self) -> None:
+        self._artifacts.clear()
+        self._blobs.clear()
+
+
+def _safe_key(key: str) -> str:
+    return key.replace("/", "_").replace(":", "_")
+
+
+class FSCache(ArtifactCache):
+    """JSON-on-disk content-addressed cache (the BoltDB fscache analogue)."""
+
+    def __init__(self, cache_dir: str):
+        self.root = os.path.join(cache_dir, "fanal")
+        os.makedirs(os.path.join(self.root, "artifact"), exist_ok=True)
+        os.makedirs(os.path.join(self.root, "blob"), exist_ok=True)
+
+    def _path(self, bucket: str, key: str) -> str:
+        return os.path.join(self.root, bucket, _safe_key(key) + ".json")
+
+    def _write(self, bucket: str, key: str, value: dict) -> None:
+        path = self._path(bucket, key)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(value, f)
+        os.replace(tmp, path)
+
+    def _read(self, bucket: str, key: str) -> dict | None:
+        try:
+            with open(self._path(bucket, key), encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def put_artifact(self, artifact_id: str, info: ArtifactInfo) -> None:
+        self._write("artifact", artifact_id, info.to_json())
+
+    def put_blob(self, blob_id: str, info: BlobInfo) -> None:
+        self._write("blob", blob_id, info.to_json())
+
+    def get_artifact(self, artifact_id: str) -> ArtifactInfo | None:
+        d = self._read("artifact", artifact_id)
+        return ArtifactInfo.from_json(d) if d is not None else None
+
+    def get_blob(self, blob_id: str) -> BlobInfo | None:
+        d = self._read("blob", blob_id)
+        if d is None:
+            return None
+        info = BlobInfo.from_json(d)
+        # Schema-version gating like cache.go: stale schema = cache miss.
+        if info.schema_version != BLOB_JSON_SCHEMA_VERSION:
+            return None
+        return info
+
+    def delete_blobs(self, blob_ids: Iterable[str]) -> None:
+        for b in blob_ids:
+            try:
+                os.remove(self._path("blob", b))
+            except OSError:
+                pass
+
+    def clear(self) -> None:
+        import shutil
+
+        shutil.rmtree(self.root, ignore_errors=True)
+        os.makedirs(os.path.join(self.root, "artifact"), exist_ok=True)
+        os.makedirs(os.path.join(self.root, "blob"), exist_ok=True)

@@ -1,0 +1,164 @@
+"""CLI entry: the command tree.
+
+Mirrors pkg/commands/app.go (NewApp :65) with argparse instead of cobra.
+Subcommands map 1:1 to the reference's: fs, rootfs, image, repository, sbom,
+convert, server, config, version.  Every flag also binds an env var
+(``TRIVY_TPU_<FLAG>``), like the reference's viper env binding.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from trivy_tpu import __version__
+from trivy_tpu.commands.run import (
+    TARGET_FILESYSTEM,
+    TARGET_IMAGE,
+    TARGET_REPOSITORY,
+    TARGET_ROOTFS,
+    Options,
+    run,
+)
+from trivy_tpu.result.filter import SEVERITIES
+
+
+def _env_default(name: str, default):
+    return os.environ.get(f"TRIVY_TPU_{name.upper().replace('-', '_')}", default)
+
+
+def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
+    p.add_argument("target")
+    p.add_argument(
+        "--scanners",
+        default=_env_default("scanners", default_scanners),
+        help="comma-separated: vuln,secret,misconfig,license",
+    )
+    p.add_argument(
+        "--severity",
+        default=_env_default("severity", ",".join(SEVERITIES)),
+        help="comma-separated severities to report",
+    )
+    p.add_argument("-f", "--format", default=_env_default("format", "table"))
+    p.add_argument("-o", "--output", default=_env_default("output", ""))
+    p.add_argument("--exit-code", type=int, default=0)
+    p.add_argument("--skip-files", action="append", default=[])
+    p.add_argument("--skip-dirs", action="append", default=[])
+    p.add_argument(
+        "--secret-config", default=_env_default("secret-config", "trivy-secret.yaml")
+    )
+    p.add_argument(
+        "--secret-backend",
+        choices=["tpu", "cpu"],
+        default=_env_default("secret-backend", "tpu"),
+        help="tpu = device sieve engine, cpu = oracle engine",
+    )
+    p.add_argument("--ignorefile", default=_env_default("ignorefile", ".trivyignore"))
+    p.add_argument("--cache-dir", default=_env_default("cache-dir", ""))
+    p.add_argument(
+        "--cache-backend",
+        choices=["memory", "fs"],
+        default=_env_default("cache-backend", "memory"),
+    )
+    p.add_argument("--server", default="", help="server address (client mode)")
+    p.add_argument("--list-all-pkgs", action="store_true")
+
+
+def _options_from_args(args: argparse.Namespace) -> Options:
+    return Options(
+        target=args.target,
+        scanners=[s for s in args.scanners.split(",") if s],
+        severities=[s for s in args.severity.upper().split(",") if s],
+        format=args.format,
+        output=args.output,
+        exit_code=args.exit_code,
+        cache_dir=args.cache_dir,
+        cache_backend=args.cache_backend,
+        skip_files=args.skip_files,
+        skip_dirs=args.skip_dirs,
+        secret_config=args.secret_config,
+        secret_backend=args.secret_backend,
+        ignore_file=args.ignorefile if os.path.exists(args.ignorefile) else "",
+        server_addr=args.server,
+        list_all_packages=args.list_all_pkgs,
+    )
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="trivy-tpu", description="TPU-native security scanner"
+    )
+    parser.add_argument("--version", action="version", version=__version__)
+    sub = parser.add_subparsers(dest="command")
+
+    p_fs = sub.add_parser("fs", help="scan a local filesystem")
+    _add_scan_flags(p_fs, "vuln,secret")
+    p_fs.set_defaults(kind=TARGET_FILESYSTEM)
+
+    p_rootfs = sub.add_parser("rootfs", help="scan an unpacked root filesystem")
+    _add_scan_flags(p_rootfs, "vuln")
+    p_rootfs.set_defaults(kind=TARGET_ROOTFS)
+
+    p_image = sub.add_parser("image", help="scan a container image archive")
+    _add_scan_flags(p_image, "vuln,secret")
+    p_image.add_argument(
+        "--input", default="", help="tar archive path (docker save / OCI layout)"
+    )
+    p_image.set_defaults(kind=TARGET_IMAGE)
+
+    p_repo = sub.add_parser("repository", aliases=["repo"], help="scan a git repository")
+    _add_scan_flags(p_repo, "vuln,secret")
+    p_repo.add_argument("--branch", default="")
+    p_repo.add_argument("--tag", default="")
+    p_repo.add_argument("--commit", default="")
+    p_repo.set_defaults(kind=TARGET_REPOSITORY)
+
+    p_convert = sub.add_parser("convert", help="convert a saved JSON report")
+    p_convert.add_argument("report")
+    p_convert.add_argument("-f", "--format", default="table")
+    p_convert.add_argument("-o", "--output", default="")
+    p_convert.add_argument("--severity", default=",".join(SEVERITIES))
+
+    p_server = sub.add_parser("server", help="run the scan server")
+    p_server.add_argument("--listen", default="localhost:4954")
+    p_server.add_argument("--cache-dir", default="")
+    p_server.add_argument("--token", default="")
+
+    sub.add_parser("version", help="print version")
+
+    p_config = sub.add_parser("config", help="scan config files for misconfigurations")
+    _add_scan_flags(p_config, "misconfig")
+    p_config.set_defaults(kind=TARGET_FILESYSTEM)
+
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.command in (None, "version"):
+        print(f"trivy-tpu version {__version__}")
+        return 0
+
+    if args.command == "convert":
+        from trivy_tpu.commands.convert import run_convert
+
+        return run_convert(args.report, args.format, args.output, args.severity)
+
+    if args.command == "server":
+        from trivy_tpu.rpc.server import serve
+
+        serve(args.listen, cache_dir=args.cache_dir, token=args.token)
+        return 0
+
+    options = _options_from_args(args)
+    if args.command == "config":
+        options.scanners = ["misconfig"]
+    if getattr(args, "input", ""):
+        options.target = args.input
+    return run(options, args.kind)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,64 @@
+"""`convert` command: re-filter/re-render a saved JSON report
+(pkg/commands/convert/run.go)."""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from trivy_tpu.atypes import _secret_from_json
+from trivy_tpu.ftypes import ArtifactType, Metadata, Report, Result, ResultClass
+from trivy_tpu.report.writer import write_report
+from trivy_tpu.result.filter import FilterOptions, filter_report
+
+
+def report_from_json(d: dict) -> Report:
+    results = []
+    for r in d.get("Results") or []:
+        secrets = []
+        for s in r.get("Secrets") or []:
+            secrets.extend(
+                _secret_from_json({"FilePath": r.get("Target", ""), "Findings": [s]}).findings
+            )
+        results.append(
+            Result(
+                target=r.get("Target", ""),
+                result_class=ResultClass(r.get("Class", "custom")),
+                result_type=r.get("Type", ""),
+                secrets=secrets,
+                vulnerabilities=list(r.get("Vulnerabilities") or []),
+                misconfigurations=list(r.get("Misconfigurations") or []),
+                licenses=list(r.get("Licenses") or []),
+            )
+        )
+    meta = d.get("Metadata") or {}
+    os_meta = meta.get("OS") or {}
+    return Report(
+        artifact_name=d.get("ArtifactName", ""),
+        artifact_type=ArtifactType(d.get("ArtifactType", "filesystem")),
+        results=results,
+        metadata=Metadata(
+            image_id=meta.get("ImageID", ""),
+            diff_ids=list(meta.get("DiffIDs") or []),
+            repo_tags=list(meta.get("RepoTags") or []),
+            repo_digests=list(meta.get("RepoDigests") or []),
+            os_family=os_meta.get("Family", ""),
+            os_name=os_meta.get("Name", ""),
+        ),
+        schema_version=d.get("SchemaVersion", 2),
+        created_at=d.get("CreatedAt", ""),
+    )
+
+
+def run_convert(report_path: str, fmt: str, output: str, severity: str) -> int:
+    with open(report_path, encoding="utf-8") as f:
+        report = report_from_json(json.load(f))
+    report = filter_report(
+        report, FilterOptions(severities=severity.upper().split(","))
+    )
+    if output:
+        with open(output, "w", encoding="utf-8") as f:
+            write_report(report, fmt, f)
+    else:
+        write_report(report, fmt, sys.stdout)
+    return 0

@@ -1,0 +1,162 @@
+"""Artifact runner: scan lifecycle orchestration.
+
+Mirrors pkg/commands/artifact/run.go — Runner lifecycle (:116 NewRunner, :394
+Run): cache init → scan → filter → report → exit code — minus the Go DI
+ceremony; scanner wiring is plain constructors (the wire_gen.go equivalent is
+`_build_scanner`).
+"""
+
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass, field
+
+from trivy_tpu.analyzer.core import AnalyzerOptions, SecretScannerOption
+from trivy_tpu.cache.store import ArtifactCache, FSCache, MemoryCache
+from trivy_tpu.ftypes import ArtifactType, Report
+from trivy_tpu.report.writer import write_report
+from trivy_tpu.result.filter import SEVERITIES, FilterOptions, filter_report
+from trivy_tpu.scanner.service import (
+    LocalDriver,
+    Scanner,
+    ScanOptions,
+)
+from trivy_tpu.walker.fs import WalkOption
+
+TARGET_FILESYSTEM = "fs"
+TARGET_ROOTFS = "rootfs"
+TARGET_IMAGE = "image"
+TARGET_REPOSITORY = "repo"
+TARGET_SBOM = "sbom"
+
+
+@dataclass
+class Options:
+    """The flag.Options megastruct analogue (pkg/flag/options.go:323) — only
+    the knobs the framework currently honors."""
+
+    target: str = ""
+    scanners: list[str] = field(default_factory=lambda: ["secret"])
+    severities: list[str] = field(default_factory=lambda: list(SEVERITIES))
+    format: str = "table"
+    output: str = ""
+    exit_code: int = 0
+    cache_dir: str = ""
+    cache_backend: str = "memory"
+    skip_files: list[str] = field(default_factory=list)
+    skip_dirs: list[str] = field(default_factory=list)
+    secret_config: str = "trivy-secret.yaml"
+    secret_backend: str = "tpu"
+    ignore_file: str = ""
+    disabled_analyzers: list[str] = field(default_factory=list)
+    server_addr: str = ""  # non-empty => client mode (remote driver)
+    list_all_packages: bool = False
+
+
+def init_cache(options: Options) -> ArtifactCache:
+    if options.cache_backend == "fs" and options.cache_dir:
+        return FSCache(options.cache_dir)
+    return MemoryCache()
+
+
+def _analyzer_options(options: Options, target_kind: str) -> AnalyzerOptions:
+    disabled = list(options.disabled_analyzers)
+    # run.go:458 disabledAnalyzers: per-target analyzer disabling policy —
+    # scanners not requested disable their analyzers.
+    if "secret" not in options.scanners:
+        disabled.append("secret")
+    if "license" not in options.scanners:
+        disabled.append("license-file")
+    return AnalyzerOptions(
+        disabled_analyzers=disabled,
+        secret_scanner_option=SecretScannerOption(
+            config_path=options.secret_config, backend=options.secret_backend
+        ),
+    )
+
+
+def _build_scanner(options: Options, target_kind: str, cache: ArtifactCache) -> Scanner:
+    """initializeFilesystemScanner etc. (wire_gen.go) without DI codegen."""
+    from trivy_tpu.artifact.local import LocalArtifact
+
+    if target_kind in (TARGET_FILESYSTEM, TARGET_ROOTFS):
+        artifact_type = ArtifactType.FILESYSTEM
+        artifact = LocalArtifact(
+            options.target,
+            cache,
+            analyzer_options=_analyzer_options(options, target_kind),
+            walk_option=WalkOption(
+                skip_files=options.skip_files, skip_dirs=options.skip_dirs
+            ),
+            artifact_type=artifact_type,
+        )
+    elif target_kind == TARGET_IMAGE:
+        from trivy_tpu.artifact.image import ImageArtifact
+
+        artifact = ImageArtifact(
+            options.target,
+            cache,
+            analyzer_options=_analyzer_options(options, target_kind),
+        )
+    elif target_kind == TARGET_REPOSITORY:
+        from trivy_tpu.artifact.repo import RepositoryArtifact
+
+        artifact = RepositoryArtifact(
+            options.target,
+            cache,
+            analyzer_options=_analyzer_options(options, target_kind),
+            walk_option=WalkOption(
+                skip_files=options.skip_files, skip_dirs=options.skip_dirs
+            ),
+        )
+    else:
+        raise ValueError(f"unsupported target kind: {target_kind}")
+
+    if options.server_addr:
+        from trivy_tpu.rpc.client import RemoteDriver
+
+        driver = RemoteDriver(options.server_addr)
+    else:
+        driver = LocalDriver(cache)
+    return Scanner(artifact=artifact, driver=driver)
+
+
+def run(options: Options, target_kind: str) -> int:
+    """artifact.Run (run.go:394): scan → filter → report → exit code."""
+    cache = init_cache(options)
+    try:
+        scanner = _build_scanner(options, target_kind, cache)
+        report = scanner.scan_artifact(
+            ScanOptions(
+                scanners=list(options.scanners),
+                list_all_packages=options.list_all_packages,
+            )
+        )
+        report = filter_report(
+            report,
+            FilterOptions(
+                severities=options.severities, ignore_file=options.ignore_file
+            ),
+        )
+        _write(report, options)
+        return _exit_code(report, options)
+    finally:
+        cache.close()
+
+
+def _write(report: Report, options: Options) -> None:
+    if options.output:
+        with open(options.output, "w", encoding="utf-8") as f:
+            write_report(report, options.format, f)
+    else:
+        write_report(report, options.format, sys.stdout)
+
+
+def _exit_code(report: Report, options: Options) -> int:
+    """operation.Exit (run.go:455): non-zero exit when findings exist."""
+    if options.exit_code == 0:
+        return 0
+    for result in report.results:
+        if not result.is_empty():
+            return options.exit_code
+    return 0

@@ -28,11 +28,10 @@ from trivy_tpu.rules.model import RuleSet, SecretConfig, build_ruleset
 from trivy_tpu.scanner.packing import DEFAULT_OVERLAP, DEFAULT_TILE_LEN, pack
 
 
-def _round_up_pow2(n: int, lo: int = 8) -> int:
-    p = lo
-    while p < n:
-        p *= 2
-    return p
+# Fixed tile-batch shapes.  Every device call uses one of these row counts, so
+# XLA compiles each bucket exactly once per process; larger scans are chunked
+# into max-bucket-row batches (static shapes — SURVEY §1 XLA semantics).
+TILE_BUCKETS = (512, 4096)
 
 
 @dataclass
@@ -85,6 +84,24 @@ class TpuSecretEngine:
 
     # ------------------------------------------------------------------
 
+    def _buckets(self) -> list[int]:
+        """Tile-row batch shapes: TILE_BUCKETS capped by max_batch_tiles,
+        rounded up to the mesh-device multiple."""
+        align = self._tile_align
+        caps = [b for b in TILE_BUCKETS if b <= self.max_batch_tiles]
+        if not caps or caps[-1] != self.max_batch_tiles:
+            caps.append(self.max_batch_tiles)
+        return [-(-b // align) * align for b in caps]
+
+    def warmup(self) -> None:
+        """Compile every tile-bucket shape ahead of timed scanning."""
+        import jax
+        import jax.numpy as jnp
+
+        for rows in self._buckets():
+            tiles = jnp.zeros((rows, self.tile_len), dtype=jnp.uint8)
+            jax.block_until_ready(self._sieve_fn(tiles, self._lut))
+
     def candidate_matrix(self, file_hits: np.ndarray) -> np.ndarray:
         """[F, R] bool candidate matrix from per-file probe bitmaps."""
         h = file_hits[:, None, :]  # [F, 1, Pw]
@@ -98,12 +115,30 @@ class TpuSecretEngine:
 
         from trivy_tpu.scanner.packing import count_tiles
 
+        buckets = self._buckets()
+        max_rows = buckets[-1]
         total = count_tiles(contents, self.tile_len, self.overlap)
-        padded = _round_up_pow2(total, lo=self._tile_align or 8)
-        padded = -(-padded // self._tile_align) * self._tile_align
-        batch = pack(contents, self.tile_len, self.overlap, pad_tiles_to=padded)
-        tile_hits = np.asarray(self._sieve_fn(jnp.asarray(batch.tiles), self._lut))
-        self.stats.tiles += len(batch.tiles)
+        self.stats.tiles += total
+        fit = next((b for b in buckets if total <= b), None)
+        if fit is not None:
+            batch = pack(contents, self.tile_len, self.overlap, pad_tiles_to=fit)
+            tile_hits = np.asarray(self._sieve_fn(jnp.asarray(batch.tiles), self._lut))
+        else:
+            # Chunk into fixed max-bucket-row batches: one compiled shape,
+            # pipelined h2d/compute across chunks (dispatch is async; we only
+            # materialize results at the end).
+            batch = pack(contents, self.tile_len, self.overlap)
+            chunks = []
+            for off in range(0, len(batch.tiles), max_rows):
+                part = batch.tiles[off : off + max_rows]
+                if len(part) < max_rows:
+                    part = np.concatenate(
+                        [part, np.zeros((max_rows - len(part), part.shape[1]), np.uint8)]
+                    )
+                chunks.append(self._sieve_fn(jnp.asarray(part), self._lut))
+            tile_hits = np.concatenate([np.asarray(c) for c in chunks])[
+                : len(batch.tiles)
+            ]
         return batch.file_hits(tile_hits)
 
     def scan_batch(self, items: list[tuple[str, bytes]]) -> list[Secret]:

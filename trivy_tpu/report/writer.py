@@ -1,0 +1,27 @@
+"""Report writer dispatch (pkg/report/writer.go:28 format switch)."""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import IO
+
+from trivy_tpu.ftypes import Report
+from trivy_tpu.report.table import write_table
+from trivy_tpu.report.sarif import to_sarif
+
+FORMATS = ["table", "json", "sarif", "template", "github"]
+
+
+def write_report(report: Report, fmt: str = "table", out: IO[str] | None = None) -> None:
+    out = out if out is not None else sys.stdout
+    if fmt == "json":
+        json.dump(report.to_json(), out, indent=2)
+        out.write("\n")
+    elif fmt == "table":
+        write_table(report, out)
+    elif fmt == "sarif":
+        json.dump(to_sarif(report), out, indent=2)
+        out.write("\n")
+    else:
+        raise ValueError(f"unknown format: {fmt} (supported: {FORMATS})")

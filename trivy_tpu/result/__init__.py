@@ -1,0 +1,3 @@
+from trivy_tpu.result.filter import FilterOptions, filter_report
+
+__all__ = ["FilterOptions", "filter_report"]

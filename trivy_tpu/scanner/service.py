@@ -1,0 +1,153 @@
+"""Scanner service: joins artifact inspection with a detection driver.
+
+Mirrors pkg/scanner/scan.go (Scanner :125, Driver seam :131-134) and the local
+driver pkg/scanner/local/scan.go (ScanTarget :107, secretsToResults :263).
+The Driver seam is where the client/server split (and the TPU sidecar RPC
+backend) plugs in: LocalDriver applies layers from the cache in-process, the
+RPC client driver (trivy_tpu/rpc/client.py) forwards the same call over HTTP.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from trivy_tpu.applier.apply import Applier
+from trivy_tpu.atypes import ArtifactReference
+from trivy_tpu.cache.store import ArtifactCache
+from trivy_tpu.ftypes import (
+    ArtifactType,
+    Metadata,
+    Report,
+    Result,
+    ResultClass,
+)
+
+SCANNER_VULN = "vuln"
+SCANNER_MISCONFIG = "misconfig"
+SCANNER_SECRET = "secret"
+SCANNER_LICENSE = "license"
+DEFAULT_SCANNERS = [SCANNER_VULN, SCANNER_SECRET]
+
+
+@dataclass
+class ScanOptions:
+    """types.ScanOptions (pkg/types/scan.go)."""
+
+    scanners: list[str] = field(default_factory=lambda: list(DEFAULT_SCANNERS))
+    pkg_types: list[str] = field(default_factory=lambda: ["os", "library"])
+    list_all_packages: bool = False
+
+
+class Driver:
+    """scanner.Driver (scan.go:131-134) — the local-vs-remote seam."""
+
+    def scan(
+        self,
+        target: str,
+        artifact_id: str,
+        blob_ids: list[str],
+        options: ScanOptions,
+    ) -> tuple[list[Result], object | None]:
+        raise NotImplementedError
+
+
+@dataclass
+class LocalDriver(Driver):
+    """pkg/scanner/local/scan.go Scanner."""
+
+    cache: ArtifactCache
+    vuln_detector: object | None = None  # wired in when detectors land
+
+    def scan(self, target, artifact_id, blob_ids, options):
+        detail = Applier(self.cache).apply_layers(artifact_id, blob_ids)
+        results: list[Result] = []
+
+        if SCANNER_VULN in options.scanners and self.vuln_detector is not None:
+            results.extend(
+                self.vuln_detector.detect(target, detail, options)  # type: ignore[attr-defined]
+            )
+
+        if SCANNER_SECRET in options.scanners:
+            results.extend(self._secrets_to_results(detail))
+
+        if SCANNER_LICENSE in options.scanners and detail.licenses:
+            results.extend(self._licenses_to_results(detail))
+
+        if SCANNER_MISCONFIG in options.scanners and detail.misconfigurations:
+            results.extend(self._misconfigs_to_results(detail))
+
+        return results, detail.os
+
+    @staticmethod
+    def _secrets_to_results(detail) -> list[Result]:
+        """local/scan.go:263-281 secretsToResults — one Result per file."""
+        out = []
+        for secret in detail.secrets:
+            out.append(
+                Result(
+                    target=secret.file_path,
+                    result_class=ResultClass.SECRET,
+                    secrets=list(secret.findings),
+                )
+            )
+        return out
+
+    @staticmethod
+    def _licenses_to_results(detail) -> list[Result]:
+        out = []
+        for lf in detail.licenses:
+            out.append(
+                Result(
+                    target=getattr(lf, "file_path", ""),
+                    result_class=ResultClass.LICENSE_FILE,
+                    licenses=list(getattr(lf, "findings", [])),
+                )
+            )
+        return out
+
+    @staticmethod
+    def _misconfigs_to_results(detail) -> list[Result]:
+        out = []
+        for mc in detail.misconfigurations:
+            out.append(
+                Result(
+                    target=getattr(mc, "file_path", ""),
+                    result_class=ResultClass.CONFIG,
+                    result_type=getattr(mc, "file_type", ""),
+                    misconfigurations=list(getattr(mc, "failures", []))
+                    + list(getattr(mc, "successes", [])),
+                )
+            )
+        return out
+
+
+@dataclass
+class Scanner:
+    """scanner.Scanner (scan.go:125)."""
+
+    artifact: object  # anything with .inspect() -> ArtifactReference
+    driver: Driver
+
+    def scan_artifact(self, options: ScanOptions) -> Report:
+        """scan.go:145 ScanArtifact."""
+        ref: ArtifactReference = self.artifact.inspect()
+        results, detected_os = self.driver.scan(
+            ref.name, ref.id, ref.blob_ids, options
+        )
+
+        metadata = Metadata()
+        if detected_os is not None and getattr(detected_os, "family", ""):
+            metadata.os_family = detected_os.family
+            metadata.os_name = detected_os.name
+        if ref.image_metadata:
+            metadata.image_id = ref.image_metadata.get("ImageID", "")
+            metadata.diff_ids = ref.image_metadata.get("DiffIDs", [])
+            metadata.repo_tags = ref.image_metadata.get("RepoTags", [])
+            metadata.repo_digests = ref.image_metadata.get("RepoDigests", [])
+
+        return Report(
+            artifact_name=ref.name,
+            artifact_type=ArtifactType(ref.artifact_type),
+            results=results,
+            metadata=metadata,
+        )

@@ -1,0 +1,3 @@
+from trivy_tpu.walker.fs import FSWalker, WalkOption, skip_path
+
+__all__ = ["FSWalker", "WalkOption", "skip_path"]
