@@ -1,0 +1,163 @@
+"""Gram sieve: extraction soundness, kernel equivalence, dense packing."""
+
+import random
+
+import numpy as np
+import pytest
+
+from trivy_tpu.engine.grams import build_gram_set, fold_byte, probe_grams
+from trivy_tpu.engine.ir import bs_members
+from trivy_tpu.engine.probes import build_probe_set
+from trivy_tpu.ops.gram_sieve import (
+    gram_sieve_numpy,
+    pad_grams,
+)
+from trivy_tpu.rules.model import build_ruleset
+from trivy_tpu.scanner.packing import pack_dense
+
+
+@pytest.fixture(scope="module")
+def pset():
+    return build_probe_set(build_ruleset().rules)
+
+
+@pytest.fixture(scope="module")
+def gset(pset):
+    return build_gram_set(pset)
+
+
+def _probe_instances(probe, rng, n=8):
+    """Concrete byte strings matching the probe's class sequence."""
+    out = []
+    for _ in range(n):
+        bs = bytes(rng.choice(bs_members(c)) for c in probe.classes)
+        out.append(bs)
+    return out
+
+
+def test_gram_soundness_per_probe(pset, gset):
+    """Every concrete instance of a probe with grams must fire one of them:
+    'no gram hit' must soundly prove 'no probe occurrence'."""
+    rng = random.Random(7)
+    masks, vals = gset.masks, gset.vals
+    for p, probe in enumerate(pset.probes):
+        if not gset.probe_has_gram[p]:
+            continue
+        own = np.flatnonzero(gset.gram_probe == p)
+        for inst in _probe_instances(probe, rng):
+            data = b"padpad" + inst + b"padpad" + b"\x00" * 3
+            rows = np.frombuffer(data, dtype=np.uint8)[None, :]
+            hits = gram_sieve_numpy(rows, masks, vals)[0]
+            assert hits[own].any(), (probe, inst)
+
+
+def test_jax_kernel_matches_numpy(gset):
+    import jax.numpy as jnp
+
+    from trivy_tpu.ops.gram_sieve import _gram_sieve_jit
+
+    rng = np.random.RandomState(3)
+    rows = rng.randint(0, 256, size=(16, 256)).astype(np.uint8)
+    # plant a couple of real grams
+    rows[2, 10:14] = [ord("a"), ord("k"), ord("i"), ord("a")]
+    rows[5, 250:254] = [ord("g"), ord("h"), ord("p"), ord("_")]
+
+    masks, vals = pad_grams(gset.masks, gset.vals)
+    packed = np.asarray(_gram_sieve_jit(jnp.asarray(rows), jnp.asarray(masks), jnp.asarray(vals)))
+    unpacked = (
+        (packed[:, :, None] >> np.arange(32, dtype=np.uint32)) & 1
+    ).astype(bool).reshape(len(rows), -1)[:, : gset.num_grams]
+    ref = gram_sieve_numpy(rows, gset.masks, gset.vals)
+    assert (unpacked == ref).all()
+
+
+def test_case_folding_hits_uppercase(gset):
+    # The device folds case: an upper-case occurrence of a lower-case gram
+    # must still hit (over-approximation, confirmed exactly on host).
+    data = b"xxx GHP_ yyy" + b"\x00" * 3
+    rows = np.frombuffer(data, dtype=np.uint8)[None, :]
+    hits = gram_sieve_numpy(rows, gset.masks, gset.vals)
+    assert hits.any()
+
+
+def test_pack_dense_roundtrip_attribution():
+    contents = [b"A" * 100, b"", b"B" * 5000, b"C" * 10, b"D" * 4093]
+    batch = pack_dense(contents, row_len=1024, overlap=3)
+    stride = 1024 - 3
+    pos = 0
+    for fi, c in enumerate(contents):
+        if not c:
+            assert batch.file_row_hi[fi] < batch.file_row_lo[fi]
+            pos += 3
+            continue
+        lo, hi = batch.file_row_lo[fi], batch.file_row_hi[fi]
+        for k in range(len(c)):
+            stream_pos = pos + k
+            r = stream_pos // stride  # the row whose window region owns it
+            assert lo <= r <= hi, (fi, k, r, lo, hi)
+            assert batch.rows[r][stream_pos - r * stride] == c[k]
+        pos += len(c) + 3
+
+
+def test_pack_dense_no_padding_waste():
+    contents = [b"x" * 2048] * 100
+    batch = pack_dense(contents, row_len=4096, overlap=3)
+    total_payload = sum(len(c) for c in contents)
+    packed_bytes = batch.rows.shape[0] * (4096 - 3)
+    assert packed_bytes < total_payload * 1.1  # <10% overhead
+
+
+def test_dense_gram_engine_matches_tiled_lut_engine():
+    from trivy_tpu.engine.device import TpuSecretEngine
+
+    rng = random.Random(11)
+    up = "ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+    alnum = up + up.lower() + "0123456789"
+
+    def pick(chars, n):
+        return "".join(rng.choice(chars) for _ in range(n)).encode()
+
+    corpus = []
+    for i in range(30):
+        body = b"filler line of code\n" * rng.randint(1, 40)
+        if i % 3 == 0:
+            body += b"tok = ghp_" + pick(alnum, 36) + b"\n"
+        if i % 5 == 0:
+            body += b'"AKIA' + pick(up + "0123456789", 16) + b'" \n'
+        corpus.append((f"f{i}.py", body))
+
+    gram_eng = TpuSecretEngine(tile_len=512, sieve="gram")
+    lut_eng = TpuSecretEngine(tile_len=512, sieve="lut")
+    a = gram_eng.scan_batch(corpus)
+    b = lut_eng.scan_batch(corpus)
+
+    def tup(res):
+        return [
+            [(f.rule_id, f.start_line, f.match) for f in r.findings] for r in res
+        ]
+
+    assert tup(a) == tup(b)
+    assert any(r.findings for r in a)
+
+
+def test_probe_grams_short_and_wide():
+    # 3-byte literal probe -> one variant with a 3-byte mask
+    from trivy_tpu.engine.ir import bs_fold_case
+
+    classes = tuple(bs_fold_case(1 << b) for b in b"ghp")
+    variants = probe_grams(classes)
+    assert variants
+    mask, val = variants[0]
+    assert mask == 0x00FFFFFF
+    assert val == (ord("g") | ord("h") << 8 | ord("p") << 16)
+
+    # all-wide probe -> no grams
+    wide = (1 << 256) - 2  # everything but NUL
+    assert probe_grams((wide, wide, wide, wide)) == []
+
+
+def test_fold_byte():
+    assert fold_byte(ord("A")) == ord("a")
+    assert fold_byte(ord("Z")) == ord("z")
+    assert fold_byte(ord("a")) == ord("a")
+    assert fold_byte(ord("0")) == ord("0")

@@ -217,25 +217,52 @@ class AnalyzerGroup:
             batch = claims[i]
             if not batch:
                 continue
-            inputs = []
-            for entry in batch:
-                try:
-                    content = entry.opener()
-                except OSError:
-                    continue  # per-file errors tolerated (analyzer.go:415-417)
-                inputs.append(
-                    AnalysisInput(
-                        dir=dir,
-                        file_path=entry.path,
-                        size=entry.size,
-                        mode=entry.mode,
-                        content=content,
-                    )
-                )
             if isinstance(a, BatchAnalyzer):
-                result.merge(a.analyze_batch(inputs))
+                # Bound resident bytes: contents are read slice-by-slice so a
+                # huge tree never sits fully in host memory (the reference
+                # streams per file; we stream per device-batch).
+                for slice_entries in _byte_bounded(batch, MAX_BATCH_BYTES):
+                    inputs = _read_inputs(dir, slice_entries)
+                    result.merge(a.analyze_batch(inputs))
             else:
-                for inp in inputs:
-                    result.merge(a.analyze(inp))
+                for entry in batch:
+                    inputs = _read_inputs(dir, [entry])
+                    if inputs:
+                        result.merge(a.analyze(inputs[0]))
         result.sort()
         return result
+
+
+MAX_BATCH_BYTES = 256 << 20  # per device-batch host residency cap
+
+
+def _byte_bounded(entries: list[FileEntry], max_bytes: int):
+    group: list[FileEntry] = []
+    total = 0
+    for e in entries:
+        if group and total + e.size > max_bytes:
+            yield group
+            group, total = [], 0
+        group.append(e)
+        total += e.size
+    if group:
+        yield group
+
+
+def _read_inputs(dir: str, entries: list[FileEntry]) -> list[AnalysisInput]:
+    inputs = []
+    for entry in entries:
+        try:
+            content = entry.opener()
+        except OSError:
+            continue  # per-file errors tolerated (analyzer.go:415-417)
+        inputs.append(
+            AnalysisInput(
+                dir=dir,
+                file_path=entry.path,
+                size=entry.size,
+                mode=entry.mode,
+                content=content,
+            )
+        )
+    return inputs

@@ -157,7 +157,11 @@ def main(argv: list[str] | None = None) -> int:
         options.scanners = ["misconfig"]
     if getattr(args, "input", ""):
         options.target = args.input
-    return run(options, args.kind)
+    try:
+        return run(options, args.kind)
+    except ModuleNotFoundError as e:
+        print(f"trivy-tpu: {args.command}: not implemented yet ({e.name})", file=sys.stderr)
+        return 2
 
 
 if __name__ == "__main__":

@@ -2,17 +2,24 @@
 
 Pipeline (the TPU-native reformulation of pkg/fanal/secret/scanner.go Scan):
 
-  1. Host packs blobs into overlapping tiles (scanner/packing.py).
-  2. Device runs the packed shift-AND sieve (ops/sieve.py) over every byte,
-     producing per-tile probe-hit bitmaps; tile axis shards over the mesh.
-  3. Host ORs bitmaps per file, resolves per-file candidate rule sets via the
-     precompiled gate/anchor masks (vectorized; typically empty).
+  1. Host packs blobs densely into overlapping rows (scanner/packing.py
+     pack_dense — zero padding waste, h2d is the wall through the host link).
+  2. Device runs the masked 4-gram sieve (ops/gram_sieve.py) over every byte,
+     producing per-row gram-hit bitmaps; the row axis shards over the mesh.
+  3. Host ORs bitmaps per file, maps grams -> probes (engine/grams.py), and
+     resolves per-file candidate rule sets via the precompiled gate/anchor
+     masks (vectorized; typically empty).
   4. Host confirms candidates byte-exactly with the oracle restricted to the
      candidate subset — findings are byte-identical to the reference engine by
-     construction (probes are necessary conditions; see engine/probes.py).
+     construction (grams are necessary conditions; see engine/probes.py and
+     engine/grams.py).
 
 Per-file path gating (AllowPath etc.) happens in the oracle exactly as the
 reference does it, so gating order is preserved.
+
+The gather-LUT shift-AND sieve (ops/sieve.py) is kept as `sieve="lut"` — it is
+the bit-exact keyword semantics but gather-bound on TPU; the gram sieve is the
+production path (~5x faster exec, no gathers).
 """
 
 from __future__ import annotations
@@ -22,16 +29,23 @@ from dataclasses import dataclass
 import numpy as np
 
 from trivy_tpu.ftypes import Secret
+from trivy_tpu.engine.grams import GramSet, build_gram_set
 from trivy_tpu.engine.oracle import OracleScanner
 from trivy_tpu.engine.probes import ProbeSet, build_probe_set
 from trivy_tpu.rules.model import RuleSet, SecretConfig, build_ruleset
-from trivy_tpu.scanner.packing import DEFAULT_OVERLAP, DEFAULT_TILE_LEN, pack
+from trivy_tpu.scanner.packing import (
+    DEFAULT_OVERLAP,
+    DEFAULT_TILE_LEN,
+    pack,
+    pack_dense,
+)
 
-
-# Fixed tile-batch shapes.  Every device call uses one of these row counts, so
+# Fixed row-batch shapes.  Every device call uses one of these row counts, so
 # XLA compiles each bucket exactly once per process; larger scans are chunked
 # into max-bucket-row batches (static shapes — SURVEY §1 XLA semantics).
 TILE_BUCKETS = (512, 4096)
+
+GRAM_OVERLAP = 3  # gram window (4) - 1
 
 
 @dataclass
@@ -53,40 +67,63 @@ class TpuSecretEngine:
         tile_len: int = DEFAULT_TILE_LEN,
         mesh=None,
         max_batch_tiles: int = 4096,
+        sieve: str = "gram",
     ):
         self.ruleset = ruleset if ruleset is not None else build_ruleset(config)
         self.oracle = OracleScanner(self.ruleset)
         self.pset: ProbeSet = build_probe_set(self.ruleset.rules)
         self.tile_len = tile_len
-        self.overlap = max(DEFAULT_OVERLAP, self.pset.jmax)
         self.max_batch_tiles = max_batch_tiles
+        self.sieve = sieve
         self.stats = SieveStats()
+        self._mesh = mesh
+        self._tile_align = (
+            int(np.prod([mesh.shape[a] for a in mesh.axis_names])) if mesh else 1
+        )
 
         self._gate, self._gate_any, self._conj, self._conj_any = self.pset.gate_masks()
 
+        from trivy_tpu.ops import enable_compilation_cache
+
+        enable_compilation_cache()
+
         import jax.numpy as jnp
 
-        self._lut = jnp.asarray(self.pset.build_lut())
-        if mesh is not None:
-            from trivy_tpu.ops.sieve import make_sharded_sieve
+        if sieve == "gram":
+            from trivy_tpu.ops import gram_sieve as gs_mod
 
-            self._mesh = mesh
-            self._sieve_fn = make_sharded_sieve(mesh)
-            self._tile_align = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+            self.gset: GramSet = build_gram_set(self.pset)
+            masks, vals = gs_mod.pad_grams(self.gset.masks, self.gset.vals)
+            self._masks = jnp.asarray(masks)
+            self._vals = jnp.asarray(vals)
+            self.overlap = GRAM_OVERLAP
+            if mesh is not None:
+                fn = gs_mod.make_sharded_gram_sieve(mesh)
+            else:
+                fn = gs_mod._gram_sieve_jit
+            self._sieve_fn = lambda rows: fn(rows, self._masks, self._vals)
+        elif sieve == "lut":
+            self._lut = jnp.asarray(self.pset.build_lut())
+            self.overlap = max(DEFAULT_OVERLAP, self.pset.jmax)
+            if mesh is not None:
+                from trivy_tpu.ops.sieve import make_sharded_sieve
+
+                fn = make_sharded_sieve(mesh)
+                self._sieve_fn = lambda tiles: fn(tiles, self._lut)
+            else:
+                from trivy_tpu.ops import sieve as sieve_mod
+
+                self._sieve_fn = lambda tiles: sieve_mod._sieve_jit(
+                    tiles, self._lut, tiles.shape[1]
+                )
         else:
-            from trivy_tpu.ops import sieve as sieve_mod
-
-            self._mesh = None
-            self._sieve_fn = lambda tiles, lut: sieve_mod._sieve_jit(
-                tiles, lut, tiles.shape[1]
-            )
-            self._tile_align = 1
+            raise ValueError(f"unknown sieve: {sieve}")
 
     # ------------------------------------------------------------------
 
     def _buckets(self) -> list[int]:
-        """Tile-row batch shapes: TILE_BUCKETS capped by max_batch_tiles,
-        rounded up to the mesh-device multiple."""
+        """Row batch shapes: TILE_BUCKETS capped by max_batch_tiles, rounded
+        up to the mesh-device multiple."""
         align = self._tile_align
         caps = [b for b in TILE_BUCKETS if b <= self.max_batch_tiles]
         if not caps or caps[-1] != self.max_batch_tiles:
@@ -94,51 +131,67 @@ class TpuSecretEngine:
         return [-(-b // align) * align for b in caps]
 
     def warmup(self) -> None:
-        """Compile every tile-bucket shape ahead of timed scanning."""
+        """Compile every row-bucket shape ahead of timed scanning."""
         import jax
         import jax.numpy as jnp
 
         for rows in self._buckets():
-            tiles = jnp.zeros((rows, self.tile_len), dtype=jnp.uint8)
-            jax.block_until_ready(self._sieve_fn(tiles, self._lut))
+            batch = jnp.zeros((rows, self.tile_len), dtype=jnp.uint8)
+            jax.block_until_ready(self._sieve_fn(batch))
 
     def candidate_matrix(self, file_hits: np.ndarray) -> np.ndarray:
-        """[F, R] bool candidate matrix from per-file probe bitmaps."""
+        """[F, R] bool candidate matrix from per-file probe bitmaps [F, Pw]."""
         h = file_hits[:, None, :]  # [F, 1, Pw]
         gate_ok = ~self._gate_any[None, :] | (h & self._gate[None]).any(-1)
         conj_hit = (file_hits[:, None, None, :] & self._conj[None]).any(-1)  # [F,R,K]
         conj_ok = (~self._conj_any[None] | conj_hit).all(-1)
         return gate_ok & conj_ok
 
-    def _run_sieve(self, contents: list[bytes]) -> np.ndarray:
+    def _sieve_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Run the device sieve over fixed-shape row chunks; returns the
+        per-row packed hit words [T, W]."""
         import jax.numpy as jnp
-
-        from trivy_tpu.scanner.packing import count_tiles
 
         buckets = self._buckets()
         max_rows = buckets[-1]
-        total = count_tiles(contents, self.tile_len, self.overlap)
-        self.stats.tiles += total
+        total = len(rows)
         fit = next((b for b in buckets if total <= b), None)
         if fit is not None:
-            batch = pack(contents, self.tile_len, self.overlap, pad_tiles_to=fit)
-            tile_hits = np.asarray(self._sieve_fn(jnp.asarray(batch.tiles), self._lut))
-        else:
-            # Chunk into fixed max-bucket-row batches: one compiled shape,
-            # pipelined h2d/compute across chunks (dispatch is async; we only
-            # materialize results at the end).
-            batch = pack(contents, self.tile_len, self.overlap)
-            chunks = []
-            for off in range(0, len(batch.tiles), max_rows):
-                part = batch.tiles[off : off + max_rows]
-                if len(part) < max_rows:
-                    part = np.concatenate(
-                        [part, np.zeros((max_rows - len(part), part.shape[1]), np.uint8)]
-                    )
-                chunks.append(self._sieve_fn(jnp.asarray(part), self._lut))
-            tile_hits = np.concatenate([np.asarray(c) for c in chunks])[
-                : len(batch.tiles)
+            if total < fit:
+                rows = np.concatenate(
+                    [rows, np.zeros((fit - total, rows.shape[1]), np.uint8)]
+                )
+            return np.asarray(self._sieve_fn(jnp.asarray(rows)))[:total]
+        # Chunk into fixed max-bucket-row batches: one compiled shape,
+        # pipelined h2d/compute across chunks (dispatch is async; results
+        # materialize only at the end).
+        chunks = []
+        for off in range(0, total, max_rows):
+            part = rows[off : off + max_rows]
+            if len(part) < max_rows:
+                part = np.concatenate(
+                    [part, np.zeros((max_rows - len(part), part.shape[1]), np.uint8)]
+                )
+            chunks.append(self._sieve_fn(jnp.asarray(part)))
+        return np.concatenate([np.asarray(c) for c in chunks])[:total]
+
+    def _file_probe_hits(self, contents: list[bytes]) -> np.ndarray:
+        """[F, Pw] packed per-file probe-hit bitmaps."""
+        if self.sieve == "gram":
+            batch = pack_dense(contents, self.tile_len, self.overlap)
+            self.stats.tiles += len(batch.rows)
+            word_hits = self._sieve_rows(batch.rows)  # [T, Gw] packed grams
+            file_words = batch.file_hits(word_hits)  # [F, Gw]
+            gram_hits = (
+                (file_words[:, :, None] >> np.arange(32, dtype=np.uint32)) & 1
+            ).astype(bool)
+            gram_hits = gram_hits.reshape(len(file_words), -1)[
+                :, : self.gset.num_grams
             ]
+            return self.gset.probe_hits(gram_hits)
+        batch = pack(contents, self.tile_len, self.overlap)
+        self.stats.tiles += len(batch.tiles)
+        tile_hits = self._sieve_rows(batch.tiles)
         return batch.file_hits(tile_hits)
 
     def scan_batch(self, items: list[tuple[str, bytes]]) -> list[Secret]:
@@ -148,7 +201,7 @@ class TpuSecretEngine:
         self.stats.files += len(items)
         self.stats.bytes += sum(len(c) for _, c in items)
 
-        file_hits = self._run_sieve([c for _, c in items])
+        file_hits = self._file_probe_hits([c for _, c in items])
         cand = self.candidate_matrix(file_hits)
 
         results: list[Secret] = []

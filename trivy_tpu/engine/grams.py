@@ -1,0 +1,178 @@
+"""Masked-gram compilation: probes -> (mask, value) uint32 compare constants.
+
+The TPU-shaped reformulation of the probe sieve (engine/probes.py).  The
+gather-LUT shift-AND sieve is correct but gather-bound on TPU (byte-table
+gathers don't vectorize onto the VPU).  Instead, each probe is compiled to a
+small set of **masked 4-gram variants**: the device case-folds the content,
+packs every 4-byte window into a uint32, and tests
+
+    (window & mask_g) == val_g
+
+for all grams at once — pure elementwise compare/AND/OR that XLA fuses into
+one VPU kernel with no gathers (ops/gram_sieve.py).
+
+Soundness: a gram is derived from a window of the probe's byte-class sequence;
+positions with wide classes are masked out, small classes (<= MAX_CLASS_EXPAND
+members after case folding) are expanded into variants.  Every true probe
+occurrence therefore fires at least one of its grams ("no gram hit" soundly
+proves "no probe occurrence").  Probes whose best window is below the
+selectivity floor get no grams and are treated as always-hit (they stop
+filtering but never drop matches).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from trivy_tpu.engine.ir import bs_members
+from trivy_tpu.engine.probes import _FREQ, ProbeSet
+
+GRAM_LEN = 4
+MAX_CLASS_EXPAND = 4  # class wider than this (folded) is masked out
+MAX_VARIANTS = 8  # max expanded (mask, val) pairs per probe
+MIN_GRAM_BITS = 9.0  # selectivity floor (bits) for a usable gram
+
+
+def fold_byte(b: int) -> int:
+    return b + 32 if 0x41 <= b <= 0x5A else b
+
+
+def fold_members(bs: int) -> list[int]:
+    return sorted({fold_byte(b) for b in bs_members(bs)})
+
+
+def _class_bits(members: list[int]) -> float:
+    p = float(sum(_FREQ[b] for b in members))
+    return -math.log2(max(p, 1e-12))
+
+
+@dataclass
+class _Position:
+    members: list[int]  # folded byte values
+    keep: bool  # participates in the mask
+    bits: float
+
+
+def _plan_window(classes: tuple[int, ...]) -> tuple[float, list[_Position]]:
+    """Score one window; greedily mask out wide / low-value positions until
+    the variant product fits MAX_VARIANTS."""
+    positions = []
+    for bs in classes:
+        members = fold_members(bs)
+        keep = 0 < len(members) <= MAX_CLASS_EXPAND and 0 not in members
+        positions.append(
+            _Position(members=members, keep=keep, bits=_class_bits(members))
+        )
+
+    def product() -> int:
+        p = 1
+        for pos in positions:
+            if pos.keep and len(pos.members) > 1:
+                p *= len(pos.members)
+        return p
+
+    while product() > MAX_VARIANTS:
+        # Drop the kept multi-member position with the least selectivity.
+        worst = min(
+            (p for p in positions if p.keep and len(p.members) > 1),
+            key=lambda p: p.bits,
+        )
+        worst.keep = False
+
+    score = sum(p.bits for p in positions if p.keep)
+    return score, positions
+
+
+def probe_grams(classes: tuple[int, ...]) -> list[tuple[int, int]]:
+    """Best window's (mask, val) uint32 variants, or [] if below the floor."""
+    wlen = min(GRAM_LEN, len(classes))
+    best_score, best_plan = -1.0, None
+    for start in range(len(classes) - wlen + 1):
+        score, plan = _plan_window(tuple(classes[start : start + wlen]))
+        if score > best_score:
+            best_score, best_plan = score, plan
+    if best_plan is None or best_score < MIN_GRAM_BITS:
+        return []
+
+    variants: list[tuple[int, int]] = [(0, 0)]
+    for j, pos in enumerate(best_plan):
+        if not pos.keep:
+            continue
+        shift = 8 * j
+        variants = [
+            (mask | (0xFF << shift), val | (member << shift))
+            for mask, val in variants
+            for member in pos.members
+        ]
+    return variants
+
+
+@dataclass
+class GramSet:
+    """Compiled gram constants + probe attribution."""
+
+    masks: np.ndarray  # [G] uint32
+    vals: np.ndarray  # [G] uint32
+    gram_probe: np.ndarray  # [G] int32 — owning probe index
+    probe_has_gram: np.ndarray  # [P] bool
+    num_probes: int
+    _member: np.ndarray = field(init=False, repr=False)  # [G, P] f32 0/1
+    _bit_weights: np.ndarray = field(init=False, repr=False)  # [P-pad] uint32
+
+    def __post_init__(self) -> None:
+        self._member = np.zeros((self.num_grams, self.num_probes), dtype=np.float32)
+        if self.num_grams:
+            self._member[np.arange(self.num_grams), self.gram_probe] = 1.0
+        pw = (self.num_probes + 31) // 32
+        self._bit_weights = (
+            np.uint32(1) << (np.arange(pw * 32, dtype=np.uint32) % 32)
+        )
+
+    @property
+    def num_grams(self) -> int:
+        return len(self.masks)
+
+    def probe_hits(self, gram_hits: np.ndarray) -> np.ndarray:
+        """[F, G] bool gram hits -> [F, Pw] packed uint32 probe bitmaps.
+
+        Probes without grams are always-hit (sound over-approximation)."""
+        f = gram_hits.shape[0]
+        probe_hit = gram_hits.astype(np.float32) @ self._member > 0  # [F, P]
+        probe_hit[:, ~self.probe_has_gram] = True
+
+        pw = (self.num_probes + 31) // 32
+        padded = np.zeros((f, pw * 32), dtype=np.uint32)
+        padded[:, : self.num_probes] = probe_hit
+        return (
+            (padded * self._bit_weights[None, :])
+            .reshape(f, pw, 32)
+            .sum(axis=-1, dtype=np.uint32)
+        )
+
+
+def build_gram_set(pset: ProbeSet) -> GramSet:
+    masks: list[int] = []
+    vals: list[int] = []
+    gram_probe: list[int] = []
+    has = np.zeros(len(pset.probes), dtype=bool)
+
+    for p, probe in enumerate(pset.probes):
+        variants = probe_grams(probe.classes)
+        if not variants:
+            continue
+        has[p] = True
+        for mask, val in variants:
+            masks.append(mask)
+            vals.append(val)
+            gram_probe.append(p)
+
+    return GramSet(
+        masks=np.array(masks, dtype=np.uint32),
+        vals=np.array(vals, dtype=np.uint32),
+        gram_probe=np.array(gram_probe, dtype=np.int32),
+        probe_has_gram=has,
+        num_probes=len(pset.probes),
+    )

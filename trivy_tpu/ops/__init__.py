@@ -1,1 +1,37 @@
-"""JAX/Pallas device ops: the packed shift-AND sieve and NFA state stepping."""
+"""Device kernels (JAX/XLA) for the scan engines."""
+
+import os
+
+_CACHE_ENABLED = False
+
+
+def enable_compilation_cache() -> None:
+    """Persist XLA executables across processes.
+
+    A CLI scanner starts a fresh process per invocation; without this every
+    `trivy-tpu fs` run pays the full XLA compile (~20-40s on TPU) for the
+    sieve kernels.  With the cache, only the first run on a machine compiles.
+    """
+    global _CACHE_ENABLED
+    if _CACHE_ENABLED:
+        return
+    import jax
+
+    if jax.config.jax_compilation_cache_dir:  # respect an embedding app's cache
+        _CACHE_ENABLED = True
+        return
+    cache_dir = os.environ.get(
+        "TRIVY_TPU_JAX_CACHE",
+        os.path.join(
+            os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+            "trivy_tpu",
+            "jax",
+        ),
+    )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        _CACHE_ENABLED = True
+    except Exception:  # cache is an optimization; never fail the scan
+        pass

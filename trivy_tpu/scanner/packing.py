@@ -46,6 +46,77 @@ def count_tiles(contents: list[bytes], tile_len: int, overlap: int) -> int:
     return sum(_tile_counts(contents, tile_len, overlap))
 
 
+@dataclass
+class DenseBatch:
+    """Zero-waste packing: files concatenated (with a small zero gap) into one
+    stream, reshaped into overlapping rows.  A row may span several files;
+    per-file hit attribution ORs every row overlapping the file's span (a
+    sound over-approximation — neighbors in a row share candidates).
+    """
+
+    rows: np.ndarray  # [T, row_len] uint8
+    file_row_lo: np.ndarray  # [F] int32 — first row overlapping the file
+    file_row_hi: np.ndarray  # [F] int32 — last row (inclusive)
+    num_files: int
+
+    def file_hits(self, row_hits: np.ndarray) -> np.ndarray:
+        """OR row-level hit bitmaps [T, W] into per-file bitmaps [F, W]."""
+        w = row_hits.shape[1]
+        out = np.zeros((self.num_files, w), dtype=row_hits.dtype)
+        # Prefix-OR would be O(T); spans are short, so slice per file.
+        for fi in range(self.num_files):
+            lo, hi = self.file_row_lo[fi], self.file_row_hi[fi]
+            if hi >= lo:
+                out[fi] = np.bitwise_or.reduce(row_hits[lo : hi + 1], axis=0)
+        return out
+
+
+def pack_dense(
+    contents: list[bytes],
+    row_len: int,
+    overlap: int,
+    gap: int | None = None,
+) -> DenseBatch:
+    """Pack files densely into overlapping rows of one byte stream.
+
+    `overlap` must be >= probe-window - 1 so no window is lost at a row seam;
+    `gap` zero bytes separate files (>= overlap stops full-window grams from
+    spanning two files).
+    """
+    gap = overlap if gap is None else gap
+    stride = row_len - overlap
+
+    offsets = []
+    pos = 0
+    for c in contents:
+        offsets.append((pos, pos + len(c)))
+        pos += len(c) + gap
+    total = pos + overlap  # tail padding so the final windows exist
+
+    nrows = max(1, -(-max(total - overlap, 1) // stride))
+    stream = np.zeros(nrows * stride + overlap, dtype=np.uint8)
+    for (s, _e), c in zip(offsets, contents):
+        stream[s : s + len(c)] = np.frombuffer(c, dtype=np.uint8)
+
+    rows = np.lib.stride_tricks.sliding_window_view(stream, row_len)[::stride]
+    assert len(rows) == nrows, (len(rows), nrows)
+
+    lo = np.zeros(len(contents), dtype=np.int32)
+    hi = np.full(len(contents), -1, dtype=np.int32)
+    for fi, (s, e) in enumerate(offsets):
+        if e == s:
+            continue  # empty file: no rows
+        # Windows containing any byte of the file start in [s-overlap, e).
+        lo[fi] = max(0, s - overlap) // stride
+        hi[fi] = min((e - 1) // stride, nrows - 1)
+    return DenseBatch(
+        rows=np.ascontiguousarray(rows),
+        file_row_lo=lo,
+        file_row_hi=hi,
+        num_files=len(contents),
+    )
+
+
 def pack(
     contents: list[bytes],
     tile_len: int = DEFAULT_TILE_LEN,

@@ -94,16 +94,22 @@ def clean_skip_paths(paths: list[str]) -> list[str]:
     return [os.path.normpath(p).replace(os.sep, "/").lstrip("/") for p in paths]
 
 
-def skip_path(path: str, skip_patterns: list[str]) -> bool:
-    """walker.SkipPath (walk.go:39-53)."""
-    path = path.lstrip("/")
-    for pattern in skip_patterns:
+def compile_skip_patterns(patterns: list[str]) -> list[re.Pattern[str]]:
+    out = []
+    for pattern in patterns:
         try:
-            if _doublestar_to_re(pattern).match(path):
-                return True
+            out.append(_doublestar_to_re(pattern))
         except re.error:
-            return False
-    return False
+            pass  # bad pattern never matches (walk.go:44-46)
+    return out
+
+
+def skip_path(path: str, skip_patterns: list) -> bool:
+    """walker.SkipPath (walk.go:39-53); accepts raw globs or precompiled."""
+    path = path.lstrip("/")
+    if skip_patterns and isinstance(skip_patterns[0], str):
+        skip_patterns = compile_skip_patterns(skip_patterns)
+    return any(rx.match(path) for rx in skip_patterns)
 
 
 class FSWalker:
@@ -113,8 +119,10 @@ class FSWalker:
         self.option = option or WalkOption()
 
     def walk(self, root: str) -> Iterator[FileEntry]:
-        skip_files = clean_skip_paths(self.option.skip_files)
-        skip_dirs = clean_skip_paths(self.option.skip_dirs) + DEFAULT_SKIP_DIRS
+        skip_files = compile_skip_patterns(clean_skip_paths(self.option.skip_files))
+        skip_dirs = compile_skip_patterns(
+            clean_skip_paths(self.option.skip_dirs) + DEFAULT_SKIP_DIRS
+        )
 
         root = os.path.abspath(root)
         if os.path.isfile(root):
@@ -153,6 +161,8 @@ class FSWalker:
 
                 if not statmod.S_ISREG(st.st_mode):
                     continue
+                if st.st_size > DEFAULT_SIZE_THRESHOLD:
+                    continue  # walk.go:15 defaultSizeThreshold
                 yield FileEntry(
                     path=rel, size=st.st_size, mode=st.st_mode, opener=_opener(full)
                 )
