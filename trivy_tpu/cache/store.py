@@ -23,6 +23,10 @@ from trivy_tpu.atypes import BLOB_JSON_SCHEMA_VERSION, ArtifactInfo, BlobInfo
 SCHEMA_VERSION = 2  # cache.go schemaVersion
 
 
+class BlobNotFoundError(KeyError):
+    """Requested blob IDs are not in the cache (deterministic client error)."""
+
+
 class ArtifactCache:
     """Interface: cache.ArtifactCache + cache.LocalArtifactCache."""
 

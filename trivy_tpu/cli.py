@@ -62,6 +62,7 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
         default=_env_default("cache-backend", "memory"),
     )
     p.add_argument("--server", default="", help="server address (client mode)")
+    p.add_argument("--token", default="", help="server auth token")
     p.add_argument("--list-all-pkgs", action="store_true")
 
 
@@ -81,6 +82,7 @@ def _options_from_args(args: argparse.Namespace) -> Options:
         secret_backend=args.secret_backend,
         ignore_file=args.ignorefile if os.path.exists(args.ignorefile) else "",
         server_addr=args.server,
+        token=args.token,
         list_all_packages=args.list_all_pkgs,
     )
 

@@ -50,10 +50,17 @@ class Options:
     ignore_file: str = ""
     disabled_analyzers: list[str] = field(default_factory=list)
     server_addr: str = ""  # non-empty => client mode (remote driver)
+    token: str = ""
     list_all_packages: bool = False
 
 
 def init_cache(options: Options) -> ArtifactCache:
+    if options.server_addr:
+        # Client mode (run.go:349-350): analysis blobs upload to the server's
+        # cache; the server owns the applier and detectors.
+        from trivy_tpu.rpc.client import RemoteCache
+
+        return RemoteCache(options.server_addr, options.token)
     if options.cache_backend == "fs" and options.cache_dir:
         return FSCache(options.cache_dir)
     return MemoryCache()
@@ -115,7 +122,7 @@ def _build_scanner(options: Options, target_kind: str, cache: ArtifactCache) -> 
     if options.server_addr:
         from trivy_tpu.rpc.client import RemoteDriver
 
-        driver = RemoteDriver(options.server_addr)
+        driver = RemoteDriver(options.server_addr, options.token)
     else:
         driver = LocalDriver(cache)
     return Scanner(artifact=artifact, driver=driver)

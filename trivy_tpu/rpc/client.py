@@ -1,0 +1,124 @@
+"""RPC client: remote scan driver + remote cache.
+
+Mirrors pkg/rpc/client/client.go (Scanner with custom headers) and
+pkg/cache/remote.go (RemoteCache), with retry/exponential backoff like
+pkg/rpc/retry.go.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Iterable
+
+from trivy_tpu.atypes import ArtifactInfo, BlobInfo
+from trivy_tpu.cache.store import ArtifactCache
+from trivy_tpu.rpc.convert import blob_to_json, os_from_json, result_from_json
+from trivy_tpu.rpc.server import TOKEN_HEADER
+from trivy_tpu.scanner.service import Driver, ScanOptions
+
+MAX_RETRIES = 3
+BACKOFF_BASE_S = 0.2
+
+
+class RpcError(RuntimeError):
+    pass
+
+
+@dataclass
+class RpcClient:
+    addr: str  # host:port
+    token: str = ""
+    headers: dict[str, str] = field(default_factory=dict)
+
+    def call(self, path: str, payload: dict) -> dict:
+        url = f"http://{self.addr}{path}"
+        body = json.dumps(payload).encode()
+        last: Exception | None = None
+        for attempt in range(MAX_RETRIES):
+            req = urllib.request.Request(
+                url, data=body, headers={"Content-Type": "application/json"}
+            )
+            if self.token:
+                req.add_header(TOKEN_HEADER, self.token)
+            for k, v in self.headers.items():
+                req.add_header(k, v)
+            try:
+                with urllib.request.urlopen(req, timeout=300) as resp:
+                    return json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                if 400 <= e.code < 500:  # deterministic; non-retryable
+                    raise RpcError(f"{path}: HTTP {e.code}: {e.read()!r}") from e
+                last = e
+            except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
+                last = e
+            time.sleep(BACKOFF_BASE_S * (2**attempt))
+        raise RpcError(f"{path}: retries exhausted: {last}")
+
+
+@dataclass
+class RemoteDriver(Driver):
+    """pkg/rpc/client Scanner: the Driver seam over the wire."""
+
+    addr: str
+    token: str = ""
+
+    def scan(self, target, artifact_id, blob_ids, options: ScanOptions):
+        client = RpcClient(self.addr, self.token)
+        resp = client.call(
+            "/twirp/trivy.scanner.v1.Scanner/Scan",
+            {
+                "Target": target,
+                "ArtifactID": artifact_id,
+                "BlobIDs": list(blob_ids),
+                "Options": {"Scanners": list(options.scanners)},
+            },
+        )
+        results = [result_from_json(r) for r in (resp.get("Results") or [])]
+        return results, os_from_json(resp.get("OS"))
+
+
+class RemoteCache(ArtifactCache):
+    """pkg/cache/remote.go: Put side goes to the server; Get side is absent on
+    the client (the server owns the applier), mirroring NopCache-wrapping."""
+
+    def __init__(self, addr: str, token: str = ""):
+        self.client = RpcClient(addr, token)
+
+    def put_artifact(self, artifact_id: str, info: ArtifactInfo) -> None:
+        self.client.call(
+            "/twirp/trivy.cache.v1.Cache/PutArtifact",
+            {"ArtifactID": artifact_id, "ArtifactInfo": info.to_json()},
+        )
+
+    def put_blob(self, blob_id: str, info: BlobInfo) -> None:
+        self.client.call(
+            "/twirp/trivy.cache.v1.Cache/PutBlob",
+            {"BlobID": blob_id, "BlobInfo": blob_to_json(info)},
+        )
+
+    def get_artifact(self, artifact_id: str) -> ArtifactInfo | None:
+        return None  # client never reads artifacts back
+
+    def get_blob(self, blob_id: str) -> BlobInfo | None:
+        return None
+
+    def missing_blobs(
+        self, artifact_id: str, blob_ids: Iterable[str]
+    ) -> tuple[bool, list[str]]:
+        resp = self.client.call(
+            "/twirp/trivy.cache.v1.Cache/MissingBlobs",
+            {"ArtifactID": artifact_id, "BlobIDs": list(blob_ids)},
+        )
+        return bool(resp.get("MissingArtifact")), list(resp.get("MissingBlobIDs") or [])
+
+    def delete_blobs(self, blob_ids: Iterable[str]) -> None:
+        self.client.call(
+            "/twirp/trivy.cache.v1.Cache/DeleteBlobs", {"BlobIDs": list(blob_ids)}
+        )
+
+    def clear(self) -> None:
+        pass

@@ -1,0 +1,178 @@
+"""Scan server: Twirp-style HTTP/JSON endpoints.
+
+Mirrors pkg/rpc/server/listen.go — a mux serving the scanner service, the
+cache service, /healthz and /version, with optional token auth header.  The
+division of labor matches the reference (§2.5): clients walk + analyze
+locally, upload blobs via the cache service, and the server runs the applier
+and detectors (and owns the TPU mesh in sidecar deployments).
+
+Endpoints (POST, JSON bodies):
+  /twirp/trivy.scanner.v1.Scanner/Scan
+      {Target, ArtifactID, BlobIDs, Options{Scanners}} -> {OS, Results}
+  /twirp/trivy.cache.v1.Cache/PutArtifact   {ArtifactID, ArtifactInfo}
+  /twirp/trivy.cache.v1.Cache/PutBlob       {BlobID, BlobInfo}
+  /twirp/trivy.cache.v1.Cache/MissingBlobs  {ArtifactID, BlobIDs}
+                                            -> {MissingArtifact, MissingBlobIDs}
+  /twirp/trivy.cache.v1.Cache/DeleteBlobs   {BlobIDs}
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from trivy_tpu import __version__
+from trivy_tpu.atypes import ArtifactInfo
+from trivy_tpu.cache.store import (
+    ArtifactCache,
+    BlobNotFoundError,
+    FSCache,
+    MemoryCache,
+)
+from trivy_tpu.rpc.convert import blob_from_json, os_to_json, result_to_json
+from trivy_tpu.scanner.service import LocalDriver, ScanOptions
+
+TOKEN_HEADER = "Trivy-Tpu-Token"
+
+
+class ScanServer:
+    """pkg/rpc/server Server: scanner + cache services over one cache."""
+
+    def __init__(self, cache: ArtifactCache, token: str = ""):
+        self.cache = cache
+        self.token = token
+        self.driver = LocalDriver(cache)
+
+    # -- service methods ------------------------------------------------
+
+    def scan(self, req: dict) -> dict:
+        options = ScanOptions(
+            scanners=list((req.get("Options") or {}).get("Scanners") or ["secret"])
+        )
+        results, detected_os = self.driver.scan(
+            req.get("Target", ""),
+            req.get("ArtifactID", ""),
+            list(req.get("BlobIDs") or []),
+            options,
+        )
+        return {
+            "OS": os_to_json(detected_os),
+            "Results": [result_to_json(r) for r in results],
+        }
+
+    def put_artifact(self, req: dict) -> dict:
+        self.cache.put_artifact(
+            req["ArtifactID"], ArtifactInfo.from_json(req.get("ArtifactInfo") or {})
+        )
+        return {}
+
+    def put_blob(self, req: dict) -> dict:
+        self.cache.put_blob(req["BlobID"], blob_from_json(req.get("BlobInfo") or {}))
+        return {}
+
+    def missing_blobs(self, req: dict) -> dict:
+        missing_artifact, missing = self.cache.missing_blobs(
+            req.get("ArtifactID", ""), list(req.get("BlobIDs") or [])
+        )
+        return {"MissingArtifact": missing_artifact, "MissingBlobIDs": missing}
+
+    def delete_blobs(self, req: dict) -> dict:
+        self.cache.delete_blobs(list(req.get("BlobIDs") or []))
+        return {}
+
+
+_ROUTES = {
+    "/twirp/trivy.scanner.v1.Scanner/Scan": "scan",
+    "/twirp/trivy.cache.v1.Cache/PutArtifact": "put_artifact",
+    "/twirp/trivy.cache.v1.Cache/PutBlob": "put_blob",
+    "/twirp/trivy.cache.v1.Cache/MissingBlobs": "missing_blobs",
+    "/twirp/trivy.cache.v1.Cache/DeleteBlobs": "delete_blobs",
+}
+
+
+def _make_handler(server: ScanServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):  # quiet
+            pass
+
+        def _send(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                body = b"ok"
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path == "/version":
+                self._send(200, {"Version": __version__})
+            else:
+                self._send(404, {"error": "not found"})
+
+        def do_POST(self):
+            # Always drain the body first: HTTP/1.1 keep-alive connections
+            # desynchronize if a response is sent with unread body bytes.
+            length = int(self.headers.get("Content-Length", "0"))
+            raw = self.rfile.read(length)
+            if server.token and self.headers.get(TOKEN_HEADER, "") != server.token:
+                self._send(401, {"error": "invalid token"})
+                return
+            method = _ROUTES.get(self.path)
+            if method is None:
+                self._send(404, {"error": f"no such rpc: {self.path}"})
+                return
+            try:
+                req = json.loads(raw or b"{}")
+                self._send(200, getattr(server, method)(req))
+            except BlobNotFoundError as e:
+                self._send(422, {"error": str(e)})  # deterministic; don't retry
+            except (KeyError, json.JSONDecodeError) as e:
+                self._send(400, {"error": f"bad request: {e}"})
+            except Exception as e:  # one bad request must not kill the server
+                self._send(500, {"error": str(e)})
+
+    return Handler
+
+
+def make_http_server(
+    addr: str, cache: ArtifactCache, token: str = ""
+) -> ThreadingHTTPServer:
+    host, _, port = addr.rpartition(":")
+    httpd = ThreadingHTTPServer(
+        (host or "localhost", int(port)), _make_handler(ScanServer(cache, token))
+    )
+    return httpd
+
+
+def serve(addr: str, cache_dir: str = "", token: str = "") -> None:
+    """pkg/rpc/server/listen.go ListenAndServe."""
+    cache = FSCache(cache_dir) if cache_dir else MemoryCache()
+    httpd = make_http_server(addr, cache, token)
+    print(f"trivy-tpu server listening on {httpd.server_address[0]}:{httpd.server_address[1]}")
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+
+
+def start_background(
+    addr: str, cache: ArtifactCache, token: str = ""
+) -> tuple[ThreadingHTTPServer, threading.Thread]:
+    """In-process server for tests (the §4 'multi-node without a cluster'
+    pattern: integration_test.go:77-103 binds a real server on a free port)."""
+    httpd = make_http_server(addr, cache, token)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    return httpd, t
