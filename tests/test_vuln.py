@@ -1,0 +1,339 @@
+"""Vulnerability vertical: analyzers -> detectors -> report (fixture DB,
+the pkg/dbtest pattern from SURVEY §4)."""
+
+import json
+
+import pytest
+
+from trivy_tpu.analyzer.lang import (
+    CargoLockAnalyzer,
+    ComposerLockAnalyzer,
+    GemfileLockAnalyzer,
+    GoModAnalyzer,
+    NpmLockAnalyzer,
+    PipRequirementsAnalyzer,
+    PipenvLockAnalyzer,
+    PnpmLockAnalyzer,
+    PoetryLockAnalyzer,
+    YarnLockAnalyzer,
+)
+from trivy_tpu.analyzer.os_release import parse_os_release
+from trivy_tpu.analyzer.pkg_apk import parse_apk_db
+from trivy_tpu.analyzer.pkg_dpkg import parse_dpkg_status
+from trivy_tpu.commands.run import Options, run
+from trivy_tpu.db.vulndb import Advisory, build_db
+from trivy_tpu.detector.version_cmp import (
+    compare_apk,
+    compare_deb,
+    compare_pep440,
+    compare_semver,
+    version_in_range,
+)
+
+
+# ---------------------------------------------------------------------------
+# version comparators
+# ---------------------------------------------------------------------------
+
+
+def test_compare_deb():
+    assert compare_deb("1.2.3", "1.2.4") < 0
+    assert compare_deb("2:1.0", "1:9.9") > 0
+    assert compare_deb("1.0-1", "1.0-2") < 0
+    assert compare_deb("1.0~rc1", "1.0") < 0  # tilde sorts first
+    assert compare_deb("1.0", "1.0") == 0
+    assert compare_deb("9.9", "10.0") < 0
+    assert compare_deb("1.0a", "1.0") > 0
+
+
+def test_compare_apk():
+    assert compare_apk("1.2.2-r0", "1.2.2-r4") < 0
+    assert compare_apk("1.2.2-r4", "1.2.3-r0") < 0
+    assert compare_apk("2.9.18-r0", "2.9.18-r0") == 0
+    assert compare_apk("1.0_rc1", "1.0") < 0
+    assert compare_apk("1.0_p1", "1.0") > 0
+    assert compare_apk("1.10", "1.9") > 0
+
+
+def test_compare_semver():
+    assert compare_semver("1.2.3", "1.2.10") < 0
+    assert compare_semver("v4.0.0", "4.0.0") == 0
+    assert compare_semver("1.0.0-alpha", "1.0.0") < 0
+    assert compare_semver("1.0.0-alpha.1", "1.0.0-alpha.2") < 0
+
+
+def test_compare_pep440():
+    assert compare_pep440("2.28.0", "2.31.0") < 0
+    assert compare_pep440("1.0rc1", "1.0") < 0
+    assert compare_pep440("2024.1", "2024.2") < 0
+
+
+def test_version_in_range_spaced_ghsa_style():
+    assert version_in_range("4.0.5", ">= 4.0.0, < 4.0.14")
+    assert not version_in_range("4.0.14", ">= 4.0.0, < 4.0.14")
+    compare_semver("1.0a", "1.0.0")  # odd versions must not TypeError
+    compare_semver("1.2.3.RELEASE", "1.2.3")
+
+
+def test_version_in_range():
+    assert version_in_range("4.0.10", ">=4.0.0, <4.0.14")
+    assert not version_in_range("4.0.14", ">=4.0.0, <4.0.14")
+    assert version_in_range("1.1.0", "<1.2.0 || >=2.0.0, <2.1.0")
+    assert version_in_range("2.0.5", "<1.2.0 || >=2.0.0, <2.1.0")
+    assert not version_in_range("1.5.0", "<1.2.0 || >=2.0.0, <2.1.0")
+
+
+# ---------------------------------------------------------------------------
+# parsers
+# ---------------------------------------------------------------------------
+
+
+def test_parse_os_release():
+    content = b'NAME="Alpine Linux"\nID=alpine\nVERSION_ID=3.15.4\n'
+    assert parse_os_release(content) == ("alpine", "3.15.4")
+
+
+def test_parse_apk_db():
+    db = (
+        b"P:musl\nV:1.2.2-r7\nA:x86_64\nL:MIT\no:musl\nD:so:libc.musl\n\n"
+        b"P:busybox\nV:1.34.1-r5\nA:x86_64\nL:GPL-2.0-only\no:busybox\n\n"
+    )
+    pkgs = parse_apk_db(db)
+    assert [(p.name, p.version) for p in pkgs] == [
+        ("musl", "1.2.2-r7"),
+        ("busybox", "1.34.1-r5"),
+    ]
+    assert pkgs[0].licenses == ["MIT"]
+
+
+def test_parse_dpkg_status():
+    status = (
+        "Package: libssl1.1\n"
+        "Status: install ok installed\n"
+        "Architecture: amd64\n"
+        "Source: openssl (1.1.1n-0+deb11u1)\n"
+        "Version: 1.1.1n-0+deb11u1\n"
+        "\n"
+        "Package: removed-pkg\n"
+        "Status: deinstall ok config-files\n"
+        "Version: 1.0\n"
+    ).encode()
+    pkgs = parse_dpkg_status(status)
+    assert len(pkgs) == 1
+    assert pkgs[0].name == "libssl1.1"
+    assert pkgs[0].src_name == "openssl"
+
+
+def test_lockfile_parsers():
+    cases = [
+        (
+            NpmLockAnalyzer(),
+            json.dumps(
+                {
+                    "lockfileVersion": 3,
+                    "packages": {
+                        "": {"name": "app"},
+                        "node_modules/lodash": {"version": "4.17.20"},
+                        "node_modules/@scope/pkg": {"version": "1.0.0", "dev": True},
+                    },
+                }
+            ).encode(),
+            [("@scope/pkg", "1.0.0"), ("lodash", "4.17.20")],
+        ),
+        (
+            YarnLockAnalyzer(),
+            b'# yarn lockfile v1\n\nlodash@^4.17.0:\n  version "4.17.20"\n',
+            [("lodash", "4.17.20")],
+        ),
+        (
+            PnpmLockAnalyzer(),
+            b"lockfileVersion: '6.0'\npackages:\n  /lodash@4.17.20:\n    resolution: {}\n",
+            [("lodash", "4.17.20")],
+        ),
+        (
+            PipRequirementsAnalyzer(),
+            b"requests==2.28.0\n# comment\nflask == 2.0.1\n-e git+https://x\n",
+            [("flask", "2.0.1"), ("requests", "2.28.0")],
+        ),
+        (
+            PipenvLockAnalyzer(),
+            json.dumps({"default": {"requests": {"version": "==2.28.0"}}}).encode(),
+            [("requests", "2.28.0")],
+        ),
+        (
+            PoetryLockAnalyzer(),
+            b'[[package]]\nname = "requests"\nversion = "2.28.0"\n',
+            [("requests", "2.28.0")],
+        ),
+        (
+            GoModAnalyzer(),
+            b"module example.com/app\n\nrequire (\n\tgithub.com/gin-gonic/gin v1.7.0\n)\n",
+            [("github.com/gin-gonic/gin", "1.7.0")],
+        ),
+        (
+            CargoLockAnalyzer(),
+            b'[[package]]\nname = "serde"\nversion = "1.0.100"\n',
+            [("serde", "1.0.100")],
+        ),
+        (
+            ComposerLockAnalyzer(),
+            json.dumps(
+                {"packages": [{"name": "guzzlehttp/guzzle", "version": "7.4.0"}]}
+            ).encode(),
+            [("guzzlehttp/guzzle", "7.4.0")],
+        ),
+        (
+            GemfileLockAnalyzer(),
+            b"GEM\n  remote: https://rubygems.org/\n  specs:\n    rails (6.1.4)\n\nDEPENDENCIES\n  rails\n",
+            [("rails", "6.1.4")],
+        ),
+    ]
+    for analyzer, content, expected in cases:
+        pkgs = analyzer.parse(content)
+        got = sorted((p.name, p.version) for p in pkgs)
+        assert got == sorted(expected), type(analyzer).__name__
+
+
+# ---------------------------------------------------------------------------
+# end-to-end vuln scan over a rootfs-like tree with a fixture DB
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def fixture_db(tmp_path):
+    db_dir = tmp_path / "db"
+    build_db(
+        str(db_dir),
+        {
+            "alpine 3.15": {
+                "musl": [
+                    Advisory(
+                        vulnerability_id="CVE-2099-0001",
+                        fixed_version="1.2.3-r0",
+                        severity="HIGH",
+                        title="musl overflow",
+                    )
+                ],
+                "busybox": [
+                    Advisory(
+                        vulnerability_id="CVE-2099-0002",
+                        fixed_version="1.34.0-r0",  # already fixed
+                        severity="LOW",
+                    )
+                ],
+            },
+            "npm": {
+                "lodash": [
+                    Advisory(
+                        vulnerability_id="CVE-2099-1000",
+                        vulnerable_versions="<4.17.21",
+                        fixed_version="4.17.21",
+                        severity="CRITICAL",
+                        title="lodash prototype pollution",
+                    )
+                ]
+            },
+        },
+    )
+    return str(db_dir)
+
+
+@pytest.fixture
+def rootfs(tmp_path):
+    root = tmp_path / "rootfs"
+    (root / "etc").mkdir(parents=True)
+    (root / "etc" / "os-release").write_bytes(
+        b"ID=alpine\nVERSION_ID=3.15.4\n"
+    )
+    (root / "lib" / "apk" / "db").mkdir(parents=True)
+    (root / "lib" / "apk" / "db" / "installed").write_bytes(
+        b"P:musl\nV:1.2.2-r7\no:musl\n\nP:busybox\nV:1.34.1-r5\no:busybox\n\n"
+    )
+    (root / "app").mkdir()
+    (root / "app" / "package-lock.json").write_bytes(
+        json.dumps(
+            {
+                "lockfileVersion": 3,
+                "packages": {"node_modules/lodash": {"version": "4.17.20"}},
+            }
+        ).encode()
+    )
+    return str(root)
+
+
+def test_rootfs_vuln_scan(tmp_path, rootfs, fixture_db):
+    out = tmp_path / "report.json"
+    code = run(
+        Options(
+            target=rootfs,
+            scanners=["vuln"],
+            format="json",
+            output=str(out),
+            db_dir=fixture_db,
+        ),
+        "rootfs",
+    )
+    assert code == 0
+    report = json.loads(out.read_text())
+    assert report["Metadata"]["OS"] == {"Family": "alpine", "Name": "3.15.4"}
+
+    results = {r["Target"]: r for r in report["Results"]}
+    os_result = results[f"{rootfs} (alpine 3.15.4)"]
+    assert os_result["Class"] == "os-pkgs"
+    vulns = {v["VulnerabilityID"]: v for v in os_result["Vulnerabilities"]}
+    assert "CVE-2099-0001" in vulns  # musl 1.2.2-r7 < 1.2.3-r0
+    assert "CVE-2099-0002" not in vulns  # busybox already fixed
+    assert vulns["CVE-2099-0001"]["FixedVersion"] == "1.2.3-r0"
+
+    npm_result = results["app/package-lock.json"]
+    assert npm_result["Class"] == "lang-pkgs"
+    assert npm_result["Type"] == "npm"
+    assert npm_result["Vulnerabilities"][0]["VulnerabilityID"] == "CVE-2099-1000"
+
+
+def test_vuln_scan_without_db(tmp_path, rootfs):
+    out = tmp_path / "report.json"
+    code = run(
+        Options(
+            target=rootfs, scanners=["vuln"], format="json", output=str(out)
+        ),
+        "rootfs",
+    )
+    assert code == 0  # no DB -> no vuln results, not a crash
+    report = json.loads(out.read_text())
+    assert not any(
+        r.get("Vulnerabilities") for r in report.get("Results", [])
+    )
+
+
+def test_client_server_vuln_scan(tmp_path, rootfs, fixture_db):
+    from trivy_tpu.cache.store import MemoryCache
+    from trivy_tpu.rpc.server import start_background
+
+    cache = MemoryCache()
+    httpd, _ = start_background("localhost:0", cache, db_dir=fixture_db)
+    addr = f"{httpd.server_address[0]}:{httpd.server_address[1]}"
+    try:
+        out = tmp_path / "remote.json"
+        code = run(
+            Options(
+                target=rootfs,
+                scanners=["vuln"],
+                format="json",
+                output=str(out),
+                server_addr=addr,
+            ),
+            "rootfs",
+        )
+        assert code == 0
+        report = json.loads(out.read_text())
+        all_vulns = [
+            v["VulnerabilityID"]
+            for r in report["Results"]
+            for v in r.get("Vulnerabilities", [])
+        ]
+        assert "CVE-2099-0001" in all_vulns
+        assert "CVE-2099-1000" in all_vulns
+    finally:
+        httpd.shutdown()
+        httpd.server_close()

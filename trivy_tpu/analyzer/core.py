@@ -180,6 +180,10 @@ def registered_analyzers() -> list[Callable[[], Analyzer]]:
 def _ensure_builtin_registered() -> None:
     # Import modules whose import side-effect registers analyzers (mirrors the
     # reference's `_ "…/analyzer/all"` blank imports).
+    from trivy_tpu.analyzer import lang as _lang  # noqa: F401
+    from trivy_tpu.analyzer import os_release as _os  # noqa: F401
+    from trivy_tpu.analyzer import pkg_apk as _apk  # noqa: F401
+    from trivy_tpu.analyzer import pkg_dpkg as _dpkg  # noqa: F401
     from trivy_tpu.analyzer import secret as _secret  # noqa: F401
 
 

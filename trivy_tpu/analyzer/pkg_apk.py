@@ -1,0 +1,81 @@
+"""apk package database analyzer (pkg/fanal/analyzer/pkg/apk/apk.go).
+
+Parses `lib/apk/db/installed` — stanzas of single-letter fields:
+P: name, V: version, A: arch, L: license, o: origin (source package),
+D/r: dependencies/provides.
+"""
+
+from __future__ import annotations
+
+from trivy_tpu.analyzer.core import (
+    AnalysisInput,
+    AnalysisResult,
+    Analyzer,
+    register_analyzer,
+)
+from trivy_tpu.atypes import Package, PackageInfo
+
+REQUIRED_FILE = "lib/apk/db/installed"
+
+
+def parse_apk_db(content: bytes) -> list[Package]:
+    packages: list[Package] = []
+    cur: dict[str, str] = {}
+    depends: list[str] = []
+
+    def flush() -> None:
+        nonlocal cur, depends
+        if cur.get("P") and cur.get("V"):
+            name, version = cur["P"], cur["V"]
+            packages.append(
+                Package(
+                    id=f"{name}@{version}",
+                    name=name,
+                    version=version,
+                    arch=cur.get("A", ""),
+                    src_name=cur.get("o", name),
+                    src_version=version,
+                    licenses=[l for l in cur.get("L", "").split(" AND ") if l],
+                    depends_on=sorted(set(depends)),
+                )
+            )
+        cur, depends = {}, []
+
+    for raw in content.decode("utf-8", errors="replace").splitlines():
+        if not raw.strip():
+            flush()
+            continue
+        key, _, value = raw.partition(":")
+        if key == "D":
+            for dep in value.split():
+                dep = dep.split("=")[0].split("<")[0].split(">")[0].split("~")[0]
+                if dep and not dep.startswith("!"):
+                    depends.append(dep)
+        elif key:
+            cur[key] = value
+    flush()
+    return packages
+
+
+class ApkAnalyzer(Analyzer):
+    def type(self) -> str:
+        return "apk"
+
+    def version(self) -> int:
+        return 2
+
+    def required(self, file_path: str, size: int, mode: int) -> bool:
+        return file_path == REQUIRED_FILE
+
+    def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
+        packages = parse_apk_db(inp.content)
+        if not packages:
+            return None
+        return AnalysisResult(
+            package_infos=[
+                PackageInfo(file_path=inp.file_path, packages=packages)
+            ]
+        )
+
+
+register_analyzer(ApkAnalyzer)

@@ -63,6 +63,8 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
     )
     p.add_argument("--server", default="", help="server address (client mode)")
     p.add_argument("--token", default="", help="server auth token")
+    p.add_argument("--db-dir", default=_env_default("db-dir", ""),
+                   help="vulnerability DB directory")
     p.add_argument("--list-all-pkgs", action="store_true")
 
 
@@ -83,6 +85,7 @@ def _options_from_args(args: argparse.Namespace) -> Options:
         ignore_file=args.ignorefile if os.path.exists(args.ignorefile) else "",
         server_addr=args.server,
         token=args.token,
+        db_dir=args.db_dir,
         list_all_packages=args.list_all_pkgs,
     )
 
@@ -126,6 +129,7 @@ def build_parser() -> argparse.ArgumentParser:
     p_server.add_argument("--listen", default="localhost:4954")
     p_server.add_argument("--cache-dir", default="")
     p_server.add_argument("--token", default="")
+    p_server.add_argument("--db-dir", default="")
 
     sub.add_parser("version", help="print version")
 
@@ -151,7 +155,12 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "server":
         from trivy_tpu.rpc.server import serve
 
-        serve(args.listen, cache_dir=args.cache_dir, token=args.token)
+        serve(
+            args.listen,
+            cache_dir=args.cache_dir,
+            token=args.token,
+            db_dir=args.db_dir,
+        )
         return 0
 
     options = _options_from_args(args)

@@ -51,6 +51,7 @@ class Options:
     disabled_analyzers: list[str] = field(default_factory=list)
     server_addr: str = ""  # non-empty => client mode (remote driver)
     token: str = ""
+    db_dir: str = ""  # vulnerability DB directory (trivy-db analogue)
     list_all_packages: bool = False
 
 
@@ -124,8 +125,16 @@ def _build_scanner(options: Options, target_kind: str, cache: ArtifactCache) -> 
 
         driver = RemoteDriver(options.server_addr, options.token)
     else:
-        driver = LocalDriver(cache)
+        driver = LocalDriver(cache, vuln_detector=_init_vuln_scanner(options))
     return Scanner(artifact=artifact, driver=driver)
+
+
+def _init_vuln_scanner(options: Options):
+    """operation.DownloadDB analogue: open the local DB if present (network
+    download of the OCI-distributed DB is a connected-deployment concern)."""
+    from trivy_tpu.scanner.vuln import init_vuln_scanner
+
+    return init_vuln_scanner(options.db_dir, options.cache_dir)
 
 
 def run(options: Options, target_kind: str) -> int:

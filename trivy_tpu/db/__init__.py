@@ -1,0 +1,3 @@
+from trivy_tpu.db.vulndb import Advisory, VulnDB, build_db, load_db
+
+__all__ = ["Advisory", "VulnDB", "build_db", "load_db"]

@@ -1,0 +1,125 @@
+"""Vulnerability database.
+
+The trivy-db analogue (pkg/db): advisories keyed by (data source, package
+name).  The reference ships a BoltDB pulled from an OCI registry; this
+framework uses a JSON tree on disk with the same logical schema, built either
+from fixture YAML (the pkg/dbtest pattern, §4) or downloaded via the OCI
+client (trivy_tpu/db/oci.py) in connected deployments.
+
+Layout: <db_dir>/metadata.json + <db_dir>/<source-bucket>.json where a source
+bucket is e.g. "alpine 3.15", "debian 11", "npm", "pip".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class Advisory:
+    """db.Advisory (trivy-db types)."""
+
+    vulnerability_id: str
+    fixed_version: str = ""
+    vulnerable_versions: str = ""  # range expr for language ecosystems
+    severity: str = ""
+    title: str = ""
+    description: str = ""
+    references: list[str] = field(default_factory=list)
+    cvss_score: float = 0.0
+
+    def to_json(self) -> dict[str, Any]:
+        out: dict[str, Any] = {"VulnerabilityID": self.vulnerability_id}
+        if self.fixed_version:
+            out["FixedVersion"] = self.fixed_version
+        if self.vulnerable_versions:
+            out["VulnerableVersions"] = self.vulnerable_versions
+        if self.severity:
+            out["Severity"] = self.severity
+        if self.title:
+            out["Title"] = self.title
+        if self.description:
+            out["Description"] = self.description
+        if self.references:
+            out["References"] = self.references
+        if self.cvss_score:
+            out["CVSSScore"] = self.cvss_score
+        return out
+
+    @classmethod
+    def from_json(cls, d: dict[str, Any]) -> "Advisory":
+        return cls(
+            vulnerability_id=d.get("VulnerabilityID", ""),
+            fixed_version=d.get("FixedVersion", ""),
+            vulnerable_versions=d.get("VulnerableVersions", ""),
+            severity=d.get("Severity", ""),
+            title=d.get("Title", ""),
+            description=d.get("Description", ""),
+            references=list(d.get("References") or []),
+            cvss_score=d.get("CVSSScore", 0.0),
+        )
+
+
+def _bucket_file(source: str) -> str:
+    return source.replace("/", "_").replace(" ", "_") + ".json"
+
+
+class VulnDB:
+    """Get-side interface (trivy-db db.Operation)."""
+
+    def __init__(self, db_dir: str):
+        self.db_dir = db_dir
+        self._cache: dict[str, dict[str, list[Advisory]]] = {}
+
+    def advisories(self, source: str, pkg_name: str) -> list[Advisory]:
+        bucket = self._load(source)
+        return bucket.get(pkg_name, [])
+
+    def _load(self, source: str) -> dict[str, list[Advisory]]:
+        if source in self._cache:
+            return self._cache[source]
+        path = os.path.join(self.db_dir, _bucket_file(source))
+        bucket: dict[str, list[Advisory]] = {}
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                raw = json.load(f)
+            for pkg, advs in raw.items():
+                bucket[pkg] = [Advisory.from_json(a) for a in advs]
+        self._cache[source] = bucket
+        return bucket
+
+    def metadata(self) -> dict[str, Any]:
+        path = os.path.join(self.db_dir, "metadata.json")
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                return json.load(f)
+        return {}
+
+
+def build_db(
+    db_dir: str, data: dict[str, dict[str, list[Advisory | dict]]]
+) -> None:
+    """Fixture DB builder (the pkg/dbtest InitDB pattern):
+    data = {source: {pkg_name: [Advisory|dict, ...]}}."""
+    os.makedirs(db_dir, exist_ok=True)
+    for source, packages in data.items():
+        out = {
+            pkg: [
+                a.to_json() if isinstance(a, Advisory) else a for a in advs
+            ]
+            for pkg, advs in packages.items()
+        }
+        with open(os.path.join(db_dir, _bucket_file(source)), "w") as f:
+            json.dump(out, f, indent=1)
+    meta = {"Version": 2, "UpdatedAt": "fixture"}
+    with open(os.path.join(db_dir, "metadata.json"), "w") as f:
+        json.dump(meta, f)
+
+
+def load_db(db_dir: str) -> VulnDB | None:
+    if db_dir and os.path.isdir(db_dir):
+        return VulnDB(db_dir)
+    return None

@@ -1,0 +1,73 @@
+"""Language-ecosystem vulnerability detectors.
+
+Mirrors pkg/detector/library (driver.go:25-84): per-ecosystem drivers with
+their version comparators; advisories carry vulnerable ranges (language DBs)
+or fixed versions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from trivy_tpu.atypes import Application
+from trivy_tpu.db.vulndb import VulnDB
+from trivy_tpu.detector.version_cmp import COMPARATORS, version_in_range
+from trivy_tpu.ftypes import DetectedVulnerability
+
+# app type -> (db source, comparator flavor)
+_ECOSYSTEMS: dict[str, tuple[str, str]] = {
+    "npm": ("npm", "semver"),
+    "yarn": ("npm", "semver"),
+    "pnpm": ("npm", "semver"),
+    "pip": ("pip", "pep440"),
+    "pipenv": ("pip", "pep440"),
+    "poetry": ("pip", "pep440"),
+    "gomod": ("go", "semver"),
+    "cargo": ("cargo", "semver"),
+    "composer": ("composer", "semver"),
+    "bundler": ("rubygems", "generic"),
+    "nuget": ("nuget", "semver"),
+    "pom": ("maven", "generic"),
+    "gradle": ("maven", "generic"),
+}
+
+
+@dataclass
+class LibraryDetector:
+    db: VulnDB
+
+    def detect_app(self, app: Application) -> list[DetectedVulnerability]:
+        eco = _ECOSYSTEMS.get(app.app_type)
+        if eco is None:
+            return []
+        source, flavor = eco
+        cmp = COMPARATORS[flavor]
+
+        out: list[DetectedVulnerability] = []
+        for pkg in app.packages:
+            for adv in self.db.advisories(source, pkg.name):
+                vulnerable = False
+                if adv.vulnerable_versions:
+                    vulnerable = version_in_range(
+                        pkg.version, adv.vulnerable_versions, flavor
+                    )
+                elif adv.fixed_version:
+                    vulnerable = cmp(pkg.version, adv.fixed_version) < 0
+                if not vulnerable:
+                    continue
+                out.append(
+                    DetectedVulnerability(
+                        vulnerability_id=adv.vulnerability_id,
+                        pkg_id=pkg.id,
+                        pkg_name=pkg.name,
+                        installed_version=pkg.version,
+                        fixed_version=adv.fixed_version,
+                        severity=adv.severity or "UNKNOWN",
+                        title=adv.title,
+                        description=adv.description,
+                        references=list(adv.references),
+                        layer=pkg.layer,
+                        status="fixed" if adv.fixed_version else "affected",
+                    )
+                )
+        return out

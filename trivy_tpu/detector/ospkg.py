@@ -1,0 +1,91 @@
+"""OS package vulnerability detectors.
+
+Mirrors pkg/detector/ospkg (driver map detect.go:32-49): per-family drivers
+that look up advisories by (release bucket, source package name) and compare
+the installed version against the fixed version with the family's comparator.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from trivy_tpu.atypes import OS, Package
+from trivy_tpu.db.vulndb import VulnDB
+from trivy_tpu.detector.version_cmp import COMPARATORS
+from trivy_tpu.ftypes import DetectedVulnerability
+
+# family -> (db source prefix, version comparator flavor, release precision)
+_DRIVERS: dict[str, tuple[str, str, int]] = {
+    "alpine": ("alpine", "apk", 2),  # bucket "alpine 3.15"
+    "wolfi": ("wolfi", "apk", 0),
+    "chainguard": ("chainguard", "apk", 0),
+    "debian": ("debian", "deb", 1),  # bucket "debian 11"
+    "ubuntu": ("ubuntu", "deb", 2),  # bucket "ubuntu 22.04"
+    "redhat": ("redhat", "deb", 1),
+    "centos": ("centos", "deb", 1),
+    "rocky": ("rocky", "deb", 1),
+    "alma": ("alma", "deb", 1),
+    "oracle": ("oracle", "deb", 1),
+    "amazon": ("amazon", "deb", 1),
+    "photon": ("photon", "deb", 1),
+    "cbl-mariner": ("cbl-mariner", "deb", 1),
+    "fedora": ("fedora", "deb", 1),
+}
+
+
+def _release_bucket(prefix: str, name: str, precision: int) -> str:
+    if precision == 0:
+        return prefix
+    parts = name.split(".")
+    return f"{prefix} {'.'.join(parts[:precision])}"
+
+
+@dataclass
+class OSPkgDetector:
+    """detector/ospkg Detect (detect.go:52)."""
+
+    db: VulnDB
+
+    def supported(self, family: str) -> bool:
+        return family in _DRIVERS
+
+    def detect(
+        self, os_info: OS, packages: list[Package]
+    ) -> list[DetectedVulnerability]:
+        driver = _DRIVERS.get(os_info.family)
+        if driver is None:
+            return []
+        prefix, flavor, precision = driver
+        source = _release_bucket(prefix, os_info.name, precision)
+        cmp = COMPARATORS[flavor]
+
+        out: list[DetectedVulnerability] = []
+        for pkg in packages:
+            names = {pkg.name, pkg.src_name} - {""}
+            seen: set[str] = set()
+            for name in sorted(names):
+                for adv in self.db.advisories(source, name):
+                    if adv.vulnerability_id in seen:
+                        continue
+                    installed = pkg.version
+                    if pkg.release:
+                        installed = f"{pkg.version}-{pkg.release}"
+                    if adv.fixed_version and cmp(installed, adv.fixed_version) >= 0:
+                        continue
+                    seen.add(adv.vulnerability_id)
+                    out.append(
+                        DetectedVulnerability(
+                            vulnerability_id=adv.vulnerability_id,
+                            pkg_id=pkg.id,
+                            pkg_name=pkg.name,
+                            installed_version=installed,
+                            fixed_version=adv.fixed_version,
+                            severity=adv.severity or "UNKNOWN",
+                            title=adv.title,
+                            description=adv.description,
+                            references=list(adv.references),
+                            layer=pkg.layer,
+                            status="fixed" if adv.fixed_version else "affected",
+                        )
+                    )
+        return out

@@ -1,0 +1,241 @@
+"""Version comparators per packaging ecosystem.
+
+Mirrors the comparator libraries the reference pulls in (go-deb-version,
+go-apk-version, go-npm-version, go-pep440-version — see pkg/detector/ospkg/*
+and pkg/detector/library/compare/*).  Each returns <0, 0, >0.
+"""
+
+from __future__ import annotations
+
+import re
+
+
+# ---------------------------------------------------------------------------
+# dpkg (Debian policy 5.6.12)
+# ---------------------------------------------------------------------------
+
+
+def _deb_order(c: str) -> int:
+    if c == "~":
+        return -1
+    if c.isalpha():
+        return ord(c)
+    if not c:
+        return 0
+    return ord(c) + 256  # non-alphanumeric sorts after letters
+
+
+def _deb_compare_part(a: str, b: str) -> int:
+    ia = ib = 0
+    while ia < len(a) or ib < len(b):
+        # non-digit run
+        while True:
+            ca = a[ia] if ia < len(a) and not a[ia].isdigit() else ""
+            cb = b[ib] if ib < len(b) and not b[ib].isdigit() else ""
+            if not ca and not cb:
+                break
+            oa, ob = _deb_order(ca), _deb_order(cb)
+            if oa != ob:
+                return -1 if oa < ob else 1
+            ia += bool(ca)
+            ib += bool(cb)
+        # digit run
+        na = nb = 0
+        while ia < len(a) and a[ia].isdigit():
+            na = na * 10 + int(a[ia])
+            ia += 1
+        while ib < len(b) and b[ib].isdigit():
+            nb = nb * 10 + int(b[ib])
+            ib += 1
+        if na != nb:
+            return -1 if na < nb else 1
+    return 0
+
+
+def _deb_split(v: str) -> tuple[int, str, str]:
+    epoch = 0
+    if ":" in v:
+        e, _, v = v.partition(":")
+        if e.isdigit():
+            epoch = int(e)
+    upstream, _, revision = v.rpartition("-")
+    if not upstream:
+        upstream, revision = v, ""
+    return epoch, upstream, revision
+
+
+def compare_deb(a: str, b: str) -> int:
+    ea, ua, ra = _deb_split(a)
+    eb, ub, rb = _deb_split(b)
+    if ea != eb:
+        return -1 if ea < eb else 1
+    c = _deb_compare_part(ua, ub)
+    if c:
+        return c
+    return _deb_compare_part(ra, rb)
+
+
+# ---------------------------------------------------------------------------
+# apk (Alpine)
+# ---------------------------------------------------------------------------
+
+_APK_SUFFIXES = {"alpha": -4, "beta": -3, "pre": -2, "rc": -1, "": 0, "cvs": 1,
+                 "svn": 2, "git": 3, "hg": 4, "p": 5}
+_APK_TOKEN = re.compile(
+    r"(\d+)|([a-z])|_(alpha|beta|pre|rc|cvs|svn|git|hg|p)(\d*)|(-r)(\d+)|(.)"
+)
+
+
+def _apk_tokens(v: str):
+    out = []
+    for m in _APK_TOKEN.finditer(v.lower()):
+        if m.group(1) is not None:
+            out.append(("num", int(m.group(1))))
+        elif m.group(2) is not None:
+            out.append(("alpha", m.group(2)))
+        elif m.group(3) is not None:
+            out.append(("suffix", _APK_SUFFIXES[m.group(3)],
+                        int(m.group(4) or 0)))
+        elif m.group(5) is not None:
+            out.append(("rev", int(m.group(6))))
+    return out
+
+
+def compare_apk(a: str, b: str) -> int:
+    ta, tb = _apk_tokens(a), _apk_tokens(b)
+    for i in range(max(len(ta), len(tb))):
+        xa = ta[i] if i < len(ta) else None
+        xb = tb[i] if i < len(tb) else None
+        if xa == xb:
+            continue
+        # missing token: a bare version < one with extra numbers, but a
+        # negative suffix (_rc etc.) sorts below a bare version.
+        if xa is None:
+            return 1 if (xb[0] == "suffix" and xb[1] < 0) else -1
+        if xb is None:
+            return -1 if (xa[0] == "suffix" and xa[1] < 0) else 1
+        if xa[0] != xb[0]:
+            order = {"num": 0, "alpha": 1, "suffix": 2, "rev": 3}
+            return -1 if order.get(xa[0], 9) < order.get(xb[0], 9) else 1
+        return -1 if xa < xb else 1
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# semver (npm & friends)
+# ---------------------------------------------------------------------------
+
+_SEMVER = re.compile(
+    r"^v?(\d+)(?:\.(\d+))?(?:\.(\d+))?(?:-([0-9A-Za-z.-]+))?(?:\+.*)?$"
+)
+
+
+def _semver_key(v: str):
+    m = _SEMVER.match(v.strip())
+    if not m:
+        # Fallback: numeric runs + the raw tail as a pseudo-prerelease, shaped
+        # like the regular pre_key so cross-form comparisons never TypeError.
+        nums = [int(x) for x in re.findall(r"\d+", v)[:4]]
+        return (tuple(nums + [0] * (3 - len(nums))), ((0,), (0.5, v)))
+    major, minor, patch = (int(m.group(i) or 0) for i in (1, 2, 3))
+    pre = m.group(4)
+    if pre is None:
+        pre_key = ((1,),)  # release > any prerelease
+    else:
+        parts = []
+        for p in pre.split("."):
+            parts.append((0, int(p)) if p.isdigit() else (0.5, p))
+        pre_key = ((0,), *parts)
+    return ((major, minor, patch), pre_key)
+
+
+def compare_semver(a: str, b: str) -> int:
+    ka, kb = _semver_key(a), _semver_key(b)
+    return -1 if ka < kb else (1 if ka > kb else 0)
+
+
+# ---------------------------------------------------------------------------
+# pep440 (PyPI)
+# ---------------------------------------------------------------------------
+
+
+def compare_pep440(a: str, b: str) -> int:
+    try:
+        from packaging.version import Version
+
+        va, vb = Version(a), Version(b)
+        return -1 if va < vb else (1 if va > vb else 0)
+    except Exception:
+        return compare_semver(a, b)
+
+
+# ---------------------------------------------------------------------------
+# generic / rubygems (close enough to semver with letter segments)
+# ---------------------------------------------------------------------------
+
+
+def compare_generic(a: str, b: str) -> int:
+    return _deb_compare_part(a, b)
+
+
+COMPARATORS = {
+    "apk": compare_apk,
+    "deb": compare_deb,
+    "semver": compare_semver,
+    "pep440": compare_pep440,
+    "generic": compare_generic,
+}
+
+
+# ---------------------------------------------------------------------------
+# Range expressions ("<1.2.3", ">=4.0.0, <4.0.14", "a || b")
+# ---------------------------------------------------------------------------
+
+_OP = re.compile(r"^(>=|<=|>|<|=|==|!=|\^|~)?\s*(.+)$")
+
+
+def _check_one(cmp, installed: str, constraint: str) -> bool:
+    m = _OP.match(constraint.strip())
+    if not m:
+        return False
+    op, ver = m.group(1) or "=", m.group(2).strip()
+    if op == "^":
+        # ^X.Y.Z: >=X.Y.Z and same major (semver-style)
+        base = _semver_key(ver)[0]
+        inst = _semver_key(installed)[0]
+        return cmp(installed, ver) >= 0 and inst[0] == base[0]
+    if op == "~":
+        base = _semver_key(ver)[0]
+        inst = _semver_key(installed)[0]
+        return cmp(installed, ver) >= 0 and inst[:2] == base[:2]
+    c = cmp(installed, ver)
+    return {
+        ">=": c >= 0,
+        "<=": c <= 0,
+        ">": c > 0,
+        "<": c < 0,
+        "=": c == 0,
+        "==": c == 0,
+        "!=": c != 0,
+    }[op]
+
+
+_CONSTRAINT = re.compile(r"\s*(>=|<=|==|!=|>|<|=|\^|~)?\s*([^\s,]+)")
+
+
+def version_in_range(installed: str, expr: str, flavor: str = "semver") -> bool:
+    """True when `installed` satisfies the vulnerable-range expression.
+
+    Handles both packed (">=4.0.0,<4.0.14") and spaced (">= 4.0.0, < 4.0.14",
+    the GHSA style) constraint forms."""
+    cmp = COMPARATORS.get(flavor, compare_semver)
+    for alternative in expr.split("||"):
+        constraints = [
+            f"{op or '='}{ver}"
+            for op, ver in _CONSTRAINT.findall(alternative)
+        ]
+        if constraints and all(
+            _check_one(cmp, installed, c) for c in constraints
+        ):
+            return True
+    return False

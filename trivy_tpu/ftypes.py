@@ -143,6 +143,73 @@ class Secret:
 
 
 @dataclass
+class DetectedVulnerability:
+    """pkg/types/vulnerability.go DetectedVulnerability (subset)."""
+
+    vulnerability_id: str
+    pkg_name: str
+    installed_version: str
+    pkg_id: str = ""
+    fixed_version: str = ""
+    status: str = ""
+    severity: str = "UNKNOWN"
+    severity_source: str = ""
+    primary_url: str = ""
+    title: str = ""
+    description: str = ""
+    references: list[str] = field(default_factory=list)
+    layer: "Layer" = field(default_factory=lambda: Layer())
+
+    def to_json(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "VulnerabilityID": self.vulnerability_id,
+            "PkgName": self.pkg_name,
+            "InstalledVersion": self.installed_version,
+        }
+        if self.pkg_id:
+            out["PkgID"] = self.pkg_id
+        if self.fixed_version:
+            out["FixedVersion"] = self.fixed_version
+        if self.status:
+            out["Status"] = self.status
+        if not self.layer.empty():
+            out["Layer"] = self.layer.to_json()
+        if self.primary_url:
+            out["PrimaryURL"] = self.primary_url
+        if self.title:
+            out["Title"] = self.title
+        if self.description:
+            out["Description"] = self.description
+        out["Severity"] = self.severity
+        if self.severity_source:
+            out["SeveritySource"] = self.severity_source
+        if self.references:
+            out["References"] = self.references
+        return out
+
+    @classmethod
+    def from_json(cls, d: dict[str, Any]) -> "DetectedVulnerability":
+        layer = d.get("Layer") or {}
+        return cls(
+            vulnerability_id=d.get("VulnerabilityID", ""),
+            pkg_name=d.get("PkgName", ""),
+            installed_version=d.get("InstalledVersion", ""),
+            pkg_id=d.get("PkgID", ""),
+            fixed_version=d.get("FixedVersion", ""),
+            status=d.get("Status", ""),
+            severity=d.get("Severity", "UNKNOWN"),
+            severity_source=d.get("SeveritySource", ""),
+            primary_url=d.get("PrimaryURL", ""),
+            title=d.get("Title", ""),
+            description=d.get("Description", ""),
+            references=list(d.get("References") or []),
+            layer=Layer(
+                digest=layer.get("Digest", ""), diff_id=layer.get("DiffID", "")
+            ),
+        )
+
+
+@dataclass
 class Result:
     """One result block in a report (pkg/types/result.go Result)."""
 
@@ -170,6 +237,10 @@ class Result:
         }
         if self.result_type:
             out["Type"] = self.result_type
+        if self.packages:
+            out["Packages"] = [
+                p.to_json() if hasattr(p, "to_json") else p for p in self.packages
+            ]
         if self.vulnerabilities:
             out["Vulnerabilities"] = [
                 v.to_json() if hasattr(v, "to_json") else v

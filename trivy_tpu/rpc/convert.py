@@ -11,7 +11,7 @@ from __future__ import annotations
 from typing import Any
 
 from trivy_tpu.atypes import BlobInfo, OS, _secret_from_json
-from trivy_tpu.ftypes import Result, ResultClass
+from trivy_tpu.ftypes import DetectedVulnerability, Result, ResultClass
 
 
 def result_to_json(r: Result) -> dict[str, Any]:
@@ -29,7 +29,10 @@ def result_from_json(d: dict[str, Any]) -> Result:
         result_class=ResultClass(d.get("Class", "custom")),
         result_type=d.get("Type", ""),
         secrets=secrets,
-        vulnerabilities=list(d.get("Vulnerabilities") or []),
+        vulnerabilities=[
+            DetectedVulnerability.from_json(v)
+            for v in (d.get("Vulnerabilities") or [])
+        ],
         misconfigurations=list(d.get("Misconfigurations") or []),
         licenses=list(d.get("Licenses") or []),
     )

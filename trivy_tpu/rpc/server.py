@@ -39,10 +39,17 @@ TOKEN_HEADER = "Trivy-Tpu-Token"
 class ScanServer:
     """pkg/rpc/server Server: scanner + cache services over one cache."""
 
-    def __init__(self, cache: ArtifactCache, token: str = ""):
+    def __init__(
+        self, cache: ArtifactCache, token: str = "", db_dir: str = "",
+        cache_dir: str = "",
+    ):
+        from trivy_tpu.scanner.vuln import init_vuln_scanner
+
         self.cache = cache
         self.token = token
-        self.driver = LocalDriver(cache)
+        self.driver = LocalDriver(
+            cache, vuln_detector=init_vuln_scanner(db_dir, cache_dir)
+        )
 
     # -- service methods ------------------------------------------------
 
@@ -145,19 +152,24 @@ def _make_handler(server: ScanServer):
 
 
 def make_http_server(
-    addr: str, cache: ArtifactCache, token: str = ""
+    addr: str,
+    cache: ArtifactCache,
+    token: str = "",
+    db_dir: str = "",
+    cache_dir: str = "",
 ) -> ThreadingHTTPServer:
     host, _, port = addr.rpartition(":")
     httpd = ThreadingHTTPServer(
-        (host or "localhost", int(port)), _make_handler(ScanServer(cache, token))
+        (host or "localhost", int(port)),
+        _make_handler(ScanServer(cache, token, db_dir, cache_dir)),
     )
     return httpd
 
 
-def serve(addr: str, cache_dir: str = "", token: str = "") -> None:
+def serve(addr: str, cache_dir: str = "", token: str = "", db_dir: str = "") -> None:
     """pkg/rpc/server/listen.go ListenAndServe."""
     cache = FSCache(cache_dir) if cache_dir else MemoryCache()
-    httpd = make_http_server(addr, cache, token)
+    httpd = make_http_server(addr, cache, token, db_dir, cache_dir)
     print(f"trivy-tpu server listening on {httpd.server_address[0]}:{httpd.server_address[1]}")
     try:
         httpd.serve_forever()
@@ -168,11 +180,11 @@ def serve(addr: str, cache_dir: str = "", token: str = "") -> None:
 
 
 def start_background(
-    addr: str, cache: ArtifactCache, token: str = ""
+    addr: str, cache: ArtifactCache, token: str = "", db_dir: str = ""
 ) -> tuple[ThreadingHTTPServer, threading.Thread]:
     """In-process server for tests (the §4 'multi-node without a cluster'
     pattern: integration_test.go:77-103 binds a real server on a free port)."""
-    httpd = make_http_server(addr, cache, token)
+    httpd = make_http_server(addr, cache, token, db_dir)
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()
     return httpd, t

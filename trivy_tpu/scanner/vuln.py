@@ -1,0 +1,96 @@
+"""Vulnerability scanning: ArtifactDetail -> per-target vuln Results.
+
+Mirrors pkg/scanner/ospkg/scan.go + pkg/scanner/langpkg/scan.go: the OS
+package set becomes one result targeted "<artifact> (<family> <release>)";
+each application becomes a result targeted at its lockfile path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from trivy_tpu.atypes import ArtifactDetail
+from trivy_tpu.db.vulndb import VulnDB
+from trivy_tpu.detector.library import LibraryDetector
+from trivy_tpu.detector.ospkg import OSPkgDetector
+from trivy_tpu.ftypes import Result, ResultClass
+
+
+def init_vuln_scanner(
+    db_dir: str = "", cache_dir: str = ""
+) -> "VulnerabilityScanner | None":
+    """Single DB bootstrap used by the runner and the RPC server: resolve
+    db_dir (explicit, or <cache_dir>/db), open, wrap.  An explicitly given
+    but missing directory is an error, not a silent all-clear."""
+    import os
+
+    from trivy_tpu.db.vulndb import load_db
+
+    explicit = bool(db_dir)
+    if not db_dir and cache_dir:
+        db_dir = os.path.join(cache_dir, "db")
+    if not db_dir:
+        return None
+    db = load_db(db_dir)
+    if db is None:
+        if explicit:
+            raise FileNotFoundError(f"vulnerability DB not found: {db_dir}")
+        return None
+    return VulnerabilityScanner(db)
+
+
+@dataclass
+class VulnerabilityScanner:
+    db: VulnDB
+
+    def detect(self, target: str, detail: ArtifactDetail, options) -> list[Result]:
+        results: list[Result] = []
+        pkg_types = getattr(options, "pkg_types", ["os", "library"])
+
+        if (
+            "os" in pkg_types
+            and detail.os is not None
+            and not detail.os.is_empty()
+            and detail.packages
+        ):
+            vulns = OSPkgDetector(self.db).detect(detail.os, detail.packages)
+            if vulns or getattr(options, "list_all_packages", False):
+                results.append(
+                    Result(
+                        target=f"{target} ({detail.os.family} {detail.os.name})",
+                        result_class=ResultClass.OS_PKGS,
+                        result_type=detail.os.family,
+                        vulnerabilities=sorted(
+                            vulns,
+                            key=lambda v: (v.pkg_name, v.vulnerability_id),
+                        ),
+                        packages=(
+                            list(detail.packages)
+                            if getattr(options, "list_all_packages", False)
+                            else []
+                        ),
+                    )
+                )
+
+        if "library" in pkg_types:
+            detector = LibraryDetector(self.db)
+            for app in detail.applications:
+                vulns = detector.detect_app(app)
+                if not vulns and not getattr(options, "list_all_packages", False):
+                    continue
+                results.append(
+                    Result(
+                        target=app.file_path or app.app_type,
+                        result_class=ResultClass.LANG_PKGS,
+                        result_type=app.app_type,
+                        vulnerabilities=sorted(
+                            vulns, key=lambda v: (v.pkg_name, v.vulnerability_id)
+                        ),
+                        packages=(
+                            list(app.packages)
+                            if getattr(options, "list_all_packages", False)
+                            else []
+                        ),
+                    )
+                )
+        return results
