@@ -1,0 +1,293 @@
+"""License, misconfiguration, and SBOM verticals."""
+
+import json
+
+import pytest
+
+from trivy_tpu.analyzer.license import classify
+from trivy_tpu.commands.run import Options, run
+from trivy_tpu.misconf.dockerfile import parse_dockerfile, scan_dockerfile
+from trivy_tpu.misconf.kubernetes import scan_kubernetes
+
+MIT_TEXT = b"""MIT License
+
+Permission is hereby granted, free of charge, to any person obtaining a copy
+of this software and associated documentation files (the "Software"), to deal
+in the Software without restriction...
+
+THE SOFTWARE IS PROVIDED "AS IS", WITHOUT WARRANTY OF ANY KIND.
+"""
+
+APACHE_TEXT = b"""                              Apache License
+                        Version 2.0, January 2004
+                     http://www.apache.org/licenses/
+"""
+
+GPL3_TEXT = b"""GNU GENERAL PUBLIC LICENSE
+                       Version 3, 29 June 2007
+"""
+
+
+# ---------------------------------------------------------------------------
+# licenses
+# ---------------------------------------------------------------------------
+
+
+def test_classify_licenses():
+    assert classify(MIT_TEXT)[0].name == "MIT"
+    assert classify(APACHE_TEXT)[0].name == "Apache-2.0"
+    assert classify(GPL3_TEXT)[0].name == "GPL-3.0"
+    assert classify(b"just some random readme text") == []
+
+
+def test_license_categories():
+    gpl = classify(GPL3_TEXT)[0]
+    assert gpl.category == "restricted"
+    assert gpl.severity == "HIGH"
+    mit = classify(MIT_TEXT)[0]
+    assert mit.category == "notice"
+    assert mit.severity == "LOW"
+
+
+def test_license_scan_e2e(tmp_path):
+    (tmp_path / "LICENSE").write_bytes(MIT_TEXT)
+    (tmp_path / "pkg").mkdir()
+    (tmp_path / "pkg" / "COPYING").write_bytes(GPL3_TEXT)
+    out = tmp_path / "report.json"
+    code = run(
+        Options(
+            target=str(tmp_path), scanners=["license"], format="json",
+            output=str(out),
+        ),
+        "fs",
+    )
+    assert code == 0
+    report = json.loads(out.read_text())
+    results = {r["Target"]: r for r in report["Results"]}
+    assert results["LICENSE"]["Licenses"][0]["Name"] == "MIT"
+    assert results["pkg/COPYING"]["Licenses"][0]["Name"] == "GPL-3.0"
+    assert results["pkg/COPYING"]["Class"] == "license-file"
+
+
+def test_dpkg_license_and_pkg_licenses(tmp_path):
+    doc = tmp_path / "usr" / "share" / "doc" / "adduser"
+    doc.mkdir(parents=True)
+    (doc / "copyright").write_bytes(
+        b"Format: https://www.debian.org/doc/packaging-manuals/copyright-format/1.0/\n"
+        b"License: GPL-2.0\n"
+    )
+    out = tmp_path / "report.json"
+    code = run(
+        Options(
+            target=str(tmp_path), scanners=["license"], format="json",
+            output=str(out),
+        ),
+        "fs",
+    )
+    assert code == 0
+    report = json.loads(out.read_text())
+    targets = {r["Target"]: r for r in report["Results"]}
+    lf = targets["usr/share/doc/adduser/copyright"]
+    assert lf["Licenses"][0]["Name"] == "GPL-2.0"
+
+
+# ---------------------------------------------------------------------------
+# misconfigurations
+# ---------------------------------------------------------------------------
+
+BAD_DOCKERFILE = b"""FROM alpine:latest
+ADD app.py /app/
+RUN sudo apt-get install -y curl
+USER root
+"""
+
+GOOD_DOCKERFILE = b"""FROM alpine:3.15
+COPY app.py /app/
+RUN adduser -D app
+USER app
+HEALTHCHECK CMD wget -q localhost:8080 || exit 1
+"""
+
+
+def test_dockerfile_parser():
+    ins = parse_dockerfile(b"FROM alpine:3.15\nRUN echo a \\\n  && echo b\n")
+    assert [i.cmd for i in ins] == ["FROM", "RUN"]
+    assert ins[1].value == "echo a && echo b"
+    assert ins[1].start_line == 2
+    assert ins[1].end_line == 3
+
+
+def test_dockerfile_checks():
+    mc = scan_dockerfile("Dockerfile", BAD_DOCKERFILE)
+    failed = {f.check_id for f in mc.failures}
+    assert {"DS001", "DS002", "DS005", "DS010", "DS026"} <= failed
+
+    mc_good = scan_dockerfile("Dockerfile", GOOD_DOCKERFILE)
+    assert {f.check_id for f in mc_good.failures} == set()
+
+
+BAD_POD = b"""apiVersion: v1
+kind: Pod
+metadata:
+  name: risky
+spec:
+  hostNetwork: true
+  containers:
+    - name: app
+      image: nginx
+      securityContext:
+        privileged: true
+  volumes:
+    - name: host
+      hostPath:
+        path: /etc
+"""
+
+
+def test_kubernetes_checks():
+    mc = scan_kubernetes("pod.yaml", BAD_POD)
+    failed = {f.check_id for f in mc.failures}
+    assert {"KSV017", "KSV009", "KSV023"} <= failed
+    assert scan_kubernetes("x.yaml", b"not: kubernetes\n") is None
+    assert scan_kubernetes("bad.yaml", b"\t:::bad yaml") is None
+
+
+def test_misconfig_scan_e2e(tmp_path):
+    (tmp_path / "Dockerfile").write_bytes(BAD_DOCKERFILE)
+    (tmp_path / "deploy").mkdir()
+    (tmp_path / "deploy" / "pod.yaml").write_bytes(BAD_POD)
+    out = tmp_path / "report.json"
+    code = run(
+        Options(
+            target=str(tmp_path), scanners=["misconfig"], format="json",
+            output=str(out),
+        ),
+        "fs",
+    )
+    assert code == 0
+    report = json.loads(out.read_text())
+    results = {r["Target"]: r for r in report["Results"]}
+    assert results["Dockerfile"]["Class"] == "config"
+    assert results["Dockerfile"]["Type"] == "dockerfile"
+    ids = {m["ID"] for m in results["Dockerfile"]["Misconfigurations"]}
+    assert "DS001" in ids
+    # PASS results filtered by default
+    assert all(
+        m["Status"] == "FAIL" for m in results["Dockerfile"]["Misconfigurations"]
+    )
+    assert "KSV017" in {
+        m["ID"] for m in results["deploy/pod.yaml"]["Misconfigurations"]
+    }
+
+
+# ---------------------------------------------------------------------------
+# SBOM
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def fixture_db(tmp_path):
+    from trivy_tpu.db.vulndb import Advisory, build_db
+
+    db_dir = tmp_path / "db"
+    build_db(
+        str(db_dir),
+        {
+            "npm": {
+                "lodash": [
+                    Advisory(
+                        vulnerability_id="CVE-2099-1000",
+                        vulnerable_versions="<4.17.21",
+                        fixed_version="4.17.21",
+                        severity="CRITICAL",
+                    )
+                ]
+            }
+        },
+    )
+    return str(db_dir)
+
+
+def test_cyclonedx_output_and_rescan(tmp_path, fixture_db):
+    # Generate a CycloneDX SBOM from an fs scan, then re-scan the SBOM.
+    (tmp_path / "app").mkdir()
+    (tmp_path / "app" / "package-lock.json").write_text(
+        json.dumps(
+            {
+                "lockfileVersion": 3,
+                "packages": {"node_modules/lodash": {"version": "4.17.20"}},
+            }
+        )
+    )
+    sbom_path = tmp_path / "bom.json"
+    code = run(
+        Options(
+            target=str(tmp_path), scanners=["vuln"], format="cyclonedx",
+            output=str(sbom_path), db_dir=fixture_db,
+        ),
+        "fs",
+    )
+    assert code == 0
+    bom = json.loads(sbom_path.read_text())
+    assert bom["bomFormat"] == "CycloneDX"
+    purls = [c["purl"] for c in bom["components"]]
+    assert "pkg:npm/lodash@4.17.20" in purls
+
+    out = tmp_path / "sbom-scan.json"
+    code = run(
+        Options(
+            target=str(sbom_path), scanners=["vuln"], format="json",
+            output=str(out), db_dir=fixture_db,
+        ),
+        "sbom",
+    )
+    assert code == 0
+    report = json.loads(out.read_text())
+    assert report["ArtifactType"] == "cyclonedx"
+    vulns = [
+        v["VulnerabilityID"]
+        for r in report["Results"]
+        for v in r.get("Vulnerabilities", [])
+    ]
+    assert vulns == ["CVE-2099-1000"]
+
+
+def test_spdx_output_and_rescan(tmp_path, fixture_db):
+    spdx = {
+        "spdxVersion": "SPDX-2.3",
+        "SPDXID": "SPDXRef-DOCUMENT",
+        "name": "app",
+        "packages": [
+            {
+                "SPDXID": "SPDXRef-Package-1",
+                "name": "lodash",
+                "versionInfo": "4.17.20",
+                "externalRefs": [
+                    {
+                        "referenceCategory": "PACKAGE-MANAGER",
+                        "referenceType": "purl",
+                        "referenceLocator": "pkg:npm/lodash@4.17.20",
+                    }
+                ],
+            }
+        ],
+    }
+    path = tmp_path / "doc.spdx.json"
+    path.write_text(json.dumps(spdx))
+    out = tmp_path / "report.json"
+    code = run(
+        Options(
+            target=str(path), scanners=["vuln"], format="json",
+            output=str(out), db_dir=fixture_db,
+        ),
+        "sbom",
+    )
+    assert code == 0
+    report = json.loads(out.read_text())
+    assert report["ArtifactType"] == "spdx"
+    vulns = [
+        v["VulnerabilityID"]
+        for r in report["Results"]
+        for v in r.get("Vulnerabilities", [])
+    ]
+    assert vulns == ["CVE-2099-1000"]

@@ -1,0 +1,59 @@
+"""Config (IaC) analyzers: route files into the misconf scanners.
+
+Mirrors pkg/fanal/analyzer/config/* + the pkg/misconf façade routing
+(scanner.go:82-112).
+"""
+
+from __future__ import annotations
+
+from trivy_tpu.analyzer.core import (
+    AnalysisInput,
+    AnalysisResult,
+    Analyzer,
+    register_analyzer,
+)
+from trivy_tpu.misconf.dockerfile import scan_dockerfile
+from trivy_tpu.misconf.kubernetes import scan_kubernetes
+
+
+class DockerfileAnalyzer(Analyzer):
+    def type(self) -> str:
+        return "dockerfile"
+
+    def version(self) -> int:
+        return 1
+
+    def required(self, file_path: str, size: int, mode: int) -> bool:
+        name = file_path.rsplit("/", 1)[-1].lower()
+        return (
+            name == "dockerfile"
+            or name.startswith("dockerfile.")
+            or name.endswith(".dockerfile")
+        )
+
+    def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
+        mc = scan_dockerfile(inp.file_path, inp.content)
+        if not mc.failures and not mc.successes:
+            return None
+        return AnalysisResult(misconfigs=[mc])
+
+
+class KubernetesYamlAnalyzer(Analyzer):
+    def type(self) -> str:
+        return "kubernetes"
+
+    def version(self) -> int:
+        return 1
+
+    def required(self, file_path: str, size: int, mode: int) -> bool:
+        return file_path.endswith((".yaml", ".yml")) and size < 1 << 20
+
+    def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
+        mc = scan_kubernetes(inp.file_path, inp.content)
+        if mc is None or (not mc.failures and not mc.successes):
+            return None
+        return AnalysisResult(misconfigs=[mc])
+
+
+register_analyzer(DockerfileAnalyzer)
+register_analyzer(KubernetesYamlAnalyzer)

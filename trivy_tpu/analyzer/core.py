@@ -11,9 +11,12 @@ input instead of file-at-a-time calls.
 
 from __future__ import annotations
 
+import logging
 import re
 from dataclasses import dataclass, field
 from typing import Callable, Iterable
+
+logger = logging.getLogger("trivy_tpu.analyzer")
 
 from trivy_tpu.ftypes import Secret
 from trivy_tpu.walker.fs import FileEntry
@@ -180,7 +183,9 @@ def registered_analyzers() -> list[Callable[[], Analyzer]]:
 def _ensure_builtin_registered() -> None:
     # Import modules whose import side-effect registers analyzers (mirrors the
     # reference's `_ "…/analyzer/all"` blank imports).
+    from trivy_tpu.analyzer import config as _config  # noqa: F401
     from trivy_tpu.analyzer import lang as _lang  # noqa: F401
+    from trivy_tpu.analyzer import license as _license  # noqa: F401
     from trivy_tpu.analyzer import os_release as _os  # noqa: F401
     from trivy_tpu.analyzer import pkg_apk as _apk  # noqa: F401
     from trivy_tpu.analyzer import pkg_dpkg as _dpkg  # noqa: F401
@@ -231,8 +236,19 @@ class AnalyzerGroup:
             else:
                 for entry in batch:
                     inputs = _read_inputs(dir, [entry])
-                    if inputs:
+                    if not inputs:
+                        continue
+                    try:
                         result.merge(a.analyze(inputs[0]))
+                    except Exception:
+                        # One malformed file must not abort the scan
+                        # (analyzer.go:415-417 tolerates per-file errors).
+                        logger.warning(
+                            "analyzer %s failed on %s",
+                            a.type(),
+                            entry.path,
+                            exc_info=True,
+                        )
         result.sort()
         return result
 

@@ -149,18 +149,22 @@ class PnpmLockAnalyzer(_LockfileAnalyzer):
         data = yaml.safe_load(content) or {}
         out = []
         for key in data.get("packages") or {}:
-            # "/name@version" or "/@scope/name@version" (v6); "/name/1.0.0" (v5)
-            k = key.lstrip("/")
+            # "/name@version(peer@dep)" or "/@scope/name@version" (v6);
+            # "/name/1.0.0" (v5).  Peer-dependency suffixes are parenthesized
+            # and contain '@'s of their own — strip them first.
+            k = key.lstrip("/").split("(")[0]
             if "@" in k[1:]:
                 name, _, version = k.rpartition("@")
             else:
                 name, _, version = k.rpartition("/")
             if name and version:
-                out.append(_pkg(name, version.split("(")[0]))
+                out.append(_pkg(name, version))
         return out
 
 
-_REQ_LINE = re.compile(r"^([A-Za-z0-9._-]+)\s*==\s*([A-Za-z0-9.*+!_-]+)")
+_REQ_LINE = re.compile(
+    r"^([A-Za-z0-9._-]+)\s*(?:\[[^\]]*\])?\s*==\s*([A-Za-z0-9.*+!_-]+)"
+)
 
 
 class PipRequirementsAnalyzer(_LockfileAnalyzer):
@@ -215,8 +219,9 @@ class GoModAnalyzer(_LockfileAnalyzer):
     def parse(self, content: bytes) -> list[Package]:
         out = []
         in_require = False
-        for line in content.decode("utf-8", errors="replace").splitlines():
-            line = line.split("//")[0].strip()
+        for raw in content.decode("utf-8", errors="replace").splitlines():
+            indirect = "// indirect" in raw
+            line = raw.split("//")[0].strip()
             if line.startswith("require ("):
                 in_require = True
                 continue
@@ -225,10 +230,9 @@ class GoModAnalyzer(_LockfileAnalyzer):
                 continue
             parts = line.split()
             if in_require and len(parts) >= 2:
-                out.append(_pkg(parts[0], parts[1].lstrip("v"),
-                                indirect="// indirect" in line))
+                out.append(_pkg(parts[0], parts[1].lstrip("v"), indirect=indirect))
             elif parts[:1] == ["require"] and len(parts) >= 3:
-                out.append(_pkg(parts[1], parts[2].lstrip("v")))
+                out.append(_pkg(parts[1], parts[2].lstrip("v"), indirect=indirect))
         return out
 
 

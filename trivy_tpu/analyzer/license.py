@@ -1,0 +1,157 @@
+"""License analyzers.
+
+Mirrors pkg/fanal/analyzer/licensing/ (license-file analyzer) and
+pkg/licensing/classifier.go — but instead of google/licenseclassifier's
+full-text model, classification uses distinctive normalized phrases per SPDX
+license (a keyword-sieve design, same shape as the secret engine's probe
+pass: cheap necessary-condition matching, host confirmation by phrase count).
+"""
+
+from __future__ import annotations
+
+import re
+
+from trivy_tpu.analyzer.core import (
+    AnalysisInput,
+    AnalysisResult,
+    Analyzer,
+    register_analyzer,
+)
+from trivy_tpu.ltypes import LICENSE_TYPE_FILE, LicenseFile, LicenseFinding
+
+# Filenames the license-file analyzer claims
+# (pkg/fanal/analyzer/licensing/license.go requiredFiles + patterns).
+_LICENSE_FILE_RE = re.compile(
+    r"^(licen[sc]e|copying|copyright|notice)([-._].*)?$", re.IGNORECASE
+)
+SKIP_DIRS = {"node_modules", ".git", "vendor"}
+
+# Distinctive phrases over normalized text (lowercase, collapsed whitespace).
+# Each entry: (SPDX id, [phrases — ALL must appear]).
+_PHRASES: list[tuple[str, list[str]]] = [
+    ("Apache-2.0", ["apache license", "version 2.0"]),
+    ("AGPL-3.0", ["gnu affero general public license", "version 3"]),
+    ("LGPL-3.0", ["gnu lesser general public license", "version 3"]),
+    ("LGPL-2.1", ["gnu lesser general public license", "version 2.1"]),
+    ("GPL-3.0", ["gnu general public license", "version 3"]),
+    ("GPL-2.0", ["gnu general public license", "version 2"]),
+    ("MPL-2.0", ["mozilla public license", "version 2.0"]),
+    ("EPL-2.0", ["eclipse public license", "v 2.0"]),
+    (
+        "BSD-3-Clause",
+        [
+            "redistribution and use in source and binary forms",
+            "neither the name",
+        ],
+    ),
+    (
+        "BSD-2-Clause",
+        ["redistribution and use in source and binary forms"],
+    ),
+    (
+        "MIT",
+        [
+            "permission is hereby granted, free of charge",
+            "the software is provided \"as is\"",
+        ],
+    ),
+    (
+        "ISC",
+        [
+            "permission to use, copy, modify, and/or distribute this software",
+        ],
+    ),
+    ("Unlicense", ["this is free and unencumbered software"]),
+    ("CC0-1.0", ["cc0 1.0"]),
+    ("Zlib", ["this software is provided 'as-is'", "zlib"]),
+]
+
+
+def normalize(text: str) -> str:
+    return re.sub(r"\s+", " ", text.lower())
+
+
+def classify(content: bytes) -> list[LicenseFinding]:
+    """pkg/licensing/classifier.go Classify, phrase-based."""
+    text = normalize(content.decode("utf-8", errors="replace"))
+    findings = []
+    for spdx_id, phrases in _PHRASES:
+        if all(p in text for p in phrases):
+            findings.append(LicenseFinding.of(spdx_id, confidence=0.9))
+            break  # first (most specific) match wins
+    return findings
+
+
+class LicenseFileAnalyzer(Analyzer):
+    """analyzer/licensing/license.go."""
+
+    def type(self) -> str:
+        return "license-file"
+
+    def version(self) -> int:
+        return 1
+
+    def required(self, file_path: str, size: int, mode: int) -> bool:
+        parts = file_path.split("/")
+        if SKIP_DIRS.intersection(parts[:-1]):
+            return False
+        return bool(_LICENSE_FILE_RE.match(parts[-1])) and size < 1 << 20
+
+    def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
+        findings = classify(inp.content)
+        if not findings:
+            return None
+        return AnalysisResult(
+            licenses=[
+                LicenseFile(
+                    license_type=LICENSE_TYPE_FILE,
+                    file_path=inp.file_path,
+                    findings=findings,
+                )
+            ]
+        )
+
+
+class DpkgLicenseAnalyzer(Analyzer):
+    """analyzer/licensing dpkg copyright files
+    (usr/share/doc/<pkg>/copyright) — machine-readable DEP-5 headers."""
+
+    _RE = re.compile(r"^usr/share/doc/([^/]+)/copyright$")
+
+    def type(self) -> str:
+        return "dpkg-license"
+
+    def version(self) -> int:
+        return 1
+
+    def required(self, file_path: str, size: int, mode: int) -> bool:
+        return bool(self._RE.match(file_path))
+
+    def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
+        m = self._RE.match(inp.file_path)
+        pkg_name = m.group(1) if m else ""
+        licenses: list[str] = []
+        for line in inp.content.decode("utf-8", errors="replace").splitlines():
+            if line.lower().startswith("license:"):
+                name = line.split(":", 1)[1].strip()
+                if name and name not in licenses:
+                    licenses.append(name)
+        if not licenses:
+            findings = classify(inp.content)
+            licenses = [f.name for f in findings]
+        if not licenses:
+            return None
+        return AnalysisResult(
+            licenses=[
+                LicenseFile(
+                    license_type="dpkg",
+                    file_path=inp.file_path,
+                    pkg_name=pkg_name,
+                    findings=[LicenseFinding.of(n) for n in licenses],
+                )
+            ]
+        )
+
+
+register_analyzer(LicenseFileAnalyzer)
+register_analyzer(DpkgLicenseAnalyzer)

@@ -16,21 +16,12 @@ from trivy_tpu.analyzer.core import (
     register_analyzer,
 )
 from trivy_tpu.atypes import Package, PackageInfo
+from trivy_tpu.detector.version_cmp import _deb_split as split_version
 
 STATUS_FILE = "var/lib/dpkg/status"
 STATUS_DIR = "var/lib/dpkg/status.d/"
 
-_VERSION_RE = re.compile(r"^(?:(\d+):)?(.+?)(?:-([^-]+))?$")
 _SOURCE_RE = re.compile(r"^(\S+)(?:\s+\((.+)\))?$")
-
-
-def split_version(full: str) -> tuple[int, str, str]:
-    """epoch:upstream-revision split (dpkg semantics)."""
-    m = _VERSION_RE.match(full)
-    if not m:
-        return 0, full, ""
-    epoch = int(m.group(1)) if m.group(1) else 0
-    return epoch, m.group(2), m.group(3) or ""
 
 
 def parse_dpkg_status(content: bytes) -> list[Package]:

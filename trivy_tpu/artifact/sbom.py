@@ -1,0 +1,68 @@
+"""SBOM artifact: decode CycloneDX/SPDX and re-scan the listed packages.
+
+Mirrors pkg/fanal/artifact/sbom/sbom.go + pkg/sbom/sbom.go Decode: format
+sniffing, decode to an ArtifactDetail-shaped blob, straight to detectors (no
+file walk).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+from trivy_tpu.atypes import ArtifactReference, BlobInfo, PackageInfo
+from trivy_tpu.cache.store import ArtifactCache
+from trivy_tpu.ftypes import ArtifactType
+
+
+def detect_format(data: dict) -> str:
+    """pkg/sbom/sbom.go Decode format sniff."""
+    if data.get("bomFormat") == "CycloneDX":
+        return "cyclonedx"
+    if str(data.get("spdxVersion", "")).startswith("SPDX-"):
+        return "spdx"
+    raise ValueError("unrecognized SBOM format (expected CycloneDX or SPDX JSON)")
+
+
+class SbomArtifact:
+    """artifact/sbom/sbom.go Artifact."""
+
+    def __init__(self, target: str, cache: ArtifactCache, **_ignored):
+        self.target = target
+        self.cache = cache
+
+    def inspect(self) -> ArtifactReference:
+        with open(self.target, encoding="utf-8") as f:
+            raw = f.read()
+        data = json.loads(raw)
+        fmt = detect_format(data)
+        if fmt == "cyclonedx":
+            from trivy_tpu.sbom.cyclonedx import decode
+
+            artifact_type = ArtifactType.CYCLONEDX
+        else:
+            from trivy_tpu.sbom.spdx import decode
+
+            artifact_type = ArtifactType.SPDX
+        detail = decode(data)
+
+        blob = BlobInfo(
+            os=detail.os,
+            package_infos=(
+                [PackageInfo(file_path="", packages=detail.packages)]
+                if detail.packages
+                else []
+            ),
+            applications=list(detail.applications),
+        )
+        blob_id = "sha256:" + hashlib.sha256(raw.encode()).hexdigest()
+        self.cache.put_blob(blob_id, blob)
+        return ArtifactReference(
+            name=self.target,
+            artifact_type=artifact_type.value,
+            id=blob_id,
+            blob_ids=[blob_id],
+        )
+
+    def clean(self, ref: ArtifactReference) -> None:
+        self.cache.delete_blobs(ref.blob_ids)

@@ -18,6 +18,7 @@ from trivy_tpu.commands.run import (
     TARGET_IMAGE,
     TARGET_REPOSITORY,
     TARGET_ROOTFS,
+    TARGET_SBOM,
     Options,
     run,
 )
@@ -118,6 +119,10 @@ def build_parser() -> argparse.ArgumentParser:
     p_repo.add_argument("--tag", default="")
     p_repo.add_argument("--commit", default="")
     p_repo.set_defaults(kind=TARGET_REPOSITORY)
+
+    p_sbom = sub.add_parser("sbom", help="scan an SBOM (CycloneDX/SPDX JSON)")
+    _add_scan_flags(p_sbom, "vuln")
+    p_sbom.set_defaults(kind=TARGET_SBOM)
 
     p_convert = sub.add_parser("convert", help="convert a saved JSON report")
     p_convert.add_argument("report")

@@ -74,7 +74,9 @@ def _analyzer_options(options: Options, target_kind: str) -> AnalyzerOptions:
     if "secret" not in options.scanners:
         disabled.append("secret")
     if "license" not in options.scanners:
-        disabled.append("license-file")
+        disabled.extend(["license-file", "dpkg-license"])
+    if "misconfig" not in options.scanners:
+        disabled.extend(["dockerfile", "kubernetes"])
     return AnalyzerOptions(
         disabled_analyzers=disabled,
         secret_scanner_option=SecretScannerOption(
@@ -106,6 +108,10 @@ def _build_scanner(options: Options, target_kind: str, cache: ArtifactCache) -> 
             cache,
             analyzer_options=_analyzer_options(options, target_kind),
         )
+    elif target_kind == TARGET_SBOM:
+        from trivy_tpu.artifact.sbom import SbomArtifact
+
+        artifact = SbomArtifact(options.target, cache)
     elif target_kind == TARGET_REPOSITORY:
         from trivy_tpu.artifact.repo import RepositoryArtifact
 
@@ -139,6 +145,9 @@ def _init_vuln_scanner(options: Options):
 
 def run(options: Options, target_kind: str) -> int:
     """artifact.Run (run.go:394): scan → filter → report → exit code."""
+    if options.format in ("cyclonedx", "spdx-json"):
+        # SBOM outputs list every package (run.go format handling).
+        options.list_all_packages = True
     cache = init_cache(options)
     try:
         scanner = _build_scanner(options, target_kind, cache)

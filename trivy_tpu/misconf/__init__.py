@@ -1,0 +1,3 @@
+from trivy_tpu.misconf.types import Misconfiguration, MisconfFinding
+
+__all__ = ["Misconfiguration", "MisconfFinding"]

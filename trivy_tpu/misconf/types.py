@@ -1,0 +1,61 @@
+"""Misconfiguration types (pkg/fanal/types/misconf.go)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class MisconfFinding:
+    """One check outcome (types.MisconfResult / DetectedMisconfiguration)."""
+
+    check_id: str
+    title: str
+    description: str = ""
+    message: str = ""
+    resolution: str = ""
+    severity: str = "MEDIUM"
+    status: str = "FAIL"  # FAIL | PASS
+    start_line: int = 0
+    end_line: int = 0
+    references: list[str] = field(default_factory=list)
+
+    def to_json(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "Type": "",
+            "ID": self.check_id,
+            "Title": self.title,
+            "Description": self.description,
+            "Message": self.message,
+            "Resolution": self.resolution,
+            "Severity": self.severity,
+            "Status": self.status,
+        }
+        if self.references:
+            out["References"] = self.references
+        if self.start_line:
+            out["CauseMetadata"] = {
+                "StartLine": self.start_line,
+                "EndLine": self.end_line or self.start_line,
+            }
+        return out
+
+
+@dataclass
+class Misconfiguration:
+    """types.Misconfiguration — per (file, checker) outcome bundle."""
+
+    file_type: str
+    file_path: str
+    failures: list[MisconfFinding] = field(default_factory=list)
+    successes: list[MisconfFinding] = field(default_factory=list)
+    layer: Any = None
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "FileType": self.file_type,
+            "FilePath": self.file_path,
+            "Failures": [f.to_json() for f in self.failures],
+            "Successes": [s.to_json() for s in self.successes],
+        }

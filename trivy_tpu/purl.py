@@ -1,0 +1,62 @@
+"""PackageURL conversion (pkg/purl/)."""
+
+from __future__ import annotations
+
+from urllib.parse import quote, unquote
+
+# app/pkg type -> purl type
+_PURL_TYPES = {
+    "npm": "npm",
+    "yarn": "npm",
+    "pnpm": "npm",
+    "pip": "pypi",
+    "pipenv": "pypi",
+    "poetry": "pypi",
+    "gomod": "golang",
+    "cargo": "cargo",
+    "composer": "composer",
+    "bundler": "gem",
+    "nuget": "nuget",
+    "pom": "maven",
+    "gradle": "maven",
+    "apk": "apk",
+    "dpkg": "deb",
+    "rpm": "rpm",
+}
+
+# purl type -> (app type, version-compare flavor)
+PURL_TO_APP = {
+    "npm": "npm",
+    "pypi": "pip",
+    "golang": "gomod",
+    "cargo": "cargo",
+    "composer": "composer",
+    "gem": "bundler",
+    "nuget": "nuget",
+    "maven": "pom",
+}
+
+
+def package_url(
+    pkg_type: str, name: str, version: str, namespace: str = ""
+) -> str:
+    ptype = _PURL_TYPES.get(pkg_type, pkg_type)
+    if "/" in name and not namespace:
+        namespace, _, name = name.rpartition("/")
+    parts = ["pkg:" + ptype]
+    if namespace:
+        parts.append(quote(namespace, safe="/"))
+    parts.append(quote(name, safe=""))
+    return "/".join(parts) + "@" + quote(version, safe="")
+
+
+def parse_purl(purl: str) -> tuple[str, str, str]:
+    """Returns (purl_type, full_name, version)."""
+    if not purl.startswith("pkg:"):
+        return "", "", ""
+    body = purl[4:].split("?")[0]
+    ptype, _, rest = body.partition("/")
+    name_part, _, version = rest.rpartition("@")
+    if not name_part:
+        name_part, version = rest, ""
+    return ptype, unquote(name_part), unquote(version)

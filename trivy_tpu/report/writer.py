@@ -10,7 +10,7 @@ from trivy_tpu.ftypes import Report
 from trivy_tpu.report.table import write_table
 from trivy_tpu.report.sarif import to_sarif
 
-FORMATS = ["table", "json", "sarif", "template", "github"]
+FORMATS = ["table", "json", "sarif", "cyclonedx", "spdx-json"]
 
 
 def write_report(report: Report, fmt: str = "table", out: IO[str] | None = None) -> None:
@@ -22,6 +22,16 @@ def write_report(report: Report, fmt: str = "table", out: IO[str] | None = None)
         write_table(report, out)
     elif fmt == "sarif":
         json.dump(to_sarif(report), out, indent=2)
+        out.write("\n")
+    elif fmt == "cyclonedx":
+        from trivy_tpu.sbom.cyclonedx import encode_report
+
+        json.dump(encode_report(report), out, indent=2)
+        out.write("\n")
+    elif fmt == "spdx-json":
+        from trivy_tpu.sbom.spdx import encode_report
+
+        json.dump(encode_report(report), out, indent=2)
         out.write("\n")
     else:
         raise ValueError(f"unknown format: {fmt} (supported: {FORMATS})")

@@ -87,11 +87,16 @@ def filter_report(report: Report, options: FilterOptions) -> Report:
     ignore = parse_ignore_file(options.ignore_file)
     allowed = set(options.severities)
     for result in report.results:
-        _filter_result(result, allowed, ignore)
+        _filter_result(result, allowed, ignore, options)
     return report
 
 
-def _filter_result(result: Result, allowed: set[str], ignore: IgnoreConfig) -> None:
+def _filter_result(
+    result: Result,
+    allowed: set[str],
+    ignore: IgnoreConfig,
+    options: FilterOptions,
+) -> None:
     result.vulnerabilities = [
         v
         for v in result.vulnerabilities
@@ -112,8 +117,11 @@ def _filter_result(result: Result, allowed: set[str], ignore: IgnoreConfig) -> N
         m
         for m in result.misconfigurations
         if (getattr(m, "severity", "UNKNOWN") or "UNKNOWN") in allowed
+        and (options.include_non_failures or getattr(m, "status", "FAIL") == "FAIL")
         and not ignore.match(
-            "misconfigurations", getattr(m, "id", ""), result.target
+            "misconfigurations",
+            getattr(m, "check_id", "") or getattr(m, "id", ""),
+            result.target,
         )
     ]
     result.licenses = [

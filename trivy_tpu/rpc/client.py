@@ -74,7 +74,11 @@ class RemoteDriver(Driver):
                 "Target": target,
                 "ArtifactID": artifact_id,
                 "BlobIDs": list(blob_ids),
-                "Options": {"Scanners": list(options.scanners)},
+                "Options": {
+                    "Scanners": list(options.scanners),
+                    "PkgTypes": list(options.pkg_types),
+                    "ListAllPackages": options.list_all_packages,
+                },
             },
         )
         results = [result_from_json(r) for r in (resp.get("Results") or [])]

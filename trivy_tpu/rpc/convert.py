@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from typing import Any
 
-from trivy_tpu.atypes import BlobInfo, OS, _secret_from_json
+from trivy_tpu.atypes import BlobInfo, OS, Package, _secret_from_json
 from trivy_tpu.ftypes import DetectedVulnerability, Result, ResultClass
 
 
@@ -35,6 +35,7 @@ def result_from_json(d: dict[str, Any]) -> Result:
         ],
         misconfigurations=list(d.get("Misconfigurations") or []),
         licenses=list(d.get("Licenses") or []),
+        packages=[Package.from_json(p) for p in (d.get("Packages") or [])],
     )
 
 

@@ -54,8 +54,11 @@ class ScanServer:
     # -- service methods ------------------------------------------------
 
     def scan(self, req: dict) -> dict:
+        opts = req.get("Options") or {}
         options = ScanOptions(
-            scanners=list((req.get("Options") or {}).get("Scanners") or ["secret"])
+            scanners=list(opts.get("Scanners") or ["secret"]),
+            pkg_types=list(opts.get("PkgTypes") or ["os", "library"]),
+            list_all_packages=bool(opts.get("ListAllPackages")),
         )
         results, detected_os = self.driver.scan(
             req.get("Target", ""),

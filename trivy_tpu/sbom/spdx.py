@@ -1,0 +1,90 @@
+"""SPDX 2.3 JSON encode/decode (pkg/sbom/spdx/)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from trivy_tpu import __version__
+from trivy_tpu.atypes import Application, ArtifactDetail, OS, Package
+from trivy_tpu.ftypes import Report
+from trivy_tpu.purl import PURL_TO_APP, package_url, parse_purl
+
+
+def encode_report(report: Report) -> dict[str, Any]:
+    packages = []
+    idx = 0
+    if report.metadata.os_family:
+        packages.append(
+            {
+                "SPDXID": "SPDXRef-OperatingSystem",
+                "name": report.metadata.os_family,
+                "versionInfo": report.metadata.os_name,
+                "downloadLocation": "NONE",
+                "primaryPackagePurpose": "OPERATING-SYSTEM",
+            }
+        )
+    for result in report.results:
+        for pkg in result.packages:
+            idx += 1
+            purl = package_url(result.result_type, pkg.name, pkg.version)
+            packages.append(
+                {
+                    "SPDXID": f"SPDXRef-Package-{idx}",
+                    "name": pkg.name,
+                    "versionInfo": pkg.version,
+                    "downloadLocation": "NONE",
+                    "licenseConcluded": " AND ".join(pkg.licenses) or "NOASSERTION",
+                    "externalRefs": [
+                        {
+                            "referenceCategory": "PACKAGE-MANAGER",
+                            "referenceType": "purl",
+                            "referenceLocator": purl,
+                        }
+                    ],
+                }
+            )
+    return {
+        "spdxVersion": "SPDX-2.3",
+        "dataLicense": "CC0-1.0",
+        "SPDXID": "SPDXRef-DOCUMENT",
+        "name": report.artifact_name,
+        "creationInfo": {
+            "creators": [f"Tool: trivy-tpu-{__version__}"],
+            "created": report.created_at or "1970-01-01T00:00:00Z",
+        },
+        "packages": packages,
+    }
+
+
+def decode(doc: dict[str, Any]) -> ArtifactDetail:
+    detail = ArtifactDetail()
+    apps: dict[str, Application] = {}
+    for pkg in doc.get("packages") or []:
+        if pkg.get("primaryPackagePurpose") == "OPERATING-SYSTEM":
+            detail.os = OS(
+                family=pkg.get("name", ""), name=pkg.get("versionInfo", "")
+            )
+            continue
+        purl = ""
+        for ref in pkg.get("externalRefs") or []:
+            if ref.get("referenceType") == "purl":
+                purl = ref.get("referenceLocator", "")
+        ptype, name, version = parse_purl(purl)
+        if not name:
+            name, version = pkg.get("name", ""), pkg.get("versionInfo", "")
+        if not name or not version or name == doc.get("name"):
+            continue
+        if ptype in ("apk", "deb", "rpm"):
+            detail.packages.append(
+                Package(id=f"{name}@{version}", name=name, version=version)
+            )
+            continue
+        app_type = PURL_TO_APP.get(ptype, ptype or "unknown")
+        app = apps.setdefault(
+            app_type, Application(app_type=app_type, file_path="")
+        )
+        app.packages.append(
+            Package(id=f"{name}@{version}", name=name, version=version)
+        )
+    detail.applications = list(apps.values())
+    return detail

@@ -70,7 +70,7 @@ class LocalDriver(Driver):
         if SCANNER_SECRET in options.scanners:
             results.extend(self._secrets_to_results(detail))
 
-        if SCANNER_LICENSE in options.scanners and detail.licenses:
+        if SCANNER_LICENSE in options.scanners:
             results.extend(self._licenses_to_results(detail))
 
         if SCANNER_MISCONFIG in options.scanners and detail.misconfigurations:
@@ -94,7 +94,39 @@ class LocalDriver(Driver):
 
     @staticmethod
     def _licenses_to_results(detail) -> list[Result]:
+        """local/scan.go:283 scanLicenses: package-declared licenses become
+        one ClassLicense result per source; license files become
+        ClassLicenseFile results."""
+        from trivy_tpu.ltypes import LicenseFinding
+
         out = []
+        os_findings = [
+            LicenseFinding.of(name)
+            for pkg in detail.packages
+            for name in pkg.licenses
+        ]
+        if os_findings:
+            out.append(
+                Result(
+                    target="OS Packages",
+                    result_class=ResultClass.LICENSE,
+                    licenses=os_findings,
+                )
+            )
+        for app in detail.applications:
+            findings = [
+                LicenseFinding.of(name)
+                for pkg in app.packages
+                for name in pkg.licenses
+            ]
+            if findings:
+                out.append(
+                    Result(
+                        target=app.file_path or app.app_type,
+                        result_class=ResultClass.LICENSE,
+                        licenses=findings,
+                    )
+                )
         for lf in detail.licenses:
             out.append(
                 Result(
