@@ -1,0 +1,201 @@
+"""Template/github/cosign-vuln writers, VEX suppression, image-config
+analysis, blob round-trip of typed findings."""
+
+import io
+import json
+
+from trivy_tpu.atypes import BlobInfo
+from trivy_tpu.ftypes import (
+    ArtifactType,
+    DetectedVulnerability,
+    Metadata,
+    Report,
+    Result,
+    ResultClass,
+)
+from trivy_tpu.report.writer import write_report
+
+
+def _vuln_report():
+    return Report(
+        artifact_name="app",
+        artifact_type=ArtifactType.FILESYSTEM,
+        metadata=Metadata(os_family="alpine", os_name="3.15"),
+        results=[
+            Result(
+                target="app/package-lock.json",
+                result_class=ResultClass.LANG_PKGS,
+                result_type="npm",
+                vulnerabilities=[
+                    DetectedVulnerability(
+                        vulnerability_id="CVE-2099-1000",
+                        pkg_name="lodash",
+                        installed_version="4.17.20",
+                        fixed_version="4.17.21",
+                        severity="CRITICAL",
+                    ),
+                    DetectedVulnerability(
+                        vulnerability_id="CVE-2099-2000",
+                        pkg_name="ws",
+                        installed_version="7.0.0",
+                        severity="HIGH",
+                    ),
+                ],
+            )
+        ],
+    )
+
+
+def test_template_writer():
+    out = io.StringIO()
+    write_report(
+        _vuln_report(),
+        "template",
+        out,
+        template="{{ range .Results }}{{ .Target }}:{{ range .Vulnerabilities }} {{ .VulnerabilityID }}{{ end }}{{ end }}",
+    )
+    assert out.getvalue() == "app/package-lock.json: CVE-2099-1000 CVE-2099-2000"
+
+
+def test_github_writer():
+    report = _vuln_report()
+    from trivy_tpu.atypes import Package
+
+    report.results[0].packages = [
+        Package(name="lodash", version="4.17.20"),
+        Package(name="ws", version="7.0.0", indirect=True),
+    ]
+    out = io.StringIO()
+    write_report(report, "github", out)
+    snap = json.loads(out.getvalue())
+    manifest = snap["manifests"]["app/package-lock.json"]
+    assert manifest["resolved"]["lodash"]["package_url"] == "pkg:npm/lodash@4.17.20"
+    assert manifest["resolved"]["ws"]["relationship"] == "indirect"
+
+
+def test_cosign_vuln_writer():
+    out = io.StringIO()
+    write_report(_vuln_report(), "cosign-vuln", out)
+    pred = json.loads(out.getvalue())
+    assert pred["scanner"]["result"]["ArtifactName"] == "app"
+
+
+def test_vex_suppression(tmp_path):
+    from trivy_tpu.result.filter import FilterOptions, filter_report
+
+    vex = {
+        "@context": "https://openvex.dev/ns/v0.2.0",
+        "statements": [
+            {
+                "vulnerability": {"name": "CVE-2099-1000"},
+                "products": [{"@id": "pkg:npm/lodash@4.17.20"}],
+                "status": "not_affected",
+            }
+        ],
+    }
+    path = tmp_path / "vex.json"
+    path.write_text(json.dumps(vex))
+    report = filter_report(_vuln_report(), FilterOptions(vex_path=str(path)))
+    ids = [v.vulnerability_id for v in report.results[0].vulnerabilities]
+    assert ids == ["CVE-2099-2000"]
+
+
+def test_blob_roundtrip_typed_findings():
+    from trivy_tpu.ltypes import LicenseFile, LicenseFinding
+    from trivy_tpu.misconf.types import MisconfFinding, Misconfiguration
+
+    blob = BlobInfo(
+        misconfigurations=[
+            Misconfiguration(
+                file_type="dockerfile",
+                file_path="Dockerfile",
+                failures=[
+                    MisconfFinding(check_id="DS001", title="t", severity="HIGH")
+                ],
+            )
+        ],
+        licenses=[
+            LicenseFile(
+                license_type="license-file",
+                file_path="LICENSE",
+                findings=[LicenseFinding.of("MIT")],
+            )
+        ],
+    )
+    back = BlobInfo.from_json(json.loads(json.dumps(blob.to_json())))
+    assert back.misconfigurations[0].failures[0].check_id == "DS001"
+    assert back.licenses[0].findings[0].name == "MIT"
+    assert back.licenses[0].findings[0].category == "notice"
+
+
+def test_image_config_secret_and_history(tmp_path):
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_image import _layer_tar, make_docker_archive
+
+    layers = [_layer_tar({"etc/hostname": b"example-host\n"})]
+    path = str(tmp_path / "img.tar")
+    config = make_docker_archive(path, layers)
+
+    # Rebuild the archive with a leaky ENV + risky history.
+    import hashlib
+    import tarfile
+
+    cfg = {
+        "architecture": "amd64",
+        "os": "linux",
+        "config": {
+            "Env": [
+                "PATH=/usr/bin",
+                "AWS_ACCESS_KEY_ID=AKIAQ6FAKEKEY1234567",
+            ]
+        },
+        "rootfs": {
+            "type": "layers",
+            "diff_ids": ["sha256:" + hashlib.sha256(layers[0]).hexdigest()],
+        },
+        "history": [
+            {"created_by": "/bin/sh -c #(nop)  FROM ubuntu:latest"},
+            {"created_by": "/bin/sh -c sudo apt-get install -y curl"},
+        ],
+    }
+    raw = json.dumps(cfg).encode()
+    cfg_name = hashlib.sha256(raw).hexdigest() + ".json"
+    manifest = [
+        {"Config": cfg_name, "RepoTags": [], "Layers": ["layer0/layer.tar"]}
+    ]
+    with tarfile.open(path, "w") as tf:
+        for name, data in [
+            (cfg_name, raw),
+            ("manifest.json", json.dumps(manifest).encode()),
+            ("layer0/layer.tar", layers[0]),
+        ]:
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+
+    from trivy_tpu.commands.run import Options, run
+
+    out = tmp_path / "report.json"
+    code = run(
+        Options(
+            target=path, scanners=["secret", "misconfig"], format="json",
+            output=str(out), secret_backend="cpu",
+        ),
+        "image",
+    )
+    assert code == 0
+    report = json.loads(out.read_text())
+    targets = {r["Target"]: r for r in report["Results"]}
+    assert any(
+        s["RuleID"] == "aws-access-key-id"
+        for s in targets.get("config.json", {}).get("Secrets", [])
+    )
+    mc_ids = {
+        m["ID"]
+        for m in targets.get("Dockerfile (image config)", {}).get(
+            "Misconfigurations", []
+        )
+    }
+    assert "DS010" in mc_ids  # sudo in history RUN

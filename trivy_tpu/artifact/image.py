@@ -145,8 +145,11 @@ class ImageArtifact:
         layer_keys = [self._layer_key(d) for d in diff_ids]
         artifact_key = self._artifact_key()
 
+        config_key = "sha256:" + hashlib.sha256(
+            (artifact_key + ":imgconf").encode()
+        ).hexdigest()
         missing_artifact, missing = self.cache.missing_blobs(
-            artifact_key, layer_keys
+            artifact_key, layer_keys + [config_key]
         )
 
         history = [
@@ -170,11 +173,15 @@ class ImageArtifact:
                 ),
             )
 
+        if config_key in missing:
+            self._config_analysis_blob(config_key)
+        blob_ids = layer_keys + [config_key]
+
         return ArtifactReference(
             name=self.target,
             artifact_type=ArtifactType.CONTAINER_IMAGE.value,
             id=artifact_key,
-            blob_ids=layer_keys,
+            blob_ids=blob_ids,
             image_metadata={
                 "ImageID": src.config_digest,
                 "DiffIDs": diff_ids,
@@ -206,6 +213,40 @@ class ImageArtifact:
             misconfigurations=list(result.misconfigs),
         )
         self.cache.put_blob(key, blob)
+
+    def _config_analysis_blob(self, key: str) -> None:
+        """Image-config analysis (imgconf analyzers): secrets in the config
+        JSON and misconfig over the history-reconstructed Dockerfile, stored
+        as one extra blob so it merges through the applier and survives the
+        client/server split.  Each sub-analysis only runs when its analyzer
+        is enabled; the blob is cache-gated like layer blobs (always put,
+        possibly empty, so missing_blobs stays accurate)."""
+        from trivy_tpu.analyzer.imgconf import (
+            scan_config_misconfig,
+            scan_config_secrets,
+        )
+
+        enabled = {a.type() for a in self.group.analyzers}
+        secrets = []
+        if "secret" in enabled:
+            secret_analyzer = next(
+                a for a in self.group.analyzers if a.type() == "secret"
+            )
+            res = scan_config_secrets(self.source.config, secret_analyzer.engine)
+            if res is not None:
+                secrets.append(res)
+        mc = scan_config_misconfig(self.source.config) if "dockerfile" in enabled else None
+        if mc is not None:
+            # Distinct path so a real /Dockerfile scanned in a layer is never
+            # overwritten by the lossy history reconstruction.
+            mc.file_path = "Dockerfile (image config)"
+        self.cache.put_blob(
+            key,
+            BlobInfo(
+                secrets=secrets,
+                misconfigurations=[mc] if mc is not None else [],
+            ),
+        )
 
     def clean(self, ref: ArtifactReference) -> None:
         pass  # layer blobs stay cached (content-addressed)

@@ -307,9 +307,23 @@ class BlobInfo:
                 Application.from_json(a) for a in (d.get("Applications") or [])
             ],
             secrets=[_secret_from_json(s) for s in (d.get("Secrets") or [])],
-            licenses=list(d.get("Licenses") or []),
-            misconfigurations=list(d.get("Misconfigurations") or []),
+            licenses=[_license_from_json(l) for l in (d.get("Licenses") or [])],
+            misconfigurations=[
+                _misconf_from_json(m) for m in (d.get("Misconfigurations") or [])
+            ],
         )
+
+
+def _license_from_json(d: dict[str, Any]):
+    from trivy_tpu.ltypes import LicenseFile
+
+    return LicenseFile.from_json(d) if isinstance(d, dict) else d
+
+
+def _misconf_from_json(d: dict[str, Any]):
+    from trivy_tpu.misconf.types import Misconfiguration
+
+    return Misconfiguration.from_json(d) if isinstance(d, dict) else d
 
 
 @dataclass

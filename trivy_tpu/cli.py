@@ -67,6 +67,9 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
     p.add_argument("--db-dir", default=_env_default("db-dir", ""),
                    help="vulnerability DB directory")
     p.add_argument("--list-all-pkgs", action="store_true")
+    p.add_argument("--template", default="", help="template for -f template")
+    p.add_argument("--vex", default="", help="OpenVEX/CycloneDX VEX document")
+    p.add_argument("--include-non-failures", action="store_true")
 
 
 def _options_from_args(args: argparse.Namespace) -> Options:
@@ -88,6 +91,9 @@ def _options_from_args(args: argparse.Namespace) -> Options:
         token=args.token,
         db_dir=args.db_dir,
         list_all_packages=args.list_all_pkgs,
+        template=args.template,
+        vex_path=args.vex,
+        include_non_failures=args.include_non_failures,
     )
 
 
@@ -129,6 +135,7 @@ def build_parser() -> argparse.ArgumentParser:
     p_convert.add_argument("-f", "--format", default="table")
     p_convert.add_argument("-o", "--output", default="")
     p_convert.add_argument("--severity", default=",".join(SEVERITIES))
+    p_convert.add_argument("--template", default="")
 
     p_server = sub.add_parser("server", help="run the scan server")
     p_server.add_argument("--listen", default="localhost:4954")
@@ -155,7 +162,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "convert":
         from trivy_tpu.commands.convert import run_convert
 
-        return run_convert(args.report, args.format, args.output, args.severity)
+        return run_convert(
+            args.report, args.format, args.output, args.severity, args.template
+        )
 
     if args.command == "server":
         from trivy_tpu.rpc.server import serve

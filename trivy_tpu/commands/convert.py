@@ -50,7 +50,18 @@ def report_from_json(d: dict) -> Report:
     )
 
 
-def run_convert(report_path: str, fmt: str, output: str, severity: str) -> int:
+def run_convert(
+    report_path: str, fmt: str, output: str, severity: str, template: str = ""
+) -> int:
+    if fmt == "template" and not template:
+        print(
+            "trivy-tpu: '--format template' requires '--template'",
+            file=sys.stderr,
+        )
+        return 2
+    if template.startswith("@"):
+        with open(template[1:], encoding="utf-8") as f:
+            template = f.read()
     with open(report_path, encoding="utf-8") as f:
         report = report_from_json(json.load(f))
     report = filter_report(
@@ -58,7 +69,7 @@ def run_convert(report_path: str, fmt: str, output: str, severity: str) -> int:
     )
     if output:
         with open(output, "w", encoding="utf-8") as f:
-            write_report(report, fmt, f)
+            write_report(report, fmt, f, template=template)
     else:
-        write_report(report, fmt, sys.stdout)
+        write_report(report, fmt, sys.stdout, template=template)
     return 0

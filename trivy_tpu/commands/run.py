@@ -53,6 +53,9 @@ class Options:
     token: str = ""
     db_dir: str = ""  # vulnerability DB directory (trivy-db analogue)
     list_all_packages: bool = False
+    template: str = ""  # --template for --format template
+    vex_path: str = ""  # --vex document
+    include_non_failures: bool = False
 
 
 def init_cache(options: Options) -> ArtifactCache:
@@ -148,6 +151,12 @@ def run(options: Options, target_kind: str) -> int:
     if options.format in ("cyclonedx", "spdx-json"):
         # SBOM outputs list every package (run.go format handling).
         options.list_all_packages = True
+    if options.format == "template" and not options.template:
+        print(
+            "trivy-tpu: '--format template' requires '--template'",
+            file=sys.stderr,
+        )
+        return 2
     cache = init_cache(options)
     try:
         scanner = _build_scanner(options, target_kind, cache)
@@ -160,7 +169,10 @@ def run(options: Options, target_kind: str) -> int:
         report = filter_report(
             report,
             FilterOptions(
-                severities=options.severities, ignore_file=options.ignore_file
+                severities=options.severities,
+                ignore_file=options.ignore_file,
+                vex_path=options.vex_path,
+                include_non_failures=options.include_non_failures,
             ),
         )
         _write(report, options)
@@ -170,11 +182,15 @@ def run(options: Options, target_kind: str) -> int:
 
 
 def _write(report: Report, options: Options) -> None:
+    template = options.template
+    if template.startswith("@"):  # template.go `@/path/to/tpl` form
+        with open(template[1:], encoding="utf-8") as f:
+            template = f.read()
     if options.output:
         with open(options.output, "w", encoding="utf-8") as f:
-            write_report(report, options.format, f)
+            write_report(report, options.format, f, template=template)
     else:
-        write_report(report, options.format, sys.stdout)
+        write_report(report, options.format, sys.stdout, template=template)
 
 
 def _exit_code(report: Report, options: Options) -> int:

@@ -93,6 +93,16 @@ class LicenseFinding:
             "Link": self.link,
         }
 
+    @classmethod
+    def from_json(cls, d: dict[str, Any]) -> "LicenseFinding":
+        return cls(
+            name=d.get("Name", ""),
+            category=d.get("Category", CATEGORY_UNKNOWN),
+            severity=d.get("Severity", "UNKNOWN"),
+            confidence=d.get("Confidence", 1.0),
+            link=d.get("Link", ""),
+        )
+
 
 @dataclass
 class LicenseFile:
@@ -111,3 +121,14 @@ class LicenseFile:
             "PkgName": self.pkg_name,
             "Findings": [f.to_json() for f in self.findings],
         }
+
+    @classmethod
+    def from_json(cls, d: dict[str, Any]) -> "LicenseFile":
+        return cls(
+            license_type=d.get("Type", ""),
+            file_path=d.get("FilePath", ""),
+            pkg_name=d.get("PkgName", ""),
+            findings=[
+                LicenseFinding.from_json(f) for f in (d.get("Findings") or [])
+            ],
+        )

@@ -41,6 +41,22 @@ class MisconfFinding:
             }
         return out
 
+    @classmethod
+    def from_json(cls, d: dict[str, Any]) -> "MisconfFinding":
+        cause = d.get("CauseMetadata") or {}
+        return cls(
+            check_id=d.get("ID", ""),
+            title=d.get("Title", ""),
+            description=d.get("Description", ""),
+            message=d.get("Message", ""),
+            resolution=d.get("Resolution", ""),
+            severity=d.get("Severity", "MEDIUM"),
+            status=d.get("Status", "FAIL"),
+            start_line=cause.get("StartLine", 0),
+            end_line=cause.get("EndLine", 0),
+            references=list(d.get("References") or []),
+        )
+
 
 @dataclass
 class Misconfiguration:
@@ -59,3 +75,16 @@ class Misconfiguration:
             "Failures": [f.to_json() for f in self.failures],
             "Successes": [s.to_json() for s in self.successes],
         }
+
+    @classmethod
+    def from_json(cls, d: dict[str, Any]) -> "Misconfiguration":
+        return cls(
+            file_type=d.get("FileType", ""),
+            file_path=d.get("FilePath", ""),
+            failures=[
+                MisconfFinding.from_json(f) for f in (d.get("Failures") or [])
+            ],
+            successes=[
+                MisconfFinding.from_json(s) for s in (d.get("Successes") or [])
+            ],
+        )

@@ -10,10 +10,18 @@ from trivy_tpu.ftypes import Report
 from trivy_tpu.report.table import write_table
 from trivy_tpu.report.sarif import to_sarif
 
-FORMATS = ["table", "json", "sarif", "cyclonedx", "spdx-json"]
+FORMATS = [
+    "table", "json", "sarif", "cyclonedx", "spdx-json", "template",
+    "github", "cosign-vuln",
+]
 
 
-def write_report(report: Report, fmt: str = "table", out: IO[str] | None = None) -> None:
+def write_report(
+    report: Report,
+    fmt: str = "table",
+    out: IO[str] | None = None,
+    template: str = "",
+) -> None:
     out = out if out is not None else sys.stdout
     if fmt == "json":
         json.dump(report.to_json(), out, indent=2)
@@ -33,5 +41,17 @@ def write_report(report: Report, fmt: str = "table", out: IO[str] | None = None)
 
         json.dump(encode_report(report), out, indent=2)
         out.write("\n")
+    elif fmt == "template":
+        from trivy_tpu.report.extra import write_template
+
+        write_template(report, template, out)
+    elif fmt == "github":
+        from trivy_tpu.report.extra import write_github
+
+        write_github(report, out)
+    elif fmt == "cosign-vuln":
+        from trivy_tpu.report.extra import write_cosign_vuln
+
+        write_cosign_vuln(report, out)
     else:
         raise ValueError(f"unknown format: {fmt} (supported: {FORMATS})")

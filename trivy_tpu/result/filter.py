@@ -80,10 +80,15 @@ class FilterOptions:
     severities: list[str] = field(default_factory=lambda: list(SEVERITIES))
     ignore_file: str = ""
     include_non_failures: bool = False
+    vex_path: str = ""
 
 
 def filter_report(report: Report, options: FilterOptions) -> Report:
     """result.Filter (filter.go:39)."""
+    if options.vex_path:
+        from trivy_tpu.result.vex import apply_vex, load_vex
+
+        apply_vex(report, load_vex(options.vex_path))
     ignore = parse_ignore_file(options.ignore_file)
     allowed = set(options.severities)
     for result in report.results:

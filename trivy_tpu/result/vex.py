@@ -1,0 +1,91 @@
+"""VEX (Vulnerability Exploitability eXchange) ingestion.
+
+Mirrors pkg/vex/vex.go: OpenVEX and CycloneDX-VEX documents suppress detected
+vulnerabilities whose status is not_affected/fixed for the scanned product.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+SUPPRESS_STATUSES = {"not_affected", "fixed"}
+
+
+@dataclass
+class VexDocument:
+    # (vuln_id, product purl or "" for any) -> status
+    statements: dict[tuple[str, str], str] = field(default_factory=dict)
+
+    def suppressed(self, vuln_id: str, purl: str = "") -> bool:
+        for key in ((vuln_id, purl), (vuln_id, "")):
+            status = self.statements.get(key)
+            if status in SUPPRESS_STATUSES:
+                return True
+        return False
+
+
+def load_vex(path: str) -> VexDocument:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if "statements" in data:  # OpenVEX
+        return _parse_openvex(data)
+    if data.get("bomFormat") == "CycloneDX":  # CycloneDX VEX
+        return _parse_cyclonedx_vex(data)
+    raise ValueError(f"unrecognized VEX document: {path}")
+
+
+def _parse_openvex(data: dict) -> VexDocument:
+    doc = VexDocument()
+    for st in data.get("statements") or []:
+        vuln = st.get("vulnerability", "")
+        if isinstance(vuln, dict):  # v0.2.0 object form; older docs use a str
+            vuln = vuln.get("name", "")
+        status = st.get("status", "")
+        products = st.get("products") or []
+        if not products:
+            doc.statements[(vuln, "")] = status
+        for p in products:
+            pid = p.get("@id", "") if isinstance(p, dict) else str(p)
+            doc.statements[(vuln, pid)] = status
+    return doc
+
+
+def _parse_cyclonedx_vex(data: dict) -> VexDocument:
+    doc = VexDocument()
+    for v in data.get("vulnerabilities") or []:
+        vuln_id = v.get("id", "")
+        analysis = (v.get("analysis") or {}).get("state", "")
+        # CycloneDX states map: not_affected / resolved -> suppress
+        status = {
+            "not_affected": "not_affected",
+            "resolved": "fixed",
+            "resolved_with_pedigree": "fixed",
+        }.get(analysis, analysis)
+        for affect in v.get("affects") or []:
+            doc.statements[(vuln_id, affect.get("ref", ""))] = status
+        if not v.get("affects"):
+            doc.statements[(vuln_id, "")] = status
+    return doc
+
+
+def apply_vex(report, vex: VexDocument) -> None:
+    """Filter hook (pkg/result/filter.go VEX step)."""
+    from trivy_tpu.purl import package_url
+
+    for result in report.results:
+        kept = []
+        for v in result.vulnerabilities:
+            vid = getattr(v, "vulnerability_id", "")
+            purl = ""
+            try:
+                purl = package_url(
+                    result.result_type,
+                    getattr(v, "pkg_name", ""),
+                    getattr(v, "installed_version", ""),
+                )
+            except Exception:
+                pass
+            if not vex.suppressed(vid, purl):
+                kept.append(v)
+        result.vulnerabilities = kept
