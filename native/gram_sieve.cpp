@@ -1,0 +1,83 @@
+// Native host gram sieve — the CPU-fallback matcher of the secret engine.
+//
+// Same contract as the device kernel (trivy_tpu/ops/gram_sieve.py
+// gram_sieve_rows): case-fold bytes, pack 4-byte windows into uint32, test
+// every (mask, value) gram constant, OR per row.  The inner compare loop is
+// written to auto-vectorize (contiguous uint32 stream vs. broadcast
+// constants); with -O3 -march=native g++ emits AVX2/AVX-512 compares.
+//
+// Role in the architecture: hosts without an accelerator (plain CPU workers,
+// the RPC server on a non-TPU machine) run this instead of the JAX path; it
+// replaces the reference's per-rule Go regexp loop
+// (pkg/fanal/secret/scanner.go:403-408) as the first-pass filter.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// rows:  [T, L] row-major bytes (zero-padded)
+// masks: [G] uint32, vals: [G] uint32
+// out:   [T, G] bytes — 1 when gram g matched anywhere in row t
+void gram_sieve(const uint8_t* rows, int64_t T, int64_t L,
+                const uint32_t* masks, const uint32_t* vals, int32_t G,
+                uint8_t* out) {
+    if (L < 4) {
+        memset(out, 0, static_cast<size_t>(T) * G);
+        return;
+    }
+    const int64_t W = L - 3;
+    std::vector<uint32_t> win(static_cast<size_t>(W));
+
+    for (int64_t t = 0; t < T; ++t) {
+        const uint8_t* row = rows + t * L;
+
+        // Fold + pack windows once per row (vectorizable single pass).
+        uint32_t w = 0;
+        for (int64_t i = 0; i < L; ++i) {
+            uint8_t b = row[i];
+            if (b >= 'A' && b <= 'Z') b += 32;
+            w = (w >> 8) | (static_cast<uint32_t>(b) << 24);
+            if (i >= 3) win[static_cast<size_t>(i - 3)] = w;
+        }
+
+        uint8_t* orow = out + t * G;
+        for (int32_t g = 0; g < G; ++g) {
+            const uint32_t m = masks[g], v = vals[g];
+            uint32_t hit = 0;
+            const uint32_t* p = win.data();
+            // Branch-free OR-reduction; compilers turn this into SIMD
+            // compare + movemask.
+            for (int64_t i = 0; i < W; ++i) {
+                hit |= ((p[i] & m) == v);
+            }
+            orow[g] = static_cast<uint8_t>(hit);
+        }
+    }
+}
+
+// Keyword prefilter helper: case-insensitive memmem over a haystack.
+// Returns 1 when needle (already lower-case) occurs in haystack after
+// case folding.  Used by the CPU oracle's keyword gate on large files.
+int32_t contains_folded(const uint8_t* hay, int64_t n, const uint8_t* needle,
+                        int64_t m) {
+    if (m == 0) return 1;
+    if (m > n) return 0;
+    const uint8_t first = needle[0];
+    for (int64_t i = 0; i + m <= n; ++i) {
+        uint8_t b = hay[i];
+        if (b >= 'A' && b <= 'Z') b += 32;
+        if (b != first) continue;
+        int64_t j = 1;
+        for (; j < m; ++j) {
+            uint8_t c = hay[i + j];
+            if (c >= 'A' && c <= 'Z') c += 32;
+            if (c != needle[j]) break;
+        }
+        if (j == m) return 1;
+    }
+    return 0;
+}
+
+}  // extern "C"

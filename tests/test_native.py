@@ -1,0 +1,69 @@
+"""Native C++ gram sieve: build, parity with NumPy reference, engine parity."""
+
+import random
+
+import numpy as np
+import pytest
+
+from trivy_tpu.engine.grams import build_gram_set
+from trivy_tpu.engine.probes import build_probe_set
+from trivy_tpu.native import gram_sieve_native, load_native
+from trivy_tpu.ops.gram_sieve import gram_sieve_numpy
+from trivy_tpu.rules.model import build_ruleset
+
+
+@pytest.fixture(scope="module")
+def gset():
+    return build_gram_set(build_probe_set(build_ruleset().rules))
+
+
+def test_native_lib_builds():
+    assert load_native() is not None, "g++ build of native/gram_sieve.cpp failed"
+
+
+def test_native_matches_numpy(gset):
+    rng = np.random.RandomState(7)
+    rows = rng.randint(0, 256, size=(8, 512)).astype(np.uint8)
+    rows[1, 100:104] = [ord(c) for c in "AKIA"]
+    rows[3, 40:44] = [ord(c) for c in "ghp_"]
+    native = gram_sieve_native(rows, gset.masks, gset.vals)
+    assert native is not None
+    ref = gram_sieve_numpy(rows, gset.masks, gset.vals)
+    assert (native == ref).all()
+
+
+def test_native_contains_folded():
+    lib = load_native()
+    hay = b"Content with GHP_token inside"
+    assert lib.contains_folded(hay, len(hay), b"ghp_", 4) == 1
+    assert lib.contains_folded(hay, len(hay), b"zzz", 3) == 0
+    assert lib.contains_folded(hay, len(hay), b"", 0) == 1
+
+
+def test_native_engine_parity_with_oracle():
+    from trivy_tpu.engine.device import TpuSecretEngine
+    from trivy_tpu.engine.oracle import OracleScanner
+
+    rng = random.Random(21)
+    up = "ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+    alnum = up + up.lower() + "0123456789"
+
+    def pick(chars, n):
+        return "".join(rng.choice(chars) for _ in range(n)).encode()
+
+    corpus = []
+    for i in range(40):
+        body = b"some plain text line\n" * rng.randint(1, 30)
+        if i % 2 == 0:
+            body += b"t = ghp_" + pick(alnum, 36) + b"\n"
+        if i % 5 == 0:
+            body += b'"AKIA' + pick(up + "0123456789", 16) + b'" \n'
+        corpus.append((f"f{i}.py", body))
+
+    eng = TpuSecretEngine(tile_len=512, sieve="native")
+    oracle = OracleScanner()
+    for (path, content), dev in zip(corpus, eng.scan_batch(corpus)):
+        ref = oracle.scan(path, content)
+        assert [f.to_json() for f in dev.findings] == [
+            f.to_json() for f in ref.findings
+        ], path

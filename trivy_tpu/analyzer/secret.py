@@ -69,6 +69,10 @@ class SecretAnalyzer(BatchAnalyzer):
                 from trivy_tpu.engine.oracle import OracleScanner
 
                 self._engine = OracleScanner(config=config)
+            elif self._backend == "native":
+                from trivy_tpu.engine.device import TpuSecretEngine
+
+                self._engine = TpuSecretEngine(config=config, sieve="native")
             else:
                 from trivy_tpu.engine.device import TpuSecretEngine
 

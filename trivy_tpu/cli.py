@@ -51,9 +51,10 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
     )
     p.add_argument(
         "--secret-backend",
-        choices=["tpu", "cpu"],
+        choices=["tpu", "cpu", "native"],
         default=_env_default("secret-backend", "tpu"),
-        help="tpu = device sieve engine, cpu = oracle engine",
+        help="tpu = device sieve engine, native = C++ host sieve, "
+        "cpu = oracle engine",
     )
     p.add_argument("--ignorefile", default=_env_default("ignorefile", ".trivyignore"))
     p.add_argument("--cache-dir", default=_env_default("cache-dir", ""))

@@ -82,6 +82,16 @@ class TpuSecretEngine:
         )
 
         self._gate, self._gate_any, self._conj, self._conj_any = self.pset.gate_masks()
+        self._build_member_matrices()
+
+        if sieve == "native":
+            # C++ host sieve (native/gram_sieve.cpp): no JAX, for CPU-only
+            # hosts; NumPy reference as last resort.
+            self.gset = build_gram_set(self.pset)
+            self._masks_np, self._vals_np = self.gset.masks, self.gset.vals
+            self.overlap = GRAM_OVERLAP
+            self._sieve_fn = None
+            return
 
         from trivy_tpu.ops import enable_compilation_cache
 
@@ -132,12 +142,46 @@ class TpuSecretEngine:
 
     def warmup(self) -> None:
         """Compile every row-bucket shape ahead of timed scanning."""
+        if self.sieve == "native":
+            from trivy_tpu.native import load_native
+
+            load_native()
+            return
         import jax
         import jax.numpy as jnp
 
         for rows in self._buckets():
             batch = jnp.zeros((rows, self.tile_len), dtype=jnp.uint8)
             jax.block_until_ready(self._sieve_fn(batch))
+
+    def _build_member_matrices(self) -> None:
+        """Dense probe->rule membership for the matmul-form candidate
+        resolution (fast path for bool probe hits)."""
+        from trivy_tpu.engine.probes import MAX_CONJUNCTS
+
+        p = len(self.pset.probes)
+        r = len(self.pset.plans)
+        self._gate_member = np.zeros((p, r), dtype=np.float32)
+        self._conj_member = np.zeros((p, r * MAX_CONJUNCTS), dtype=np.float32)
+        self._num_conjuncts = MAX_CONJUNCTS
+        for i, plan in enumerate(self.pset.plans):
+            for pid in plan.gate_probe_ids:
+                self._gate_member[pid, i] = 1.0
+            for k, conjunct in enumerate(plan.anchor_conjuncts):
+                for pid in conjunct:
+                    self._conj_member[pid, i * MAX_CONJUNCTS + k] = 1.0
+
+    def candidate_matrix_bool(self, probe_bool: np.ndarray) -> np.ndarray:
+        """[F, P] bool probe hits -> [F, R] bool candidates (matmul form)."""
+        f = len(probe_bool)
+        r = len(self.pset.plans)
+        ph = probe_bool.astype(np.float32)
+        gate_ok = ~self._gate_any[None, :] | (ph @ self._gate_member > 0)
+        conj_hit = (ph @ self._conj_member).reshape(
+            f, r, self._num_conjuncts
+        ) > 0
+        conj_ok = (~self._conj_any[None] | conj_hit).all(-1)
+        return gate_ok & conj_ok
 
     def candidate_matrix(self, file_hits: np.ndarray) -> np.ndarray:
         """[F, R] bool candidate matrix from per-file probe bitmaps [F, Pw]."""
@@ -175,24 +219,41 @@ class TpuSecretEngine:
             chunks.append(self._sieve_fn(jnp.asarray(part)))
         return np.concatenate([np.asarray(c) for c in chunks])[:total]
 
-    def _file_probe_hits(self, contents: list[bytes]) -> np.ndarray:
-        """[F, Pw] packed per-file probe-hit bitmaps."""
-        if self.sieve == "gram":
-            batch = pack_dense(contents, self.tile_len, self.overlap)
-            self.stats.tiles += len(batch.rows)
+    def _candidates(self, contents: list[bytes]) -> np.ndarray:
+        """[F, R] bool candidate matrix for a content batch."""
+        if self.sieve == "lut":
+            batch = pack(contents, self.tile_len, self.overlap)
+            self.stats.tiles += len(batch.tiles)
+            tile_hits = self._sieve_rows(batch.tiles)
+            return self.candidate_matrix(batch.file_hits(tile_hits))
+
+        batch = pack_dense(contents, self.tile_len, self.overlap)
+        self.stats.tiles += len(batch.rows)
+        if self.sieve == "native":
+            from trivy_tpu.native import gram_sieve_native
+            from trivy_tpu.ops.gram_sieve import gram_sieve_numpy
+
+            hits = gram_sieve_native(batch.rows, self._masks_np, self._vals_np)
+            if hits is None:
+                hits = gram_sieve_numpy(batch.rows, self._masks_np, self._vals_np)
+            # Pack per-row bools into the shared word layout for file OR-ing.
+            gw = -(-max(self.gset.num_grams, 1) // 32)
+            padded = np.zeros((len(hits), gw * 32), dtype=np.uint32)
+            padded[:, : self.gset.num_grams] = hits
+            weights = np.uint32(1) << (np.arange(gw * 32, dtype=np.uint32) % 32)
+            word_hits = (
+                (padded * weights[None, :])
+                .reshape(len(hits), gw, 32)
+                .sum(axis=-1, dtype=np.uint32)
+            )
+        else:  # device gram sieve
             word_hits = self._sieve_rows(batch.rows)  # [T, Gw] packed grams
-            file_words = batch.file_hits(word_hits)  # [F, Gw]
-            gram_hits = (
-                (file_words[:, :, None] >> np.arange(32, dtype=np.uint32)) & 1
-            ).astype(bool)
-            gram_hits = gram_hits.reshape(len(file_words), -1)[
-                :, : self.gset.num_grams
-            ]
-            return self.gset.probe_hits(gram_hits)
-        batch = pack(contents, self.tile_len, self.overlap)
-        self.stats.tiles += len(batch.tiles)
-        tile_hits = self._sieve_rows(batch.tiles)
-        return batch.file_hits(tile_hits)
+
+        file_words = batch.file_hits(word_hits)  # [F, Gw]
+        gram_hits = (
+            (file_words[:, :, None] >> np.arange(32, dtype=np.uint32)) & 1
+        ).astype(bool).reshape(len(file_words), -1)[:, : self.gset.num_grams]
+        return self.candidate_matrix_bool(self.gset.probe_hits_bool(gram_hits))
 
     def scan_batch(self, items: list[tuple[str, bytes]]) -> list[Secret]:
         """Scan (path, content) blobs; returns per-file Secret results."""
@@ -201,8 +262,7 @@ class TpuSecretEngine:
         self.stats.files += len(items)
         self.stats.bytes += sum(len(c) for _, c in items)
 
-        file_hits = self._file_probe_hits([c for _, c in items])
-        cand = self.candidate_matrix(file_hits)
+        cand = self._candidates([c for _, c in items])
 
         results: list[Secret] = []
         for fi, (path, content) in enumerate(items):

@@ -135,14 +135,18 @@ class GramSet:
     def num_grams(self) -> int:
         return len(self.masks)
 
-    def probe_hits(self, gram_hits: np.ndarray) -> np.ndarray:
-        """[F, G] bool gram hits -> [F, Pw] packed uint32 probe bitmaps.
+    def probe_hits_bool(self, gram_hits: np.ndarray) -> np.ndarray:
+        """[F, G] bool gram hits -> [F, P] bool probe hits.
 
         Probes without grams are always-hit (sound over-approximation)."""
-        f = gram_hits.shape[0]
         probe_hit = gram_hits.astype(np.float32) @ self._member > 0  # [F, P]
         probe_hit[:, ~self.probe_has_gram] = True
+        return probe_hit
 
+    def probe_hits(self, gram_hits: np.ndarray) -> np.ndarray:
+        """[F, G] bool gram hits -> [F, Pw] packed uint32 probe bitmaps."""
+        probe_hit = self.probe_hits_bool(gram_hits)
+        f = len(probe_hit)
         pw = (self.num_probes + 31) // 32
         padded = np.zeros((f, pw * 32), dtype=np.uint32)
         padded[:, : self.num_probes] = probe_hit

@@ -1,0 +1,3 @@
+from trivy_tpu.native.loader import gram_sieve_native, load_native
+
+__all__ = ["gram_sieve_native", "load_native"]

@@ -1,0 +1,115 @@
+"""Native library build + ctypes bindings.
+
+The C++ sources live in native/; the shared object is built on first use
+with g++ into the user cache dir (keyed by a source hash so edits rebuild)
+and loaded via ctypes — no pybind11 dependency.  Every binding has a NumPy
+fallback, so missing toolchains degrade gracefully.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_SOURCES = ["gram_sieve.cpp"]
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_lib_failed = False
+
+
+def _cache_dir() -> str:
+    return os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+        "trivy_tpu",
+        "native",
+    )
+
+
+def _build() -> str | None:
+    srcs = [os.path.join(_NATIVE_DIR, s) for s in _SOURCES]
+    if not all(os.path.exists(s) for s in srcs):
+        return None
+    h = hashlib.sha256()
+    for s in srcs:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    out = os.path.join(_cache_dir(), f"libtrivytpu-{h.hexdigest()[:16]}.so")
+    if os.path.exists(out):
+        return out
+    os.makedirs(_cache_dir(), exist_ok=True)
+    cmd = [
+        "g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+        "-o", out + ".tmp", *srcs,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        try:  # portable fallback without -march=native
+            cmd.remove("-march=native")
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except (OSError, subprocess.SubprocessError):
+            return None
+    os.replace(out + ".tmp", out)
+    return out
+
+
+def load_native() -> ctypes.CDLL | None:
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        path = _build()
+        if path is None:
+            _lib_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+            lib.gram_sieve.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+                ctypes.c_void_p,
+            ]
+            lib.gram_sieve.restype = None
+            lib.contains_folded.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_int64,
+            ]
+            lib.contains_folded.restype = ctypes.c_int32
+            _lib = lib
+        except OSError:
+            _lib_failed = True
+    return _lib
+
+
+def gram_sieve_native(
+    rows: np.ndarray, masks: np.ndarray, vals: np.ndarray
+) -> np.ndarray | None:
+    """[T, L] uint8 rows -> [T, G] bool hits, or None when the native lib is
+    unavailable (caller falls back to NumPy)."""
+    lib = load_native()
+    if lib is None:
+        return None
+    rows = np.ascontiguousarray(rows, dtype=np.uint8)
+    masks = np.ascontiguousarray(masks, dtype=np.uint32)
+    vals = np.ascontiguousarray(vals, dtype=np.uint32)
+    t, l = rows.shape
+    g = len(masks)
+    out = np.zeros((t, g), dtype=np.uint8)
+    lib.gram_sieve(
+        rows.ctypes.data, t, l,
+        masks.ctypes.data, vals.ctypes.data, g,
+        out.ctypes.data,
+    )
+    return out.astype(bool)

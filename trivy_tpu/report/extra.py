@@ -25,7 +25,22 @@ def write_template(report: Report, template: str, out: IO[str]) -> None:
     out.write(_render(template, data))
 
 
-_TOKEN = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}")
+_TOKEN = re.compile(r"\{\{(-?)\s*(.*?)\s*(-?)\}\}")
+
+
+class TemplateError(ValueError):
+    pass
+
+
+def resolve_template(template: str) -> str:
+    """Shared --template handling: `@/path` loads the file (errors early)."""
+    if template.startswith("@"):
+        path = template[1:]
+        if not os.path.exists(path):
+            raise TemplateError(f"template file not found: {path}")
+        with open(path, encoding="utf-8") as f:
+            return f.read()
+    return template
 
 
 def _lookup(data: Any, path: str) -> Any:
@@ -43,13 +58,25 @@ def _lookup(data: Any, path: str) -> Any:
 def _tokenize(template: str) -> list[tuple[str, str]]:
     tokens: list[tuple[str, str]] = []
     pos = 0
+    trim_next = False
     for m in _TOKEN.finditer(template):
         if m.start() > pos:
-            tokens.append(("text", template[pos : m.start()]))
-        tokens.append(("expr", m.group(1)))
+            text = template[pos : m.start()]
+            if trim_next:
+                text = text.lstrip()
+            if m.group(1):  # {{- left trim marker
+                text = text.rstrip()
+            if text:
+                tokens.append(("text", text))
+        tokens.append(("expr", m.group(2)))
+        trim_next = bool(m.group(3))  # -}} right trim marker
         pos = m.end()
     if pos < len(template):
-        tokens.append(("text", template[pos:]))
+        text = template[pos:]
+        if trim_next:
+            text = text.lstrip()
+        if text:
+            tokens.append(("text", text))
     return tokens
 
 
@@ -108,7 +135,11 @@ def _eval(nodes: list, data: Any) -> str:
 
 
 def _render(template: str, data: Any) -> str:
-    nodes, _ = _build(_tokenize(template), 0)
+    tokens = _tokenize(template)
+    nodes, consumed = _build(tokens, 0)
+    if consumed != len(tokens):
+        kind, val = tokens[consumed]
+        raise TemplateError(f"unexpected {{{{ {val} }}}} outside a block")
     return _eval(nodes, data)
 
 
