@@ -1,0 +1,13 @@
+"""The test environment must expose the virtual 8-device CPU mesh.
+
+Round-1 regression: conftest used os.environ.setdefault, which lost to an
+ambient JAX_PLATFORMS pin, so every "mesh" test silently ran on one device.
+"""
+
+import jax
+
+
+def test_eight_cpu_devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, devs
+    assert devs[0].platform == "cpu"
