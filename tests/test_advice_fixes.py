@@ -1,0 +1,81 @@
+"""Regression tests for round-1 advisor findings (ADVICE.md)."""
+
+import hmac
+
+import pytest
+
+from trivy_tpu.applier.apply import Applier, BlobNotFoundError
+from trivy_tpu.analyzer.secret import SecretAnalyzer
+from trivy_tpu.atypes import BlobInfo
+from trivy_tpu.cache.store import MemoryCache
+from trivy_tpu.detector.version_cmp import version_in_range
+from trivy_tpu.ltypes import LicenseFinding
+from trivy_tpu.misconf.types import MisconfFinding
+from trivy_tpu.rpc.convert import result_from_json, result_to_json
+from trivy_tpu.ftypes import Result, ResultClass
+
+
+def test_result_from_json_rehydrates_misconfigs_and_licenses():
+    r = Result(
+        target="Dockerfile",
+        result_class=ResultClass.CONFIG,
+        result_type="dockerfile",
+        misconfigurations=[
+            MisconfFinding(
+                check_id="DS002",
+                title="root user",
+                severity="HIGH",
+                status="FAIL",
+                start_line=3,
+                end_line=3,
+            ),
+            MisconfFinding(check_id="DS001", title="ok", status="PASS"),
+        ],
+        licenses=[
+            LicenseFinding(category="restricted", name="GPL-3.0", confidence=1.0)
+        ],
+    )
+    back = result_from_json(result_to_json(r))
+    assert all(isinstance(m, MisconfFinding) for m in back.misconfigurations)
+    sev = {m.check_id: m.severity for m in back.misconfigurations}
+    status = {m.check_id: m.status for m in back.misconfigurations}
+    assert sev["DS002"] == "HIGH"
+    assert status["DS001"] == "PASS"  # round-1 bug: every remote misconf => FAIL
+    assert all(isinstance(l, LicenseFinding) for l in back.licenses)
+    assert back.licenses[0].name == "GPL-3.0"
+
+
+def test_applier_raises_on_any_missing_blob():
+    cache = MemoryCache()
+    cache.put_blob("sha256:aaa", BlobInfo())
+    applier = Applier(cache=cache)
+    with pytest.raises(BlobNotFoundError):
+        applier.apply_layers("art", ["sha256:aaa", "sha256:missing"])
+
+
+def test_npm_caret_pins_leftmost_nonzero():
+    assert version_in_range("1.9.0", "^1.2.3")
+    assert not version_in_range("2.0.0", "^1.2.3")
+    assert version_in_range("0.2.9", "^0.2.3")
+    assert not version_in_range("0.9.0", "^0.2.3")  # round-1 bug: was True
+    assert version_in_range("0.0.3", "^0.0.3")
+    assert not version_in_range("0.0.4", "^0.0.3")
+    # partial carets (node-semver): ^0 => <1.0.0, ^0.0 => <0.1.0
+    assert version_in_range("0.5.0", "^0")
+    assert not version_in_range("1.0.0", "^0")
+    assert version_in_range("0.0.7", "^0.0")
+    assert not version_in_range("0.1.0", "^0.0")
+
+
+def test_secret_config_excluded_at_any_depth(tmp_path):
+    a = SecretAnalyzer.__new__(SecretAnalyzer)
+    a._config_path = "conf/trivy-secret.yaml"
+    a._engine = object()  # bypass lazy engine build; required() never touches it
+
+    # object() has no ruleset => engine_allow_path is False
+    assert not a.required("conf/trivy-secret.yaml", 100, 0o644)
+    assert not a.required("/conf/trivy-secret.yaml", 100, 0o644)
+    # reference-parity basename form
+    assert not a.required("trivy-secret.yaml", 100, 0o644)
+    # unrelated file still scanned
+    assert a.required("src/app.py", 100, 0o644)

@@ -109,3 +109,13 @@ def test_unknown_rpc_404(server):
     addr, _ = server
     with pytest.raises(RpcError):
         RpcClient(addr).call("/twirp/trivy.nope.v1.X/Y", {})
+
+
+def test_client_accepts_url_form_server_addr(server):
+    """--server may be a full URL (reference flag form), not just host:port."""
+    addr, _ = server
+    resp = RpcClient(f"http://{addr}/").call(
+        "/twirp/trivy.cache.v1.Cache/MissingBlobs",
+        {"ArtifactID": "x", "BlobIDs": []},
+    )
+    assert "MissingArtifact" in resp

@@ -94,8 +94,15 @@ class SecretAnalyzer(BatchAnalyzer):
             return False
         if fname in SKIP_FILES:
             return False
-        if self._config_path and os.path.basename(self._config_path) == file_path:
-            return False
+        if self._config_path:
+            # Reference parity: basename match (secret.go:138).  Additionally
+            # match the configured path itself (normalized, and with the
+            # leading-/ form image-extracted paths carry) so the config file
+            # is skipped wherever it sits in the scan tree.
+            norm = os.path.normpath(self._config_path).replace(os.sep, "/")
+            fp = file_path.replace(os.sep, "/")
+            if fp in (os.path.basename(self._config_path), norm, "/" + norm):
+                return False
         if os.path.splitext(fname)[1] in SKIP_EXTS:
             return False
         if self.engine_allow_path(file_path):

@@ -129,6 +129,9 @@ class Applier:
                 missing.append(bid)
             else:
                 blobs.append(blob)
-        if not blobs:
-            raise BlobNotFoundError(f"no blobs found in cache: {missing}")
+        if missing or not blob_ids:
+            # Any absent layer blob means the squashed view would be silently
+            # incomplete; the reference errors likewise (applier.go:28-29).
+            # An empty blob list is equally a client error, not a clean scan.
+            raise BlobNotFoundError(f"layer cache missing blobs: {missing}")
         return apply_layers(blobs)

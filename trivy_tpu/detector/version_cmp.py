@@ -200,10 +200,21 @@ def _check_one(cmp, installed: str, constraint: str) -> bool:
         return False
     op, ver = m.group(1) or "=", m.group(2).strip()
     if op == "^":
-        # ^X.Y.Z: >=X.Y.Z and same major (semver-style)
+        # ^X.Y.Z pins the leftmost non-zero component (npm caret semantics):
+        # ^1.2.3 => <2.0.0, ^0.2.3 => <0.3.0, ^0.0.3 => <0.0.4.  Partial
+        # versions pin at the last specified component when all are zero:
+        # ^0 => <1.0.0, ^0.0 => <0.1.0 (node-semver partial-caret rules).
         base = _semver_key(ver)[0]
         inst = _semver_key(installed)[0]
-        return cmp(installed, ver) >= 0 and inst[0] == base[0]
+        core = re.split(r"[-+]", ver, maxsplit=1)[0]
+        ncomp = min(3, len([c for c in core.split(".") if c not in ("", "x", "X", "*")]))
+        ncomp = max(1, ncomp)
+        pin = ncomp
+        for i in range(ncomp):
+            if base[i] != 0:
+                pin = i + 1
+                break
+        return cmp(installed, ver) >= 0 and inst[:pin] == base[:pin]
     if op == "~":
         base = _semver_key(ver)[0]
         inst = _semver_key(installed)[0]

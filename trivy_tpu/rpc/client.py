@@ -35,7 +35,12 @@ class RpcClient:
     headers: dict[str, str] = field(default_factory=dict)
 
     def call(self, path: str, payload: dict) -> dict:
-        url = f"http://{self.addr}{path}"
+        # Accept both bare "host:port" and full "http(s)://host:port" forms
+        # (the reference's --server flag takes a URL).
+        base = self.addr.rstrip("/")
+        if not base.startswith(("http://", "https://")):
+            base = f"http://{base}"
+        url = f"{base}{path}"
         body = json.dumps(payload).encode()
         last: Exception | None = None
         for attempt in range(MAX_RETRIES):

@@ -12,6 +12,8 @@ from typing import Any
 
 from trivy_tpu.atypes import BlobInfo, OS, Package, _secret_from_json
 from trivy_tpu.ftypes import DetectedVulnerability, Result, ResultClass
+from trivy_tpu.ltypes import LicenseFinding
+from trivy_tpu.misconf.types import MisconfFinding
 
 
 def result_to_json(r: Result) -> dict[str, Any]:
@@ -33,8 +35,13 @@ def result_from_json(d: dict[str, Any]) -> Result:
             DetectedVulnerability.from_json(v)
             for v in (d.get("Vulnerabilities") or [])
         ],
-        misconfigurations=list(d.get("Misconfigurations") or []),
-        licenses=list(d.get("Licenses") or []),
+        misconfigurations=[
+            MisconfFinding.from_json(m)
+            for m in (d.get("Misconfigurations") or [])
+        ],
+        licenses=[
+            LicenseFinding.from_json(l) for l in (d.get("Licenses") or [])
+        ],
         packages=[Package.from_json(p) for p in (d.get("Packages") or [])],
     )
 

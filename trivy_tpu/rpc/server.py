@@ -18,6 +18,7 @@ Endpoints (POST, JSON bodies):
 
 from __future__ import annotations
 
+import hmac
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -134,7 +135,10 @@ def _make_handler(server: ScanServer):
             # desynchronize if a response is sent with unread body bytes.
             length = int(self.headers.get("Content-Length", "0"))
             raw = self.rfile.read(length)
-            if server.token and self.headers.get(TOKEN_HEADER, "") != server.token:
+            if server.token and not hmac.compare_digest(
+                self.headers.get(TOKEN_HEADER, "").encode("utf-8", "replace"),
+                server.token.encode("utf-8", "replace"),
+            ):
                 self._send(401, {"error": "invalid token"})
                 return
             method = _ROUTES.get(self.path)
