@@ -1,7 +1,5 @@
 """Regression tests for round-1 advisor findings (ADVICE.md)."""
 
-import hmac
-
 import pytest
 
 from trivy_tpu.applier.apply import Applier, BlobNotFoundError
@@ -70,6 +68,7 @@ def test_npm_caret_pins_leftmost_nonzero():
 def test_secret_config_excluded_at_any_depth(tmp_path):
     a = SecretAnalyzer.__new__(SecretAnalyzer)
     a._config_path = "conf/trivy-secret.yaml"
+    a._config_skip_paths = SecretAnalyzer._build_config_skip_paths(a._config_path)
     a._engine = object()  # bypass lazy engine build; required() never touches it
 
     # object() has no ruleset => engine_allow_path is False

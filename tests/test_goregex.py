@@ -81,3 +81,40 @@ def test_builtin_corpus_all_compile():
     assert len(BUILTIN_RULES) == 86  # builtin-rules.go:95-823
     for r in BUILTIN_RULES:
         assert isinstance(r.regex, re.Pattern)
+
+
+def test_duplicate_named_groups_deduplicated():
+    """Go RE2 allows duplicate group names; Python requires renames."""
+    from trivy_tpu.engine.goregex import base_group_name, compile_bytes
+
+    pat = compile_bytes(
+        r"""credentials: (?P<secret>[a-z]{4}) (?P<secret>[0-9]{4})"""
+    )
+    m = pat.search(b"credentials: abcd 1234")
+    assert m is not None
+    names = sorted(pat.groupindex)
+    assert names == ["secret", "secret__dup1"]
+    assert all(base_group_name(n) == "secret" for n in names)
+    # Non-__dupN names are untouched.
+    assert base_group_name("secret__dupe") == "secret__dupe"
+
+
+def test_nonparticipating_duplicate_group_skipped():
+    """Alternation with duplicate names: unmatched branch yields no finding."""
+    from trivy_tpu.engine.oracle import OracleScanner
+    from trivy_tpu.rules.model import Rule, RuleSet
+    from trivy_tpu.engine.goregex import compile_bytes
+
+    rule = Rule(
+        id="alt-dup",
+        category="general",
+        title="alt",
+        severity="HIGH",
+        regex=compile_bytes(r"(?P<secret>AAA[0-9]+)|(?P<secret>BBB[0-9]+)"),
+        secret_group_name="secret",
+    )
+    res = OracleScanner(RuleSet(rules=[rule], allow_rules=[])).scan(
+        "x.txt", b"token=BBB123"
+    )
+    assert len(res.findings) == 1
+    assert res.findings[0].match == "token=******"

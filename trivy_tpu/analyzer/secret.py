@@ -55,11 +55,27 @@ class SecretAnalyzer(BatchAnalyzer):
     def __init__(self) -> None:
         self._engine = None
         self._config_path = ""
+        self._config_skip_paths: frozenset[str] = frozenset()
         self._backend = "tpu"
 
     def init(self, options: AnalyzerOptions) -> None:
         self._config_path = options.secret_scanner_option.config_path
         self._backend = options.secret_scanner_option.backend
+        self._config_skip_paths = self._build_config_skip_paths(self._config_path)
+
+    @staticmethod
+    def _build_config_skip_paths(config_path: str) -> frozenset[str]:
+        """Forms of the secret-config path to exclude from scanning.
+
+        Reference parity: basename match (secret.go:138).  Additionally the
+        configured path itself (normalized, and with the leading-/ form
+        image-extracted paths carry) so the config file is skipped wherever
+        it sits in the scan tree.
+        """
+        if not config_path:
+            return frozenset()
+        norm = os.path.normpath(config_path).replace(os.sep, "/")
+        return frozenset({os.path.basename(config_path), norm, "/" + norm})
 
     @property
     def engine(self):
@@ -94,15 +110,10 @@ class SecretAnalyzer(BatchAnalyzer):
             return False
         if fname in SKIP_FILES:
             return False
-        if self._config_path:
-            # Reference parity: basename match (secret.go:138).  Additionally
-            # match the configured path itself (normalized, and with the
-            # leading-/ form image-extracted paths carry) so the config file
-            # is skipped wherever it sits in the scan tree.
-            norm = os.path.normpath(self._config_path).replace(os.sep, "/")
-            fp = file_path.replace(os.sep, "/")
-            if fp in (os.path.basename(self._config_path), norm, "/" + norm):
-                return False
+        if self._config_skip_paths and (
+            file_path.replace(os.sep, "/") in self._config_skip_paths
+        ):
+            return False
         if os.path.splitext(fname)[1] in SKIP_EXTS:
             return False
         if self.engine_allow_path(file_path):

@@ -129,7 +129,27 @@ def _translate_class(s: str, i: int) -> tuple[str, int]:
     raise GoRegexError("unterminated character class")
 
 
-def _translate(s: str, i: int, flags: frozenset[str]) -> tuple[str, int]:
+_DUP_SEP = "__dup"
+
+
+_DUP_SUFFIX_RE = re.compile(rf"{_DUP_SEP}\d+$")
+
+
+def base_group_name(name: str) -> str:
+    """Original Go group name for a (possibly deduplicated) Python group name.
+
+    Go RE2 permits several groups with the same name in one pattern
+    (e.g. the multiple-secret-groups fixture, scanner_test.go); Python `re`
+    forbids redefinition, so the translator renames repeats to
+    ``name__dupN``.  Only that exact numeric suffix is stripped, so a
+    user-authored group literally named e.g. ``secret__dupe`` is untouched.
+    """
+    return _DUP_SUFFIX_RE.sub("", name)
+
+
+def _translate(
+    s: str, i: int, flags: frozenset[str], seen_names: dict[str, int]
+) -> tuple[str, int]:
     """Translate until an unmatched ')' (not consumed) or end of string."""
     out: list[str] = []
     while i < len(s):
@@ -170,11 +190,11 @@ def _translate(s: str, i: int, flags: frozenset[str]) -> tuple[str, int]:
                 prefix = _flag_group_prefix(set_f, clear_f)
                 if s[j] == ")":
                     # Scoped to remainder of the enclosing group: wrap the rest.
-                    rest, k = _translate(s, j + 1, new_flags)
+                    rest, k = _translate(s, j + 1, new_flags, seen_names)
                     out.append(prefix + rest + ")")
                     return "".join(out), k
                 # "(?flags: ... )" group
-                body, k = _translate(s, j + 1, new_flags)
+                body, k = _translate(s, j + 1, new_flags, seen_names)
                 if k >= len(s) or s[k] != ")":
                     raise GoRegexError("unterminated group")
                 out.append(prefix + body + ")")
@@ -185,14 +205,19 @@ def _translate(s: str, i: int, flags: frozenset[str]) -> tuple[str, int]:
                 prefix, body_start = "(?:", i + 3
             elif s.startswith("(?P<", i):
                 end = s.index(">", i)
-                prefix, body_start = s[i : end + 1], end + 1
+                name = s[i + 4 : end]
+                n = seen_names.get(name, 0)
+                seen_names[name] = n + 1
+                if n:
+                    name = f"{name}{_DUP_SEP}{n}"
+                prefix, body_start = f"(?P<{name}>", end + 1
             elif s.startswith("(?<", i) or s.startswith("(?'", i):
                 raise GoRegexError("unsupported group syntax")
             elif s.startswith("(?P=", i) or s.startswith("(?=", i) or s.startswith("(?!", i):
                 raise GoRegexError("lookaround/backreference not in RE2")
             else:
                 prefix, body_start = "(", i + 1
-            body, k = _translate(s, body_start, flags)
+            body, k = _translate(s, body_start, flags, seen_names)
             if k >= len(s) or s[k] != ")":
                 raise GoRegexError("unterminated group")
             out.append(prefix + body + ")")
@@ -205,7 +230,7 @@ def _translate(s: str, i: int, flags: frozenset[str]) -> tuple[str, int]:
 
 def go_to_python(pattern: str) -> str:
     """Translate a Go RE2 pattern into an equivalent Python re pattern (str form)."""
-    text, i = _translate(pattern, 0, frozenset())
+    text, i = _translate(pattern, 0, frozenset(), {})
     if i != len(pattern):
         raise GoRegexError(f"unbalanced ')' at {i} in {pattern!r}")
     return text
