@@ -1,25 +1,32 @@
 """Benchmark: secret-scan throughput, device engine vs CPU oracle.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
 
-Corpus: synthetic source/config-like text files, hit-sparse (~1% of files
-contain a planted secret) — the shape of BASELINE.md config #3 (throughput on
-a hit-sparse monorepo, keyword-prefilter dominated).  Baseline is the CPU
-oracle engine (the faithful reimplementation of the reference's Go scan loop,
-pkg/fanal/secret/scanner.go:371) on the same corpus, measured on a subset and
-extrapolated.
+Primary config (BASELINE.md #3 shape): hit-sparse monorepo — N_FILES
+source/config-like text files, ~1% with a planted secret, builtin 86-rule
+corpus.  `vs_baseline` compares against the CPU oracle engine (the faithful
+reimplementation of the reference's Go scan loop,
+pkg/fanal/secret/scanner.go:371) measured on a subset and extrapolated.
+
+Secondary config (BASELINE.md #4 shape): rule-axis scaling — 500 synthetic
+keyword-anchored rules over 10k files, reported under detail.rule_scaling.
+
+Per-phase wall times (pack / sieve / candidate / confirm) come from
+SieveStats and are reported under detail.phases.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
 
-N_FILES = int(__import__("os").environ.get("BENCH_FILES", "4000"))
-FILE_LEN = int(__import__("os").environ.get("BENCH_FILE_LEN", "2048"))
-ORACLE_SUBSET = 200
+N_FILES = int(os.environ.get("BENCH_FILES", "100000"))
+FILE_LEN = int(os.environ.get("BENCH_FILE_LEN", "2048"))
+ORACLE_SUBSET = int(os.environ.get("BENCH_ORACLE_SUBSET", "300"))
+RULE_SCALING = os.environ.get("BENCH_RULE_SCALING", "1") == "1"
 
 _WORDS = (
     b"import os sys json yaml config server client request response data key value "
@@ -29,11 +36,16 @@ _WORDS = (
 
 
 def make_corpus(n_files: int, file_len: int) -> list[tuple[str, bytes]]:
+    """Synthetic source-like text, vectorized so 100k files builds in seconds."""
     rng = np.random.RandomState(42)
+    # One large word stream; files are slices at staggered offsets.
+    stream_words = rng.randint(0, len(_WORDS), size=300_000)
+    stream = b" ".join(_WORDS[i] for i in stream_words)
+    step = 61  # co-prime-ish stagger so neighboring files differ
     corpus = []
     for i in range(n_files):
-        words = [bytes(_WORDS[j]) for j in rng.randint(0, len(_WORDS), size=file_len // 6)]
-        body = b" ".join(words)[:file_len]
+        off = (i * step * 7) % max(1, len(stream) - file_len - 1)
+        body = stream[off : off + file_len]
         lines = [body[k : k + 64] for k in range(0, len(body), 64)]
         blob = b"\n".join(lines)
         if i % 100 == 0:  # 1% planted secrets
@@ -42,23 +54,27 @@ def make_corpus(n_files: int, file_len: int) -> list[tuple[str, bytes]]:
     return corpus
 
 
-def main() -> None:
-    from trivy_tpu.engine.device import TpuSecretEngine
+def bench_primary() -> dict:
+    from trivy_tpu.engine.device import SieveStats, TpuSecretEngine
     from trivy_tpu.engine.oracle import OracleScanner
 
     corpus = make_corpus(N_FILES, FILE_LEN)
     total_bytes = sum(len(c) for _, c in corpus)
 
     engine = TpuSecretEngine()
-    engine.warmup()  # compile all tile-bucket shapes outside the timed region
+    engine.warmup()  # compile all row-bucket shapes outside the timed region
 
     # Best of 3: the device link (and any shared TPU frontend) has high
     # variance; steady-state throughput is the meaningful number.
     device_s = float("inf")
+    best_stats = None
     for _ in range(3):
+        engine.stats = SieveStats()
         t0 = time.perf_counter()
         results = engine.scan_batch(corpus)
-        device_s = min(device_s, time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        if dt < device_s:
+            device_s, best_stats = dt, engine.stats
     n_findings = sum(len(r.findings) for r in results)
 
     oracle = OracleScanner()
@@ -72,8 +88,81 @@ def main() -> None:
             f.to_json() for f in ores.findings
         ], f"parity mismatch on {corpus[i][0]}"
 
-    files_per_sec = len(corpus) / device_s
-    baseline_files_per_sec = len(corpus) / oracle_s
+    return {
+        "files": len(corpus),
+        "bytes": total_bytes,
+        "device_s": device_s,
+        "findings": n_findings,
+        "oracle_files_per_sec": len(corpus) / oracle_s,
+        "phases": best_stats.phases(),
+        "candidate_pairs": best_stats.candidate_pairs,
+    }
+
+
+def bench_rule_scaling(n_rules: int = 500, n_files: int = 10000) -> dict:
+    """BASELINE.md config #4: custom rule corpus, rule-axis scaling."""
+    from trivy_tpu.engine.device import TpuSecretEngine
+    from trivy_tpu.rules.model import RuleSet, Rule
+    from trivy_tpu.engine.goregex import compile_bytes
+
+    rules = [
+        Rule(
+            id=f"synthetic-{i:03d}",
+            category="synthetic",
+            title=f"Synthetic rule {i}",
+            severity="HIGH",
+            regex=compile_bytes(rf"marker{i:03d}q[0-9a-f]{{16}}"),
+            keywords=[f"marker{i:03d}q"],
+        )
+        for i in range(n_rules)
+    ]
+    corpus = make_corpus(n_files, FILE_LEN)
+    # Plant matches for ~0.5% of files, cycling through rules.
+    planted = 0
+    out = []
+    for i, (p, c) in enumerate(corpus):
+        if i % 200 == 0:
+            r = planted % n_rules
+            c += b"\nmarker%03dq0123456789abcdef\n" % r
+            planted += 1
+        out.append((p, c))
+
+    engine = TpuSecretEngine(ruleset=RuleSet(rules=rules, allow_rules=[]))
+    engine.warmup()
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        results = engine.scan_batch(out)
+        best = min(best, time.perf_counter() - t0)
+    found = sum(len(r.findings) for r in results)
+    assert found >= planted, (found, planted)
+    return {
+        "rules": n_rules,
+        "files": n_files,
+        "files_per_sec": round(n_files / best, 1),
+        "findings": found,
+        "grams": engine.gset.num_grams,
+    }
+
+
+def main() -> None:
+    primary = bench_primary()
+    files_per_sec = primary["files"] / primary["device_s"]
+    detail = {
+        "files": primary["files"],
+        "bytes": primary["bytes"],
+        "mb_per_sec": round(primary["bytes"] / primary["device_s"] / 1e6, 1),
+        "findings": primary["findings"],
+        "device_s": round(primary["device_s"], 3),
+        "oracle_files_per_sec": round(primary["oracle_files_per_sec"], 1),
+        "candidate_pairs": primary["candidate_pairs"],
+        "phases": primary["phases"],
+    }
+    if RULE_SCALING:
+        try:
+            detail["rule_scaling"] = bench_rule_scaling()
+        except Exception as e:  # secondary config must not sink the bench
+            detail["rule_scaling"] = {"error": f"{type(e).__name__}: {e}"}
 
     print(
         json.dumps(
@@ -81,15 +170,10 @@ def main() -> None:
                 "metric": "secret_scan_files_per_sec",
                 "value": round(files_per_sec, 1),
                 "unit": "files/s",
-                "vs_baseline": round(files_per_sec / baseline_files_per_sec, 2),
-                "detail": {
-                    "files": len(corpus),
-                    "bytes": total_bytes,
-                    "mb_per_sec": round(total_bytes / device_s / 1e6, 1),
-                    "findings": n_findings,
-                    "device_s": round(device_s, 3),
-                    "oracle_files_per_sec": round(baseline_files_per_sec, 1),
-                },
+                "vs_baseline": round(
+                    files_per_sec / primary["oracle_files_per_sec"], 2
+                ),
+                "detail": detail,
             }
         )
     )

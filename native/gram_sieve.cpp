@@ -1,58 +1,156 @@
-// Native host gram sieve — the CPU-fallback matcher of the secret engine.
+// Native host gram sieve — the CPU matcher of the secret engine.
 //
-// Same contract as the device kernel (trivy_tpu/ops/gram_sieve.py
-// gram_sieve_rows): case-fold bytes, pack 4-byte windows into uint32, test
-// every (mask, value) gram constant, OR per row.  The inner compare loop is
-// written to auto-vectorize (contiguous uint32 stream vs. broadcast
-// constants); with -O3 -march=native g++ emits AVX2/AVX-512 compares.
+// Same contract as the device kernel (trivy_tpu/ops/gram_sieve.py /
+// gram_sieve_pallas.py): case-fold bytes, pack 4-byte windows into uint32,
+// test every (mask, value) gram constant, OR per attribution row.
+//
+// v2 algorithm: instead of G compares per window (G ~ hundreds), each
+// distinct mask group gets an O(1) membership probe per window:
+//   - 16-bit masks (0x0000FFFF / 0xFFFF0000): exact 64K-bit direct bitset.
+//   - other masks: 2^17-bit bloom (multiplicative hash) + rare slow-path
+//     verification over the group's value range.
+// Gram constants arrive sorted by (mask, value) (engine/grams.py sorts), so
+// mask groups are contiguous index ranges and slow-path attribution is a
+// short linear scan.  gram_sieve_stream evaluates windows over one flat
+// stream — row boundaries are attribution buckets only, so no window is
+// ever lost at a seam and no overlap bytes are needed.
 //
 // Role in the architecture: hosts without an accelerator (plain CPU workers,
-// the RPC server on a non-TPU machine) run this instead of the JAX path; it
-// replaces the reference's per-rule Go regexp loop
+// the RPC server on a non-TPU machine) and the host half of the hybrid
+// engine run this; it replaces the reference's per-rule Go regexp loop
 // (pkg/fanal/secret/scanner.go:403-408) as the first-pass filter.
 
 #include <cstdint>
 #include <cstring>
 #include <vector>
 
+namespace {
+
+constexpr uint32_t kHashMul = 2654435761u;  // Knuth multiplicative
+constexpr int kBloomBits = 17;
+
+struct MaskGroup {
+    uint32_t mask;
+    int32_t start;  // gram index range [start, end)
+    int32_t end;
+    int kind;  // 0 = bloom, 1 = direct16 low, 2 = direct16 high
+    std::vector<uint64_t> table;
+};
+
+inline uint32_t table_index(const MaskGroup& g, uint32_t x) {
+    if (g.kind == 1) return x & 0xFFFFu;
+    if (g.kind == 2) return x >> 16;
+    return (x * kHashMul) >> (32 - kBloomBits);
+}
+
+inline bool table_probe(const MaskGroup& g, uint32_t x) {
+    const uint32_t idx = table_index(g, x);
+    return (g.table[idx >> 6] >> (idx & 63)) & 1u;
+}
+
+std::vector<MaskGroup> build_groups(const uint32_t* masks, const uint32_t* vals,
+                                    int32_t G) {
+    std::vector<MaskGroup> groups;
+    int32_t i = 0;
+    while (i < G) {
+        int32_t j = i;
+        while (j < G && masks[j] == masks[i]) ++j;
+        MaskGroup g;
+        g.mask = masks[i];
+        g.start = i;
+        g.end = j;
+        if (g.mask == 0x0000FFFFu || g.mask == 0xFFFF0000u) {
+            g.kind = g.mask == 0x0000FFFFu ? 1 : 2;
+            g.table.assign((1u << 16) / 64, 0);
+        } else {
+            g.kind = 0;
+            g.table.assign((1u << kBloomBits) / 64, 0);
+        }
+        for (int32_t k = i; k < j; ++k) {
+            const uint32_t idx = table_index(g, vals[k]);
+            g.table[idx >> 6] |= 1ull << (idx & 63);
+        }
+        groups.push_back(std::move(g));
+        i = j;
+    }
+    return groups;
+}
+
+}  // namespace
+
 extern "C" {
 
-// rows:  [T, L] row-major bytes (zero-padded)
-// masks: [G] uint32, vals: [G] uint32
-// out:   [T, G] bytes — 1 when gram g matched anywhere in row t
+// stream:  [n] bytes (files joined with >=3 zero-gap bytes)
+// masks:   [G] uint32 sorted so equal masks are contiguous; vals: [G] uint32
+// row_len: attribution bucket size in window-start positions
+// out:     [ceil((n-3)/row_len) rows, G] bytes — 1 when gram g matched at a
+//          window starting inside bucket t.  Caller zeroes `out`.
+void gram_sieve_stream(const uint8_t* stream, int64_t n, const uint32_t* masks,
+                       const uint32_t* vals, int32_t G, int64_t row_len,
+                       uint8_t* out) {
+    if (n < 4 || G <= 0) return;
+    std::vector<MaskGroup> groups = build_groups(masks, vals, G);
+    const MaskGroup* gp = groups.data();
+    const size_t ngroups = groups.size();
+
+    // Seed the window with the first 3 folded bytes.
+    uint32_t w = 0;
+    for (int k = 0; k < 3; ++k) {
+        uint8_t b = stream[k];
+        if (b >= 'A' && b <= 'Z') b += 32;
+        w |= (uint32_t)b << (8 * k);
+    }
+
+    uint8_t* orow = out;
+    int64_t rem = row_len;
+    for (int64_t i = 3; i < n; ++i) {
+        uint8_t b = stream[i];
+        if (b >= 'A' && b <= 'Z') b += 32;
+        w = (w >> 8) | ((uint32_t)b << 24);
+        for (size_t k = 0; k < ngroups; ++k) {
+            const uint32_t x = w & gp[k].mask;
+            if (table_probe(gp[k], x)) {
+                for (int32_t g = gp[k].start; g < gp[k].end; ++g) {
+                    if (x == vals[g]) orow[g] = 1;
+                }
+            }
+        }
+        if (--rem == 0) {
+            rem = row_len;
+            orow += G;
+        }
+    }
+}
+
+// Row API: [T, L] rows (zero-padded); out [T, G].  Each row is an
+// independent stream (row boundaries here DO cut windows; callers pack rows
+// with overlap).  Kept for the NumPy-parity tests and the XLA-path contract.
 void gram_sieve(const uint8_t* rows, int64_t T, int64_t L,
                 const uint32_t* masks, const uint32_t* vals, int32_t G,
                 uint8_t* out) {
-    if (L < 4) {
-        memset(out, 0, static_cast<size_t>(T) * G);
-        return;
-    }
-    const int64_t W = L - 3;
-    std::vector<uint32_t> win(static_cast<size_t>(W));
+    memset(out, 0, (size_t)T * (size_t)G);
+    if (L < 4 || G <= 0) return;
+    std::vector<MaskGroup> groups = build_groups(masks, vals, G);
+    const MaskGroup* gp = groups.data();
+    const size_t ngroups = groups.size();
 
     for (int64_t t = 0; t < T; ++t) {
         const uint8_t* row = rows + t * L;
-
-        // Fold + pack windows once per row (vectorizable single pass).
+        uint8_t* orow = out + t * G;
         uint32_t w = 0;
         for (int64_t i = 0; i < L; ++i) {
             uint8_t b = row[i];
             if (b >= 'A' && b <= 'Z') b += 32;
-            w = (w >> 8) | (static_cast<uint32_t>(b) << 24);
-            if (i >= 3) win[static_cast<size_t>(i - 3)] = w;
-        }
-
-        uint8_t* orow = out + t * G;
-        for (int32_t g = 0; g < G; ++g) {
-            const uint32_t m = masks[g], v = vals[g];
-            uint32_t hit = 0;
-            const uint32_t* p = win.data();
-            // Branch-free OR-reduction; compilers turn this into SIMD
-            // compare + movemask.
-            for (int64_t i = 0; i < W; ++i) {
-                hit |= ((p[i] & m) == v);
+            w = (w >> 8) | ((uint32_t)b << 24);
+            if (i < 3) continue;
+            for (size_t k = 0; k < ngroups; ++k) {
+                const uint32_t x = w & gp[k].mask;
+                if (table_probe(gp[k], x)) {
+                    for (int32_t g = gp[k].start; g < gp[k].end; ++g) {
+                        if (x == vals[g]) orow[g] = 1;
+                    }
+                }
             }
-            orow[g] = static_cast<uint8_t>(hit);
         }
     }
 }

@@ -44,6 +44,9 @@ from trivy_tpu.scanner.packing import (
 # XLA compiles each bucket exactly once per process; larger scans are chunked
 # into max-bucket-row batches (static shapes — SURVEY §1 XLA semantics).
 TILE_BUCKETS = (512, 4096)
+# The TPU link has a large fixed per-call latency (~100ms through the axon
+# relay); the Pallas path uses few, huge calls so the fixed cost amortizes.
+TILE_BUCKETS_PALLAS = (4096, 32768)
 
 GRAM_OVERLAP = 3  # gram window (4) - 1
 
@@ -55,6 +58,21 @@ class SieveStats:
     tiles: int = 0
     candidate_pairs: int = 0
     confirmed_findings: int = 0
+    # Wall-clock per phase (seconds), accumulated across scan_batch calls:
+    # host pack, device sieve (dispatch+execute+fetch), per-file OR +
+    # gram->probe->rule candidate resolution, exact host confirm.
+    pack_s: float = 0.0
+    sieve_s: float = 0.0
+    candidate_s: float = 0.0
+    confirm_s: float = 0.0
+
+    def phases(self) -> dict:
+        return {
+            "pack_s": round(self.pack_s, 4),
+            "sieve_s": round(self.sieve_s, 4),
+            "candidate_s": round(self.candidate_s, 4),
+            "confirm_s": round(self.confirm_s, 4),
+        }
 
 
 class TpuSecretEngine:
@@ -68,6 +86,7 @@ class TpuSecretEngine:
         mesh=None,
         max_batch_tiles: int = 4096,
         sieve: str = "gram",
+        kernel: str = "auto",
     ):
         self.ruleset = ruleset if ruleset is not None else build_ruleset(config)
         self.oracle = OracleScanner(self.ruleset)
@@ -77,6 +96,7 @@ class TpuSecretEngine:
         self.sieve = sieve
         self.stats = SieveStats()
         self._mesh = mesh
+        self._tile_buckets = TILE_BUCKETS
         self._tile_align = (
             int(np.prod([mesh.shape[a] for a in mesh.axis_names])) if mesh else 1
         )
@@ -100,18 +120,35 @@ class TpuSecretEngine:
         import jax.numpy as jnp
 
         if sieve == "gram":
+            import jax
+
             from trivy_tpu.ops import gram_sieve as gs_mod
 
             self.gset: GramSet = build_gram_set(self.pset)
-            masks, vals = gs_mod.pad_grams(self.gset.masks, self.gset.vals)
-            self._masks = jnp.asarray(masks)
-            self._vals = jnp.asarray(vals)
             self.overlap = GRAM_OVERLAP
-            if mesh is not None:
-                fn = gs_mod.make_sharded_gram_sieve(mesh)
+            on_tpu = jax.devices()[0].platform == "tpu"
+            use_pallas = kernel == "pallas" or (
+                kernel == "auto" and mesh is None and on_tpu
+            )
+            if use_pallas:
+                # Pallas kernel (single-chip production path): gram constants
+                # baked into the program, ~10x the XLA formulation.
+                from trivy_tpu.ops.gram_sieve_pallas import PallasGramSieve
+
+                self._sieve_fn = PallasGramSieve(self.gset.masks, self.gset.vals)
+                self._tile_buckets = TILE_BUCKETS_PALLAS
+                if self.max_batch_tiles < self._tile_buckets[-1]:
+                    self.max_batch_tiles = self._tile_buckets[-1]
             else:
-                fn = gs_mod._gram_sieve_jit
-            self._sieve_fn = lambda rows: fn(rows, self._masks, self._vals)
+                masks, vals = gs_mod.pad_grams(self.gset.masks, self.gset.vals)
+                self._masks = jnp.asarray(masks)
+                self._vals = jnp.asarray(vals)
+                if mesh is not None:
+                    fn = gs_mod.make_sharded_gram_sieve(mesh)
+                else:
+                    fn = gs_mod._gram_sieve_jit
+                self._sieve_fn = lambda rows: fn(rows, self._masks, self._vals)
+                self._tile_buckets = TILE_BUCKETS
         elif sieve == "lut":
             self._lut = jnp.asarray(self.pset.build_lut())
             self.overlap = max(DEFAULT_OVERLAP, self.pset.jmax)
@@ -135,7 +172,7 @@ class TpuSecretEngine:
         """Row batch shapes: TILE_BUCKETS capped by max_batch_tiles, rounded
         up to the mesh-device multiple."""
         align = self._tile_align
-        caps = [b for b in TILE_BUCKETS if b <= self.max_batch_tiles]
+        caps = [b for b in self._tile_buckets if b <= self.max_batch_tiles]
         if not caps or caps[-1] != self.max_batch_tiles:
             caps.append(self.max_batch_tiles)
         return [-(-b // align) * align for b in caps]
@@ -221,13 +258,17 @@ class TpuSecretEngine:
 
     def _candidates(self, contents: list[bytes]) -> np.ndarray:
         """[F, R] bool candidate matrix for a content batch."""
+        import time as _time
+
         if self.sieve == "lut":
             batch = pack(contents, self.tile_len, self.overlap)
             self.stats.tiles += len(batch.tiles)
             tile_hits = self._sieve_rows(batch.tiles)
             return self.candidate_matrix(batch.file_hits(tile_hits))
 
+        t0 = _time.perf_counter()
         batch = pack_dense(contents, self.tile_len, self.overlap)
+        self.stats.pack_s += _time.perf_counter() - t0
         self.stats.tiles += len(batch.rows)
         if self.sieve == "native":
             from trivy_tpu.native import gram_sieve_native
@@ -247,16 +288,23 @@ class TpuSecretEngine:
                 .sum(axis=-1, dtype=np.uint32)
             )
         else:  # device gram sieve
+            t0 = _time.perf_counter()
             word_hits = self._sieve_rows(batch.rows)  # [T, Gw] packed grams
+            self.stats.sieve_s += _time.perf_counter() - t0
 
+        t0 = _time.perf_counter()
         file_words = batch.file_hits(word_hits)  # [F, Gw]
         gram_hits = (
             (file_words[:, :, None] >> np.arange(32, dtype=np.uint32)) & 1
         ).astype(bool).reshape(len(file_words), -1)[:, : self.gset.num_grams]
-        return self.candidate_matrix_bool(self.gset.probe_hits_bool(gram_hits))
+        cand = self.candidate_matrix_bool(self.gset.probe_hits_bool(gram_hits))
+        self.stats.candidate_s += _time.perf_counter() - t0
+        return cand
 
     def scan_batch(self, items: list[tuple[str, bytes]]) -> list[Secret]:
         """Scan (path, content) blobs; returns per-file Secret results."""
+        import time as _time
+
         if not items:
             return []
         self.stats.files += len(items)
@@ -264,6 +312,7 @@ class TpuSecretEngine:
 
         cand = self._candidates([c for _, c in items])
 
+        t0 = _time.perf_counter()
         results: list[Secret] = []
         for fi, (path, content) in enumerate(items):
             idxs = np.flatnonzero(cand[fi])
@@ -281,6 +330,7 @@ class TpuSecretEngine:
             res = self.oracle.scan(path, content, rule_indices=idxs.tolist())
             self.stats.confirmed_findings += len(res.findings)
             results.append(res)
+        self.stats.confirm_s += _time.perf_counter() - t0
         return results
 
     def scan(self, file_path: str, content: bytes) -> Secret:

@@ -86,19 +86,9 @@ def _plan_window(classes: tuple[int, ...]) -> tuple[float, list[_Position]]:
     return score, positions
 
 
-def probe_grams(classes: tuple[int, ...]) -> list[tuple[int, int]]:
-    """Best window's (mask, val) uint32 variants, or [] if below the floor."""
-    wlen = min(GRAM_LEN, len(classes))
-    best_score, best_plan = -1.0, None
-    for start in range(len(classes) - wlen + 1):
-        score, plan = _plan_window(tuple(classes[start : start + wlen]))
-        if score > best_score:
-            best_score, best_plan = score, plan
-    if best_plan is None or best_score < MIN_GRAM_BITS:
-        return []
-
+def _window_variants(plan: list[_Position]) -> list[tuple[int, int]]:
     variants: list[tuple[int, int]] = [(0, 0)]
-    for j, pos in enumerate(best_plan):
+    for j, pos in enumerate(plan):
         if not pos.keep:
             continue
         shift = 8 * j
@@ -110,22 +100,79 @@ def probe_grams(classes: tuple[int, ...]) -> list[tuple[int, int]]:
     return variants
 
 
+def probe_gram_windows(
+    classes: tuple[int, ...], max_windows: int = 2
+) -> list[list[tuple[int, int]]]:
+    """Select up to `max_windows` windows of the probe; each returns its
+    (mask, val) uint32 variants.  A probe occurrence fires EVERY selected
+    window (AND semantics across windows, OR across a window's variants).
+
+    Single-window selection by letter-frequency score alone is fragile: the
+    best-scored window of "atlassian" is "lass", a substring of "class",
+    which fires on essentially all source code.  Requiring two well-separated
+    windows ("atla" AND "sian") keeps soundness (both are necessary
+    conditions) while multiplying selectivities.
+    """
+    wlen = min(GRAM_LEN, len(classes))
+    scored: list[tuple[float, int, list[_Position]]] = []
+    for start in range(len(classes) - wlen + 1):
+        score, plan = _plan_window(tuple(classes[start : start + wlen]))
+        if score >= MIN_GRAM_BITS:
+            scored.append((score, start, plan))
+    if not scored:
+        return []
+
+    best = max(scored, key=lambda t: t[0])
+    chosen = [best]
+    if max_windows >= 2 and len(scored) > 1:
+        # Farthest usable window from the best one (ties: higher score);
+        # require enough separation that one common word can't contain both.
+        far = max(
+            (t for t in scored if t != best),
+            key=lambda t: (abs(t[1] - best[1]), t[0]),
+        )
+        if abs(far[1] - best[1]) >= 2:
+            chosen.append(far)
+
+    return [_window_variants(plan) for _score, _start, plan in chosen]
+
+
+def probe_grams(classes: tuple[int, ...]) -> list[tuple[int, int]]:
+    """Backward-compatible single-window form: the best window's variants."""
+    windows = probe_gram_windows(classes, max_windows=1)
+    return windows[0] if windows else []
+
+
 @dataclass
 class GramSet:
-    """Compiled gram constants + probe attribution."""
+    """Compiled gram constants + probe attribution.
+
+    Grams group into *windows* (a window's variants are case/class
+    expansions of one probe window; OR semantics) and windows group into
+    probes (a probe occurrence fires every one of its windows; AND
+    semantics — see probe_gram_windows)."""
 
     masks: np.ndarray  # [G] uint32
     vals: np.ndarray  # [G] uint32
     gram_probe: np.ndarray  # [G] int32 — owning probe index
+    gram_window: np.ndarray  # [G] int32 — owning window index
+    window_probe: np.ndarray  # [W] int32 — window's probe index
     probe_has_gram: np.ndarray  # [P] bool
     num_probes: int
-    _member: np.ndarray = field(init=False, repr=False)  # [G, P] f32 0/1
+    _wmember: np.ndarray = field(init=False, repr=False)  # [G, W] f32 0/1
+    _pmember: np.ndarray = field(init=False, repr=False)  # [W, P] f32 0/1
+    _pwindows: np.ndarray = field(init=False, repr=False)  # [P] f32 counts
     _bit_weights: np.ndarray = field(init=False, repr=False)  # [P-pad] uint32
 
     def __post_init__(self) -> None:
-        self._member = np.zeros((self.num_grams, self.num_probes), dtype=np.float32)
+        w = self.num_windows
+        self._wmember = np.zeros((self.num_grams, w), dtype=np.float32)
         if self.num_grams:
-            self._member[np.arange(self.num_grams), self.gram_probe] = 1.0
+            self._wmember[np.arange(self.num_grams), self.gram_window] = 1.0
+        self._pmember = np.zeros((w, self.num_probes), dtype=np.float32)
+        if w:
+            self._pmember[np.arange(w), self.window_probe] = 1.0
+        self._pwindows = self._pmember.sum(axis=0)
         pw = (self.num_probes + 31) // 32
         self._bit_weights = (
             np.uint32(1) << (np.arange(pw * 32, dtype=np.uint32) % 32)
@@ -135,11 +182,18 @@ class GramSet:
     def num_grams(self) -> int:
         return len(self.masks)
 
+    @property
+    def num_windows(self) -> int:
+        return len(self.window_probe)
+
     def probe_hits_bool(self, gram_hits: np.ndarray) -> np.ndarray:
         """[F, G] bool gram hits -> [F, P] bool probe hits.
 
         Probes without grams are always-hit (sound over-approximation)."""
-        probe_hit = gram_hits.astype(np.float32) @ self._member > 0  # [F, P]
+        window_hit = gram_hits.astype(np.float32) @ self._wmember > 0  # [F, W]
+        probe_hit = (
+            window_hit.astype(np.float32) @ self._pmember
+        ) >= self._pwindows[None, :]  # all windows present
         probe_hit[:, ~self.probe_has_gram] = True
         return probe_hit
 
@@ -161,22 +215,42 @@ def build_gram_set(pset: ProbeSet) -> GramSet:
     masks: list[int] = []
     vals: list[int] = []
     gram_probe: list[int] = []
+    gram_window: list[int] = []
+    window_probe: list[int] = []
     has = np.zeros(len(pset.probes), dtype=bool)
 
     for p, probe in enumerate(pset.probes):
-        variants = probe_grams(probe.classes)
-        if not variants:
+        windows = probe_gram_windows(probe.classes)
+        if not windows:
             continue
         has[p] = True
-        for mask, val in variants:
-            masks.append(mask)
-            vals.append(val)
-            gram_probe.append(p)
+        for variants in windows:
+            wid = len(window_probe)
+            window_probe.append(p)
+            for mask, val in variants:
+                masks.append(mask)
+                vals.append(val)
+                gram_probe.append(p)
+                gram_window.append(wid)
+
+    masks_a = np.array(masks, dtype=np.uint32)
+    vals_a = np.array(vals, dtype=np.uint32)
+    gram_probe_a = np.array(gram_probe, dtype=np.int32)
+    gram_window_a = np.array(gram_window, dtype=np.int32)
+    # Sort grams by (mask, val) so kernels can hoist `w & mask` across runs
+    # of equal masks (ops/gram_sieve_pallas.py); per-gram arrays permute
+    # together, so attribution is unaffected.
+    if len(masks_a):
+        perm = np.lexsort((vals_a, masks_a))
+        masks_a, vals_a = masks_a[perm], vals_a[perm]
+        gram_probe_a, gram_window_a = gram_probe_a[perm], gram_window_a[perm]
 
     return GramSet(
-        masks=np.array(masks, dtype=np.uint32),
-        vals=np.array(vals, dtype=np.uint32),
-        gram_probe=np.array(gram_probe, dtype=np.int32),
+        masks=masks_a,
+        vals=vals_a,
+        gram_probe=gram_probe_a,
+        gram_window=gram_window_a,
+        window_probe=np.array(window_probe, dtype=np.int32),
         probe_has_gram=has,
         num_probes=len(pset.probes),
     )

@@ -60,14 +60,21 @@ class DenseBatch:
     num_files: int
 
     def file_hits(self, row_hits: np.ndarray) -> np.ndarray:
-        """OR row-level hit bitmaps [T, W] into per-file bitmaps [F, W]."""
-        w = row_hits.shape[1]
-        out = np.zeros((self.num_files, w), dtype=row_hits.dtype)
-        # Prefix-OR would be O(T); spans are short, so slice per file.
-        for fi in range(self.num_files):
-            lo, hi = self.file_row_lo[fi], self.file_row_hi[fi]
-            if hi >= lo:
-                out[fi] = np.bitwise_or.reduce(row_hits[lo : hi + 1], axis=0)
+        """OR row-level hit bitmaps [T, W] into per-file bitmaps [F, W].
+
+        Vectorized via bitwise_or.reduceat over the (monotonic) file row
+        starts: segment i covers [lo_i, lo_{i+1}), which is the file's rows
+        except possibly its last (shared-seam) row — OR'd in explicitly.
+        """
+        if self.num_files == 0:
+            return np.zeros((0, row_hits.shape[1]), dtype=row_hits.dtype)
+        nrows = len(row_hits)
+        lo = np.minimum(self.file_row_lo, nrows - 1)
+        hi = self.file_row_hi
+        valid = hi >= self.file_row_lo
+        seg = np.bitwise_or.reduceat(row_hits, lo, axis=0)
+        out = seg | row_hits[np.clip(hi, 0, nrows - 1)]
+        out[~valid] = 0
         return out
 
 
@@ -85,35 +92,34 @@ def pack_dense(
     """
     gap = overlap if gap is None else gap
     stride = row_len - overlap
+    nfiles = len(contents)
 
-    offsets = []
-    pos = 0
-    for c in contents:
-        offsets.append((pos, pos + len(c)))
-        pos += len(c) + gap
+    # Single C-level join builds the stream; offsets via cumsum.
+    lens = np.fromiter((len(c) for c in contents), dtype=np.int64, count=nfiles)
+    starts = np.zeros(nfiles, dtype=np.int64)
+    if nfiles > 1:
+        np.cumsum(lens[:-1] + gap, out=starts[1:])
+    pos = int(starts[-1] + lens[-1] + gap) if nfiles else 0
     total = pos + overlap  # tail padding so the final windows exist
 
     nrows = max(1, -(-max(total - overlap, 1) // stride))
     stream = np.zeros(nrows * stride + overlap, dtype=np.uint8)
-    for (s, _e), c in zip(offsets, contents):
-        stream[s : s + len(c)] = np.frombuffer(c, dtype=np.uint8)
+    joined = np.frombuffer((b"\x00" * gap).join(contents), dtype=np.uint8)
+    stream[: len(joined)] = joined
 
     rows = np.lib.stride_tricks.sliding_window_view(stream, row_len)[::stride]
     assert len(rows) == nrows, (len(rows), nrows)
 
-    lo = np.zeros(len(contents), dtype=np.int32)
-    hi = np.full(len(contents), -1, dtype=np.int32)
-    for fi, (s, e) in enumerate(offsets):
-        if e == s:
-            continue  # empty file: no rows
-        # Windows containing any byte of the file start in [s-overlap, e).
-        lo[fi] = max(0, s - overlap) // stride
-        hi[fi] = min((e - 1) // stride, nrows - 1)
+    ends = starts + lens
+    # Windows containing any byte of the file start in [s-overlap, e).
+    lo = (np.maximum(starts - overlap, 0) // stride).astype(np.int32)
+    hi = np.minimum((ends - 1) // stride, nrows - 1).astype(np.int32)
+    hi[lens == 0] = -1  # empty file: no rows
     return DenseBatch(
         rows=np.ascontiguousarray(rows),
         file_row_lo=lo,
         file_row_hi=hi,
-        num_files=len(contents),
+        num_files=nfiles,
     )
 
 
