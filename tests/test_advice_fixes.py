@@ -65,16 +65,18 @@ def test_npm_caret_pins_leftmost_nonzero():
     assert not version_in_range("0.1.0", "^0.0")
 
 
-def test_secret_config_excluded_at_any_depth(tmp_path):
+def test_secret_config_skip_is_basename_parity(tmp_path):
+    """r2 advisor: skip exactly filepath.Base(configPath) == filePath
+    (secret.go:138) — a scan-tree file at the configured path is scanned."""
     a = SecretAnalyzer.__new__(SecretAnalyzer)
     a._config_path = "conf/trivy-secret.yaml"
     a._config_skip_paths = SecretAnalyzer._build_config_skip_paths(a._config_path)
     a._engine = object()  # bypass lazy engine build; required() never touches it
 
-    # object() has no ruleset => engine_allow_path is False
-    assert not a.required("conf/trivy-secret.yaml", 100, 0o644)
-    assert not a.required("/conf/trivy-secret.yaml", 100, 0o644)
-    # reference-parity basename form
+    # reference-parity basename form is skipped
     assert not a.required("trivy-secret.yaml", 100, 0o644)
+    # the configured path inside the scan tree is scanned (reference scans it)
+    assert a.required("conf/trivy-secret.yaml", 100, 0o644)
+    assert a.required("/conf/trivy-secret.yaml", 100, 0o644)
     # unrelated file still scanned
     assert a.required("src/app.py", 100, 0o644)

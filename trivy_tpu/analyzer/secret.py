@@ -67,15 +67,14 @@ class SecretAnalyzer(BatchAnalyzer):
     def _build_config_skip_paths(config_path: str) -> frozenset[str]:
         """Forms of the secret-config path to exclude from scanning.
 
-        Reference parity: basename match (secret.go:138).  Additionally the
-        configured path itself (normalized, and with the leading-/ form
-        image-extracted paths carry) so the config file is skipped wherever
-        it sits in the scan tree.
+        Reference parity: the reference skips exactly the scanned file whose
+        path equals filepath.Base(configPath) (secret.go:138) — nothing
+        else.  A scan-tree file that merely sits at the configured path is
+        still scanned, matching the reference.
         """
         if not config_path:
             return frozenset()
-        norm = os.path.normpath(config_path).replace(os.sep, "/")
-        return frozenset({os.path.basename(config_path), norm, "/" + norm})
+        return frozenset({os.path.basename(config_path)})
 
     @property
     def engine(self):

@@ -78,16 +78,21 @@ class SieveStats:
 class TpuSecretEngine:
     """Drop-in engine with the oracle's Scan semantics, device-accelerated."""
 
+    DEFAULT_MAX_BATCH_TILES = 4096
+
     def __init__(
         self,
         ruleset: RuleSet | None = None,
         config: SecretConfig | None = None,
         tile_len: int = DEFAULT_TILE_LEN,
         mesh=None,
-        max_batch_tiles: int = 4096,
+        max_batch_tiles: int | None = None,
         sieve: str = "gram",
         kernel: str = "auto",
     ):
+        self._max_tiles_explicit = max_batch_tiles is not None
+        if max_batch_tiles is None:
+            max_batch_tiles = self.DEFAULT_MAX_BATCH_TILES
         self.ruleset = ruleset if ruleset is not None else build_ruleset(config)
         self.oracle = OracleScanner(self.ruleset)
         self.pset: ProbeSet = build_probe_set(self.ruleset.rules)
@@ -131,13 +136,28 @@ class TpuSecretEngine:
                 kernel == "auto" and mesh is None and on_tpu
             )
             if use_pallas:
+                if kernel == "pallas" and mesh is not None:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "kernel='pallas' ignores the mesh and runs "
+                        "single-device; use kernel='auto' with a mesh for "
+                        "the sharded sieve"
+                    )
                 # Pallas kernel (single-chip production path): gram constants
                 # baked into the program, ~10x the XLA formulation.
                 from trivy_tpu.ops.gram_sieve_pallas import PallasGramSieve
 
                 self._sieve_fn = PallasGramSieve(self.gset.masks, self.gset.vals)
                 self._tile_buckets = TILE_BUCKETS_PALLAS
-                if self.max_batch_tiles < self._tile_buckets[-1]:
+                if (
+                    not self._max_tiles_explicit
+                    and self.max_batch_tiles < self._tile_buckets[-1]
+                ):
+                    # Default cap tuned for the XLA path; the Pallas path
+                    # amortizes per-call link latency with bigger batches.
+                    # An explicit caller cap (memory bound) is respected:
+                    # buckets are min-capped in _buckets().
                     self.max_batch_tiles = self._tile_buckets[-1]
             else:
                 masks, vals = gs_mod.pad_grams(self.gset.masks, self.gset.vals)

@@ -136,19 +136,22 @@ _DUP_SUFFIX_RE = re.compile(rf"{_DUP_SEP}\d+$")
 
 
 def base_group_name(name: str) -> str:
-    """Original Go group name for a (possibly deduplicated) Python group name.
+    """Heuristic original Go group name for a deduplicated Python group name.
 
-    Go RE2 permits several groups with the same name in one pattern
-    (e.g. the multiple-secret-groups fixture, scanner_test.go); Python `re`
-    forbids redefinition, so the translator renames repeats to
-    ``name__dupN``.  Only that exact numeric suffix is stripped, so a
-    user-authored group literally named e.g. ``secret__dupe`` is untouched.
+    Prefer the explicit rename map from :func:`translate` — this suffix
+    stripping cannot distinguish a renamed repeat from a user-authored group
+    literally named e.g. ``secret__dup2``.  Kept for callers without access
+    to the translation's rename map.
     """
     return _DUP_SUFFIX_RE.sub("", name)
 
 
 def _translate(
-    s: str, i: int, flags: frozenset[str], seen_names: dict[str, int]
+    s: str,
+    i: int,
+    flags: frozenset[str],
+    seen_names: dict[str, int],
+    renames: dict[str, str],
 ) -> tuple[str, int]:
     """Translate until an unmatched ')' (not consumed) or end of string."""
     out: list[str] = []
@@ -190,11 +193,11 @@ def _translate(
                 prefix = _flag_group_prefix(set_f, clear_f)
                 if s[j] == ")":
                     # Scoped to remainder of the enclosing group: wrap the rest.
-                    rest, k = _translate(s, j + 1, new_flags, seen_names)
+                    rest, k = _translate(s, j + 1, new_flags, seen_names, renames)
                     out.append(prefix + rest + ")")
                     return "".join(out), k
                 # "(?flags: ... )" group
-                body, k = _translate(s, j + 1, new_flags, seen_names)
+                body, k = _translate(s, j + 1, new_flags, seen_names, renames)
                 if k >= len(s) or s[k] != ")":
                     raise GoRegexError("unterminated group")
                 out.append(prefix + body + ")")
@@ -209,7 +212,16 @@ def _translate(
                 n = seen_names.get(name, 0)
                 seen_names[name] = n + 1
                 if n:
-                    name = f"{name}{_DUP_SEP}{n}"
+                    # Go RE2 allows duplicate group names; Python re does
+                    # not.  Pick an unused dedup name (a user-authored group
+                    # may already occupy name__dupN) and record the rename.
+                    cand = f"{name}{_DUP_SEP}{n}"
+                    while cand in seen_names:
+                        n += 1
+                        cand = f"{name}{_DUP_SEP}{n}"
+                    seen_names[cand] = 1
+                    renames[cand] = name
+                    name = cand
                 prefix, body_start = f"(?P<{name}>", end + 1
             elif s.startswith("(?<", i) or s.startswith("(?'", i):
                 raise GoRegexError("unsupported group syntax")
@@ -217,7 +229,7 @@ def _translate(
                 raise GoRegexError("lookaround/backreference not in RE2")
             else:
                 prefix, body_start = "(", i + 1
-            body, k = _translate(s, body_start, flags, seen_names)
+            body, k = _translate(s, body_start, flags, seen_names, renames)
             if k >= len(s) or s[k] != ")":
                 raise GoRegexError("unterminated group")
             out.append(prefix + body + ")")
@@ -228,17 +240,36 @@ def _translate(
     return "".join(out), i
 
 
-def go_to_python(pattern: str) -> str:
-    """Translate a Go RE2 pattern into an equivalent Python re pattern (str form)."""
-    text, i = _translate(pattern, 0, frozenset(), {})
+def translate(pattern: str) -> tuple[str, dict[str, str]]:
+    """Translate a Go RE2 pattern; returns (python pattern, rename map).
+
+    The rename map sends each deduplicated Python group name back to its
+    original Go name (duplicate names are legal in RE2, illegal in `re`);
+    user-authored names are never entries in the map.
+    """
+    renames: dict[str, str] = {}
+    text, i = _translate(pattern, 0, frozenset(), {}, renames)
     if i != len(pattern):
         raise GoRegexError(f"unbalanced ')' at {i} in {pattern!r}")
-    return text
+    return text, renames
+
+
+def go_to_python(pattern: str) -> str:
+    """Translate a Go RE2 pattern into an equivalent Python re pattern (str form)."""
+    return translate(pattern)[0]
 
 
 def compile_bytes(pattern: str) -> re.Pattern[bytes]:
     """Compile a Go RE2 pattern for matching over bytes content."""
     return re.compile(go_to_python(pattern).encode("utf-8"))
+
+
+def compile_bytes_renamed(
+    pattern: str,
+) -> tuple[re.Pattern[bytes], dict[str, str]]:
+    """compile_bytes plus the duplicate-group rename map (see translate)."""
+    text, renames = translate(pattern)
+    return re.compile(text.encode("utf-8")), renames
 
 
 def compile_str(pattern: str) -> re.Pattern[str]:

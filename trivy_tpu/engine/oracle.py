@@ -22,7 +22,6 @@ from __future__ import annotations
 import re
 from dataclasses import dataclass
 
-from trivy_tpu.engine.goregex import base_group_name
 from trivy_tpu.ftypes import Code, Line, Secret, SecretFinding
 from trivy_tpu.rules.model import (
     ExcludeBlock,
@@ -102,12 +101,14 @@ class OracleScanner:
                 continue
             # getMatchSubgroupsLocations (scanner.go:150-163): spans of every
             # group whose name equals SecretGroupName.  Go allows duplicate
-            # group names; the translator renames repeats (base_group_name).
+            # group names; the translator renames repeats and records the
+            # renames (goregex.translate), which Rule.original_group_name
+            # consults so user-authored lookalike names are never stripped.
             # Deliberate divergence: a group that did not participate in the
             # match (span -1) is skipped — the reference appends Location{-1,-1}
             # and would panic slicing it (latent bug, unreachable via builtins).
             for name in rule.regex.groupindex:
-                if base_group_name(name) == rule.secret_group_name:
+                if rule.original_group_name(name) == rule.secret_group_name:
                     if m.start(name) < 0:
                         continue
                     out.append(Location(m.start(name), m.end(name)))

@@ -58,6 +58,9 @@ class Rule:
     secret_group_name: str = ""
     regex_src: str = ""
     path_src: str = ""
+    # Python->Go group-name rename map from goregex.translate; None means
+    # "unknown" (precompiled regex), {} means "no renames were needed".
+    group_renames: dict[str, str] | None = None
 
     # ---- Matching helpers (scanner.go:165-189) ----
 
@@ -78,6 +81,19 @@ class Rule:
 
     def allow(self, match: bytes) -> bool:
         return allow_rules_allow(self.allow_rules, match)
+
+    def original_group_name(self, name: str) -> str:
+        """Go group name for a Python group name of this rule's regex.
+
+        Uses the translator's explicit rename map (duplicate Go group names
+        are renamed for Python `re`, recorded at parse time); a user-authored
+        name that merely looks like a dedup name (e.g. ``secret__dup2``)
+        maps to itself.  Rules built with a precompiled regex and no rename
+        map fall back to the suffix heuristic.
+        """
+        if self.group_renames is None:
+            return goregex.base_group_name(name)
+        return self.group_renames.get(name, name)
 
 
 def allow_rules_allow_path(rules: list[AllowRule], path: str) -> bool:
@@ -156,13 +172,17 @@ def _parse_exclude_block(d: dict | None) -> ExcludeBlock:
 
 
 def _parse_rule(d: dict) -> Rule:
+    regex, renames = (
+        goregex.compile_bytes_renamed(d["regex"]) if d.get("regex") else (None, {})
+    )
     return Rule(
         id=d.get("id", ""),
         category=d.get("category", ""),
         title=d.get("title", ""),
         severity=d.get("severity", ""),
-        regex=_compile_bytes(d["regex"]) if d.get("regex") else None,
+        regex=regex,
         regex_src=d.get("regex", ""),
+        group_renames=renames,
         keywords=list(d.get("keywords") or []),
         path=_compile_str(d["path"]) if d.get("path") else None,
         path_src=d.get("path", ""),

@@ -62,18 +62,25 @@ class DenseBatch:
     def file_hits(self, row_hits: np.ndarray) -> np.ndarray:
         """OR row-level hit bitmaps [T, W] into per-file bitmaps [F, W].
 
-        Vectorized via bitwise_or.reduceat over the (monotonic) file row
-        starts: segment i covers [lo_i, lo_{i+1}), which is the file's rows
-        except possibly its last (shared-seam) row — OR'd in explicitly.
+        Exactly ORs rows [lo_i, hi_i] per file: reduceat runs over
+        interleaved (lo_i, hi_i+1) boundaries (with a zero sentinel row so
+        hi+1 may reach nrows) and keeps the even segments.  End-bounding
+        means rows past a file's hi — trailing padding included — never
+        contribute, with no reliance on padding-can't-hit invariants.
         """
         if self.num_files == 0:
             return np.zeros((0, row_hits.shape[1]), dtype=row_hits.dtype)
         nrows = len(row_hits)
-        lo = np.minimum(self.file_row_lo, nrows - 1)
+        lo = np.minimum(self.file_row_lo, nrows - 1).astype(np.int64)
         hi = self.file_row_hi
         valid = hi >= self.file_row_lo
-        seg = np.bitwise_or.reduceat(row_hits, lo, axis=0)
-        out = seg | row_hits[np.clip(hi, 0, nrows - 1)]
+        padded = np.concatenate(
+            [row_hits, np.zeros((1, row_hits.shape[1]), row_hits.dtype)]
+        )
+        idx = np.empty(2 * self.num_files, dtype=np.int64)
+        idx[0::2] = lo
+        idx[1::2] = np.clip(hi, 0, nrows - 1) + 1
+        out = np.bitwise_or.reduceat(padded, idx, axis=0)[0::2]
         out[~valid] = 0
         return out
 
