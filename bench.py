@@ -55,14 +55,15 @@ def make_corpus(n_files: int, file_len: int) -> list[tuple[str, bytes]]:
 
 
 def bench_primary() -> dict:
-    from trivy_tpu.engine.device import SieveStats, TpuSecretEngine
+    from trivy_tpu.engine.device import SieveStats
+    from trivy_tpu.engine.hybrid import make_secret_engine
     from trivy_tpu.engine.oracle import OracleScanner
 
     corpus = make_corpus(N_FILES, FILE_LEN)
     total_bytes = sum(len(c) for _, c in corpus)
 
-    engine = TpuSecretEngine()
-    engine.warmup()  # compile all row-bucket shapes outside the timed region
+    engine = make_secret_engine(backend=os.environ.get("BENCH_BACKEND", "auto"))
+    engine.warmup()  # build/compile outside the timed region
 
     # Best of 3: the device link (and any shared TPU frontend) has high
     # variance; steady-state throughput is the meaningful number.
@@ -127,7 +128,12 @@ def bench_rule_scaling(n_rules: int = 500, n_files: int = 10000) -> dict:
             planted += 1
         out.append((p, c))
 
-    engine = TpuSecretEngine(ruleset=RuleSet(rules=rules, allow_rules=[]))
+    from trivy_tpu.engine.hybrid import make_secret_engine
+
+    engine = make_secret_engine(
+        ruleset=RuleSet(rules=rules, allow_rules=[]),
+        backend=os.environ.get("BENCH_BACKEND", "auto"),
+    )
     engine.warmup()
     best = float("inf")
     for _ in range(2):

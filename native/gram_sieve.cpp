@@ -24,6 +24,11 @@
 #include <cstring>
 #include <vector>
 
+#if defined(__AVX512F__) && defined(__AVX512BW__)
+#include <immintrin.h>
+#define TRIVY_TPU_AVX512 1
+#endif
+
 namespace {
 
 constexpr uint32_t kHashMul = 2654435761u;  // Knuth multiplicative
@@ -155,9 +160,308 @@ void gram_sieve(const uint8_t* rows, int64_t T, int64_t L,
     }
 }
 
-// Keyword prefilter helper: case-insensitive memmem over a haystack.
-// Returns 1 when needle (already lower-case) occurs in haystack after
-// case folding.  Used by the CPU oracle's keyword gate on large files.
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Shared per-file scan driver (used by gram_sieve_files and gram_sieve_scan).
+//
+// Grams arrive NORMALIZED (leading masked bytes stripped so byte 0 of every
+// gram is kept; engine/hybrid.py normalizes and keeps the permutation) and
+// sorted by (mask, value) so mask groups are contiguous.
+//
+// Screen: a 2^18-bit bloom over the folded byte triple (bytes 0-2 of the
+// window) — text pairs like "ke"/"se" are common, full keyword triples are
+// not (measured: pair screen passes ~28% on source text, the tri screen
+// ~5%).  Masked-out positions admit every byte value.  The AVX-512 path
+// tests 16 overlapping windows per iteration with a gather from the
+// 32KB L1-resident table; the scalar path adds a 64K-bit pair pre-screen
+// (cheaper than the tri hash when testing one position at a time).
+//
+// Dedup: keyword occurrences repeat the same 4-byte window dozens of times
+// per file; a 64-entry direct-mapped seen-set (stamped with the file
+// ordinal) and a 4-entry vectorized `recent` filter drop re-resolutions.
+// Both reset when attribution crosses a file boundary.
+//
+// Attribution is exactly per file: file_starts are monotonic positions in
+// the joined stream (files separated by >= 4 zero bytes so no window spans
+// two files; kept gram bytes exclude 0x00, so gap/padding cannot fire).
+//
+// OnGram(file, gram_index) fires once per (file, distinct window) per
+// matching gram; OnFileClose(file) fires when attribution leaves a file
+// (and for the final file before returning).
+
+namespace {
+
+constexpr int kTriBits = 18;
+
+std::vector<uint64_t> build_tri_screen(const uint32_t* masks,
+                                       const uint32_t* vals, int32_t G) {
+    std::vector<uint64_t> tri_bits((1u << kTriBits) / 64, 0);
+    for (int32_t g = 0; g < G; ++g) {
+        const uint32_t b0 = vals[g] & 0xFFu;  // byte 0 always kept
+        const bool k1 = (masks[g] >> 8 & 0xFFu) == 0xFFu;
+        const bool k2 = (masks[g] >> 16 & 0xFFu) == 0xFFu;
+        const uint32_t v1 = vals[g] >> 8 & 0xFFu, v2 = vals[g] >> 16 & 0xFFu;
+        for (uint32_t b1 = k1 ? v1 : 0; b1 < (k1 ? v1 + 1 : 256); ++b1) {
+            for (uint32_t b2 = k2 ? v2 : 0; b2 < (k2 ? v2 + 1 : 256); ++b2) {
+                const uint32_t t = b0 | (b1 << 8) | (b2 << 16);
+                const uint32_t h = (t * kHashMul) >> (32 - kTriBits);
+                tri_bits[h >> 6] |= 1ull << (h & 63);
+            }
+        }
+    }
+    return tri_bits;
+}
+
+template <class OnGram, class OnFileClose>
+void scan_files_impl(const uint8_t* stream, int64_t n,
+                     const int64_t* file_starts, int32_t F,
+                     const uint32_t* masks, const uint32_t* vals, int32_t G,
+                     OnGram&& on_gram, OnFileClose&& on_close) {
+    if (n < 4 || G <= 0 || F <= 0) return;
+    std::vector<MaskGroup> groups = build_groups(masks, vals, G);
+    const MaskGroup* gp = groups.data();
+    const size_t ngroups = groups.size();
+    std::vector<uint64_t> tri_bits = build_tri_screen(masks, vals, G);
+    const uint64_t* tb = tri_bits.data();
+
+    int32_t cur = 0;
+    int64_t next_start = F > 1 ? file_starts[1] : INT64_MAX;
+    uint32_t recent[4] = {0xFFFFFFFFu, 0xFFFFFFFFu, 0xFFFFFFFFu, 0xFFFFFFFFu};
+    int recent_at = 0;
+    uint32_t seen_w[64];
+    int32_t seen_file[64];
+    for (int k = 0; k < 64; ++k) seen_file[k] = -1;
+    auto resolve = [&](int64_t i, uint32_t w) {
+        const int32_t prev = cur;
+        while (cur + 1 < F && i >= file_starts[cur + 1]) ++cur;
+        if (cur != prev) {
+            on_close(prev);
+            next_start = cur + 1 < F ? file_starts[cur + 1] : INT64_MAX;
+            recent[0] = recent[1] = recent[2] = recent[3] = 0xFFFFFFFFu;
+        } else {
+            const uint32_t si0 = (w * kHashMul) >> 26;
+            if (seen_file[si0] == cur && seen_w[si0] == w) return;
+        }
+        const uint32_t si = (w * kHashMul) >> 26;
+        seen_w[si] = w;
+        seen_file[si] = cur;
+        recent[recent_at] = w;
+        recent_at = (recent_at + 1) & 3;
+        // Exact resolution: binary search in each mask group's sorted value
+        // range (duplicate (mask, val) grams from different probes share a
+        // run).
+        for (size_t k = 0; k < ngroups; ++k) {
+            const uint32_t x = w & gp[k].mask;
+            int32_t lo = gp[k].start, hi = gp[k].end;
+            while (lo < hi) {
+                const int32_t mid = (lo + hi) >> 1;
+                if (vals[mid] < x) lo = mid + 1; else hi = mid;
+            }
+            for (int32_t g = lo; g < gp[k].end && vals[g] == x; ++g)
+                on_gram(cur, g);
+        }
+    };
+
+#ifdef TRIVY_TPU_AVX512
+    // Reused scratch: a fresh buffer per call would pay ~n bytes of page
+    // faults (the sieve is called once per ~32MB chunk).
+    static thread_local std::vector<uint8_t> folded;
+    if ((int64_t)folded.size() < n) folded.resize(n);
+    {
+        const __m512i vA = _mm512_set1_epi8('A');
+        const __m512i v26 = _mm512_set1_epi8(26);
+        const __m512i v32 = _mm512_set1_epi8(32);
+        int64_t i = 0;
+        for (; i + 64 <= n; i += 64) {
+            const __m512i v = _mm512_loadu_si512(stream + i);
+            const __mmask64 up = _mm512_cmplt_epu8_mask(
+                _mm512_sub_epi8(v, vA), v26);
+            _mm512_storeu_si512(folded.data() + i,
+                                _mm512_mask_add_epi8(v, up, v, v32));
+        }
+        for (; i < n; ++i) {
+            uint8_t b = stream[i];
+            folded[i] = b + ((uint8_t)((uint8_t)(b - 'A') < 26) << 5);
+        }
+    }
+    const uint8_t* fp = folded.data();
+    const __m512i vmul = _mm512_set1_epi32((int32_t)kHashMul);
+    const __m512i vtri = _mm512_set1_epi32(0xFFFFFF);
+    const __m512i v31 = _mm512_set1_epi32(31);
+    int64_t i = 0;
+    for (; i + 19 < n; i += 16) {
+        const __m512i b0 = _mm512_cvtepu8_epi32(_mm_loadu_si128((const __m128i*)(fp + i)));
+        const __m512i b1 = _mm512_cvtepu8_epi32(_mm_loadu_si128((const __m128i*)(fp + i + 1)));
+        const __m512i b2 = _mm512_cvtepu8_epi32(_mm_loadu_si128((const __m128i*)(fp + i + 2)));
+        const __m512i b3 = _mm512_cvtepu8_epi32(_mm_loadu_si128((const __m128i*)(fp + i + 3)));
+        const __m512i w = _mm512_or_si512(
+            _mm512_or_si512(b0, _mm512_slli_epi32(b1, 8)),
+            _mm512_or_si512(_mm512_slli_epi32(b2, 16),
+                            _mm512_slli_epi32(b3, 24)));
+        const __m512i h = _mm512_srli_epi32(
+            _mm512_mullo_epi32(_mm512_and_si512(w, vtri), vmul), 32 - kTriBits);
+        const __m512i word = _mm512_i32gather_epi32(
+            _mm512_srli_epi32(h, 5), tb, 4);
+        const __m512i bit = _mm512_srlv_epi32(word, _mm512_and_si512(h, v31));
+        __mmask16 m = _mm512_test_epi32_mask(bit, _mm512_set1_epi32(1));
+        if (!m) continue;
+        if (i + 19 < next_start) {
+            // Whole block inside the current file: lanes repeating a
+            // recently resolved window are pure re-resolution — drop them
+            // vectorized (the dominant case: keyword runs).  Not applied
+            // across file boundaries, where attribution must restart.
+            m &= ~_mm512_cmpeq_epi32_mask(w, _mm512_set1_epi32((int32_t)recent[0]));
+            m &= ~_mm512_cmpeq_epi32_mask(w, _mm512_set1_epi32((int32_t)recent[1]));
+            m &= ~_mm512_cmpeq_epi32_mask(w, _mm512_set1_epi32((int32_t)recent[2]));
+            m &= ~_mm512_cmpeq_epi32_mask(w, _mm512_set1_epi32((int32_t)recent[3]));
+            if (!m) continue;
+        }
+        uint32_t wv[16];
+        _mm512_storeu_si512(wv, w);
+        while (m) {
+            const int k = __builtin_ctz(m);
+            m &= m - 1;
+            resolve(i + k, wv[k]);
+        }
+    }
+    // Scalar tail (shares resolve/cur state; anchors stay in order).
+    for (; i + 3 < n; ++i) {
+        uint32_t w = (uint32_t)fp[i] | ((uint32_t)fp[i + 1] << 8) |
+                     ((uint32_t)fp[i + 2] << 16) | ((uint32_t)fp[i + 3] << 24);
+        const uint32_t h = ((w & 0xFFFFFFu) * kHashMul) >> (32 - kTriBits);
+        if (!((tb[h >> 6] >> (h & 63)) & 1u)) continue;
+        resolve(i, w);
+    }
+#else
+    // Scalar path: rolling folded window with a cheap 64K-bit pair
+    // pre-screen before the tri probe.
+    std::vector<uint64_t> pair_bits((1u << 16) / 64, 0);
+    for (int32_t g = 0; g < G; ++g) {
+        const uint32_t b0 = vals[g] & 0xFFu;
+        if ((masks[g] >> 8 & 0xFFu) == 0xFFu) {
+            const uint32_t p = b0 | (vals[g] & 0xFF00u);
+            pair_bits[p >> 6] |= 1ull << (p & 63);
+        } else {
+            for (uint32_t b1 = 0; b1 < 256; ++b1) {
+                const uint32_t p = b0 | (b1 << 8);
+                pair_bits[p >> 6] |= 1ull << (p & 63);
+            }
+        }
+    }
+    const uint64_t* pb = pair_bits.data();
+    uint32_t w = 0;
+    for (int k = 0; k < 3; ++k) {
+        uint8_t b = stream[k];
+        b += (uint8_t)((uint8_t)(b - 'A') < 26) << 5;
+        w |= (uint32_t)b << (8 * (k + 1));
+    }
+    for (int64_t i = 0; i + 3 < n; ++i) {
+        uint8_t b = stream[i + 3];
+        b += (uint8_t)((uint8_t)(b - 'A') < 26) << 5;
+        w = (w >> 8) | ((uint32_t)b << 24);
+        const uint32_t pair = w & 0xFFFFu;
+        if (!((pb[pair >> 6] >> (pair & 63)) & 1u)) continue;
+        const uint32_t h = ((w & 0xFFFFFFu) * kHashMul) >> (32 - kTriBits);
+        if (!((tb[h >> 6] >> (h & 63)) & 1u)) continue;
+        resolve(i, w);
+    }
+#endif
+    on_close(cur);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Per-file sieve: [F, G] byte matrix of gram hits (diagnostics and the
+// NumPy-parity tests; the production path is gram_sieve_scan below).
+void gram_sieve_files(const uint8_t* stream, int64_t n,
+                      const int64_t* file_starts, int32_t F,
+                      const uint32_t* masks, const uint32_t* vals,
+                      int32_t G, uint8_t* out) {
+    scan_files_impl(
+        stream, n, file_starts, F, masks, vals, G,
+        [&](int32_t f, int32_t g) { out[(size_t)f * G + g] = 1; },
+        [](int32_t) {});
+}
+
+// Fused scan: sieve + per-file candidate-rule resolution in one pass.
+//
+// Emits (file, rule) candidate pairs directly instead of a [F, G] hit
+// matrix: per-file gram hits feed window -> probe -> gate/conjunct
+// resolution at file-close time (engine/probes.py semantics: candidate =
+// (no gates OR any gate probe hit) AND every anchor conjunct has a probe
+// hit; probes without grams count as always-hit).  Resolution is ~1e3
+// simple ops per hit-file — the Python/NumPy equivalent was the second
+// largest host phase at 100k files.
+//
+// Tables (all in the caller's normalized-sorted gram order):
+//   gram_window [G]      owning window id per gram
+//   window_probe [W]     owning probe id per window
+//   probe_n_windows [P]  windows per probe (0 = gramless = always-hit)
+//   gate_ptr [R+1] / gate_probes        CSR: per-rule gate probe ids
+//   rule_conj_ptr [R+1] / conj_ptr [NC+1] / conj_probes   nested CSR:
+//       per-rule conjuncts, each an OR-list of probe ids
+//
+// Returns the number of pairs found; writes at most `cap` pairs to
+// out_pairs as (file, rule) int32 couples.  A return > cap means the caller
+// must retry with a larger buffer.
+int64_t gram_sieve_scan(const uint8_t* stream, int64_t n,
+                        const int64_t* file_starts, int32_t F,
+                        const uint32_t* masks, const uint32_t* vals, int32_t G,
+                        const int32_t* gram_window, int32_t W,
+                        const int32_t* window_probe,
+                        const int32_t* probe_n_windows, int32_t P,
+                        const int32_t* gate_ptr, const int32_t* gate_probes,
+                        const int32_t* rule_conj_ptr, const int32_t* conj_ptr,
+                        const int32_t* conj_probes, int32_t R,
+                        int32_t* out_pairs, int64_t cap) {
+    std::vector<uint8_t> win_hit(W, 0);
+    std::vector<uint8_t> probe_hit(P, 0);
+    std::vector<int32_t> cnt(P, 0);
+    bool any_hit = false;
+    int64_t found = 0;
+
+    auto on_gram = [&](int32_t, int32_t g) {
+        win_hit[gram_window[g]] = 1;
+        any_hit = true;
+    };
+    auto on_close = [&](int32_t f) {
+        if (!any_hit) return;
+        any_hit = false;
+        memset(cnt.data(), 0, (size_t)P * 4);
+        for (int32_t w2 = 0; w2 < W; ++w2)
+            if (win_hit[w2]) ++cnt[window_probe[w2]];
+        memset(win_hit.data(), 0, (size_t)W);
+        for (int32_t p = 0; p < P; ++p)
+            probe_hit[p] = cnt[p] == probe_n_windows[p];
+        for (int32_t r = 0; r < R; ++r) {
+            bool ok = gate_ptr[r] == gate_ptr[r + 1];
+            for (int32_t k = gate_ptr[r]; !ok && k < gate_ptr[r + 1]; ++k)
+                ok = probe_hit[gate_probes[k]];
+            if (!ok) continue;
+            for (int32_t c = rule_conj_ptr[r];
+                 ok && c < rule_conj_ptr[r + 1]; ++c) {
+                bool chit = false;
+                for (int32_t k = conj_ptr[c]; !chit && k < conj_ptr[c + 1]; ++k)
+                    chit = probe_hit[conj_probes[k]];
+                ok = chit;
+            }
+            if (!ok) continue;
+            if (found < cap) {
+                out_pairs[found * 2] = f;
+                out_pairs[found * 2 + 1] = r;
+            }
+            ++found;
+        }
+    };
+
+    scan_files_impl(stream, n, file_starts, F, masks, vals, G, on_gram,
+                    on_close);
+    return found;
+}
+
 int32_t contains_folded(const uint8_t* hay, int64_t n, const uint8_t* needle,
                         int64_t m) {
     if (m == 0) return 1;

@@ -100,6 +100,29 @@ def test_device_engine_matches_reference_goldens(case):
     _assert_findings(result, case)
 
 
+_HYBRID_ENGINES: dict = {}
+
+
+def _hybrid_engine(config_name: str):
+    from trivy_tpu.engine.hybrid import HybridSecretEngine
+
+    if config_name not in _HYBRID_ENGINES:
+        _HYBRID_ENGINES[config_name] = HybridSecretEngine(
+            ruleset=_ruleset(config_name)
+        )
+    return _HYBRID_ENGINES[config_name]
+
+
+@pytest.mark.parametrize(
+    "case", CASES, ids=[f"{c['name']}::{c['config']}" for c in CASES]
+)
+def test_hybrid_engine_matches_reference_goldens(case):
+    content = _read_fixture(case["input"])
+    engine = _hybrid_engine(case["config"])
+    [result] = engine.scan_batch([("testdata/" + case["input"], content)])
+    _assert_findings(result, case)
+
+
 def test_builtin_corpus_counts():
     """86 builtin rules + 12 builtin allow rules (builtin-rules.go:95-823,
     builtin-allow-rules.go:5-61)."""

@@ -56,7 +56,7 @@ class SecretAnalyzer(BatchAnalyzer):
         self._engine = None
         self._config_path = ""
         self._config_skip_paths: frozenset[str] = frozenset()
-        self._backend = "tpu"
+        self._backend = "auto"
 
     def init(self, options: AnalyzerOptions) -> None:
         self._config_path = options.secret_scanner_option.config_path
@@ -88,6 +88,12 @@ class SecretAnalyzer(BatchAnalyzer):
                 from trivy_tpu.engine.device import TpuSecretEngine
 
                 self._engine = TpuSecretEngine(config=config, sieve="native")
+            elif self._backend in ("auto", "hybrid"):
+                from trivy_tpu.engine.hybrid import make_secret_engine
+
+                self._engine = make_secret_engine(
+                    config=config, backend=self._backend
+                )
             else:
                 from trivy_tpu.engine.device import TpuSecretEngine
 

@@ -51,9 +51,11 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
     )
     p.add_argument(
         "--secret-backend",
-        choices=["tpu", "cpu", "native"],
-        default=_env_default("secret-backend", "tpu"),
-        help="tpu = device sieve engine, native = C++ host sieve, "
+        choices=["auto", "hybrid", "tpu", "cpu", "native"],
+        default=_env_default("secret-backend", "auto"),
+        help="auto = hybrid when the native sieve builds else device engine, "
+        "hybrid = C++ host pre-sieve + confirm, tpu = device sieve engine, "
+        "native = C++ host sieve via the device engine flow, "
         "cpu = oracle engine",
     )
     p.add_argument("--ignorefile", default=_env_default("ignorefile", ".trivyignore"))

@@ -59,20 +59,26 @@ class SieveStats:
     candidate_pairs: int = 0
     confirmed_findings: int = 0
     # Wall-clock per phase (seconds), accumulated across scan_batch calls:
-    # host pack, device sieve (dispatch+execute+fetch), per-file OR +
-    # gram->probe->rule candidate resolution, exact host confirm.
+    # host pack, sieve (device dispatch+execute+fetch, or native host scan),
+    # gram->probe->rule candidate resolution, optional device NFA verify,
+    # exact host confirm.  Overlapped pipelines (engine/hybrid.py) make the
+    # sum exceed wall-clock — that is the point.
     pack_s: float = 0.0
     sieve_s: float = 0.0
     candidate_s: float = 0.0
+    verify_s: float = 0.0
     confirm_s: float = 0.0
 
     def phases(self) -> dict:
-        return {
+        out = {
             "pack_s": round(self.pack_s, 4),
             "sieve_s": round(self.sieve_s, 4),
             "candidate_s": round(self.candidate_s, 4),
             "confirm_s": round(self.confirm_s, 4),
         }
+        if self.verify_s:
+            out["verify_s"] = round(self.verify_s, 4)
+        return out
 
 
 class TpuSecretEngine:

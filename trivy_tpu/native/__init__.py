@@ -1,3 +1,7 @@
-from trivy_tpu.native.loader import gram_sieve_native, load_native
+from trivy_tpu.native.loader import (
+    gram_sieve_files_native,
+    gram_sieve_native,
+    load_native,
+)
 
-__all__ = ["gram_sieve_native", "load_native"]
+__all__ = ["gram_sieve_files_native", "gram_sieve_native", "load_native"]

@@ -87,10 +87,63 @@ def load_native() -> ctypes.CDLL | None:
                 ctypes.c_int64,
             ]
             lib.contains_folded.restype = ctypes.c_int32
+            lib.gram_sieve_files.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_int32,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+                ctypes.c_void_p,
+            ]
+            lib.gram_sieve_files.restype = None
+            lib.gram_sieve_scan.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,           # stream
+                ctypes.c_void_p, ctypes.c_int32,           # file_starts, F
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,  # grams
+                ctypes.c_void_p, ctypes.c_int32,           # gram_window, W
+                ctypes.c_void_p,                           # window_probe
+                ctypes.c_void_p, ctypes.c_int32,           # probe_n_windows, P
+                ctypes.c_void_p, ctypes.c_void_p,          # gate CSR
+                ctypes.c_void_p, ctypes.c_void_p,          # conj CSR ptrs
+                ctypes.c_void_p, ctypes.c_int32,           # conj_probes, R
+                ctypes.c_void_p, ctypes.c_int64,           # out_pairs, cap
+            ]
+            lib.gram_sieve_scan.restype = ctypes.c_int64
             _lib = lib
         except OSError:
             _lib_failed = True
     return _lib
+
+
+def gram_sieve_files_native(
+    stream: np.ndarray,
+    file_starts: np.ndarray,
+    num_files: int,
+    masks: np.ndarray,
+    vals: np.ndarray,
+) -> np.ndarray | None:
+    """Joined stream + per-file start offsets -> [F, G] bool gram hits with
+    exact per-file attribution, or None when the native lib is unavailable.
+
+    `masks`/`vals` must be NORMALIZED (byte 0 kept; see
+    engine/hybrid.normalize_grams) and sorted so equal masks are contiguous.
+    The stream must end with >= 4 zero bytes and files must be separated by
+    >= 4 zero bytes.
+    """
+    lib = load_native()
+    if lib is None:
+        return None
+    stream = np.ascontiguousarray(stream, dtype=np.uint8)
+    file_starts = np.ascontiguousarray(file_starts, dtype=np.int64)
+    masks = np.ascontiguousarray(masks, dtype=np.uint32)
+    vals = np.ascontiguousarray(vals, dtype=np.uint32)
+    g = len(masks)
+    out = np.zeros((num_files, g), dtype=np.uint8)
+    lib.gram_sieve_files(
+        stream.ctypes.data, len(stream),
+        file_starts.ctypes.data, num_files,
+        masks.ctypes.data, vals.ctypes.data, g,
+        out.ctypes.data,
+    )
+    return out.astype(bool)
 
 
 def gram_sieve_native(
