@@ -259,7 +259,7 @@ void scan_files_impl(const uint8_t* stream, int64_t n,
                 if (vals[mid] < x) lo = mid + 1; else hi = mid;
             }
             for (int32_t g = lo; g < gp[k].end && vals[g] == x; ++g)
-                on_gram(cur, g);
+                on_gram(cur, g, i);
         }
     };
 
@@ -382,7 +382,7 @@ void gram_sieve_files(const uint8_t* stream, int64_t n,
                       int32_t G, uint8_t* out) {
     scan_files_impl(
         stream, n, file_starts, F, masks, vals, G,
-        [&](int32_t f, int32_t g) { out[(size_t)f * G + g] = 1; },
+        [&](int32_t f, int32_t g, int64_t) { out[(size_t)f * G + g] = 1; },
         [](int32_t) {});
 }
 
@@ -421,11 +421,15 @@ int64_t gram_sieve_scan(const uint8_t* stream, int64_t n,
     std::vector<uint8_t> probe_hit(P, 0);
     std::vector<int32_t> cnt(P, 0);
     bool any_hit = false;
+    int32_t first_hit = 0;  // first gram-hit offset within the open file
     int64_t found = 0;
 
-    auto on_gram = [&](int32_t, int32_t g) {
+    auto on_gram = [&](int32_t f, int32_t g, int64_t pos) {
         win_hit[gram_window[g]] = 1;
-        any_hit = true;
+        if (!any_hit) {
+            any_hit = true;
+            first_hit = (int32_t)(pos - file_starts[f]);
+        }
     };
     auto on_close = [&](int32_t f) {
         if (!any_hit) return;
@@ -450,8 +454,9 @@ int64_t gram_sieve_scan(const uint8_t* stream, int64_t n,
             }
             if (!ok) continue;
             if (found < cap) {
-                out_pairs[found * 2] = f;
-                out_pairs[found * 2 + 1] = r;
+                out_pairs[found * 3] = f;
+                out_pairs[found * 3 + 1] = r;
+                out_pairs[found * 3 + 2] = first_hit;
             }
             ++found;
         }
@@ -460,6 +465,102 @@ int64_t gram_sieve_scan(const uint8_t* stream, int64_t n,
     scan_files_impl(stream, n, file_starts, F, masks, vals, G, on_gram,
                     on_close);
     return found;
+}
+
+// Automaton verification of candidate (file, rule) pairs (engine/redfa.py).
+// mode[r]: 0 = no automaton (stay verified=1, oracle confirms), 1 = search
+// DFA (one class lookup + one transition lookup per byte), 2 = bit-parallel
+// NFA-64 (rules whose subset construction explodes, e.g. counted runs whose
+// alphabet overlaps their prefix: AKIA[A-Z0-9]{16}).  Early exit on the
+// first accepting step.
+void dfa_verify_pairs(const uint8_t* stream, const int64_t* file_starts,
+                      const int64_t* file_lens, const int32_t* pair_file,
+                      const int32_t* pair_rule, const int32_t* pair_hint,
+                      int64_t npairs,
+                      const int32_t* prefix_bound,  // [R]; INT32_MAX = no trim
+                      const uint8_t* mode,          // [R]
+                      const uint8_t* cls_luts,      // [R, 256]
+                      const uint16_t* trans_blob, const int64_t* trans_off,
+                      const uint8_t* accept_blob, const int64_t* accept_off,
+                      const int32_t* n_classes,
+                      const uint64_t* follow_blob, const int64_t* follow_off,
+                      const uint64_t* cmask_blob, const int64_t* cmask_off,
+                      const uint64_t* nfa_first, const uint64_t* nfa_last,
+                      const uint8_t* start_ok,      // [R, 256]: byte can leave
+                      uint8_t* out_verified) {      //   the start state
+    for (int64_t k = 0; k < npairs; ++k) {
+        const int32_t r = pair_rule[k];
+        if (mode[r] == 0) {
+            out_verified[k] = 1;
+            continue;
+        }
+        const uint8_t* lut = cls_luts + (size_t)r * 256;
+        const uint8_t* sok = start_ok + (size_t)r * 256;
+        const int32_t f = pair_file[k];
+        // Sound walk-start trim: any match contains a gram occurrence, and
+        // the file's first gram hit is at pair_hint; a bounded-length rule's
+        // match can start at most prefix_bound before it.
+        int64_t skip = 0;
+        if (pair_hint && prefix_bound[r] != INT32_MAX) {
+            skip = (int64_t)pair_hint[k] - prefix_bound[r];
+            if (skip < 0) skip = 0;
+            if (skip > file_lens[f]) skip = file_lens[f];
+        }
+        const uint8_t* p = stream + file_starts[f] + skip;
+        const uint8_t* end = stream + file_starts[f] + file_lens[f];
+        uint8_t ok = 0;
+        // In the start state, fast-forward to the next byte that can begin
+        // a match (the RE2 memchr trick): on miss-dominated files almost
+        // every byte is skipped at ~1 table load instead of an automaton
+        // step.  The skip run re-engages whenever the automaton falls back
+        // to its start state.
+#define TRIVY_TPU_SKIP_RUN()                                   \
+        do {                                                   \
+            while (p < end && !sok[*p]) ++p;                   \
+        } while (0)
+        if (mode[r] == 1) {
+            const uint16_t* trans = trans_blob + trans_off[r];
+            const uint8_t* accept = accept_blob + accept_off[r];
+            const int32_t c = n_classes[r];
+            uint32_t s = 0;
+            while (p < end) {
+                if (s == 0) {
+                    TRIVY_TPU_SKIP_RUN();
+                    if (p >= end) break;
+                }
+                s = trans[s * c + lut[*p]];
+                ++p;
+                if (accept[s]) {
+                    ok = 1;
+                    break;
+                }
+            }
+        } else {
+            const uint64_t* follow = follow_blob + follow_off[r];
+            const uint64_t* cmask = cmask_blob + cmask_off[r];
+            const uint64_t first = nfa_first[r], last = nfa_last[r];
+            uint64_t s = 0;
+            while (p < end) {
+                if (s == 0) {
+                    TRIVY_TPU_SKIP_RUN();
+                    if (p >= end) break;
+                }
+                uint64_t reach = 0, t = s;
+                while (t) {
+                    reach |= follow[__builtin_ctzll(t)];
+                    t &= t - 1;
+                }
+                s = (reach | first) & cmask[lut[*p]];
+                ++p;
+                if (s & last) {
+                    ok = 1;
+                    break;
+                }
+            }
+        }
+#undef TRIVY_TPU_SKIP_RUN
+        out_verified[k] = ok;
+    }
 }
 
 int32_t contains_folded(const uint8_t* hay, int64_t n, const uint8_t* needle,

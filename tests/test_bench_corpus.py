@@ -1,0 +1,173 @@
+"""Tests for the honest benchmark corpora and the DFA/NFA verify stage."""
+
+import numpy as np
+import pytest
+
+import bench_corpus
+from trivy_tpu.engine.oracle import OracleScanner
+from trivy_tpu.engine.redfa import (
+    MODE_DFA,
+    MODE_NFA,
+    MODE_NONE,
+    DfaVerifier,
+    compile_search_dfa,
+    compile_search_nfa64,
+)
+from trivy_tpu.rules.model import build_ruleset
+
+
+@pytest.fixture(scope="module")
+def ruleset():
+    return build_ruleset(None)
+
+
+def test_every_planted_shape_matches_a_rule():
+    oracle = OracleScanner()
+    rng = np.random.default_rng(5)
+    for kind in range(5):
+        line = bench_corpus.planted_secret(rng, kind)
+        res = oracle.scan("src/app.py", b"x = 1\n" + line + b"y = 2\n")
+        assert len(res.findings) >= 1, (kind, line)
+
+
+def test_corpus_shapes():
+    mono = bench_corpus.make_monorepo_corpus(2000)
+    assert len(mono) == 2000
+    sizes = np.array([len(c) for _, c in mono])
+    assert sizes.min() >= 8  # binaries have an 8-byte ELF header floor
+    assert np.median(sizes) < sizes.mean() < np.percentile(sizes, 99)
+    paths = [p for p, _ in mono]
+    assert any("/vendor/" in p for p in paths)
+    assert any("/tests/" in p for p in paths)
+    assert any(p.endswith(".md") for p in paths)
+    kern = bench_corpus.make_kernel_corpus(500, planted_every=100)
+    assert len(kern) == 500
+    assert all(p.endswith(".c") for p, _ in kern)
+
+
+def test_corpus_is_deterministic():
+    a = bench_corpus.make_monorepo_corpus(300)
+    b = bench_corpus.make_monorepo_corpus(300)
+    assert a == b
+
+
+# ---------------------------------------------------------------------------
+# DFA/NFA verify stage
+# ---------------------------------------------------------------------------
+
+
+def test_every_builtin_rule_gets_an_automaton(ruleset):
+    v = DfaVerifier(ruleset.rules)
+    assert (v.mode != MODE_NONE).all(), [
+        r.id for r, m in zip(ruleset.rules, v.mode) if m == MODE_NONE
+    ]
+    # the subset-construction blowup cases go to the NFA-64 path
+    by_id = {r.id: m for r, m in zip(ruleset.rules, v.mode)}
+    assert by_id["aws-access-key-id"] == MODE_NFA
+    assert by_id["github-pat"] == MODE_DFA
+
+
+@pytest.mark.parametrize(
+    "rid,hit,miss",
+    [
+        ("aws-access-key-id", b"AKIA" + b"Z3" * 8, b"akia" + b"z3" * 8),
+        ("github-pat", b"ghp_" + b"a" * 36, b"ghp_" + b"a" * 10),
+        ("twilio-api-key", b"SK" + b"0af1" * 8, b"task_lock SK then nothing"),
+        (
+            "stripe-secret-token",
+            b"sk_live_" + b"0" * 12,
+            b"task_lock = live0000 sk_dead",
+        ),
+    ],
+)
+def test_automaton_match_existence(ruleset, rid, hit, miss):
+    idx = next(i for i, r in enumerate(ruleset.rules) if r.id == rid)
+    v = DfaVerifier(ruleset.rules)
+    for content, want in ((hit, 1), (miss, 0)):
+        pad = b"int x = 0;\n" + content + b"\nreturn x;\n\x00\x00\x00\x00"
+        stream = np.frombuffer(pad, dtype=np.uint8)
+        out = v.verify_pairs(
+            stream,
+            np.array([0], dtype=np.int64),
+            np.array([len(pad) - 4], dtype=np.int64),
+            np.array([0], dtype=np.int32),
+            np.array([idx], dtype=np.int32),
+        )
+        assert out[0] == want, (rid, content, want)
+
+
+def test_automaton_never_rejects_a_real_match(ruleset):
+    """Differential soundness: on files where the oracle finds something,
+    every finding's rule must be verified by its automaton."""
+    oracle = OracleScanner(ruleset)
+    v = DfaVerifier(ruleset.rules)
+    rng = np.random.default_rng(9)
+    rule_idx = {r.id: i for i, r in enumerate(ruleset.rules)}
+    checked = 0
+    for kind in range(5):
+        body = b"prefix line\n" + bench_corpus.planted_secret(rng, kind) + b"tail\n"
+        res = oracle.scan("f.py", body)
+        pad = body + b"\x00" * 4
+        stream = np.frombuffer(pad, dtype=np.uint8)
+        for f in res.findings:
+            out = v.verify_pairs(
+                stream,
+                np.array([0], dtype=np.int64),
+                np.array([len(body)], dtype=np.int64),
+                np.array([0], dtype=np.int32),
+                np.array([rule_idx[f.rule_id]], dtype=np.int32),
+            )
+            assert out[0] == 1, f.rule_id
+            checked += 1
+    assert checked >= 4
+
+
+def test_trim_not_applied_to_gramless_anchor_rules():
+    """r3 review repro: a rule whose anchor probes carry no grams gets its
+    candidacy from an always-hit probe, so the file's first gram hit says
+    nothing about where the match is — the walk-start trim must not apply,
+    or a match before the first gram hit is silently dropped."""
+    from trivy_tpu.engine.goregex import compile_bytes
+    from trivy_tpu.engine.hybrid import HybridSecretEngine
+    from trivy_tpu.engine.oracle import OracleScanner
+    from trivy_tpu.rules.model import Rule, RuleSet
+
+    rule = Rule(
+        id="custom-gramless",
+        severity="HIGH",
+        regex=compile_bytes(r"[a-z]{6}[0-9]{10}"),
+        regex_src=r"[a-z]{6}[0-9]{10}",
+        keywords=["sessionword"],
+    )
+    rs = RuleSet(rules=[rule], allow_rules=[])
+    eng = HybridSecretEngine(ruleset=rs)
+    oracle = OracleScanner(rs)
+    # match at offset 0, the only gram-able text ('sessionword') at the end
+    content = b"abcdef1234567890\n" + b"x " * 2500 + b"sessionword\n"
+    [got] = eng.scan_batch([("f.txt", content)])
+    want = oracle.scan("f.txt", content)
+    assert len(want.findings) == 1
+    assert [f.to_json() for f in got.findings] == [
+        f.to_json() for f in want.findings
+    ]
+
+
+def test_python_fallback_walk_matches_native(ruleset, monkeypatch):
+    from trivy_tpu import native as native_mod
+
+    v = DfaVerifier(ruleset.rules)
+    body = (
+        b"config AKIA" + b"Q7" * 8 + b" task_lock SKdead ghp_" + b"b" * 36
+        + b"\x00\x00\x00\x00"
+    )
+    stream = np.frombuffer(body, dtype=np.uint8)
+    starts = np.array([0], dtype=np.int64)
+    lens = np.array([len(body) - 4], dtype=np.int64)
+    pf = np.zeros(len(ruleset.rules), dtype=np.int32)
+    pr = np.arange(len(ruleset.rules), dtype=np.int32)
+    native = v.verify_pairs(stream, starts, lens, pf, pr)
+    monkeypatch.setattr(
+        "trivy_tpu.native.loader.load_native", lambda: None
+    )
+    fallback = v.verify_pairs(stream, starts, lens, pf, pr)
+    assert (native == fallback).all()

@@ -87,15 +87,16 @@ class HybridSecretEngine(TpuSecretEngine):
         ruleset=None,
         config=None,
         chunk_bytes: int = DEFAULT_CHUNK_BYTES,
-        verify: str = "host",
+        verify: str = "auto",
         mesh=None,
     ):
         super().__init__(ruleset=ruleset, config=config, sieve="native")
         self.chunk_bytes = chunk_bytes
-        if verify not in ("host", "device"):
+        if verify not in ("auto", "dfa", "none", "device"):
             raise ValueError(f"unknown verify mode: {verify!r}")
         self.verify = verify
         self._nfa_verifier = None
+        self._dfa_verifier = None
         if verify == "device":
             try:
                 from trivy_tpu.engine.nfa_device import NfaVerifier
@@ -104,6 +105,12 @@ class HybridSecretEngine(TpuSecretEngine):
                     "device NFA verify stage is not available"
                 ) from e
             self._nfa_verifier = NfaVerifier(self.ruleset.rules, mesh=mesh)
+        elif verify in ("auto", "dfa"):
+            from trivy_tpu.engine.redfa import DfaVerifier
+
+            self._dfa_verifier = DfaVerifier(
+                self.ruleset.rules, trimmable=self._trimmable_rules()
+            )
         from trivy_tpu.native import load_native
 
         self._native_ok = load_native() is not None
@@ -118,11 +125,11 @@ class HybridSecretEngine(TpuSecretEngine):
         base = self.candidate_matrix_bool(self.gset.probe_hits_bool(zero))[0]
         self._base_cand = np.flatnonzero(base)
         self._allow_path_re = self._build_allow_path_re()
-        # reduceat metadata for the O(F*G) probe resolution: grams grouped by
-        # window (OR within a window), windows grouped by probe (AND across a
-        # probe's windows) — replaces dense [F,G]@[G,W]@[W,P] matmuls.
-        # Used by the hits-matrix fallback path and tests; the production
-        # path resolves candidates inside the fused C++ scan.
+        # reduceat metadata for the O(F*G) probe resolution: grams grouped
+        # by window (OR within a window), windows grouped by probe (AND
+        # across a probe's windows).  Diagnostic-only: the differential test
+        # (tests/test_hybrid_engine.py) re-derives candidates from a hits
+        # matrix through these tables to cross-check the fused C++ scan.
         gw = self.gset.gram_window
         self._gperm = np.argsort(gw, kind="stable")
         sorted_w = gw[self._gperm]
@@ -174,6 +181,19 @@ class HybridSecretEngine(TpuSecretEngine):
 
     # ------------------------------------------------------------------
 
+    def _trimmable_rules(self) -> np.ndarray:
+        """bool[R]: rule has an anchor conjunct whose probes are all
+        gram-backed, so every match contains a gram occurrence and the
+        verify walk may be start-trimmed (see DfaVerifier)."""
+        has_gram = self.gset.probe_has_gram
+        out = np.zeros(len(self.pset.plans), dtype=bool)
+        for i, plan in enumerate(self.pset.plans):
+            out[i] = any(
+                conj and all(has_gram[p] for p in conj)
+                for conj in plan.anchor_conjuncts
+            )
+        return out
+
     def _build_allow_path_re(self) -> re.Pattern[str] | None:
         """Union of the global allow-rule path regexes (scanner.go:200-207)
         for the O(files) fast path; None when any rule lacks a path regex
@@ -208,9 +228,11 @@ class HybridSecretEngine(TpuSecretEngine):
 
     # ------------------------------------------------------------------
 
-    def _sieve_chunk(self, contents: list[bytes]) -> np.ndarray:
-        """Join a chunk and run the fused native scan.  Returns candidate
-        (file, rule) pairs [N, 2] int32, ordered by file then rule."""
+    def _sieve_chunk(self, contents: list[bytes]):
+        """Join a chunk and run the fused native scan.  Returns (pairs,
+        stream, starts, lens): candidate (file, rule) pairs [N, 2] int32
+        ordered by file then rule, plus the joined stream context the DFA
+        verify stage walks."""
         from trivy_tpu.native import load_native
 
         t0 = time.perf_counter()
@@ -229,7 +251,7 @@ class HybridSecretEngine(TpuSecretEngine):
         lib = load_native()
         cap = max(1024, 4 * nfiles)
         while True:
-            out = np.empty((cap, 2), dtype=np.int32)
+            out = np.empty((cap, 3), dtype=np.int32)
             found = lib.gram_sieve_scan(
                 stream.ctypes.data, len(stream),
                 starts.ctypes.data, nfiles,
@@ -247,7 +269,20 @@ class HybridSecretEngine(TpuSecretEngine):
                 break
             cap = int(found) + 64
         self.stats.sieve_s += time.perf_counter() - t0
-        return out[: int(found)]
+
+        pairs = out[: int(found)]
+        if self._dfa_verifier is not None and len(pairs):
+            # Automaton verify in the same worker: the stream is hot in
+            # cache and the walk releases the GIL like the sieve.  The third
+            # pair column is the file's first gram-hit offset — a sound
+            # walk-start trim for bounded-length rules.
+            t0 = time.perf_counter()
+            ok = self._dfa_verifier.verify_pairs(
+                stream, starts, lens, pairs[:, 0], pairs[:, 1], pairs[:, 2]
+            )
+            pairs = pairs[ok.astype(bool)]
+            self.stats.verify_s += time.perf_counter() - t0
+        return pairs[:, :2], stream, starts, lens
 
     def _chunks(self, items: list[tuple[str, bytes]]):
         """Split items into contiguous chunks of ~chunk_bytes."""
@@ -285,7 +320,7 @@ class HybridSecretEngine(TpuSecretEngine):
                     pending.append((lo, hi, fut))
                     si += 1
                 lo, hi, fut = pending.popleft()
-                self._finish_chunk(items, lo, hi, fut.result(), results)
+                self._finish_chunk(items, lo, hi, fut.result()[0], results)
         return results  # type: ignore[return-value]
 
     def _finish_chunk(

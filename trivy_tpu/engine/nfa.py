@@ -66,11 +66,15 @@ class UnionNFA:
 
 
 class _Builder:
-    def __init__(self) -> None:
+    def __init__(self, max_rep: int = MAX_REP_EXPAND) -> None:
         self.pos_bs: list[int] = []  # byte-set per position
         self.follow: list[set[int]] = []
         self.pos_rule: list[int] = []
         self._rule: int = -1
+        # Cap on instantiated copies of a counted repeat; smaller caps widen
+        # the language further (sound for sieves/verifiers) and keep the
+        # position count inside a machine word for bit-parallel simulation.
+        self.max_rep = max_rep
 
     def new_pos(self, bs: int) -> int:
         p = len(self.pos_bs)
@@ -118,9 +122,9 @@ class _Builder:
         return nullable_acc, first_acc, last_acc
 
     def _rep(self, node: Rep) -> tuple[bool, set[int], set[int]]:
-        lo = min(node.min, MAX_REP_EXPAND)
+        lo = min(node.min, self.max_rep)
         hi = node.max
-        if hi is not None and (hi - lo > REP_WIDEN_LIMIT or hi > MAX_REP_EXPAND):
+        if hi is not None and (hi - lo > REP_WIDEN_LIMIT or hi > self.max_rep):
             hi = None  # widen to unbounded (sieve over-approximation)
         if hi is None:
             if lo == 0:

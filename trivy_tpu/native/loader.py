@@ -107,6 +107,21 @@ def load_native() -> ctypes.CDLL | None:
                 ctypes.c_void_p, ctypes.c_int64,           # out_pairs, cap
             ]
             lib.gram_sieve_scan.restype = ctypes.c_int64
+            lib.dfa_verify_pairs.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p,
+            ]
+            lib.dfa_verify_pairs.restype = None
             _lib = lib
         except OSError:
             _lib_failed = True
