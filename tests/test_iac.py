@@ -1,0 +1,484 @@
+"""Tests for the IaC engine: rego evaluator, HCL parser, check corpus."""
+
+import pytest
+
+from trivy_tpu.iac.engine import IacScanner, load_checks
+from trivy_tpu.iac.hcl import parse_hcl, terraform_input
+from trivy_tpu.iac.rego import RegoError, _Evaluator, parse_module
+
+
+# ---------------------------------------------------------------------------
+# rego evaluator
+# ---------------------------------------------------------------------------
+
+
+def _eval_deny(src: str, input_doc):
+    mod = parse_module(src)
+    return _Evaluator(input_doc, mod.rules).eval_set_rule("deny")
+
+
+def test_rego_basic_deny():
+    src = """
+package test
+
+deny[msg] {
+    input.user == "root"
+    msg := "no root"
+}
+"""
+    assert _eval_deny(src, {"user": "root"}) == ["no root"]
+    assert _eval_deny(src, {"user": "app"}) == []
+
+
+def test_rego_wildcard_iteration_and_sprintf():
+    src = """
+package test
+
+deny[msg] {
+    port := input.ports[_]
+    port < 1024
+    msg := sprintf("privileged port %d", [port])
+}
+"""
+    out = _eval_deny(src, {"ports": [80, 8080, 443]})
+    assert sorted(out) == ["privileged port 443", "privileged port 80"]
+
+
+def test_rego_some_in_and_helpers():
+    src = """
+package test
+
+bad_users[u] {
+    u := input.users[_]
+    u.admin == true
+}
+
+deny[msg] {
+    some u in bad_users
+    msg := u.name
+}
+"""
+    doc = {"users": [{"name": "a", "admin": True}, {"name": "b", "admin": False}]}
+    assert _eval_deny(src, doc) == ["a"]
+
+
+def test_rego_not_and_object_get():
+    src = """
+package test
+
+deny[msg] {
+    not object.get(input, "enabled", false) == true
+    msg := "disabled"
+}
+"""
+    assert _eval_deny(src, {}) == ["disabled"]
+    assert _eval_deny(src, {"enabled": True}) == []
+
+
+def test_rego_comprehension_count():
+    src = """
+package test
+
+deny[msg] {
+    n := count([u | u := input.users[_]; u.active])
+    n == 0
+    msg := "no active users"
+}
+"""
+    assert _eval_deny(src, {"users": [{"active": False}]}) == ["no active users"]
+    assert _eval_deny(src, {"users": [{"active": True}]}) == []
+
+
+def test_rego_contains_if_modern_syntax():
+    src = """
+package test
+
+deny contains msg if {
+    input.x > 3
+    msg := "big"
+}
+"""
+    assert _eval_deny(src, {"x": 5}) == ["big"]
+    assert _eval_deny(src, {"x": 1}) == []
+
+
+def test_rego_default_and_complete_rules():
+    src = """
+package test
+
+default limit := 10
+
+threshold := t {
+    t := input.threshold
+}
+
+deny[msg] {
+    input.value > limit
+    msg := "over default limit"
+}
+
+deny[msg] {
+    input.value > threshold
+    msg := "over threshold"
+}
+"""
+    assert _eval_deny(src, {"value": 11}) == ["over default limit"]
+    assert sorted(_eval_deny(src, {"value": 11, "threshold": 5})) == [
+        "over default limit",
+        "over threshold",
+    ]
+
+
+def test_rego_undefined_path_is_unsatisfied_not_error():
+    src = """
+package test
+
+deny[msg] {
+    input.a.b.c == 1
+    msg := "x"
+}
+"""
+    assert _eval_deny(src, {}) == []
+
+
+def test_rego_functions():
+    src = """
+package test
+
+is_priv(p) {
+    p < 1024
+}
+
+deny[msg] {
+    p := input.ports[_]
+    is_priv(p)
+    msg := sprintf("%d", [p])
+}
+"""
+    assert _eval_deny(src, {"ports": [80, 9000]}) == ["80"]
+
+
+def test_rego_metadata_comment():
+    src = """# METADATA
+# title: Test check
+# description: Something
+# custom:
+#   id: XY123
+#   severity: HIGH
+package test
+
+deny[msg] { msg := "x" }
+"""
+    mod = parse_module(src)
+    assert mod.metadata["title"] == "Test check"
+    assert mod.metadata["custom"]["id"] == "XY123"
+    assert mod.metadata["custom"]["severity"] == "HIGH"
+
+
+def test_rego_unsupported_is_loud():
+    with pytest.raises(RegoError):
+        parse_module("package t\n\ndeny[m] { every x in input.a { x > 1 }; m := 1 }")
+
+
+def test_rego_result_new_carries_lines():
+    src = """
+package test
+
+deny[res] {
+    cmd := input.cmds[_]
+    cmd.bad
+    res := result.new("bad cmd", cmd)
+}
+"""
+    out = _eval_deny(src, {"cmds": [{"bad": True, "StartLine": 7, "EndLine": 9}]})
+    assert out == [{"msg": "bad cmd", "startline": 7, "endline": 9}]
+
+
+# ---------------------------------------------------------------------------
+# HCL
+# ---------------------------------------------------------------------------
+
+
+def test_hcl_blocks_and_attrs():
+    doc = parse_hcl(
+        """
+resource "aws_s3_bucket" "b" {
+  bucket = "x"
+  tags = {
+    env = "prod"
+  }
+  versioning {
+    enabled = true
+  }
+}
+"""
+    )
+    b = doc["resource"]["aws_s3_bucket"]["b"]
+    assert b["bucket"] == "x"
+    assert b["tags"]["env"] == "prod"
+    assert b["versioning"]["enabled"] is True
+    assert b["__startline__"] == 2
+
+
+def test_hcl_variable_resolution_and_interpolation():
+    doc = terraform_input(
+        """
+variable "name" { default = "logs" }
+locals { prefix = "acme" }
+
+resource "aws_s3_bucket" "b" {
+  bucket = "${local.prefix}-${var.name}"
+  acl    = var.name
+}
+"""
+    )
+    b = doc["resource"]["aws_s3_bucket"]["b"]
+    assert b["bucket"] == "acme-logs"
+    assert b["acl"] == "logs"
+
+
+def test_hcl_lists_heredoc_conditionals():
+    doc = parse_hcl(
+        """
+resource "aws_iam_policy" "p" {
+  cidrs  = ["10.0.0.0/8", "0.0.0.0/0"]
+  policy = <<EOF
+{"Version": "2012-10-17"}
+EOF
+  count  = true ? 1 : 2
+}
+"""
+    )
+    p = doc["resource"]["aws_iam_policy"]["p"]
+    assert p["cidrs"] == ["10.0.0.0/8", "0.0.0.0/0"]
+    assert "2012-10-17" in p["policy"]
+    assert p["count"] == 1
+
+
+def test_hcl_repeated_blocks_accumulate():
+    doc = parse_hcl(
+        """
+resource "aws_security_group" "sg" {
+  ingress {
+    from_port = 80
+  }
+  ingress {
+    from_port = 443
+  }
+}
+"""
+    )
+    ing = doc["resource"]["aws_security_group"]["sg"]["ingress"]
+    assert isinstance(ing, list) and len(ing) == 2
+
+
+# ---------------------------------------------------------------------------
+# engine + builtin corpus
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def scanner():
+    return IacScanner()
+
+
+def test_builtin_corpus_loads(scanner):
+    ids = {c.check_id for c in scanner.checks}
+    assert len(scanner.checks) >= 30
+    assert {"DS001", "DS002", "KSV001", "KSV017", "AVD-AWS-0086",
+            "AVD-AWS-0107"} <= ids
+    # every check carries metadata
+    for c in scanner.checks:
+        assert c.title and c.severity in (
+            "LOW", "MEDIUM", "HIGH", "CRITICAL",
+        ), c.check_id
+
+
+def test_terraform_scan_end_to_end(scanner):
+    tf = b"""
+resource "aws_s3_bucket" "pub" {
+  acl = "public-read"
+}
+
+resource "aws_db_instance" "db" {
+  storage_encrypted = true
+}
+"""
+    mc = scanner.scan("main.tf", tf)
+    failed = {f.check_id for f in mc.failures}
+    passed = {s.check_id for s in mc.successes}
+    assert "AVD-AWS-0086" in failed
+    assert "AVD-AWS-0080" in passed
+    acl_fail = next(f for f in mc.failures if f.check_id == "AVD-AWS-0086")
+    assert acl_fail.start_line == 2
+    assert "public-read" in acl_fail.message
+
+
+def test_kubernetes_multi_doc(scanner):
+    y = b"""apiVersion: v1
+kind: Pod
+metadata: {name: a}
+spec:
+  hostNetwork: true
+  containers:
+  - name: c1
+    image: x:1.2
+---
+apiVersion: v1
+kind: Pod
+metadata: {name: b}
+spec:
+  containers:
+  - name: c2
+    image: y:latest
+"""
+    mc = scanner.scan("pods.yaml", y)
+    failed = {f.check_id for f in mc.failures}
+    assert "KSV009" in failed
+    assert "KSV013" in failed
+
+
+def test_non_k8s_yaml_skipped(scanner):
+    assert scanner.scan("config.yaml", b"foo: bar\n") is None
+
+
+def test_custom_check_dir(tmp_path):
+    d = tmp_path / "policies"
+    d.mkdir()
+    (d / "corp.rego").write_text(
+        """# METADATA
+# title: Corp registry required
+# custom:
+#   id: CORP001
+#   severity: CRITICAL
+package user.dockerfile.CORP001
+
+deny[res] {
+    cmd := input.Stages[_].Commands[_]
+    cmd.Cmd == "from"
+    img := cmd.Value[0]
+    not startswith(img, "registry.corp/")
+    res := result.new(sprintf("image %q not from corp registry", [img]), cmd)
+}
+"""
+    )
+    s = IacScanner(extra_check_dirs=[str(d)])
+    mc = s.scan("Dockerfile", b"FROM alpine:3.18\n")
+    assert "CORP001" in {f.check_id for f in mc.failures}
+    mc2 = s.scan("Dockerfile", b"FROM registry.corp/base:1\n")
+    assert "CORP001" in {f.check_id for f in mc2.successes}
+
+
+def test_init_containers_are_checked(scanner):
+    """r3 review: privileged initContainers must be flagged like regular
+    containers (the pre-rego Python checks covered them)."""
+    y = b"""apiVersion: v1
+kind: Pod
+metadata: {name: a}
+spec:
+  initContainers:
+  - name: setup
+    securityContext:
+      privileged: true
+  containers:
+  - name: app
+    image: x:1.0
+"""
+    mc = scanner.scan("pod.yaml", y)
+    ksv017 = [f for f in mc.failures if f.check_id == "KSV017"]
+    assert len(ksv017) == 1 and "setup" in ksv017[0].message
+
+
+def test_cronjob_pod_spec_paths(scanner):
+    y = b"""apiVersion: batch/v1
+kind: CronJob
+metadata: {name: c}
+spec:
+  jobTemplate:
+    spec:
+      template:
+        spec:
+          hostNetwork: true
+          volumes:
+          - name: h
+            hostPath: {path: /}
+          containers:
+          - name: app
+            image: x:1.0
+"""
+    mc = scanner.scan("cron.yaml", y)
+    failed = {f.check_id for f in mc.failures}
+    assert {"KSV009", "KSV023"} <= failed
+
+
+def test_hcl_index_expressions(scanner):
+    tf = b"""
+resource "aws_instance" "app" {
+  subnet_id                   = aws_subnet.subnets[0].id
+  associate_public_ip_address = true
+}
+"""
+    mc = scanner.scan("main.tf", tf)
+    assert mc is not None
+    assert "AVD-AWS-0009" in {f.check_id for f in mc.failures}
+
+
+def test_k8s_manifest_with_long_header(scanner):
+    y = (b"# license header\n" * 500) + b"""apiVersion: v1
+kind: Pod
+metadata: {name: a}
+spec:
+  containers:
+  - name: app
+    image: x:latest
+"""
+    mc = scanner.scan("pod.yaml", y)
+    assert mc is not None
+    assert "KSV013" in {f.check_id for f in mc.failures}
+
+
+def test_tf_json_supported(scanner):
+    tfjson = b"""{
+  "resource": {
+    "aws_s3_bucket": {"b": {"acl": "public-read"}}
+  }
+}"""
+    mc = scanner.scan("main.tf.json", tfjson)
+    assert mc is not None
+    assert "AVD-AWS-0086" in {f.check_id for f in mc.failures}
+
+
+def test_broken_check_is_not_green(tmp_path):
+    """r3 review: a policy that cannot evaluate must not be recorded PASS."""
+    d = tmp_path / "p"
+    d.mkdir()
+    (d / "broken.rego").write_text(
+        """# METADATA
+# title: Uses unsupported builtin
+# custom:
+#   id: BRK001
+#   severity: HIGH
+package user.dockerfile.BRK001
+
+deny[res] {
+    cmd := input.Stages[_].Commands[_]
+    net.cidr_contains("10.0.0.0/8", cmd.Value[0])
+    res := result.new("x", cmd)
+}
+"""
+    )
+    s = IacScanner(extra_check_dirs=[str(d)])
+    mc = s.scan("Dockerfile", b"FROM alpine:3.18\nRUN true\n")
+    ids_pass = {x.check_id for x in mc.successes}
+    ids_fail = {x.check_id for x in mc.failures}
+    assert "BRK001" not in ids_pass
+    assert "BRK001" not in ids_fail
+
+
+def test_dockerfile_line_attribution(scanner):
+    mc = scanner.scan(
+        "Dockerfile", b"FROM golang:1.22\nRUN sudo make\nUSER app\nHEALTHCHECK CMD true\n"
+    )
+    sudo = next(f for f in mc.failures if f.check_id == "DS010")
+    assert sudo.start_line == 2
+    assert {"DS001", "DS002", "DS026"} <= {s.check_id for s in mc.successes}

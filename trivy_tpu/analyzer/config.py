@@ -55,5 +55,28 @@ class KubernetesYamlAnalyzer(Analyzer):
         return AnalysisResult(misconfigs=[mc])
 
 
+class TerraformAnalyzer(Analyzer):
+    """Route .tf files through the rego engine (the reference's terraform
+    scanner seat, pkg/misconf/scanner.go:82-112)."""
+
+    def type(self) -> str:
+        return "terraform"
+
+    def version(self) -> int:
+        return 1
+
+    def required(self, file_path: str, size: int, mode: int) -> bool:
+        return file_path.endswith((".tf", ".tf.json")) and size < 1 << 20
+
+    def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
+        from trivy_tpu.iac.engine import shared_scanner
+
+        mc = shared_scanner().scan(inp.file_path, inp.content)
+        if mc is None or (not mc.failures and not mc.successes):
+            return None
+        return AnalysisResult(misconfigs=[mc])
+
+
 register_analyzer(DockerfileAnalyzer)
 register_analyzer(KubernetesYamlAnalyzer)
+register_analyzer(TerraformAnalyzer)

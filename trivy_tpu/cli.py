@@ -73,6 +73,10 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
     p.add_argument("--template", default="", help="template for -f template")
     p.add_argument("--vex", default="", help="OpenVEX/CycloneDX VEX document")
     p.add_argument("--include-non-failures", action="store_true")
+    p.add_argument(
+        "--config-check", action="append", default=[],
+        help="directory with custom .rego checks (repeatable)",
+    )
 
 
 def _options_from_args(args: argparse.Namespace) -> Options:
@@ -97,6 +101,7 @@ def _options_from_args(args: argparse.Namespace) -> Options:
         template=args.template,
         vex_path=args.vex,
         include_non_failures=args.include_non_failures,
+        config_check=list(args.config_check),
     )
 
 

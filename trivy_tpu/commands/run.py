@@ -56,6 +56,7 @@ class Options:
     template: str = ""  # --template for --format template
     vex_path: str = ""  # --vex document
     include_non_failures: bool = False
+    config_check: list[str] = field(default_factory=list)  # --config-check dirs
 
 
 def init_cache(options: Options) -> ArtifactCache:
@@ -79,7 +80,12 @@ def _analyzer_options(options: Options, target_kind: str) -> AnalyzerOptions:
     if "license" not in options.scanners:
         disabled.extend(["license-file", "dpkg-license"])
     if "misconfig" not in options.scanners:
-        disabled.extend(["dockerfile", "kubernetes"])
+        disabled.extend(["dockerfile", "kubernetes", "terraform"])
+    from trivy_tpu.iac.engine import configure_shared_scanner
+
+    # Unconditional: also RESETS custom dirs left by a prior scan in this
+    # process (the scanner is process-global).
+    configure_shared_scanner(list(getattr(options, "config_check", []) or []))
     return AnalyzerOptions(
         disabled_analyzers=disabled,
         secret_scanner_option=SecretScannerOption(

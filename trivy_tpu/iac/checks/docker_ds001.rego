@@ -1,0 +1,40 @@
+# METADATA
+# title: ":latest" tag used
+# description: Using the latest tag makes builds unrepeatable.
+# custom:
+#   id: DS001
+#   severity: MEDIUM
+#   recommended_action: Use a specific container image tag.
+package builtin.dockerfile.DS001
+
+image_names[cmd] {
+    cmd := input.Stages[_].Commands[_]
+    cmd.Cmd == "from"
+    count(cmd.Value) > 0
+}
+
+aliases[a] {
+    some cmd in image_names
+    count(cmd.Value) == 3
+    a := lower(cmd.Value[2])
+}
+
+deny[res] {
+    some cmd in image_names
+    img := cmd.Value[0]
+    img != "scratch"
+    not startswith(img, "$")
+    not lower(img) in aliases
+    not contains(img, "@")
+    parts := split(img, "/")
+    last := parts[count(parts) - 1]
+    not contains(last, ":")
+    res := result.new(sprintf("Specify a tag in the image reference %q", [img]), cmd)
+}
+
+deny[res] {
+    some cmd in image_names
+    img := cmd.Value[0]
+    endswith(img, ":latest")
+    res := result.new(sprintf("Avoid the ':latest' tag in %q", [img]), cmd)
+}

@@ -1,0 +1,32 @@
+# METADATA
+# title: Image user should not be "root"
+# description: Running containers as root increases blast radius.
+# custom:
+#   id: DS002
+#   severity: HIGH
+#   recommended_action: Add "USER <non-root>" to the Dockerfile.
+package builtin.dockerfile.DS002
+
+users[cmd] {
+    cmd := input.Stages[_].Commands[_]
+    cmd.Cmd == "user"
+}
+
+last_user := u {
+    n := count([c | c := users[_]])
+    n > 0
+    all := [c | c := users[_]]
+    u := all[n - 1]
+}
+
+deny[res] {
+    count([c | c := users[_]]) == 0
+    res := result.new("Specify at least one USER command in the Dockerfile", {})
+}
+
+deny[res] {
+    u := last_user
+    name := split(u.Value[0], ":")[0]
+    name in ["root", "0"]
+    res := result.new("Last USER command should not be 'root'", u)
+}

@@ -1,0 +1,16 @@
+# METADATA
+# title: Port 22 exposed
+# description: Exposing SSH from a container is rarely intended.
+# custom:
+#   id: DS004
+#   severity: MEDIUM
+#   recommended_action: Remove "EXPOSE 22".
+package builtin.dockerfile.DS004
+
+deny[res] {
+    cmd := input.Stages[_].Commands[_]
+    cmd.Cmd == "expose"
+    port := cmd.Value[_]
+    split(port, "/")[0] == "22"
+    res := result.new("Do not expose port 22 (SSH)", cmd)
+}

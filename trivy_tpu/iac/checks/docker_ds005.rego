@@ -1,0 +1,17 @@
+# METADATA
+# title: ADD instead of COPY
+# description: COPY is preferred for local files; ADD has surprising extras.
+# custom:
+#   id: DS005
+#   severity: LOW
+#   recommended_action: Use COPY for copying local resources.
+package builtin.dockerfile.DS005
+
+deny[res] {
+    cmd := input.Stages[_].Commands[_]
+    cmd.Cmd == "add"
+    args := concat(" ", cmd.Value)
+    not regex.match(`\.(tar|tar\.\w+|tgz|zip)(\s|$)`, args)
+    not regex.match(`^https?://`, args)
+    res := result.new(sprintf("Consider using 'COPY %s' instead of 'ADD'", [args]), cmd)
+}

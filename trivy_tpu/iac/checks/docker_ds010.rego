@@ -1,0 +1,15 @@
+# METADATA
+# title: sudo usage in RUN
+# description: Builds already run as root; sudo hides privilege boundaries.
+# custom:
+#   id: DS010
+#   severity: HIGH
+#   recommended_action: Remove sudo from RUN commands.
+package builtin.dockerfile.DS010
+
+deny[res] {
+    cmd := input.Stages[_].Commands[_]
+    cmd.Cmd == "run"
+    regex.match(`(^|\s|&&\s*)sudo\s`, concat(" ", cmd.Value))
+    res := result.new("Avoid using 'sudo' in RUN commands", cmd)
+}

@@ -1,0 +1,15 @@
+# METADATA
+# title: "RUN cd ..." used
+# description: cd in RUN does not persist; use WORKDIR.
+# custom:
+#   id: DS013
+#   severity: MEDIUM
+#   recommended_action: Use WORKDIR instead of "RUN cd".
+package builtin.dockerfile.DS013
+
+deny[res] {
+    cmd := input.Stages[_].Commands[_]
+    cmd.Cmd == "run"
+    regex.match(`^cd\s`, trim_space(concat(" ", cmd.Value)))
+    res := result.new("Use WORKDIR instead of 'RUN cd ...'", cmd)
+}

@@ -1,0 +1,17 @@
+# METADATA
+# title: yum cache not cleaned
+# description: Leftover caches bloat the image.
+# custom:
+#   id: DS015
+#   severity: HIGH
+#   recommended_action: Add "yum clean all" after yum install.
+package builtin.dockerfile.DS015
+
+deny[res] {
+    cmd := input.Stages[_].Commands[_]
+    cmd.Cmd == "run"
+    args := concat(" ", cmd.Value)
+    regex.match(`yum (-\S+ )*install`, args)
+    not contains(args, "yum clean all")
+    res := result.new("Add 'yum clean all' after 'yum install'", cmd)
+}

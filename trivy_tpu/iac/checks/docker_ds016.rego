@@ -1,0 +1,15 @@
+# METADATA
+# title: Multiple CMD instructions
+# description: Only the last CMD takes effect.
+# custom:
+#   id: DS016
+#   severity: HIGH
+#   recommended_action: Keep exactly one CMD.
+package builtin.dockerfile.DS016
+
+deny[res] {
+    stage := input.Stages[_]
+    cmds := [c | c := stage.Commands[_]; c.Cmd == "cmd"]
+    count(cmds) > 1
+    res := result.new(sprintf("Stage has %d CMD instructions; only the last applies", [count(cmds)]), cmds[1])
+}

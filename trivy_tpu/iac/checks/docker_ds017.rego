@@ -1,0 +1,17 @@
+# METADATA
+# title: apt lists not cleaned up
+# description: apt caches bloat the layer.
+# custom:
+#   id: DS017
+#   severity: LOW
+#   recommended_action: Clean apt cache in the same layer.
+package builtin.dockerfile.DS017
+
+deny[res] {
+    cmd := input.Stages[_].Commands[_]
+    cmd.Cmd == "run"
+    args := concat(" ", cmd.Value)
+    regex.match(`apt(-get)?\s+(-\S+ )*install`, args)
+    not contains(args, "rm -rf /var/lib/apt/lists")
+    res := result.new("Remove apt lists after installing ('rm -rf /var/lib/apt/lists/*')", cmd)
+}

@@ -1,0 +1,15 @@
+# METADATA
+# title: apt-get upgrade used
+# description: Upgrading all packages makes builds unreproducible.
+# custom:
+#   id: DS021
+#   severity: HIGH
+#   recommended_action: Remove apt-get upgrade.
+package builtin.dockerfile.DS021
+
+deny[res] {
+    cmd := input.Stages[_].Commands[_]
+    cmd.Cmd == "run"
+    regex.match(`apt-get (-\S+ )*upgrade`, concat(" ", cmd.Value))
+    res := result.new("Avoid 'apt-get upgrade' in images", cmd)
+}

@@ -1,0 +1,14 @@
+# METADATA
+# title: MAINTAINER is deprecated
+# description: Use OCI labels instead.
+# custom:
+#   id: DS022
+#   severity: HIGH
+#   recommended_action: Use 'LABEL maintainer=...'.
+package builtin.dockerfile.DS022
+
+deny[res] {
+    cmd := input.Stages[_].Commands[_]
+    cmd.Cmd == "maintainer"
+    res := result.new("MAINTAINER is deprecated; use 'LABEL maintainer='", cmd)
+}

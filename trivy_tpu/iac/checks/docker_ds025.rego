@@ -1,0 +1,17 @@
+# METADATA
+# title: apk add without --no-cache
+# description: apk caches bloat the layer.
+# custom:
+#   id: DS025
+#   severity: HIGH
+#   recommended_action: Use 'apk add --no-cache'.
+package builtin.dockerfile.DS025
+
+deny[res] {
+    cmd := input.Stages[_].Commands[_]
+    cmd.Cmd == "run"
+    args := concat(" ", cmd.Value)
+    regex.match(`apk (-\S+ )*add`, args)
+    not contains(args, "--no-cache")
+    res := result.new("Use 'apk add --no-cache' to avoid layer bloat", cmd)
+}

@@ -1,0 +1,14 @@
+# METADATA
+# title: No HEALTHCHECK defined
+# description: Health checks allow orchestrators to monitor containers.
+# custom:
+#   id: DS026
+#   severity: LOW
+#   recommended_action: Add a HEALTHCHECK instruction.
+package builtin.dockerfile.DS026
+
+deny[res] {
+    count([c | c := input.Stages[_].Commands[_]; c.Cmd == "healthcheck"]) == 0
+    count(input.Stages) > 0
+    res := result.new("Add a HEALTHCHECK instruction", {})
+}

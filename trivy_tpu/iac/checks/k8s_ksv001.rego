@@ -1,0 +1,37 @@
+# METADATA
+# title: Process can elevate its own privileges
+# custom:
+#   id: KSV001
+#   severity: MEDIUM
+#   recommended_action: Set securityContext.allowPrivilegeEscalation to false.
+package builtin.kubernetes.KSV001
+
+containers[c] {
+    c := input.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.initContainers[_]
+}
+
+deny[res] {
+    some c in containers
+    not object.get(object.get(c, "securityContext", {}), "allowPrivilegeEscalation", true) == false
+    res := result.new(sprintf("Container %q should set securityContext.allowPrivilegeEscalation to false", [object.get(c, "name", "?")]), c)
+}

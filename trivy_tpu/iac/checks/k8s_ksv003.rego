@@ -1,0 +1,39 @@
+# METADATA
+# title: Default capabilities not dropped
+# custom:
+#   id: KSV003
+#   severity: LOW
+#   recommended_action: Add ALL to securityContext.capabilities.drop.
+package builtin.kubernetes.KSV003
+
+containers[c] {
+    c := input.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.initContainers[_]
+}
+
+deny[res] {
+    some c in containers
+    drops := object.get(object.get(object.get(c, "securityContext", {}), "capabilities", {}), "drop", [])
+    not "ALL" in drops
+    not "all" in drops
+    res := result.new(sprintf("Container %q should drop all capabilities", [object.get(c, "name", "?")]), c)
+}

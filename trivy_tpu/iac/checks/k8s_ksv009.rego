@@ -1,0 +1,25 @@
+# METADATA
+# title: Access to host network
+# custom:
+#   id: KSV009
+#   severity: HIGH
+#   recommended_action: Do not set hostNetwork to true.
+package builtin.kubernetes.KSV009
+
+specs[s] {
+    s := input.spec
+}
+
+specs[s] {
+    s := input.spec.template.spec
+}
+
+specs[s] {
+    s := input.spec.jobTemplate.spec.template.spec
+}
+
+deny[res] {
+    some s in specs
+    object.get(s, "hostNetwork", false) == true
+    res := result.new("hostNetwork must not be set to true", s)
+}

@@ -1,0 +1,31 @@
+# METADATA
+# title: Access to host PID or IPC namespace
+# custom:
+#   id: KSV010
+#   severity: HIGH
+#   recommended_action: Do not set hostPID or hostIPC to true.
+package builtin.kubernetes.KSV010
+
+specs[s] {
+    s := input.spec
+}
+
+specs[s] {
+    s := input.spec.template.spec
+}
+
+specs[s] {
+    s := input.spec.jobTemplate.spec.template.spec
+}
+
+deny[res] {
+    some s in specs
+    object.get(s, "hostPID", false) == true
+    res := result.new("hostPID must not be set to true", s)
+}
+
+deny[res] {
+    some s in specs
+    object.get(s, "hostIPC", false) == true
+    res := result.new("hostIPC must not be set to true", s)
+}

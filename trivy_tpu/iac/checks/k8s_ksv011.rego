@@ -1,0 +1,37 @@
+# METADATA
+# title: CPU not limited
+# custom:
+#   id: KSV011
+#   severity: LOW
+#   recommended_action: Set resources.limits.cpu.
+package builtin.kubernetes.KSV011
+
+containers[c] {
+    c := input.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.initContainers[_]
+}
+
+deny[res] {
+    some c in containers
+    not object.get(object.get(object.get(c, "resources", {}), "limits", {}), "cpu", null)
+    res := result.new(sprintf("Container %q should set resources.limits.cpu", [object.get(c, "name", "?")]), c)
+}

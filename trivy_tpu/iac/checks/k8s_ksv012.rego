@@ -1,0 +1,46 @@
+# METADATA
+# title: Container runs as root user
+# custom:
+#   id: KSV012
+#   severity: MEDIUM
+#   recommended_action: Set securityContext.runAsNonRoot to true.
+package builtin.kubernetes.KSV012
+
+containers[c] {
+    c := input.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.initContainers[_]
+}
+
+pod_non_root {
+    object.get(object.get(object.get(input, "spec", {}), "securityContext", {}), "runAsNonRoot", false) == true
+}
+
+pod_non_root {
+    object.get(object.get(object.get(object.get(object.get(input, "spec", {}), "template", {}), "spec", {}), "securityContext", {}), "runAsNonRoot", false) == true
+}
+
+deny[res] {
+    some c in containers
+    not object.get(object.get(c, "securityContext", {}), "runAsNonRoot", false) == true
+    not pod_non_root
+    res := result.new(sprintf("Container %q should set securityContext.runAsNonRoot to true", [object.get(c, "name", "?")]), c)
+}

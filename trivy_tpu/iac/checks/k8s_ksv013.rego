@@ -1,0 +1,48 @@
+# METADATA
+# title: Image tag ":latest" used
+# custom:
+#   id: KSV013
+#   severity: MEDIUM
+#   recommended_action: Use a specific image tag.
+package builtin.kubernetes.KSV013
+
+containers[c] {
+    c := input.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.initContainers[_]
+}
+
+deny[res] {
+    some c in containers
+    img := object.get(c, "image", "")
+    endswith(img, ":latest")
+    res := result.new(sprintf("Container %q uses the ':latest' image tag", [object.get(c, "name", "?")]), c)
+}
+
+deny[res] {
+    some c in containers
+    img := object.get(c, "image", "")
+    img != ""
+    not contains(img, "@")
+    parts := split(img, "/")
+    not contains(parts[count(parts) - 1], ":")
+    res := result.new(sprintf("Container %q image has no tag", [object.get(c, "name", "?")]), c)
+}

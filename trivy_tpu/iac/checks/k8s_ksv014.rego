@@ -1,0 +1,37 @@
+# METADATA
+# title: Root file system is not read-only
+# custom:
+#   id: KSV014
+#   severity: HIGH
+#   recommended_action: Set securityContext.readOnlyRootFilesystem to true.
+package builtin.kubernetes.KSV014
+
+containers[c] {
+    c := input.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.initContainers[_]
+}
+
+deny[res] {
+    some c in containers
+    not object.get(object.get(c, "securityContext", {}), "readOnlyRootFilesystem", false) == true
+    res := result.new(sprintf("Container %q should set securityContext.readOnlyRootFilesystem to true", [object.get(c, "name", "?")]), c)
+}

@@ -1,0 +1,37 @@
+# METADATA
+# title: Privileged container
+# custom:
+#   id: KSV017
+#   severity: HIGH
+#   recommended_action: Remove securityContext.privileged.
+package builtin.kubernetes.KSV017
+
+containers[c] {
+    c := input.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.initContainers[_]
+}
+
+deny[res] {
+    some c in containers
+    object.get(object.get(c, "securityContext", {}), "privileged", false) == true
+    res := result.new(sprintf("Container %q should not be privileged", [object.get(c, "name", "?")]), c)
+}

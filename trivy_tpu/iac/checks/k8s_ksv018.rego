@@ -1,0 +1,37 @@
+# METADATA
+# title: Memory not limited
+# custom:
+#   id: KSV018
+#   severity: LOW
+#   recommended_action: Set resources.limits.memory.
+package builtin.kubernetes.KSV018
+
+containers[c] {
+    c := input.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.initContainers[_]
+}
+
+deny[res] {
+    some c in containers
+    not object.get(object.get(object.get(c, "resources", {}), "limits", {}), "memory", null)
+    res := result.new(sprintf("Container %q should set resources.limits.memory", [object.get(c, "name", "?")]), c)
+}

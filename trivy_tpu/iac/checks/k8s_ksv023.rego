@@ -1,0 +1,25 @@
+# METADATA
+# title: hostPath volume mounted
+# custom:
+#   id: KSV023
+#   severity: MEDIUM
+#   recommended_action: Do not mount hostPath volumes.
+package builtin.kubernetes.KSV023
+
+volumes[v] {
+    v := input.spec.volumes[_]
+}
+
+volumes[v] {
+    v := input.spec.template.spec.volumes[_]
+}
+
+volumes[v] {
+    v := input.spec.jobTemplate.spec.template.spec.volumes[_]
+}
+
+deny[res] {
+    some v in volumes
+    object.get(v, "hostPath", null) != null
+    res := result.new(sprintf("Volume %q mounts a hostPath", [object.get(v, "name", "?")]), v)
+}

@@ -1,0 +1,37 @@
+# METADATA
+# title: Seccomp profile unconfined
+# custom:
+#   id: KSV104
+#   severity: MEDIUM
+#   recommended_action: Set a RuntimeDefault seccomp profile.
+package builtin.kubernetes.KSV104
+
+containers[c] {
+    c := input.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.initContainers[_]
+}
+
+deny[res] {
+    some c in containers
+    object.get(object.get(object.get(c, "securityContext", {}), "seccompProfile", {}), "type", "") == "Unconfined"
+    res := result.new(sprintf("Container %q uses an unconfined seccomp profile", [object.get(c, "name", "?")]), c)
+}

@@ -1,0 +1,21 @@
+# METADATA
+# title: EBS volume unencrypted
+# custom:
+#   id: AVD-AWS-0026
+#   severity: HIGH
+#   recommended_action: Set encrypted = true on EBS volumes.
+package builtin.terraform.AWS0026
+
+deny[res] {
+    some name, v in object.get(object.get(input, "resource", {}), "aws_ebs_volume", {})
+    not object.get(v, "encrypted", false) == true
+    res := result.new(sprintf("EBS volume %q is not encrypted", [name]), v)
+}
+
+deny[res] {
+    some name, inst in object.get(object.get(input, "resource", {}), "aws_instance", {})
+    rbd := object.get(inst, "root_block_device", null)
+    is_object(rbd)
+    not object.get(rbd, "encrypted", false) == true
+    res := result.new(sprintf("Instance %q root block device is not encrypted", [name]), inst)
+}

@@ -1,0 +1,13 @@
+# METADATA
+# title: EC2 instance does not require IMDSv2
+# custom:
+#   id: AVD-AWS-0028
+#   severity: HIGH
+#   recommended_action: Set metadata_options.http_tokens = "required".
+package builtin.terraform.AWS0028
+
+deny[res] {
+    some name, inst in object.get(object.get(input, "resource", {}), "aws_instance", {})
+    not object.get(object.get(inst, "metadata_options", {}), "http_tokens", "optional") == "required"
+    res := result.new(sprintf("Instance %q should require IMDSv2 (http_tokens = \"required\")", [name]), inst)
+}

@@ -1,0 +1,20 @@
+# METADATA
+# title: EC2 instance with public IP
+# custom:
+#   id: AVD-AWS-0009
+#   severity: HIGH
+#   recommended_action: Set associate_public_ip_address = false.
+package builtin.terraform.AWS0009
+
+deny[res] {
+    some name, inst in object.get(object.get(input, "resource", {}), "aws_instance", {})
+    object.get(inst, "associate_public_ip_address", false) == true
+    res := result.new(sprintf("Instance %q associates a public IP", [name]), inst)
+}
+
+deny[res] {
+    some name, lt in object.get(object.get(input, "resource", {}), "aws_launch_template", {})
+    ni := object.get(lt, "network_interfaces", {})
+    object.get(ni, "associate_public_ip_address", false) == true
+    res := result.new(sprintf("Launch template %q associates a public IP", [name]), lt)
+}

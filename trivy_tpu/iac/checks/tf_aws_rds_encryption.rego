@@ -1,0 +1,13 @@
+# METADATA
+# title: RDS instance storage unencrypted
+# custom:
+#   id: AVD-AWS-0080
+#   severity: HIGH
+#   recommended_action: Set storage_encrypted = true.
+package builtin.terraform.AWS0080
+
+deny[res] {
+    some name, db in object.get(object.get(input, "resource", {}), "aws_db_instance", {})
+    not object.get(db, "storage_encrypted", false) == true
+    res := result.new(sprintf("RDS instance %q storage is not encrypted", [name]), db)
+}

@@ -1,0 +1,21 @@
+# METADATA
+# title: S3 bucket has a public ACL
+# custom:
+#   id: AVD-AWS-0086
+#   severity: HIGH
+#   recommended_action: Remove public-read/public-read-write ACLs.
+package builtin.terraform.AWS0086
+
+deny[res] {
+    some name, b in object.get(object.get(input, "resource", {}), "aws_s3_bucket", {})
+    acl := object.get(b, "acl", "private")
+    acl in ["public-read", "public-read-write", "website"]
+    res := result.new(sprintf("S3 bucket %q has ACL %q", [name, acl]), b)
+}
+
+deny[res] {
+    some name, b in object.get(object.get(input, "resource", {}), "aws_s3_bucket_acl", {})
+    acl := object.get(b, "acl", "private")
+    acl in ["public-read", "public-read-write", "website"]
+    res := result.new(sprintf("S3 bucket ACL %q is %q", [name, acl]), b)
+}

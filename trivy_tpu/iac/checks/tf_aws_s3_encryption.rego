@@ -1,0 +1,19 @@
+# METADATA
+# title: S3 bucket without server-side encryption
+# custom:
+#   id: AVD-AWS-0088
+#   severity: HIGH
+#   recommended_action: Configure bucket server-side encryption.
+package builtin.terraform.AWS0088
+
+encrypted_elsewhere[name] {
+    some key, _b in object.get(object.get(input, "resource", {}), "aws_s3_bucket_server_side_encryption_configuration", {})
+    name := key
+}
+
+deny[res] {
+    some name, b in object.get(object.get(input, "resource", {}), "aws_s3_bucket", {})
+    not object.get(b, "server_side_encryption_configuration", null)
+    count([n | n := encrypted_elsewhere[_]]) == 0
+    res := result.new(sprintf("S3 bucket %q has no server-side encryption configured", [name]), b)
+}

@@ -1,0 +1,19 @@
+# METADATA
+# title: S3 bucket versioning disabled
+# custom:
+#   id: AVD-AWS-0090
+#   severity: MEDIUM
+#   recommended_action: Enable bucket versioning.
+package builtin.terraform.AWS0090
+
+versioned_elsewhere[name] {
+    some key, _b in object.get(object.get(input, "resource", {}), "aws_s3_bucket_versioning", {})
+    name := key
+}
+
+deny[res] {
+    some name, b in object.get(object.get(input, "resource", {}), "aws_s3_bucket", {})
+    not object.get(object.get(b, "versioning", {}), "enabled", false) == true
+    count([n | n := versioned_elsewhere[_]]) == 0
+    res := result.new(sprintf("S3 bucket %q has versioning disabled", [name]), b)
+}

@@ -1,0 +1,35 @@
+# METADATA
+# title: Security group allows ingress from 0.0.0.0/0
+# custom:
+#   id: AVD-AWS-0107
+#   severity: CRITICAL
+#   recommended_action: Restrict ingress CIDR ranges.
+package builtin.terraform.AWS0107
+
+ingress_blocks[pair] {
+    some name, sg in object.get(object.get(input, "resource", {}), "aws_security_group", {})
+    ing := object.get(sg, "ingress", [])
+    is_array(ing)
+    blk := ing[_]
+    pair := {"name": name, "blk": blk}
+}
+
+ingress_blocks[pair] {
+    some name, sg in object.get(object.get(input, "resource", {}), "aws_security_group", {})
+    blk := object.get(sg, "ingress", null)
+    is_object(blk)
+    pair := {"name": name, "blk": blk}
+}
+
+ingress_blocks[pair] {
+    some name, r in object.get(object.get(input, "resource", {}), "aws_security_group_rule", {})
+    object.get(r, "type", "") == "ingress"
+    pair := {"name": name, "blk": r}
+}
+
+deny[res] {
+    some pair in ingress_blocks
+    cidr := object.get(pair.blk, "cidr_blocks", [])[_]
+    cidr in ["0.0.0.0/0", "::/0"]
+    res := result.new(sprintf("Security group %q allows ingress from %s", [pair.name, cidr]), pair.blk)
+}

@@ -1,0 +1,197 @@
+"""IaC scan engine: rego checks over structured file inputs.
+
+The policy-as-code half of the misconf façade (reference:
+pkg/misconf/scanner.go routing + pkg/iac/rego driving the trivy-checks
+bundle).  Builtin checks ship as .rego sources in trivy_tpu/iac/checks/;
+user checks load from extra directories (--config-check), exactly like the
+reference's custom-policy flow — both run through the same evaluator
+(iac/rego.py).
+
+Check metadata carries id/severity/title (METADATA comment block or
+__rego_metadata__); the package path routes the check to its input type:
+``builtin.dockerfile.*`` / ``<ns>.dockerfile.*`` -> dockerfile inputs, and
+likewise for kubernetes and terraform.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any
+
+from trivy_tpu.iac.inputs import (
+    detect_type,
+    dockerfile_input,
+    kubernetes_inputs,
+    terraform_input,
+)
+from trivy_tpu.iac.rego import RegoError, RegoModule, parse_module, _Evaluator
+from trivy_tpu.misconf.types import MisconfFinding, Misconfiguration
+
+_CHECK_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "checks")
+
+
+@dataclass
+class Check:
+    module: RegoModule
+    check_id: str
+    title: str
+    description: str
+    severity: str
+    resolution: str
+    input_type: str  # dockerfile | kubernetes | terraform
+
+
+def _input_type_of(package: str) -> str | None:
+    parts = package.split(".")
+    for t in ("dockerfile", "kubernetes", "terraform"):
+        if t in parts:
+            return t
+    return None
+
+
+def load_checks(extra_dirs: list[str] | None = None) -> list[Check]:
+    checks: list[Check] = []
+    dirs = [_CHECK_DIR] + list(extra_dirs or [])
+    for d in dirs:
+        if not os.path.isdir(d):
+            continue
+        for name in sorted(os.listdir(d)):
+            if not name.endswith(".rego"):
+                continue
+            path = os.path.join(d, name)
+            with open(path, "r", encoding="utf-8") as f:
+                src = f.read()
+            mod = parse_module(src, source_path=path)
+            itype = _input_type_of(mod.package)
+            if itype is None or "deny" not in mod.rules:
+                continue
+            md = mod.metadata or {}
+            custom = md.get("custom") or {}
+            checks.append(
+                Check(
+                    module=mod,
+                    check_id=custom.get("id", mod.package.rsplit(".", 1)[-1]),
+                    title=md.get("title", ""),
+                    description=md.get("description", ""),
+                    severity=str(custom.get("severity", "MEDIUM")).upper(),
+                    resolution=custom.get("recommended_action", ""),
+                    input_type=itype,
+                )
+            )
+    return checks
+
+
+_shared: IacScanner | None = None
+_shared_extra_dirs: list[str] = []
+
+
+def configure_shared_scanner(extra_check_dirs: list[str]) -> None:
+    """Set custom-check directories (--config-check) before the first scan;
+    resets the cached scanner so new checks load."""
+    global _shared, _shared_extra_dirs
+    _shared_extra_dirs = list(extra_check_dirs)
+    _shared = None
+
+
+def shared_scanner() -> "IacScanner":
+    """Process-wide scanner with the builtin checks (compiled once)."""
+    global _shared
+    if _shared is None:
+        _shared = IacScanner(extra_check_dirs=_shared_extra_dirs)
+    return _shared
+
+
+class IacScanner:
+    """Routes config files to rego checks; one instance caches compiled
+    checks for the whole scan (pkg/misconf/scanner.go role)."""
+
+    def __init__(self, extra_check_dirs: list[str] | None = None):
+        self.checks = load_checks(extra_check_dirs)
+
+    def scan(self, file_path: str, content: bytes) -> Misconfiguration | None:
+        ftype = detect_type(file_path, content)
+        if ftype is None:
+            return None
+        if ftype == "dockerfile":
+            inputs: list[Any] = [dockerfile_input(content)]
+        elif ftype == "kubernetes":
+            inputs = kubernetes_inputs(content)
+        elif file_path.endswith(".tf.json"):
+            import json as _json
+
+            try:
+                doc = _json.loads(content)
+            except ValueError:
+                return None
+            inputs = [doc] if isinstance(doc, dict) else []
+        else:
+            try:
+                inputs = [terraform_input(content.decode("utf-8", "replace"))]
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "terraform parse failed for %s; file skipped", file_path
+                )
+                return None
+        if not inputs:
+            return None
+
+        mc = Misconfiguration(file_type=ftype, file_path=file_path)
+        for check in self.checks:
+            if check.input_type != ftype:
+                continue
+            failures = []
+            broken = False
+            for doc in inputs:
+                ev = _Evaluator(doc, check.module.rules)
+                try:
+                    denies = ev.eval_set_rule("deny")
+                except RegoError as e:
+                    # A policy that cannot evaluate must not read as green
+                    # (PASS); log and record nothing for this check.
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "check %s failed to evaluate on %s: %s",
+                        check.check_id, file_path, e,
+                    )
+                    broken = True
+                    continue
+                for d in denies:
+                    if isinstance(d, dict):
+                        msg = str(d.get("msg", ""))
+                        start = int(d.get("startline", 0) or 0)
+                        end = int(d.get("endline", 0) or start)
+                    else:
+                        msg, start, end = str(d), 0, 0
+                    failures.append(
+                        MisconfFinding(
+                            check_id=check.check_id,
+                            title=check.title,
+                            description=check.description,
+                            message=msg,
+                            resolution=check.resolution,
+                            severity=check.severity,
+                            status="FAIL",
+                            start_line=start,
+                            end_line=end or start,
+                        )
+                    )
+            if failures:
+                mc.failures.extend(failures)
+            elif broken:
+                pass  # neither PASS nor FAIL: the check did not evaluate
+            else:
+                mc.successes.append(
+                    MisconfFinding(
+                        check_id=check.check_id,
+                        title=check.title,
+                        description=check.description,
+                        resolution=check.resolution,
+                        severity=check.severity,
+                        status="PASS",
+                    )
+                )
+        return mc

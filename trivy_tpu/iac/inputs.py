@@ -1,0 +1,172 @@
+"""Structured rego input documents per IaC file type.
+
+Shapes mirror the reference so checks written for trivy port over:
+  dockerfile -> pkg/iac/providers/dockerfile/dockerfile.go ToRego():
+      {"Stages": [{"Name": ..., "Commands": [{"Cmd", "SubCmd", "Flags",
+       "Value", "Original", "JSON", "Stage", "Path", "StartLine",
+       "EndLine"}]}]}
+  kubernetes -> the YAML document itself (trivy feeds parsed YAML straight
+      to rego for k8s checks), with __startline__/__endline__ markers on
+      mappings (pkg/iac/scanners/kubernetes parser convention)
+  terraform  -> conftest-style document (iac/hcl.py terraform_input)
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import shlex
+from typing import Any
+
+from trivy_tpu.iac.hcl import terraform_input
+
+__all__ = [
+    "dockerfile_input",
+    "kubernetes_inputs",
+    "terraform_input",
+    "detect_type",
+]
+
+
+def detect_type(file_path: str, content: bytes) -> str | None:
+    """File-type routing (pkg/misconf/scanner.go:82-112 per-type scanners +
+    pkg/iac/detection)."""
+    name = file_path.rsplit("/", 1)[-1].lower()
+    if name == "dockerfile" or name.startswith("dockerfile.") or name.endswith(
+        ".dockerfile"
+    ):
+        return "dockerfile"
+    if name.endswith((".tf", ".tf.json")):
+        return "terraform"
+    if name.endswith((".yaml", ".yml")):
+        if b"apiVersion" in content and b"kind" in content:
+            return "kubernetes"
+        return None
+    if name.endswith(".json"):
+        try:
+            doc = json.loads(content)
+        except ValueError:
+            return None
+        if isinstance(doc, dict) and "apiVersion" in doc and "kind" in doc:
+            return "kubernetes"
+        return None
+    return None
+
+
+# ---------------------------------------------------------------------------
+# dockerfile
+# ---------------------------------------------------------------------------
+
+_FLAG_RE = re.compile(r"^--[A-Za-z][\w-]*(=\S*)?$")
+
+
+def dockerfile_input(content: bytes) -> dict[str, Any]:
+    from trivy_tpu.misconf.dockerfile import parse_dockerfile
+
+    instructions = parse_dockerfile(content)
+    stages: list[dict[str, Any]] = []
+    cur: dict[str, Any] | None = None
+    stage_idx = -1
+    for ins in instructions:
+        cmd = ins.cmd.lower()
+        value = ins.value
+        flags: list[str] = []
+        sub = ""
+        rest = value
+        if cmd in ("run", "copy", "add", "from", "healthcheck"):
+            parts = rest.split()
+            while parts and _FLAG_RE.match(parts[0]):
+                flags.append(parts[0])
+                parts.pop(0)
+            rest = " ".join(parts)
+        if cmd == "healthcheck" and rest.split()[:1]:
+            sub = rest.split()[0].upper()
+        is_json = rest.lstrip().startswith("[")
+        if is_json:
+            try:
+                vals = [str(v) for v in json.loads(rest)]
+            except ValueError:
+                vals = [rest]
+                is_json = False
+        elif cmd in ("run",):
+            vals = [rest]
+        else:
+            try:
+                vals = shlex.split(rest)
+            except ValueError:
+                vals = rest.split()
+        command = {
+            "Cmd": cmd,
+            "SubCmd": sub.lower(),
+            "Flags": flags,
+            "Value": vals,
+            "Original": f"{ins.cmd} {ins.value}".strip(),
+            "JSON": is_json,
+            "Stage": stage_idx if cmd != "from" else stage_idx + 1,
+            "Path": "",
+            "StartLine": ins.start_line,
+            "EndLine": ins.end_line,
+        }
+        if cmd == "from":
+            stage_idx += 1
+            cur = {"Name": ins.value, "Commands": [command]}
+            stages.append(cur)
+        else:
+            if cur is None:  # instructions before any FROM (ARG is legal)
+                stage_idx = 0
+                cur = {"Name": "", "Commands": []}
+                stages.append(cur)
+                command["Stage"] = 0
+            cur["Commands"].append(command)
+    return {"Stages": stages}
+
+
+# ---------------------------------------------------------------------------
+# kubernetes
+# ---------------------------------------------------------------------------
+
+
+class _LineLoaderFactory:
+    _cls = None
+
+    @classmethod
+    def get(cls):
+        if cls._cls is not None:
+            return cls._cls
+        import yaml
+
+        class LineLoader(yaml.SafeLoader):
+            pass
+
+        def construct_mapping(loader, node, deep=False):
+            mapping = yaml.SafeLoader.construct_mapping(loader, node, deep=deep)
+            mapping["__startline__"] = node.start_mark.line + 1
+            mapping["__endline__"] = node.end_mark.line + 1
+            return mapping
+
+        LineLoader.add_constructor(
+            yaml.resolver.BaseResolver.DEFAULT_MAPPING_TAG, construct_mapping
+        )
+        cls._cls = LineLoader
+        return cls._cls
+
+
+def kubernetes_inputs(content: bytes) -> list[dict[str, Any]]:
+    """Parse (possibly multi-document) k8s YAML or JSON with line markers."""
+    text = content.decode("utf-8", errors="replace")
+    if text.lstrip().startswith("{"):
+        try:
+            doc = json.loads(text)
+        except ValueError:
+            return []
+        return [doc] if isinstance(doc, dict) else []
+    import yaml
+
+    out = []
+    try:
+        for doc in yaml.load_all(text, Loader=_LineLoaderFactory.get()):
+            if isinstance(doc, dict) and doc.get("kind"):
+                out.append(doc)
+    except yaml.YAMLError:
+        return []
+    return out

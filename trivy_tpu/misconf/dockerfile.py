@@ -1,8 +1,9 @@
-"""Dockerfile misconfiguration checks.
+"""Dockerfile parsing + the misconf facade's dockerfile entry point.
 
-The pkg/iac dockerfile scanner's role (checks modeled on trivy-checks'
-DS-series Rego policies), as plain Python checks over a parsed instruction
-list.
+The instruction parser feeds both the rego input builder
+(trivy_tpu/iac/inputs.py, mirroring the reference's buildkit-parsed
+Stages/Commands shape) and the image-history analyzer; the DS-series
+checks themselves are .rego policies under trivy_tpu/iac/checks/.
 """
 
 from __future__ import annotations
@@ -10,7 +11,7 @@ from __future__ import annotations
 import re
 from dataclasses import dataclass
 
-from trivy_tpu.misconf.types import MisconfFinding, Misconfiguration
+from trivy_tpu.misconf.types import Misconfiguration
 
 
 @dataclass
@@ -49,101 +50,16 @@ def parse_dockerfile(content: bytes) -> list[Instruction]:
     return out
 
 
-def _check_latest_tag(instructions):
-    for ins in instructions:
-        if ins.cmd != "FROM":
-            continue
-        image = ins.value.split(" as ")[0].split(" AS ")[0].strip()
-        if image.lower() == "scratch" or image.startswith("$"):
-            continue
-        if ":" not in image.split("/")[-1] and "@" not in image:
-            yield ins, f"Specify a tag in the image reference '{image}'"
-        elif image.endswith(":latest"):
-            yield ins, f"Avoid the ':latest' tag in '{image}'"
-
-
-def _check_root_user(instructions):
-    last_user = None
-    for ins in instructions:
-        if ins.cmd == "USER":
-            last_user = ins
-    if last_user is None:
-        yield None, "Specify at least one USER command in the Dockerfile"
-    elif last_user.value.split(":")[0] in ("root", "0"):
-        yield last_user, "Last USER command should not be 'root'"
-
-
-def _check_add(instructions):
-    for ins in instructions:
-        if ins.cmd == "ADD" and not re.search(
-            r"\.(tar|tar\.\w+|tgz|zip)(\s|$)|^https?://", ins.value
-        ):
-            yield ins, "Consider using 'COPY' instead of 'ADD'"
-
-
-def _check_sudo(instructions):
-    for ins in instructions:
-        if ins.cmd == "RUN" and re.search(r"(^|\s|&&\s*)sudo\s", ins.value):
-            yield ins, "Avoid using 'sudo' in RUN commands"
-
-
-def _check_apt_no_clean(instructions):
-    for ins in instructions:
-        if (
-            ins.cmd == "RUN"
-            and re.search(r"apt(-get)?\s+install", ins.value)
-            and "rm -rf /var/lib/apt/lists" not in ins.value
-        ):
-            yield ins, (
-                "Remove apt lists after installing "
-                "('rm -rf /var/lib/apt/lists/*')"
-            )
-
-
-def _check_healthcheck(instructions):
-    if not any(i.cmd == "HEALTHCHECK" for i in instructions):
-        yield None, "Add a HEALTHCHECK instruction"
-
-
-_CHECKS = [
-    ("DS001", "':latest' tag used", "HIGH",
-     "Use a specific version tag for the image.", _check_latest_tag),
-    ("DS002", "Image user should not be 'root'", "HIGH",
-     "Add 'USER <non-root>' to the Dockerfile.", _check_root_user),
-    ("DS005", "ADD instead of COPY", "LOW",
-     "Use COPY for copying local resources.", _check_add),
-    ("DS010", "'sudo' usage", "HIGH",
-     "Don't use sudo; the build already runs as root.", _check_sudo),
-    ("DS017", "apt lists not cleaned up", "LOW",
-     "Clean apt cache in the same layer.", _check_apt_no_clean),
-    ("DS026", "No HEALTHCHECK defined", "LOW",
-     "Add HEALTHCHECK to allow container health monitoring.", _check_healthcheck),
-]
-
-
 def scan_dockerfile(file_path: str, content: bytes) -> Misconfiguration:
-    instructions = parse_dockerfile(content)
-    mc = Misconfiguration(file_type="dockerfile", file_path=file_path)
-    for check_id, title, severity, resolution, fn in _CHECKS:
-        failed = False
-        for ins, message in fn(instructions):
-            failed = True
-            mc.failures.append(
-                MisconfFinding(
-                    check_id=check_id,
-                    title=title,
-                    severity=severity,
-                    resolution=resolution,
-                    message=message,
-                    start_line=ins.start_line if ins else 0,
-                    end_line=ins.end_line if ins else 0,
-                )
-            )
-        if not failed:
-            mc.successes.append(
-                MisconfFinding(
-                    check_id=check_id, title=title, severity=severity,
-                    status="PASS",
-                )
-            )
+    """Rego-driven dockerfile scan (DS-series checks in trivy_tpu/iac/checks).
+
+    Kept as the misconf facade entry point; the hand-coded Python checks
+    this module originally carried are now .rego policies evaluated by
+    trivy_tpu/iac (the same engine user checks load into).
+    """
+    from trivy_tpu.iac.engine import shared_scanner
+
+    mc = shared_scanner().scan(file_path, content)
+    if mc is None:
+        return Misconfiguration(file_type="dockerfile", file_path=file_path)
     return mc

@@ -6,145 +6,11 @@ policies) over parsed YAML documents.
 
 from __future__ import annotations
 
-import yaml
 
-from trivy_tpu.misconf.types import MisconfFinding, Misconfiguration
+def scan_kubernetes(file_path: str, content: bytes):
+    """Rego-driven kubernetes scan (KSV-series checks in
+    trivy_tpu/iac/checks); returns None for YAML that is not a k8s
+    manifest."""
+    from trivy_tpu.iac.engine import shared_scanner
 
-_WORKLOAD_KINDS = {
-    "Pod", "Deployment", "StatefulSet", "DaemonSet", "ReplicaSet",
-    "Job", "CronJob",
-}
-
-
-def is_kubernetes(doc) -> bool:
-    return (
-        isinstance(doc, dict) and "apiVersion" in doc and "kind" in doc
-    )
-
-
-def _pod_spec(doc: dict) -> dict:
-    kind = doc.get("kind")
-    spec = doc.get("spec") or {}
-    if kind == "Pod":
-        return spec
-    if kind == "CronJob":
-        job_spec = (spec.get("jobTemplate") or {}).get("spec") or {}
-        return (job_spec.get("template") or {}).get("spec") or {}
-    return (spec.get("template") or {}).get("spec") or {}
-
-
-def _containers(pod_spec: dict):
-    for section in ("initContainers", "containers"):
-        for c in pod_spec.get(section) or []:
-            if isinstance(c, dict):
-                yield c
-
-
-def _check_privileged(doc, pod_spec):
-    for c in _containers(pod_spec):
-        sc = c.get("securityContext") or {}
-        if sc.get("privileged"):
-            yield f"Container '{c.get('name', '?')}' is privileged"
-
-
-def _check_run_as_nonroot(doc, pod_spec):
-    pod_sc = pod_spec.get("securityContext") or {}
-    for c in _containers(pod_spec):
-        sc = c.get("securityContext") or {}
-        if not (sc.get("runAsNonRoot") or pod_sc.get("runAsNonRoot")):
-            yield (
-                f"Container '{c.get('name', '?')}' should set "
-                "securityContext.runAsNonRoot to true"
-            )
-
-
-def _check_host_network(doc, pod_spec):
-    if pod_spec.get("hostNetwork"):
-        yield "Pod uses the host network namespace"
-
-
-def _check_host_pid_ipc(doc, pod_spec):
-    if pod_spec.get("hostPID"):
-        yield "Pod uses the host PID namespace"
-    if pod_spec.get("hostIPC"):
-        yield "Pod uses the host IPC namespace"
-
-
-def _check_hostpath(doc, pod_spec):
-    for v in pod_spec.get("volumes") or []:
-        if isinstance(v, dict) and "hostPath" in v:
-            yield f"Volume '{v.get('name', '?')}' mounts a hostPath"
-
-
-def _check_resource_limits(doc, pod_spec):
-    for c in _containers(pod_spec):
-        limits = (c.get("resources") or {}).get("limits") or {}
-        if "memory" not in limits:
-            yield f"Container '{c.get('name', '?')}' has no memory limit"
-
-
-def _check_allow_privilege_escalation(doc, pod_spec):
-    for c in _containers(pod_spec):
-        sc = c.get("securityContext") or {}
-        if sc.get("allowPrivilegeEscalation", True) and not sc.get("privileged"):
-            yield (
-                f"Container '{c.get('name', '?')}' should set "
-                "securityContext.allowPrivilegeEscalation to false"
-            )
-
-
-_CHECKS = [
-    ("KSV017", "Privileged container", "HIGH",
-     "Remove securityContext.privileged.", _check_privileged),
-    ("KSV012", "Runs as root user", "MEDIUM",
-     "Set securityContext.runAsNonRoot: true.", _check_run_as_nonroot),
-    ("KSV009", "Access to host network", "HIGH",
-     "Remove hostNetwork.", _check_host_network),
-    ("KSV010", "Access to host PID/IPC", "HIGH",
-     "Remove hostPID/hostIPC.", _check_host_pid_ipc),
-    ("KSV023", "hostPath volume mounted", "MEDIUM",
-     "Do not mount hostPath volumes.", _check_hostpath),
-    ("KSV018", "Memory limit not set", "LOW",
-     "Set resources.limits.memory.", _check_resource_limits),
-    ("KSV001", "Privilege escalation allowed", "MEDIUM",
-     "Set allowPrivilegeEscalation: false.", _check_allow_privilege_escalation),
-]
-
-
-def scan_kubernetes(file_path: str, content: bytes) -> Misconfiguration | None:
-    try:
-        docs = [d for d in yaml.safe_load_all(content) if is_kubernetes(d)]
-    except yaml.YAMLError:
-        return None
-    workloads = [d for d in docs if d.get("kind") in _WORKLOAD_KINDS]
-    if not docs:
-        return None
-
-    mc = Misconfiguration(file_type="kubernetes", file_path=file_path)
-    for check_id, title, severity, resolution, fn in _CHECKS:
-        failed = False
-        for doc in workloads:
-            pod_spec = _pod_spec(doc)
-            if not pod_spec:
-                continue
-            for message in fn(doc, pod_spec):
-                failed = True
-                mc.failures.append(
-                    MisconfFinding(
-                        check_id=check_id,
-                        title=title,
-                        severity=severity,
-                        resolution=resolution,
-                        message=f"{doc.get('kind')}/"
-                        f"{(doc.get('metadata') or {}).get('name', '?')}: "
-                        f"{message}",
-                    )
-                )
-        if workloads and not failed:
-            mc.successes.append(
-                MisconfFinding(
-                    check_id=check_id, title=title, severity=severity,
-                    status="PASS",
-                )
-            )
-    return mc
+    return shared_scanner().scan(file_path, content)
