@@ -475,6 +475,43 @@ deny[res] {
     assert "BRK001" not in ids_fail
 
 
+def test_hcl_arithmetic_expressions(scanner):
+    """r3 review: arithmetic in .tf must not kill the whole file."""
+    tf = b"""
+resource "aws_autoscaling_group" "a" {
+  max_size = 2 * 4
+  min_size = var.n + 1
+}
+
+resource "aws_security_group" "web" {
+  ingress {
+    cidr_blocks = ["0.0.0.0/0"]
+  }
+}
+"""
+    mc = scanner.scan("main.tf", tf)
+    assert mc is not None
+    assert "AVD-AWS-0107" in {f.check_id for f in mc.failures}
+
+
+def test_crashing_check_does_not_abort_file(scanner):
+    """r3 review: a builtin crashing on an odd input shape (image: 123)
+    must not suppress the file's other findings."""
+    y = b"""apiVersion: v1
+kind: Pod
+metadata: {name: a}
+spec:
+  containers:
+  - name: app
+    image: 123
+    securityContext:
+      privileged: true
+"""
+    mc = scanner.scan("pod.yaml", y)
+    assert mc is not None
+    assert "KSV017" in {f.check_id for f in mc.failures}
+
+
 def test_dockerfile_line_attribution(scanner):
     mc = scanner.scan(
         "Dockerfile", b"FROM golang:1.22\nRUN sudo make\nUSER app\nHEALTHCHECK CMD true\n"

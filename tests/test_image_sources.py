@@ -1,0 +1,242 @@
+"""Tests: registry image source, resolution chain, base-layer secret skip."""
+
+import gzip
+import hashlib
+import io
+import json
+import tarfile
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from trivy_tpu.artifact.image import (
+    ImageArtifact,
+    guess_base_image_index,
+    guess_base_layers,
+)
+from trivy_tpu.cache.store import MemoryCache
+from trivy_tpu.image import RegistryClient, parse_reference, resolve_image
+from trivy_tpu.image.registry import RegistryError
+
+
+def _layer_tar(files: dict[str, bytes], gz: bool = False) -> bytes:
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        for name, data in files.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    raw = buf.getvalue()
+    return gzip.compress(raw) if gz else raw
+
+
+def _digest(b: bytes) -> str:
+    return "sha256:" + hashlib.sha256(b).hexdigest()
+
+
+SECRET_BASE = b'base_key = "ghp_' + b"B" * 36 + b'"\n'
+SECRET_APP = b'app_key = "ghp_' + b"A" * 36 + b'"\n'
+
+
+def _fake_image():
+    """Two-layer image: base layer (ADD+CMD history) with a planted secret,
+    app layer (RUN) with another."""
+    base = _layer_tar({"etc/base.conf": SECRET_BASE}, gz=True)
+    app = _layer_tar({"srv/app.conf": SECRET_APP}, gz=True)
+    base_diff = _digest(gzip.decompress(base))
+    app_diff = _digest(gzip.decompress(app))
+    config = {
+        "architecture": "amd64",
+        "os": "linux",
+        "rootfs": {"type": "layers", "diff_ids": [base_diff, app_diff]},
+        "history": [
+            {"created_by": "/bin/sh -c #(nop) ADD file:aaa in / "},
+            {"created_by": '/bin/sh -c #(nop)  CMD ["/bin/sh"]', "empty_layer": True},
+            {"created_by": "/bin/sh -c echo app > /srv/app.conf"},
+            {"created_by": '/bin/sh -c #(nop)  CMD ["app"]', "empty_layer": True},
+        ],
+    }
+    raw_config = json.dumps(config).encode()
+    manifest = {
+        "schemaVersion": 2,
+        "mediaType": "application/vnd.docker.distribution.manifest.v2+json",
+        "config": {
+            "mediaType": "application/vnd.docker.container.image.v1+json",
+            "digest": _digest(raw_config),
+            "size": len(raw_config),
+        },
+        "layers": [
+            {
+                "mediaType": "application/vnd.docker.image.rootfs.diff.tar.gzip",
+                "digest": _digest(base),
+                "size": len(base),
+            },
+            {
+                "mediaType": "application/vnd.docker.image.rootfs.diff.tar.gzip",
+                "digest": _digest(app),
+                "size": len(app),
+            },
+        ],
+    }
+    blobs = {
+        _digest(raw_config): raw_config,
+        _digest(base): base,
+        _digest(app): app,
+    }
+    return manifest, blobs
+
+
+class _FakeRegistry(BaseHTTPRequestHandler):
+    manifest: dict = {}
+    blobs: dict = {}
+    require_token = False
+    issued_token = "testtoken123"
+
+    def log_message(self, *a):  # noqa: D102
+        pass
+
+    def _authed(self) -> bool:
+        if not self.require_token:
+            return True
+        return self.headers.get("Authorization") == f"Bearer {self.issued_token}"
+
+    def do_GET(self):  # noqa: N802
+        if self.path.startswith("/token"):
+            body = json.dumps({"token": self.issued_token}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if not self._authed():
+            self.send_response(401)
+            host = self.headers.get("Host", "localhost")
+            self.send_header(
+                "WWW-Authenticate",
+                f'Bearer realm="http://{host}/token",service="registry",scope="repository:pull"',
+            )
+            self.end_headers()
+            return
+        if "/manifests/" in self.path:
+            body = json.dumps(self.manifest).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", self.manifest.get("mediaType", ""))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if "/blobs/" in self.path:
+            digest = self.path.rsplit("/", 1)[-1]
+            blob = self.blobs.get(digest)
+            if blob is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(blob)
+            return
+        self.send_response(404)
+        self.end_headers()
+
+
+@pytest.fixture(scope="module")
+def registry():
+    manifest, blobs = _fake_image()
+    _FakeRegistry.manifest = manifest
+    _FakeRegistry.blobs = blobs
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeRegistry)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def test_parse_reference_forms():
+    r = parse_reference("alpine")
+    assert (r.registry, r.repository, r.tag) == (
+        "index.docker.io", "library/alpine", "latest",
+    )
+    r = parse_reference("ghcr.io/org/app:1.2")
+    assert (r.registry, r.repository, r.tag) == ("ghcr.io", "org/app", "1.2")
+    r = parse_reference("localhost:5000/app@sha256:" + "a" * 64)
+    assert r.registry == "localhost:5000"
+    assert r.digest.startswith("sha256:")
+
+
+def test_parse_reference_docker_io_alias():
+    r = parse_reference("docker.io/nginx:1.25")
+    assert (r.registry, r.repository, r.tag) == (
+        "index.docker.io", "library/nginx", "1.25",
+    )
+
+
+def test_registry_pull(registry):
+    src = RegistryClient(insecure=True).fetch_image(f"{registry}/test/app:1")
+    assert len(src.diff_ids) == 2
+    with src.layers[0]() as f:
+        names = tarfile.open(fileobj=f, mode="r:*").getnames()
+    assert names == ["etc/base.conf"]
+
+
+def test_registry_token_auth(registry):
+    _FakeRegistry.require_token = True
+    try:
+        src = RegistryClient(insecure=True).fetch_image(f"{registry}/test/app:1")
+        assert len(src.diff_ids) == 2
+    finally:
+        _FakeRegistry.require_token = False
+
+
+def test_resolve_chain_reports_all_sources():
+    with pytest.raises(RegistryError) as exc:
+        resolve_image("127.0.0.1:1/enoent/image:1", insecure_registry=True)
+    msg = str(exc.value)
+    assert "docker:" in msg and "containerd:" in msg and "podman:" in msg
+
+
+def test_guess_base_image_index_reference_semantics():
+    history = [
+        {"created_by": "ADD file:x in /"},
+        {"created_by": '/bin/sh -c #(nop)  CMD ["/bin/sh"]', "empty_layer": True},
+        {"created_by": "RUN apt-get update"},
+        {"created_by": "COPY mysecret /"},
+        {"created_by": 'ENTRYPOINT ["e.sh"]', "empty_layer": True},
+        {"created_by": 'CMD ["somecmd"]', "empty_layer": True},
+    ]
+    assert guess_base_image_index(history) == 1
+    diff_ids = ["sha256:l0", "sha256:l1", "sha256:l2"]
+    config = {"history": history}
+    assert guess_base_layers(diff_ids, config) == ["sha256:l0"]
+
+
+def test_guess_base_layers_no_cmd():
+    config = {"history": [{"created_by": "RUN x"}]}
+    assert guess_base_layers(["sha256:a"], config) == []
+
+
+def test_base_layer_secret_skip(registry):
+    """image.go:209-213: secrets in guessed base layers are not scanned;
+    the app layer's secret still is."""
+    src = RegistryClient(insecure=True).fetch_image(f"{registry}/test/app:1")
+    art = ImageArtifact("test/app:1", MemoryCache(), source=src)
+    base, app = src.diff_ids
+    assert guess_base_layers(src.diff_ids, src.config) == [base]
+
+    ref = art.inspect()
+    secrets = []
+    for bid in ref.blob_ids:
+        blob = art.cache.get_blob(bid)
+        if blob is not None:
+            secrets.extend(blob.secrets)
+    paths = {s.file_path for s in secrets}
+    assert "/srv/app.conf" in paths  # app layer scanned
+    assert not any("base.conf" in p for p in paths)  # base layer skipped
+
+
+def test_base_layer_cache_keys_differ(registry):
+    """Disabling secret scanning on a layer must change its cache key."""
+    src = RegistryClient(insecure=True).fetch_image(f"{registry}/test/app:1")
+    art = ImageArtifact("test/app:1", MemoryCache(), source=src)
+    d = src.diff_ids[0]
+    assert art._layer_key(d, ()) != art._layer_key(d, ("secret",))

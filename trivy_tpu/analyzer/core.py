@@ -213,11 +213,22 @@ class AnalyzerGroup:
             versions.setdefault(t, 0)
         return versions
 
-    def analyze_entries(self, dir: str, entries: Iterable[FileEntry]) -> AnalysisResult:
-        """Claim pass + batched dispatch (replaces AnalyzeFile fan-out)."""
+    def analyze_entries(
+        self,
+        dir: str,
+        entries: Iterable[FileEntry],
+        disabled: set[str] | None = None,
+    ) -> AnalysisResult:
+        """Claim pass + batched dispatch (replaces AnalyzeFile fan-out).
+
+        `disabled`: analyzer types suppressed for THIS call only — the
+        per-layer disabling seam (base layers skip secret scanning,
+        image.go:209-213)."""
         claims: dict[int, list[FileEntry]] = {i: [] for i in range(len(self.analyzers))}
         for entry in entries:
             for i, a in enumerate(self.analyzers):
+                if disabled and a.type() in disabled:
+                    continue
                 if a.required(entry.path, entry.size, entry.mode):
                     claims[i].append(entry)
 

@@ -113,6 +113,47 @@ def load_image(target: str) -> ImageSource:
     return load_docker_archive(target)
 
 
+def guess_base_image_index(history: list[dict]) -> int:
+    """pkg/fanal/image/image.go:111 GuessBaseImageIndex: walk history from
+    the bottom, skip the trailing empty layers (ENTRYPOINT/CMD of the built
+    image), and treat the next CMD as the end of the base image."""
+    base_index = -1
+    found_non_empty = False
+    for i in range(len(history) - 1, -1, -1):
+        h = history[i]
+        empty = bool(h.get("empty_layer"))
+        if not found_non_empty:
+            if empty:
+                continue
+            found_non_empty = True
+        if not empty:
+            continue
+        created_by = h.get("created_by", "")
+        if created_by.startswith("/bin/sh -c #(nop)  CMD") or created_by.startswith("CMD"):
+            base_index = i
+            break
+    return base_index
+
+
+def guess_base_layers(diff_ids: list[str], config: dict) -> list[str]:
+    """image.go:399 guessBaseLayers: diff IDs of the guessed base image
+    (empty layers carry no diff ID)."""
+    history = list(config.get("history") or [])
+    base_index = guess_base_image_index(history)
+    out: list[str] = []
+    di = 0
+    for i, h in enumerate(history):
+        if i > base_index:
+            break
+        if h.get("empty_layer"):
+            continue
+        if di >= len(diff_ids):
+            return []
+        out.append(diff_ids[di])
+        di += 1
+    return out
+
+
 class ImageArtifact:
     """artifact/image/image.go Artifact."""
 
@@ -121,16 +162,22 @@ class ImageArtifact:
         target: str,
         cache: ArtifactCache,
         analyzer_options: AnalyzerOptions | None = None,
+        source: ImageSource | None = None,
     ):
         self.target = target
         self.cache = cache
         self.group = AnalyzerGroup(analyzer_options)
-        self.source = load_image(target)
+        # `source` lets the daemon/registry chain (trivy_tpu/image) hand in
+        # an already-resolved image; plain paths load as archives/layouts.
+        self.source = source if source is not None else load_image(target)
 
-    def _layer_key(self, diff_id: str) -> str:
+    def _layer_key(self, diff_id: str, disabled: tuple[str, ...] = ()) -> str:
         h = hashlib.sha256()
         h.update(diff_id.encode())
         h.update(json.dumps(self.group.analyzer_versions(), sort_keys=True).encode())
+        # Per-layer disabled analyzers change the blob's contents, so they
+        # are part of the key (image.go calcCacheKey includes them).
+        h.update(json.dumps(sorted(disabled)).encode())
         return "sha256:" + h.hexdigest()
 
     def _artifact_key(self) -> str:
@@ -142,7 +189,17 @@ class ImageArtifact:
     def inspect(self) -> ArtifactReference:
         src = self.source
         diff_ids = src.diff_ids
-        layer_keys = [self._layer_key(d) for d in diff_ids]
+        # Base layers skip secret scanning (image.go:100-102, 209-213): the
+        # base image's secrets are the base image publisher's problem, and
+        # scanning them again in every derived image is pure waste.
+        base_diff_ids = set(guess_base_layers(diff_ids, src.config))
+        layer_disabled = [
+            ("secret",) if d in base_diff_ids else () for d in diff_ids
+        ]
+        layer_keys = [
+            self._layer_key(d, dis)
+            for d, dis in zip(diff_ids, layer_disabled)
+        ]
         artifact_key = self._artifact_key()
 
         config_key = "sha256:" + hashlib.sha256(
@@ -159,7 +216,9 @@ class ImageArtifact:
             if key not in missing:
                 continue
             created_by = history[i].get("created_by", "") if i < len(history) else ""
-            self._inspect_layer(i, diff_id, key, created_by)
+            self._inspect_layer(
+                i, diff_id, key, created_by, set(layer_disabled[i])
+            )
 
         if missing_artifact:
             cfg = src.config
@@ -192,14 +251,19 @@ class ImageArtifact:
         )
 
     def _inspect_layer(
-        self, index: int, diff_id: str, key: str, created_by: str
+        self,
+        index: int,
+        diff_id: str,
+        key: str,
+        created_by: str,
+        disabled: set[str] | None = None,
     ) -> None:
         """image.go:242 inspectLayer."""
         with self.source.layers[index]() as f:
             # Entries read lazily through the open tar; analysis happens
             # inside the `with` so only claimed files materialize.
             layer = walk_layer_tar(f)
-            result = self.group.analyze_entries("", layer.entries)
+            result = self.group.analyze_entries("", layer.entries, disabled)
         blob = BlobInfo(
             diff_id=diff_id,
             created_by=created_by,

@@ -125,6 +125,10 @@ def build_parser() -> argparse.ArgumentParser:
     p_image.add_argument(
         "--input", default="", help="tar archive path (docker save / OCI layout)"
     )
+    p_image.add_argument(
+        "--insecure", action="store_true",
+        help="allow plain-http registry access",
+    )
     p_image.set_defaults(kind=TARGET_IMAGE)
 
     p_repo = sub.add_parser("repository", aliases=["repo"], help="scan a git repository")
@@ -190,6 +194,7 @@ def main(argv: list[str] | None = None) -> int:
         options.scanners = ["misconfig"]
     if getattr(args, "input", ""):
         options.target = args.input
+    options.insecure_registry = getattr(args, "insecure", False)
     try:
         return run(options, args.kind)
     except ModuleNotFoundError as e:

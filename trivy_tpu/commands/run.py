@@ -57,6 +57,7 @@ class Options:
     vex_path: str = ""  # --vex document
     include_non_failures: bool = False
     config_check: list[str] = field(default_factory=list)  # --config-check dirs
+    insecure_registry: bool = False  # plain-http registry pulls
 
 
 def init_cache(options: Options) -> ArtifactCache:
@@ -110,12 +111,25 @@ def _build_scanner(options: Options, target_kind: str, cache: ArtifactCache) -> 
             artifact_type=artifact_type,
         )
     elif target_kind == TARGET_IMAGE:
+        import os as _os
+
         from trivy_tpu.artifact.image import ImageArtifact
 
+        source = None
+        if not _os.path.exists(options.target):
+            # Not an archive path: resolve through the daemon -> podman ->
+            # registry chain (image.go:26).
+            from trivy_tpu.image import resolve_image
+
+            source = resolve_image(
+                options.target,
+                insecure_registry=getattr(options, "insecure_registry", False),
+            )
         artifact = ImageArtifact(
             options.target,
             cache,
             analyzer_options=_analyzer_options(options, target_kind),
+            source=source,
         )
     elif target_kind == TARGET_SBOM:
         from trivy_tpu.artifact.sbom import SbomArtifact

@@ -148,9 +148,11 @@ class IacScanner:
                 ev = _Evaluator(doc, check.module.rules)
                 try:
                     denies = ev.eval_set_rule("deny")
-                except RegoError as e:
-                    # A policy that cannot evaluate must not read as green
-                    # (PASS); log and record nothing for this check.
+                except Exception as e:  # noqa: BLE001 — any check crash
+                    # A policy that cannot evaluate — RegoError or a builtin
+                    # crashing on unexpected input shapes — must not read as
+                    # green (PASS) nor abort the file's other checks; log
+                    # and record nothing for this check.
                     import logging
 
                     logging.getLogger(__name__).warning(

@@ -43,7 +43,7 @@ _TOKEN_RE = re.compile(
   | (?P<string>"(?:[^"\\]|\\.)*")
   | (?P<number>-?\d+(?:\.\d+)?)
   | (?P<name>[A-Za-z_][A-Za-z0-9_.-]*)
-  | (?P<punct>\{|\}|\[|\]|\(|\)|=|,|\?|:|\.)
+  | (?P<punct>\{|\}|\[|\]|\(|\)|==|!=|>=|<=|=|,|\?|:|\.|\+|-|\*|/|%|>|<|!|&&|\|\|)
   | (?P<nl>\n)
   | (?P<ws>[ \t\r]+)
 """,
@@ -180,6 +180,43 @@ class _Parser:
         # unreachable
 
     def parse_value(self) -> Any:
+        """Primary value plus infix folding: arithmetic/comparison chains on
+        non-literal operands collapse into opaque reference text (the same
+        treatment as function calls), and ``cond ? a : b`` resolves when the
+        condition is a literal bool."""
+        val = self._parse_primary_value()
+        while self.peek().kind == "punct" and self.peek(skip_nl=False).text in (
+            "+", "-", "*", "/", "%", "==", "!=", ">", "<", ">=", "<=",
+            "&&", "||",
+        ):
+            op = self.next().text
+            rhs = self._parse_primary_value()
+            if isinstance(val, (int, float)) and isinstance(rhs, (int, float))                     and not isinstance(val, bool) and not isinstance(rhs, bool)                     and op in ("+", "-", "*", "/", "%"):
+                try:
+                    val = {
+                        "+": lambda a, b: a + b,
+                        "-": lambda a, b: a - b,
+                        "*": lambda a, b: a * b,
+                        "/": lambda a, b: a / b,
+                        "%": lambda a, b: a % b,
+                    }[op](val, rhs)
+                    continue
+                except ZeroDivisionError:
+                    pass
+            val = _RefStr(f"{val} {op} {rhs}")
+        if self.at("punct", "?"):  # conditional
+            self.next()
+            a = self.parse_value()
+            self.expect("punct", ":")
+            b = self.parse_value()
+            if val is True:
+                return a
+            if val is False:
+                return b
+            return a  # unresolved condition: keep the true branch
+        return val
+
+    def _parse_primary_value(self) -> Any:
         t = self.peek()
         if t.kind == "string":
             self.next()
@@ -237,16 +274,6 @@ class _Parser:
                     if tok.kind != "nl":
                         parts.append(tok.text)
                 val = _RefStr("".join(parts))
-            if self.at("punct", "?"):  # conditional
-                self.next()
-                a = self.parse_value()
-                self.expect("punct", ":")
-                b = self.parse_value()
-                if val is True:
-                    return a
-                if val is False:
-                    return b
-                return a  # unresolved condition: keep the true branch
             return val
         if t.kind == "punct" and t.text == "[":
             self.next()

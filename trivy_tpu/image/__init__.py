@@ -1,0 +1,54 @@
+"""Image source resolution: archives, runtime daemons, remote registries.
+
+The reference probes docker daemon -> containerd -> podman -> remote
+registry in order, accumulating errors (pkg/fanal/image/image.go:26); the
+same chain lives in resolve_image below.  Archive paths (docker save tars,
+OCI layouts) bypass the chain via the artifact loader.
+"""
+
+from __future__ import annotations
+
+import os
+
+from trivy_tpu.image.daemon import (
+    SourceUnavailable,
+    containerd_image,
+    docker_image,
+    podman_image,
+)
+from trivy_tpu.image.registry import RegistryClient, RegistryError, parse_reference
+
+__all__ = [
+    "resolve_image",
+    "RegistryClient",
+    "RegistryError",
+    "SourceUnavailable",
+    "parse_reference",
+]
+
+
+def resolve_image(ref: str, insecure_registry: bool = False):
+    """Resolution chain (image.go:26): local archive path, then daemon ->
+    containerd -> podman -> registry; raises with every source's error when
+    all fail, like the reference's errs join."""
+    from trivy_tpu.artifact.image import load_image
+
+    if os.path.exists(ref):
+        return load_image(ref)
+    errors: list[str] = []
+    for name, source in (
+        ("docker", docker_image),
+        ("containerd", containerd_image),
+        ("podman", podman_image),
+    ):
+        try:
+            return source(ref)
+        except SourceUnavailable as e:
+            errors.append(f"{name}: {e}")
+    try:
+        return RegistryClient(insecure=insecure_registry).fetch_image(ref)
+    except RegistryError as e:
+        errors.append(f"registry: {e}")
+    raise RegistryError(
+        "unable to resolve image %r:\n  %s" % (ref, "\n  ".join(errors))
+    )

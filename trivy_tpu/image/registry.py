@@ -1,0 +1,247 @@
+"""OCI Distribution (registry) image source.
+
+The remote end of the reference's source chain
+(pkg/fanal/image/remote.go:15, backed by go-containerregistry): pull
+manifest + config + layer blobs over the Distribution API v2 so
+``image <name>`` works without a pre-exported archive.
+
+Implemented against the spec with stdlib HTTP only:
+  * ``GET /v2/<name>/manifests/<ref>`` with the manifest-list, OCI-index,
+    Docker-v2 and OCI-manifest media types accepted; indexes resolve to the
+    requested (default linux/amd64) platform.
+  * Bearer token auth: a 401 with ``WWW-Authenticate: Bearer realm=...``
+    triggers the token round-trip (anonymous or basic credentials), like
+    go-containerregistry's default keychain flow.
+  * Blobs download to spooled temp files; gzip/zstd layer compression is
+    transparent to the tar walker (tarfile mode "r:*").
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import shutil
+import re
+import tempfile
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field
+
+MANIFEST_ACCEPT = ", ".join(
+    [
+        "application/vnd.docker.distribution.manifest.v2+json",
+        "application/vnd.docker.distribution.manifest.list.v2+json",
+        "application/vnd.oci.image.manifest.v1+json",
+        "application/vnd.oci.image.index.v1+json",
+    ]
+)
+
+_INDEX_TYPES = {
+    "application/vnd.docker.distribution.manifest.list.v2+json",
+    "application/vnd.oci.image.index.v1+json",
+}
+
+
+class RegistryError(RuntimeError):
+    pass
+
+
+@dataclass
+class Reference:
+    """A parsed image reference (registry/repository:tag@digest)."""
+
+    registry: str
+    repository: str
+    tag: str = "latest"
+    digest: str = ""
+
+    @property
+    def name(self) -> str:
+        out = f"{self.registry}/{self.repository}"
+        if self.digest:
+            return f"{out}@{self.digest}"
+        return f"{out}:{self.tag}"
+
+
+def parse_reference(ref: str) -> Reference:
+    """Docker-style reference normalization: bare names go to
+    index.docker.io with the library/ prefix (image.go's behavior through
+    go-containerregistry's name.ParseReference)."""
+    digest = ""
+    if "@" in ref:
+        ref, _, digest = ref.partition("@")
+    head, _, rest = ref.partition("/")
+    if rest and ("." in head or ":" in head or head == "localhost"):
+        registry, repo = head, rest
+    else:
+        registry, repo = "index.docker.io", ref
+    if registry in ("docker.io", "registry-1.docker.io"):
+        registry = "index.docker.io"
+    if registry == "index.docker.io" and "/" not in repo:
+        repo = "library/" + repo  # official images live under library/
+    tag = "latest"
+    if ":" in repo.rsplit("/", 1)[-1]:
+        repo, _, tag = repo.rpartition(":")
+    return Reference(registry=registry, repository=repo, tag=tag, digest=digest)
+
+
+@dataclass
+class RegistryClient:
+    """Minimal Distribution API client (one registry host per instance)."""
+
+    insecure: bool = False  # plain http (local/test registries)
+    username: str = ""
+    password: str = ""
+    platform_os: str = "linux"
+    platform_arch: str = "amd64"
+    _tokens: dict[str, str] = field(default_factory=dict)
+
+    def _scheme(self, registry: str) -> str:
+        if self.insecure or registry.startswith(("localhost", "127.0.0.1")):
+            return "http"
+        return "https"
+
+    def _request(
+        self, url: str, headers: dict[str, str], token_scope: str
+    ) -> tuple[bytes, dict[str, str]]:
+        hdrs = dict(headers)
+        tok = self._tokens.get(token_scope)
+        if tok:
+            hdrs["Authorization"] = f"Bearer {tok}"
+        elif self.username:
+            cred = base64.b64encode(
+                f"{self.username}:{self.password}".encode()
+            ).decode()
+            hdrs["Authorization"] = f"Basic {cred}"
+        req = urllib.request.Request(url, headers=hdrs)
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.read(), dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            if e.code == 401 and "Authorization" not in hdrs:
+                challenge = e.headers.get("WWW-Authenticate", "")
+                token = self._fetch_token(challenge)
+                if token:
+                    self._tokens[token_scope] = token
+                    return self._request(url, headers, token_scope)
+            raise RegistryError(f"registry: GET {url}: HTTP {e.code}") from e
+        except urllib.error.URLError as e:
+            raise RegistryError(f"registry: GET {url}: {e.reason}") from e
+
+    def _fetch_token(self, challenge: str) -> str:
+        """Bearer token round-trip from a WWW-Authenticate challenge."""
+        if not challenge.lower().startswith("bearer"):
+            return ""
+        params = dict(re.findall(r'(\w+)="([^"]*)"', challenge))
+        realm = params.get("realm")
+        if not realm:
+            return ""
+        query = []
+        if params.get("service"):
+            query.append("service=" + urllib.parse.quote(params["service"]))
+        if params.get("scope"):
+            query.append("scope=" + urllib.parse.quote(params["scope"]))
+        url = realm + ("?" + "&".join(query) if query else "")
+        headers = {}
+        if self.username:
+            cred = base64.b64encode(
+                f"{self.username}:{self.password}".encode()
+            ).decode()
+            headers["Authorization"] = f"Basic {cred}"
+        req = urllib.request.Request(url, headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                doc = json.loads(resp.read())
+        except (urllib.error.URLError, ValueError):
+            return ""
+        return doc.get("token") or doc.get("access_token") or ""
+
+    # ------------------------------------------------------------------
+
+    def get_manifest(self, ref: Reference) -> tuple[dict, bytes]:
+        base = f"{self._scheme(ref.registry)}://{ref.registry}/v2/{ref.repository}"
+        target = ref.digest or ref.tag
+        raw, _ = self._request(
+            f"{base}/manifests/{target}",
+            {"Accept": MANIFEST_ACCEPT},
+            ref.repository,
+        )
+        manifest = json.loads(raw)
+        if manifest.get("mediaType") in _INDEX_TYPES or "manifests" in manifest:
+            desc = self._pick_platform(manifest)
+            raw, _ = self._request(
+                f"{base}/manifests/{desc['digest']}",
+                {"Accept": MANIFEST_ACCEPT},
+                ref.repository,
+            )
+            manifest = json.loads(raw)
+        return manifest, raw
+
+    def _pick_platform(self, index: dict) -> dict:
+        best = None
+        for desc in index.get("manifests", []):
+            plat = desc.get("platform") or {}
+            if (
+                plat.get("os", self.platform_os) == self.platform_os
+                and plat.get("architecture", self.platform_arch)
+                == self.platform_arch
+            ):
+                return desc
+            best = best or desc
+        if best is None:
+            raise RegistryError("registry: empty manifest index")
+        return best
+
+    def get_blob(self, ref: Reference, digest: str):
+        """Stream a blob into a spooled temp file; returns the open file
+        positioned at 0 (caller owns/closes it).  Streaming keeps multi-GB
+        layers out of resident memory."""
+        base = f"{self._scheme(ref.registry)}://{ref.registry}/v2/{ref.repository}"
+        url = f"{base}/blobs/{digest}"
+        hdrs: dict[str, str] = {}
+        tok = self._tokens.get(ref.repository)
+        if tok:
+            hdrs["Authorization"] = f"Bearer {tok}"
+        elif self.username:
+            cred = base64.b64encode(
+                f"{self.username}:{self.password}".encode()
+            ).decode()
+            hdrs["Authorization"] = f"Basic {cred}"
+        req = urllib.request.Request(url, headers=hdrs)
+        try:
+            resp = urllib.request.urlopen(req, timeout=300)
+        except urllib.error.HTTPError as e:
+            if e.code == 401 and "Authorization" not in hdrs:
+                token = self._fetch_token(e.headers.get("WWW-Authenticate", ""))
+                if token:
+                    self._tokens[ref.repository] = token
+                    return self.get_blob(ref, digest)
+            raise RegistryError(f"registry: GET {url}: HTTP {e.code}") from e
+        except urllib.error.URLError as e:
+            raise RegistryError(f"registry: GET {url}: {e.reason}") from e
+        f = tempfile.SpooledTemporaryFile(max_size=32 << 20)
+        with resp:
+            shutil.copyfileobj(resp, f, length=1 << 20)
+        f.seek(0)
+        return f
+
+    def fetch_image(self, ref_str: str):
+        """Resolve a reference into an ImageSource (artifact/image.py)."""
+        from trivy_tpu.artifact.image import ImageSource, _sha256_hex
+
+        ref = parse_reference(ref_str)
+        manifest, _raw = self.get_manifest(ref)
+        with self.get_blob(ref, manifest["config"]["digest"]) as f:
+            raw_config = f.read()
+        layers = [
+            (lambda d=layer["digest"]: self.get_blob(ref, d))
+            for layer in manifest.get("layers", [])
+        ]
+        return ImageSource(
+            config=json.loads(raw_config),
+            config_digest=_sha256_hex(raw_config),
+            layers=layers,
+            repo_tags=[f"{ref.repository}:{ref.tag}"] if not ref.digest else [],
+            repo_digests=[ref.name] if ref.digest else [],
+        )
