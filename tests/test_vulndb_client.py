@@ -1,0 +1,303 @@
+"""Tests: OCI vuln-DB distribution, NeedsUpdate semantics, EOL tables,
+severity-source precedence."""
+
+import datetime as dt
+import hashlib
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from trivy_tpu.db.client import (
+    MEDIA_TYPE,
+    SCHEMA_VERSION,
+    DBClient,
+    DBError,
+    Metadata,
+    build_db_archive,
+)
+from trivy_tpu.db.vulndb import Advisory
+from trivy_tpu.detector.eol import is_supported_version
+from trivy_tpu.detector.severity import resolve_severity
+
+UTC = dt.timezone.utc
+
+
+def _digest(b: bytes) -> str:
+    return "sha256:" + hashlib.sha256(b).hexdigest()
+
+
+# A real advisory: CVE-2023-42363 (busybox awk use-after-free), fixed in
+# 1.36.1-r1 for alpine 3.19 — the integration "real CVE" fixture.
+DB_BUCKETS = {
+    "alpine 3.19": {
+        "busybox": [
+            {
+                "VulnerabilityID": "CVE-2023-42363",
+                "FixedVersion": "1.36.1-r1",
+                "Severity": "MEDIUM",
+                "VendorSeverity": {"alpine": "MEDIUM", "nvd": "HIGH"},
+                "Title": "busybox: use-after-free in awk",
+            }
+        ]
+    }
+}
+
+
+class _DBRegistry(BaseHTTPRequestHandler):
+    layer = b""
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):  # noqa: N802
+        if "/manifests/" in self.path:
+            manifest = {
+                "schemaVersion": 2,
+                "mediaType": "application/vnd.oci.image.manifest.v1+json",
+                "config": {"mediaType": "application/vnd.oci.empty.v1+json",
+                           "digest": _digest(b"{}"), "size": 2},
+                "layers": [{
+                    "mediaType": MEDIA_TYPE,
+                    "digest": _digest(self.layer),
+                    "size": len(self.layer),
+                }],
+            }
+            body = json.dumps(manifest).encode()
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if "/blobs/" in self.path:
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(self.layer)
+            return
+        self.send_response(404)
+        self.end_headers()
+
+
+@pytest.fixture(scope="module")
+def db_registry():
+    _DBRegistry.layer = build_db_archive(
+        DB_BUCKETS, next_update="2099-01-01T00:00:00Z"
+    )
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _DBRegistry)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"127.0.0.1:{srv.server_address[1]}/aquasecurity/trivy-db:2"
+    srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# NeedsUpdate semantics (db.go:96)
+# ---------------------------------------------------------------------------
+
+
+def _client(tmp_path, now, **kw):
+    return DBClient(
+        db_dir=str(tmp_path), clock=lambda: now, insecure=True, **kw
+    )
+
+
+def _write_meta(tmp_path, meta: Metadata):
+    (tmp_path / "metadata.json").write_text(json.dumps(meta.to_json()))
+
+
+def test_needs_update_first_run(tmp_path):
+    now = dt.datetime(2026, 1, 1, tzinfo=UTC)
+    assert _client(tmp_path, now).needs_update() is True
+
+
+def test_needs_update_skip_on_first_run_errors(tmp_path):
+    now = dt.datetime(2026, 1, 1, tzinfo=UTC)
+    with pytest.raises(DBError):
+        _client(tmp_path, now).needs_update(skip=True)
+
+
+def test_needs_update_fresh_db_skipped(tmp_path):
+    now = dt.datetime(2026, 1, 1, tzinfo=UTC)
+    _write_meta(tmp_path, Metadata(
+        version=SCHEMA_VERSION, next_update="2026-06-01T00:00:00Z",
+    ))
+    assert _client(tmp_path, now).needs_update() is False
+
+
+def test_needs_update_stale_db(tmp_path):
+    now = dt.datetime(2026, 1, 1, tzinfo=UTC)
+    _write_meta(tmp_path, Metadata(
+        version=SCHEMA_VERSION, next_update="2025-01-01T00:00:00Z",
+        downloaded_at="2025-01-01T00:00:00Z",
+    ))
+    assert _client(tmp_path, now).needs_update() is True
+
+
+def test_needs_update_one_hour_throttle(tmp_path):
+    """db.go:145: a download within the last hour suppresses re-download
+    even past NextUpdate."""
+    now = dt.datetime(2026, 1, 1, 10, 30, tzinfo=UTC)
+    _write_meta(tmp_path, Metadata(
+        version=SCHEMA_VERSION, next_update="2025-01-01T00:00:00Z",
+        downloaded_at="2026-01-01T10:00:00Z",
+    ))
+    assert _client(tmp_path, now).needs_update() is False
+
+
+def test_needs_update_newer_schema_errors(tmp_path):
+    now = dt.datetime(2026, 1, 1, tzinfo=UTC)
+    _write_meta(tmp_path, Metadata(version=SCHEMA_VERSION + 1))
+    with pytest.raises(DBError):
+        _client(tmp_path, now).needs_update()
+
+
+def test_needs_update_old_schema_updates(tmp_path):
+    now = dt.datetime(2026, 1, 1, tzinfo=UTC)
+    _write_meta(tmp_path, Metadata(version=SCHEMA_VERSION - 1))
+    assert _client(tmp_path, now).needs_update() is True
+    with pytest.raises(DBError):
+        _client(tmp_path, now).needs_update(skip=True)
+
+
+# ---------------------------------------------------------------------------
+# download + end-to-end detection of a real CVE
+# ---------------------------------------------------------------------------
+
+
+def test_download_and_detect_real_cve(tmp_path, db_registry):
+    now = dt.datetime(2026, 1, 1, tzinfo=UTC)
+    client = _client(tmp_path, now, repository=db_registry)
+    assert client.ensure() is True
+    meta = client.metadata()
+    assert meta is not None and meta.downloaded_at.startswith("2026-01-01")
+    # fresh DB: a second ensure is a no-op (NextUpdate 2099)
+    assert client.ensure() is False
+
+    from trivy_tpu.atypes import OS, Package
+    from trivy_tpu.db.vulndb import VulnDB
+    from trivy_tpu.detector.ospkg import OSPkgDetector
+
+    det = OSPkgDetector(db=VulnDB(str(tmp_path)))
+    vulns = det.detect(
+        OS(family="alpine", name="3.19.1"),
+        [Package(name="busybox", version="1.36.1", release="r0")],
+    )
+    assert [v.vulnerability_id for v in vulns] == ["CVE-2023-42363"]
+    v = vulns[0]
+    assert v.fixed_version == "1.36.1-r1"
+    # severity precedence picked the detection source (alpine), not NVD
+    assert (v.severity, v.severity_source) == ("MEDIUM", "alpine")
+
+
+def test_scan_cli_with_downloaded_db(tmp_path, db_registry):
+    """fs --scanners vuln detects the CVE from the downloaded DB."""
+    import contextlib
+    import io
+
+    from trivy_tpu.db.client import DBClient
+    from trivy_tpu.cli import main
+
+    dbdir = tmp_path / "db"
+    DBClient(db_dir=str(dbdir), repository=db_registry, insecure=True).ensure()
+
+    root = tmp_path / "rootfs"
+    (root / "lib" / "apk" / "db").mkdir(parents=True)
+    (root / "etc").mkdir()
+    (root / "etc" / "os-release").write_text(
+        'ID=alpine\nVERSION_ID=3.19.1\nPRETTY_NAME="Alpine Linux v3.19"\n'
+    )
+    (root / "lib" / "apk" / "db" / "installed").write_text(
+        "C:Q1abcdef\nP:busybox\nV:1.36.1-r0\nA:x86_64\n\n"
+    )
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main([
+            "rootfs", "--scanners", "vuln", "--format", "json",
+            "--db-dir", str(dbdir), str(root),
+        ])
+    report = json.loads(buf.getvalue())
+    ids = [
+        v["VulnerabilityID"]
+        for r in report["Results"]
+        for v in r.get("Vulnerabilities", [])
+    ]
+    assert "CVE-2023-42363" in ids
+
+
+# ---------------------------------------------------------------------------
+# EOL tables
+# ---------------------------------------------------------------------------
+
+
+def test_eol_supported_and_unsupported(caplog):
+    now = dt.datetime(2026, 1, 1, tzinfo=UTC)
+    with caplog.at_level(logging.WARNING, logger="trivy_tpu.detector.eol"):
+        assert is_supported_version("alpine", "3.10", now) is False
+    assert "no longer supported" in caplog.text
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="trivy_tpu.detector.eol"):
+        assert is_supported_version("debian", "12", now) is True
+    assert caplog.text == ""
+    with caplog.at_level(logging.WARNING, logger="trivy_tpu.detector.eol"):
+        assert is_supported_version("alpine", "99.99", now) is True
+    assert "not on the EOL list" in caplog.text
+
+
+def test_detector_warns_on_eol_os(caplog):
+    from trivy_tpu.atypes import OS
+    from trivy_tpu.db.vulndb import VulnDB
+    from trivy_tpu.detector.ospkg import OSPkgDetector
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        det = OSPkgDetector(db=VulnDB(d))
+        with caplog.at_level(logging.WARNING, logger="trivy_tpu.detector.eol"):
+            det.detect(OS(family="alpine", name="3.10.2"), [])
+    assert "no longer supported" in caplog.text
+
+
+# ---------------------------------------------------------------------------
+# severity-source precedence
+# ---------------------------------------------------------------------------
+
+
+def test_severity_precedence_detection_source_first():
+    adv = Advisory(
+        vulnerability_id="CVE-1", severity="LOW",
+        severity_sources={"debian": "high", "nvd": "critical"},
+    )
+    assert resolve_severity(adv, "debian") == ("HIGH", "debian")
+
+
+def test_severity_precedence_nvd_fallback():
+    adv = Advisory(
+        vulnerability_id="CVE-1", severity="LOW",
+        severity_sources={"nvd": "critical"},
+    )
+    assert resolve_severity(adv, "alpine") == ("CRITICAL", "nvd")
+
+
+def test_severity_precedence_ghsa_for_ghsa_ids():
+    adv = Advisory(
+        vulnerability_id="GHSA-xxxx", severity="LOW",
+        severity_sources={"ghsa": "moderate", "nvd": "critical"},
+    )
+    # GHSA "moderate" normalizes to the canonical MEDIUM so the default
+    # severity filter does not silently drop it (r3 review)
+    assert resolve_severity(adv, "npm") == ("MEDIUM", "ghsa")
+
+
+def test_severity_normalization_vendor_vocabularies():
+    from trivy_tpu.detector.severity import normalize_severity
+
+    assert normalize_severity("moderate") == "MEDIUM"
+    assert normalize_severity("Important") == "HIGH"
+    assert normalize_severity("negligible") == "LOW"
+    assert normalize_severity("untriaged") == "UNKNOWN"
+    assert normalize_severity("weird") == "UNKNOWN"
+    assert normalize_severity("CRITICAL") == "CRITICAL"
+
+
+def test_severity_precedence_bare_fallbacks():
+    assert resolve_severity(Advisory("CVE-1", severity="low"), "x") == ("LOW", "")
+    assert resolve_severity(Advisory("CVE-1"), "x") == ("UNKNOWN", "")

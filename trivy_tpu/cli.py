@@ -77,6 +77,15 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
         "--config-check", action="append", default=[],
         help="directory with custom .rego checks (repeatable)",
     )
+    p.add_argument(
+        "--db-repository", default=_env_default("db-repository", ""),
+        help="OCI reference to pull the vulnerability DB from",
+    )
+    p.add_argument("--skip-db-update", action="store_true")
+    p.add_argument(
+        "--insecure", action="store_true",
+        help="allow plain-http registry access (images and DB pulls)",
+    )
 
 
 def _options_from_args(args: argparse.Namespace) -> Options:
@@ -102,6 +111,8 @@ def _options_from_args(args: argparse.Namespace) -> Options:
         vex_path=args.vex,
         include_non_failures=args.include_non_failures,
         config_check=list(args.config_check),
+        db_repository=args.db_repository,
+        skip_db_update=args.skip_db_update,
     )
 
 
@@ -124,10 +135,6 @@ def build_parser() -> argparse.ArgumentParser:
     _add_scan_flags(p_image, "vuln,secret")
     p_image.add_argument(
         "--input", default="", help="tar archive path (docker save / OCI layout)"
-    )
-    p_image.add_argument(
-        "--insecure", action="store_true",
-        help="allow plain-http registry access",
     )
     p_image.set_defaults(kind=TARGET_IMAGE)
 
@@ -200,6 +207,14 @@ def main(argv: list[str] | None = None) -> int:
     except ModuleNotFoundError as e:
         print(f"trivy-tpu: {args.command}: not implemented yet ({e.name})", file=sys.stderr)
         return 2
+    except Exception as e:
+        from trivy_tpu.db.client import DBError
+        from trivy_tpu.image.registry import RegistryError
+
+        if isinstance(e, (DBError, RegistryError)):
+            print(f"trivy-tpu: {e}", file=sys.stderr)
+            return 2
+        raise
 
 
 if __name__ == "__main__":

@@ -58,6 +58,8 @@ class Options:
     include_non_failures: bool = False
     config_check: list[str] = field(default_factory=list)  # --config-check dirs
     insecure_registry: bool = False  # plain-http registry pulls
+    db_repository: str = ""  # OCI ref for the vuln DB (--db-repository)
+    skip_db_update: bool = False
 
 
 def init_cache(options: Options) -> ArtifactCache:
@@ -159,10 +161,27 @@ def _build_scanner(options: Options, target_kind: str, cache: ArtifactCache) -> 
 
 
 def _init_vuln_scanner(options: Options):
-    """operation.DownloadDB analogue: open the local DB if present (network
-    download of the OCI-distributed DB is a connected-deployment concern)."""
+    """operation.DownloadDB analogue (operation.go:114): gate on NeedsUpdate,
+    pull the OCI-distributed DB when stale, then open the local DB."""
     from trivy_tpu.scanner.vuln import init_vuln_scanner
 
+    if options.db_repository or options.skip_db_update:
+        import os as _os
+
+        from trivy_tpu.db.client import DEFAULT_REPOSITORY, DBClient
+
+        # Resolve the directory the same way init_vuln_scanner will, so
+        # --db-repository with only --cache-dir downloads into the dir the
+        # scanner then opens.
+        db_dir = options.db_dir or (
+            _os.path.join(options.cache_dir, "db") if options.cache_dir else ""
+        )
+        if db_dir:
+            DBClient(
+                db_dir=db_dir,
+                repository=options.db_repository or DEFAULT_REPOSITORY,
+                insecure=options.insecure_registry,
+            ).ensure(skip=options.skip_db_update)
     return init_vuln_scanner(options.db_dir, options.cache_dir)
 
 

@@ -30,6 +30,9 @@ class Advisory:
     description: str = ""
     references: list[str] = field(default_factory=list)
     cvss_score: float = 0.0
+    # source id -> severity string (trivy-db VendorSeverity); consumed by
+    # the severity-source precedence resolution (detector/severity.py)
+    severity_sources: dict[str, str] = field(default_factory=dict)
 
     def to_json(self) -> dict[str, Any]:
         out: dict[str, Any] = {"VulnerabilityID": self.vulnerability_id}
@@ -47,6 +50,8 @@ class Advisory:
             out["References"] = self.references
         if self.cvss_score:
             out["CVSSScore"] = self.cvss_score
+        if self.severity_sources:
+            out["VendorSeverity"] = dict(self.severity_sources)
         return out
 
     @classmethod
@@ -58,6 +63,7 @@ class Advisory:
             severity=d.get("Severity", ""),
             title=d.get("Title", ""),
             description=d.get("Description", ""),
+            severity_sources=dict(d.get("VendorSeverity") or {}),
             references=list(d.get("References") or []),
             cvss_score=d.get("CVSSScore", 0.0),
         )

@@ -11,6 +11,7 @@ from dataclasses import dataclass
 
 from trivy_tpu.atypes import Application
 from trivy_tpu.db.vulndb import VulnDB
+from trivy_tpu.detector.severity import resolve_severity
 from trivy_tpu.detector.version_cmp import COMPARATORS, version_in_range
 from trivy_tpu.ftypes import DetectedVulnerability
 
@@ -55,6 +56,7 @@ class LibraryDetector:
                     vulnerable = cmp(pkg.version, adv.fixed_version) < 0
                 if not vulnerable:
                     continue
+                severity, severity_source = resolve_severity(adv, source)
                 out.append(
                     DetectedVulnerability(
                         vulnerability_id=adv.vulnerability_id,
@@ -62,7 +64,8 @@ class LibraryDetector:
                         pkg_name=pkg.name,
                         installed_version=pkg.version,
                         fixed_version=adv.fixed_version,
-                        severity=adv.severity or "UNKNOWN",
+                        severity=severity,
+                        severity_source=severity_source,
                         title=adv.title,
                         description=adv.description,
                         references=list(adv.references),

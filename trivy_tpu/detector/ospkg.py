@@ -11,6 +11,8 @@ from dataclasses import dataclass
 
 from trivy_tpu.atypes import OS, Package
 from trivy_tpu.db.vulndb import VulnDB
+from trivy_tpu.detector.eol import is_supported_version
+from trivy_tpu.detector.severity import resolve_severity
 from trivy_tpu.detector.version_cmp import COMPARATORS
 from trivy_tpu.ftypes import DetectedVulnerability
 
@@ -58,6 +60,10 @@ class OSPkgDetector:
         prefix, flavor, precision = driver
         source = _release_bucket(prefix, os_info.name, precision)
         cmp = COMPARATORS[flavor]
+        # EOL gate (detect.go:32-49 drivers + osver.Supported): warn on
+        # outdated or unknown OS versions; detection proceeds regardless.
+        release = source.partition(" ")[2] or os_info.name
+        is_supported_version(os_info.family, release)
 
         out: list[DetectedVulnerability] = []
         for pkg in packages:
@@ -73,6 +79,7 @@ class OSPkgDetector:
                     if adv.fixed_version and cmp(installed, adv.fixed_version) >= 0:
                         continue
                     seen.add(adv.vulnerability_id)
+                    severity, severity_source = resolve_severity(adv, prefix)
                     out.append(
                         DetectedVulnerability(
                             vulnerability_id=adv.vulnerability_id,
@@ -80,7 +87,8 @@ class OSPkgDetector:
                             pkg_name=pkg.name,
                             installed_version=installed,
                             fixed_version=adv.fixed_version,
-                            severity=adv.severity or "UNKNOWN",
+                            severity=severity,
+                            severity_source=severity_source,
                             title=adv.title,
                             description=adv.description,
                             references=list(adv.references),
