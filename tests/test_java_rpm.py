@@ -1,0 +1,341 @@
+"""Tests: Java (jar/war/pom/gradle) and rpm verticals."""
+
+import hashlib
+import io
+import sqlite3
+import struct
+import zipfile
+
+import pytest
+
+from trivy_tpu.analyzer.java import (
+    parse_gradle_lock,
+    parse_jar,
+    parse_pom,
+)
+from trivy_tpu.analyzer.pkg_rpm import (
+    _src_name,
+    parse_header_blob,
+    parse_rpmdb_sqlite,
+)
+from trivy_tpu.detector.version_cmp import compare_maven, compare_rpm
+from trivy_tpu.javadb import JavaDB, build_javadb
+
+
+# ---------------------------------------------------------------------------
+# jar / war
+# ---------------------------------------------------------------------------
+
+
+def _make_jar(
+    props: tuple[str, str, str] | None = None,
+    manifest: dict[str, str] | None = None,
+    nested: dict[str, bytes] | None = None,
+) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        if props:
+            g, a, v = props
+            zf.writestr(
+                f"META-INF/maven/{g}/{a}/pom.properties",
+                f"groupId={g}\nartifactId={a}\nversion={v}\n",
+            )
+        if manifest:
+            body = "".join(f"{k}: {v}\n" for k, v in manifest.items())
+            zf.writestr("META-INF/MANIFEST.MF", body)
+        for name, data in (nested or {}).items():
+            zf.writestr(name, data)
+    return buf.getvalue()
+
+
+def test_jar_pom_properties():
+    jar = _make_jar(props=("org.apache.logging.log4j", "log4j-core", "2.14.1"))
+    pkgs = parse_jar(jar, "app/log4j-core-2.14.1.jar")
+    assert [(p.name, p.version) for p in pkgs] == [
+        ("org.apache.logging.log4j:log4j-core", "2.14.1")
+    ]
+
+
+def test_war_nested_jars():
+    inner = _make_jar(props=("com.fasterxml.jackson.core", "jackson-databind", "2.9.1"))
+    war = _make_jar(
+        props=("com.example", "webapp", "1.0"),
+        nested={"WEB-INF/lib/jackson-databind-2.9.1.jar": inner},
+    )
+    pkgs = parse_jar(war, "app.war")
+    names = {(p.name, p.version) for p in pkgs}
+    assert ("com.example:webapp", "1.0") in names
+    assert ("com.fasterxml.jackson.core:jackson-databind", "2.9.1") in names
+
+
+def test_jar_manifest_fallback():
+    jar = _make_jar(manifest={
+        "Implementation-Title": "guava",
+        "Implementation-Version": "31.1-jre",
+    })
+    pkgs = parse_jar(jar, "guava.jar")
+    assert [(p.name, p.version) for p in pkgs] == [("guava", "31.1-jre")]
+
+
+def test_jar_filename_fallback():
+    jar = _make_jar()
+    pkgs = parse_jar(jar, "lib/commons-text-1.9.jar")
+    assert [(p.name, p.version) for p in pkgs] == [("commons-text", "1.9")]
+
+
+def test_jar_javadb_digest_lookup(tmp_path):
+    jar = _make_jar()  # no identifying metadata inside
+    sha1 = hashlib.sha1(jar).hexdigest()
+    build_javadb(str(tmp_path), {sha1: "org.example:mystery:9.9.9"})
+    pkgs = parse_jar(jar, "mystery.bin.jar", javadb=JavaDB(str(tmp_path)))
+    assert [(p.name, p.version) for p in pkgs] == [
+        ("org.example:mystery", "9.9.9")
+    ]
+
+
+# ---------------------------------------------------------------------------
+# pom.xml / gradle.lockfile
+# ---------------------------------------------------------------------------
+
+
+def test_pom_parse_with_properties_and_parent():
+    pom = b"""<?xml version="1.0"?>
+<project xmlns="http://maven.apache.org/POM/4.0.0">
+  <parent>
+    <groupId>com.example</groupId>
+    <version>2.0.0</version>
+  </parent>
+  <artifactId>svc</artifactId>
+  <properties>
+    <jackson.version>2.12.3</jackson.version>
+  </properties>
+  <dependencies>
+    <dependency>
+      <groupId>com.fasterxml.jackson.core</groupId>
+      <artifactId>jackson-databind</artifactId>
+      <version>${jackson.version}</version>
+    </dependency>
+    <dependency>
+      <groupId>org.junit</groupId>
+      <artifactId>junit</artifactId>
+      <version>5.0</version>
+      <scope>test</scope>
+    </dependency>
+    <dependency>
+      <groupId>org.unresolved</groupId>
+      <artifactId>x</artifactId>
+      <version>${missing.prop}</version>
+    </dependency>
+  </dependencies>
+</project>
+"""
+    pkgs = parse_pom(pom)
+    got = {(p.name, p.version) for p in pkgs}
+    assert ("com.example:svc", "2.0.0") in got
+    assert ("com.fasterxml.jackson.core:jackson-databind", "2.12.3") in got
+    assert not any("junit" in n for n, _ in got)  # test scope skipped
+    assert not any("unresolved" in n for n, _ in got)
+
+
+def test_gradle_lockfile():
+    lock = b"""# This is a Gradle generated file
+com.squareup.okio:okio:2.8.0=compileClasspath,runtimeClasspath
+org.slf4j:slf4j-api:1.7.30=runtimeClasspath
+empty=annotationProcessor
+"""
+    pkgs = parse_gradle_lock(lock)
+    assert {(p.name, p.version) for p in pkgs} == {
+        ("com.squareup.okio:okio", "2.8.0"),
+        ("org.slf4j:slf4j-api", "1.7.30"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# rpm header blobs + sqlite rpmdb
+# ---------------------------------------------------------------------------
+
+
+def encode_header_blob(values: dict[int, object]) -> bytes:
+    """Test-only encoder for the rpm header store format the analyzer
+    decodes: strings as type 6, ints as type 4."""
+    index = b""
+    data = b""
+    for tag, val in values.items():
+        off = len(data)
+        if isinstance(val, int):
+            # INT32 entries are 4-aligned in real headers
+            while len(data) % 4:
+                data += b"\x00"
+            off = len(data)
+            index += struct.pack(">IIII", tag, 4, off, 1)
+            data += struct.pack(">I", val)
+        else:
+            index += struct.pack(">IIII", tag, 6, off, 1)
+            data += str(val).encode() + b"\x00"
+    il = len(index) // 16
+    return struct.pack(">II", il, len(data)) + index + data
+
+
+def _rpm_sqlite(packages: list[dict[int, object]]) -> bytes:
+    import tempfile, os
+
+    with tempfile.NamedTemporaryFile(suffix=".sqlite", delete=False) as f:
+        path = f.name
+    conn = sqlite3.connect(path)
+    conn.execute("CREATE TABLE Packages (hnum INTEGER PRIMARY KEY, blob BLOB)")
+    for i, values in enumerate(packages):
+        conn.execute(
+            "INSERT INTO Packages VALUES (?, ?)", (i, encode_header_blob(values))
+        )
+    conn.commit()
+    conn.close()
+    with open(path, "rb") as f:
+        data = f.read()
+    os.unlink(path)
+    return data
+
+
+OPENSSL_HDR = {
+    1000: "openssl-libs",
+    1001: "3.0.7",
+    1002: "16.el9",
+    1022: "x86_64",
+    1044: "openssl-3.0.7-16.el9.src.rpm",
+    1014: "Apache-2.0",
+}
+
+
+def test_parse_header_blob_roundtrip():
+    hdr = parse_header_blob(encode_header_blob(OPENSSL_HDR))
+    assert hdr[1000] == "openssl-libs"
+    assert hdr[1001] == "3.0.7"
+    assert hdr[1044] == "openssl-3.0.7-16.el9.src.rpm"
+
+
+def test_src_name():
+    assert _src_name("openssl-3.0.7-16.el9.src.rpm") == "openssl"
+    assert _src_name("python3.9-3.9.16-1.el9.src.rpm") == "python3.9"
+
+
+def test_parse_rpmdb_sqlite():
+    db = _rpm_sqlite([OPENSSL_HDR, {1000: "bash", 1001: "5.1.8", 1002: "6.el9"}])
+    pkgs = parse_rpmdb_sqlite(db)
+    by_name = {p.name: p for p in pkgs}
+    assert set(by_name) == {"openssl-libs", "bash"}
+    o = by_name["openssl-libs"]
+    assert (o.version, o.release, o.arch, o.src_name) == (
+        "3.0.7", "16.el9", "x86_64", "openssl",
+    )
+    assert o.licenses == ["Apache-2.0"]
+
+
+def test_rpm_version_compare_semantics():
+    assert compare_rpm("3.0.7-16.el9", "3.0.7-18.el9") < 0
+    assert compare_rpm("1:1.0-1", "2.0-1") > 0  # epoch wins
+    assert compare_rpm("1.0~beta-1", "1.0-1") < 0  # tilde pre-release
+    assert compare_rpm("1.0.2k-1", "1.0.2j-1") > 0  # alpha run compare
+
+
+def test_maven_version_compare_semantics():
+    assert compare_maven("2.14.1", "2.15.0") < 0
+    assert compare_maven("1.0-alpha-2", "1.0-rc1") < 0
+    assert compare_maven("1.0", "1.0.0") == 0
+    # r3 review: digit-suffixed qualifiers split at the letter-digit
+    # boundary, so pre-releases sort before the release
+    assert compare_maven("2.0-rc1", "2.0") < 0
+    assert compare_maven("1.0-beta1", "1.0") < 0
+
+
+def test_rpm_epoch_in_installed_version(tmp_path):
+    """r3 review: the detector must include the package epoch when
+    comparing against epoch-carrying fixed versions."""
+    from trivy_tpu.atypes import OS, Package
+    from trivy_tpu.db.vulndb import VulnDB, build_db
+    from trivy_tpu.detector.ospkg import OSPkgDetector
+
+    build_db(str(tmp_path), {
+        "redhat 9": {
+            "bind": [{
+                "VulnerabilityID": "CVE-X",
+                "FixedVersion": "2:2.17-326",
+                "Severity": "HIGH",
+            }],
+        },
+    })
+    det = OSPkgDetector(db=VulnDB(str(tmp_path)))
+    fixed_pkg = Package(name="bind", version="2.17", release="400", epoch=2)
+    vulnerable_pkg = Package(name="bind", version="2.17", release="300", epoch=2)
+    os_info = OS(family="redhat", name="9.2")
+    assert det.detect(os_info, [fixed_pkg]) == []
+    assert [v.vulnerability_id for v in det.detect(os_info, [vulnerable_pkg])] == ["CVE-X"]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: RHEL-family rootfs and a Java app tree produce packages+vulns
+# ---------------------------------------------------------------------------
+
+
+def _write_db(tmp_path):
+    from trivy_tpu.db.vulndb import build_db
+
+    build_db(str(tmp_path), {
+        "redhat 9": {
+            "openssl": [{
+                "VulnerabilityID": "CVE-2023-0286",
+                "FixedVersion": "3.0.7-18.el9",
+                "Severity": "HIGH",
+            }],
+        },
+        "maven": {
+            "org.apache.logging.log4j:log4j-core": [{
+                "VulnerabilityID": "CVE-2021-44228",
+                "FixedVersion": "2.15.0",
+                "VulnerableVersions": "<2.15.0",
+                "Severity": "CRITICAL",
+            }],
+        },
+    })
+
+
+def test_e2e_rhel_rootfs_and_java_app(tmp_path):
+    import contextlib
+    import io as _io
+    import json
+
+    from trivy_tpu.cli import main
+
+    _write_db(tmp_path / "db")
+    (tmp_path / "db").mkdir(exist_ok=True)
+    _write_db(tmp_path / "db")
+
+    root = tmp_path / "rootfs"
+    (root / "var" / "lib" / "rpm").mkdir(parents=True)
+    (root / "etc").mkdir()
+    # RHEL detection comes from the redhatbase analyzer (etc/redhat-release),
+    # not os-release — the reference's os-release mapping has no "rhel" id.
+    (root / "etc" / "redhat-release").write_text(
+        "Red Hat Enterprise Linux release 9.2 (Plow)\n"
+    )
+    (root / "var" / "lib" / "rpm" / "rpmdb.sqlite").write_bytes(
+        _rpm_sqlite([OPENSSL_HDR])
+    )
+    (root / "app").mkdir()
+    (root / "app" / "log4j-core-2.14.1.jar").write_bytes(
+        _make_jar(props=("org.apache.logging.log4j", "log4j-core", "2.14.1"))
+    )
+
+    buf = _io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main([
+            "rootfs", "--scanners", "vuln", "--format", "json",
+            "--db-dir", str(tmp_path / "db"), str(root),
+        ])
+    report = json.loads(buf.getvalue())
+    found = {
+        (r.get("Type"), v["VulnerabilityID"])
+        for r in report["Results"]
+        for v in r.get("Vulnerabilities", [])
+    }
+    assert ("rhel", "CVE-2023-0286") in {(t, i) for t, i in found} or (
+        "redhat", "CVE-2023-0286") in found, found
+    assert any(i == "CVE-2021-44228" for _t, i in found), found

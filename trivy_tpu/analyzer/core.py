@@ -184,11 +184,13 @@ def _ensure_builtin_registered() -> None:
     # Import modules whose import side-effect registers analyzers (mirrors the
     # reference's `_ "…/analyzer/all"` blank imports).
     from trivy_tpu.analyzer import config as _config  # noqa: F401
+    from trivy_tpu.analyzer import java as _java  # noqa: F401
     from trivy_tpu.analyzer import lang as _lang  # noqa: F401
     from trivy_tpu.analyzer import license as _license  # noqa: F401
     from trivy_tpu.analyzer import os_release as _os  # noqa: F401
     from trivy_tpu.analyzer import pkg_apk as _apk  # noqa: F401
     from trivy_tpu.analyzer import pkg_dpkg as _dpkg  # noqa: F401
+    from trivy_tpu.analyzer import pkg_rpm as _rpm  # noqa: F401
     from trivy_tpu.analyzer import secret as _secret  # noqa: F401
 
 

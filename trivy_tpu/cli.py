@@ -83,6 +83,10 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
     )
     p.add_argument("--skip-db-update", action="store_true")
     p.add_argument(
+        "--java-db-repository", default=_env_default("java-db-repository", ""),
+        help="OCI reference to pull the Java index DB from",
+    )
+    p.add_argument(
         "--insecure", action="store_true",
         help="allow plain-http registry access (images and DB pulls)",
     )
@@ -112,6 +116,7 @@ def _options_from_args(args: argparse.Namespace) -> Options:
         include_non_failures=args.include_non_failures,
         config_check=list(args.config_check),
         db_repository=args.db_repository,
+        java_db_repository=args.java_db_repository,
         skip_db_update=args.skip_db_update,
     )
 

@@ -59,6 +59,7 @@ class Options:
     config_check: list[str] = field(default_factory=list)  # --config-check dirs
     insecure_registry: bool = False  # plain-http registry pulls
     db_repository: str = ""  # OCI ref for the vuln DB (--db-repository)
+    java_db_repository: str = ""  # OCI ref for the Java index DB
     skip_db_update: bool = False
 
 
@@ -174,14 +175,33 @@ def _init_vuln_scanner(options: Options):
         # --db-repository with only --cache-dir downloads into the dir the
         # scanner then opens.
         db_dir = options.db_dir or (
-            _os.path.join(options.cache_dir, "db") if options.cache_dir else ""
+            _os.path.join(options.cache_dir, "db")
+            if options.cache_dir
+            else _os.path.expanduser("~/.cache/trivy-tpu/db")
         )
-        if db_dir:
-            DBClient(
-                db_dir=db_dir,
-                repository=options.db_repository or DEFAULT_REPOSITORY,
-                insecure=options.insecure_registry,
-            ).ensure(skip=options.skip_db_update)
+        options.db_dir = db_dir  # the scanner must open the same directory
+        DBClient(
+            db_dir=db_dir,
+            repository=options.db_repository or DEFAULT_REPOSITORY,
+            insecure=options.insecure_registry,
+        ).ensure(skip=options.skip_db_update)
+    if options.java_db_repository:
+        import os as _os2
+
+        from trivy_tpu import javadb as _javadb
+
+        jdir = _os2.path.join(
+            options.db_dir
+            or options.cache_dir
+            or _os2.path.expanduser("~/.cache/trivy-tpu"),
+            "java-db",
+        )
+        _javadb.ensure_javadb(
+            jdir,
+            repository=options.java_db_repository,
+            insecure=options.insecure_registry,
+        )
+        _javadb.set_default_javadb_dir(jdir)
     return init_vuln_scanner(options.db_dir, options.cache_dir)
 
 

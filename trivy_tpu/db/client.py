@@ -35,7 +35,10 @@ logger = logging.getLogger(__name__)
 def _parse_time(s: str) -> _dt.datetime:
     if not s:
         return _dt.datetime.fromtimestamp(0, _dt.timezone.utc)
-    return _dt.datetime.fromisoformat(s.replace("Z", "+00:00"))
+    t = _dt.datetime.fromisoformat(s.replace("Z", "+00:00"))
+    if t.tzinfo is None:  # tolerate suffix-less timestamps as UTC
+        t = t.replace(tzinfo=_dt.timezone.utc)
+    return t
 
 
 @dataclass

@@ -28,8 +28,9 @@ _ECOSYSTEMS: dict[str, tuple[str, str]] = {
     "composer": ("composer", "semver"),
     "bundler": ("rubygems", "generic"),
     "nuget": ("nuget", "semver"),
-    "pom": ("maven", "generic"),
-    "gradle": ("maven", "generic"),
+    "pom": ("maven", "maven"),
+    "gradle": ("maven", "maven"),
+    "jar": ("maven", "maven"),
 }
 
 

@@ -23,15 +23,15 @@ _DRIVERS: dict[str, tuple[str, str, int]] = {
     "chainguard": ("chainguard", "apk", 0),
     "debian": ("debian", "deb", 1),  # bucket "debian 11"
     "ubuntu": ("ubuntu", "deb", 2),  # bucket "ubuntu 22.04"
-    "redhat": ("redhat", "deb", 1),
-    "centos": ("centos", "deb", 1),
-    "rocky": ("rocky", "deb", 1),
-    "alma": ("alma", "deb", 1),
-    "oracle": ("oracle", "deb", 1),
-    "amazon": ("amazon", "deb", 1),
-    "photon": ("photon", "deb", 1),
-    "cbl-mariner": ("cbl-mariner", "deb", 1),
-    "fedora": ("fedora", "deb", 1),
+    "redhat": ("redhat", "rpm", 1),
+    "centos": ("centos", "rpm", 1),
+    "rocky": ("rocky", "rpm", 1),
+    "alma": ("alma", "rpm", 1),
+    "oracle": ("oracle", "rpm", 1),
+    "amazon": ("amazon", "rpm", 1),
+    "photon": ("photon", "rpm", 1),
+    "cbl-mariner": ("cbl-mariner", "rpm", 1),
+    "fedora": ("fedora", "rpm", 1),
 }
 
 
@@ -76,6 +76,10 @@ class OSPkgDetector:
                     installed = pkg.version
                     if pkg.release:
                         installed = f"{pkg.version}-{pkg.release}"
+                    if pkg.epoch:
+                        # utils.FormatVersion includes the epoch; compare_rpm
+                        # and compare_deb both parse the N: prefix.
+                        installed = f"{pkg.epoch}:{installed}"
                     if adv.fixed_version and cmp(installed, adv.fixed_version) >= 0:
                         continue
                     seen.add(adv.vulnerability_id)

@@ -178,9 +178,143 @@ def compare_generic(a: str, b: str) -> int:
     return _deb_compare_part(a, b)
 
 
+def _rpm_seg_cmp(a: str, b: str) -> int:
+    """librpm rpmvercmp over one version component: alternating digit and
+    alpha runs; tilde sorts before everything, caret after release-equal."""
+    ia = ib = 0
+    while ia < len(a) or ib < len(b):
+        ca = a[ia] if ia < len(a) else ""
+        cb = b[ib] if ib < len(b) else ""
+        if ca == "~" or cb == "~":
+            if ca != "~":
+                return 1
+            if cb != "~":
+                return -1
+            ia += 1
+            ib += 1
+            continue
+        if ca == "^" or cb == "^":
+            if not ca:
+                return -1
+            if not cb:
+                return 1
+            if ca != "^":
+                return 1
+            if cb != "^":
+                return -1
+            ia += 1
+            ib += 1
+            continue
+        # skip non-alphanumeric separators
+        while ia < len(a) and not a[ia].isalnum() and a[ia] not in "~^":
+            ia += 1
+        while ib < len(b) and not b[ib].isalnum() and b[ib] not in "~^":
+            ib += 1
+        if ia >= len(a) or ib >= len(b):
+            if ia < len(a):
+                return 1
+            if ib < len(b):
+                return -1
+            return 0
+        if a[ia].isdigit() or b[ib].isdigit():
+            ja, jb = ia, ib
+            while ja < len(a) and a[ja].isdigit():
+                ja += 1
+            while jb < len(b) and b[jb].isdigit():
+                jb += 1
+            da, db_ = a[ia:ja], b[ib:jb]
+            if not da:
+                return -1  # alpha sorts before digits
+            if not db_:
+                return 1
+            if int(da) != int(db_):
+                return 1 if int(da) > int(db_) else -1
+            ia, ib = ja, jb
+        else:
+            ja, jb = ia, ib
+            while ja < len(a) and a[ja].isalpha():
+                ja += 1
+            while jb < len(b) and b[jb].isalpha():
+                jb += 1
+            sa, sb = a[ia:ja], b[ib:jb]
+            if sa != sb:
+                return 1 if sa > sb else -1
+            ia, ib = ja, jb
+    return 0
+
+
+def _rpm_split(v: str) -> tuple[int, str, str]:
+    epoch = 0
+    if ":" in v:
+        e, _, v = v.partition(":")
+        try:
+            epoch = int(e)
+        except ValueError:
+            pass
+    ver, _, rel = v.partition("-")
+    return epoch, ver, rel
+
+
+def compare_rpm(a: str, b: str) -> int:
+    """Full [epoch:]version[-release] comparison (rpm.go / go-rpm-version)."""
+    ea, va, ra = _rpm_split(a)
+    eb, vb, rb = _rpm_split(b)
+    if ea != eb:
+        return 1 if ea > eb else -1
+    c = _rpm_seg_cmp(va, vb)
+    if c != 0:
+        return c
+    return _rpm_seg_cmp(ra, rb)
+
+
+_MAVEN_QUALIFIERS = {
+    "alpha": 1, "a": 1, "beta": 2, "b": 2, "milestone": 3, "m": 3,
+    "rc": 4, "cr": 4, "snapshot": 5, "": 6, "ga": 6, "final": 6,
+    "release": 6, "sp": 7,
+}
+
+
+def _maven_tokens(v: str):
+    """org.apache.maven.artifact.versioning.ComparableVersion, abridged:
+    dot/dash-separated runs, numbers compare numerically, known qualifiers
+    by rank (alpha < beta < milestone < rc < snapshot < release < sp),
+    unknown qualifiers lexically after release."""
+    for raw in re.split(r"[.\-_]", v.lower()):
+        # ComparableVersion splits letter-digit transitions: rc1 -> rc, 1
+        for tok in re.findall(r"\d+|[a-z]+", raw):
+            if tok.isdigit():
+                yield (1, int(tok), "")
+            else:
+                rank = _MAVEN_QUALIFIERS.get(tok)
+                if rank is None:
+                    yield (2, 8, tok)
+                else:
+                    yield (2, rank, "")
+
+
+def compare_maven(a: str, b: str) -> int:
+    ta, tb = list(_maven_tokens(a)), list(_maven_tokens(b))
+    # trailing zeros / release qualifiers are neutral padding
+    pad = (2, 6, "")
+    n = max(len(ta), len(tb))
+    for i in range(n):
+        xa = ta[i] if i < len(ta) else ((1, 0, "") if (i < len(tb) and tb[i][0] == 1) else pad)
+        xb = tb[i] if i < len(tb) else ((1, 0, "") if ta[i][0] == 1 else pad)
+        if xa != xb:
+            # numeric vs qualifier: numeric sorts after release qualifier
+            if xa[0] != xb[0]:
+                if xa[0] == 1:  # a numeric vs b qualifier
+                    return 1 if xb[1] <= 6 or xa[1] > 0 else -1
+                return -1 if xa[1] <= 6 or xb[1] > 0 else 1
+            return 1 if xa > xb else -1
+    return 0
+
+
 COMPARATORS = {
     "apk": compare_apk,
     "deb": compare_deb,
+    "rpm": compare_rpm,
+    "maven": compare_maven,
     "semver": compare_semver,
     "pep440": compare_pep440,
     "generic": compare_generic,
