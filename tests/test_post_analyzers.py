@@ -1,0 +1,179 @@
+"""Tests: composite-FS post-analyzers and post-scan hooks."""
+
+import json
+
+import pytest
+
+from trivy_tpu.analyzer.core import AnalyzerGroup, AnalyzerOptions
+from trivy_tpu.mapfs import MapFS
+from trivy_tpu.scanner.post import (
+    register_post_scan_hook,
+    run_post_scan_hooks,
+    unregister_post_scan_hook,
+)
+from trivy_tpu.walker.fs import FileEntry
+
+
+def _entry(path: str, content: bytes) -> FileEntry:
+    return FileEntry(
+        path=path, size=len(content), mode=0o644, opener=lambda c=content: c
+    )
+
+
+LOCK = json.dumps({
+    "lockfileVersion": 2,
+    "packages": {
+        "": {"name": "app"},
+        "node_modules/left-pad": {"version": "1.3.0"},
+        "node_modules/lodash": {"version": "4.17.21"},
+    },
+}).encode()
+
+MANIFEST = json.dumps({
+    "dependencies": {"lodash": "^4.17.0"},
+}).encode()
+
+LODASH_META = json.dumps({"name": "lodash", "license": "MIT"}).encode()
+
+
+def test_npm_post_analyzer_cross_file_context():
+    """The post-analyzer resolves context three files apart: lockfile +
+    sibling manifest (direct marking) + node_modules metadata (license)."""
+    group = AnalyzerGroup(AnalyzerOptions())
+    entries = [
+        _entry("srv/package-lock.json", LOCK),
+        _entry("srv/package.json", MANIFEST),
+        _entry("srv/node_modules/lodash/package.json", LODASH_META),
+    ]
+    result = group.analyze_entries("", entries)
+    result.merge(group.post_analyze())
+    apps = [a for a in result.applications if a.app_type == "npm"]
+    assert len(apps) == 1
+    pkgs = {p.name: p for p in apps[0].packages}
+    assert set(pkgs) == {"left-pad", "lodash"}
+    assert pkgs["lodash"].indirect is False
+    assert pkgs["lodash"].licenses == ["MIT"]
+    assert pkgs["left-pad"].indirect is True  # not in the manifest
+    assert pkgs["left-pad"].licenses == []
+
+
+def test_npm_post_analyzer_without_context_still_parses():
+    group = AnalyzerGroup(AnalyzerOptions())
+    result = group.analyze_entries("", [_entry("package-lock.json", LOCK)])
+    result.merge(group.post_analyze())
+    apps = [a for a in result.applications if a.app_type == "npm"]
+    assert len(apps) == 1
+    assert {p.name for p in apps[0].packages} == {"left-pad", "lodash"}
+
+
+def test_post_fs_cleared_between_runs():
+    """The composite FS resets after post_analyze so per-layer reuse
+    (image artifacts) cannot leak files across layers."""
+    group = AnalyzerGroup(AnalyzerOptions())
+    group.analyze_entries("", [_entry("a/package-lock.json", LOCK)])
+    r1 = group.post_analyze()
+    assert len(r1.applications) == 1
+    r2 = group.post_analyze()
+    assert r2.applications == []
+
+
+def test_post_analyzer_versions_in_cache_key():
+    group = AnalyzerGroup(AnalyzerOptions())
+    assert group.analyzer_versions().get("npm") == 2
+
+
+def test_mapfs_helpers():
+    fs = MapFS()
+    fs.write_file("/a/b/lock.json", b"1")
+    fs.write_file("a/b/manifest.json", b"2")
+    assert fs.exists("a/b/lock.json") and fs.exists("/a/b/lock.json")
+    assert fs.read("a/b/manifest.json") == b"2"
+    assert fs.siblings("a/b/lock.json", "manifest.json") == "a/b/manifest.json"
+    assert fs.siblings("a/b/lock.json", "nope.json") is None
+    assert fs.glob("**/lock.json") == ["a/b/lock.json"]
+
+
+def test_post_scan_hook_mutates_results():
+    from trivy_tpu.ftypes import Result, ResultClass, SecretFinding
+    from trivy_tpu.ftypes import Code
+
+    def drop_low(results):
+        for r in results:
+            r.secrets = [s for s in r.secrets if s.severity != "LOW"]
+        return [r for r in results if r.secrets]
+
+    base = [
+        Result(
+            target="a.py", result_class=ResultClass.SECRET,
+            secrets=[
+                SecretFinding(
+                    rule_id="x", category="c", severity="LOW", title="t",
+                    start_line=1, end_line=1, code=Code(), match="m",
+                ),
+                SecretFinding(
+                    rule_id="y", category="c", severity="HIGH", title="t",
+                    start_line=2, end_line=2, code=Code(), match="m",
+                ),
+            ],
+        ),
+        Result(
+            target="b.py", result_class=ResultClass.SECRET,
+            secrets=[
+                SecretFinding(
+                    rule_id="z", category="c", severity="LOW", title="t",
+                    start_line=1, end_line=1, code=Code(), match="m",
+                ),
+            ],
+        ),
+    ]
+    register_post_scan_hook(drop_low)
+    try:
+        out = run_post_scan_hooks(base)
+    finally:
+        unregister_post_scan_hook(drop_low)
+    assert len(out) == 1
+    assert [s.rule_id for s in out[0].secrets] == ["y"]
+
+
+def test_post_scan_hook_failure_is_tolerated():
+    def broken(results):
+        raise RuntimeError("boom")
+
+    register_post_scan_hook(broken)
+    try:
+        out = run_post_scan_hooks([1, 2, 3])
+    finally:
+        unregister_post_scan_hook(broken)
+    assert out == [1, 2, 3]
+
+
+def test_post_scan_hook_runs_in_driver(tmp_path):
+    """End to end: a registered hook rewrites severities through a real
+    fs scan (the reference's WASM post-scan seat, post_scan.go)."""
+    import contextlib
+    import io
+
+    from trivy_tpu.cli import main
+
+    (tmp_path / "x.py").write_text('token = "ghp_' + "A" * 36 + '"\n')
+
+    def upgrade(results):
+        for r in results:
+            for s in getattr(r, "secrets", []):
+                s.severity = "LOW"
+        return results
+
+    register_post_scan_hook(upgrade)
+    try:
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            main(["fs", "--scanners", "secret", "--format", "json", str(tmp_path)])
+    finally:
+        unregister_post_scan_hook(upgrade)
+    report = json.loads(buf.getvalue())
+    sevs = [
+        s["Severity"]
+        for r in report["Results"]
+        for s in r.get("Secrets", [])
+    ]
+    assert sevs == ["LOW"]  # builtin github-pat is CRITICAL; the hook rewrote it

@@ -168,12 +168,39 @@ class BatchAnalyzer(Analyzer):
         return self.analyze_batch([inp])
 
 
+class PostAnalyzer:
+    """analyzer.PostAnalyzer (analyzer.go:78-83): claims files during the
+    walk (copied into its composite FS) and analyzes them together after
+    the walk, with cross-file context (composite_fs.go / mapfs)."""
+
+    def init(self, options: "AnalyzerOptions") -> None:
+        pass
+
+    def type(self) -> str:
+        raise NotImplementedError
+
+    def version(self) -> int:
+        raise NotImplementedError
+
+    def required(self, file_path: str, size: int, mode: int) -> bool:
+        raise NotImplementedError
+
+    def post_analyze(self, fs) -> "AnalysisResult | None":
+        raise NotImplementedError
+
+
 _REGISTRY: list[Callable[[], Analyzer]] = []
+_POST_REGISTRY: list[Callable[[], PostAnalyzer]] = []
 
 
 def register_analyzer(factory: Callable[[], Analyzer]) -> None:
     """analyzer.RegisterAnalyzer (analyzer.go:93)."""
     _REGISTRY.append(factory)
+
+
+def register_post_analyzer(factory: Callable[[], PostAnalyzer]) -> None:
+    """analyzer.RegisterPostAnalyzer (analyzer.go:102)."""
+    _POST_REGISTRY.append(factory)
 
 
 def registered_analyzers() -> list[Callable[[], Analyzer]]:
@@ -207,13 +234,45 @@ class AnalyzerGroup:
                 continue
             a.init(self.options)
             self.analyzers.append(a)
+        self.post_analyzers: list[PostAnalyzer] = []
+        for factory in _POST_REGISTRY:
+            p = factory()
+            if p.type() in self.options.disabled_analyzers:
+                continue
+            p.init(self.options)
+            self.post_analyzers.append(p)
+        self._post_fs: list = [None] * len(self.post_analyzers)
 
     def analyzer_versions(self) -> dict[str, int]:
         """AnalyzerVersions (analyzer.go:372-381) — cache-key component."""
         versions = {a.type(): a.version() for a in self.analyzers}
+        versions.update({p.type(): p.version() for p in self.post_analyzers})
         for t in self.options.disabled_analyzers:
             versions.setdefault(t, 0)
         return versions
+
+    def post_analyze(self) -> "AnalysisResult":
+        """PostAnalyze over each post-analyzer's composite FS
+        (analyzer.go:506 PostAnalyzerFS); clears the collected FSes so the
+        group can be reused per layer."""
+        result = AnalysisResult()
+        for i, p in enumerate(self.post_analyzers):
+            fs = self._post_fs[i]
+            self._post_fs[i] = None
+            if fs is None or len(fs) == 0:
+                continue
+            try:
+                res = p.post_analyze(fs)
+            except Exception:
+                # One malformed tree must not abort the scan — the same
+                # tolerance analyze_entries gives per-file analyzers.
+                logger.warning(
+                    "post-analyzer %s failed", p.type(), exc_info=True
+                )
+                continue
+            if res is not None:
+                result.merge(res)
+        return result
 
     def analyze_entries(
         self,
@@ -233,6 +292,22 @@ class AnalyzerGroup:
                     continue
                 if a.required(entry.path, entry.size, entry.mode):
                     claims[i].append(entry)
+            for j, p in enumerate(self.post_analyzers):
+                if disabled and p.type() in disabled:
+                    continue
+                if not p.required(entry.path, entry.size, entry.mode):
+                    continue
+                # Copy into the post-analyzer's composite FS
+                # (analyzer.go:506 + composite_fs.go): the file is read now
+                # — the walk's opener may not outlive this pass (layer tars).
+                if self._post_fs[j] is None:
+                    from trivy_tpu.mapfs import MapFS
+
+                    self._post_fs[j] = MapFS()
+                try:
+                    self._post_fs[j].write_file(entry.path, entry.opener())
+                except OSError:
+                    continue
 
         result = AnalysisResult()
         for i, a in enumerate(self.analyzers):

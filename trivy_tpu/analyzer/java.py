@@ -243,8 +243,9 @@ def parse_pom(content: bytes) -> list[Package]:
         return s
 
     out: list[Package] = []
-    if group and artifact and version:
-        out.append(_pkg(f"{group}:{artifact}", interp(version)))
+    ig, iv = interp(group), interp(version)
+    if ig and artifact and iv:
+        out.append(_pkg(f"{ig}:{artifact}", iv))
     deps = find(root, "dependencies")
     if deps is not None:
         for dep in deps:

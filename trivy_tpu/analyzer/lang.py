@@ -15,6 +15,8 @@ import re
 import yaml
 
 from trivy_tpu.analyzer.core import (
+    PostAnalyzer,
+    register_post_analyzer,
     AnalysisInput,
     AnalysisResult,
     Analyzer,
@@ -305,9 +307,10 @@ class NugetLockAnalyzer(_LockfileAnalyzer):
         return list(out.values())
 
 
+# NpmLockAnalyzer is not registered per-file: npm runs as a post-analyzer
+# (NpmPostAnalyzer below) so it can see the manifest and node_modules
+# metadata through the composite FS.
 for _cls in (
-    NpmLockAnalyzer,
-    YarnLockAnalyzer,
     PnpmLockAnalyzer,
     PipRequirementsAnalyzer,
     PipenvLockAnalyzer,
@@ -317,5 +320,76 @@ for _cls in (
     ComposerLockAnalyzer,
     GemfileLockAnalyzer,
     NugetLockAnalyzer,
+    YarnLockAnalyzer,
 ):
     register_analyzer(_cls)
+
+
+class NpmPostAnalyzer(PostAnalyzer):
+    """pkg/fanal/analyzer/language/nodejs/npm/npm.go: the lockfile parse
+    plus cross-file context from the composite FS — direct-dependency
+    marking from the sibling package.json and license enrichment from
+    node_modules/<name>/package.json.  The per-file analyzer cannot see
+    those neighbors; this is the post-analyzer mechanism's seat
+    (analyzer.go:506)."""
+
+    def type(self) -> str:
+        return NPM
+
+    def version(self) -> int:
+        return 2  # v1 was the plain per-file lock analyzer
+
+    def required(self, file_path: str, size: int, mode: int) -> bool:
+        name = file_path.rsplit("/", 1)[-1]
+        if name == "package-lock.json":
+            return True
+        return name == "package.json" and size < 1 << 20
+
+    def post_analyze(self, fs) -> AnalysisResult | None:
+        import posixpath
+
+        apps = []
+        for lock_path in fs.glob("**/package-lock.json") + (
+            ["package-lock.json"] if fs.exists("package-lock.json") else []
+        ):
+            try:
+                pkgs = NpmLockAnalyzer().parse(fs.read(lock_path))
+            except (ValueError, KeyError, TypeError):
+                continue  # unparseable lockfiles are skipped, not fatal
+            base = posixpath.dirname(lock_path)
+
+            direct: set[str] = set()
+            manifest = fs.siblings(lock_path, "package.json")
+            if manifest is not None:
+                try:
+                    m = json.loads(fs.read(manifest))
+                    for sect in ("dependencies", "devDependencies"):
+                        direct.update((m.get(sect) or {}).keys())
+                except ValueError:
+                    pass
+
+            for p in pkgs:
+                if direct:
+                    p.indirect = p.name not in direct
+                nm = posixpath.join(base, "node_modules", p.name, "package.json")
+                if fs.exists(nm):
+                    try:
+                        meta = json.loads(fs.read(nm))
+                    except ValueError:
+                        continue
+                    lic = meta.get("license")
+                    if isinstance(lic, dict):
+                        lic = lic.get("type")
+                    if isinstance(lic, str) and lic:
+                        p.licenses = [lic]
+            apps.append(
+                Application(
+                    app_type=NPM, file_path=lock_path, packages=pkgs
+                )
+            )
+        if not apps:
+            return None
+        return AnalysisResult(applications=apps)
+
+
+register_post_analyzer(NpmPostAnalyzer)

@@ -32,7 +32,6 @@ RPM = "rpm"
 _SQLITE_PATHS = (
     "var/lib/rpm/rpmdb.sqlite",
     "usr/lib/sysimage/rpm/rpmdb.sqlite",
-    "var/lib/rpm/rpmdb.sqlite-wal",  # claimed so it never hits other analyzers
 )
 _LEGACY_PATHS = (
     "var/lib/rpm/Packages",
@@ -150,19 +149,18 @@ class RpmDbAnalyzer(Analyzer):
 
     def required(self, file_path: str, size: int, mode: int) -> bool:
         p = file_path.lstrip("/")
-        return p in _SQLITE_PATHS or p in _LEGACY_PATHS
-
-    def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
-        p = inp.file_path.lstrip("/")
         if p in _LEGACY_PATHS:
+            # Warn at claim time so the (often large) BerkeleyDB/ndb file is
+            # never read into memory just to be discarded.
             logger.warning(
                 "legacy rpm database format at %s (BerkeleyDB/ndb) is not "
                 "supported; packages from it are not reported",
-                inp.file_path,
+                file_path,
             )
-            return None
-        if not p.endswith("rpmdb.sqlite"):
-            return None
+            return False
+        return p in _SQLITE_PATHS
+
+    def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
         pkgs = parse_rpmdb_sqlite(inp.content)
         if not pkgs:
             return None

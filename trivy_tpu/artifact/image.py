@@ -264,6 +264,8 @@ class ImageArtifact:
             # inside the `with` so only claimed files materialize.
             layer = walk_layer_tar(f)
             result = self.group.analyze_entries("", layer.entries, disabled)
+            result.merge(self.group.post_analyze())
+            result.sort()
         blob = BlobInfo(
             diff_id=diff_id,
             created_by=created_by,

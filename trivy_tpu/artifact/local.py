@@ -40,6 +40,10 @@ class LocalArtifact:
     def inspect(self) -> ArtifactReference:
         """fs.go:71 Inspect."""
         result = self.group.analyze_entries(self.root, self.walker.walk(self.root))
+        # Post-analyzers see their composite FS after the walk (fs.go:120
+        # PostAnalyze): cross-file context like lockfile + manifest pairs.
+        result.merge(self.group.post_analyze())
+        result.sort()
 
         blob = BlobInfo(
             os=result.os if isinstance(result.os, OS) else None,

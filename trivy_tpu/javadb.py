@@ -106,15 +106,14 @@ def ensure_javadb(
     reference's javadb updates once a day).  Returns True on download."""
     import datetime
 
+    from trivy_tpu.db.client import _parse_time
+
     meta_path = os.path.join(db_dir, "metadata.json")
     try:
         with open(meta_path, encoding="utf-8") as f:
             stamp = json.load(f).get("DownloadedAt", "")
-        t = datetime.datetime.fromisoformat(stamp.replace("Z", "+00:00"))
-        if t.tzinfo is None:
-            t = t.replace(tzinfo=datetime.timezone.utc)
-        age = datetime.datetime.now(datetime.timezone.utc) - t
-        if age < datetime.timedelta(hours=max_age_hours):
+        age = datetime.datetime.now(datetime.timezone.utc) - _parse_time(stamp)
+        if stamp and age < datetime.timedelta(hours=max_age_hours):
             return False
     except (OSError, ValueError):
         pass

@@ -1,0 +1,41 @@
+"""Post-scan hook registry (pkg/scanner/post/post_scan.go:19-41).
+
+Hooks run after the driver assembles results and may insert, update, or
+delete findings — the seam WASM modules and other extensions mutate scan
+output through.  Hooks are plain callables `(results) -> results`; a hook
+raising is logged and skipped so one broken extension cannot sink a scan.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+logger = logging.getLogger(__name__)
+
+_HOOKS: list[Callable] = []
+
+
+def register_post_scan_hook(hook: Callable) -> None:
+    """post.RegisterPostScanner."""
+    _HOOKS.append(hook)
+
+
+def unregister_post_scan_hook(hook: Callable) -> None:
+    try:
+        _HOOKS.remove(hook)
+    except ValueError:
+        pass
+
+
+def run_post_scan_hooks(results: list) -> list:
+    """post.Scan: thread results through every registered hook."""
+    for hook in list(_HOOKS):
+        try:
+            out = hook(results)
+        except Exception:
+            logger.warning("post-scan hook %r failed", hook, exc_info=True)
+            continue
+        if out is not None:
+            results = out
+    return results

@@ -76,6 +76,12 @@ class LocalDriver(Driver):
         if SCANNER_MISCONFIG in options.scanners and detail.misconfigurations:
             results.extend(self._misconfigs_to_results(detail))
 
+        # Post-scan hooks mutate assembled results (post_scan.go:19-41);
+        # the WASM/extension seat.
+        from trivy_tpu.scanner.post import run_post_scan_hooks
+
+        results = run_post_scan_hooks(results)
+
         return results, detail.os
 
     @staticmethod
