@@ -1,0 +1,177 @@
+"""Tests: --timeout enforcement and the YAML config-file flag layer."""
+
+import contextlib
+import io
+import json
+import os
+
+import pytest
+
+from trivy_tpu.cli import _parse_duration, main
+from trivy_tpu.commands.run import Options, ScanTimeoutError, run
+
+
+def test_parse_duration_forms():
+    assert _parse_duration("300") == 300.0
+    assert _parse_duration("300s") == 300.0
+    assert _parse_duration("5m") == 300.0
+    assert _parse_duration("1h30m") == 5400.0
+    assert _parse_duration(42) == 42.0
+    with pytest.raises(ValueError):
+        _parse_duration("5x")
+
+
+def test_timeout_aborts_long_scan(tmp_path, monkeypatch):
+    """A scan exceeding --timeout raises/exits with a clean error
+    (run.go:395-402 context deadline)."""
+    (tmp_path / "f.py").write_text("x = 1\n")
+
+    import trivy_tpu.commands.run as run_mod
+
+    def slow_inner(options, kind):
+        import time
+
+        time.sleep(5)
+        return 0
+
+    monkeypatch.setattr(run_mod, "_run_inner", slow_inner)
+    opts = Options(target=str(tmp_path), timeout=0.2)
+    with pytest.raises(ScanTimeoutError):
+        run(opts, "fs")
+
+
+def test_timeout_cli_surface(tmp_path, monkeypatch):
+    (tmp_path / "f.py").write_text("x = 1\n")
+    import trivy_tpu.commands.run as run_mod
+
+    def slow_inner(options, kind):
+        import time
+
+        time.sleep(5)
+        return 0
+
+    monkeypatch.setattr(run_mod, "_run_inner", slow_inner)
+    err = io.StringIO()
+    with contextlib.redirect_stderr(err):
+        rc = main(["fs", "--timeout", "0.2s", str(tmp_path)])
+    assert rc == 2
+    assert "timed out" in err.getvalue()
+
+
+def test_timeout_worker_aborts_cooperatively(tmp_path):
+    """r3 review: the deadline is cooperative — the worker thread stops at
+    the next analyzer boundary instead of scanning on in the background."""
+    from trivy_tpu import deadline
+
+    deadline.set_deadline(0.0001)
+    import time
+
+    time.sleep(0.01)
+    with pytest.raises(deadline.ScanTimeoutError):
+        deadline.check()
+    deadline.clear()
+    deadline.check()  # cleared: no raise
+
+
+def test_bad_timeout_is_clean_cli_error(tmp_path):
+    (tmp_path / "f.py").write_text("x = 1\n")
+    err = io.StringIO()
+    with contextlib.redirect_stderr(err):
+        rc = main(["fs", "--timeout", "5x", str(tmp_path)])
+    assert rc == 2
+    assert "duration" in err.getvalue()
+
+
+def test_broken_config_file_is_hard_error(tmp_path):
+    cfg = tmp_path / "trivy.yaml"
+    cfg.write_text("severity: [CRITICAL\n")  # YAML syntax error
+    (tmp_path / "f.py").write_text("x = 1\n")
+    err = io.StringIO()
+    with contextlib.redirect_stderr(err):
+        rc = main(["fs", "--config", str(cfg), str(tmp_path)])
+    assert rc == 2
+    assert "bad config file" in err.getvalue()
+
+
+def test_fast_scan_unaffected_by_timeout(tmp_path):
+    (tmp_path / "f.py").write_text('token = "ghp_' + "A" * 36 + '"\n')
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main([
+            "fs", "--scanners", "secret", "--format", "json",
+            "--timeout", "5m", str(tmp_path),
+        ])
+    assert rc == 0
+    assert json.loads(buf.getvalue())["Results"]
+
+
+# ---------------------------------------------------------------------------
+# config file
+# ---------------------------------------------------------------------------
+
+
+def _scan_with_config(tmp_path, config_text, argv_extra=(), env=None):
+    cfg = tmp_path / "trivy.yaml"
+    cfg.write_text(config_text)
+    (tmp_path / "x.py").write_text('token = "ghp_' + "A" * 36 + '"\n')
+    buf = io.StringIO()
+    old_env = {}
+    for k, v in (env or {}).items():
+        old_env[k] = os.environ.get(k)
+        os.environ[k] = v
+    try:
+        with contextlib.redirect_stdout(buf):
+            rc = main([
+                "fs", "--config", str(cfg), "--scanners", "secret",
+                *argv_extra, str(tmp_path),
+            ])
+    finally:
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return rc, buf.getvalue()
+
+
+def test_config_file_sets_format(tmp_path):
+    rc, out = _scan_with_config(tmp_path, "format: json\n")
+    assert rc == 0
+    assert json.loads(out)["SchemaVersion"] == 2  # json, not the table default
+
+
+def test_config_file_nested_groups_flatten(tmp_path):
+    # {"secret": {"backend": "cpu"}} -> "secret-backend"
+    rc, out = _scan_with_config(
+        tmp_path, "format: json\nsecret:\n  backend: cpu\n"
+    )
+    assert rc == 0
+    assert json.loads(out)["Results"]  # oracle backend still finds the secret
+
+
+def test_cli_flag_overrides_config_file(tmp_path):
+    rc, out = _scan_with_config(
+        tmp_path, "format: json\nseverity: [LOW]\n",
+        argv_extra=("--severity", "CRITICAL"),
+    )
+    assert rc == 0
+    results = json.loads(out)["Results"]
+    # github-pat is CRITICAL; the CLI severity filter (not the config's LOW)
+    # applied, so the finding is present
+    assert any(r.get("Secrets") for r in results)
+
+
+def test_config_file_severity_filters(tmp_path):
+    rc, out = _scan_with_config(tmp_path, "format: json\nseverity: [LOW]\n")
+    assert rc == 0
+    results = json.loads(out)["Results"] or []
+    assert not any(r.get("Secrets") for r in results)
+
+
+def test_env_overrides_config_file(tmp_path):
+    rc, out = _scan_with_config(
+        tmp_path, "format: table\n",
+        env={"TRIVY_TPU_FORMAT": "json"},
+    )
+    assert rc == 0
+    assert out.lstrip().startswith("{")  # env var won over the config file

@@ -285,8 +285,11 @@ class AnalyzerGroup:
         `disabled`: analyzer types suppressed for THIS call only — the
         per-layer disabling seam (base layers skip secret scanning,
         image.go:209-213)."""
+        from trivy_tpu import deadline
+
         claims: dict[int, list[FileEntry]] = {i: [] for i in range(len(self.analyzers))}
         for entry in entries:
+            deadline.check()
             for i, a in enumerate(self.analyzers):
                 if disabled and a.type() in disabled:
                     continue
@@ -311,6 +314,7 @@ class AnalyzerGroup:
 
         result = AnalysisResult()
         for i, a in enumerate(self.analyzers):
+            deadline.check()
             batch = claims[i]
             if not batch:
                 continue

@@ -382,6 +382,9 @@ class NpmPostAnalyzer(PostAnalyzer):
                         lic = lic.get("type")
                     if isinstance(lic, str) and lic:
                         p.licenses = [lic]
+            if not pkgs:
+                continue  # empty lockfile: no Application, like the per-file path
+            pkgs.sort(key=lambda p: (p.name, p.version))
             apps.append(
                 Application(
                     app_type=NPM, file_path=lock_path, packages=pkgs

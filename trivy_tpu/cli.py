@@ -25,8 +25,82 @@ from trivy_tpu.commands.run import (
 from trivy_tpu.result.filter import SEVERITIES
 
 
+# Config-file layer (the viper config file, pkg/flag/*): values from
+# trivy.yaml (or --config FILE) sit under env vars, which sit under explicit
+# CLI flags — flag > env > config file > built-in default.
+_CONFIG_FILE: dict[str, object] = {}
+
+
+class ConfigFileError(ValueError):
+    pass
+
+
+def _load_config_file(argv) -> None:
+    """Pre-pass: find --config (default ./trivy.yaml) and flatten it.
+
+    Nested groups flatten with dashes ({"db": {"repository": R}} ->
+    "db-repository"), matching the reference's dotted config keys."""
+    global _CONFIG_FILE
+    _CONFIG_FILE = {}
+    pre = argparse.ArgumentParser(add_help=False)
+    pre.add_argument(
+        "--config", default=os.environ.get("TRIVY_TPU_CONFIG", "trivy.yaml")
+    )
+    known, _ = pre.parse_known_args(argv)
+    if not os.path.exists(known.config):
+        return
+    import yaml
+
+    try:
+        with open(known.config, encoding="utf-8") as f:
+            doc = yaml.safe_load(f) or {}
+    except (OSError, yaml.YAMLError) as e:
+        # A broken config file must fail the run, not silently fall back to
+        # defaults (the reference's viper load is a hard error).
+        raise ConfigFileError(f"bad config file {known.config}: {e}") from e
+    flat: dict[str, object] = {}
+
+    def walk(prefix: str, node) -> None:
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(f"{prefix}{k}-", v)
+        else:
+            flat[prefix[:-1]] = node
+
+    walk("", doc if isinstance(doc, dict) else {})
+    _CONFIG_FILE = flat
+
+
 def _env_default(name: str, default):
-    return os.environ.get(f"TRIVY_TPU_{name.upper().replace('-', '_')}", default)
+    env = os.environ.get(f"TRIVY_TPU_{name.upper().replace('-', '_')}")
+    if env is not None:
+        return env
+    val = _CONFIG_FILE.get(name)
+    if val is None:
+        return default
+    if isinstance(val, list):
+        return ",".join(str(v) for v in val)
+    return val
+
+
+def _parse_duration(s) -> float:
+    """"300", "300s", "5m", "1h30m" -> seconds (flag.DurationFlag)."""
+    if isinstance(s, (int, float)):
+        return float(s)
+    total = 0.0
+    num = ""
+    units = {"s": 1, "m": 60, "h": 3600}
+    for ch in str(s).strip():
+        if ch.isdigit() or ch == ".":
+            num += ch
+        elif ch in units and num:
+            total += float(num) * units[ch]
+            num = ""
+        else:
+            raise ValueError(f"bad duration: {s!r}")
+    if num:
+        total += float(num)
+    return total
 
 
 def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
@@ -87,6 +161,14 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
         help="OCI reference to pull the Java index DB from",
     )
     p.add_argument(
+        "--timeout", default=_env_default("timeout", "5m"),
+        help="scan timeout, e.g. 300s / 5m / 1h (default 5m)",
+    )
+    p.add_argument(
+        "--config", default=os.environ.get("TRIVY_TPU_CONFIG", "trivy.yaml"),
+        help="YAML config file merged under flags and env vars",
+    )
+    p.add_argument(
         "--insecure", action="store_true",
         help="allow plain-http registry access (images and DB pulls)",
     )
@@ -118,6 +200,7 @@ def _options_from_args(args: argparse.Namespace) -> Options:
         db_repository=args.db_repository,
         java_db_repository=args.java_db_repository,
         skip_db_update=args.skip_db_update,
+        timeout=_parse_duration(args.timeout),
     )
 
 
@@ -177,7 +260,12 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: list[str] | None = None) -> int:
-    args = build_parser().parse_args(argv)
+    try:
+        _load_config_file(argv if argv is not None else sys.argv[1:])
+        args = build_parser().parse_args(argv)
+    except ConfigFileError as e:
+        print(f"trivy-tpu: {e}", file=sys.stderr)
+        return 2
 
     if args.command in (None, "version"):
         print(f"trivy-tpu version {__version__}")
@@ -201,7 +289,11 @@ def main(argv: list[str] | None = None) -> int:
         )
         return 0
 
-    options = _options_from_args(args)
+    try:
+        options = _options_from_args(args)
+    except ValueError as e:  # e.g. a malformed --timeout duration
+        print(f"trivy-tpu: {e}", file=sys.stderr)
+        return 2
     if args.command == "config":
         options.scanners = ["misconfig"]
     if getattr(args, "input", ""):
@@ -213,10 +305,11 @@ def main(argv: list[str] | None = None) -> int:
         print(f"trivy-tpu: {args.command}: not implemented yet ({e.name})", file=sys.stderr)
         return 2
     except Exception as e:
+        from trivy_tpu.commands.run import ScanTimeoutError
         from trivy_tpu.db.client import DBError
         from trivy_tpu.image.registry import RegistryError
 
-        if isinstance(e, (DBError, RegistryError)):
+        if isinstance(e, (DBError, RegistryError, ScanTimeoutError)):
             print(f"trivy-tpu: {e}", file=sys.stderr)
             return 2
         raise

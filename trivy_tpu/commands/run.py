@@ -56,6 +56,7 @@ class Options:
     template: str = ""  # --template for --format template
     vex_path: str = ""  # --vex document
     include_non_failures: bool = False
+    timeout: float = 300.0  # --timeout seconds (reference default 5m)
     config_check: list[str] = field(default_factory=list)  # --config-check dirs
     insecure_registry: bool = False  # plain-http registry pulls
     db_repository: str = ""  # OCI ref for the vuln DB (--db-repository)
@@ -205,8 +206,46 @@ def _init_vuln_scanner(options: Options):
     return init_vuln_scanner(options.db_dir, options.cache_dir)
 
 
+from trivy_tpu.deadline import ScanTimeoutError
+
+
 def run(options: Options, target_kind: str) -> int:
-    """artifact.Run (run.go:394): scan → filter → report → exit code."""
+    """artifact.Run (run.go:394): scan → filter → report → exit code,
+    bounded by --timeout (run.go:395-402 context deadline).
+
+    The worker also arms a cooperative deadline (trivy_tpu/deadline.py) that
+    the analyzer dispatch checks, so the scan aborts shortly after the
+    timeout instead of running on (and writing reports) in the background."""
+    if options.timeout and options.timeout > 0:
+        import threading
+
+        from trivy_tpu import deadline as _deadline
+
+        box: dict = {}
+
+        def _worker() -> None:
+            _deadline.set_deadline(options.timeout)
+            try:
+                box["rc"] = _run_inner(options, target_kind)
+            except BaseException as e:  # surfaced in the caller
+                box["err"] = e
+            finally:
+                _deadline.clear()
+
+        t = threading.Thread(target=_worker, daemon=True)
+        t.start()
+        t.join(options.timeout)
+        if t.is_alive():
+            raise ScanTimeoutError(
+                f"scan timed out after {options.timeout:g}s (--timeout)"
+            )
+        if "err" in box:
+            raise box["err"]
+        return box["rc"]
+    return _run_inner(options, target_kind)
+
+
+def _run_inner(options: Options, target_kind: str) -> int:
     if options.format in ("cyclonedx", "spdx-json"):
         # SBOM outputs list every package (run.go format handling).
         options.list_all_packages = True

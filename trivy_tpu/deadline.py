@@ -1,0 +1,34 @@
+"""Scan deadline (the --timeout context, run.go:395-402).
+
+The runner's worker thread arms a monotonic deadline; long loops (analyzer
+dispatch, report writing) call check() at work boundaries so the scan stops
+soon after the timeout instead of running to completion in the background.
+Thread-local so a server process can run concurrent scans with independent
+deadlines.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class ScanTimeoutError(RuntimeError):
+    pass
+
+
+_local = threading.local()
+
+
+def set_deadline(seconds: float | None) -> None:
+    _local.at = (time.monotonic() + seconds) if seconds and seconds > 0 else None
+
+
+def clear() -> None:
+    _local.at = None
+
+
+def check() -> None:
+    at = getattr(_local, "at", None)
+    if at is not None and time.monotonic() > at:
+        raise ScanTimeoutError("scan deadline exceeded (--timeout)")
