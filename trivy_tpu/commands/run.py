@@ -273,6 +273,9 @@ def _run_inner(options: Options, target_kind: str) -> int:
                 include_non_failures=options.include_non_failures,
             ),
         )
+        from trivy_tpu import deadline as _dl
+
+        _dl.check()  # a timed-out worker must not write the report
         _write(report, options)
         return _exit_code(report, options)
     finally:

@@ -1,8 +1,11 @@
 """Scan deadline (the --timeout context, run.go:395-402).
 
-The runner's worker thread arms a monotonic deadline; long loops (analyzer
-dispatch, report writing) call check() at work boundaries so the scan stops
-soon after the timeout instead of running to completion in the background.
+The runner's worker thread arms a monotonic deadline; work boundaries call
+check() — per walked file and per analyzer in the dispatch loop, per chunk
+in the hybrid engine, and before the report writes — so a timed-out scan
+stops shortly after the deadline and never emits a report.  Phases between
+checkpoints (a single device sieve call, one oracle confirm) still run to
+their own completion first.
 Thread-local so a server process can run concurrent scans with independent
 deadlines.
 """

@@ -320,6 +320,9 @@ class HybridSecretEngine(TpuSecretEngine):
                     pending.append((lo, hi, fut))
                     si += 1
                 lo, hi, fut = pending.popleft()
+                from trivy_tpu import deadline
+
+                deadline.check()
                 self._finish_chunk(items, lo, hi, fut.result()[0], results)
         return results  # type: ignore[return-value]
 

@@ -186,3 +186,45 @@ class PallasGramSieve:
             self.interpret,
         )
         return out[:t] if pad else out
+
+
+def make_sharded_pallas_sieve(mesh, sieve: PallasGramSieve):
+    """The production kernel over a device mesh: the row axis shards across
+    the mesh's 'data' axis with shard_map, each device running the Pallas
+    program on its local rows (embarrassingly data-parallel — no collectives
+    in the sieve itself; per-file OR/candidate resolution happens after
+    gather).  Callers must size row batches to a multiple of
+    (mesh devices x block_rows) so every shard tiles cleanly.
+    """
+    import inspect
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map as _shard_map
+    except ImportError:  # older jax: experimental namespace
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+    # The replication-check kwarg was renamed across jax versions
+    # (check_rep -> check_vma); detect by signature instead of catching a
+    # TypeError that would only surface later at trace time.  Either way it
+    # is disabled: the pallas_call's out_shape carries no varying-mesh
+    # annotation and the sieve is per-shard pure.
+    params = inspect.signature(_shard_map).parameters
+    if "check_vma" in params:
+        extra = {"check_vma": False}
+    elif "check_rep" in params:
+        extra = {"check_rep": False}
+    else:
+        extra = {}
+    smap = lambda f: _shard_map(
+        f, mesh=mesh, in_specs=P("data", None), out_specs=P("data", None),
+        **extra,
+    )
+
+    @jax.jit
+    def sharded(rows: jax.Array) -> jax.Array:
+        return smap(sieve)(rows)
+
+    return sharded
