@@ -1,0 +1,123 @@
+"""Tests: compliance specs, control rollup, CLI surface."""
+
+import contextlib
+import io
+import json
+
+import pytest
+
+from trivy_tpu.compliance import build_compliance_report, load_spec
+from trivy_tpu.compliance.spec import ComplianceError
+from trivy_tpu.ftypes import Report, Result, ResultClass
+from trivy_tpu.misconf.types import MisconfFinding, Misconfiguration
+
+
+def test_builtin_spec_loads():
+    spec = load_spec("docker-cis-1.6.0")
+    assert spec.id == "docker-cis-1.6.0"
+    assert any(c.id == "4.1" for c in spec.controls)
+    assert "DS002" in spec.check_ids()
+
+
+def test_unknown_spec_is_loud():
+    with pytest.raises(ComplianceError) as e:
+        load_spec("nope")
+    assert "docker-cis-1.6.0" in str(e.value)
+
+
+def test_custom_spec_from_file(tmp_path):
+    p = tmp_path / "corp.yaml"
+    p.write_text(
+        """spec:
+  id: corp-1
+  title: Corp policy
+  controls:
+    - id: C1
+      name: No root user
+      severity: HIGH
+      checks:
+        - id: DS002
+"""
+    )
+    spec = load_spec(f"@{p}")
+    assert spec.id == "corp-1"
+    assert spec.controls[0].checks == ["DS002"]
+
+
+def _report_with(check_id: str, status: str = "FAIL") -> Report:
+    from trivy_tpu.ftypes import Result
+
+    return Report(
+        artifact_name="t",
+        artifact_type="filesystem",
+        results=[
+            Result(
+                target="Dockerfile",
+                result_class=ResultClass.CONFIG,
+                misconfigurations=[
+                    MisconfFinding(
+                        check_id=check_id, title="x", severity="HIGH",
+                        status=status,
+                    )
+                ],
+            )
+        ],
+    )
+
+
+def test_control_rollup_fail_pass_warn():
+    spec = load_spec("docker-cis-1.6.0")
+    creport = build_compliance_report(_report_with("DS002"), spec)
+    by_id = {c.control.id: c for c in creport.controls}
+    assert by_id["4.1"].status == "FAIL"
+    assert len(by_id["4.1"].findings) == 1
+    assert by_id["4.9"].status == "PASS"  # DS005 not failing
+    assert by_id["6.1"].status == "WARN"  # defaultStatus, no checks
+
+    # passing misconfigs don't fail controls
+    creport2 = build_compliance_report(_report_with("DS002", "PASS"), spec)
+    assert {c.control.id: c.status for c in creport2.controls}["4.1"] == "PASS"
+
+
+def test_compliance_json_shapes():
+    spec = load_spec("docker-cis-1.6.0")
+    creport = build_compliance_report(_report_with("DS002"), spec)
+    summary = creport.to_json(full=False)
+    assert summary["ID"] == "docker-cis-1.6.0"
+    assert summary["SummaryReport"]["SummaryControls"]
+    full = creport.to_json(full=True)
+    c41 = next(c for c in full["ControlResults"] if c["ID"] == "4.1")
+    assert c41["Results"][0]["Target"] == "Dockerfile"
+
+
+def test_compliance_cli_end_to_end(tmp_path):
+    from trivy_tpu.cli import main
+
+    (tmp_path / "Dockerfile").write_text("FROM alpine:3.18\nUSER root\n")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main([
+            "config", "--compliance", "docker-cis-1.6.0", "--format", "json",
+            str(tmp_path),
+        ])
+    assert rc == 0
+    doc = json.loads(buf.getvalue())
+    controls = {
+        c["ID"]: c for c in doc["SummaryReport"]["SummaryControls"]
+    }
+    assert controls["4.1"]["Status"] == "FAIL"  # USER root
+    assert controls["4.6"]["Status"] == "FAIL"  # no HEALTHCHECK
+    assert controls["4.7"]["Status"] == "PASS"
+
+
+def test_compliance_exit_code(tmp_path):
+    from trivy_tpu.cli import main
+
+    (tmp_path / "Dockerfile").write_text("FROM alpine:3.18\nUSER root\n")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main([
+            "config", "--compliance", "docker-cis-1.6.0", "--exit-code", "3",
+            str(tmp_path),
+        ])
+    assert rc == 3

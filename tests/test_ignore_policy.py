@@ -1,0 +1,205 @@
+"""Tests: --ignore-policy (rego result filter) and the checks bundle."""
+
+import contextlib
+import io
+import json
+
+import pytest
+
+from trivy_tpu.ftypes import (
+    Code,
+    DetectedVulnerability,
+    Result,
+    ResultClass,
+    SecretFinding,
+)
+from trivy_tpu.result.filter import FilterOptions, filter_report
+from trivy_tpu.ftypes import Report
+
+
+def _report():
+    return Report(
+        artifact_name="t",
+        artifact_type="filesystem",
+        results=[
+            Result(
+                target="app",
+                result_class=ResultClass.LANG_PKGS,
+                vulnerabilities=[
+                    DetectedVulnerability(
+                        vulnerability_id="CVE-2022-0001",
+                        pkg_name="foo",
+                        installed_version="1.0",
+                        severity="HIGH",
+                    ),
+                    DetectedVulnerability(
+                        vulnerability_id="CVE-2022-0002",
+                        pkg_name="bar",
+                        installed_version="2.0",
+                        severity="HIGH",
+                    ),
+                ],
+            ),
+            Result(
+                target="x.py",
+                result_class=ResultClass.SECRET,
+                secrets=[
+                    SecretFinding(
+                        rule_id="github-pat", category="c", severity="CRITICAL",
+                        title="t", start_line=1, end_line=1, code=Code(),
+                        match="m",
+                    ),
+                ],
+            ),
+        ],
+    )
+
+
+def test_ignore_policy_filters_by_id(tmp_path):
+    pol = tmp_path / "ignore.rego"
+    pol.write_text(
+        """package trivy
+
+default ignore := false
+
+ignore {
+    input.VulnerabilityID == "CVE-2022-0001"
+}
+"""
+    )
+    report = filter_report(
+        _report(), FilterOptions(ignore_policy=str(pol))
+    )
+    ids = [v.vulnerability_id for v in report.results[0].vulnerabilities]
+    assert ids == ["CVE-2022-0002"]
+    assert len(report.results[1].secrets) == 1  # untouched
+
+
+def test_ignore_policy_filters_secrets_and_pkg_names(tmp_path):
+    pol = tmp_path / "ignore.rego"
+    pol.write_text(
+        """package trivy
+
+default ignore := false
+
+ignore {
+    input.PkgName == "bar"
+}
+
+ignore {
+    input.RuleID == "github-pat"
+}
+"""
+    )
+    report = filter_report(_report(), FilterOptions(ignore_policy=str(pol)))
+    assert [v.vulnerability_id for v in report.results[0].vulnerabilities] == [
+        "CVE-2022-0001"
+    ]
+    assert report.results[1].secrets == []
+
+
+def test_ignore_policy_without_rule_is_loud(tmp_path):
+    from trivy_tpu.iac.rego import RegoError
+
+    pol = tmp_path / "bad.rego"
+    pol.write_text("package trivy\n\nallow { true }\n")
+    with pytest.raises(RegoError):
+        filter_report(_report(), FilterOptions(ignore_policy=str(pol)))
+
+
+def test_ignore_policy_cli_surface(tmp_path):
+    from trivy_tpu.cli import main
+
+    (tmp_path / "x.py").write_text('token = "ghp_' + "A" * 36 + '"\n')
+    pol = tmp_path / "pol.rego"
+    pol.write_text(
+        """package trivy
+
+default ignore := false
+
+ignore {
+    input.RuleID == "github-pat"
+}
+"""
+    )
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main([
+            "fs", "--scanners", "secret", "--format", "json",
+            "--ignore-policy", str(pol), str(tmp_path),
+        ])
+    assert rc == 0
+    report = json.loads(buf.getvalue())
+    assert not any(r.get("Secrets") for r in report["Results"] or [])
+
+
+def test_checks_bundle_pull(tmp_path):
+    """An OCI-distributed .rego bundle loads into the IaC engine."""
+    import gzip
+    import hashlib
+    import tarfile
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from trivy_tpu.iac.engine import IacScanner
+    from trivy_tpu.policy import BUNDLE_MEDIA_TYPE, ensure_checks_bundle
+
+    check = """# METADATA
+# title: Bundle check
+# custom:
+#   id: BNDL001
+#   severity: HIGH
+package bundle.dockerfile.BNDL001
+
+deny[res] {
+    cmd := input.Stages[_].Commands[_]
+    cmd.Cmd == "from"
+    res := result.new("bundle check fired", cmd)
+}
+"""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        data = check.encode()
+        info = tarfile.TarInfo("checks/bundle001.rego")
+        info.size = len(data)
+        tf.addfile(info, io.BytesIO(data))
+    layer = gzip.compress(buf.getvalue())
+    digest = "sha256:" + hashlib.sha256(layer).hexdigest()
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if "/manifests/" in self.path:
+                body = json.dumps({
+                    "schemaVersion": 2,
+                    "config": {"mediaType": "application/vnd.oci.empty.v1+json",
+                               "digest": "sha256:0", "size": 2},
+                    "layers": [{"mediaType": BUNDLE_MEDIA_TYPE,
+                                "digest": digest, "size": len(layer)}],
+                }).encode()
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(body)
+            elif "/blobs/" in self.path:
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(layer)
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        bundle_dir = ensure_checks_bundle(
+            f"127.0.0.1:{srv.server_address[1]}/org/checks:1",
+            cache_dir=str(tmp_path),
+            insecure=True,
+        )
+        scanner = IacScanner(extra_check_dirs=[bundle_dir])
+        mc = scanner.scan("Dockerfile", b"FROM alpine:3.18\nUSER app\n")
+        assert "BNDL001" in {f.check_id for f in mc.failures}
+    finally:
+        srv.shutdown()

@@ -177,15 +177,26 @@ def test_env_overrides_config_file(tmp_path):
     assert out.lstrip().startswith("{")  # env var won over the config file
 
 
-def test_config_file_boolean_flags(tmp_path):
-    """r3 review: store_true flags must also honor the config file."""
-    rc, out = _scan_with_config(
-        tmp_path, "format: json\ninsecure: true\nskip-db-update: false\n"
-    )
-    assert rc == 0  # parses and scans; values routed through _bool_default
-    from trivy_tpu.cli import _bool_default, _CONFIG_FILE
+def test_config_file_boolean_flags(tmp_path, monkeypatch):
+    """r3 review: store_true flags must also honor the config file —
+    asserted by capturing the Options the runner receives."""
+    import trivy_tpu.cli as cli_mod
 
-    assert _CONFIG_FILE == {} or True  # state reset per main() call
+    cfg = tmp_path / "trivy.yaml"
+    cfg.write_text("insecure: true\nlist-all-pkgs: true\n")
+    (tmp_path / "x.py").write_text("x = 1\n")
+    captured = {}
+
+    def fake_run(options, kind):
+        captured["options"] = options
+        return 0
+
+    monkeypatch.setattr(cli_mod, "run", fake_run)
+    rc = main(["fs", "--config", str(cfg), str(tmp_path)])
+    assert rc == 0
+    opts = captured["options"]
+    assert opts.insecure_registry is True
+    assert opts.list_all_packages is True
 
 
 def test_bool_default_parsing(monkeypatch):

@@ -83,6 +83,16 @@ def _env_default(name: str, default):
     return val
 
 
+def _int_default(name: str, default: int) -> int:
+    val = _env_default(name, default)
+    try:
+        return int(val)
+    except (TypeError, ValueError) as e:
+        raise ConfigFileError(
+            f"{name} must be an integer, got {val!r} (env/config)"
+        ) from e
+
+
 def _bool_default(name: str, default: bool = False) -> bool:
     val = _env_default(name, default)
     if isinstance(val, bool):
@@ -125,7 +135,7 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
     p.add_argument("-f", "--format", default=_env_default("format", "table"))
     p.add_argument("-o", "--output", default=_env_default("output", ""))
     p.add_argument(
-        "--exit-code", type=int, default=int(_env_default("exit-code", 0))
+        "--exit-code", type=int, default=_int_default("exit-code", 0)
     )
     p.add_argument(
         "--skip-files", action="append",
@@ -200,6 +210,24 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
         help="OCI reference to pull the Java index DB from",
     )
     p.add_argument(
+        "--ignore-policy", default=_env_default("ignore-policy", ""),
+        help="rego file whose 'ignore' rule filters findings",
+    )
+    p.add_argument(
+        "--checks-bundle-repository",
+        default=_env_default("checks-bundle-repository", ""),
+        help="OCI reference to pull extra .rego checks from",
+    )
+    p.add_argument(
+        "--compliance", default=_env_default("compliance", ""),
+        help="compliance spec: builtin name or @/path/to/spec.yaml",
+    )
+    p.add_argument(
+        "--report", choices=["summary", "all"],
+        default=_env_default("report", "summary"),
+        help="compliance report granularity",
+    )
+    p.add_argument(
         "--timeout", default=_env_default("timeout", "5m"),
         help="scan timeout, e.g. 300s / 5m / 1h (default 5m)",
     )
@@ -241,6 +269,10 @@ def _options_from_args(args: argparse.Namespace) -> Options:
         java_db_repository=args.java_db_repository,
         skip_db_update=args.skip_db_update,
         timeout=_parse_duration(args.timeout),
+        ignore_policy=args.ignore_policy,
+        checks_bundle_repository=args.checks_bundle_repository,
+        compliance=args.compliance,
+        compliance_report=args.report,
     )
 
 
@@ -331,6 +363,13 @@ def main(argv: list[str] | None = None) -> int:
 
     try:
         options = _options_from_args(args)
+        if options.compliance_report not in ("summary", "all"):
+            # argparse validates choices only for CLI-supplied values, not
+            # env/config-sourced defaults.
+            raise ValueError(
+                f"--report must be summary or all, got "
+                f"{options.compliance_report!r}"
+            )
     except ValueError as e:  # e.g. a malformed --timeout duration
         print(f"trivy-tpu: {e}", file=sys.stderr)
         return 2
@@ -346,10 +385,17 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     except Exception as e:
         from trivy_tpu.commands.run import ScanTimeoutError
+        from trivy_tpu.compliance.spec import ComplianceError
         from trivy_tpu.db.client import DBError
         from trivy_tpu.image.registry import RegistryError
 
-        if isinstance(e, (DBError, RegistryError, ScanTimeoutError)):
+        from trivy_tpu.iac.rego import RegoError
+
+        if isinstance(
+            e,
+            (DBError, RegistryError, ScanTimeoutError, ComplianceError,
+             RegoError),
+        ):
             print(f"trivy-tpu: {e}", file=sys.stderr)
             return 2
         raise

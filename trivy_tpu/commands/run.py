@@ -57,6 +57,10 @@ class Options:
     vex_path: str = ""  # --vex document
     include_non_failures: bool = False
     timeout: float = 300.0  # --timeout seconds (reference default 5m)
+    ignore_policy: str = ""  # --ignore-policy rego file
+    checks_bundle_repository: str = ""  # OCI ref for the checks bundle
+    compliance: str = ""  # --compliance spec name or @path
+    compliance_report: str = "summary"  # --report summary|all
     config_check: list[str] = field(default_factory=list)  # --config-check dirs
     insecure_registry: bool = False  # plain-http registry pulls
     db_repository: str = ""  # OCI ref for the vuln DB (--db-repository)
@@ -88,9 +92,22 @@ def _analyzer_options(options: Options, target_kind: str) -> AnalyzerOptions:
         disabled.extend(["dockerfile", "kubernetes", "terraform"])
     from trivy_tpu.iac.engine import configure_shared_scanner
 
+    extra_dirs = list(getattr(options, "config_check", []) or [])
+    if getattr(options, "checks_bundle_repository", ""):
+        # policy/policy.go InitBuiltinPolicies: pull the OCI-distributed
+        # .rego bundle and add it as a check source.
+        from trivy_tpu.policy import ensure_checks_bundle
+
+        extra_dirs.append(
+            ensure_checks_bundle(
+                options.checks_bundle_repository,
+                cache_dir=options.cache_dir,
+                insecure=options.insecure_registry,
+            )
+        )
     # Unconditional: also RESETS custom dirs left by a prior scan in this
     # process (the scanner is process-global).
-    configure_shared_scanner(list(getattr(options, "config_check", []) or []))
+    configure_shared_scanner(extra_dirs)
     return AnalyzerOptions(
         disabled_analyzers=disabled,
         secret_scanner_option=SecretScannerOption(
@@ -255,6 +272,9 @@ def _run_inner(options: Options, target_kind: str) -> int:
             file=sys.stderr,
         )
         return 2
+    if options.compliance:
+        # Validate the spec before the (possibly long) scan starts.
+        _compliance_spec(options)
     cache = init_cache(options)
     try:
         scanner = _build_scanner(options, target_kind, cache)
@@ -271,15 +291,51 @@ def _run_inner(options: Options, target_kind: str) -> int:
                 ignore_file=options.ignore_file,
                 vex_path=options.vex_path,
                 include_non_failures=options.include_non_failures,
+                ignore_policy=options.ignore_policy,
             ),
         )
         from trivy_tpu import deadline as _dl
 
         _dl.check()  # a timed-out worker must not write the report
+        if options.compliance:
+            from trivy_tpu.compliance import build_compliance_report
+
+            creport = build_compliance_report(
+                report, _compliance_spec(options)
+            )
+            _write_compliance_out(creport, options)
+            failed = any(c.status == "FAIL" for c in creport.controls)
+            return options.exit_code if failed and options.exit_code else 0
         _write(report, options)
         return _exit_code(report, options)
     finally:
         cache.close()
+
+
+_SPEC_CACHE: dict[str, object] = {}
+
+
+def _compliance_spec(options: Options):
+    from trivy_tpu.compliance import load_spec
+
+    key = options.compliance
+    if key not in _SPEC_CACHE:
+        _SPEC_CACHE[key] = load_spec(key)
+    return _SPEC_CACHE[key]
+
+
+def _write_compliance_out(creport, options: Options) -> None:
+    import sys
+
+    from trivy_tpu.compliance import write_compliance
+
+    full = options.compliance_report == "all"
+    fmt = "json" if options.format == "json" else "table"
+    if options.output:
+        with open(options.output, "w", encoding="utf-8") as f:
+            write_compliance(creport, fmt, full, out=f)
+    else:
+        write_compliance(creport, fmt, full, out=sys.stdout)
 
 
 def _write(report: Report, options: Options) -> None:

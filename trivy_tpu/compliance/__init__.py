@@ -1,0 +1,16 @@
+"""Compliance reporting (pkg/compliance).
+
+A compliance spec maps named controls to check/vulnerability IDs; scan
+results roll up per control into PASS/FAIL (or WARN for controls without
+automated checks), rendered as a summary or a full per-control report.
+"""
+
+from trivy_tpu.compliance.spec import ComplianceSpec, load_spec
+from trivy_tpu.compliance.report import build_compliance_report, write_compliance
+
+__all__ = [
+    "ComplianceSpec",
+    "load_spec",
+    "build_compliance_report",
+    "write_compliance",
+]

@@ -305,10 +305,13 @@ class HybridSecretEngine(TpuSecretEngine):
         self.stats.files += len(items)
         self.stats.bytes += sum(len(c) for _, c in items)
 
+        from trivy_tpu import deadline
+
         results: list[Secret | None] = [None] * len(items)
         spans = self._chunks(items)
-        with ThreadPoolExecutor(max_workers=1) as pool:
-            pending: deque = deque()
+        pool = ThreadPoolExecutor(max_workers=1)
+        pending: deque = deque()
+        try:
             si = 0
             while pending or si < len(spans):
                 # Keep up to 2 sieve jobs in flight (double buffering).
@@ -320,10 +323,16 @@ class HybridSecretEngine(TpuSecretEngine):
                     pending.append((lo, hi, fut))
                     si += 1
                 lo, hi, fut = pending.popleft()
-                from trivy_tpu import deadline
-
                 deadline.check()
                 self._finish_chunk(items, lo, hi, fut.result()[0], results)
+        except BaseException:
+            # On deadline/interrupt, drop queued chunks so shutdown only
+            # waits for the single in-flight sieve call.
+            for _lo, _hi, fut in pending:
+                fut.cancel()
+            raise
+        finally:
+            pool.shutdown(wait=True)
         return results  # type: ignore[return-value]
 
     def _finish_chunk(
