@@ -1,0 +1,257 @@
+"""Tests: subprocess plugins and extension modules."""
+
+import contextlib
+import io
+import json
+import os
+import tarfile
+
+import pytest
+
+from trivy_tpu import plugin as plugin_mod
+from trivy_tpu.module import ModuleManager
+from trivy_tpu.plugin import PluginError
+
+
+@pytest.fixture()
+def plugin_home(tmp_path, monkeypatch):
+    home = tmp_path / "plugins"
+    monkeypatch.setenv("TRIVY_TPU_PLUGIN_DIR", str(home))
+    return home
+
+
+def _make_plugin_dir(tmp_path, name="echoer", bin_body=None):
+    d = tmp_path / f"src-{name}"
+    d.mkdir()
+    (d / "plugin.yaml").write_text(
+        f"""name: {name}
+version: "0.1.0"
+usage: echo the arguments
+platforms:
+  - selector:
+      os: linux
+    uri: ./
+    bin: ./run.sh
+  - uri: ./
+    bin: ./run.sh
+"""
+    )
+    (d / "run.sh").write_text(bin_body or "#!/bin/sh\necho plugin-ran $@\n")
+    os.chmod(d / "run.sh", 0o755)
+    return d
+
+
+def test_plugin_install_list_info_uninstall(plugin_home, tmp_path):
+    src = _make_plugin_dir(tmp_path)
+    p = plugin_mod.install(str(src))
+    assert p.name == "echoer"
+    assert [pl.name for pl in plugin_mod.list_plugins()] == ["echoer"]
+    assert plugin_mod.find("echoer").version == "0.1.0"
+    plugin_mod.uninstall("echoer")
+    assert plugin_mod.list_plugins() == []
+    with pytest.raises(PluginError):
+        plugin_mod.uninstall("echoer")
+
+
+def test_plugin_install_from_tarball(plugin_home, tmp_path):
+    src = _make_plugin_dir(tmp_path, name="tarry")
+    tarball = tmp_path / "tarry.tar.gz"
+    with tarfile.open(tarball, "w:gz") as tf:
+        tf.add(src, arcname="tarry")
+    p = plugin_mod.install(str(tarball))
+    assert p.name == "tarry"
+    assert os.path.exists(os.path.join(p.dir, "run.sh"))
+
+
+def test_plugin_run_subprocess(plugin_home, tmp_path, capfd):
+    plugin_mod.install(str(_make_plugin_dir(tmp_path)))
+    p = plugin_mod.find("echoer")
+    rc = p.run(["hello", "world"])
+    assert rc == 0
+    out = capfd.readouterr().out
+    assert "plugin-ran hello world" in out
+
+
+def test_plugin_platform_selector(plugin_home, tmp_path):
+    d = tmp_path / "never"
+    d.mkdir()
+    (d / "plugin.yaml").write_text(
+        """name: never
+version: "1"
+platforms:
+  - selector:
+      os: plan9
+    bin: ./x
+"""
+    )
+    p = plugin_mod.install(str(d))
+    with pytest.raises(PluginError):
+        p.select_platform()
+
+
+def test_unknown_cli_command_falls_through_to_plugin(
+    plugin_home, tmp_path, capfd
+):
+    from trivy_tpu.cli import main
+
+    plugin_mod.install(str(_make_plugin_dir(tmp_path)))
+    rc = main(["echoer", "via-cli"])
+    assert rc == 0
+    assert "plugin-ran via-cli" in capfd.readouterr().out
+
+
+def test_plugin_cli_subcommands(plugin_home, tmp_path, capsys):
+    from trivy_tpu.cli import main
+
+    src = _make_plugin_dir(tmp_path)
+    assert main(["plugin", "install", str(src)]) == 0
+    assert main(["plugin", "list"]) == 0
+    assert "echoer" in capsys.readouterr().out
+    assert main(["plugin", "info", "echoer"]) == 0
+    assert "0.1.0" in capsys.readouterr().out
+    assert main(["plugin", "uninstall", "echoer"]) == 0
+    assert main(["plugin", "info", "echoer"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# extension modules
+# ---------------------------------------------------------------------------
+
+MODULE_SRC = '''
+NAME = "spring4shell-ish"
+VERSION = 1
+
+
+def required(file_path, size):
+    return file_path.endswith("MANIFEST.MF")
+
+
+def analyze(file_path, content):
+    if b"Spring" in content:
+        return {"custom": {"framework": "spring", "path": file_path}}
+    return None
+
+
+def post_scan(results):
+    for r in results:
+        for v in r.get("Vulnerabilities", []) or []:
+            if v["VulnerabilityID"] == "CVE-2022-22965":
+                v["Severity"] = "CRITICAL"
+    return results
+'''
+
+
+def test_module_loads_and_analyzes(tmp_path):
+    mdir = tmp_path / "modules"
+    mdir.mkdir()
+    (mdir / "spring.py").write_text(MODULE_SRC)
+    mgr = ModuleManager(str(mdir))
+    loaded = mgr.load()
+    assert [m.name for m in loaded] == ["spring4shell-ish"]
+
+    [analyzer] = mgr.analyzers()
+    assert analyzer.required("META-INF/MANIFEST.MF", 10, 0o644)
+    assert not analyzer.required("x.py", 10, 0o644)
+
+    from trivy_tpu.analyzer.core import AnalysisInput
+
+    res = analyzer.analyze(
+        AnalysisInput(
+            dir="", file_path="META-INF/MANIFEST.MF", size=20, mode=0o644,
+            content=b"Framework: Spring\n",
+        )
+    )
+    assert res.configs[0]["custom"]["framework"] == "spring"
+
+
+def test_module_post_scan_mutates_results(tmp_path):
+    from trivy_tpu.ftypes import DetectedVulnerability, Result, ResultClass
+    from trivy_tpu.scanner.post import run_post_scan_hooks
+
+    mdir = tmp_path / "modules"
+    mdir.mkdir()
+    (mdir / "spring.py").write_text(MODULE_SRC)
+    mgr = ModuleManager(str(mdir))
+    mgr.load()
+    mgr.register()
+    try:
+        results = [
+            Result(
+                target="app.jar",
+                result_class=ResultClass.LANG_PKGS,
+                vulnerabilities=[
+                    DetectedVulnerability(
+                        vulnerability_id="CVE-2022-22965",
+                        pkg_name="spring-beans",
+                        installed_version="5.3.17",
+                        severity="HIGH",
+                    )
+                ],
+            )
+        ]
+        out = run_post_scan_hooks(results)
+        assert out[0].vulnerabilities[0].severity == "CRITICAL"
+    finally:
+        mgr.unregister()
+
+
+def test_broken_module_is_tolerated(tmp_path):
+    mdir = tmp_path / "modules"
+    mdir.mkdir()
+    (mdir / "bad.py").write_text("raise RuntimeError('boom at import')\n")
+    (mdir / "good.py").write_text("NAME='ok'\nVERSION=1\n")
+    mgr = ModuleManager(str(mdir))
+    loaded = mgr.load()
+    assert [m.name for m in loaded] == ["ok"]
+
+
+def test_module_custom_resources_reach_post_scan(tmp_path):
+    """r3 review: analyze outputs must actually flow to post_scan (they
+    thread blob -> applier -> hook as CustomResources), end to end through
+    a real fs scan."""
+    from trivy_tpu.cli import main
+
+    mdir = tmp_path / "modules"
+    mdir.mkdir()
+    (mdir / "marker.py").write_text(
+        '''
+NAME = "marker"
+VERSION = 1
+SEEN = []
+
+
+def required(file_path, size):
+    return file_path.endswith(".marker")
+
+
+def analyze(file_path, content):
+    return {"custom": {"path": file_path, "tag": content.decode().strip()}}
+
+
+def post_scan(results, custom_resources):
+    import json, os
+    out = os.environ.get("MARKER_OUT")
+    if out:
+        with open(out, "w") as f:
+            json.dump(custom_resources, f)
+    return results
+'''
+    )
+    scandir = tmp_path / "tree"
+    scandir.mkdir()
+    (scandir / "a.marker").write_text("tag-one\n")
+    (scandir / "b.py").write_text("x = 1\n")
+
+    out_path = tmp_path / "seen.json"
+    os.environ["MARKER_OUT"] = str(out_path)
+    try:
+        rc = main([
+            "fs", "--scanners", "secret", "--format", "json",
+            "--module-dir", str(mdir), "-o", str(tmp_path / "r.json"),
+            str(scandir),
+        ])
+    finally:
+        os.environ.pop("MARKER_OUT", None)
+    assert rc == 0
+    seen = json.loads(out_path.read_text())
+    assert seen == [{"custom": {"path": "a.marker", "tag": "tag-one"}}]

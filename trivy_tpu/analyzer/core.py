@@ -42,6 +42,9 @@ class AnalyzerOptions:
     secret_scanner_option: "SecretScannerOption" = None  # type: ignore[assignment]
     file_patterns: dict[str, list[re.Pattern[str]]] = field(default_factory=dict)
     parallel: int = 5
+    # Per-scan extension analyzers (module manager), scoped to this group
+    # rather than the process-global registry.
+    extra_analyzers: list = field(default_factory=list)
 
     def __post_init__(self) -> None:
         if self.secret_scanner_option is None:
@@ -234,6 +237,11 @@ class AnalyzerGroup:
                 continue
             a.init(self.options)
             self.analyzers.append(a)
+        for extra in self.options.extra_analyzers:
+            if extra.type() in self.options.disabled_analyzers:
+                continue
+            extra.init(self.options)
+            self.analyzers.append(extra)
         self.post_analyzers: list[PostAnalyzer] = []
         for factory in _POST_REGISTRY:
             p = factory()

@@ -92,6 +92,7 @@ def apply_layers(layers: list[BlobInfo]) -> ArtifactDetail:
                     created_by=layer.created_by,
                 ),
             )
+        merged.custom_resources.extend(layer.custom_resources)
         for license_file in layer.licenses:
             lf = copy.copy(license_file)
             if hasattr(lf, "layer"):

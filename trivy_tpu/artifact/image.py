@@ -277,6 +277,7 @@ class ImageArtifact:
             secrets=list(result.secrets),
             licenses=list(result.licenses),
             misconfigurations=list(result.misconfigs),
+            custom_resources=list(result.configs),
         )
         self.cache.put_blob(key, blob)
 

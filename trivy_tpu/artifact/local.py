@@ -52,6 +52,7 @@ class LocalArtifact:
             secrets=list(result.secrets),
             licenses=list(result.licenses),
             misconfigurations=list(result.misconfigs),
+            custom_resources=list(result.configs),
         )
         blob_id = self._calc_cache_key(blob)
         self.cache.put_blob(blob_id, blob)

@@ -258,6 +258,9 @@ class BlobInfo:
     secrets: list[Secret] = field(default_factory=list)
     licenses: list = field(default_factory=list)
     misconfigurations: list = field(default_factory=list)
+    # Extension-module outputs (module.go CustomResources): opaque JSON
+    # values threaded through the cache/applier to post-scan hooks.
+    custom_resources: list = field(default_factory=list)
 
     def to_json(self) -> dict[str, Any]:
         out: dict[str, Any] = {"SchemaVersion": self.schema_version}
@@ -288,6 +291,8 @@ class BlobInfo:
                 m.to_json() if hasattr(m, "to_json") else m
                 for m in self.misconfigurations
             ]
+        if self.custom_resources:
+            out["CustomResources"] = list(self.custom_resources)
         return out
 
     @classmethod
@@ -311,6 +316,7 @@ class BlobInfo:
             misconfigurations=[
                 _misconf_from_json(m) for m in (d.get("Misconfigurations") or [])
             ],
+            custom_resources=list(d.get("CustomResources") or []),
         )
 
 
@@ -338,6 +344,7 @@ class ArtifactDetail:
     secrets: list[Secret] = field(default_factory=list)
     licenses: list = field(default_factory=list)
     misconfigurations: list = field(default_factory=list)
+    custom_resources: list = field(default_factory=list)
 
 
 @dataclass

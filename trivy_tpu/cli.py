@@ -223,6 +223,10 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
         help="compliance spec: builtin name or @/path/to/spec.yaml",
     )
     p.add_argument(
+        "--module-dir", default=_env_default("module-dir", ""),
+        help="directory of extension modules (custom analyzers/hooks)",
+    )
+    p.add_argument(
         "--report", choices=["summary", "all"],
         default=_env_default("report", "summary"),
         help="compliance report granularity",
@@ -273,7 +277,46 @@ def _options_from_args(args: argparse.Namespace) -> Options:
         checks_bundle_repository=args.checks_bundle_repository,
         compliance=args.compliance,
         compliance_report=args.report,
+        module_dir=args.module_dir,
     )
+
+
+def _plugin_command(args) -> int:
+    from trivy_tpu import plugin as plugin_mod
+
+    try:
+        if args.plugin_command == "install":
+            p = plugin_mod.install(args.src)
+            print(f"installed plugin {p.name} {p.version}")
+        elif args.plugin_command == "uninstall":
+            plugin_mod.uninstall(args.name)
+            print(f"uninstalled plugin {args.name}")
+        elif args.plugin_command == "list":
+            for p in plugin_mod.list_plugins():
+                print(f"{p.name}\t{p.version}\t{p.usage or p.description}")
+        elif args.plugin_command == "info":
+            p = plugin_mod.find(args.name)
+            if p is None:
+                print(f"trivy-tpu: plugin {args.name!r} not installed",
+                      file=sys.stderr)
+                return 2
+            print(f"name: {p.name}\nversion: {p.version}\n"
+                  f"usage: {p.usage}\ndescription: {p.description}")
+        elif args.plugin_command == "run":
+            p = plugin_mod.find(args.name)
+            if p is None:
+                print(f"trivy-tpu: plugin {args.name!r} not installed",
+                      file=sys.stderr)
+                return 2
+            return p.run(list(args.plugin_args))
+        else:
+            print("trivy-tpu: plugin {install|uninstall|list|info|run}",
+                  file=sys.stderr)
+            return 2
+        return 0
+    except plugin_mod.PluginError as e:
+        print(f"trivy-tpu: {e}", file=sys.stderr)
+        return 2
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -324,16 +367,46 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("version", help="print version")
 
+    p_plugin = sub.add_parser("plugin", help="manage plugins")
+    plugin_sub = p_plugin.add_subparsers(dest="plugin_command")
+    pp_install = plugin_sub.add_parser("install", help="install a plugin")
+    pp_install.add_argument("src", help="directory, .tar.gz, or URL")
+    pp_un = plugin_sub.add_parser("uninstall", help="remove a plugin")
+    pp_un.add_argument("name")
+    plugin_sub.add_parser("list", help="list installed plugins")
+    pp_info = plugin_sub.add_parser("info", help="show plugin information")
+    pp_info.add_argument("name")
+    pp_run = plugin_sub.add_parser("run", help="run a plugin")
+    pp_run.add_argument("name")
+    pp_run.add_argument("plugin_args", nargs=argparse.REMAINDER)
+
     p_config = sub.add_parser("config", help="scan config files for misconfigurations")
     _add_scan_flags(p_config, "misconfig")
     p_config.set_defaults(kind=TARGET_FILESYSTEM)
 
+    # Exposed for the plugin fall-through (aliases included), so the
+    # known-command set cannot drift from the subparser registry.
+    parser.subcommands = frozenset(sub.choices)
     return parser
 
 
 def main(argv: list[str] | None = None) -> int:
+    raw = list(argv) if argv is not None else sys.argv[1:]
+    # Unknown top-level commands fall through to installed plugins
+    # (app.go loadPluginCommands): `trivy-tpu <plugin> args...`.
+    if raw and not raw[0].startswith("-"):
+        known = getattr(build_parser(), "subcommands", frozenset())
+        if raw[0] not in known:
+            from trivy_tpu.plugin import PluginError, find
+
+            try:
+                plugin = find(raw[0])
+            except PluginError:
+                plugin = None
+            if plugin is not None:
+                return plugin.run(raw[1:])
     try:
-        _load_config_file(argv if argv is not None else sys.argv[1:])
+        _load_config_file(raw)
         args = build_parser().parse_args(argv)
     except ConfigFileError as e:
         print(f"trivy-tpu: {e}", file=sys.stderr)
@@ -342,6 +415,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.command in (None, "version"):
         print(f"trivy-tpu version {__version__}")
         return 0
+
+    if args.command == "plugin":
+        return _plugin_command(args)
 
     if args.command == "convert":
         from trivy_tpu.commands.convert import run_convert

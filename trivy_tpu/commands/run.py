@@ -61,6 +61,7 @@ class Options:
     checks_bundle_repository: str = ""  # OCI ref for the checks bundle
     compliance: str = ""  # --compliance spec name or @path
     compliance_report: str = "summary"  # --report summary|all
+    module_dir: str = ""  # --module-dir extension modules
     config_check: list[str] = field(default_factory=list)  # --config-check dirs
     insecure_registry: bool = False  # plain-http registry pulls
     db_repository: str = ""  # OCI ref for the vuln DB (--db-repository)
@@ -108,11 +109,15 @@ def _analyzer_options(options: Options, target_kind: str) -> AnalyzerOptions:
     # Unconditional: also RESETS custom dirs left by a prior scan in this
     # process (the scanner is process-global).
     configure_shared_scanner(extra_dirs)
+    extra = []
+    if getattr(options, "_module_manager", None) is not None:
+        extra = options._module_manager.analyzers()
     return AnalyzerOptions(
         disabled_analyzers=disabled,
         secret_scanner_option=SecretScannerOption(
             config_path=options.secret_config, backend=options.secret_backend
         ),
+        extra_analyzers=extra,
     )
 
 
@@ -275,8 +280,19 @@ def _run_inner(options: Options, target_kind: str) -> int:
     if options.compliance:
         # Validate the spec before the (possibly long) scan starts.
         _compliance_spec(options)
-    cache = init_cache(options)
+    manager = None
+    cache = None
     try:
+        if options.module_dir:
+            # module.NewManager (run.go:116-143 lifecycle seat): load
+            # extension modules and wire their analyzer/post-scan exports.
+            from trivy_tpu.module import ModuleManager
+
+            manager = ModuleManager(options.module_dir)
+            manager.load()
+            manager.register()
+            options._module_manager = manager
+        cache = init_cache(options)
         scanner = _build_scanner(options, target_kind, cache)
         report = scanner.scan_artifact(
             ScanOptions(
@@ -309,7 +325,10 @@ def _run_inner(options: Options, target_kind: str) -> int:
         _write(report, options)
         return _exit_code(report, options)
     finally:
-        cache.close()
+        if manager is not None:
+            manager.unregister()
+        if cache is not None:
+            cache.close()
 
 
 _SPEC_CACHE: dict[str, object] = {}

@@ -28,11 +28,26 @@ def unregister_post_scan_hook(hook: Callable) -> None:
         pass
 
 
-def run_post_scan_hooks(results: list) -> list:
-    """post.Scan: thread results through every registered hook."""
+def run_post_scan_hooks(results: list, custom_resources: list | None = None) -> list:
+    """post.Scan: thread results through every registered hook.
+
+    Hooks accepting a second parameter also receive the scan's custom
+    resources (extension-module analyze outputs, module.go CustomResources).
+    """
+    import inspect
+
     for hook in list(_HOOKS):
         try:
-            out = hook(results)
+            try:
+                accepts_two = (
+                    len(inspect.signature(hook).parameters) >= 2
+                )
+            except (TypeError, ValueError):
+                accepts_two = False
+            if accepts_two:
+                out = hook(results, custom_resources or [])
+            else:
+                out = hook(results)
         except Exception:
             logger.warning("post-scan hook %r failed", hook, exc_info=True)
             continue

@@ -80,7 +80,9 @@ class LocalDriver(Driver):
         # the WASM/extension seat.
         from trivy_tpu.scanner.post import run_post_scan_hooks
 
-        results = run_post_scan_hooks(results)
+        results = run_post_scan_hooks(
+            results, custom_resources=detail.custom_resources
+        )
 
         return results, detail.os
 
