@@ -1,0 +1,178 @@
+"""Tests: SBOM discovery, executable digests, buildinfo, python-pkg, and
+the system-file post-handler."""
+
+import hashlib
+import json
+
+import pytest
+
+from trivy_tpu.analyzer.core import AnalysisInput, AnalysisResult
+from trivy_tpu.analyzer.misc import (
+    ContentManifestAnalyzer,
+    DockerfileLabelAnalyzer,
+    ExecutableAnalyzer,
+    PythonPkgAnalyzer,
+    SbomFileAnalyzer,
+)
+from trivy_tpu.handler import system_file_filter
+from trivy_tpu.atypes import Application
+
+
+def _inp(path: str, content: bytes, mode: int = 0o644) -> AnalysisInput:
+    return AnalysisInput(
+        dir="", file_path=path, size=len(content), mode=mode, content=content
+    )
+
+
+def test_sbom_analyzer_cyclonedx():
+    bom = {
+        "bomFormat": "CycloneDX",
+        "specVersion": "1.5",
+        "components": [
+            {
+                "type": "library",
+                "name": "log4j-core",
+                "group": "org.apache.logging.log4j",
+                "version": "2.14.1",
+                "purl": "pkg:maven/org.apache.logging.log4j/log4j-core@2.14.1",
+            }
+        ],
+    }
+    a = SbomFileAnalyzer()
+    assert a.required("opt/bitnami/elasticsearch/.spdx-es.cdx.json", 100, 0)
+    assert not a.required("app.json", 100, 0)
+    res = a.analyze(_inp("app/.sbom.cdx.json", json.dumps(bom).encode()))
+    assert res is not None
+    pkgs = [p for app in res.applications for p in app.packages] + [
+        p for pi in res.package_infos for p in pi.packages
+    ]
+    assert any("log4j-core" in p.name for p in pkgs)
+
+
+def test_executable_digests():
+    a = ExecutableAnalyzer()
+    elf = b"\x7fELF" + b"\x00" * 64
+    # disabled by default: hashing every binary is gated behind rekor
+    assert not a.required("usr/bin/tool", len(elf), 0o755)
+
+    class _Opts:
+        sbom_sources = ["rekor"]
+
+    a.init(_Opts())
+    assert a.required("usr/bin/tool", len(elf), 0o755)
+    assert not a.required("usr/share/doc.txt", 10, 0o644)
+    res = a.analyze(_inp("usr/bin/tool", elf, mode=0o755))
+    [rec] = res.configs
+    assert rec["Type"] == "executable"
+    assert rec["Digest"] == "sha256:" + hashlib.sha256(elf).hexdigest()
+    # scripts (non-ELF) are skipped
+    assert a.analyze(_inp("s.sh", b"#!/bin/sh\n", mode=0o755)) is None
+
+
+def test_redhat_buildinfo():
+    cm = ContentManifestAnalyzer()
+    assert cm.required("root/buildinfo/content_manifests/ubi8.json", 10, 0)
+    res = cm.analyze(_inp(
+        "root/buildinfo/content_manifests/ubi8.json",
+        json.dumps({"content_sets": ["rhel-8-for-x86_64-baseos-rpms"]}).encode(),
+    ))
+    assert res.build_info == {
+        "ContentSets": ["rhel-8-for-x86_64-baseos-rpms"]
+    }
+
+    dl = DockerfileLabelAnalyzer()
+    text = (
+        b'LABEL "com.redhat.component"="ubi8-container" '
+        b'"version"="8.9" "release"="1023" "architecture"="x86_64"\n'
+    )
+    res = dl.analyze(_inp("root/buildinfo/Dockerfile-ubi8-8.9", text))
+    assert res.build_info["Nvr"] == "ubi8-container-8.9-1023"
+    assert res.build_info["Arch"] == "x86_64"
+
+
+def test_python_pkg_analyzer():
+    a = PythonPkgAnalyzer()
+    meta = b"Metadata-Version: 2.1\nName: Requests\nVersion: 2.31.0\nLicense: Apache-2.0\n"
+    assert a.required(
+        "usr/lib/python3.9/site-packages/requests-2.31.0.dist-info/METADATA",
+        len(meta), 0o644,
+    )
+    res = a.analyze(_inp(
+        "usr/lib/python3.9/site-packages/requests-2.31.0.dist-info/METADATA",
+        meta,
+    ))
+    [app] = res.applications
+    assert app.app_type == "python-pkg"
+    assert [(p.name, p.version) for p in app.packages] == [
+        ("requests", "2.31.0")
+    ]
+    assert app.packages[0].licenses == ["Apache-2.0"]
+
+
+def test_system_file_filter_drops_os_owned_packages():
+    result = AnalysisResult()
+    result.system_installed_files = [
+        "/usr/lib/python3.9/site-packages/requests-2.31.0.dist-info/METADATA"
+    ]
+    result.applications = [
+        Application(
+            app_type="python-pkg",
+            file_path="usr/lib/python3.9/site-packages/requests-2.31.0.dist-info/METADATA",
+        ),
+        Application(
+            app_type="python-pkg",
+            file_path="opt/app/venv/lib/flask-3.0.dist-info/METADATA",
+        ),
+        Application(app_type="pip", file_path="opt/app/requirements.txt"),
+    ]
+    system_file_filter(result)
+    paths = [a.file_path for a in result.applications]
+    # OS-owned metadata dropped; venv-installed and lockfile apps kept
+    assert paths == [
+        "opt/app/venv/lib/flask-3.0.dist-info/METADATA",
+        "opt/app/requirements.txt",
+    ]
+
+
+def test_sysfile_filter_end_to_end(tmp_path):
+    """An rpm/apk-owned python package disappears from the fs scan while a
+    user-installed one stays (handler runs in the artifact pipeline)."""
+    import contextlib
+    import io
+
+    from trivy_tpu.cli import main
+
+    root = tmp_path / "rootfs"
+    apkdir = root / "lib" / "apk" / "db"
+    apkdir.mkdir(parents=True)
+    (apkdir / "installed").write_text(
+        "P:py3-requests\nV:2.31.0-r0\nA:x86_64\n"
+        "F:usr/lib/python3.11/site-packages/requests-2.31.0.dist-info\n"
+        "R:METADATA\n\n"
+    )
+    meta_dir = root / "usr/lib/python3.11/site-packages/requests-2.31.0.dist-info"
+    meta_dir.mkdir(parents=True)
+    (meta_dir / "METADATA").write_text("Name: requests\nVersion: 2.31.0\n")
+    user_dir = root / "opt/app/flask-3.0.dist-info"
+    user_dir.mkdir(parents=True)
+    (user_dir / "METADATA").write_text("Name: flask\nVersion: 3.0.0\n")
+
+    # a present (empty) DB so the vuln pipeline emits package results
+    from trivy_tpu.db.vulndb import build_db
+
+    build_db(str(tmp_path / "db"), {})
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main([
+            "rootfs", "--scanners", "vuln", "--format", "json",
+            "--list-all-pkgs", "--db-dir", str(tmp_path / "db"), str(root),
+        ])
+    assert rc == 0
+    report = json.loads(buf.getvalue())
+    pypkg_targets = [
+        r["Target"] for r in report["Results"] or []
+        if r.get("Type") == "python-pkg"
+    ]
+    assert any("flask" in t for t in pypkg_targets)
+    assert not any("requests" in t for t in pypkg_targets)

@@ -95,15 +95,17 @@ def test_parse_os_release():
 
 def test_parse_apk_db():
     db = (
-        b"P:musl\nV:1.2.2-r7\nA:x86_64\nL:MIT\no:musl\nD:so:libc.musl\n\n"
+        b"P:musl\nV:1.2.2-r7\nA:x86_64\nL:MIT\no:musl\nD:so:libc.musl\n"
+        b"F:lib\nR:ld-musl-x86_64.so.1\n\n"
         b"P:busybox\nV:1.34.1-r5\nA:x86_64\nL:GPL-2.0-only\no:busybox\n\n"
     )
-    pkgs = parse_apk_db(db)
+    pkgs, files = parse_apk_db(db)
     assert [(p.name, p.version) for p in pkgs] == [
         ("musl", "1.2.2-r7"),
         ("busybox", "1.34.1-r5"),
     ]
     assert pkgs[0].licenses == ["MIT"]
+    assert files == ["lib/ld-musl-x86_64.so.1"]
 
 
 def test_parse_dpkg_status():

@@ -45,6 +45,7 @@ class AnalyzerOptions:
     # Per-scan extension analyzers (module manager), scoped to this group
     # rather than the process-global registry.
     extra_analyzers: list = field(default_factory=list)
+    sbom_sources: list = field(default_factory=list)  # --sbom-sources
 
     def __post_init__(self) -> None:
         if self.secret_scanner_option is None:
@@ -82,6 +83,7 @@ class AnalysisResult:
     misconfigs: list = field(default_factory=list)
     configs: list = field(default_factory=list)
     system_installed_files: list[str] = field(default_factory=list)
+    build_info: dict | None = None  # Red Hat buildinfo (content sets, nvr)
 
     def merge(self, other: "AnalysisResult | None") -> None:
         """AnalysisResult.Merge (analyzer.go:245-313)."""
@@ -96,6 +98,10 @@ class AnalysisResult:
         self.misconfigs.extend(other.misconfigs)
         self.configs.extend(other.configs)
         self.system_installed_files.extend(other.system_installed_files)
+        if other.build_info:
+            merged = dict(self.build_info or {})
+            merged.update(other.build_info)
+            self.build_info = merged
 
     def sort(self) -> None:
         """AnalysisResult.Sort (analyzer.go:186-243); secrets :219-229."""
@@ -217,6 +223,7 @@ def _ensure_builtin_registered() -> None:
     from trivy_tpu.analyzer import java as _java  # noqa: F401
     from trivy_tpu.analyzer import lang as _lang  # noqa: F401
     from trivy_tpu.analyzer import license as _license  # noqa: F401
+    from trivy_tpu.analyzer import misc as _misc  # noqa: F401
     from trivy_tpu.analyzer import os_release as _os  # noqa: F401
     from trivy_tpu.analyzer import pkg_apk as _apk  # noqa: F401
     from trivy_tpu.analyzer import pkg_dpkg as _dpkg  # noqa: F401

@@ -18,13 +18,19 @@ from trivy_tpu.atypes import Package, PackageInfo
 REQUIRED_FILE = "lib/apk/db/installed"
 
 
-def parse_apk_db(content: bytes) -> list[Package]:
+def parse_apk_db(content: bytes) -> tuple[list[Package], list[str]]:
+    """Returns (packages, installed_files): F:/R: stanza fields list each
+    package's directory/file entries (apk.go collects them for the
+    system-file filter, SystemInstalledFiles)."""
     packages: list[Package] = []
+    installed_files: list[str] = []
     cur: dict[str, str] = {}
     depends: list[str] = []
+    cur_dir = ""
 
     def flush() -> None:
-        nonlocal cur, depends
+        nonlocal cur, depends, cur_dir
+        cur_dir = ""
         if cur.get("P") and cur.get("V"):
             name, version = cur["P"], cur["V"]
             packages.append(
@@ -46,6 +52,12 @@ def parse_apk_db(content: bytes) -> list[Package]:
             flush()
             continue
         key, _, value = raw.partition(":")
+        if key == "F":
+            cur_dir = value
+            continue
+        if key == "R":
+            installed_files.append(f"{cur_dir}/{value}" if cur_dir else value)
+            continue
         if key == "D":
             for dep in value.split():
                 dep = dep.split("=")[0].split("<")[0].split(">")[0].split("~")[0]
@@ -54,7 +66,7 @@ def parse_apk_db(content: bytes) -> list[Package]:
         elif key:
             cur[key] = value
     flush()
-    return packages
+    return packages, installed_files
 
 
 class ApkAnalyzer(Analyzer):
@@ -68,13 +80,14 @@ class ApkAnalyzer(Analyzer):
         return file_path == REQUIRED_FILE
 
     def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
-        packages = parse_apk_db(inp.content)
+        packages, installed_files = parse_apk_db(inp.content)
         if not packages:
             return None
         return AnalysisResult(
             package_infos=[
                 PackageInfo(file_path=inp.file_path, packages=packages)
-            ]
+            ],
+            system_installed_files=installed_files,
         )
 
 

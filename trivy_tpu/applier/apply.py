@@ -93,6 +93,12 @@ def apply_layers(layers: list[BlobInfo]) -> ArtifactDetail:
                 ),
             )
         merged.custom_resources.extend(layer.custom_resources)
+        if layer.build_info:
+            # Red Hat buildinfo: later layers override earlier fields
+            # (applier/docker.go BuildInfo handling).
+            bi = dict(merged.build_info or {})
+            bi.update(layer.build_info)
+            merged.build_info = bi
         for license_file in layer.licenses:
             lf = copy.copy(license_file)
             if hasattr(lf, "layer"):

@@ -265,6 +265,9 @@ class ImageArtifact:
             layer = walk_layer_tar(f)
             result = self.group.analyze_entries("", layer.entries, disabled)
             result.merge(self.group.post_analyze())
+            from trivy_tpu.handler import run_post_handlers
+
+            run_post_handlers(result)
             result.sort()
         blob = BlobInfo(
             diff_id=diff_id,
@@ -278,6 +281,7 @@ class ImageArtifact:
             licenses=list(result.licenses),
             misconfigurations=list(result.misconfigs),
             custom_resources=list(result.configs),
+            build_info=result.build_info,
         )
         self.cache.put_blob(key, blob)
 

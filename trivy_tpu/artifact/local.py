@@ -43,6 +43,9 @@ class LocalArtifact:
         # Post-analyzers see their composite FS after the walk (fs.go:120
         # PostAnalyze): cross-file context like lockfile + manifest pairs.
         result.merge(self.group.post_analyze())
+        from trivy_tpu.handler import run_post_handlers
+
+        run_post_handlers(result)
         result.sort()
 
         blob = BlobInfo(
@@ -53,6 +56,7 @@ class LocalArtifact:
             licenses=list(result.licenses),
             misconfigurations=list(result.misconfigs),
             custom_resources=list(result.configs),
+            build_info=result.build_info,
         )
         blob_id = self._calc_cache_key(blob)
         self.cache.put_blob(blob_id, blob)

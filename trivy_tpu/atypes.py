@@ -261,6 +261,7 @@ class BlobInfo:
     # Extension-module outputs (module.go CustomResources): opaque JSON
     # values threaded through the cache/applier to post-scan hooks.
     custom_resources: list = field(default_factory=list)
+    build_info: dict | None = None  # Red Hat buildinfo (types.BuildInfo)
 
     def to_json(self) -> dict[str, Any]:
         out: dict[str, Any] = {"SchemaVersion": self.schema_version}
@@ -293,6 +294,8 @@ class BlobInfo:
             ]
         if self.custom_resources:
             out["CustomResources"] = list(self.custom_resources)
+        if self.build_info:
+            out["BuildInfo"] = dict(self.build_info)
         return out
 
     @classmethod
@@ -317,6 +320,7 @@ class BlobInfo:
                 _misconf_from_json(m) for m in (d.get("Misconfigurations") or [])
             ],
             custom_resources=list(d.get("CustomResources") or []),
+            build_info=d.get("BuildInfo") or None,
         )
 
 
@@ -345,6 +349,7 @@ class ArtifactDetail:
     licenses: list = field(default_factory=list)
     misconfigurations: list = field(default_factory=list)
     custom_resources: list = field(default_factory=list)
+    build_info: dict | None = None  # Red Hat buildinfo (merged over layers)
 
 
 @dataclass

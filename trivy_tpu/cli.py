@@ -227,6 +227,11 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
         help="directory of extension modules (custom analyzers/hooks)",
     )
     p.add_argument(
+        "--sbom-sources", action="append",
+        default=[s for s in str(_env_default("sbom-sources", "")).split(",") if s],
+        help="external SBOM sources (rekor enables executable digesting)",
+    )
+    p.add_argument(
         "--report", choices=["summary", "all"],
         default=_env_default("report", "summary"),
         help="compliance report granularity",
@@ -278,6 +283,7 @@ def _options_from_args(args: argparse.Namespace) -> Options:
         compliance=args.compliance,
         compliance_report=args.report,
         module_dir=args.module_dir,
+        sbom_sources=list(args.sbom_sources),
     )
 
 
@@ -394,8 +400,14 @@ def main(argv: list[str] | None = None) -> int:
     raw = list(argv) if argv is not None else sys.argv[1:]
     # Unknown top-level commands fall through to installed plugins
     # (app.go loadPluginCommands): `trivy-tpu <plugin> args...`.
+    try:
+        _load_config_file(raw)  # must precede build_parser (flag defaults)
+        parser = build_parser()
+    except ConfigFileError as e:
+        print(f"trivy-tpu: {e}", file=sys.stderr)
+        return 2
     if raw and not raw[0].startswith("-"):
-        known = getattr(build_parser(), "subcommands", frozenset())
+        known = getattr(parser, "subcommands", frozenset())
         if raw[0] not in known:
             from trivy_tpu.plugin import PluginError, find
 
@@ -406,8 +418,7 @@ def main(argv: list[str] | None = None) -> int:
             if plugin is not None:
                 return plugin.run(raw[1:])
     try:
-        _load_config_file(raw)
-        args = build_parser().parse_args(argv)
+        args = parser.parse_args(argv)
     except ConfigFileError as e:
         print(f"trivy-tpu: {e}", file=sys.stderr)
         return 2

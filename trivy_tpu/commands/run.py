@@ -62,6 +62,7 @@ class Options:
     compliance: str = ""  # --compliance spec name or @path
     compliance_report: str = "summary"  # --report summary|all
     module_dir: str = ""  # --module-dir extension modules
+    sbom_sources: list[str] = field(default_factory=list)  # --sbom-sources
     config_check: list[str] = field(default_factory=list)  # --config-check dirs
     insecure_registry: bool = False  # plain-http registry pulls
     db_repository: str = ""  # OCI ref for the vuln DB (--db-repository)
@@ -118,6 +119,7 @@ def _analyzer_options(options: Options, target_kind: str) -> AnalyzerOptions:
             config_path=options.secret_config, backend=options.secret_backend
         ),
         extra_analyzers=extra,
+        sbom_sources=list(getattr(options, "sbom_sources", []) or []),
     )
 
 
@@ -283,12 +285,19 @@ def _run_inner(options: Options, target_kind: str) -> int:
     manager = None
     cache = None
     try:
-        if options.module_dir:
+        import os as _osm
+
+        from trivy_tpu.module import DEFAULT_MODULE_DIR
+
+        module_dir = options.module_dir or (
+            DEFAULT_MODULE_DIR if _osm.path.isdir(DEFAULT_MODULE_DIR) else ""
+        )
+        if module_dir:
             # module.NewManager (run.go:116-143 lifecycle seat): load
             # extension modules and wire their analyzer/post-scan exports.
             from trivy_tpu.module import ModuleManager
 
-            manager = ModuleManager(options.module_dir)
+            manager = ModuleManager(module_dir)
             manager.load()
             manager.register()
             options._module_manager = manager

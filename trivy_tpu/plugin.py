@@ -137,16 +137,20 @@ class Plugin:
 
 
 def _extract_tar(src, dest: str) -> None:
-    with tarfile.open(fileobj=src, mode="r:*") as tf:
-        for member in tf.getmembers():
-            if ".." in member.name or member.name.startswith("/"):
-                continue
-            try:
-                tf.extract(member, dest, filter="data")
-            except TypeError:  # Python < 3.10.12: no extraction filters
-                if member.issym() or member.islnk() or member.isdev():
-                    continue
-                tf.extract(member, dest)
+    try:
+        with tarfile.open(fileobj=src, mode="r:*") as tf:
+            for member in tf.getmembers():
+                parts = member.name.split("/")
+                if ".." in parts or member.name.startswith("/"):
+                    continue  # path traversal; names merely containing '..' pass
+                try:
+                    tf.extract(member, dest, filter="data")
+                except TypeError:  # Python < 3.10.12: no extraction filters
+                    if member.issym() or member.islnk() or member.isdev():
+                        continue
+                    tf.extract(member, dest)
+    except tarfile.TarError as e:
+        raise PluginError(f"invalid plugin archive: {e}") from e
 
 
 def install(src: str) -> Plugin:
@@ -159,12 +163,15 @@ def install(src: str) -> Plugin:
                 _extract_tar(f, tmp)
             stage = tmp
         elif src.startswith(("http://", "https://")):
+            import io
+            import urllib.error
             import urllib.request
 
-            with urllib.request.urlopen(src, timeout=120) as resp:
-                import io
-
-                buf = io.BytesIO(resp.read())
+            try:
+                with urllib.request.urlopen(src, timeout=120) as resp:
+                    buf = io.BytesIO(resp.read())
+            except urllib.error.URLError as e:
+                raise PluginError(f"cannot download plugin {src!r}: {e}") from e
             _extract_tar(buf, tmp)
             stage = tmp
         else:
