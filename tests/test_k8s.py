@@ -1,0 +1,198 @@
+"""Tests: k8s super-command — kubeconfig, API enumeration, scan fan-out."""
+
+import base64
+import contextlib
+import io
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+import yaml
+
+from trivy_tpu.k8s import (
+    K8sScanner,
+    KubeClient,
+    KubeConfigError,
+    load_kubeconfig,
+)
+
+PRIVILEGED_DEPLOY = {
+    "apiVersion": "apps/v1",
+    "kind": "Deployment",
+    "metadata": {"name": "web", "namespace": "prod"},
+    "spec": {
+        "template": {
+            "spec": {
+                "containers": [
+                    {
+                        "name": "app",
+                        "image": "registry.example/app:1.0",
+                        "securityContext": {"privileged": True},
+                    }
+                ]
+            }
+        }
+    },
+}
+
+OWNED_POD = {
+    "apiVersion": "v1",
+    "kind": "Pod",
+    "metadata": {
+        "name": "web-abc123",
+        "namespace": "prod",
+        "ownerReferences": [{"kind": "ReplicaSet", "controller": True}],
+    },
+    "spec": {"containers": [{"name": "app", "image": "registry.example/app:1.0"}]},
+}
+
+STANDALONE_POD = {
+    "apiVersion": "v1",
+    "kind": "Pod",
+    "metadata": {"name": "debug", "namespace": "ops"},
+    "spec": {
+        "hostNetwork": True,
+        "containers": [{"name": "sh", "image": "tools:latest"}],
+    },
+}
+
+
+class _FakeAPI(BaseHTTPRequestHandler):
+    token = "sekret-token"
+    seen_auth: list = []
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):  # noqa: N802
+        type(self).seen_auth.append(self.headers.get("Authorization", ""))
+        if self.headers.get("Authorization") != f"Bearer {self.token}":
+            self.send_response(401)
+            self.end_headers()
+            return
+        items: list = []
+        if self.path == "/api/v1/pods":
+            items = [OWNED_POD, STANDALONE_POD]
+        elif self.path == "/apis/apps/v1/deployments":
+            items = [PRIVILEGED_DEPLOY]
+        elif self.path.startswith("/api/v1/namespaces/prod/pods"):
+            items = [OWNED_POD]
+        elif self.path.startswith("/apis/apps/v1/namespaces/prod/deployments"):
+            items = [PRIVILEGED_DEPLOY]
+        elif "replicasets" in self.path or "statefulsets" in self.path or \
+                "daemonsets" in self.path or "jobs" in self.path or \
+                "cronjobs" in self.path:
+            items = []
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        body = json.dumps({"items": items}).encode()
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture(scope="module")
+def api_server():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeAPI)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def _write_kubeconfig(tmp_path, server: str) -> str:
+    cfg = {
+        "current-context": "test",
+        "contexts": [
+            {"name": "test", "context": {"cluster": "c1", "user": "u1"}}
+        ],
+        "clusters": [{"name": "c1", "cluster": {"server": server}}],
+        "users": [{"name": "u1", "user": {"token": _FakeAPI.token}}],
+    }
+    path = tmp_path / "kubeconfig"
+    path.write_text(yaml.safe_dump(cfg))
+    return str(path)
+
+
+def test_kubeconfig_loading(tmp_path, api_server):
+    path = _write_kubeconfig(tmp_path, api_server)
+    auth = load_kubeconfig(path)
+    assert auth.server == api_server
+    assert auth.token == _FakeAPI.token
+    with pytest.raises(KubeConfigError):
+        load_kubeconfig(path, context="missing")
+    with pytest.raises(KubeConfigError):
+        load_kubeconfig(str(tmp_path / "enoent"))
+
+
+def test_enumeration_and_auth(tmp_path, api_server):
+    auth = load_kubeconfig(_write_kubeconfig(tmp_path, api_server))
+    client = KubeClient(auth)
+    resources = client.list_workloads()
+    kinds = sorted(r["kind"] for r in resources)
+    assert kinds == ["Deployment", "Pod", "Pod"]
+    assert any(
+        a == f"Bearer {_FakeAPI.token}" for a in _FakeAPI.seen_auth
+    )
+    # namespace-scoped enumeration
+    prod = client.list_workloads(namespace="prod")
+    assert sorted(r["kind"] for r in prod) == ["Deployment", "Pod"]
+
+
+def test_scan_fanout_misconfig(tmp_path, api_server):
+    auth = load_kubeconfig(_write_kubeconfig(tmp_path, api_server))
+    resources = KubeClient(auth).list_workloads()
+    report = K8sScanner(scanners=["misconfig"]).scan(
+        resources, cluster_name="test-cluster"
+    )
+    rows = {(r.kind, r.name): r for r in report.resources}
+    # owned pod deduped; deployment + standalone pod remain
+    assert set(rows) == {("Deployment", "web"), ("Pod", "debug")}
+    dep = rows[("Deployment", "web")]
+    ids = {
+        m.check_id
+        for res in dep.results
+        for m in res.misconfigurations
+    }
+    assert "KSV017" in ids  # privileged container
+    pod = rows[("Pod", "debug")]
+    pod_ids = {
+        m.check_id for res in pod.results for m in res.misconfigurations
+    }
+    assert "KSV009" in pod_ids  # hostNetwork
+
+    summary = report.to_json(full=False)
+    dep_row = next(
+        r for r in summary["Resources"] if r["Name"] == "web"
+    )
+    assert dep_row["Summary"]["Misconfigurations"]["HIGH"] >= 1
+
+
+def test_k8s_cli_surface(tmp_path, api_server):
+    from trivy_tpu.cli import main
+
+    path = _write_kubeconfig(tmp_path, api_server)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main([
+            "k8s", "cluster", "--kubeconfig", path, "--format", "json",
+            "--scanners", "misconfig",
+        ])
+    assert rc == 0
+    doc = json.loads(buf.getvalue())
+    assert doc["ClusterName"].startswith("http://127.0.0.1")
+    assert {r["Kind"] for r in doc["Resources"]} == {"Deployment", "Pod"}
+
+
+def test_k8s_image_scan_failure_tolerated(tmp_path, api_server):
+    """Unreachable registries mark the resource, not the whole run."""
+    auth = load_kubeconfig(_write_kubeconfig(tmp_path, api_server))
+    resources = KubeClient(auth).list_workloads(namespace="prod")
+    report = K8sScanner(
+        scanners=["misconfig", "secret"], insecure_registry=True
+    ).scan(resources)
+    dep = next(r for r in report.resources if r.kind == "Deployment")
+    assert dep.error  # registry.example is unreachable
+    assert any(res.misconfigurations for res in dep.results)  # misconf kept

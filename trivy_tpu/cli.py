@@ -287,6 +287,44 @@ def _options_from_args(args: argparse.Namespace) -> Options:
     )
 
 
+def _k8s_command(args) -> int:
+    from trivy_tpu.k8s import (
+        K8sScanner,
+        KubeClient,
+        KubeConfigError,
+        load_kubeconfig,
+        write_k8s_report,
+    )
+
+    try:
+        auth = load_kubeconfig(args.kubeconfig, args.context)
+        client = KubeClient(auth)
+        namespace = "" if args.k8s_target == "cluster" else args.k8s_target
+        resources = client.list_workloads(namespace=namespace)
+    except KubeConfigError as e:
+        print(f"trivy-tpu: {e}", file=sys.stderr)
+        return 2
+    scanner = K8sScanner(
+        scanners=[s for s in args.scanners.split(",") if s],
+        insecure_registry=args.insecure,
+        db_dir=args.db_dir,
+    )
+    report = scanner.scan(resources, cluster_name=auth.server)
+    full = args.report == "all"
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as f:
+            write_k8s_report(report, args.format, full, out=f)
+    else:
+        write_k8s_report(report, args.format, full)
+    if args.exit_code and any(
+        r.counts() or r.error for r in report.resources
+    ):
+        # Findings AND per-resource scan errors both fail the run: an
+        # unreachable registry must not turn CI green.
+        return args.exit_code
+    return 0
+
+
 def _plugin_command(args) -> int:
     from trivy_tpu import plugin as plugin_mod
 
@@ -390,6 +428,26 @@ def build_parser() -> argparse.ArgumentParser:
     _add_scan_flags(p_config, "misconfig")
     p_config.set_defaults(kind=TARGET_FILESYSTEM)
 
+    p_k8s = sub.add_parser("k8s", help="scan a kubernetes cluster")
+    p_k8s.add_argument(
+        "k8s_target", nargs="?", default="cluster",
+        help="'cluster' or a namespace name",
+    )
+    p_k8s.add_argument("--kubeconfig", default=_env_default("kubeconfig", ""))
+    p_k8s.add_argument("--context", default="")
+    p_k8s.add_argument(
+        "--scanners", default=_env_default("scanners", "misconfig"),
+        help="comma-separated: misconfig,vuln,secret",
+    )
+    p_k8s.add_argument("-f", "--format", default=_env_default("format", "table"))
+    p_k8s.add_argument("-o", "--output", default="")
+    p_k8s.add_argument("--report", choices=["summary", "all"], default="summary")
+    p_k8s.add_argument("--insecure", action="store_true",
+                       default=_bool_default("insecure"))
+    p_k8s.add_argument("--db-dir", default=_env_default("db-dir", ""))
+    p_k8s.add_argument("--exit-code", type=int,
+                       default=_int_default("exit-code", 0))
+
     # Exposed for the plugin fall-through (aliases included), so the
     # known-command set cannot drift from the subparser registry.
     parser.subcommands = frozenset(sub.choices)
@@ -400,14 +458,21 @@ def main(argv: list[str] | None = None) -> int:
     raw = list(argv) if argv is not None else sys.argv[1:]
     # Unknown top-level commands fall through to installed plugins
     # (app.go loadPluginCommands): `trivy-tpu <plugin> args...`.
+    config_err: ConfigFileError | None = None
+    parser = None
     try:
         _load_config_file(raw)  # must precede build_parser (flag defaults)
         parser = build_parser()
     except ConfigFileError as e:
-        print(f"trivy-tpu: {e}", file=sys.stderr)
-        return 2
+        # Deferred: a broken config file must not block plugin dispatch
+        # (plugins do not consume trivy.yaml); builtin commands still fail.
+        config_err = e
     if raw and not raw[0].startswith("-"):
-        known = getattr(parser, "subcommands", frozenset())
+        known = (
+            getattr(parser, "subcommands", frozenset())
+            if parser is not None
+            else frozenset()
+        )
         if raw[0] not in known:
             from trivy_tpu.plugin import PluginError, find
 
@@ -417,11 +482,10 @@ def main(argv: list[str] | None = None) -> int:
                 plugin = None
             if plugin is not None:
                 return plugin.run(raw[1:])
-    try:
-        args = parser.parse_args(argv)
-    except ConfigFileError as e:
-        print(f"trivy-tpu: {e}", file=sys.stderr)
+    if config_err is not None:
+        print(f"trivy-tpu: {config_err}", file=sys.stderr)
         return 2
+    args = parser.parse_args(argv)
 
     if args.command in (None, "version"):
         print(f"trivy-tpu version {__version__}")
@@ -429,6 +493,9 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.command == "plugin":
         return _plugin_command(args)
+
+    if args.command == "k8s":
+        return _k8s_command(args)
 
     if args.command == "convert":
         from trivy_tpu.commands.convert import run_convert

@@ -92,6 +92,12 @@ def _analyzer_options(options: Options, target_kind: str) -> AnalyzerOptions:
         disabled.extend(["license-file", "dpkg-license"])
     if "misconfig" not in options.scanners:
         disabled.extend(["dockerfile", "kubernetes", "terraform"])
+    if "rekor" not in (getattr(options, "sbom_sources", []) or []):
+        # Executable digesting costs a full-content hash per binary and only
+        # serves Rekor lookups; disabling it here (not just gating required)
+        # keeps it out of the blob cache key so toggling --sbom-sources
+        # invalidates cached blobs correctly.
+        disabled.append("executable")
     from trivy_tpu.iac.engine import configure_shared_scanner
 
     extra_dirs = list(getattr(options, "config_check", []) or [])

@@ -1,0 +1,187 @@
+"""Kubernetes API client: kubeconfig parsing + resource enumeration.
+
+The reference rides the trivy-kubernetes library; this client speaks the
+API directly with stdlib HTTP: kubeconfig contexts resolve to (server,
+auth) where auth is a bearer token, basic credentials, or client
+certificates (an mTLS ssl context).  Enumerated kinds mirror the
+reference's artifact list (workloads first; RBAC via --include-kinds).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import ssl
+import tempfile
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+
+import yaml
+
+WORKLOAD_KINDS = (
+    # (kind, api path, namespaced collection name)
+    ("Pod", "/api/v1", "pods"),
+    ("Deployment", "/apis/apps/v1", "deployments"),
+    ("StatefulSet", "/apis/apps/v1", "statefulsets"),
+    ("DaemonSet", "/apis/apps/v1", "daemonsets"),
+    ("ReplicaSet", "/apis/apps/v1", "replicasets"),
+    ("Job", "/apis/batch/v1", "jobs"),
+    ("CronJob", "/apis/batch/v1", "cronjobs"),
+)
+
+
+class KubeConfigError(RuntimeError):
+    def __init__(self, msg: str, status: int = 0):
+        super().__init__(msg)
+        self.status = status
+
+
+@dataclass
+class KubeAuth:
+    server: str
+    token: str = ""
+    username: str = ""
+    password: str = ""
+    client_cert_data: bytes = b""
+    client_key_data: bytes = b""
+    ca_data: bytes = b""
+    insecure: bool = False
+
+
+def _b64field(d: dict, key: str) -> bytes:
+    v = d.get(key, "")
+    return base64.b64decode(v) if v else b""
+
+
+def load_kubeconfig(path: str = "", context: str = "") -> KubeAuth:
+    """Resolve (server, auth) from a kubeconfig (KUBECONFIG or
+    ~/.kube/config by default), honoring the selected/current context."""
+    path = (
+        path
+        or os.environ.get("KUBECONFIG", "")
+        or os.path.expanduser("~/.kube/config")
+    )
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = yaml.safe_load(f) or {}
+    except (OSError, yaml.YAMLError) as e:
+        raise KubeConfigError(f"cannot load kubeconfig {path}: {e}") from e
+
+    ctx_name = context or doc.get("current-context", "")
+    contexts = {c["name"]: c["context"] for c in doc.get("contexts") or []}
+    if ctx_name not in contexts:
+        raise KubeConfigError(f"kubeconfig context {ctx_name!r} not found")
+    ctx = contexts[ctx_name]
+    clusters = {c["name"]: c["cluster"] for c in doc.get("clusters") or []}
+    users = {u["name"]: u.get("user", {}) for u in doc.get("users") or []}
+    cluster = clusters.get(ctx.get("cluster", ""))
+    if cluster is None:
+        raise KubeConfigError(f"cluster {ctx.get('cluster')!r} not found")
+    user = users.get(ctx.get("user", ""), {})
+
+    token = user.get("token", "")
+    token_file = user.get("tokenFile", "")
+    if not token and token_file:
+        try:
+            with open(token_file, encoding="utf-8") as f:
+                token = f.read().strip()
+        except OSError:
+            pass
+    return KubeAuth(
+        server=cluster.get("server", "").rstrip("/"),
+        token=token,
+        username=user.get("username", ""),
+        password=user.get("password", ""),
+        client_cert_data=_b64field(user, "client-certificate-data"),
+        client_key_data=_b64field(user, "client-key-data"),
+        ca_data=_b64field(cluster, "certificate-authority-data"),
+        insecure=bool(cluster.get("insecure-skip-tls-verify")),
+    )
+
+
+@dataclass
+class KubeClient:
+    auth: KubeAuth
+    _ctx: ssl.SSLContext | None = field(default=None, repr=False)
+
+    def _ssl_context(self) -> ssl.SSLContext | None:
+        if not self.auth.server.startswith("https"):
+            return None
+        if self._ctx is None:
+            ctx = ssl.create_default_context()
+            if self.auth.insecure:
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            elif self.auth.ca_data:
+                ctx.load_verify_locations(
+                    cadata=self.auth.ca_data.decode("utf-8", "replace")
+                )
+            if self.auth.client_cert_data and self.auth.client_key_data:
+                # ssl wants files; write key material to a private tempdir
+                d = tempfile.mkdtemp(prefix="trivy-tpu-kube-")
+                cert = os.path.join(d, "cert.pem")
+                key = os.path.join(d, "key.pem")
+                try:
+                    with open(cert, "wb") as f:
+                        f.write(self.auth.client_cert_data)
+                    with open(key, "wb") as f:
+                        f.write(self.auth.client_key_data)
+                    os.chmod(key, 0o600)
+                    ctx.load_cert_chain(cert, key)
+                finally:
+                    # The context holds the loaded chain; the private key
+                    # must not linger on disk.
+                    import shutil
+
+                    shutil.rmtree(d, ignore_errors=True)
+            self._ctx = ctx
+        return self._ctx
+
+    def get(self, path: str) -> dict:
+        url = self.auth.server + path
+        headers = {"Accept": "application/json"}
+        if self.auth.token:
+            headers["Authorization"] = f"Bearer {self.auth.token}"
+        elif self.auth.username:
+            cred = base64.b64encode(
+                f"{self.auth.username}:{self.auth.password}".encode()
+            ).decode()
+            headers["Authorization"] = f"Basic {cred}"
+        req = urllib.request.Request(url, headers=headers)
+        try:
+            with urllib.request.urlopen(
+                req, timeout=60, context=self._ssl_context()
+            ) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            raise KubeConfigError(f"GET {path}: HTTP {e.code}", e.code) from e
+        except (urllib.error.URLError, ValueError) as e:
+            raise KubeConfigError(f"GET {path}: {e}") from e
+
+    def list_workloads(
+        self, namespace: str = "", kinds: tuple = WORKLOAD_KINDS
+    ) -> list[dict]:
+        """All workload resources (cluster-wide or one namespace), each a
+        full resource dict with kind/metadata/spec."""
+        out: list[dict] = []
+        for kind, api, collection in kinds:
+            if namespace:
+                path = f"{api}/namespaces/{namespace}/{collection}"
+            else:
+                path = f"{api}/{collection}"
+            try:
+                doc = self.get(path)
+            except KubeConfigError as e:
+                if e.status == 404:
+                    continue  # API group absent (minimal clusters)
+                # Auth/network failures must not read as an empty cluster.
+                raise
+            for item in doc.get("items") or []:
+                item.setdefault("kind", kind)
+                item.setdefault(
+                    "apiVersion", api.removeprefix("/apis/").removeprefix("/api/")
+                )
+                out.append(item)
+        return out

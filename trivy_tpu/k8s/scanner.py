@@ -1,0 +1,142 @@
+"""k8s scan fan-out (pkg/k8s/scanner + commands/cluster.go).
+
+Per enumerated workload: the manifest runs through the rego kubernetes
+checks; every container image it references scans through the image
+pipeline (daemon/registry chain).  Per-resource failures are recorded on
+the resource (resource.Error) instead of sinking the cluster scan —
+unreachable registries and RBAC holes are normal in a live cluster.
+Owned resources (pods of a deployment's replicaset etc.) are skipped when
+their controller is also enumerated, matching the reference's dedup.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import dataclass, field
+
+from trivy_tpu.k8s.report import K8sReport, K8sResource
+
+logger = logging.getLogger(__name__)
+
+
+def _images_of(resource: dict) -> list[str]:
+    spec = resource.get("spec") or {}
+    pod = (
+        spec.get("template", {}).get("spec")
+        or spec.get("jobTemplate", {})
+        .get("spec", {})
+        .get("template", {})
+        .get("spec")
+        or (spec if "containers" in spec else {})
+    )
+    out = []
+    for section in ("initContainers", "containers"):
+        for c in pod.get(section) or []:
+            img = c.get("image")
+            if img:
+                out.append(img)
+    return out
+
+
+def _owned(resource: dict) -> bool:
+    refs = (resource.get("metadata") or {}).get("ownerReferences") or []
+    return any(r.get("controller") for r in refs)
+
+
+@dataclass
+class K8sScanner:
+    scanners: list[str] = field(default_factory=lambda: ["misconfig"])
+    insecure_registry: bool = False
+    db_dir: str = ""
+    _vuln_detector: object = field(default=None, repr=False)
+    _vuln_ready: bool = field(default=False, repr=False)
+
+    def scan(
+        self, resources: list[dict], cluster_name: str = ""
+    ) -> K8sReport:
+        report = K8sReport(cluster_name=cluster_name)
+        scanned_images: dict[str, list] = {}
+        for resource in resources:
+            if _owned(resource):
+                continue  # controller-owned: the controller row covers it
+            meta = resource.get("metadata") or {}
+            res = K8sResource(
+                namespace=meta.get("namespace", ""),
+                kind=resource.get("kind", ""),
+                name=meta.get("name", ""),
+            )
+            try:
+                if "misconfig" in self.scanners:
+                    res.results.extend(self._scan_manifest(resource))
+                if {"vuln", "secret"} & set(self.scanners):
+                    for image in _images_of(resource):
+                        res.results.extend(
+                            self._scan_image(image, scanned_images)
+                        )
+            except Exception as e:  # per-resource tolerance
+                logger.warning(
+                    "k8s scan failed for %s/%s", res.kind, res.name,
+                    exc_info=True,
+                )
+                res.error = str(e)
+            report.resources.append(res)
+        return report
+
+    def _scan_manifest(self, resource: dict) -> list:
+        from trivy_tpu.ftypes import Result, ResultClass
+        from trivy_tpu.iac.engine import shared_scanner
+
+        meta = resource.get("metadata") or {}
+        name = f"{resource.get('kind')}/{meta.get('name', '')}"
+        mc = shared_scanner().scan(
+            f"{name}.json", json.dumps(resource).encode()
+        )
+        if mc is None or not (mc.failures or mc.successes):
+            return []
+        return [
+            Result(
+                target=name,
+                result_class=ResultClass.CONFIG,
+                result_type="kubernetes",
+                misconfigurations=mc.failures,
+            )
+        ]
+
+    def _scan_image(self, image: str, cache: dict[str, list]) -> list:
+        if image in cache:
+            return cache[image]
+        from trivy_tpu.artifact.image import ImageArtifact
+        from trivy_tpu.cache.store import MemoryCache
+        from trivy_tpu.commands.run import (
+            Options,
+            _analyzer_options,
+            _init_vuln_scanner,
+        )
+        from trivy_tpu.image import resolve_image
+        from trivy_tpu.scanner.service import LocalDriver, ScanOptions, Scanner
+
+        source = resolve_image(image, insecure_registry=self.insecure_registry)
+        mem = MemoryCache()
+        options = Options(
+            target=image,
+            scanners=[s for s in self.scanners if s != "misconfig"],
+            db_dir=self.db_dir,
+        )
+        if not self._vuln_ready:
+            # One DB open per cluster scan, not per image.
+            self._vuln_detector = _init_vuln_scanner(options)
+            self._vuln_ready = True
+        artifact = ImageArtifact(
+            image, mem,
+            analyzer_options=_analyzer_options(options, "image"),
+            source=source,
+        )
+        driver = LocalDriver(mem, vuln_detector=self._vuln_detector)
+        scanner = Scanner(artifact=artifact, driver=driver)
+        report = scanner.scan_artifact(
+            ScanOptions(scanners=list(options.scanners))
+        )
+        results = list(report.results)
+        cache[image] = results
+        return results
