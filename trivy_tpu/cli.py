@@ -161,8 +161,8 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
     p.add_argument("--cache-dir", default=_env_default("cache-dir", ""))
     p.add_argument(
         "--cache-backend",
-        choices=["memory", "fs"],
         default=_env_default("cache-backend", "memory"),
+        help="memory | fs | redis://host:port[/db] | s3://bucket/prefix",
     )
     p.add_argument(
         "--server", default=_env_default("server", ""),

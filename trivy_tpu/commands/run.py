@@ -77,8 +77,22 @@ def init_cache(options: Options) -> ArtifactCache:
         from trivy_tpu.rpc.client import RemoteCache
 
         return RemoteCache(options.server_addr, options.token)
-    if options.cache_backend == "fs" and options.cache_dir:
+    backend = options.cache_backend
+    if backend.startswith(("redis://", "rediss://")):
+        from trivy_tpu.cache.redis import RedisCache
+
+        return RedisCache(backend)
+    if backend.startswith("s3://"):
+        from trivy_tpu.cache.s3 import S3Cache
+
+        return S3Cache(backend)
+    if backend == "fs" and options.cache_dir:
         return FSCache(options.cache_dir)
+    if backend not in ("memory", "fs"):
+        raise ValueError(
+            f"unknown cache backend {backend!r} "
+            "(memory | fs | redis://... | s3://...)"
+        )
     return MemoryCache()
 
 

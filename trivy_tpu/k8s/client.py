@@ -70,12 +70,21 @@ def load_kubeconfig(path: str = "", context: str = "") -> KubeAuth:
         raise KubeConfigError(f"cannot load kubeconfig {path}: {e}") from e
 
     ctx_name = context or doc.get("current-context", "")
-    contexts = {c["name"]: c["context"] for c in doc.get("contexts") or []}
+    try:
+        contexts = {
+            c["name"]: c.get("context") or {}
+            for c in doc.get("contexts") or []
+        }
+        clusters = {
+            c["name"]: c.get("cluster") or {}
+            for c in doc.get("clusters") or []
+        }
+        users = {u["name"]: u.get("user") or {} for u in doc.get("users") or []}
+    except (KeyError, TypeError) as e:
+        raise KubeConfigError(f"malformed kubeconfig {path}: {e}") from e
     if ctx_name not in contexts:
         raise KubeConfigError(f"kubeconfig context {ctx_name!r} not found")
     ctx = contexts[ctx_name]
-    clusters = {c["name"]: c["cluster"] for c in doc.get("clusters") or []}
-    users = {u["name"]: u.get("user", {}) for u in doc.get("users") or []}
     cluster = clusters.get(ctx.get("cluster", ""))
     if cluster is None:
         raise KubeConfigError(f"cluster {ctx.get('cluster')!r} not found")

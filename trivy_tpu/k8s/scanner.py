@@ -39,9 +39,21 @@ def _images_of(resource: dict) -> list[str]:
     return out
 
 
+_ENUMERATED_KINDS = {
+    "Pod", "Deployment", "StatefulSet", "DaemonSet", "ReplicaSet", "Job",
+    "CronJob",
+}
+
+
 def _owned(resource: dict) -> bool:
+    """Skip only resources whose controller kind is itself enumerated —
+    a pod owned by a CRD controller (Rollout, static-pod Node ref) has no
+    covering row and must be scanned directly."""
     refs = (resource.get("metadata") or {}).get("ownerReferences") or []
-    return any(r.get("controller") for r in refs)
+    return any(
+        r.get("controller") and r.get("kind") in _ENUMERATED_KINDS
+        for r in refs
+    )
 
 
 @dataclass
@@ -103,9 +115,12 @@ class K8sScanner:
             )
         ]
 
-    def _scan_image(self, image: str, cache: dict[str, list]) -> list:
+    def _scan_image(self, image: str, cache: dict[str, object]) -> list:
         if image in cache:
-            return cache[image]
+            hit = cache[image]
+            if isinstance(hit, Exception):
+                raise hit  # one timeout per unreachable image, not per resource
+            return hit
         from trivy_tpu.artifact.image import ImageArtifact
         from trivy_tpu.cache.store import MemoryCache
         from trivy_tpu.commands.run import (
@@ -116,12 +131,19 @@ class K8sScanner:
         from trivy_tpu.image import resolve_image
         from trivy_tpu.scanner.service import LocalDriver, ScanOptions, Scanner
 
-        source = resolve_image(image, insecure_registry=self.insecure_registry)
+        try:
+            source = resolve_image(
+                image, insecure_registry=self.insecure_registry
+            )
+        except Exception as e:
+            cache[image] = e
+            raise
         mem = MemoryCache()
         options = Options(
             target=image,
             scanners=[s for s in self.scanners if s != "misconfig"],
             db_dir=self.db_dir,
+            secret_backend="auto",  # the CLI-wide default (hybrid fallback)
         )
         if not self._vuln_ready:
             # One DB open per cluster scan, not per image.
