@@ -19,6 +19,7 @@ from trivy_tpu.commands.run import (
     TARGET_REPOSITORY,
     TARGET_ROOTFS,
     TARGET_SBOM,
+    TARGET_VM,
     Options,
     run,
 )
@@ -396,6 +397,10 @@ def build_parser() -> argparse.ArgumentParser:
     _add_scan_flags(p_sbom, "vuln")
     p_sbom.set_defaults(kind=TARGET_SBOM)
 
+    p_vm = sub.add_parser("vm", help="scan a raw VM disk image")
+    _add_scan_flags(p_vm, "vuln,secret")
+    p_vm.set_defaults(kind=TARGET_VM)
+
     p_convert = sub.add_parser("convert", help="convert a saved JSON report")
     p_convert.add_argument("report")
     p_convert.add_argument("-f", "--format", default="table")
@@ -538,7 +543,9 @@ def main(argv: list[str] | None = None) -> int:
         print(f"trivy-tpu: {args.command}: not implemented yet ({e.name})", file=sys.stderr)
         return 2
     except Exception as e:
-        from trivy_tpu.commands.run import ScanTimeoutError
+        from trivy_tpu.cache.redis import RedisError
+        from trivy_tpu.cache.s3 import S3Error
+        from trivy_tpu.commands.run import CacheConfigError, ScanTimeoutError
         from trivy_tpu.compliance.spec import ComplianceError
         from trivy_tpu.db.client import DBError
         from trivy_tpu.image.registry import RegistryError
@@ -548,7 +555,7 @@ def main(argv: list[str] | None = None) -> int:
         if isinstance(
             e,
             (DBError, RegistryError, ScanTimeoutError, ComplianceError,
-             RegoError),
+             RegoError, CacheConfigError, RedisError, S3Error),
         ):
             print(f"trivy-tpu: {e}", file=sys.stderr)
             return 2

@@ -28,6 +28,11 @@ TARGET_ROOTFS = "rootfs"
 TARGET_IMAGE = "image"
 TARGET_REPOSITORY = "repo"
 TARGET_SBOM = "sbom"
+TARGET_VM = "vm"
+
+
+class CacheConfigError(ValueError):
+    pass
 
 
 @dataclass
@@ -86,10 +91,14 @@ def init_cache(options: Options) -> ArtifactCache:
         from trivy_tpu.cache.s3 import S3Cache
 
         return S3Cache(backend)
-    if backend == "fs" and options.cache_dir:
+    if backend == "fs":
+        if not options.cache_dir:
+            raise CacheConfigError(
+                "--cache-backend fs requires --cache-dir"
+            )
         return FSCache(options.cache_dir)
-    if backend not in ("memory", "fs"):
-        raise ValueError(
+    if backend != "memory":
+        raise CacheConfigError(
             f"unknown cache backend {backend!r} "
             "(memory | fs | redis://... | s3://...)"
         )
@@ -183,6 +192,14 @@ def _build_scanner(options: Options, target_kind: str, cache: ArtifactCache) -> 
         from trivy_tpu.artifact.sbom import SbomArtifact
 
         artifact = SbomArtifact(options.target, cache)
+    elif target_kind == TARGET_VM:
+        from trivy_tpu.artifact.vm import VMArtifact
+
+        artifact = VMArtifact(
+            options.target,
+            cache,
+            analyzer_options=_analyzer_options(options, target_kind),
+        )
     elif target_kind == TARGET_REPOSITORY:
         from trivy_tpu.artifact.repo import RepositoryArtifact
 

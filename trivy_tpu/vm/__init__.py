@@ -1,0 +1,19 @@
+"""VM disk-image scanning (pkg/fanal/artifact/vm + walker/vm.go).
+
+Raw disk images open directly; partitions enumerate via MBR/GPT (bare
+filesystems scan as one partition), ext2/3/4 filesystems walk with the
+from-scratch reader, and each file feeds the same analyzer group the
+filesystem artifact uses.  LVM physical volumes and non-ext filesystems
+are reported and skipped (documented divergences)."""
+
+from trivy_tpu.vm.disk import Partition, is_ext, is_lvm, list_partitions
+from trivy_tpu.vm.ext4 import Ext4Error, Ext4Reader
+
+__all__ = [
+    "Partition",
+    "list_partitions",
+    "is_ext",
+    "is_lvm",
+    "Ext4Reader",
+    "Ext4Error",
+]
