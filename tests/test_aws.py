@@ -1,0 +1,160 @@
+"""Tests: AWS account scanning against a fake endpoint (localstack
+pattern) — S3/EC2 adapters feeding the shared terraform check corpus."""
+
+import contextlib
+import io
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from trivy_tpu.cloud import AwsError, AwsScanner
+
+LIST_BUCKETS = """<?xml version="1.0"?>
+<ListAllMyBucketsResult>
+  <Buckets>
+    <Bucket><Name>public-logs</Name></Bucket>
+    <Bucket><Name>locked-down</Name></Bucket>
+  </Buckets>
+</ListAllMyBucketsResult>"""
+
+PUBLIC_ACL = """<?xml version="1.0"?>
+<AccessControlPolicy>
+  <AccessControlList>
+    <Grant>
+      <Grantee><URI>http://acs.amazonaws.com/groups/global/AllUsers</URI></Grantee>
+      <Permission>READ</Permission>
+    </Grant>
+  </AccessControlList>
+</AccessControlPolicy>"""
+
+PRIVATE_ACL = """<?xml version="1.0"?>
+<AccessControlPolicy>
+  <AccessControlList>
+    <Grant>
+      <Grantee><ID>owner</ID></Grantee>
+      <Permission>FULL_CONTROL</Permission>
+    </Grant>
+  </AccessControlList>
+</AccessControlPolicy>"""
+
+ENCRYPTION = """<?xml version="1.0"?>
+<ServerSideEncryptionConfiguration>
+  <Rule><ApplyServerSideEncryptionByDefault>
+    <SSEAlgorithm>aws:kms</SSEAlgorithm>
+  </ApplyServerSideEncryptionByDefault></Rule>
+</ServerSideEncryptionConfiguration>"""
+
+VERSIONING_ON = """<?xml version="1.0"?>
+<VersioningConfiguration><Status>Enabled</Status></VersioningConfiguration>"""
+
+VERSIONING_OFF = """<?xml version="1.0"?>
+<VersioningConfiguration/>"""
+
+DESCRIBE_INSTANCES = """<?xml version="1.0"?>
+<DescribeInstancesResponse>
+  <reservationSet><item>
+    <instancesSet><item>
+      <instanceId>i-0abc</instanceId>
+      <ipAddress>54.1.2.3</ipAddress>
+      <metadataOptions><httpTokens>optional</httpTokens></metadataOptions>
+    </item></instancesSet>
+  </item></reservationSet>
+</DescribeInstancesResponse>"""
+
+
+class _FakeAws(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def _send(self, body: str, status: int = 200):
+        data = body.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/xml")
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802
+        path, _, query = self.path.partition("?")
+        if path == "/" and "Action=DescribeInstances" in query:
+            return self._send(DESCRIBE_INSTANCES)
+        if path == "/":
+            return self._send(LIST_BUCKETS)
+        if path == "/public-logs" and query == "acl":
+            return self._send(PUBLIC_ACL)
+        if path == "/locked-down" and query == "acl":
+            return self._send(PRIVATE_ACL)
+        if path == "/locked-down" and query == "encryption":
+            return self._send(ENCRYPTION)
+        if path == "/public-logs" and query == "encryption":
+            return self._send("", 404)
+        if query == "versioning":
+            return self._send(
+                VERSIONING_ON if path == "/locked-down" else VERSIONING_OFF
+            )
+        self._send("", 404)
+
+
+@pytest.fixture(scope="module")
+def aws_endpoint(tmp_path_factory):
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeAws)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _creds(monkeypatch):
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "AKIATEST")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "secret")
+    monkeypatch.setenv("AWS_REGION", "us-east-1")
+
+
+def test_s3_adapter_shapes(aws_endpoint):
+    scanner = AwsScanner(services=["s3"], endpoint=aws_endpoint)
+    resources = scanner.adapt_s3(scanner._api("s3"))
+    buckets = resources["aws_s3_bucket"]
+    assert buckets["public-logs"]["acl"] == "public-read"
+    assert "acl" not in buckets["locked-down"]
+    assert "server_side_encryption_configuration" in buckets["locked-down"]
+    assert buckets["locked-down"]["versioning"] == {"enabled": True}
+
+
+def test_aws_scan_runs_terraform_checks(aws_endpoint):
+    scanner = AwsScanner(services=["s3", "ec2"], endpoint=aws_endpoint)
+    [mc] = scanner.scan()
+    failed = {(f.check_id, f.message) for f in mc.failures}
+    ids = {c for c, _ in failed}
+    assert "AVD-AWS-0086" in ids  # public ACL on public-logs
+    assert "AVD-AWS-0009" in ids  # instance with public IP
+    assert "AVD-AWS-0028" in ids  # IMDSv1 allowed
+    # the locked-down bucket passes the ACL check (only public-logs flagged)
+    acl_msgs = [m for c, m in failed if c == "AVD-AWS-0086"]
+    assert all("public-logs" in m for m in acl_msgs)
+
+
+def test_unsupported_service_is_loud(aws_endpoint):
+    with pytest.raises(AwsError):
+        AwsScanner(services=["dynamodb"], endpoint=aws_endpoint).scan()
+
+
+def test_aws_cli_surface(aws_endpoint):
+    from trivy_tpu.cli import main
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main([
+            "aws", "--service", "s3", "--service", "ec2",
+            "--endpoint", aws_endpoint, "--format", "json",
+            "--exit-code", "3",
+        ])
+    assert rc == 3  # findings present + exit-code set
+    doc = json.loads(buf.getvalue())
+    assert doc["ArtifactType"] == "aws_account"
+    ids = {
+        m["ID"]
+        for r in doc["Results"]
+        for m in r.get("Failures", [])
+    }
+    assert "AVD-AWS-0086" in ids

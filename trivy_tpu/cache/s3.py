@@ -47,8 +47,10 @@ class S3Client:
         access_key: str = "",
         secret_key: str = "",
         session_token: str = "",
+        service: str = "s3",
     ):
         self.bucket = bucket
+        self.service = service
         self.region = region or os.environ.get("AWS_REGION", "us-east-1")
         self.endpoint = (
             endpoint
@@ -64,10 +66,13 @@ class S3Client:
         )
 
     def _request(
-        self, method: str, key: str, body: bytes = b""
+        self, method: str, key: str, body: bytes = b"", query: str = ""
     ) -> tuple[int, bytes]:
-        path = f"/{self.bucket}/{urllib.parse.quote(key)}"
-        url = self.endpoint + path
+        if key.startswith("/"):
+            path = key  # pre-built path (service APIs)
+        else:
+            path = f"/{self.bucket}/{urllib.parse.quote(key)}"
+        url = self.endpoint + path + (f"?{query}" if query else "")
         host = urllib.parse.urlparse(self.endpoint).netloc
         now = datetime.datetime.now(datetime.timezone.utc)
         amz_date = now.strftime("%Y%m%dT%H%M%SZ")
@@ -82,17 +87,24 @@ class S3Client:
         if self.session_token:
             headers["x-amz-security-token"] = self.session_token
         signed_headers = ";".join(sorted(headers))
+        canonical_query = "&".join(
+            sorted(
+                part if "=" in part else f"{part}="
+                for part in query.split("&")
+                if part
+            )
+        )
         canonical = "\n".join(
             [
                 method,
                 path,
-                "",  # query
+                canonical_query,
                 "".join(f"{k}:{headers[k]}\n" for k in sorted(headers)),
                 signed_headers,
                 payload_hash,
             ]
         )
-        scope = f"{datestamp}/{self.region}/s3/aws4_request"
+        scope = f"{datestamp}/{self.region}/{self.service}/aws4_request"
         to_sign = "\n".join(
             [
                 "AWS4-HMAC-SHA256",
@@ -103,7 +115,7 @@ class S3Client:
         )
         k = _sign(f"AWS4{self.secret_key}".encode(), datestamp)
         k = _sign(k, self.region)
-        k = _sign(k, "s3")
+        k = _sign(k, self.service)
         k = _sign(k, "aws4_request")
         signature = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
         headers["Authorization"] = (

@@ -288,6 +288,62 @@ def _options_from_args(args: argparse.Namespace) -> Options:
     )
 
 
+def _aws_command(args) -> int:
+    import json as _json
+
+    from trivy_tpu.cloud import AwsError, AwsScanner
+
+    try:
+        scanner = AwsScanner(
+            services=args.service or ["s3"],
+            endpoint=args.endpoint,
+            region=args.region,
+        )
+        misconfigs = scanner.scan()
+    except AwsError as e:
+        print(f"trivy-tpu: {e}", file=sys.stderr)
+        return 2
+    failures = [f for mc in misconfigs for f in mc.failures]
+    for err in scanner.errors:
+        print(f"trivy-tpu: aws: {err}", file=sys.stderr)
+    out = sys.stdout
+    close = False
+    if args.output:
+        try:
+            out = open(args.output, "w", encoding="utf-8")
+        except OSError as e:
+            print(f"trivy-tpu: cannot write {args.output}: {e}", file=sys.stderr)
+            return 2
+        close = True
+    try:
+        if args.format == "json":
+            _json.dump(
+                {
+                    "ArtifactType": "aws_account",
+                    "Results": [mc.to_json() for mc in misconfigs],
+                },
+                out, indent=2,
+            )
+            out.write("\n")
+        else:
+            out.write("\nAWS account scan\n")
+            for f in failures:
+                out.write(
+                    f"{f.check_id:14} {f.severity:9} {f.message}\n"
+                )
+            if not failures:
+                out.write("no failed checks\n")
+    finally:
+        if close:
+            out.close()
+    if scanner.errors:
+        # Degraded enumeration must not read as a clean account.
+        return args.exit_code or 2
+    if args.exit_code and failures:
+        return args.exit_code
+    return 0
+
+
 def _k8s_command(args) -> int:
     from trivy_tpu.k8s import (
         K8sScanner,
@@ -433,6 +489,21 @@ def build_parser() -> argparse.ArgumentParser:
     _add_scan_flags(p_config, "misconfig")
     p_config.set_defaults(kind=TARGET_FILESYSTEM)
 
+    p_aws = sub.add_parser("aws", help="scan an AWS account")
+    p_aws.add_argument(
+        "--service", action="append", default=[],
+        help="services to scan (s3, ec2; repeatable; default s3)",
+    )
+    p_aws.add_argument("--region", default=_env_default("region", ""))
+    p_aws.add_argument(
+        "--endpoint", default=_env_default("endpoint", ""),
+        help="custom AWS endpoint (localstack etc.)",
+    )
+    p_aws.add_argument("-f", "--format", default=_env_default("format", "table"))
+    p_aws.add_argument("-o", "--output", default="")
+    p_aws.add_argument("--exit-code", type=int,
+                       default=_int_default("exit-code", 0))
+
     p_k8s = sub.add_parser("k8s", help="scan a kubernetes cluster")
     p_k8s.add_argument(
         "k8s_target", nargs="?", default="cluster",
@@ -501,6 +572,9 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.command == "k8s":
         return _k8s_command(args)
+
+    if args.command == "aws":
+        return _aws_command(args)
 
     if args.command == "convert":
         from trivy_tpu.commands.convert import run_convert

@@ -1,0 +1,12 @@
+"""AWS cloud scanning (pkg/cloud/aws).
+
+Enumerates live account resources over the AWS APIs (SigV4, stdlib HTTP),
+adapts them into the same conftest-style resource documents the terraform
+checks evaluate, and reports per-service findings — one policy corpus for
+IaC and live cloud state, the reference's own design (its cloud scans run
+the same AVD checks against adapted state).
+"""
+
+from trivy_tpu.cloud.aws import AwsScanner, AwsError
+
+__all__ = ["AwsScanner", "AwsError"]

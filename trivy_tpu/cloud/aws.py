@@ -1,0 +1,204 @@
+"""AWS account scanning: enumerate, adapt, evaluate.
+
+Service adapters pull live state (S3 buckets with ACL/encryption/
+versioning, EC2 instances with metadata options) through SigV4-signed XML
+APIs and synthesize the conftest-style document the terraform AVD checks
+already understand:
+
+    {"resource": {"aws_s3_bucket": {...}, "aws_instance": {...}}}
+
+so cloud scans and IaC scans share one policy corpus (the reference's
+adapters feed the same rego state model, pkg/iac/adapters/cloud).
+
+AWS_ENDPOINT_URL redirects every service to an S3-compatible/localstack
+endpoint, which is also how the tests drive a fake account.
+"""
+
+from __future__ import annotations
+
+import logging
+import urllib.parse
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass, field
+
+from trivy_tpu.cache.s3 import S3Client, S3Error
+
+logger = logging.getLogger(__name__)
+
+SUPPORTED_SERVICES = ("s3", "ec2")
+
+
+class AwsError(RuntimeError):
+    pass
+
+
+def _strip_ns(tag: str) -> str:
+    return tag.rsplit("}", 1)[-1]
+
+
+def _find(el, name):
+    for child in el.iter():
+        if _strip_ns(child.tag) == name:
+            return child
+    return None
+
+
+def _findall(el, name):
+    return [c for c in el.iter() if _strip_ns(c.tag) == name]
+
+
+class _AwsApi(S3Client):
+    """SigV4 requests with query strings + XML replies, riding the cache
+    client's generalized signing (service/scope and canonical query are
+    parameters of the base _request)."""
+
+    def call(self, method: str, path_and_query: str) -> ET.Element | None:
+        path, _, query = path_and_query.partition("?")
+        if not path.startswith("/"):
+            path = "/" + path
+        try:
+            status, payload = self._request(method, path, query=query)
+        except S3Error as e:
+            raise AwsError(str(e)) from e
+        if status == 404:
+            return None
+        if status >= 400:
+            raise AwsError(
+                f"aws: {method} {path_and_query}: HTTP {status}: "
+                f"{payload[:200]!r}"
+            )
+        if not payload:
+            return None
+        try:
+            return ET.fromstring(payload)
+        except ET.ParseError as e:
+            raise AwsError(f"aws: bad XML from {path_and_query}: {e}") from e
+
+
+@dataclass
+class AwsScanner:
+    services: list[str] = field(default_factory=lambda: ["s3"])
+    endpoint: str = ""
+    region: str = ""
+    errors: list[str] = field(default_factory=list)
+
+    def _api(self, service: str) -> _AwsApi:
+        import os
+
+        endpoint = self.endpoint or os.environ.get("AWS_ENDPOINT_URL", "")
+        if not endpoint:
+            region = self.region or os.environ.get("AWS_REGION", "us-east-1")
+            endpoint = f"https://{service}.{region}.amazonaws.com"
+        return _AwsApi(
+            bucket="", region=self.region, endpoint=endpoint, service=service
+        )
+
+    # -- adapters ----------------------------------------------------------
+
+    def adapt_s3(self, api: _AwsApi) -> dict:
+        """Buckets + attributes -> aws_s3_bucket/-acl resources."""
+        root = api.call("GET", "/")
+        buckets: dict[str, dict] = {}
+        if root is None:
+            return {}
+        for b in _findall(root, "Bucket"):
+            name_el = _find(b, "Name")
+            if name_el is None or not name_el.text:
+                continue
+            name = name_el.text
+            doc: dict = {"bucket": name}
+            try:
+                acl = api.call("GET", f"/{name}?acl")
+                if acl is not None and self._acl_is_public(acl):
+                    doc["acl"] = "public-read"
+                enc = api.call("GET", f"/{name}?encryption")
+                if enc is not None and _find(enc, "SSEAlgorithm") is not None:
+                    doc["server_side_encryption_configuration"] = {
+                        "rule": {"sse_algorithm": True}
+                    }
+                ver = api.call("GET", f"/{name}?versioning")
+                status = _find(ver, "Status") if ver is not None else None
+                if status is not None and (status.text or "") == "Enabled":
+                    doc["versioning"] = {"enabled": True}
+            except AwsError as e:
+                # A bucket whose attributes cannot be read must not pass as
+                # private/encrypted; record the degradation for the caller
+                # (a degraded scan must not turn CI green).
+                logger.warning("s3 bucket %s: %s", name, e)
+                self.errors.append(f"s3 bucket {name}: {e}")
+            buckets[name] = doc
+        return {"aws_s3_bucket": buckets} if buckets else {}
+
+    @staticmethod
+    def _acl_is_public(acl: ET.Element) -> bool:
+        for grant in _findall(acl, "Grant"):
+            uri = _find(grant, "URI")
+            if uri is not None and (uri.text or "").endswith(
+                ("AllUsers", "AuthenticatedUsers")
+            ):
+                return True
+        return False
+
+    def adapt_ec2(self, api: _AwsApi) -> dict:
+        """DescribeInstances -> aws_instance resources.
+
+        Traversal uses DIRECT children only: real responses nest further
+        <item>/<instanceId> elements under networkInterfaceSet, and a
+        deep .iter() search would let those overwrite the instance doc."""
+        root = api.call("GET", "/?Action=DescribeInstances&Version=2016-11-15")
+        if root is None:
+            return {}
+
+        def children(el, name):
+            return [c for c in list(el) if _strip_ns(c.tag) == name]
+
+        def child(el, name):
+            got = children(el, name)
+            return got[0] if got else None
+
+        instances: dict[str, dict] = {}
+        for rset in children(root, "reservationSet"):
+            for res_item in children(rset, "item"):
+                for iset in children(res_item, "instancesSet"):
+                    for item in children(iset, "item"):
+                        iid = child(item, "instanceId")
+                        if iid is None or not iid.text:
+                            continue
+                        doc: dict = {}
+                        pub = child(item, "ipAddress")
+                        if pub is not None and pub.text:
+                            doc["associate_public_ip_address"] = True
+                        mo = child(item, "metadataOptions")
+                        tokens = child(mo, "httpTokens") if mo is not None else None
+                        doc["metadata_options"] = {
+                            "http_tokens": (tokens.text or "optional")
+                            if tokens is not None
+                            else "optional"
+                        }
+                        instances[iid.text] = doc
+        return {"aws_instance": instances} if instances else {}
+
+    # -- scan --------------------------------------------------------------
+
+    def scan(self) -> list:
+        """Adapt every requested service, evaluate the terraform check
+        corpus over the combined resource document, return
+        Misconfiguration results per service."""
+        from trivy_tpu.iac.engine import shared_scanner
+
+        resources: dict = {}
+        for service in self.services:
+            if service not in SUPPORTED_SERVICES:
+                raise AwsError(
+                    f"unsupported service {service!r} "
+                    f"(supported: {', '.join(SUPPORTED_SERVICES)})"
+                )
+            adapter = getattr(self, f"adapt_{service}")
+            resources.update(adapter(self._api(service)))
+        if not resources:
+            return []
+        doc = {"resource": resources}
+        import json as _json
+
+        mc = shared_scanner().scan("cloud.tf.json", _json.dumps(doc).encode())
+        return [mc] if mc is not None else []
