@@ -178,3 +178,44 @@ def test_make_secret_engine_backends():
         assert isinstance(make_secret_engine(backend="auto"), HybridSecretEngine)
     hybrid = make_secret_engine(backend="hybrid")
     assert isinstance(hybrid, HybridSecretEngine)
+
+
+@needs_native
+def test_device_nfa_verify_parity(oracle):
+    """verify='device': the batched NFA on the device refutes non-matching
+    candidate pairs; findings stay oracle-identical."""
+    eng = HybridSecretEngine(verify="device")
+    eng.warmup()
+    items = [
+        ("a.py", b'key = "ghp_' + b"R" * 36 + b'"'),
+        # keyword present but no real match: the device must refute it
+        ("b.py", b"task_lock sk_live_nope but nothing real here " * 40),
+        ("c.env", b"AWS_ACCESS_KEY_ID=AKIA" + b"Q7" * 8 + b"\n"),
+        ("d.txt", b"plain text " * 100),
+    ]
+    results = eng.scan_batch(items)
+    for (path, content), got in zip(items, results):
+        want = oracle.scan(path, content)
+        assert [f.to_json() for f in got.findings] == [
+            f.to_json() for f in want.findings
+        ], path
+    assert sum(len(r.findings) for r in results) == 2
+    assert eng.stats.verify_s > 0  # the device stage actually ran
+
+
+@needs_native
+def test_device_nfa_verify_random_corpus(oracle):
+    eng = HybridSecretEngine(verify="device")
+    rng = np.random.default_rng(21)
+    items = []
+    for i in range(120):
+        body = bytes(rng.integers(32, 127, size=int(rng.integers(50, 1500)), dtype=np.int32).astype(np.uint8))
+        if i % 11 == 0:
+            body += b'\ntok = "ghp_' + bytes([97 + i % 26]) * 36 + b'"\n'
+        items.append((f"f{i}.py", body))
+    results = eng.scan_batch(items)
+    for (path, content), got in zip(items, results):
+        want = oracle.scan(path, content)
+        assert [f.to_json() for f in got.findings] == [
+            f.to_json() for f in want.findings
+        ], path

@@ -1,0 +1,183 @@
+"""Device NFA verification of candidate (file, rule) pairs.
+
+The TPU seat of the hybrid engine's verify stage (engine/hybrid.py step 3):
+each rule's 64-position Glushkov search automaton (the same compilation
+redfa.py uses for its bit-parallel fallback) becomes dense tensors, and a
+batch of candidate pairs advances through `lax.scan` over byte positions:
+
+    S'[b] = (step(S[b] @ F[rule_b]) | first[rule_b]) & accept[rule_b, c_t]
+
+— boolean matmuls on the MXU, one scan step per byte, every pair in the
+batch in parallel.  Rule count is absorbed by batching (each lane carries
+its own rule's tensors, gathered once per call), which is what makes the
+500-rule configuration scale: the device does the per-rule regex work the
+reference runs as a host loop.
+
+Only candidate bytes cross the link (class ids, one byte each), so the
+stage pays for itself exactly when candidates are sparse — the common
+case after the gram sieve.  Pairs whose rule has no 64-position automaton
+or whose file exceeds the length cap pass through unverified (the host
+oracle confirms them exactly, as always).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trivy_tpu.engine.redfa import compile_search_nfa64
+
+MAX_LEN = 1 << 15  # files above this verify on host
+LEN_BUCKETS = (2048, 8192, MAX_LEN)
+BATCH_BUCKETS = (64, 512, 2048)
+
+
+class NfaVerifier:
+    def __init__(self, rules, mesh=None):
+        self.mesh = mesh  # single-program path; mesh reserved for sharding
+        self.num_rules = len(rules)
+        nfas = [compile_search_nfa64(r) for r in rules]
+        # The dense accept tensor holds 64 classes; rules needing more fall
+        # back to host confirmation (out-of-range class ids would clip and
+        # silently corrupt matching).
+        nfas = [
+            n if (n is not None and n.num_classes <= 64) else None
+            for n in nfas
+        ]
+        self.has_nfa = np.array([n is not None for n in nfas], dtype=bool)
+        r = self.num_rules
+        # Dense per-rule tensors, padded to 64 positions / 64 classes.
+        self.follow = np.zeros((r, 64, 64), dtype=np.float32)
+        self.accept = np.zeros((r, 64, 64), dtype=np.float32)  # [R, C, S]
+        self.first = np.zeros((r, 64), dtype=np.float32)
+        self.last = np.zeros((r, 64), dtype=np.float32)
+        self.luts = np.zeros((r, 256), dtype=np.uint8)
+        for i, nfa in enumerate(nfas):
+            if nfa is None:
+                continue
+            m = len(nfa.follow)
+            for p in range(m):
+                word = int(nfa.follow[p])
+                for q in range(m):
+                    if word >> q & 1:
+                        self.follow[i, p, q] = 1.0
+            for c in range(nfa.num_classes):
+                word = int(nfa.classmask[c])
+                for q in range(m):
+                    if word >> q & 1:
+                        self.accept[i, c, q] = 1.0
+            for q in range(m):
+                if nfa.first >> q & 1:
+                    self.first[i, q] = 1.0
+                if nfa.last >> q & 1:
+                    self.last[i, q] = 1.0
+            self.luts[i] = nfa.byte_class
+        self._tensors_on_device = None
+
+    # ------------------------------------------------------------------
+
+    def _device_tensors(self):
+        if self._tensors_on_device is None:
+            self._tensors_on_device = (
+                jnp.asarray(self.follow),
+                jnp.asarray(self.accept),
+                jnp.asarray(self.first),
+                jnp.asarray(self.last),
+            )
+        return self._tensors_on_device
+
+    def warmup(self) -> None:
+        self._device_tensors()
+
+    @staticmethod
+    @functools.partial(jax.jit, static_argnames=("length",))
+    def _run(classes, rule_ids, follow, accept, first, last, length):
+        """classes [B, L] uint8, rule_ids [B] int32 -> matched [B] bool."""
+        f = follow[rule_ids]  # [B, 64, 64]
+        a = accept[rule_ids]  # [B, 64, 64]  (class, state)
+        fst = first[rule_ids]  # [B, 64]
+        lst = last[rule_ids]  # [B, 64]
+
+        def step(carry, t):
+            state, matched = carry  # [B, 64] f32, [B] bool
+            c = classes[:, t]  # [B]
+            cmask = jnp.take_along_axis(
+                a, c[:, None, None].astype(jnp.int32), axis=1
+            )[:, 0, :]  # [B, 64]
+            reach = jnp.einsum("bp,bpq->bq", state, f)
+            nxt = jnp.minimum(reach + fst, 1.0) * cmask
+            nxt = jnp.minimum(nxt, 1.0)
+            hit = (nxt * lst).sum(axis=1) > 0
+            return (nxt, matched | hit), None
+
+        init = (jnp.zeros(classes.shape[0:1] + (64,), jnp.float32),
+                jnp.zeros(classes.shape[:1], bool))
+        (state, matched), _ = jax.lax.scan(
+            step, init, jnp.arange(length), unroll=4
+        )
+        return matched
+
+    # ------------------------------------------------------------------
+
+    def verify(self, contents, pairs):
+        """contents[i] is the bytes for pairs[i] = (fi, rule_idxs).  Flattens
+        into (file, rule) lanes, drops lanes the device refutes, returns the
+        surviving pairs in the same structure."""
+        flat: list[tuple[int, int, bytes]] = []
+        passthrough: dict[int, set[int]] = {}
+        for (fi, idxs), content in zip(pairs, contents):
+            for r in np.asarray(idxs).tolist():
+                if not self.has_nfa[r] or len(content) > MAX_LEN:
+                    passthrough.setdefault(fi, set()).add(int(r))
+                else:
+                    flat.append((fi, int(r), content))
+        verdicts: dict[int, set[int]] = {
+            fi: set(rs) for fi, rs in passthrough.items()
+        }
+        if flat:
+            follow, accept, first, last = self._device_tensors()
+            # Lanes group per length bucket (the jit specializes on the
+            # static length): one 30KB candidate among thousands of small
+            # ones must not pad every batch to 32768 scan steps.  A file
+            # with k candidate rules still ships k class rows — per-rule
+            # byte classes differ, and candidate multiplicity is small
+            # after the gram sieve.
+            by_len: dict[int, list] = {}
+            for lane in flat:
+                bucket = next(b for b in LEN_BUCKETS if len(lane[2]) <= b)
+                by_len.setdefault(bucket, []).append(lane)
+            for length, lanes in sorted(by_len.items()):
+                batch_cap = next(
+                    (b for b in BATCH_BUCKETS if len(lanes) <= b),
+                    BATCH_BUCKETS[-1],
+                )
+                for off in range(0, len(lanes), batch_cap):
+                    chunk = lanes[off : off + batch_cap]
+                    b = len(chunk)
+                    classes = np.zeros((batch_cap, length), dtype=np.uint8)
+                    rule_ids = np.zeros(batch_cap, dtype=np.int32)
+                    for k, (_fi, r, content) in enumerate(chunk):
+                        data = np.frombuffer(content, dtype=np.uint8)
+                        classes[k, : len(data)] = self.luts[r][data]
+                        rule_ids[k] = r
+                    matched = np.asarray(
+                        self._run(
+                            jnp.asarray(classes),
+                            jnp.asarray(rule_ids),
+                            follow, accept, first, last,
+                            length,
+                        )
+                    )[:b]
+                    for (fi, r, _c), hit in zip(chunk, matched):
+                        if hit:
+                            verdicts.setdefault(fi, set()).add(r)
+        out = []
+        for fi, _idxs in pairs:
+            if fi in verdicts and verdicts[fi]:
+                out.append(
+                    (fi, np.array(sorted(verdicts[fi]), dtype=np.int64))
+                )
+        return out
