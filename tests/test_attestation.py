@@ -1,0 +1,248 @@
+"""Tests: in-toto attestation parsing, the Rekor client, and the
+unpackaged flow (executable digest -> Rekor SBOM -> packages) against a
+fake transparency log."""
+
+import base64
+import contextlib
+import hashlib
+import io
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from trivy_tpu.attestation import (
+    AttestationError,
+    RekorClient,
+    parse_envelope,
+    sbom_from_statement,
+)
+
+ELF = b"\x7fELF" + b"fake-binary-body" * 8
+ELF_SHA = hashlib.sha256(ELF).hexdigest()
+
+SBOM_PREDICATE = {
+    "bomFormat": "CycloneDX",
+    "specVersion": "1.5",
+    "components": [
+        {
+            "type": "library",
+            "group": "com.fasterxml.jackson.core",
+            "name": "jackson-databind",
+            "version": "2.9.1",
+            "purl": "pkg:maven/com.fasterxml.jackson.core/jackson-databind@2.9.1",
+        }
+    ],
+}
+
+
+def _envelope(predicate) -> dict:
+    statement = {
+        "_type": "https://in-toto.io/Statement/v0.1",
+        "predicateType": "https://cyclonedx.org/bom",
+        "subject": [{"name": "app", "digest": {"sha256": ELF_SHA}}],
+        "predicate": predicate,
+    }
+    return {
+        "payloadType": "application/vnd.in-toto+json",
+        "payload": base64.b64encode(json.dumps(statement).encode()).decode(),
+        "signatures": [{"sig": "unverified"}],
+    }
+
+
+def test_parse_envelope_roundtrip():
+    stmt = parse_envelope(_envelope(SBOM_PREDICATE))
+    assert stmt.predicate_type == "https://cyclonedx.org/bom"
+    assert stmt.subjects[0]["digest"]["sha256"] == ELF_SHA
+    detail = sbom_from_statement(stmt)
+    pkgs = [p for a in detail.applications for p in a.packages] + [
+        p for pi in detail.package_infos for p in pi.packages
+    ]
+    assert any("jackson-databind" in p.name for p in pkgs)
+
+
+def test_parse_envelope_rejects_non_intoto():
+    with pytest.raises(AttestationError):
+        parse_envelope({"payloadType": "text/plain", "payload": ""})
+
+
+def test_non_sbom_predicate_is_none():
+    stmt = parse_envelope(_envelope({"something": "else"}))
+    assert sbom_from_statement(stmt) is None
+
+
+class _FakeRekor(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):  # noqa: N802
+        n = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(n))
+        uuids = ["uuid-1"] if body.get("hash") == f"sha256:{ELF_SHA}" else []
+        data = json.dumps(uuids).encode()
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802
+        if self.path.endswith("/uuid-1"):
+            att = base64.b64encode(
+                json.dumps(_envelope(SBOM_PREDICATE)).encode()
+            ).decode()
+            entry = {"uuid-1": {"attestation": {"data": att}}}
+            data = json.dumps(entry).encode()
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(data)
+        else:
+            self.send_response(404)
+            self.end_headers()
+
+
+@pytest.fixture(scope="module")
+def rekor_url():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeRekor)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def test_rekor_client_lookup(rekor_url):
+    client = RekorClient(rekor_url)
+    assert client.search_by_digest(ELF_SHA) == ["uuid-1"]
+    assert client.search_by_digest("0" * 64) == []
+    detail = client.sbom_for_digest(ELF_SHA)
+    assert detail is not None
+    pkgs = [p for a in detail.applications for p in a.packages] + [
+        p for pi in detail.package_infos for p in pi.packages
+    ]
+    assert any(p.version == "2.9.1" for p in pkgs)
+
+
+def test_handler_memoizes_per_digest(rekor_url, monkeypatch):
+    """Duplicate binaries (same sha256) cost one Rekor round trip, and each
+    occurrence gets its own package objects with its own file path."""
+    from trivy_tpu.analyzer.core import AnalysisResult
+    from trivy_tpu.attestation import rekor_unpackaged_handler
+
+    calls = []
+    orig = RekorClient.sbom_for_digest
+    monkeypatch.setattr(
+        RekorClient,
+        "sbom_for_digest",
+        lambda self, d: (calls.append(d), orig(self, d))[1],
+    )
+    handler = rekor_unpackaged_handler(rekor_url)
+
+    result = AnalysisResult()
+    for fp in ("bin/a", "bin/b"):
+        result.configs.append(
+            {"Type": "executable", "FilePath": fp, "Digest": f"sha256:{ELF_SHA}"}
+        )
+    handler(result)
+    assert calls == [ELF_SHA]
+    paths = sorted(a.file_path for a in result.applications)
+    assert paths == ["bin/a", "bin/b"]
+    # distinct objects: mutating one occurrence must not affect the other
+    assert result.applications[0] is not result.applications[1]
+    assert (
+        result.applications[0].packages[0]
+        is not result.applications[1].packages[0]
+    )
+
+
+def test_jar_purl_is_maven():
+    """jar/war app types map to maven purls with the group as namespace
+    (purl.go:198-203), round-tripping back to group:artifact."""
+    from trivy_tpu.purl import package_url, parse_purl
+
+    p = package_url("jar", "com.fasterxml.jackson.core:jackson-databind", "2.9.1")
+    assert p == (
+        "pkg:maven/com.fasterxml.jackson.core/jackson-databind@2.9.1"
+    )
+    assert parse_purl(p) == (
+        "maven", "com.fasterxml.jackson.core:jackson-databind", "2.9.1"
+    )
+
+
+def test_handler_surfaces_os_packages(rekor_url, monkeypatch):
+    """An attested SBOM listing apk/deb/rpm purls lands in package_infos
+    (the flat ArtifactDetail.packages list would otherwise be dropped)."""
+    from trivy_tpu.analyzer.core import AnalysisResult
+    from trivy_tpu.attestation import rekor_unpackaged_handler
+    from trivy_tpu.atypes import ArtifactDetail, Package
+
+    detail = ArtifactDetail(packages=[Package(name="musl", version="1.2.4-r1")])
+    monkeypatch.setattr(
+        RekorClient, "sbom_for_digest", lambda self, d: detail
+    )
+    handler = rekor_unpackaged_handler(rekor_url)
+    result = AnalysisResult()
+    result.configs.append(
+        {"Type": "executable", "FilePath": "bin/a", "Digest": f"sha256:{ELF_SHA}"}
+    )
+    handler(result)
+    assert result.package_infos
+    assert result.package_infos[0].file_path == "bin/a"
+    assert result.package_infos[0].packages[0].name == "musl"
+
+
+def test_malformed_log_entry_tolerated(rekor_url):
+    """A non-dict entry body must not raise out of get_attestation."""
+    client = RekorClient(rekor_url)
+    client._get = lambda path: {"u1": "not-a-dict", "u2": None}
+    assert client.get_attestation("u1") is None
+
+
+def test_rekor_url_keys_blob_cache():
+    """Image layer cache keys must change with the Rekor URL so switching
+    logs cannot reuse blobs resolved against another one."""
+    from trivy_tpu.analyzer.core import AnalyzerOptions
+    from trivy_tpu.artifact.image import ImageArtifact
+
+    def key_for(extra):
+        art = ImageArtifact.__new__(ImageArtifact)
+        from trivy_tpu.analyzer.core import AnalyzerGroup
+
+        art.group = AnalyzerGroup(AnalyzerOptions(cache_key_extra=extra))
+        return art._layer_key("sha256:deadbeef")
+
+    assert key_for("rekor=https://a") != key_for("rekor=https://b")
+    assert key_for("") != key_for("rekor=https://a")
+
+
+def test_unpackaged_flow_end_to_end(tmp_path, rekor_url):
+    """fs --sbom-sources rekor: an orphan ELF binary's packages resolve
+    from its Rekor SBOM attestation and get vuln-matched."""
+    from trivy_tpu.cli import main
+    from trivy_tpu.db.vulndb import build_db
+
+    (tmp_path / "rootfs").mkdir()
+    bin_path = tmp_path / "rootfs" / "mystery-tool"
+    bin_path.write_bytes(ELF)
+    bin_path.chmod(0o755)
+    build_db(str(tmp_path / "db"), {
+        "maven": {
+            "com.fasterxml.jackson.core:jackson-databind": [{
+                "VulnerabilityID": "CVE-2017-17485",
+                "FixedVersion": "2.9.4",
+                "Severity": "CRITICAL",
+            }],
+        },
+    })
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main([
+            "rootfs", "--scanners", "vuln", "--format", "json",
+            "--sbom-sources", "rekor", "--rekor-url", rekor_url,
+            "--db-dir", str(tmp_path / "db"), str(tmp_path / "rootfs"),
+        ])
+    assert rc == 0
+    report = json.loads(buf.getvalue())
+    vulns = [
+        v["VulnerabilityID"]
+        for r in report["Results"] or []
+        for v in r.get("Vulnerabilities", [])
+    ]
+    assert "CVE-2017-17485" in vulns

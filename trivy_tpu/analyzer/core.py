@@ -46,6 +46,11 @@ class AnalyzerOptions:
     # rather than the process-global registry.
     extra_analyzers: list = field(default_factory=list)
     sbom_sources: list = field(default_factory=list)  # --sbom-sources
+    # Artifact options that change blob contents without changing analyzer
+    # versions (e.g. the Rekor URL attestations resolve against) — hashed
+    # into diff-id-keyed blob cache keys the way the reference hashes
+    # artifact.Option (artifact.go calcCacheKey).
+    cache_key_extra: str = ""
 
     def __post_init__(self) -> None:
         if self.secret_scanner_option is None:

@@ -175,6 +175,7 @@ class ImageArtifact:
         h = hashlib.sha256()
         h.update(diff_id.encode())
         h.update(json.dumps(self.group.analyzer_versions(), sort_keys=True).encode())
+        h.update(self.group.options.cache_key_extra.encode())
         # Per-layer disabled analyzers change the blob's contents, so they
         # are part of the key (image.go calcCacheKey includes them).
         h.update(json.dumps(sorted(disabled)).encode())
@@ -184,6 +185,7 @@ class ImageArtifact:
         h = hashlib.sha256()
         h.update(self.source.config_digest.encode())
         h.update(json.dumps(self.group.analyzer_versions(), sort_keys=True).encode())
+        h.update(self.group.options.cache_key_extra.encode())
         return "sha256:" + h.hexdigest()
 
     def inspect(self) -> ArtifactReference:

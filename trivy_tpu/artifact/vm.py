@@ -49,7 +49,10 @@ class VMArtifact:
 
     def inspect(self) -> ArtifactReference:
         digest = self._image_digest()
-        versions = json.dumps(self.group.analyzer_versions(), sort_keys=True)
+        versions = (
+            json.dumps(self.group.analyzer_versions(), sort_keys=True)
+            + self.group.options.cache_key_extra
+        )
         size = os.path.getsize(self.target)
         blob_ids: list[str] = []
         with open(self.target, "rb") as img:

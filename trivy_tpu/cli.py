@@ -233,6 +233,10 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
         help="external SBOM sources (rekor enables executable digesting)",
     )
     p.add_argument(
+        "--rekor-url", default=_env_default("rekor-url", ""),
+        help="Rekor transparency-log URL for attestation lookups",
+    )
+    p.add_argument(
         "--report", choices=["summary", "all"],
         default=_env_default("report", "summary"),
         help="compliance report granularity",
@@ -285,6 +289,7 @@ def _options_from_args(args: argparse.Namespace) -> Options:
         compliance_report=args.report,
         module_dir=args.module_dir,
         sbom_sources=list(args.sbom_sources),
+        rekor_url=args.rekor_url,
     )
 
 

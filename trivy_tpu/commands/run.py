@@ -68,6 +68,7 @@ class Options:
     compliance_report: str = "summary"  # --report summary|all
     module_dir: str = ""  # --module-dir extension modules
     sbom_sources: list[str] = field(default_factory=list)  # --sbom-sources
+    rekor_url: str = ""  # --rekor-url (unpackaged SBOM lookups)
     config_check: list[str] = field(default_factory=list)  # --config-check dirs
     insecure_registry: bool = False  # plain-http registry pulls
     db_repository: str = ""  # OCI ref for the vuln DB (--db-repository)
@@ -142,6 +143,14 @@ def _analyzer_options(options: Options, target_kind: str) -> AnalyzerOptions:
     extra = []
     if getattr(options, "_module_manager", None) is not None:
         extra = options._module_manager.analyzers()
+    cache_key_extra = ""
+    if "rekor" in (getattr(options, "sbom_sources", []) or []):
+        from trivy_tpu.attestation import DEFAULT_REKOR_URL
+
+        # Attestation-resolved packages land in diff-id-keyed blobs, so the
+        # log they came from must key the cache: switching --rekor-url must
+        # not reuse blobs resolved against another transparency log.
+        cache_key_extra = f"rekor={options.rekor_url or DEFAULT_REKOR_URL}"
     return AnalyzerOptions(
         disabled_analyzers=disabled,
         secret_scanner_option=SecretScannerOption(
@@ -149,6 +158,7 @@ def _analyzer_options(options: Options, target_kind: str) -> AnalyzerOptions:
         ),
         extra_analyzers=extra,
         sbom_sources=list(getattr(options, "sbom_sources", []) or []),
+        cache_key_extra=cache_key_extra,
     )
 
 
@@ -321,7 +331,19 @@ def _run_inner(options: Options, target_kind: str) -> int:
         _compliance_spec(options)
     manager = None
     cache = None
+    rekor_handler = None
     try:
+        if "rekor" in (options.sbom_sources or []):
+            from trivy_tpu.attestation import (
+                DEFAULT_REKOR_URL,
+                rekor_unpackaged_handler,
+            )
+            from trivy_tpu.handler import register_post_handler
+
+            rekor_handler = rekor_unpackaged_handler(
+                options.rekor_url or DEFAULT_REKOR_URL
+            )
+            register_post_handler(rekor_handler)
         import os as _osm
 
         from trivy_tpu.module import DEFAULT_MODULE_DIR
@@ -371,6 +393,10 @@ def _run_inner(options: Options, target_kind: str) -> int:
         _write(report, options)
         return _exit_code(report, options)
     finally:
+        if rekor_handler is not None:
+            from trivy_tpu.handler import unregister_post_handler
+
+            unregister_post_handler(rekor_handler)
         if manager is not None:
             manager.unregister()
         if cache is not None:

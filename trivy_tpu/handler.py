@@ -41,6 +41,13 @@ def register_post_handler(handler: Callable) -> None:
     _HANDLERS.append(handler)
 
 
+def unregister_post_handler(handler: Callable) -> None:
+    try:
+        _HANDLERS.remove(handler)
+    except ValueError:
+        pass
+
+
 def run_post_handlers(result) -> None:
     for handler in list(_HANDLERS):
         try:

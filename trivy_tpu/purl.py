@@ -19,6 +19,8 @@ _PURL_TYPES = {
     "nuget": "nuget",
     "pom": "maven",
     "gradle": "maven",
+    "jar": "maven",
+    "war": "maven",
     "apk": "apk",
     "dpkg": "deb",
     "rpm": "rpm",
@@ -41,6 +43,10 @@ def package_url(
     pkg_type: str, name: str, version: str, namespace: str = ""
 ) -> str:
     ptype = _PURL_TYPES.get(pkg_type, pkg_type)
+    if ptype == "maven" and ":" in name and not namespace:
+        # Maven package names are group:artifact (purl.go:198-203); the
+        # group becomes the purl namespace.
+        namespace, _, name = name.rpartition(":")
     if "/" in name and not namespace:
         namespace, _, name = name.rpartition("/")
     parts = ["pkg:" + ptype]
@@ -59,4 +65,10 @@ def parse_purl(purl: str) -> tuple[str, str, str]:
     name_part, _, version = rest.rpartition("@")
     if not name_part:
         name_part, version = rest, ""
-    return ptype, unquote(name_part), unquote(version)
+    name = unquote(name_part)
+    if ptype == "maven" and "/" in name:
+        # Back to the group:artifact form trivy package names / DB keys use
+        # (purl.go:129-137 Package(): maven joins namespace with ':').
+        ns, _, base = name.rpartition("/")
+        name = f"{ns}:{base}"
+    return ptype, name, unquote(version)
