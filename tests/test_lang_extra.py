@@ -1,0 +1,316 @@
+"""Tests: binary analyzers (Go buildinfo, Rust cargo-auditable) and the
+extra lockfile ecosystems (conan/conda/pub/mix/cocoapods/swift)."""
+
+import json
+import struct
+import zlib
+
+from trivy_tpu.analyzer.binary import (
+    _INFO_END,
+    _INFO_START,
+    GoBinaryAnalyzer,
+    RustBinaryAnalyzer,
+    read_go_buildinfo,
+    read_rust_audit,
+)
+from trivy_tpu.analyzer.core import AnalysisInput
+from trivy_tpu.analyzer.lang_extra import (
+    CocoaPodsAnalyzer,
+    CondaEnvironmentAnalyzer,
+    CondaMetaAnalyzer,
+    ConanLockAnalyzer,
+    MixLockAnalyzer,
+    PubLockAnalyzer,
+    SwiftAnalyzer,
+)
+
+def _inp(path, content):
+    return AnalysisInput("", path, len(content), 0o755, content)
+
+
+MODINFO = (
+    "path\tgithub.com/acme/tool\n"
+    "mod\tgithub.com/acme/tool\tv1.2.3\th1:abc=\n"
+    "dep\tgolang.org/x/text\tv0.3.7\th1:def=\n"
+    "dep\tgithub.com/old/pkg\tv1.0.0\th1:x=\n"
+    "=>\tgithub.com/new/pkg\tv2.0.0\th1:y=\n"
+)
+
+
+def _uvarint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _inline_go_binary() -> bytes:
+    modinfo = _INFO_START + MODINFO.encode() + _INFO_END
+    block = bytearray(b"\xff Go buildinf:")
+    block += bytes([8, 0x2])  # ptrSize, flags: inline
+    block += b"\x00" * 16  # pad to offset 32
+    block += _uvarint(len(b"go1.21.5")) + b"go1.21.5"
+    block += _uvarint(len(modinfo)) + modinfo
+    return b"\x7fELF" + b"\x00" * 123 + bytes(block) + b"\x00" * 64
+
+
+def test_go_buildinfo_inline():
+    go_version, modinfo = read_go_buildinfo(_inline_go_binary())
+    assert go_version == "go1.21.5"
+    assert "golang.org/x/text" in modinfo
+    a = GoBinaryAnalyzer()
+    assert a.required("usr/bin/tool", 1024, 0o755)
+    assert not a.required("usr/bin/tool", 1024, 0o644)
+    res = a.analyze(_inp("usr/bin/tool", _inline_go_binary()))
+    pkgs = {p.name: p.version for p in res.applications[0].packages}
+    assert pkgs["stdlib"] == "1.21.5"
+    assert pkgs["github.com/acme/tool"] == "v1.2.3"
+    assert pkgs["golang.org/x/text"] == "v0.3.7"
+    # the "=>" replacement overrides the preceding dep
+    assert "github.com/old/pkg" not in pkgs
+    assert pkgs["github.com/new/pkg"] == "v2.0.0"
+
+
+def test_go_buildinfo_pointer_format():
+    """Pre-go1.18 layout: header holds vaddrs of Go string headers,
+    resolved through PT_LOAD translation."""
+    base = 0x400000
+    blob = bytearray(b"\x00" * 4096)
+    # ELF64 header: phoff=64, 1 phdr, no sections
+    blob[0:16] = b"\x7fELF\x02\x01\x01" + b"\x00" * 9
+    struct.pack_into("<HHIQQQIHHHHHH", blob, 16, 2, 0x3E, 1, base, 64, 0, 0,
+                     64, 56, 1, 0, 0, 0)
+    # PT_LOAD covering the whole file at vaddr base
+    struct.pack_into("<IIQQQQQQ", blob, 64, 1, 5, 0, base, base, 4096, 4096,
+                     0x1000)
+    go_version = b"go1.17.13"
+    modinfo = _INFO_START + MODINFO.encode() + _INFO_END
+    # string data
+    gv_off, mi_off = 1024, 1064
+    blob[gv_off : gv_off + len(go_version)] = go_version
+    blob[mi_off : mi_off + len(modinfo)] = modinfo
+    # string headers (ptr, len)
+    h1, h2 = 2048, 2064
+    struct.pack_into("<QQ", blob, h1, base + gv_off, len(go_version))
+    struct.pack_into("<QQ", blob, h2, base + mi_off, len(modinfo))
+    # buildinfo block at 3072: magic, ptrSize=8, flags=0, two header vaddrs
+    bi = 3072
+    blob[bi : bi + 14] = b"\xff Go buildinf:"
+    blob[bi + 14] = 8
+    blob[bi + 15] = 0
+    struct.pack_into("<QQ", blob, bi + 16, base + h1, base + h2)
+    gv, mi = read_go_buildinfo(bytes(blob))
+    assert gv == "go1.17.13"
+    assert "github.com/acme/tool" in mi
+
+
+def _elf_with_dep_section(payload: bytes) -> bytes:
+    """Minimal ELF64 with .dep-v0 + .shstrtab sections."""
+    shstrtab = b"\x00.dep-v0\x00.shstrtab\x00"
+    data_off = 64
+    str_off = data_off + len(payload)
+    sh_off = (str_off + len(shstrtab) + 7) & ~7
+    blob = bytearray(sh_off + 3 * 64)
+    blob[0:16] = b"\x7fELF\x02\x01\x01" + b"\x00" * 9
+    struct.pack_into("<HHIQQQIHHHHHH", blob, 16, 2, 0x3E, 1, 0, 0, sh_off, 0,
+                     64, 0, 0, 64, 3, 2)
+    blob[data_off:str_off] = payload
+    blob[str_off : str_off + len(shstrtab)] = shstrtab
+
+    def shdr(idx, name, off, size):
+        struct.pack_into("<IIQQQQIIQQ", blob, sh_off + idx * 64, name, 1, 0,
+                         0, off, size, 0, 0, 1, 0)
+
+    shdr(1, 1, data_off, len(payload))  # .dep-v0
+    shdr(2, 9, str_off, len(shstrtab))  # .shstrtab
+    return bytes(blob)
+
+
+def test_rust_audit_section():
+    audit = {
+        "packages": [
+            {"name": "serde", "version": "1.0.190", "kind": "runtime"},
+            {"name": "cc", "version": "1.0.83", "kind": "build"},
+            {"name": "mytool", "version": "0.1.0", "kind": "runtime", "root": True},
+        ]
+    }
+    elf = _elf_with_dep_section(zlib.compress(json.dumps(audit).encode()))
+    pkgs = {p.name: p.version for p in read_rust_audit(elf)}
+    assert pkgs == {"serde": "1.0.190", "mytool": "0.1.0"}  # build kind dropped
+    res = RustBinaryAnalyzer().analyze(_inp("app", elf))
+    assert res.applications[0].app_type == "rustbinary"
+    assert read_rust_audit(b"\x7fELFnope") is None
+    assert read_rust_audit(b"not elf") is None
+
+
+def test_conan_lock_v1_and_v2():
+    v1 = {
+        "graph_lock": {
+            "nodes": {
+                "0": {"ref": "myproject/1.0"},
+                "1": {"ref": "zlib/1.2.13#rev1"},
+                "2": {"ref": "openssl/3.1.0@user/channel"},
+            }
+        }
+    }
+    pkgs = {p.name: p.version for p in ConanLockAnalyzer().parse(json.dumps(v1).encode())}
+    assert pkgs == {"zlib": "1.2.13", "openssl": "3.1.0"}
+    v2 = {"requires": ["fmt/10.1.1#abc%1699", "spdlog/1.12.0"]}
+    pkgs = {p.name: p.version for p in ConanLockAnalyzer().parse(json.dumps(v2).encode())}
+    assert pkgs == {"fmt": "10.1.1", "spdlog": "1.12.0"}
+
+
+def test_conda_meta_and_environment():
+    a = CondaMetaAnalyzer()
+    assert a.required("envs/myenv/conda-meta/numpy-1.26.0-py311.json", 10, 0o644)
+    assert not a.required("envs/myenv/other/numpy.json", 10, 0o644)
+    res = a.analyze(_inp(
+        "envs/e/conda-meta/numpy-1.26.0.json",
+        json.dumps({"name": "numpy", "version": "1.26.0", "license": "BSD-3-Clause"}).encode(),
+    ))
+    pkg = res.applications[0].packages[0]
+    assert (pkg.name, pkg.version, pkg.licenses) == ("numpy", "1.26.0", ["BSD-3-Clause"])
+
+    env = b"""
+name: test
+dependencies:
+  - python=3.11.5=h123
+  - numpy=1.26.*
+  - requests
+"""
+    pkgs = {p.name: p.version for p in CondaEnvironmentAnalyzer().parse(env)}
+    assert pkgs == {"python": "3.11.5", "numpy": "", "requests": ""}
+    # comparison-operator specs keep clean names and empty versions
+    env2 = b"dependencies:\n  - python>=3.9\n  - numpy<2\n  - scipy=1.11.2\n"
+    pkgs = {p.name: p.version for p in CondaEnvironmentAnalyzer().parse(env2)}
+    assert pkgs == {"python": "", "numpy": "", "scipy": "1.11.2"}
+
+
+def test_empty_version_never_matches_advisories():
+    """Unversioned packages (unstamped Go '(devel)' mains) must not match
+    every advisory via ''-sorts-lowest comparisons."""
+    from trivy_tpu.atypes import Application, Package
+    from trivy_tpu.db.vulndb import VulnDB, build_db
+    from trivy_tpu.detector.library import LibraryDetector
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        build_db(d, {"go": {"github.com/acme/tool": [{
+            "VulnerabilityID": "GO-X", "FixedVersion": "v9.9.9",
+            "Severity": "HIGH"}]}})
+        det = LibraryDetector(VulnDB(d))
+        app = Application(app_type="gobinary", file_path="bin/t", packages=[
+            Package(id="github.com/acme/tool", name="github.com/acme/tool",
+                    version=""),
+        ])
+        assert det.detect_app(app) == []
+        app.packages[0].version = "v1.0.0"
+        assert [v.vulnerability_id for v in det.detect_app(app)] == ["GO-X"]
+
+
+def test_pub_lock():
+    lock = b"""
+packages:
+  http:
+    dependency: "direct main"
+    version: "1.1.0"
+  meta:
+    dependency: transitive
+    version: "1.9.1"
+"""
+    pkgs = {p.name: p.version for p in PubLockAnalyzer().parse(lock)}
+    assert pkgs == {"http": "1.1.0", "meta": "1.9.1"}
+
+
+def test_mix_lock():
+    lock = b'''%{
+  "phoenix": {:hex, :phoenix, "1.7.10", "cafe", [:mix], [], "hexpm", "sum"},
+  "mygit": {:git, "https://github.com/x/y.git", "abc123", []},
+}
+'''
+    pkgs = {p.name: p.version for p in MixLockAnalyzer().parse(lock)}
+    assert pkgs == {"phoenix": "1.7.10"}
+
+
+def test_cocoapods_lock():
+    lock = b"""
+PODS:
+  - Alamofire (5.8.0)
+  - AppCenter/Analytics (5.0.4):
+    - AppCenter/Core
+  - AppCenter/Core (5.0.4)
+"""
+    pkgs = {p.name: p.version for p in CocoaPodsAnalyzer().parse(lock)}
+    assert pkgs == {
+        "Alamofire": "5.8.0",
+        "AppCenter/Analytics": "5.0.4",
+        "AppCenter/Core": "5.0.4",
+    }
+
+
+def test_swift_resolved_v1_v2():
+    v2 = {
+        "version": 2,
+        "pins": [
+            {"identity": "alamofire",
+             "location": "https://github.com/Alamofire/Alamofire.git",
+             "state": {"version": "5.8.1"}},
+            {"identity": "branch-only",
+             "location": "https://github.com/x/y",
+             "state": {"branch": "main"}},
+        ],
+    }
+    pkgs = {p.name: p.version for p in SwiftAnalyzer().parse(json.dumps(v2).encode())}
+    assert pkgs == {
+        "github.com/Alamofire/Alamofire": "5.8.1",
+        "github.com/x/y": "main",
+    }
+    v1 = {
+        "version": 1,
+        "object": {"pins": [
+            {"repositoryURL": "https://github.com/apple/swift-nio.git",
+             "state": {"version": "2.60.0"}},
+        ]},
+    }
+    pkgs = {p.name: p.version for p in SwiftAnalyzer().parse(json.dumps(v1).encode())}
+    assert pkgs == {"github.com/apple/swift-nio": "2.60.0"}
+
+
+def test_end_to_end_pub_vuln(tmp_path):
+    """fs scan matches a pub advisory through the new analyzer."""
+    import contextlib
+    import io
+
+    from trivy_tpu.cli import main
+    from trivy_tpu.db.vulndb import build_db
+
+    (tmp_path / "proj").mkdir()
+    (tmp_path / "proj" / "pubspec.lock").write_text(
+        'packages:\n  http:\n    dependency: "direct main"\n    version: "0.13.0"\n'
+    )
+    build_db(str(tmp_path / "db"), {
+        "pub": {"http": [{
+            "VulnerabilityID": "CVE-2020-35669",
+            "FixedVersion": "0.13.3",
+            "Severity": "MEDIUM",
+        }]},
+    })
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main([
+            "fs", "--scanners", "vuln", "--format", "json",
+            "--db-dir", str(tmp_path / "db"), str(tmp_path / "proj"),
+        ])
+    assert rc == 0
+    report = json.loads(buf.getvalue())
+    vulns = [
+        v["VulnerabilityID"]
+        for r in report["Results"] or []
+        for v in r.get("Vulnerabilities", [])
+    ]
+    assert vulns == ["CVE-2020-35669"]

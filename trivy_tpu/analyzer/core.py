@@ -224,9 +224,11 @@ def registered_analyzers() -> list[Callable[[], Analyzer]]:
 def _ensure_builtin_registered() -> None:
     # Import modules whose import side-effect registers analyzers (mirrors the
     # reference's `_ "…/analyzer/all"` blank imports).
+    from trivy_tpu.analyzer import binary as _binary  # noqa: F401
     from trivy_tpu.analyzer import config as _config  # noqa: F401
     from trivy_tpu.analyzer import java as _java  # noqa: F401
     from trivy_tpu.analyzer import lang as _lang  # noqa: F401
+    from trivy_tpu.analyzer import lang_extra as _lang_extra  # noqa: F401
     from trivy_tpu.analyzer import license as _license  # noqa: F401
     from trivy_tpu.analyzer import misc as _misc  # noqa: F401
     from trivy_tpu.analyzer import os_release as _os  # noqa: F401

@@ -15,22 +15,34 @@ from trivy_tpu.detector.severity import resolve_severity
 from trivy_tpu.detector.version_cmp import COMPARATORS, version_in_range
 from trivy_tpu.ftypes import DetectedVulnerability
 
-# app type -> (db source, comparator flavor)
+# app type -> (db source, comparator flavor); mirrors driver.go:24-90
 _ECOSYSTEMS: dict[str, tuple[str, str]] = {
     "npm": ("npm", "semver"),
     "yarn": ("npm", "semver"),
     "pnpm": ("npm", "semver"),
+    "node-pkg": ("npm", "semver"),
     "pip": ("pip", "pep440"),
     "pipenv": ("pip", "pep440"),
     "poetry": ("pip", "pep440"),
+    "python-pkg": ("pip", "pep440"),
     "gomod": ("go", "semver"),
+    "gobinary": ("go", "semver"),
     "cargo": ("cargo", "semver"),
+    "rustbinary": ("cargo", "semver"),
     "composer": ("composer", "semver"),
     "bundler": ("rubygems", "generic"),
+    "gemspec": ("rubygems", "generic"),
     "nuget": ("nuget", "semver"),
     "pom": ("maven", "maven"),
     "gradle": ("maven", "maven"),
     "jar": ("maven", "maven"),
+    "war": ("maven", "maven"),
+    "pub": ("pub", "generic"),
+    "hex": ("erlang", "generic"),
+    "conan": ("conan", "generic"),
+    "swift": ("swift", "generic"),
+    "cocoapods": ("cocoapods", "generic"),
+    # conda-pkg / conda-environment: SBOM-only, no vuln DB (driver.go:75-77)
 }
 
 
@@ -47,6 +59,11 @@ class LibraryDetector:
 
         out: list[DetectedVulnerability] = []
         for pkg in app.packages:
+            if not pkg.version:
+                # Unversioned packages (unstamped Go '(devel)' main modules,
+                # unpinned conda specs) compare below every fixed version and
+                # would match every advisory — skip, don't false-positive.
+                continue
             for adv in self.db.advisories(source, pkg.name):
                 vulnerable = False
                 if adv.vulnerable_versions:

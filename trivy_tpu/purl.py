@@ -21,6 +21,18 @@ _PURL_TYPES = {
     "gradle": "maven",
     "jar": "maven",
     "war": "maven",
+    "gobinary": "golang",
+    "rustbinary": "cargo",
+    "python-pkg": "pypi",
+    "node-pkg": "npm",
+    "gemspec": "gem",
+    "pub": "pub",
+    "hex": "hex",
+    "conan": "conan",
+    "swift": "swift",
+    "cocoapods": "cocoapods",
+    "conda-pkg": "conda",
+    "conda-environment": "conda",
     "apk": "apk",
     "dpkg": "deb",
     "rpm": "rpm",
@@ -36,6 +48,12 @@ PURL_TO_APP = {
     "gem": "bundler",
     "nuget": "nuget",
     "maven": "pom",
+    "pub": "pub",
+    "hex": "hex",
+    "conan": "conan",
+    "swift": "swift",
+    "cocoapods": "cocoapods",
+    "conda": "conda-pkg",
 }
 
 
