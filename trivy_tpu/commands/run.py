@@ -115,7 +115,15 @@ def _analyzer_options(options: Options, target_kind: str) -> AnalyzerOptions:
     if "license" not in options.scanners:
         disabled.extend(["license-file", "dpkg-license"])
     if "misconfig" not in options.scanners:
-        disabled.extend(["dockerfile", "kubernetes", "terraform"])
+        disabled.extend(
+            [
+                "dockerfile",
+                "kubernetes",
+                "terraform",
+                "config-json",
+                "config-toml",
+            ]
+        )
     if "rekor" not in (getattr(options, "sbom_sources", []) or []):
         # Executable digesting costs a full-content hash per binary and only
         # serves Rekor lookups; disabling it here (not just gating required)

@@ -1,0 +1,14 @@
+# METADATA
+# title: Storage account allows insecure (HTTP) transfer
+# custom:
+#   id: AVD-AZU-0008
+#   severity: HIGH
+#   recommended_action: Set supportsHttpsTrafficOnly true.
+package builtin.azure.arm.AZU0008
+
+deny[res] {
+    r := object.get(input, "resources", [])[_]
+    object.get(r, "type", "") == "Microsoft.Storage/storageAccounts"
+    object.get(object.get(r, "properties", {}), "supportsHttpsTrafficOnly", true) != true
+    res := result.new(sprintf("Storage account %q allows insecure transfer", [object.get(r, "name", "")]), r)
+}

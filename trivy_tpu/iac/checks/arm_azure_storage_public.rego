@@ -1,0 +1,14 @@
+# METADATA
+# title: Storage account allows public blob access
+# custom:
+#   id: AVD-AZU-0007
+#   severity: HIGH
+#   recommended_action: Set allowBlobPublicAccess false.
+package builtin.azure.arm.AZU0007
+
+deny[res] {
+    r := object.get(input, "resources", [])[_]
+    object.get(r, "type", "") == "Microsoft.Storage/storageAccounts"
+    object.get(object.get(r, "properties", {}), "allowBlobPublicAccess", false) == true
+    res := result.new(sprintf("Storage account %q allows public blob access", [object.get(r, "name", "")]), r)
+}

@@ -1,0 +1,14 @@
+# METADATA
+# title: EBS volume is not encrypted
+# custom:
+#   id: AVD-AWS-0026
+#   severity: HIGH
+#   recommended_action: Set Encrypted true on the volume.
+package builtin.cloudformation.AWS0026
+
+deny[res] {
+    some name, r in object.get(input, "Resources", {})
+    object.get(r, "Type", "") == "AWS::EC2::Volume"
+    object.get(object.get(r, "Properties", {}), "Encrypted", false) != true
+    res := result.new(sprintf("EBS volume %q is not encrypted", [name]), r)
+}

@@ -1,0 +1,26 @@
+# METADATA
+# title: EC2 instance does not require IMDSv2
+# custom:
+#   id: AVD-AWS-0028
+#   severity: HIGH
+#   recommended_action: Set MetadataOptions HttpTokens to required.
+package builtin.cloudformation.AWS0028
+
+metadata_options[pair] {
+    some name, r in object.get(input, "Resources", {})
+    object.get(r, "Type", "") == "AWS::EC2::Instance"
+    pair := {"name": name, "r": r, "opts": object.get(object.get(r, "Properties", {}), "MetadataOptions", {})}
+}
+
+metadata_options[pair] {
+    some name, r in object.get(input, "Resources", {})
+    object.get(r, "Type", "") == "AWS::EC2::LaunchTemplate"
+    data := object.get(object.get(r, "Properties", {}), "LaunchTemplateData", {})
+    pair := {"name": name, "r": r, "opts": object.get(data, "MetadataOptions", {})}
+}
+
+deny[res] {
+    some pair in metadata_options
+    object.get(pair.opts, "HttpTokens", "optional") != "required"
+    res := result.new(sprintf("EC2 resource %q does not enforce IMDSv2 (HttpTokens required)", [pair.name]), pair.r)
+}

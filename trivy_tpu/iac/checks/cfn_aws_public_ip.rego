@@ -1,0 +1,14 @@
+# METADATA
+# title: Subnet or instance assigns public IP addresses by default
+# custom:
+#   id: AVD-AWS-0164
+#   severity: HIGH
+#   recommended_action: Disable automatic public IP assignment.
+package builtin.cloudformation.AWS0164
+
+deny[res] {
+    some name, r in object.get(input, "Resources", {})
+    object.get(r, "Type", "") == "AWS::EC2::Subnet"
+    object.get(object.get(r, "Properties", {}), "MapPublicIpOnLaunch", false) == true
+    res := result.new(sprintf("Subnet %q maps public IPs on launch", [name]), r)
+}

@@ -1,0 +1,14 @@
+# METADATA
+# title: RDS instance storage is not encrypted
+# custom:
+#   id: AVD-AWS-0080
+#   severity: HIGH
+#   recommended_action: Set StorageEncrypted true on the DB instance.
+package builtin.cloudformation.AWS0080
+
+deny[res] {
+    some name, r in object.get(input, "Resources", {})
+    object.get(r, "Type", "") == "AWS::RDS::DBInstance"
+    object.get(object.get(r, "Properties", {}), "StorageEncrypted", false) != true
+    res := result.new(sprintf("RDS instance %q does not encrypt storage", [name]), r)
+}

@@ -1,0 +1,15 @@
+# METADATA
+# title: S3 bucket with a public ACL
+# custom:
+#   id: AVD-AWS-0092
+#   severity: HIGH
+#   recommended_action: Remove the public AccessControl setting.
+package builtin.cloudformation.AWS0092
+
+deny[res] {
+    some name, r in object.get(input, "Resources", {})
+    object.get(r, "Type", "") == "AWS::S3::Bucket"
+    acl := object.get(object.get(r, "Properties", {}), "AccessControl", "")
+    acl in ["PublicRead", "PublicReadWrite", "AuthenticatedRead"]
+    res := result.new(sprintf("S3 bucket %q uses public ACL %q", [name, acl]), r)
+}

@@ -1,0 +1,15 @@
+# METADATA
+# title: S3 bucket without server-side encryption
+# custom:
+#   id: AVD-AWS-0088
+#   severity: HIGH
+#   recommended_action: Add a BucketEncryption block to the bucket.
+package builtin.cloudformation.AWS0088
+
+deny[res] {
+    some name, r in object.get(input, "Resources", {})
+    object.get(r, "Type", "") == "AWS::S3::Bucket"
+    props := object.get(r, "Properties", {})
+    not object.get(props, "BucketEncryption", null)
+    res := result.new(sprintf("S3 bucket %q has no server-side encryption configured", [name]), r)
+}

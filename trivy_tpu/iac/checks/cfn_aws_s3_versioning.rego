@@ -1,0 +1,15 @@
+# METADATA
+# title: S3 bucket versioning disabled
+# custom:
+#   id: AVD-AWS-0090
+#   severity: MEDIUM
+#   recommended_action: Enable VersioningConfiguration on the bucket.
+package builtin.cloudformation.AWS0090
+
+deny[res] {
+    some name, r in object.get(input, "Resources", {})
+    object.get(r, "Type", "") == "AWS::S3::Bucket"
+    props := object.get(r, "Properties", {})
+    object.get(object.get(props, "VersioningConfiguration", {}), "Status", "Suspended") != "Enabled"
+    res := result.new(sprintf("S3 bucket %q does not have versioning enabled", [name]), r)
+}

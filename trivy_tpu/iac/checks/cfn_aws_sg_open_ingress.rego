@@ -1,0 +1,27 @@
+# METADATA
+# title: Security group allows ingress from 0.0.0.0/0
+# custom:
+#   id: AVD-AWS-0107
+#   severity: CRITICAL
+#   recommended_action: Restrict ingress CIDR ranges.
+package builtin.cloudformation.AWS0107
+
+ingress_rules[pair] {
+    some name, r in object.get(input, "Resources", {})
+    object.get(r, "Type", "") == "AWS::EC2::SecurityGroup"
+    rule := object.get(object.get(r, "Properties", {}), "SecurityGroupIngress", [])[_]
+    pair := {"name": name, "rule": rule}
+}
+
+ingress_rules[pair] {
+    some name, r in object.get(input, "Resources", {})
+    object.get(r, "Type", "") == "AWS::EC2::SecurityGroupIngress"
+    pair := {"name": name, "rule": object.get(r, "Properties", {})}
+}
+
+deny[res] {
+    some pair in ingress_rules
+    cidr := object.get(pair.rule, "CidrIp", object.get(pair.rule, "CidrIpv6", ""))
+    cidr in ["0.0.0.0/0", "::/0"]
+    res := result.new(sprintf("Security group %q allows ingress from %s", [pair.name, cidr]), pair.rule)
+}

@@ -44,9 +44,19 @@ class Check:
 
 def _input_type_of(package: str) -> str | None:
     parts = package.split(".")
-    for t in ("dockerfile", "kubernetes", "terraform"):
+    for t in (
+        "dockerfile",
+        "kubernetes",
+        "terraform",
+        "cloudformation",
+        "json",
+        "yaml",
+        "toml",
+    ):
         if t in parts:
             return t
+    if "azure" in parts or "arm" in parts:
+        return "azure-arm"
     return None
 
 
@@ -113,10 +123,69 @@ class IacScanner:
         ftype = detect_type(file_path, content)
         if ftype is None:
             return None
+        if ftype in ("json", "yaml", "toml") and not any(
+            c.input_type == ftype for c in self.checks
+        ):
+            # Generic config types only matter when custom checks target
+            # them (scanner.go:82-112 gates these scanners the same way) —
+            # don't parse every config file in the tree for nothing.
+            return None
         if ftype == "dockerfile":
             inputs: list[Any] = [dockerfile_input(content)]
         elif ftype == "kubernetes":
             inputs = kubernetes_inputs(content)
+        elif ftype == "cloudformation":
+            from trivy_tpu.iac.inputs import cloudformation_input
+
+            doc = cloudformation_input(content)
+            inputs = [doc] if doc else []
+        elif ftype == "tfplan":
+            from trivy_tpu.iac.inputs import tfplan_input
+
+            doc = tfplan_input(content)
+            inputs = [doc] if doc else []
+            ftype = "terraform"  # plans run the terraform check corpus
+        elif ftype == "azure-arm":
+            from trivy_tpu.iac.inputs import azure_arm_input
+
+            doc = azure_arm_input(content)
+            inputs = [doc] if doc else []
+        elif ftype == "yaml":
+            import yaml as _yaml
+
+            try:
+                inputs = [
+                    d
+                    for d in _yaml.safe_load_all(
+                        content.decode("utf-8", "replace")
+                    )
+                    if isinstance(d, (dict, list))
+                ]
+            except _yaml.YAMLError:
+                return None
+        elif ftype == "toml":
+            try:
+                import tomllib
+            except ImportError:  # Python 3.10: tomllib landed in 3.11
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "toml checks need Python >= 3.11 (tomllib); %s skipped",
+                    file_path,
+                )
+                return None
+            try:
+                inputs = [tomllib.loads(content.decode("utf-8", "replace"))]
+            except (tomllib.TOMLDecodeError, ValueError):
+                return None
+        elif ftype == "json":
+            import json as _json
+
+            try:
+                doc = _json.loads(content)
+            except ValueError:
+                return None
+            inputs = [doc] if isinstance(doc, (dict, list)) else []
         elif file_path.endswith(".tf.json"):
             import json as _json
 

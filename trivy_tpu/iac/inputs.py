@@ -30,7 +30,9 @@ __all__ = [
 
 def detect_type(file_path: str, content: bytes) -> str | None:
     """File-type routing (pkg/misconf/scanner.go:82-112 per-type scanners +
-    pkg/iac/detection)."""
+    pkg/iac/detection: content sniffing decides between k8s manifests,
+    CloudFormation templates, ARM templates, and plan files sharing the
+    same extensions)."""
     name = file_path.rsplit("/", 1)[-1].lower()
     if name == "dockerfile" or name.startswith("dockerfile.") or name.endswith(
         ".dockerfile"
@@ -41,15 +43,44 @@ def detect_type(file_path: str, content: bytes) -> str | None:
     if name.endswith((".yaml", ".yml")):
         if b"apiVersion" in content and b"kind" in content:
             return "kubernetes"
-        return None
-    if name.endswith(".json"):
+        if b"Resources" in content and (
+            b"AWSTemplateFormatVersion" in content
+            or b"AWS::" in content
+        ):
+            return "cloudformation"
+        return "yaml"  # generic: only custom yaml-namespace checks fire
+    if name.endswith(".toml"):
+        return "toml"
+    if name.endswith((".json", ".template")):
         try:
             doc = json.loads(content)
         except ValueError:
+            # .template is also a common extension for YAML-format
+            # CloudFormation; apply the same content sniff as .yaml.
+            if name.endswith(".template") and b"Resources" in content and (
+                b"AWSTemplateFormatVersion" in content or b"AWS::" in content
+            ):
+                return "cloudformation"
             return None
-        if isinstance(doc, dict) and "apiVersion" in doc and "kind" in doc:
+        if isinstance(doc, list):
+            return "json"  # generic: custom json-namespace checks
+        if not isinstance(doc, dict):
+            return None
+        if "apiVersion" in doc and "kind" in doc:
             return "kubernetes"
-        return None
+        if isinstance(doc.get("Resources"), dict) and (
+            "AWSTemplateFormatVersion" in doc
+            or any(
+                isinstance(r, dict) and str(r.get("Type", "")).startswith("AWS::")
+                for r in doc["Resources"].values()
+            )
+        ):
+            return "cloudformation"
+        if "deploymentTemplate.json" in str(doc.get("$schema", "")):
+            return "azure-arm"
+        if "planned_values" in doc and "terraform_version" in doc:
+            return "tfplan"
+        return "json"  # generic
     return None
 
 
@@ -170,3 +201,147 @@ def kubernetes_inputs(content: bytes) -> list[dict[str, Any]]:
     except yaml.YAMLError:
         return []
     return out
+
+
+# ---------------------------------------------------------------------------
+# cloudformation
+# ---------------------------------------------------------------------------
+
+
+_CFN_LOADER_CLS = None
+
+
+def _cfn_loader():
+    """YAML loader understanding CloudFormation's short intrinsic tags
+    (!Ref, !Sub, !GetAtt, ...), normalized to the long Fn:: forms the
+    JSON template syntax uses (pkg/iac/scanners/cloudformation parser).
+    The class is built once (same pattern as _LineLoaderFactory)."""
+    global _CFN_LOADER_CLS
+    if _CFN_LOADER_CLS is not None:
+        return _CFN_LOADER_CLS
+    import yaml
+
+    class CfnLoader(yaml.SafeLoader):
+        pass
+
+    def tag(loader, tag_suffix, node):
+        if isinstance(node, yaml.ScalarNode):
+            value: Any = loader.construct_scalar(node)
+        elif isinstance(node, yaml.SequenceNode):
+            value = loader.construct_sequence(node, deep=True)
+        else:
+            value = loader.construct_mapping(node, deep=True)
+        if tag_suffix == "Ref":
+            return {"Ref": value}
+        if tag_suffix == "Condition":
+            return {"Condition": value}
+        if tag_suffix == "GetAtt" and isinstance(value, str):
+            value = value.split(".", 1)
+        return {f"Fn::{tag_suffix}": value}
+
+    CfnLoader.add_multi_constructor("!", tag)
+    _CFN_LOADER_CLS = CfnLoader
+    return CfnLoader
+
+
+def _cfn_resolve(value: Any, params: dict[str, Any]) -> Any:
+    """Resolve Ref/Fn::Sub against parameter defaults so checks see values
+    (cloudformation/parser resolution, defaults only — no stack inputs)."""
+    if isinstance(value, dict):
+        if len(value) == 1:
+            (k, v), = value.items()
+            if k == "Ref" and isinstance(v, str) and v in params:
+                return params[v]
+            if k == "Fn::Sub" and isinstance(v, str):
+                def sub(m):
+                    name = m.group(1)
+                    return str(params.get(name, m.group(0)))
+                return re.sub(r"\$\{([A-Za-z0-9:.]+)\}", sub, v)
+        return {k: _cfn_resolve(v, params) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_cfn_resolve(v, params) for v in value]
+    return value
+
+
+def cloudformation_input(content: bytes) -> dict[str, Any] | None:
+    """CloudFormation template (YAML or JSON) -> rego input document:
+    the template itself with parameter defaults folded into Ref/Sub."""
+    import yaml
+
+    text = content.decode("utf-8", errors="replace")
+    try:
+        if text.lstrip().startswith("{"):
+            doc = json.loads(text)
+        else:
+            doc = yaml.load(text, Loader=_cfn_loader())
+    except (ValueError, yaml.YAMLError):
+        return None
+    if not isinstance(doc, dict) or not isinstance(doc.get("Resources"), dict):
+        return None
+    params = {
+        name: blk.get("Default")
+        for name, blk in (doc.get("Parameters") or {}).items()
+        if isinstance(blk, dict) and "Default" in blk
+    }
+    return _cfn_resolve(doc, params)
+
+
+# ---------------------------------------------------------------------------
+# terraform plan / azure ARM
+# ---------------------------------------------------------------------------
+
+
+def tfplan_input(content: bytes) -> dict[str, Any] | None:
+    """terraform plan JSON -> the conftest-style terraform document shape,
+    so the terraform check corpus applies to plans (the reference's
+    terraformplan scanner converts plans back into HCL-shaped state)."""
+    try:
+        doc = json.loads(content)
+    except ValueError:
+        return None
+    if not isinstance(doc, dict):
+        return None
+    resources: dict[str, dict[str, Any]] = {}
+
+    def walk(module: dict[str, Any]) -> None:
+        for res in module.get("resources") or []:
+            rtype, name = res.get("type"), res.get("name")
+            values = res.get("values")
+            if not rtype or not name or not isinstance(values, dict):
+                continue
+            resources.setdefault(rtype, {})[name] = values
+        for child in module.get("child_modules") or []:
+            walk(child)
+
+    walk((doc.get("planned_values") or {}).get("root_module") or {})
+    return {"resource": resources} if resources else None
+
+
+def azure_arm_input(content: bytes) -> dict[str, Any] | None:
+    """Azure ARM deployment template -> rego input with parameter
+    defaultValue folded into [parameters('name')] expressions."""
+    try:
+        doc = json.loads(content)
+    except ValueError:
+        return None
+    if not isinstance(doc, dict) or not isinstance(doc.get("resources"), list):
+        return None
+    params = {
+        name: blk.get("defaultValue")
+        for name, blk in (doc.get("parameters") or {}).items()
+        if isinstance(blk, dict) and "defaultValue" in blk
+    }
+
+    def resolve(value: Any) -> Any:
+        if isinstance(value, str):
+            m = re.fullmatch(r"\[parameters\('([^']+)'\)\]", value.strip())
+            if m and m.group(1) in params:
+                return params[m.group(1)]
+            return value
+        if isinstance(value, dict):
+            return {k: resolve(v) for k, v in value.items()}
+        if isinstance(value, list):
+            return [resolve(v) for v in value]
+        return value
+
+    return resolve(doc)
