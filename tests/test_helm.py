@@ -1,0 +1,178 @@
+"""Tests: helm chart rendering (Go-template subset) and chart scanning."""
+
+import json
+import textwrap
+
+from trivy_tpu.iac.helm import find_charts, render_chart
+
+CHART_YAML = b"name: myapp\nversion: 0.1.0\nappVersion: '2.1'\n"
+
+VALUES_YAML = textwrap.dedent(
+    """
+    replicaCount: 2
+    image:
+      repository: nginx
+      tag: ""
+    securityContext: {}
+    resources: {}
+    privileged: true
+    ports:
+      - 80
+      - 443
+    """
+).encode()
+
+HELPERS = textwrap.dedent(
+    """
+    {{- define "myapp.fullname" -}}
+    {{- printf "%s-%s" .Release.Name .Chart.Name | trunc 63 | trimSuffix "-" -}}
+    {{- end -}}
+    {{- define "myapp.labels" -}}
+    app: {{ .Chart.Name }}
+    release: {{ .Release.Name }}
+    {{- end -}}
+    """
+).encode()
+
+DEPLOYMENT = textwrap.dedent(
+    """
+    apiVersion: apps/v1
+    kind: Deployment
+    metadata:
+      name: {{ include "myapp.fullname" . }}
+      labels:
+        {{- include "myapp.labels" . | nindent 4 }}
+    spec:
+      replicas: {{ .Values.replicaCount }}
+      template:
+        spec:
+          containers:
+            - name: {{ .Chart.Name }}
+              image: "{{ .Values.image.repository }}:{{ .Values.image.tag | default .Chart.AppVersion }}"
+              securityContext:
+                privileged: {{ .Values.privileged }}
+              ports:
+                {{- range .Values.ports }}
+                - containerPort: {{ . }}
+                {{- end }}
+              {{- if .Values.resources }}
+              resources: {{- toYaml .Values.resources | nindent 16 }}
+              {{- else }}
+              resources: {}
+              {{- end }}
+    """
+).encode()
+
+
+def _chart_files():
+    return {
+        "Chart.yaml": CHART_YAML,
+        "values.yaml": VALUES_YAML,
+        "templates/_helpers.tpl": HELPERS,
+        "templates/deployment.yaml": DEPLOYMENT,
+    }
+
+
+def test_render_chart_basics():
+    import yaml as pyyaml
+
+    out = render_chart(_chart_files(), chart_root="myapp")
+    assert set(out) == {"templates/deployment.yaml"}
+    doc = pyyaml.safe_load(out["templates/deployment.yaml"])
+    assert doc["metadata"]["name"] == "myapp-myapp"  # include + printf + trunc
+    assert doc["metadata"]["labels"] == {"app": "myapp", "release": "myapp"}
+    assert doc["spec"]["replicas"] == 2
+    c = doc["spec"]["template"]["spec"]["containers"][0]
+    assert c["image"] == "nginx:2.1"  # default fell back to appVersion
+    assert c["securityContext"]["privileged"] is True
+    assert [p["containerPort"] for p in c["ports"]] == [80, 443]
+    assert c["resources"] == {}  # else-branch of the if
+
+
+def test_render_range_dict_and_with():
+    files = {
+        "Chart.yaml": b"name: c\nversion: 1.0.0\n",
+        "values.yaml": b"labels:\n  a: x\n  b: y\nnode: {}\n",
+        "templates/cm.yaml": textwrap.dedent(
+            """
+            apiVersion: v1
+            kind: ConfigMap
+            metadata:
+              name: cm
+              labels:
+                {{- range $k, $v := .Values.labels }}
+                {{ $k }}: {{ $v | quote }}
+                {{- end }}
+            data:
+              {{- with .Values.node }}
+              scoped: "unreachable-for-empty-map"
+              {{- else }}
+              scoped: "else-branch"
+              {{- end }}
+            """
+        ).encode(),
+    }
+    import yaml as pyyaml
+
+    out = render_chart(files, chart_root="c")
+    doc = pyyaml.safe_load(out["templates/cm.yaml"])
+    assert doc["metadata"]["labels"] == {"a": "x", "b": "y"}
+    assert doc["data"]["scoped"] == "else-branch"  # empty map is falsy
+
+
+def test_render_failures_skip_file():
+    files = _chart_files()
+    files["templates/broken.yaml"] = b"x: {{ include \"nope\" . }}\n"
+    out = render_chart(files, chart_root="myapp")
+    assert "templates/broken.yaml" not in out
+    assert "templates/deployment.yaml" in out  # others unaffected
+
+
+def test_find_charts_excludes_subcharts():
+    paths = [
+        "app/Chart.yaml",
+        "app/values.yaml",
+        "app/templates/d.yaml",
+        "app/charts/dep/Chart.yaml",
+        "app/charts/dep/templates/x.yaml",
+        "unrelated.yaml",
+    ]
+    charts = find_charts(paths)
+    assert set(charts) == {"app", "app/charts/dep"}
+    assert "app/charts/dep/templates/x.yaml" not in charts["app"]
+    assert "app/templates/d.yaml" in charts["app"]
+
+
+def test_helm_chart_ksv_checks_fire(tmp_path):
+    """End-to-end: a chart rendering a privileged container trips KSV-series
+    checks through the fs config scan."""
+    import contextlib
+    import io
+
+    from trivy_tpu.cli import main
+
+    chart = tmp_path / "repo" / "chart"
+    (chart / "templates").mkdir(parents=True)
+    (chart / "Chart.yaml").write_bytes(CHART_YAML)
+    (chart / "values.yaml").write_bytes(VALUES_YAML)
+    (chart / "templates" / "_helpers.tpl").write_bytes(HELPERS)
+    (chart / "templates" / "deployment.yaml").write_bytes(DEPLOYMENT)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(["config", "--format", "json", str(tmp_path / "repo")])
+    assert rc == 0
+    report = json.loads(buf.getvalue())
+    by_target = {
+        r["Target"]: [
+            m["ID"]
+            for m in r.get("Misconfigurations", [])
+            if m.get("Status") == "FAIL"
+        ]
+        for r in report["Results"] or []
+    }
+    target = "chart/templates/deployment.yaml"
+    assert target in by_target
+    # KSV017: privileged container (rendered from .Values.privileged)
+    assert "KSV017" in {i.split("-")[-1] for i in by_target[target]} or any(
+        "017" in i for i in by_target[target]
+    )

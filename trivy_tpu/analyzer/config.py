@@ -10,7 +10,9 @@ from trivy_tpu.analyzer.core import (
     AnalysisInput,
     AnalysisResult,
     Analyzer,
+    PostAnalyzer,
     register_analyzer,
+    register_post_analyzer,
 )
 from trivy_tpu.misconf.dockerfile import scan_dockerfile
 from trivy_tpu.misconf.kubernetes import scan_kubernetes
@@ -55,6 +57,17 @@ class KubernetesYamlAnalyzer(Analyzer):
         return AnalysisResult(misconfigs=[mc])
 
 
+def _scan_with_engine(inp: AnalysisInput) -> AnalysisResult | None:
+    """Shared routing body: content-sniffing engine scan, dropping empty
+    results (used by every engine-backed config analyzer)."""
+    from trivy_tpu.iac.engine import shared_scanner
+
+    mc = shared_scanner().scan(inp.file_path, inp.content)
+    if mc is None or (not mc.failures and not mc.successes):
+        return None
+    return AnalysisResult(misconfigs=[mc])
+
+
 class TerraformAnalyzer(Analyzer):
     """Route .tf files through the rego engine (the reference's terraform
     scanner seat, pkg/misconf/scanner.go:82-112)."""
@@ -69,12 +82,7 @@ class TerraformAnalyzer(Analyzer):
         return file_path.endswith((".tf", ".tf.json")) and size < 1 << 20
 
     def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
-        from trivy_tpu.iac.engine import shared_scanner
-
-        mc = shared_scanner().scan(inp.file_path, inp.content)
-        if mc is None or (not mc.failures and not mc.successes):
-            return None
-        return AnalysisResult(misconfigs=[mc])
+        return _scan_with_engine(inp)
 
 
 class ConfigJsonAnalyzer(Analyzer):
@@ -99,12 +107,7 @@ class ConfigJsonAnalyzer(Analyzer):
         )
 
     def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
-        from trivy_tpu.iac.engine import shared_scanner
-
-        mc = shared_scanner().scan(inp.file_path, inp.content)
-        if mc is None or (not mc.failures and not mc.successes):
-            return None
-        return AnalysisResult(misconfigs=[mc])
+        return _scan_with_engine(inp)
 
 
 class TomlConfigAnalyzer(Analyzer):
@@ -121,16 +124,66 @@ class TomlConfigAnalyzer(Analyzer):
         return file_path.endswith(".toml") and size < 1 << 20
 
     def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
-        from trivy_tpu.iac.engine import shared_scanner
+        return _scan_with_engine(inp)
 
-        mc = shared_scanner().scan(inp.file_path, inp.content)
-        if mc is None or (not mc.failures and not mc.successes):
+
+class HelmPostAnalyzer(PostAnalyzer):
+    """Helm chart scanning (pkg/iac/scanners/helm scanner.go): claims
+    Chart.yaml + values.yaml + templates/** into the composite FS, renders
+    each chart after the walk, and routes the manifests through the
+    kubernetes checks.  Needs the post-analyzer seat because rendering
+    requires the whole chart, not one file."""
+
+    def type(self) -> str:
+        return "helm"
+
+    def version(self) -> int:
+        return 1
+
+    def required(self, file_path: str, size: int, mode: int) -> bool:
+        if size >= 1 << 20:  # everything claimed here lands in MapFS whole
+            return False
+        name = file_path.rsplit("/", 1)[-1]
+        if name in ("Chart.yaml", "values.yaml"):
+            return True
+        return "templates/" in file_path and name.endswith(
+            (".yaml", ".yml", ".tpl")
+        )
+
+    def post_analyze(self, fs) -> AnalysisResult | None:
+        from trivy_tpu.iac.engine import shared_scanner
+        from trivy_tpu.iac.helm import HelmError, find_charts, render_chart
+
+        charts = find_charts(fs.paths())
+        if not charts:
             return None
-        return AnalysisResult(misconfigs=[mc])
+        misconfigs = []
+        for root, members in charts.items():
+            prefix = root + "/" if root else ""
+            files = {p[len(prefix) :]: fs.read(p) for p in members}
+            try:
+                rendered = render_chart(files, chart_root=root)
+            except HelmError as e:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "helm chart %s failed to render: %s", root or ".", e
+                )
+                continue
+            for rel_path, text in rendered.items():
+                full = prefix + rel_path
+                mc = shared_scanner().scan(full, text.encode())
+                if mc is not None and (mc.failures or mc.successes):
+                    mc.file_type = "helm"
+                    misconfigs.append(mc)
+        if not misconfigs:
+            return None
+        return AnalysisResult(misconfigs=misconfigs)
 
 
 register_analyzer(DockerfileAnalyzer)
 register_analyzer(ConfigJsonAnalyzer)
 register_analyzer(TomlConfigAnalyzer)
+register_post_analyzer(HelmPostAnalyzer)
 register_analyzer(KubernetesYamlAnalyzer)
 register_analyzer(TerraformAnalyzer)

@@ -122,6 +122,7 @@ def _analyzer_options(options: Options, target_kind: str) -> AnalyzerOptions:
                 "terraform",
                 "config-json",
                 "config-toml",
+                "helm",
             ]
         )
     if "rekor" not in (getattr(options, "sbom_sources", []) or []):

@@ -21,7 +21,8 @@ ingress_rules[pair] {
 
 deny[res] {
     some pair in ingress_rules
-    cidr := object.get(pair.rule, "CidrIp", object.get(pair.rule, "CidrIpv6", ""))
+    some field in ["CidrIp", "CidrIpv6"]
+    cidr := object.get(pair.rule, field, "")
     cidr in ["0.0.0.0/0", "::/0"]
     res := result.new(sprintf("Security group %q allows ingress from %s", [pair.name, cidr]), pair.rule)
 }

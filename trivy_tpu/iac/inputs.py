@@ -41,6 +41,10 @@ def detect_type(file_path: str, content: bytes) -> str | None:
     if name.endswith((".tf", ".tf.json")):
         return "terraform"
     if name.endswith((".yaml", ".yml")):
+        # Unrendered helm templates also reach here; they fail the YAML
+        # parse downstream and produce nothing, while the helm
+        # post-analyzer rescans their rendered form (the applier dedupes
+        # by file path if a template happens to be valid YAML as-is).
         if b"apiVersion" in content and b"kind" in content:
             return "kubernetes"
         if b"Resources" in content and (
@@ -305,11 +309,16 @@ def tfplan_input(content: bytes) -> dict[str, Any] | None:
 
     def walk(module: dict[str, Any]) -> None:
         for res in module.get("resources") or []:
+            if res.get("mode") == "data":
+                continue  # data sources are reads, not planned resources
             rtype, name = res.get("type"), res.get("name")
             values = res.get("values")
             if not rtype or not name or not isinstance(values, dict):
                 continue
-            resources.setdefault(rtype, {})[name] = values
+            # Key by the unique address: the same type+name recurs across
+            # module instances and must not overwrite.
+            key = res.get("address") or name
+            resources.setdefault(rtype, {})[key] = values
         for child in module.get("child_modules") or []:
             walk(child)
 
