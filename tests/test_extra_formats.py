@@ -199,3 +199,120 @@ def test_image_config_secret_and_history(tmp_path):
         )
     }
     assert "DS010" in mc_ids  # sudo in history RUN
+
+
+def test_csaf_vex_suppression(tmp_path):
+    """CSAF VEX: CVE match + product_status.known_not_affected product ->
+    product-tree purl (versionless covers all versions)."""
+    from trivy_tpu.result.filter import FilterOptions, filter_report
+
+    csaf = {
+        "document": {"category": "csaf_vex", "title": "t"},
+        "product_tree": {
+            "branches": [{
+                "branches": [{
+                    "product": {
+                        "product_id": "LODASH",
+                        "name": "lodash",
+                        "product_identification_helper": {
+                            "purl": "pkg:npm/lodash"
+                        },
+                    },
+                }],
+            }],
+            "relationships": [{
+                "category": "default_component_of",
+                "product_reference": "LODASH",
+                "full_product_name": {"product_id": "LODASH-IN-APP"},
+            }],
+        },
+        "vulnerabilities": [{
+            "cve": "CVE-2099-1000",
+            "product_status": {"known_not_affected": ["LODASH-IN-APP"]},
+        }],
+    }
+    path = tmp_path / "csaf.json"
+    path.write_text(json.dumps(csaf))
+    report = filter_report(_vuln_report(), FilterOptions(vex_path=str(path)))
+    ids = [v.vulnerability_id for v in report.results[0].vulnerabilities]
+    assert ids == ["CVE-2099-2000"]
+
+
+def test_amazon_and_mariner_release_analyzers():
+    from trivy_tpu.analyzer.core import AnalysisInput
+    from trivy_tpu.analyzer.os_release import (
+        AmazonReleaseAnalyzer,
+        MarinerReleaseAnalyzer,
+    )
+
+    def inp(path, content):
+        return AnalysisInput("", path, len(content), 0o644, content)
+
+    a = AmazonReleaseAnalyzer()
+    assert a.required("etc/system-release", 10, 0o644)
+    assert a.required("usr/lib/system-release", 10, 0o644)
+    assert not a.required("etc/os-release", 10, 0o644)
+    res = a.analyze(inp("etc/system-release", b"Amazon Linux release 2 (Karoo)\n"))
+    assert (res.os.family, res.os.name) == ("amazon", "2 (Karoo)")
+    res = a.analyze(inp("usr/lib/system-release", b"Amazon Linux 2023.3.20240108\n"))
+    assert (res.os.family, res.os.name) == ("amazon", "2023.3.20240108")
+
+    m = MarinerReleaseAnalyzer()
+    res = m.analyze(inp("etc/mariner-release", b"CBL-Mariner 2.0.20231004\n"))
+    assert (res.os.family, res.os.name) == ("cbl-mariner", "2.0.20231004")
+
+
+def test_amazon_bucket_forms():
+    """AL2 codename and AL2023 'release' strings both land in working
+    advisory buckets (first-whitespace-field stripping)."""
+    from trivy_tpu.analyzer.core import AnalysisInput
+    from trivy_tpu.analyzer.os_release import AmazonReleaseAnalyzer
+    from trivy_tpu.detector.ospkg import _release_bucket
+
+    def name_of(content):
+        a = AmazonReleaseAnalyzer()
+        return a.analyze(
+            AnalysisInput("", "etc/system-release", len(content), 0o644, content)
+        ).os.name
+
+    assert name_of(b"Amazon Linux release 2 (Karoo)\n") == "2 (Karoo)"
+    assert (
+        name_of(b"Amazon Linux release 2023.3.20240108\n") == "2023.3.20240108"
+    )
+    assert _release_bucket("amazon", "2 (Karoo)", 1) == "amazon 2"
+    assert _release_bucket("amazon", "2023.3.20240108", 1) == "amazon 2023"
+
+
+def test_csaf_relationship_chain_fixpoint(tmp_path):
+    """Chained + forward-referenced relationships resolve regardless of
+    document order."""
+    from trivy_tpu.result.vex import load_vex
+
+    csaf = {
+        "document": {"category": "csaf_vex"},
+        "product_tree": {
+            "branches": [{
+                "product": {
+                    "product_id": "PKG",
+                    "name": "lodash",
+                    "product_identification_helper": {"purl": "pkg:npm/lodash"},
+                },
+            }],
+            "relationships": [
+                # forward reference: outer listed before the link it needs
+                {"product_reference": "PKG-IN-MODULE",
+                 "full_product_name": {"product_id": "PKG-IN-STREAM"}},
+                {"product_reference": "PKG",
+                 "full_product_name": {"product_id": "PKG-IN-MODULE"}},
+            ],
+        },
+        "vulnerabilities": [{
+            "cve": "CVE-2099-1000",
+            "product_status": {"known_not_affected": ["PKG-IN-STREAM"]},
+        }],
+    }
+    path = tmp_path / "chain.json"
+    path.write_text(json.dumps(csaf))
+    doc = load_vex(str(path))
+    assert doc.suppressed("CVE-2099-1000", "pkg:npm/lodash@4.17.20")
+    assert not doc.suppressed("CVE-2099-2000", "pkg:npm/lodash@4.17.20")

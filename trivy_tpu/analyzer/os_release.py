@@ -191,8 +191,63 @@ class RedHatReleaseAnalyzer(Analyzer):
         return None
 
 
+class AmazonReleaseAnalyzer(Analyzer):
+    """analyzer/os/amazonlinux/amazonlinux.go — etc/system-release (AL1/2)
+    or usr/lib/system-release (AL2022/2023); version text follows the
+    'Amazon Linux [release]' prefix."""
+
+    REQUIRED = {"etc/system-release", "usr/lib/system-release"}
+
+    def type(self) -> str:
+        return "amazon"
+
+    def version(self) -> int:
+        return 1
+
+    def required(self, file_path: str, size: int, mode: int) -> bool:
+        return file_path in self.REQUIRED
+
+    def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
+        for line in inp.content.decode("utf-8", "replace").splitlines():
+            fields = line.split()
+            if not line.startswith("Amazon Linux") or len(fields) < 3:
+                continue
+            # "Amazon Linux release 2 (Karoo)" / "Amazon Linux release
+            # 2023.3.x" -> version after 'release'; "Amazon Linux 2023.x"
+            # (AL2022/2023 usr/lib form) has no 'release' token.
+            if fields[2] == "release" and len(fields) >= 4:
+                name = " ".join(fields[3:])
+            else:
+                name = " ".join(fields[2:])
+            return AnalysisResult(os=OS(family=AMAZON, name=name))
+        return None
+
+
+class MarinerReleaseAnalyzer(Analyzer):
+    """analyzer/os/mariner/mariner.go — etc/mariner-release:
+    'CBL-Mariner <version>'."""
+
+    def type(self) -> str:
+        return "cbl-mariner"
+
+    def version(self) -> int:
+        return 1
+
+    def required(self, file_path: str, size: int, mode: int) -> bool:
+        return file_path == "etc/mariner-release"
+
+    def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
+        for line in inp.content.decode("utf-8", "replace").splitlines():
+            fields = line.split()
+            if line.startswith("CBL-Mariner") and len(fields) >= 2:
+                return AnalysisResult(os=OS(family=MARINER, name=fields[1]))
+        return None
+
+
 register_analyzer(OSReleaseAnalyzer)
 register_analyzer(AlpineReleaseAnalyzer)
 register_analyzer(DebianVersionAnalyzer)
 register_analyzer(LsbReleaseAnalyzer)
 register_analyzer(RedHatReleaseAnalyzer)
+register_analyzer(AmazonReleaseAnalyzer)
+register_analyzer(MarinerReleaseAnalyzer)

@@ -38,6 +38,10 @@ _DRIVERS: dict[str, tuple[str, str, int]] = {
 def _release_bucket(prefix: str, name: str, precision: int) -> str:
     if precision == 0:
         return prefix
+    # Codename suffixes ("2 (Karoo)") and 'release N' forms never reach
+    # the bucket: the reference strips to the first whitespace field
+    # before versioning (amazon driver strings.Fields(osVer)[0]).
+    name = name.split()[0] if name.split() else name
     parts = name.split(".")
     return f"{prefix} {'.'.join(parts[:precision])}"
 
