@@ -64,6 +64,44 @@ DESCRIBE_INSTANCES = """<?xml version="1.0"?>
 </DescribeInstancesResponse>"""
 
 
+DESCRIBE_VOLUMES = """<?xml version="1.0"?>
+<DescribeVolumesResponse>
+  <volumeSet><item>
+    <volumeId>vol-01</volumeId>
+    <encrypted>false</encrypted>
+  </item></volumeSet>
+</DescribeVolumesResponse>"""
+
+DESCRIBE_SGS = """<?xml version="1.0"?>
+<DescribeSecurityGroupsResponse>
+  <securityGroupInfo><item>
+    <groupId>sg-01</groupId>
+    <ipPermissions><item>
+      <ipRanges><item><cidrIp>0.0.0.0/0</cidrIp></item></ipRanges>
+    </item></ipPermissions>
+  </item></securityGroupInfo>
+</DescribeSecurityGroupsResponse>"""
+
+DESCRIBE_DBS = """<?xml version="1.0"?>
+<DescribeDBInstancesResponse>
+  <DescribeDBInstancesResult><DBInstances>
+    <DBInstance>
+      <DBInstanceIdentifier>maindb</DBInstanceIdentifier>
+      <StorageEncrypted>false</StorageEncrypted>
+      <PubliclyAccessible>true</PubliclyAccessible>
+    </DBInstance>
+  </DBInstances></DescribeDBInstancesResult>
+</DescribeDBInstancesResponse>"""
+
+PASSWORD_POLICY = """<?xml version="1.0"?>
+<GetAccountPasswordPolicyResponse>
+  <GetAccountPasswordPolicyResult><PasswordPolicy>
+    <MinimumPasswordLength>8</MinimumPasswordLength>
+    <RequireSymbols>false</RequireSymbols>
+  </PasswordPolicy></GetAccountPasswordPolicyResult>
+</GetAccountPasswordPolicyResponse>"""
+
+
 class _FakeAws(BaseHTTPRequestHandler):
     def log_message(self, *a):
         pass
@@ -79,6 +117,14 @@ class _FakeAws(BaseHTTPRequestHandler):
         path, _, query = self.path.partition("?")
         if path == "/" and "Action=DescribeInstances" in query:
             return self._send(DESCRIBE_INSTANCES)
+        if path == "/" and "Action=DescribeVolumes" in query:
+            return self._send(DESCRIBE_VOLUMES)
+        if path == "/" and "Action=DescribeSecurityGroups" in query:
+            return self._send(DESCRIBE_SGS)
+        if path == "/" and "Action=DescribeDBInstances" in query:
+            return self._send(DESCRIBE_DBS)
+        if path == "/" and "Action=GetAccountPasswordPolicy" in query:
+            return self._send(PASSWORD_POLICY)
         if path == "/":
             return self._send(LIST_BUCKETS)
         if path == "/public-logs" and query == "acl":
@@ -158,3 +204,40 @@ def test_aws_cli_surface(aws_endpoint):
         for m in r.get("Failures", [])
     }
     assert "AVD-AWS-0086" in ids
+
+
+def test_rds_and_iam_adapters(aws_endpoint):
+    scanner = AwsScanner(services=["rds", "iam"], endpoint=aws_endpoint)
+    results = scanner.scan()
+    assert results
+    ids = {f.check_id for mc in results for f in mc.failures}
+    # unencrypted + public RDS, weak password policy
+    assert {"AVD-AWS-0080", "AVD-AWS-0180", "AVD-AWS-0063"} <= ids
+
+
+def test_ec2_volumes_and_security_groups(aws_endpoint):
+    scanner = AwsScanner(services=["ec2"], endpoint=aws_endpoint)
+    results = scanner.scan()
+    ids = {f.check_id for mc in results for f in mc.failures}
+    assert "AVD-AWS-0026" in ids  # unencrypted vol-01
+    assert "AVD-AWS-0107" in ids  # sg-01 open to the world
+
+
+def test_ec2_partial_permissions_degrade(aws_endpoint, monkeypatch):
+    """A 403 on one Describe call records an error and keeps the rest."""
+    from trivy_tpu.cloud.aws import _AwsApi
+
+    orig = _AwsApi.call
+
+    def flaky(self, method, path_and_query):
+        if "DescribeVolumes" in path_and_query:
+            raise AwsError("403 AccessDenied")
+        return orig(self, method, path_and_query)
+
+    monkeypatch.setattr(_AwsApi, "call", flaky)
+    scanner = AwsScanner(services=["ec2"], endpoint=aws_endpoint)
+    results = scanner.scan()
+    ids = {f.check_id for mc in results for f in mc.failures}
+    assert "AVD-AWS-0107" in ids  # SGs still scanned
+    assert "AVD-AWS-0026" not in ids  # volumes skipped...
+    assert any("DescribeVolumes" in e for e in scanner.errors)  # ...loudly

@@ -25,7 +25,7 @@ from trivy_tpu.cache.s3 import S3Client, S3Error
 
 logger = logging.getLogger(__name__)
 
-SUPPORTED_SERVICES = ("s3", "ec2")
+SUPPORTED_SERVICES = ("s3", "ec2", "rds", "iam")
 
 
 class AwsError(RuntimeError):
@@ -140,14 +140,15 @@ class AwsScanner:
         return False
 
     def adapt_ec2(self, api: _AwsApi) -> dict:
-        """DescribeInstances -> aws_instance resources.
+        """DescribeInstances/Volumes/SecurityGroups -> aws_instance /
+        aws_ebs_volume / aws_security_group resources.
 
         Traversal uses DIRECT children only: real responses nest further
         <item>/<instanceId> elements under networkInterfaceSet, and a
-        deep .iter() search would let those overwrite the instance doc."""
-        root = api.call("GET", "/?Action=DescribeInstances&Version=2016-11-15")
-        if root is None:
-            return {}
+        deep .iter() search would let those overwrite the instance doc.
+        Each Describe call degrades independently (adapt_s3's contract): a
+        role missing one permission still scans what the others return,
+        with the gap recorded in self.errors."""
 
         def children(el, name):
             return [c for c in list(el) if _strip_ns(c.tag) == name]
@@ -156,8 +157,21 @@ class AwsScanner:
             got = children(el, name)
             return got[0] if got else None
 
+        def call(action: str):
+            try:
+                return api.call(
+                    "GET", f"/?Action={action}&Version=2016-11-15"
+                )
+            except AwsError as e:
+                logger.warning("ec2 %s: %s", action, e)
+                self.errors.append(f"ec2 {action}: {e}")
+                return None
+
+        out: dict = {}
+
+        root = call("DescribeInstances")
         instances: dict[str, dict] = {}
-        for rset in children(root, "reservationSet"):
+        for rset in children(root, "reservationSet") if root is not None else []:
             for res_item in children(rset, "item"):
                 for iset in children(res_item, "instancesSet"):
                     for item in children(iset, "item"):
@@ -176,7 +190,104 @@ class AwsScanner:
                             else "optional"
                         }
                         instances[iid.text] = doc
-        return {"aws_instance": instances} if instances else {}
+        if instances:
+            out["aws_instance"] = instances
+
+        vroot = call("DescribeVolumes")
+        volumes: dict[str, dict] = {}
+        for vset in children(vroot, "volumeSet") if vroot is not None else []:
+            for item in children(vset, "item"):
+                vid = child(item, "volumeId")
+                if vid is None or not vid.text:
+                    continue
+                enc = child(item, "encrypted")
+                volumes[vid.text] = {
+                    "encrypted": enc is not None and enc.text == "true"
+                }
+        if volumes:
+            out["aws_ebs_volume"] = volumes
+
+        sroot = call("DescribeSecurityGroups")
+        groups: dict[str, dict] = {}
+        srets = children(sroot, "securityGroupInfo") if sroot is not None else []
+        for gset in srets:
+            for item in children(gset, "item"):
+                # Explicit None test: leaf Elements are falsy, so
+                # `a or b` would discard a found groupId.
+                gid = child(item, "groupId")
+                if gid is None:
+                    gid = child(item, "groupName")
+                if gid is None or not gid.text:
+                    continue
+                ingress = []
+                for perms in children(item, "ipPermissions"):
+                    for perm in children(perms, "item"):
+                        cidrs = []
+                        for set_tag, ip_tag in (
+                            ("ipRanges", "cidrIp"),
+                            ("ipv6Ranges", "cidrIpv6"),
+                        ):
+                            for rset in children(perm, set_tag):
+                                for r in children(rset, "item"):
+                                    ip = child(r, ip_tag)
+                                    if ip is not None and ip.text:
+                                        cidrs.append(ip.text)
+                        if cidrs:
+                            ingress.append({"cidr_blocks": cidrs})
+                groups[gid.text] = {"ingress": ingress}
+        if groups:
+            out["aws_security_group"] = groups
+        return out
+
+    def adapt_rds(self, api: _AwsApi) -> dict:
+        """DescribeDBInstances -> aws_db_instance resources (the cloud
+        adapter feeds the same fields the terraform corpus checks:
+        storage_encrypted, publicly_accessible)."""
+        root = api.call("GET", "/?Action=DescribeDBInstances&Version=2014-10-31")
+        if root is None:
+            return {}
+        dbs: dict[str, dict] = {}
+        for item in root.iter():
+            if _strip_ns(item.tag) != "DBInstance":
+                continue
+            ident = _find(item, "DBInstanceIdentifier")
+            if ident is None or not ident.text:
+                continue
+            enc = _find(item, "StorageEncrypted")
+            pub = _find(item, "PubliclyAccessible")
+            dbs[ident.text] = {
+                "storage_encrypted": (enc is not None and enc.text == "true"),
+                "publicly_accessible": (pub is not None and pub.text == "true"),
+            }
+        return {"aws_db_instance": dbs} if dbs else {}
+
+    def adapt_iam(self, api: _AwsApi) -> dict:
+        """GetAccountPasswordPolicy -> aws_iam_account_password_policy.
+
+        An account with no policy set must FAIL the password-policy check,
+        not vanish: AWS answers NoSuchEntity, which adapts to an empty
+        policy document (every minimum is unset)."""
+        try:
+            root = api.call(
+                "GET", "/?Action=GetAccountPasswordPolicy&Version=2010-05-08"
+            )
+        except AwsError as e:
+            if "NoSuchEntity" not in str(e):
+                raise
+            root = None
+        policy: dict = {}
+        if root is not None:
+            for el in root.iter():
+                tag = _strip_ns(el.tag)
+                if tag == "MinimumPasswordLength" and el.text:
+                    policy["minimum_password_length"] = int(el.text)
+                elif tag == "RequireSymbols":
+                    policy["require_symbols"] = el.text == "true"
+                elif tag == "RequireNumbers":
+                    policy["require_numbers"] = el.text == "true"
+                elif tag == "MaxPasswordAge" and el.text:
+                    policy["max_password_age"] = int(el.text)
+        return {"aws_iam_account_password_policy": {"account": policy}}
 
     # -- scan --------------------------------------------------------------
 

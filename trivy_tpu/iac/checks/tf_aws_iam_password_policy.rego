@@ -1,0 +1,13 @@
+# METADATA
+# title: IAM password policy minimum length below 14
+# custom:
+#   id: AVD-AWS-0063
+#   severity: MEDIUM
+#   recommended_action: Require passwords of at least 14 characters.
+package builtin.terraform.AWS0063
+
+deny[res] {
+    some name, p in object.get(object.get(input, "resource", {}), "aws_iam_account_password_policy", {})
+    object.get(p, "minimum_password_length", 0) < 14
+    res := result.new(sprintf("IAM password policy %q allows passwords shorter than 14 characters", [name]), p)
+}

@@ -1,0 +1,13 @@
+# METADATA
+# title: RDS instance is publicly accessible
+# custom:
+#   id: AVD-AWS-0180
+#   severity: HIGH
+#   recommended_action: Set publicly_accessible = false.
+package builtin.terraform.AWS0180
+
+deny[res] {
+    some name, db in object.get(object.get(input, "resource", {}), "aws_db_instance", {})
+    object.get(db, "publicly_accessible", false) == true
+    res := result.new(sprintf("RDS instance %q is publicly accessible", [name]), db)
+}
