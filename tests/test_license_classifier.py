@@ -1,0 +1,154 @@
+"""Tests: full-text license classification (hashed-trigram similarity)."""
+
+import os
+
+import pytest
+
+from trivy_tpu.analyzer.core import AnalysisInput
+from trivy_tpu.analyzer.license import LicenseFileAnalyzer
+from trivy_tpu.license import FullTextClassifier, shared_classifier
+
+needs_system_corpus = pytest.mark.skipif(
+    not os.path.isdir("/usr/share/common-licenses"),
+    reason="system license corpus not present (non-Debian host)",
+)
+
+
+@pytest.fixture(scope="module")
+def clf():
+    return shared_classifier()
+
+
+def test_exact_texts_classify_with_high_confidence(clf):
+    from trivy_tpu.license.classifier import _EMBEDDED
+
+    for spdx, text in _EMBEDDED.items():
+        m = clf.classify("Copyright (c) 2024 Acme Corp\n" + text)
+        assert m is not None and m.license == spdx, (spdx, m)
+        assert m.confidence > 0.95
+
+
+@needs_system_corpus
+def test_system_corpus_loaded(clf):
+    # /usr/share/common-licenses provides the long copyleft texts
+    assert "Apache-2.0" in clf.names
+    assert "GPL-3.0" in clf.names
+    with open("/usr/share/common-licenses/Apache-2.0", encoding="utf-8") as f:
+        text = f.read()
+    m = clf.classify(text)
+    assert m.license == "Apache-2.0"
+
+
+def test_edited_text_still_matches(clf):
+    """Realistic variation: custom copyright line + project name spliced
+    into the MIT wording still classifies as MIT."""
+    from trivy_tpu.license.classifier import _EMBEDDED
+
+    text = (
+        "The MIT License (MIT)\n"
+        "Copyright (c) 2019-2024 The FooBar Project Contributors\n"
+        + _EMBEDDED["MIT"].replace("the Software", "FooBar")
+    )
+    m = clf.classify(text)
+    assert m is not None and m.license == "MIT"
+
+
+def test_unrelated_text_is_rejected(clf):
+    assert clf.classify("the quick brown fox jumps over the lazy dog " * 50) is None
+    assert clf.classify("") is None
+
+
+def test_mit_vs_isc_disambiguation(clf):
+    """Both are short permissive texts sharing phrases; trigram histograms
+    keep them apart."""
+    from trivy_tpu.license.classifier import _EMBEDDED
+
+    assert clf.classify(_EMBEDDED["ISC"]).license == "ISC"
+    assert clf.classify(_EMBEDDED["MIT"]).license == "MIT"
+    assert clf.classify(_EMBEDDED["BSD-2-Clause"]).license == "BSD-2-Clause"
+    assert clf.classify(_EMBEDDED["BSD-3-Clause"]).license == "BSD-3-Clause"
+
+
+@needs_system_corpus
+def test_batch_analyzer_path():
+    from trivy_tpu.license.classifier import _EMBEDDED
+
+    a = LicenseFileAnalyzer()
+    inputs = [
+        AnalysisInput("", "LICENSE", 10, 0o644, _EMBEDDED["MIT"].encode()),
+        AnalysisInput(
+            "", "pkg/COPYING", 10, 0o644,
+            open("/usr/share/common-licenses/GPL-2", "rb").read(),
+        ),
+        # phrase-sieve fallback: truncated apache header text
+        AnalysisInput(
+            "", "vendor_license.txt", 10, 0o644,
+            b"Licensed under the Apache License, Version 2.0 (the License)",
+        ),
+    ]
+    res = a.analyze_batch(inputs)
+    by_path = {lf.file_path: lf.findings[0].name for lf in res.licenses}
+    assert by_path["LICENSE"] == "MIT"
+    assert by_path["pkg/COPYING"] == "GPL-2.0"
+    assert by_path["vendor_license.txt"] == "Apache-2.0"
+    mit = [lf for lf in res.licenses if lf.file_path == "LICENSE"][0]
+    assert mit.findings[0].category == "notice"
+
+
+def test_extra_corpus():
+    clf = FullTextClassifier(extra={"MyLic-1.0": "totally custom words " * 40})
+    assert clf.classify("totally custom words " * 40).license == "MyLic-1.0"
+
+
+@needs_system_corpus
+def test_agpl_not_shadowed_by_gpl_corpus():
+    """AGPL-3.0 is absent from the full-text corpus and ~0.98 cosine to
+    GPL-3.0; the phrase sieve's corpus-blind answer must win."""
+    with open("/usr/share/common-licenses/GPL-3", encoding="utf-8") as f:
+        gpl3 = f.read()
+    agplish = (
+        gpl3.replace(
+            "GNU General Public License", "GNU Affero General Public License"
+        )
+        + "\n13. Remote Network Interaction; Use with the GNU General"
+        " Public License.\n"
+    )
+    a = LicenseFileAnalyzer()
+    res = a.analyze_batch(
+        [AnalysisInput("", "LICENSE", 10, 0o644, agplish.encode())]
+    )
+    assert res.licenses[0].findings[0].name == "AGPL-3.0"
+
+
+@needs_system_corpus
+def test_mpl_mentioning_agpl_is_not_vetoed():
+    """MPL-2.0's Secondary Licenses clause names the AGPL; the verbatim
+    corpus match must survive the corpus-blind veto."""
+    with open("/usr/share/common-licenses/MPL-2.0", encoding="utf-8") as f:
+        mpl = f.read()
+    a = LicenseFileAnalyzer()
+    res = a.analyze_batch(
+        [AnalysisInput("", "COPYING", 10, 0o644, mpl.encode())]
+    )
+    assert res.licenses[0].findings[0].name == "MPL-2.0"
+
+
+def test_batch_analyzer_crash_does_not_abort_scan(tmp_path, monkeypatch):
+    """core dispatch tolerates a batch-analyzer exception (one slice lost,
+    scan continues)."""
+    from trivy_tpu.analyzer.core import AnalyzerGroup, AnalyzerOptions
+    from trivy_tpu.artifact.local import LocalArtifact
+    from trivy_tpu.cache.store import MemoryCache
+    from trivy_tpu.analyzer.license import LicenseFileAnalyzer as LFA
+
+    monkeypatch.setattr(
+        LFA, "analyze_batch",
+        lambda self, inputs: (_ for _ in ()).throw(RuntimeError("boom")),
+    )
+    (tmp_path / "LICENSE").write_text("MIT stuff")
+    (tmp_path / "requirements.txt").write_text("requests==2.0.0\n")
+    art = LocalArtifact(str(tmp_path), MemoryCache(), analyzer_options=AnalyzerOptions())
+    ref = art.inspect()  # must not raise
+    blob = art.cache.get_blob(ref.blob_ids[0])
+    assert any(a.app_type == "pip" for a in blob.applications)
+    assert not blob.licenses  # the failed slice is lost, loudly logged

@@ -346,7 +346,20 @@ class AnalyzerGroup:
                 # streams per file; we stream per device-batch).
                 for slice_entries in _byte_bounded(batch, MAX_BATCH_BYTES):
                     inputs = _read_inputs(dir, slice_entries)
-                    result.merge(a.analyze_batch(inputs))
+                    try:
+                        result.merge(a.analyze_batch(inputs))
+                    except deadline.ScanTimeoutError:
+                        raise  # --timeout must stop the scan, not log on
+                    except Exception:
+                        # Same per-file tolerance the non-batch path has
+                        # (analyzer.go:415-417): one failing slice must not
+                        # abort the scan; its files are lost, loudly.
+                        logger.warning(
+                            "batch analyzer %s failed on a %d-file slice",
+                            a.type(),
+                            len(inputs),
+                            exc_info=True,
+                        )
             else:
                 for entry in batch:
                     inputs = _read_inputs(dir, [entry])

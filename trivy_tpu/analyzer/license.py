@@ -1,10 +1,12 @@
 """License analyzers.
 
 Mirrors pkg/fanal/analyzer/licensing/ (license-file analyzer) and
-pkg/licensing/classifier.go — but instead of google/licenseclassifier's
-full-text model, classification uses distinctive normalized phrases per SPDX
-license (a keyword-sieve design, same shape as the secret engine's probe
-pass: cheap necessary-condition matching, host confirmation by phrase count).
+pkg/licensing/classifier.go with a two-tier design: the primary
+classifier is the batched full-text similarity matmul in
+trivy_tpu/license/classifier.py (the licenseclassifier analogue), and
+the distinctive-phrase sieve below is the fallback for texts under the
+confidence threshold plus the corpus-blind veto for licenses the
+full-text corpus cannot represent (e.g. AGPL-3.0 vs GPL-3.0).
 """
 
 from __future__ import annotations
@@ -15,6 +17,7 @@ from trivy_tpu.analyzer.core import (
     AnalysisInput,
     AnalysisResult,
     Analyzer,
+    BatchAnalyzer,
     register_analyzer,
 )
 from trivy_tpu.ltypes import LICENSE_TYPE_FILE, LicenseFile, LicenseFinding
@@ -30,7 +33,10 @@ SKIP_DIRS = {"node_modules", ".git", "vendor"}
 # Each entry: (SPDX id, [phrases — ALL must appear]).
 _PHRASES: list[tuple[str, list[str]]] = [
     ("Apache-2.0", ["apache license", "version 2.0"]),
-    ("AGPL-3.0", ["gnu affero general public license", "version 3"]),
+    # "remote network interaction" is AGPL-3.0's own section 13 heading;
+    # the license NAME appears in GPL-3.0 section 13 and MPL-2.0's
+    # Secondary Licenses clause, so it cannot distinguish on its own.
+    ("AGPL-3.0", ["gnu affero general public license", "remote network interaction"]),
     ("LGPL-3.0", ["gnu lesser general public license", "version 3"]),
     ("LGPL-2.1", ["gnu lesser general public license", "version 2.1"]),
     ("GPL-3.0", ["gnu general public license", "version 3"]),
@@ -71,9 +77,9 @@ def normalize(text: str) -> str:
     return re.sub(r"\s+", " ", text.lower())
 
 
-def classify(content: bytes) -> list[LicenseFinding]:
+def classify_text(text: str) -> list[LicenseFinding]:
     """pkg/licensing/classifier.go Classify, phrase-based."""
-    text = normalize(content.decode("utf-8", errors="replace"))
+    text = normalize(text)
     findings = []
     for spdx_id, phrases in _PHRASES:
         if all(p in text for p in phrases):
@@ -82,14 +88,31 @@ def classify(content: bytes) -> list[LicenseFinding]:
     return findings
 
 
-class LicenseFileAnalyzer(Analyzer):
-    """analyzer/licensing/license.go."""
+def classify(content: bytes) -> list[LicenseFinding]:
+    return classify_text(content.decode("utf-8", errors="replace"))
+
+
+class LicenseFileAnalyzer(BatchAnalyzer):
+    """analyzer/licensing/license.go + pkg/licensing/classifier.go.
+
+    Batch-first: every claimed license file in the scan classifies in ONE
+    hashed-trigram similarity matmul (trivy_tpu/license/classifier.py) —
+    the full-text analogue of google/licenseclassifier — with the phrase
+    sieve as fallback for texts below the confidence threshold (heavily
+    edited or truncated license files)."""
 
     def type(self) -> str:
         return "license-file"
 
     def version(self) -> int:
-        return 1
+        # v1 was the phrase sieve alone.  The classification outcome also
+        # depends on the host's license corpus (/usr/share/common-licenses
+        # presence and contents), so the corpus digest participates in the
+        # version — two hosts with different corpora must not share
+        # cached blobs for the same artifact.
+        from trivy_tpu.license import shared_classifier
+
+        return 2_000_000 + shared_classifier().corpus_digest % 1_000_000
 
     def required(self, file_path: str, size: int, mode: int) -> bool:
         parts = file_path.split("/")
@@ -97,19 +120,56 @@ class LicenseFileAnalyzer(Analyzer):
             return False
         return bool(_LICENSE_FILE_RE.match(parts[-1])) and size < 1 << 20
 
-    def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
-        findings = classify(inp.content)
-        if not findings:
+    def analyze_batch(self, inputs: list) -> AnalysisResult | None:
+        if not inputs:
             return None
-        return AnalysisResult(
-            licenses=[
+        from trivy_tpu.license import shared_classifier
+
+        clf = shared_classifier()
+        texts = [
+            inp.content.decode("utf-8", errors="replace") for inp in inputs
+        ]
+        matches = clf.classify_batch(texts)
+        licenses = []
+        for inp, text, match in zip(inputs, texts, matches):
+            if match is not None and match.confidence >= 0.99:
+                # Essentially-exact corpus match: the phrase sieve can
+                # add nothing (a verbatim corpus text merely MENTIONING
+                # another license must not be vetoed) — skip its pass.
+                findings = [
+                    LicenseFinding.of(match.license, confidence=match.confidence)
+                ]
+            else:
+                phrase = classify_text(text)
+                if match is None:
+                    findings = phrase
+                # Corpus-blind veto: licenses absent from the full-text
+                # corpus score high against near-identical relatives
+                # (AGPL-3.0 vs GPL-3.0 is ~0.98 cosine).  When the phrase
+                # sieve names a license the corpus cannot represent, its
+                # more specific answer wins.
+                elif (
+                    phrase
+                    and phrase[0].name != match.license
+                    and phrase[0].name not in clf.names
+                ):
+                    findings = phrase
+                else:
+                    findings = [
+                        LicenseFinding.of(
+                            match.license, confidence=match.confidence
+                        )
+                    ]
+            if not findings:
+                continue
+            licenses.append(
                 LicenseFile(
                     license_type=LICENSE_TYPE_FILE,
                     file_path=inp.file_path,
                     findings=findings,
                 )
-            ]
-        )
+            )
+        return AnalysisResult(licenses=licenses) if licenses else None
 
 
 class DpkgLicenseAnalyzer(Analyzer):

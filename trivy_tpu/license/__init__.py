@@ -1,0 +1,4 @@
+from trivy_tpu.license.classifier import (  # noqa: F401
+    FullTextClassifier,
+    shared_classifier,
+)

@@ -1,0 +1,259 @@
+"""Full-text license classification as one batched similarity matmul.
+
+The reference classifies license files with google/licenseclassifier
+(pkg/licensing/classifier.go): normalized text against a canonical
+corpus with a confidence threshold.  The TPU-native formulation: every
+candidate file becomes a hashed token-trigram histogram (L2-normalized),
+the corpus is a [L, D] matrix built once, and classifying a whole scan's
+worth of license files is a single [F, D] x [D, L] matmul — MXU work,
+batched, static shapes — with cosine scores as confidences.
+
+Corpus sources: the distribution's canonical texts under
+/usr/share/common-licenses plus embedded templates for the short
+permissive licenses (MIT/ISC/BSD are standardized wordings).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import zlib
+from dataclasses import dataclass
+
+import numpy as np
+
+DIM = 4096  # histogram buckets; collisions are noise the L2 dot tolerates
+DEFAULT_CONFIDENCE = 0.9  # reference classifier's default threshold
+
+_WORD = re.compile(r"[a-z0-9]+")
+_COPYRIGHT_LINE = re.compile(r"^.*copyright (\(c\)|©|[0-9]{4}).*$", re.M)
+
+# Short standardized license wordings (public-domain boilerplate).
+_EMBEDDED: dict[str, str] = {
+    "MIT": """
+Permission is hereby granted, free of charge, to any person obtaining a
+copy of this software and associated documentation files (the "Software"),
+to deal in the Software without restriction, including without limitation
+the rights to use, copy, modify, merge, publish, distribute, sublicense,
+and/or sell copies of the Software, and to permit persons to whom the
+Software is furnished to do so, subject to the following conditions:
+The above copyright notice and this permission notice shall be included
+in all copies or substantial portions of the Software.
+THE SOFTWARE IS PROVIDED "AS IS", WITHOUT WARRANTY OF ANY KIND, EXPRESS
+OR IMPLIED, INCLUDING BUT NOT LIMITED TO THE WARRANTIES OF
+MERCHANTABILITY, FITNESS FOR A PARTICULAR PURPOSE AND NONINFRINGEMENT.
+IN NO EVENT SHALL THE AUTHORS OR COPYRIGHT HOLDERS BE LIABLE FOR ANY
+CLAIM, DAMAGES OR OTHER LIABILITY, WHETHER IN AN ACTION OF CONTRACT,
+TORT OR OTHERWISE, ARISING FROM, OUT OF OR IN CONNECTION WITH THE
+SOFTWARE OR THE USE OR OTHER DEALINGS IN THE SOFTWARE.
+""",
+    "ISC": """
+Permission to use, copy, modify, and/or distribute this software for any
+purpose with or without fee is hereby granted, provided that the above
+copyright notice and this permission notice appear in all copies.
+THE SOFTWARE IS PROVIDED "AS IS" AND THE AUTHOR DISCLAIMS ALL WARRANTIES
+WITH REGARD TO THIS SOFTWARE INCLUDING ALL IMPLIED WARRANTIES OF
+MERCHANTABILITY AND FITNESS. IN NO EVENT SHALL THE AUTHOR BE LIABLE FOR
+ANY SPECIAL, DIRECT, INDIRECT, OR CONSEQUENTIAL DAMAGES OR ANY DAMAGES
+WHATSOEVER RESULTING FROM LOSS OF USE, DATA OR PROFITS, WHETHER IN AN
+ACTION OF CONTRACT, NEGLIGENCE OR OTHER TORTIOUS ACTION, ARISING OUT OF
+OR IN CONNECTION WITH THE USE OR PERFORMANCE OF THIS SOFTWARE.
+""",
+    "BSD-3-Clause": """
+Redistribution and use in source and binary forms, with or without
+modification, are permitted provided that the following conditions are met:
+1. Redistributions of source code must retain the above copyright notice,
+this list of conditions and the following disclaimer.
+2. Redistributions in binary form must reproduce the above copyright
+notice, this list of conditions and the following disclaimer in the
+documentation and/or other materials provided with the distribution.
+3. Neither the name of the copyright holder nor the names of its
+contributors may be used to endorse or promote products derived from this
+software without specific prior written permission.
+THIS SOFTWARE IS PROVIDED BY THE COPYRIGHT HOLDERS AND CONTRIBUTORS
+"AS IS" AND ANY EXPRESS OR IMPLIED WARRANTIES, INCLUDING, BUT NOT
+LIMITED TO, THE IMPLIED WARRANTIES OF MERCHANTABILITY AND FITNESS FOR A
+PARTICULAR PURPOSE ARE DISCLAIMED. IN NO EVENT SHALL THE COPYRIGHT
+HOLDER OR CONTRIBUTORS BE LIABLE FOR ANY DIRECT, INDIRECT, INCIDENTAL,
+SPECIAL, EXEMPLARY, OR CONSEQUENTIAL DAMAGES (INCLUDING, BUT NOT LIMITED
+TO, PROCUREMENT OF SUBSTITUTE GOODS OR SERVICES; LOSS OF USE, DATA, OR
+PROFITS; OR BUSINESS INTERRUPTION) HOWEVER CAUSED AND ON ANY THEORY OF
+LIABILITY, WHETHER IN CONTRACT, STRICT LIABILITY, OR TORT (INCLUDING
+NEGLIGENCE OR OTHERWISE) ARISING IN ANY WAY OUT OF THE USE OF THIS
+SOFTWARE, EVEN IF ADVISED OF THE POSSIBILITY OF SUCH DAMAGE.
+""",
+    "BSD-2-Clause": """
+Redistribution and use in source and binary forms, with or without
+modification, are permitted provided that the following conditions are met:
+1. Redistributions of source code must retain the above copyright notice,
+this list of conditions and the following disclaimer.
+2. Redistributions in binary form must reproduce the above copyright
+notice, this list of conditions and the following disclaimer in the
+documentation and/or other materials provided with the distribution.
+THIS SOFTWARE IS PROVIDED BY THE COPYRIGHT HOLDERS AND CONTRIBUTORS
+"AS IS" AND ANY EXPRESS OR IMPLIED WARRANTIES, INCLUDING, BUT NOT
+LIMITED TO, THE IMPLIED WARRANTIES OF MERCHANTABILITY AND FITNESS FOR A
+PARTICULAR PURPOSE ARE DISCLAIMED. IN NO EVENT SHALL THE COPYRIGHT
+HOLDER OR CONTRIBUTORS BE LIABLE FOR ANY DIRECT, INDIRECT, INCIDENTAL,
+SPECIAL, EXEMPLARY, OR CONSEQUENTIAL DAMAGES (INCLUDING, BUT NOT LIMITED
+TO, PROCUREMENT OF SUBSTITUTE GOODS OR SERVICES; LOSS OF USE, DATA, OR
+PROFITS; OR BUSINESS INTERRUPTION) HOWEVER CAUSED AND ON ANY THEORY OF
+LIABILITY, WHETHER IN CONTRACT, STRICT LIABILITY, OR TORT (INCLUDING
+NEGLIGENCE OR OTHERWISE) ARISING IN ANY WAY OUT OF THE USE OF THIS
+SOFTWARE, EVEN IF ADVISED OF THE POSSIBILITY OF SUCH DAMAGE.
+""",
+    "Unlicense": """
+This is free and unencumbered software released into the public domain.
+Anyone is free to copy, modify, publish, use, compile, sell, or
+distribute this software, either in source code form or as a compiled
+binary, for any purpose, commercial or non-commercial, and by any means.
+In jurisdictions that recognize copyright laws, the author or authors of
+this software dedicate any and all copyright interest in the software to
+the public domain. We make this dedication for the benefit of the public
+at large and to the detriment of our heirs and successors. We intend
+this dedication to be an overt act of relinquishment in perpetuity of
+all present and future rights to this software under copyright law.
+THE SOFTWARE IS PROVIDED "AS IS", WITHOUT WARRANTY OF ANY KIND, EXPRESS
+OR IMPLIED, INCLUDING BUT NOT LIMITED TO THE WARRANTIES OF
+MERCHANTABILITY, FITNESS FOR A PARTICULAR PURPOSE AND NONINFRINGEMENT.
+IN NO EVENT SHALL THE AUTHORS BE LIABLE FOR ANY CLAIM, DAMAGES OR OTHER
+LIABILITY, WHETHER IN AN ACTION OF CONTRACT, TORT OR OTHERWISE, ARISING
+FROM, OUT OF OR IN CONNECTION WITH THE SOFTWARE OR THE USE OR OTHER
+DEALINGS IN THE SOFTWARE.
+""",
+}
+
+# Map /usr/share/common-licenses filenames to SPDX ids.
+_SYSTEM_LICENSES = {
+    "Apache-2.0": "Apache-2.0",
+    "GPL-2": "GPL-2.0",
+    "GPL-3": "GPL-3.0",
+    "LGPL-2.1": "LGPL-2.1",
+    "LGPL-3": "LGPL-3.0",
+    "MPL-2.0": "MPL-2.0",
+    "CC0-1.0": "CC0-1.0",
+    "Artistic": "Artistic-1.0",
+}
+_SYSTEM_DIR = "/usr/share/common-licenses"
+
+
+def normalize_tokens(text: str) -> list[str]:
+    """licenseclassifier-style normalization: lowercase, copyright lines
+    out, words only."""
+    text = _COPYRIGHT_LINE.sub(" ", text.lower())
+    return _WORD.findall(text)
+
+
+def _fingerprint(tokens: list[str]) -> np.ndarray:
+    """Hashed token-trigram histogram, L2-normalized float32 [DIM]."""
+    vec = np.zeros(DIM, dtype=np.float32)
+    if len(tokens) < 3:
+        return vec
+    joined = [" ".join(tokens[i : i + 3]) for i in range(len(tokens) - 2)]
+    for gram in joined:
+        vec[zlib.crc32(gram.encode()) % DIM] += 1.0
+    norm = float(np.linalg.norm(vec))
+    if norm > 0:
+        vec /= norm
+    return vec
+
+
+@dataclass
+class Match:
+    license: str
+    confidence: float
+
+
+class FullTextClassifier:
+    """Corpus matrix built once; classification is one batched matmul."""
+
+    def __init__(self, extra: dict[str, str] | None = None):
+        corpus: dict[str, str] = dict(_EMBEDDED)
+        if os.path.isdir(_SYSTEM_DIR):
+            for fname, spdx in _SYSTEM_LICENSES.items():
+                path = os.path.join(_SYSTEM_DIR, fname)
+                try:
+                    with open(path, encoding="utf-8", errors="replace") as f:
+                        corpus[spdx] = f.read()
+                except OSError:
+                    continue
+        corpus.update(extra or {})
+        self.names = sorted(corpus)
+        self.matrix = np.stack(
+            [_fingerprint(normalize_tokens(corpus[n])) for n in self.names]
+        )  # [L, DIM]
+        # Stable digest of the corpus contents: cache keys must change
+        # when the host's license corpus does.
+        digest = 0
+        for n in self.names:
+            digest = zlib.crc32(corpus[n].encode(), zlib.crc32(n.encode(), digest))
+        self.corpus_digest = digest
+
+    def classify_batch(
+        self,
+        texts: list[str],
+        confidence: float = DEFAULT_CONFIDENCE,
+    ) -> list[Match | None]:
+        """All candidate files at once: [F, DIM] x [DIM, L] -> best
+        cosine per file.  Runs on the accelerator when one is attached
+        (the MXU eats this shape); numpy otherwise."""
+        if not texts:
+            return []
+        fps = np.stack(
+            [_fingerprint(normalize_tokens(t)) for t in texts]
+        )  # [F, DIM]
+        sims = self._matmul(fps)  # [F, L]
+        out: list[Match | None] = []
+        for row in sims:
+            best = int(np.argmax(row))
+            score = float(row[best])
+            if score >= confidence:
+                out.append(Match(self.names[best], round(score, 4)))
+            else:
+                out.append(None)
+        return out
+
+    def _matmul(self, fps: np.ndarray) -> np.ndarray:
+        try:
+            import jax
+
+            if jax.devices()[0].platform not in ("cpu",):
+                return np.asarray(
+                    _device_dot()(
+                        jax.numpy.asarray(fps),
+                        jax.numpy.asarray(self.matrix),
+                    )
+                )
+        except Exception:  # no accelerator / jax import issue: numpy path
+            pass
+        return fps @ self.matrix.T
+
+    def classify(
+        self, text: str, confidence: float = DEFAULT_CONFIDENCE
+    ) -> Match | None:
+        return self.classify_batch([text], confidence)[0]
+
+
+_DEVICE_DOT = None
+
+
+def _device_dot():
+    """One jitted dot for the process: a fresh lambda per call would make
+    every batch a recompile instead of a jit-cache hit."""
+    global _DEVICE_DOT
+    if _DEVICE_DOT is None:
+        import jax
+        import jax.numpy as jnp
+
+        _DEVICE_DOT = jax.jit(lambda a, b: jnp.dot(a, b.T))
+    return _DEVICE_DOT
+
+
+_shared: FullTextClassifier | None = None
+
+
+def shared_classifier() -> FullTextClassifier:
+    global _shared
+    if _shared is None:
+        _shared = FullTextClassifier()
+    return _shared
