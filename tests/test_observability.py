@@ -1,0 +1,129 @@
+"""Tests: colored logging setup, server /metrics, --profile-dir."""
+
+import json
+import logging
+import threading
+import urllib.request
+
+from trivy_tpu.cache.store import MemoryCache
+from trivy_tpu.log import ConsoleFormatter, setup
+from trivy_tpu.rpc.server import make_http_server
+
+
+def test_log_setup_levels_and_idempotence():
+    setup(debug=True)
+    logger = logging.getLogger("trivy_tpu")
+    assert logger.level == logging.DEBUG
+    setup(quiet=True)
+    assert logger.level == logging.ERROR
+    handlers = [
+        h for h in logger.handlers if getattr(h, "_trivy_console", False)
+    ]
+    assert len(handlers) == 1  # repeated setup replaces, never stacks
+    setup()  # restore default for other tests
+    assert logger.level == logging.INFO
+
+
+def test_formatter_colors():
+    rec = logging.LogRecord(
+        "trivy_tpu.engine.hybrid", logging.WARNING, "f", 1, "watch out",
+        None, None,
+    )
+    colored = ConsoleFormatter(color=True).format(rec)
+    plain = ConsoleFormatter(color=False).format(rec)
+    assert "\x1b[33m" in colored and "\x1b[33m" not in plain
+    assert "[engine.hybrid] watch out" in plain
+
+
+def test_server_metrics_endpoint():
+    srv = make_http_server("localhost:0", MemoryCache(), token="")
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        base = f"http://localhost:{srv.server_address[1]}"
+        req = urllib.request.Request(
+            base + "/twirp/trivy.cache.v1.Cache/MissingBlobs",
+            data=json.dumps({"ArtifactID": "a", "BlobIDs": []}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        urllib.request.urlopen(req, timeout=10).read()
+        # an unknown rpc counts too, under its own code
+        bad = urllib.request.Request(base + "/twirp/nope", data=b"{}")
+        try:
+            urllib.request.urlopen(bad, timeout=10)
+        except urllib.error.HTTPError:
+            pass
+        body = urllib.request.urlopen(base + "/metrics", timeout=10).read()
+        text = body.decode()
+        assert 'trivy_tpu_requests_total{method="missing_blobs",code="200"} 1' in text
+        assert 'code="404"' in text
+        assert "trivy_tpu_request_seconds_total" in text
+    finally:
+        srv.shutdown()
+
+
+def test_profile_dir_wraps_scan(tmp_path, monkeypatch):
+    """--profile-dir produces a JAX trace directory around a real scan."""
+    import contextlib
+    import io
+
+    from trivy_tpu.cli import main
+
+    (tmp_path / "proj").mkdir()
+    (tmp_path / "proj" / "app.py").write_text("x = 1\n")
+    prof = tmp_path / "prof"
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main([
+            "fs", "--scanners", "secret", "--format", "json",
+            "--profile-dir", str(prof), str(tmp_path / "proj"),
+        ])
+    assert rc == 0
+    json.loads(buf.getvalue())  # report still well-formed
+    assert prof.is_dir() and any(prof.rglob("*"))  # trace files written
+
+
+def test_profiler_failure_degrades_not_crashes(tmp_path, monkeypatch):
+    """An unwritable profile dir logs a warning and scans unprofiled."""
+    import contextlib
+    import io
+
+    from trivy_tpu.cli import main
+
+    (tmp_path / "proj").mkdir()
+    (tmp_path / "proj" / "a.py").write_text("x = 1\n")
+    ro = tmp_path / "ro"
+    ro.mkdir()
+    ro.chmod(0o555)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main([
+            "fs", "--scanners", "secret", "--format", "json",
+            "--profile-dir", str(ro / "sub"), str(tmp_path / "proj"),
+        ])
+    ro.chmod(0o755)
+    assert rc == 0
+    json.loads(buf.getvalue())
+
+
+def test_metrics_unknown_path_fixed_label():
+    srv = make_http_server("localhost:0", MemoryCache(), token="")
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        import socket
+
+        base = f"http://localhost:{srv.server_address[1]}"
+        # Raw socket: urllib refuses hostile request paths client-side.
+        evil_path = '/twirp/a"}injected'
+        with socket.create_connection(
+            ("localhost", srv.server_address[1]), timeout=10
+        ) as s:
+            s.sendall(
+                f"POST {evil_path} HTTP/1.1\r\nHost: x\r\n"
+                "Content-Length: 2\r\nConnection: close\r\n\r\n{}".encode()
+            )
+            s.recv(4096)
+        text = urllib.request.urlopen(base + "/metrics", timeout=10).read().decode()
+        assert 'method="unknown",code="404"' in text
+        assert "injected" not in text
+    finally:
+        srv.shutdown()

@@ -159,6 +159,19 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
         "cpu = oracle engine",
     )
     p.add_argument("--ignorefile", default=_env_default("ignorefile", ".trivyignore"))
+    p.add_argument(
+        "--debug", action="store_true", default=_bool_default("debug")
+    )
+    p.add_argument(
+        "--quiet", "-q", action="store_true", default=_bool_default("quiet")
+    )
+    p.add_argument(
+        "--no-color", action="store_true", default=_bool_default("no-color")
+    )
+    p.add_argument(
+        "--profile-dir", default=_env_default("profile-dir", ""),
+        help="write a JAX profiler trace of the scan to this directory",
+    )
     p.add_argument("--cache-dir", default=_env_default("cache-dir", ""))
     p.add_argument(
         "--cache-backend",
@@ -290,6 +303,7 @@ def _options_from_args(args: argparse.Namespace) -> Options:
         module_dir=args.module_dir,
         sbom_sources=list(args.sbom_sources),
         rekor_url=args.rekor_url,
+        profile_dir=getattr(args, "profile_dir", ""),
     )
 
 
@@ -567,6 +581,14 @@ def main(argv: list[str] | None = None) -> int:
         print(f"trivy-tpu: {config_err}", file=sys.stderr)
         return 2
     args = parser.parse_args(argv)
+
+    from trivy_tpu.log import setup as _setup_logging
+
+    _setup_logging(
+        debug=getattr(args, "debug", False),
+        quiet=getattr(args, "quiet", False),
+        no_color=getattr(args, "no_color", False),
+    )
 
     if args.command in (None, "version"):
         print(f"trivy-tpu version {__version__}")

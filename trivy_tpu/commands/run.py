@@ -69,6 +69,7 @@ class Options:
     module_dir: str = ""  # --module-dir extension modules
     sbom_sources: list[str] = field(default_factory=list)  # --sbom-sources
     rekor_url: str = ""  # --rekor-url (unpackaged SBOM lookups)
+    profile_dir: str = ""  # --profile-dir (JAX profiler trace of the scan)
     config_check: list[str] = field(default_factory=list)  # --config-check dirs
     insecure_registry: bool = False  # plain-http registry pulls
     db_repository: str = ""  # OCI ref for the vuln DB (--db-repository)
@@ -292,10 +293,43 @@ from trivy_tpu.deadline import ScanTimeoutError
 def run(options: Options, target_kind: str) -> int:
     """artifact.Run (run.go:394): scan → filter → report → exit code,
     bounded by --timeout (run.go:395-402 context deadline).
+    With --profile-dir, the whole scan runs under jax.profiler.trace so
+    device sieve/verify phases show up in TensorBoard/XProf (the aux
+    tracing subsystem seat, SURVEY §5).
 
     The worker also arms a cooperative deadline (trivy_tpu/deadline.py) that
     the analyzer dispatch checks, so the scan aborts shortly after the
     timeout instead of running on (and writing reports) in the background."""
+    if getattr(options, "profile_dir", ""):
+        # Profiling must never break the scan — and a scan error must
+        # never read as a profiler error.  Enter/exit are guarded
+        # SEPARATELY (StartTrace runs in __enter__, StopTrace/writing in
+        # __exit__): either failing degrades to an unprofiled result
+        # while scan exceptions pass through untouched.
+        import logging
+
+        log = logging.getLogger(__name__)
+        tracer = None
+        try:
+            import jax
+
+            tracer = jax.profiler.trace(options.profile_dir)
+            tracer.__enter__()
+        except Exception as e:
+            log.warning("profiler start failed (%s); running unprofiled", e)
+            tracer = None
+        try:
+            return _run_with_timeout(options, target_kind)
+        finally:
+            if tracer is not None:
+                try:
+                    tracer.__exit__(None, None, None)
+                except Exception as e:
+                    log.warning("profiler stop failed: %s", e)
+    return _run_with_timeout(options, target_kind)
+
+
+def _run_with_timeout(options: Options, target_kind: str) -> int:
     if options.timeout and options.timeout > 0:
         import threading
 

@@ -1,0 +1,63 @@
+"""Logging setup: the reference's colored console handler discipline
+(pkg/log): level-colored prefixes on a tty, plain text otherwise,
+--debug/--quiet verbosity control, per-module loggers unchanged.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_COLORS = {
+    logging.DEBUG: "\x1b[35m",  # magenta
+    logging.INFO: "\x1b[34m",  # blue
+    logging.WARNING: "\x1b[33m",  # yellow
+    logging.ERROR: "\x1b[31m",  # red
+    logging.CRITICAL: "\x1b[31;1m",
+}
+_RESET = "\x1b[0m"
+
+
+class ConsoleFormatter(logging.Formatter):
+    def __init__(self, color: bool):
+        super().__init__(datefmt="%Y-%m-%dT%H:%M:%S")
+        self.color = color
+
+    def format(self, record: logging.LogRecord) -> str:
+        level = record.levelname
+        if self.color:
+            c = _COLORS.get(record.levelno, "")
+            level = f"{c}{level}{_RESET}"
+        prefix = f"{self.formatTime(record, self.datefmt)}\t{level}\t"
+        name = record.name.removeprefix("trivy_tpu.")
+        msg = record.getMessage()
+        out = f"{prefix}[{name}] {msg}"
+        if record.exc_info:
+            out += "\n" + self.formatException(record.exc_info)
+        return out
+
+
+def setup(
+    debug: bool = False, quiet: bool = False, no_color: bool = False
+) -> None:
+    """Install the console handler on the package root logger.
+
+    Idempotent: replaces a previously-installed handler, so tests and
+    repeated main() calls do not stack duplicates."""
+    logger = logging.getLogger("trivy_tpu")
+    for h in list(logger.handlers):
+        if getattr(h, "_trivy_console", False):
+            logger.removeHandler(h)
+    handler = logging.StreamHandler(sys.stderr)
+    handler._trivy_console = True  # type: ignore[attr-defined]
+    color = not no_color and sys.stderr.isatty()
+    handler.setFormatter(ConsoleFormatter(color))
+    logger.addHandler(handler)
+    # Propagation stays on: the root logger has no handlers in CLI use
+    # (no double printing) and log-capture tooling relies on it.
+    if quiet:
+        logger.setLevel(logging.ERROR)
+    elif debug:
+        logger.setLevel(logging.DEBUG)
+    else:
+        logger.setLevel(logging.INFO)

@@ -37,6 +37,45 @@ from trivy_tpu.scanner.service import LocalDriver, ScanOptions
 TOKEN_HEADER = "Trivy-Tpu-Token"
 
 
+class _Metrics:
+    """Process counters in Prometheus text exposition format (the aux
+    metrics subsystem seat — the reference exposes its server metrics the
+    same pull-based way)."""
+
+    def __init__(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()
+        self.requests: dict[tuple[str, str], int] = {}  # (method, code) -> n
+        self.seconds: dict[str, float] = {}  # method -> total latency
+
+    def observe(self, method: str, code: int, elapsed: float) -> None:
+        with self._lock:
+            key = (method, str(code))
+            self.requests[key] = self.requests.get(key, 0) + 1
+            self.seconds[method] = self.seconds.get(method, 0.0) + elapsed
+
+    def render(self) -> str:
+        with self._lock:
+            lines = [
+                "# HELP trivy_tpu_requests_total RPC requests by method and code",
+                "# TYPE trivy_tpu_requests_total counter",
+            ]
+            for (method, code), n in sorted(self.requests.items()):
+                lines.append(
+                    f'trivy_tpu_requests_total{{method="{method}",code="{code}"}} {n}'
+                )
+            lines += [
+                "# HELP trivy_tpu_request_seconds_total cumulative handler latency",
+                "# TYPE trivy_tpu_request_seconds_total counter",
+            ]
+            for method, secs in sorted(self.seconds.items()):
+                lines.append(
+                    f'trivy_tpu_request_seconds_total{{method="{method}"}} {secs:.6f}'
+                )
+            return "\n".join(lines) + "\n"
+
+
 class ScanServer:
     """pkg/rpc/server Server: scanner + cache services over one cache."""
 
@@ -48,6 +87,7 @@ class ScanServer:
 
         self.cache = cache
         self.token = token
+        self.metrics = _Metrics()
         self.driver = LocalDriver(
             cache, vuln_detector=init_vuln_scanner(db_dir, cache_dir)
         )
@@ -127,33 +167,55 @@ def _make_handler(server: ScanServer):
                 self.wfile.write(body)
             elif self.path == "/version":
                 self._send(200, {"Version": __version__})
+            elif self.path == "/metrics":
+                body = server.metrics.render().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._send(404, {"error": "not found"})
 
         def do_POST(self):
+            import time as _time
+
             # Always drain the body first: HTTP/1.1 keep-alive connections
             # desynchronize if a response is sent with unread body bytes.
             length = int(self.headers.get("Content-Length", "0"))
             raw = self.rfile.read(length)
+            method = _ROUTES.get(self.path)
+            start = _time.monotonic()
+
+            def send(code: int, payload: dict) -> None:
+                # Known method names only: raw request paths would let an
+                # unauthenticated client inject label characters and grow
+                # the counter map without bound.
+                server.metrics.observe(
+                    method or "unknown", code, _time.monotonic() - start
+                )
+                self._send(code, payload)
+
             if server.token and not hmac.compare_digest(
                 self.headers.get(TOKEN_HEADER, "").encode("utf-8", "replace"),
                 server.token.encode("utf-8", "replace"),
             ):
-                self._send(401, {"error": "invalid token"})
+                send(401, {"error": "invalid token"})
                 return
-            method = _ROUTES.get(self.path)
             if method is None:
-                self._send(404, {"error": f"no such rpc: {self.path}"})
+                send(404, {"error": f"no such rpc: {self.path}"})
                 return
             try:
                 req = json.loads(raw or b"{}")
-                self._send(200, getattr(server, method)(req))
+                send(200, getattr(server, method)(req))
             except BlobNotFoundError as e:
-                self._send(422, {"error": str(e)})  # deterministic; don't retry
+                send(422, {"error": str(e)})  # deterministic; don't retry
             except (KeyError, json.JSONDecodeError) as e:
-                self._send(400, {"error": f"bad request: {e}"})
+                send(400, {"error": f"bad request: {e}"})
             except Exception as e:  # one bad request must not kill the server
-                self._send(500, {"error": str(e)})
+                send(500, {"error": str(e)})
 
     return Handler
 
