@@ -246,3 +246,148 @@ def test_end_to_end_cfn_scan(tmp_path):
         for m in r.get("Misconfigurations", [])
     }
     assert "AVD-AWS-0092" in ids
+
+
+def test_terraform_module_expansion(tmp_path):
+    """A caller passing encrypted=false into a child module flips the
+    child's passing default; the module-aware result wins over the
+    defaults-only per-file scan of the same child file."""
+    import contextlib
+    import io
+
+    from trivy_tpu.cli import main
+
+    root = tmp_path / "infra"
+    (root / "modules" / "vol").mkdir(parents=True)
+    (root / "modules" / "vol" / "main.tf").write_text(textwrap.dedent(
+        """
+        variable "encrypt" { default = true }
+        resource "aws_ebs_volume" "data" {
+          size      = 10
+          encrypted = var.encrypt
+        }
+        """
+    ))
+    (root / "main.tf").write_text(textwrap.dedent(
+        """
+        module "vol" {
+          source  = "./modules/vol"
+          encrypt = false
+        }
+        """
+    ))
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(["config", "--format", "json", str(root)])
+    assert rc == 0
+    report = json.loads(buf.getvalue())
+    by_target = {
+        r["Target"]: {
+            m["ID"]: m["Status"] for m in r.get("Misconfigurations", [])
+        }
+        for r in report["Results"] or []
+    }
+    target = "modules/vol/main.tf"
+    # defaults alone would PASS; the module call's encrypt=false FAILs
+    assert by_target[target]["AVD-AWS-0026"] == "FAIL"
+
+
+def test_terraform_module_defaults_pass(tmp_path):
+    """Without overrides the child's safe default stays a PASS."""
+    import contextlib
+    import io
+
+    from trivy_tpu.cli import main
+
+    root = tmp_path / "infra"
+    (root / "m").mkdir(parents=True)
+    (root / "m" / "main.tf").write_text(
+        'variable "e" { default = true }\n'
+        'resource "aws_ebs_volume" "d" { encrypted = var.e }\n'
+    )
+    (root / "main.tf").write_text('module "m" { source = "./m" }\n')
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main([
+            "config", "--format", "json", "--include-non-failures", str(root),
+        ])
+    assert rc == 0
+    report = json.loads(buf.getvalue())
+    statuses = {
+        m["ID"]: m["Status"]
+        for r in report["Results"] or []
+        if r["Target"] == "m/main.tf"
+        for m in r.get("Misconfigurations", [])
+    }
+    assert statuses["AVD-AWS-0026"] == "PASS"
+
+
+def test_module_caller_expression_args_do_not_leak(tmp_path):
+    """encrypt = var.secure in the CALLER resolves in the caller's scope;
+    an unresolvable ref is dropped so the child keeps its default (a raw
+    'var.secure' string must never read as truthy)."""
+    import contextlib
+    import io
+
+    from trivy_tpu.cli import main
+
+    root = tmp_path / "infra"
+    (root / "m").mkdir(parents=True)
+    (root / "m" / "main.tf").write_text(
+        'variable "e" { default = false }\n'
+        'resource "aws_ebs_volume" "d" { encrypted = var.e }\n'
+    )
+    (root / "main.tf").write_text(
+        'variable "secure" { default = true }\n'
+        'module "m" { source = "./m"\n  e = var.secure }\n'
+        'module "m2" { source = "./m"\n  e = var.undefined_thing }\n'
+    )
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(["config", "--format", "json", str(root)])
+    assert rc == 0
+    report = json.loads(buf.getvalue())
+    fails = {
+        m["ID"]
+        for r in report["Results"] or []
+        if r["Target"] == "m/main.tf"
+        for m in r.get("Misconfigurations", [])
+        if m["Status"] == "FAIL"
+    }
+    # m resolves e=true (PASS), but m2's dropped override leaves the
+    # child default false -> FAIL survives the cross-instantiation merge
+    assert "AVD-AWS-0026" in fails
+
+
+def test_module_multifile_child_suppresses_stale_defaults(tmp_path):
+    """variables.tf + ebs.tf child: caller passes e=true, so the
+    defaults-only FAIL on ebs.tf must not survive next to the
+    module-aware PASS."""
+    import contextlib
+    import io
+
+    from trivy_tpu.cli import main
+
+    root = tmp_path / "infra"
+    (root / "m").mkdir(parents=True)
+    (root / "m" / "variables.tf").write_text(
+        'variable "e" { default = false }\n'
+    )
+    (root / "m" / "ebs.tf").write_text(
+        'resource "aws_ebs_volume" "d" { encrypted = var.e }\n'
+    )
+    (root / "main.tf").write_text(
+        'module "m" { source = "./m"\n  e = true }\n'
+    )
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(["config", "--format", "json", str(root)])
+    assert rc == 0
+    report = json.loads(buf.getvalue())
+    fails = [
+        (r["Target"], m["ID"])
+        for r in report["Results"] or []
+        for m in r.get("Misconfigurations", [])
+        if m["Status"] == "FAIL"
+    ]
+    assert fails == []  # neither stale per-file FAIL nor module FAIL

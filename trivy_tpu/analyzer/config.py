@@ -181,9 +181,161 @@ class HelmPostAnalyzer(PostAnalyzer):
         return AnalysisResult(misconfigs=misconfigs)
 
 
+class TerraformModulePostAnalyzer(PostAnalyzer):
+    """Terraform module expansion (pkg/iac/scanners/terraform executor):
+    a `module` block with a local relative source evaluates the child
+    directory's merged files with the caller's arguments overriding the
+    child's variable defaults.  Needs the post-analyzer seat — the child
+    dir and the caller are different files.
+
+    The module-aware result is emitted under the child's file path; the
+    applier's last-write-wins merge lets it override the per-file
+    defaults-only scan of the same file."""
+
+    def type(self) -> str:
+        return "terraform-module"
+
+    def version(self) -> int:
+        return 2
+
+    def required(self, file_path: str, size: int, mode: int) -> bool:
+        # .tf only: the expansion below reads HCL syntax (module calls in
+        # .tf.json are out of scope, so those files are not buffered).
+        return file_path.endswith(".tf") and size < 1 << 20
+
+    @staticmethod
+    def _resolved_calls(docs: list[dict]) -> dict[str, dict]:
+        """Module blocks with arguments resolved in the CALLER's scope.
+
+        Caller-side expressions (encrypt = var.secure) must resolve
+        against the caller's variables/locals, never leak as raw
+        reference strings into the child (a junk truthy string would
+        flip checks).  Still-unresolved references are dropped so the
+        child keeps its own default."""
+        import re
+
+        from trivy_tpu.iac.hcl import terraform_docs_input
+
+        resolved = terraform_docs_input(docs)
+        calls: dict[str, dict] = {}
+        for name, blk in (resolved.get("module") or {}).items():
+            if not isinstance(blk, dict):
+                continue
+            calls[name] = {
+                k: v
+                for k, v in blk.items()
+                if not (
+                    isinstance(v, str)
+                    and re.match(r"^(var|local|module|data)\.", v)
+                )
+            }
+        return calls
+
+    def post_analyze(self, fs) -> AnalysisResult | None:
+        import logging
+        import posixpath
+
+        from trivy_tpu.iac.engine import shared_scanner
+        from trivy_tpu.iac.hcl import parse_hcl, terraform_docs_input
+        from trivy_tpu.misconf.types import Misconfiguration
+
+        logger = logging.getLogger(__name__)
+        by_dir: dict[str, dict[str, dict]] = {}  # dir -> path -> parsed doc
+        for path in fs.paths():
+            if not path.endswith(".tf"):
+                continue
+            try:
+                doc = parse_hcl(fs.read(path).decode("utf-8", "replace"))
+            except Exception:
+                continue
+            by_dir.setdefault(posixpath.dirname(path), {})[path] = doc
+
+        # child dir -> list of per-instantiation evaluated Misconfigurations
+        per_child: dict[str, list] = {}
+        for parent_dir, docs_by_path in sorted(by_dir.items()):
+            try:
+                calls = self._resolved_calls(list(docs_by_path.values()))
+            except Exception:
+                continue
+            for name, blk in sorted(calls.items()):
+                source = str(blk.get("source", ""))
+                if not source.startswith(("./", "../")):
+                    continue  # registry/remote modules are out of scope
+                child_dir = posixpath.normpath(
+                    posixpath.join(parent_dir, source)
+                )
+                if child_dir == ".":
+                    child_dir = ""
+                child_docs = by_dir.get(child_dir)
+                if not child_docs:
+                    continue
+                try:
+                    doc = terraform_docs_input(
+                        [child_docs[p] for p in sorted(child_docs)],
+                        overrides=blk,
+                    )
+                except Exception as e:
+                    logger.warning(
+                        "module %s (%s) failed to evaluate: %s",
+                        name, child_dir, e,
+                    )
+                    continue
+                mc = shared_scanner().evaluate(
+                    child_dir or ".", "terraform", [doc]
+                )
+                per_child.setdefault(child_dir, []).append(mc)
+
+        misconfigs = []
+        for child_dir, mcs in sorted(per_child.items()):
+            child_paths = sorted(by_dir.get(child_dir, {}))
+            if not child_paths:
+                continue
+            report_path = next(
+                (
+                    p
+                    for p in child_paths
+                    if posixpath.basename(p) == "main.tf"
+                ),
+                child_paths[0],
+            )
+            # Merge across instantiations: any FAIL survives (two callers
+            # of the same module must not mask each other), a check
+            # PASSes only when every instantiation passed.
+            merged = Misconfiguration(
+                file_type="terraform", file_path=report_path
+            )
+            seen_failures = set()
+            for mc in mcs:
+                for f in mc.failures:
+                    key = (f.check_id, f.message)
+                    if key not in seen_failures:
+                        seen_failures.add(key)
+                        merged.failures.append(f)
+            failed_ids = {f.check_id for f in merged.failures}
+            seen_pass = set()
+            for mc in mcs:
+                for s in mc.successes:
+                    if s.check_id not in failed_ids | seen_pass:
+                        seen_pass.add(s.check_id)
+                        merged.successes.append(s)
+            misconfigs.append(merged)
+            # The instantiated evaluation supersedes the defaults-only
+            # per-file scans of EVERY child file; empty entries clear the
+            # stale ones under the applier's last-write-wins merge.
+            for p in child_paths:
+                if p != report_path:
+                    misconfigs.append(
+                        Misconfiguration(file_type="terraform", file_path=p)
+                    )
+        if not misconfigs:
+            return None
+        return AnalysisResult(misconfigs=misconfigs)
+
+
 register_analyzer(DockerfileAnalyzer)
 register_analyzer(ConfigJsonAnalyzer)
 register_analyzer(TomlConfigAnalyzer)
 register_post_analyzer(HelmPostAnalyzer)
+register_post_analyzer(TerraformModulePostAnalyzer)
 register_analyzer(KubernetesYamlAnalyzer)
 register_analyzer(TerraformAnalyzer)

@@ -124,6 +124,7 @@ def _analyzer_options(options: Options, target_kind: str) -> AnalyzerOptions:
                 "config-json",
                 "config-toml",
                 "helm",
+                "terraform-module",
             ]
         )
     if "rekor" not in (getattr(options, "sbom_sources", []) or []):

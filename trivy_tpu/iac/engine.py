@@ -206,7 +206,14 @@ class IacScanner:
                 return None
         if not inputs:
             return None
+        return self.evaluate(file_path, ftype, inputs)
 
+    def evaluate(
+        self, file_path: str, ftype: str, inputs: list[Any]
+    ) -> Misconfiguration:
+        """Run every ftype-matching check over pre-built input documents
+        (the seam the terraform module post-analyzer and cloud adapters
+        use to evaluate docs that never existed as a single file)."""
         mc = Misconfiguration(file_type=ftype, file_path=file_path)
         for check in self.checks:
             if check.input_type != ftype:

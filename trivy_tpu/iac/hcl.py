@@ -349,20 +349,77 @@ def _resolve(value: Any, variables: dict, local_vals: dict) -> Any:
 def terraform_input(content: str) -> dict[str, Any]:
     """Parse terraform source and resolve var defaults/locals into the
     conftest-style input document."""
-    doc = parse_hcl(content)
+    return terraform_docs_input([parse_hcl(content)])
+
+
+def _merge_tf_docs(docs: list[dict[str, Any]]) -> dict[str, Any]:
+    """Merge per-file parse_hcl docs the way terraform merges a module
+    dir: block-type dicts union (resource types/names across files),
+    locals lists concatenate."""
+    merged: dict[str, Any] = {}
+    for doc in docs:
+        for key, val in doc.items():
+            if key == "locals":
+                cur = merged.setdefault("locals", [])
+                if isinstance(cur, dict):
+                    cur = merged["locals"] = [cur]
+                cur.extend(val if isinstance(val, list) else [val])
+            elif isinstance(val, dict) and isinstance(merged.get(key), dict):
+                for sub, blk in val.items():
+                    if isinstance(blk, dict) and isinstance(
+                        merged[key].get(sub), dict
+                    ):
+                        merged[key][sub].update(blk)
+                    else:
+                        merged[key][sub] = blk
+            else:
+                merged[key] = val
+    return merged
+
+
+_MODULE_META_KEYS = {
+    "source", "version", "providers", "count", "for_each", "depends_on",
+}
+
+
+def terraform_docs_input(
+    docs: list[dict[str, Any]], overrides: dict[str, Any] | None = None
+) -> dict[str, Any]:
+    """The shared resolution core: merge per-file docs, apply variable
+    defaults then caller overrides, fold locals, resolve references.
+    terraform_input (single file) and terraform_module_input (module dir
+    with caller arguments) both delegate here so the variable semantics
+    cannot diverge."""
+    doc = _merge_tf_docs(docs) if len(docs) != 1 else docs[0]
     variables: dict[str, Any] = {}
     for name, blk in (doc.get("variable") or {}).items():
         if isinstance(blk, dict) and "default" in blk:
             variables[name] = blk["default"]
+    for name, val in (overrides or {}).items():
+        if name not in _MODULE_META_KEYS and not name.startswith("__"):
+            variables[name] = val
     local_vals: dict[str, Any] = {}
     locals_blk = doc.get("locals")
     if isinstance(locals_blk, list):
-        merged: dict[str, Any] = {}
+        m: dict[str, Any] = {}
         for b in locals_blk:
-            merged.update(b)
-        locals_blk = merged
+            if isinstance(b, dict):
+                m.update(b)
+        locals_blk = m
     if isinstance(locals_blk, dict):
         local_vals = {
             k: v for k, v in locals_blk.items() if not k.startswith("__")
         }
     return _resolve(doc, variables, local_vals)
+
+
+def terraform_module_input(
+    sources: dict[str, str], overrides: dict[str, Any] | None = None
+) -> dict[str, Any]:
+    """Evaluate a terraform module directory: every file's doc merged,
+    variable defaults overridden by the caller's module-block arguments
+    (the reference's module expansion, pkg/iac/scanners/terraform
+    executor — defaults-only here, no remote modules)."""
+    return terraform_docs_input(
+        [parse_hcl(sources[p]) for p in sorted(sources)], overrides
+    )
