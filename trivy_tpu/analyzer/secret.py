@@ -40,13 +40,21 @@ SKIP_EXTS = {
 }
 
 
+# Bytes the reference's control-byte heuristic accepts as text; translate()
+# deletes them at C speed, so anything left over marks the file binary.
+_TEXT_BYTES = bytes(
+    b
+    for b in range(256)
+    if not (
+        b < 7 or b == 11 or (13 < b < 27) or (27 < b < 0x20) or b == 0x7F
+    )
+)
+
+
 def is_binary(head: bytes) -> bool:
     """utils.IsBinary control-byte heuristic over the first 300 bytes
     (pkg/fanal/utils/utils.go:76-93)."""
-    for b in head[:300]:
-        if b < 7 or b == 11 or (13 < b < 27) or (27 < b < 0x20) or b == 0x7F:
-            return True
-    return False
+    return bool(head[:300].translate(None, _TEXT_BYTES))
 
 
 class SecretAnalyzer(BatchAnalyzer):

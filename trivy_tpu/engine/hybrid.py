@@ -196,23 +196,12 @@ class HybridSecretEngine(TpuSecretEngine):
 
     def _build_allow_path_re(self) -> re.Pattern[str] | None:
         """Union of the global allow-rule path regexes (scanner.go:200-207)
-        for the O(files) fast path; None when any rule lacks a path regex
-        source (fall back to the per-rule loop)."""
-        from trivy_tpu.engine import goregex
+        for the O(files) fast path; None falls back to the per-rule loop.
+        One shared builder (rules/model.py) so the two gating fast paths
+        cannot diverge."""
+        from trivy_tpu.rules.model import build_combined_allow_path
 
-        pats = []
-        for r in self.ruleset.allow_rules:
-            if r.path is None:
-                continue
-            if not r.path_src:
-                return None
-            try:
-                pats.append("(?:%s)" % goregex.go_to_python(r.path_src))
-            except goregex.GoRegexError:
-                return None
-        if not pats:
-            return None
-        return re.compile("|".join(pats))
+        return build_combined_allow_path(self.ruleset.allow_rules)
 
     def _fast_allow_path(self, path: str) -> bool:
         if self._allow_path_re is not None:

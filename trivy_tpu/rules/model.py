@@ -101,6 +101,33 @@ def allow_rules_allow_path(rules: list[AllowRule], path: str) -> bool:
     return any(r.path is not None and r.path.search(path) for r in rules)
 
 
+def build_combined_allow_path(
+    rules: list[AllowRule],
+) -> "re.Pattern[str] | None":
+    """Union of the allow-rule path regexes as ONE compiled alternation —
+    the O(files) gating fast path (one search instead of N; most paths
+    match nothing, so every pattern used to run).  Returns None when any
+    path rule lacks a translatable source or the joined pattern cannot
+    compile (e.g. cross-rule group-name collisions): callers fall back to
+    the per-rule loop."""
+    pats = []
+    for r in rules:
+        if r.path is None:
+            continue
+        if not r.path_src:
+            return None
+        try:
+            pats.append("(?:%s)" % goregex.go_to_python(r.path_src))
+        except goregex.GoRegexError:
+            return None
+    if not pats:
+        return None
+    try:
+        return re.compile("|".join(pats))
+    except re.error:
+        return None
+
+
 def allow_rules_allow(rules: list[AllowRule], match: bytes) -> bool:
     """scanner.go:209-216."""
     return any(r.regex is not None and r.regex.search(match) for r in rules)
@@ -125,11 +152,26 @@ class RuleSet:
     rules: list[Rule] = field(default_factory=list)
     allow_rules: list[AllowRule] = field(default_factory=list)
     exclude_block: ExcludeBlock = field(default_factory=ExcludeBlock)
+    # Lazy gating fast path (build_combined_allow_path); rebuilt never —
+    # allow_rules are fixed after construction.
+    _combined_allow_path: "re.Pattern[str] | None" = field(
+        default=None, init=False, repr=False, compare=False
+    )
+    _combined_built: bool = field(
+        default=False, init=False, repr=False, compare=False
+    )
 
     def allow(self, match: bytes) -> bool:
         return allow_rules_allow(self.allow_rules, match)
 
     def allow_path(self, path: str) -> bool:
+        if not self._combined_built:
+            self._combined_allow_path = build_combined_allow_path(
+                self.allow_rules
+            )
+            self._combined_built = True
+        if self._combined_allow_path is not None:
+            return self._combined_allow_path.search(path) is not None
         return allow_rules_allow_path(self.allow_rules, path)
 
 
