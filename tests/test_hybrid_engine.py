@@ -219,3 +219,20 @@ def test_device_nfa_verify_random_corpus(oracle):
         assert [f.to_json() for f in got.findings] == [
             f.to_json() for f in want.findings
         ], path
+
+
+@needs_native
+def test_shared_empty_secret_stays_empty(engine):
+    """The shared non-candidate sentinel must never accumulate state: two
+    scans over plain files return identity-shared empties with no
+    findings and no file_path."""
+    from trivy_tpu.engine.hybrid import _EMPTY_SECRET
+
+    items = [(f"plain{i}.txt", b"nothing here " * 30) for i in range(50)]
+    first = engine.scan_batch(items)
+    second = engine.scan_batch(items)
+    for r in first + second:
+        if r is _EMPTY_SECRET:
+            assert not r.findings and not r.file_path
+    assert any(r is _EMPTY_SECRET for r in first)
+    assert not _EMPTY_SECRET.findings and not _EMPTY_SECRET.file_path

@@ -391,3 +391,37 @@ def test_module_multifile_child_suppresses_stale_defaults(tmp_path):
         if m["Status"] == "FAIL"
     ]
     assert fails == []  # neither stale per-file FAIL nor module FAIL
+
+
+def test_trace_flag_attaches_rego_traces(tmp_path):
+    import contextlib
+    import io
+
+    from trivy_tpu.cli import main
+
+    (tmp_path / "c").mkdir()
+    (tmp_path / "c" / "main.tf").write_text(
+        'resource "aws_ebs_volume" "d" { size = 1 }\n'
+    )
+    def run(*flags):
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = main(["config", "--format", "json", *flags, str(tmp_path / "c")])
+        assert rc == 0
+        return json.loads(buf.getvalue())
+
+    rep = run("--trace")
+    traced = [
+        m.get("Traces")
+        for r in rep["Results"] or []
+        for m in r.get("Misconfigurations", [])
+        if m["ID"] == "AVD-AWS-0026"
+    ]
+    assert traced and traced[0] and "deny produced" in traced[0][0]
+    rep = run()
+    untraced = [
+        m.get("Traces")
+        for r in rep["Results"] or []
+        for m in r.get("Misconfigurations", [])
+    ]
+    assert not any(untraced)

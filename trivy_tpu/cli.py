@@ -172,6 +172,10 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
         "--profile-dir", default=_env_default("profile-dir", ""),
         help="write a JAX profiler trace of the scan to this directory",
     )
+    p.add_argument(
+        "--trace", action="store_true", default=_bool_default("trace"),
+        help="attach rego evaluation traces to misconfiguration findings",
+    )
     p.add_argument("--cache-dir", default=_env_default("cache-dir", ""))
     p.add_argument(
         "--cache-backend",
@@ -304,6 +308,7 @@ def _options_from_args(args: argparse.Namespace) -> Options:
         sbom_sources=list(args.sbom_sources),
         rekor_url=args.rekor_url,
         profile_dir=getattr(args, "profile_dir", ""),
+        trace=getattr(args, "trace", False),
     )
 
 

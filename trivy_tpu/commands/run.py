@@ -70,6 +70,7 @@ class Options:
     sbom_sources: list[str] = field(default_factory=list)  # --sbom-sources
     rekor_url: str = ""  # --rekor-url (unpackaged SBOM lookups)
     profile_dir: str = ""  # --profile-dir (JAX profiler trace of the scan)
+    trace: bool = False  # --trace (rego traces on misconfig findings)
     config_check: list[str] = field(default_factory=list)  # --config-check dirs
     insecure_registry: bool = False  # plain-http registry pulls
     db_repository: str = ""  # OCI ref for the vuln DB (--db-repository)
@@ -150,7 +151,9 @@ def _analyzer_options(options: Options, target_kind: str) -> AnalyzerOptions:
         )
     # Unconditional: also RESETS custom dirs left by a prior scan in this
     # process (the scanner is process-global).
-    configure_shared_scanner(extra_dirs)
+    configure_shared_scanner(
+        extra_dirs, trace=bool(getattr(options, "trace", False))
+    )
     extra = []
     if getattr(options, "_module_manager", None) is not None:
         extra = options._module_manager.analyzers()

@@ -33,7 +33,6 @@ scans; `make_secret_engine` picks per availability.
 
 from __future__ import annotations
 
-import re
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor

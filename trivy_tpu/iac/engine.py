@@ -94,13 +94,17 @@ def load_checks(extra_dirs: list[str] | None = None) -> list[Check]:
 
 _shared: IacScanner | None = None
 _shared_extra_dirs: list[str] = []
+_shared_trace: bool = False
 
 
-def configure_shared_scanner(extra_check_dirs: list[str]) -> None:
+def configure_shared_scanner(
+    extra_check_dirs: list[str], trace: bool = False
+) -> None:
     """Set custom-check directories (--config-check) before the first scan;
     resets the cached scanner so new checks load."""
-    global _shared, _shared_extra_dirs
+    global _shared, _shared_extra_dirs, _shared_trace
     _shared_extra_dirs = list(extra_check_dirs)
+    _shared_trace = trace
     _shared = None
 
 
@@ -108,7 +112,9 @@ def shared_scanner() -> "IacScanner":
     """Process-wide scanner with the builtin checks (compiled once)."""
     global _shared
     if _shared is None:
-        _shared = IacScanner(extra_check_dirs=_shared_extra_dirs)
+        _shared = IacScanner(
+            extra_check_dirs=_shared_extra_dirs, trace=_shared_trace
+        )
     return _shared
 
 
@@ -116,8 +122,15 @@ class IacScanner:
     """Routes config files to rego checks; one instance caches compiled
     checks for the whole scan (pkg/misconf/scanner.go role)."""
 
-    def __init__(self, extra_check_dirs: list[str] | None = None):
+    def __init__(
+        self,
+        extra_check_dirs: list[str] | None = None,
+        trace: bool = False,
+    ):
         self.checks = load_checks(extra_check_dirs)
+        # --trace (misconf.ScannerOption.Trace, scanner.go:51): per-check
+        # evaluation traces attached to findings.
+        self.trace = trace
 
     def scan(self, file_path: str, content: bytes) -> Misconfiguration | None:
         ftype = detect_type(file_path, content)
@@ -219,8 +232,9 @@ class IacScanner:
             if check.input_type != ftype:
                 continue
             failures = []
+            traces: list[str] = []
             broken = False
-            for doc in inputs:
+            for di, doc in enumerate(inputs):
                 ev = _Evaluator(doc, check.module.rules)
                 try:
                     denies = ev.eval_set_rule("deny")
@@ -237,6 +251,11 @@ class IacScanner:
                     )
                     broken = True
                     continue
+                if self.trace:
+                    traces.append(
+                        f"input[{di}] package {check.module.package}: "
+                        f"deny produced {len(denies)} result(s)"
+                    )
                 for d in denies:
                     if isinstance(d, dict):
                         msg = str(d.get("msg", ""))
@@ -257,6 +276,9 @@ class IacScanner:
                             end_line=end or start,
                         )
                     )
+            if self.trace:
+                for f in failures:
+                    f.traces = list(traces)
             if failures:
                 mc.failures.extend(failures)
             elif broken:
@@ -270,6 +292,7 @@ class IacScanner:
                         resolution=check.resolution,
                         severity=check.severity,
                         status="PASS",
+                        traces=list(traces),
                     )
                 )
         return mc

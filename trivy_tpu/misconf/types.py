@@ -20,6 +20,7 @@ class MisconfFinding:
     start_line: int = 0
     end_line: int = 0
     references: list[str] = field(default_factory=list)
+    traces: list[str] = field(default_factory=list)  # --trace rego traces
 
     def to_json(self) -> dict[str, Any]:
         out: dict[str, Any] = {
@@ -34,6 +35,8 @@ class MisconfFinding:
         }
         if self.references:
             out["References"] = self.references
+        if self.traces:
+            out["Traces"] = self.traces
         if self.start_line:
             out["CauseMetadata"] = {
                 "StartLine": self.start_line,
@@ -55,6 +58,7 @@ class MisconfFinding:
             start_line=cause.get("StartLine", 0),
             end_line=cause.get("EndLine", 0),
             references=list(d.get("References") or []),
+            traces=list(d.get("Traces") or []),
         )
 
 
