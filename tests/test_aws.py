@@ -113,6 +113,42 @@ class _FakeAws(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    def do_POST(self):  # noqa: N802 — JSON-protocol APIs (cloudtrail/kms)
+        n = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(n) or b"{}")
+        target = self.headers.get("X-Amz-Target", "")
+        if target.endswith("DescribeTrails"):
+            out = {"trailList": [{
+                "Name": "main-trail",
+                "IsMultiRegionTrail": False,
+                "LogFileValidationEnabled": False,
+            }]}
+        elif target.endswith("ListKeys"):
+            if body.get("Marker"):
+                out = {"Keys": [{"KeyId": "key-2"}, {"KeyId": "key-asym"},
+                                {"KeyId": "key-awsmanaged"}]}
+            else:
+                out = {"Keys": [{"KeyId": "key-1"}],
+                       "Truncated": True, "NextMarker": "m1"}
+        elif target.endswith("DescribeKey"):
+            kid = body.get("KeyId", "")
+            out = {"KeyMetadata": {
+                "KeyId": kid,
+                "KeyManager": "AWS" if kid == "key-awsmanaged" else "CUSTOMER",
+                "KeySpec": "RSA_2048" if kid == "key-asym" else "SYMMETRIC_DEFAULT",
+            }}
+        elif target.endswith("GetKeyRotationStatus"):
+            out = {"KeyRotationEnabled": body.get("KeyId") == "key-2"}
+        else:
+            self.send_response(400)
+            self.end_headers()
+            return
+        data = json.dumps(out).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-amz-json-1.1")
+        self.end_headers()
+        self.wfile.write(data)
+
     def do_GET(self):  # noqa: N802
         path, _, query = self.path.partition("?")
         if path == "/" and "Action=DescribeInstances" in query:
@@ -241,3 +277,38 @@ def test_ec2_partial_permissions_degrade(aws_endpoint, monkeypatch):
     assert "AVD-AWS-0107" in ids  # SGs still scanned
     assert "AVD-AWS-0026" not in ids  # volumes skipped...
     assert any("DescribeVolumes" in e for e in scanner.errors)  # ...loudly
+
+
+def test_cloudtrail_and_kms_adapters(aws_endpoint):
+    scanner = AwsScanner(services=["cloudtrail", "kms"], endpoint=aws_endpoint)
+    results = scanner.scan()
+    fails = {
+        (f.check_id, f.message)
+        for mc in results
+        for f in mc.failures
+    }
+    ids = {c for c, _ in fails}
+    assert "AVD-AWS-0014" in ids  # single-region + no validation trail
+    assert "AVD-AWS-0065" in ids  # key-1 rotation disabled
+    # key-2 rotates; asymmetric/AWS-managed keys excluded: only key-1 flagged
+    kms_msgs = [m for c, m in fails if c == "AVD-AWS-0065"]
+    assert kms_msgs and all("key-1" in m for m in kms_msgs)
+    assert not scanner.errors  # unsupported keys skipped, not errored
+
+
+def test_cloudtrail_absence_fails(aws_endpoint, monkeypatch):
+    """Zero trails must FAIL the trail checks, not vanish."""
+    from trivy_tpu.cloud.aws import _AwsApi
+
+    orig = _AwsApi.call_json
+
+    def no_trails(self, target, body):
+        if target.endswith("DescribeTrails"):
+            return {"trailList": []}
+        return orig(self, target, body)
+
+    monkeypatch.setattr(_AwsApi, "call_json", no_trails)
+    scanner = AwsScanner(services=["cloudtrail"], endpoint=aws_endpoint)
+    results = scanner.scan()
+    ids = {f.check_id for mc in results for f in mc.failures}
+    assert "AVD-AWS-0014" in ids

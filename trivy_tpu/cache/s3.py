@@ -66,7 +66,12 @@ class S3Client:
         )
 
     def _request(
-        self, method: str, key: str, body: bytes = b"", query: str = ""
+        self,
+        method: str,
+        key: str,
+        body: bytes = b"",
+        query: str = "",
+        headers_extra: dict[str, str] | None = None,
     ) -> tuple[int, bytes]:
         if key.startswith("/"):
             path = key  # pre-built path (service APIs)
@@ -86,6 +91,11 @@ class S3Client:
         }
         if self.session_token:
             headers["x-amz-security-token"] = self.session_token
+        # Extra headers participate in signing (SigV4 requires any present
+        # x-amz-* header to be signed; JSON-protocol APIs route on
+        # x-amz-target).
+        for k, v in (headers_extra or {}).items():
+            headers[k.lower()] = v
         signed_headers = ";".join(sorted(headers))
         canonical_query = "&".join(
             sorted(

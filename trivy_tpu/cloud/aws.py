@@ -25,7 +25,7 @@ from trivy_tpu.cache.s3 import S3Client, S3Error
 
 logger = logging.getLogger(__name__)
 
-SUPPORTED_SERVICES = ("s3", "ec2", "rds", "iam")
+SUPPORTED_SERVICES = ("s3", "ec2", "rds", "iam", "cloudtrail", "kms")
 
 
 class AwsError(RuntimeError):
@@ -73,6 +73,34 @@ class _AwsApi(S3Client):
             return ET.fromstring(payload)
         except ET.ParseError as e:
             raise AwsError(f"aws: bad XML from {path_and_query}: {e}") from e
+
+    def call_json(self, target: str, body: dict) -> dict:
+        """JSON-protocol service call (CloudTrail/KMS): POST / with the
+        x-amz-target routing header, amz-json-1.1 body."""
+        import json as _json
+
+        data = _json.dumps(body).encode()
+        try:
+            status, payload = self._request(
+                "POST",
+                "/",
+                body=data,
+                headers_extra={
+                    "x-amz-target": target,
+                    "content-type": "application/x-amz-json-1.1",
+                },
+            )
+        except S3Error as e:
+            raise AwsError(str(e)) from e
+        if status >= 400:
+            raise AwsError(
+                f"aws: {target}: HTTP {status}: {payload[:200]!r}"
+            )
+        try:
+            out = _json.loads(payload or b"{}")
+        except ValueError as e:
+            raise AwsError(f"aws: bad JSON from {target}: {e}") from e
+        return out if isinstance(out, dict) else {}
 
 
 @dataclass
@@ -288,6 +316,76 @@ class AwsScanner:
                 elif tag == "MaxPasswordAge" and el.text:
                     policy["max_password_age"] = int(el.text)
         return {"aws_iam_account_password_policy": {"account": policy}}
+
+    def adapt_cloudtrail(self, api: _AwsApi) -> dict:
+        """DescribeTrails -> aws_cloudtrail resources (multi-region and
+        log-validation fields feed the terraform corpus)."""
+        out = api.call_json(
+            "com.amazonaws.cloudtrail.v20131101.CloudTrail_20131101"
+            ".DescribeTrails",
+            {},
+        )
+        trails: dict[str, dict] = {}
+        for t in out.get("trailList") or []:
+            name = t.get("Name") or t.get("TrailARN", "")
+            if not name:
+                continue
+            trails[name] = {
+                "is_multi_region_trail": bool(t.get("IsMultiRegionTrail")),
+                "enable_log_file_validation": bool(
+                    t.get("LogFileValidationEnabled")
+                ),
+            }
+        if not trails:
+            # No audit logging at all must FAIL the trail checks, not
+            # vanish (adapt_iam's absence contract): an empty document
+            # fails every per-field requirement.
+            trails["account"] = {}
+        return {"aws_cloudtrail": trails}
+
+    def adapt_kms(self, api: _AwsApi) -> dict:
+        """ListKeys (paginated) + DescribeKey + GetKeyRotationStatus ->
+        aws_kms_key resources.  Only customer-managed symmetric keys are
+        rotation-checked (rotation is unsupported/meaningless for
+        asymmetric and AWS-managed keys); a key whose state cannot be
+        read is recorded (self.errors), never assumed rotated."""
+        key_ids: list[str] = []
+        marker = None
+        while True:
+            req: dict = {"Marker": marker} if marker else {}
+            out = api.call_json("TrentService.ListKeys", req)
+            key_ids.extend(
+                k.get("KeyId", "") for k in out.get("Keys") or []
+            )
+            marker = out.get("NextMarker")
+            if not out.get("Truncated") or not marker:
+                break
+
+        keys: dict[str, dict] = {}
+        for key_id in key_ids:
+            if not key_id:
+                continue
+            try:
+                meta = (
+                    api.call_json(
+                        "TrentService.DescribeKey", {"KeyId": key_id}
+                    ).get("KeyMetadata")
+                    or {}
+                )
+                if meta.get("KeyManager", "CUSTOMER") != "CUSTOMER":
+                    continue
+                if meta.get("KeySpec", "SYMMETRIC_DEFAULT") != "SYMMETRIC_DEFAULT":
+                    continue
+                status = api.call_json(
+                    "TrentService.GetKeyRotationStatus", {"KeyId": key_id}
+                )
+                keys[key_id] = {
+                    "enable_key_rotation": bool(status.get("KeyRotationEnabled"))
+                }
+            except AwsError as e:
+                logger.warning("kms key %s: %s", key_id, e)
+                self.errors.append(f"kms key {key_id}: {e}")
+        return {"aws_kms_key": keys} if keys else {}
 
     # -- scan --------------------------------------------------------------
 

@@ -1,0 +1,13 @@
+# METADATA
+# title: KMS key rotation disabled
+# custom:
+#   id: AVD-AWS-0065
+#   severity: MEDIUM
+#   recommended_action: Enable automatic key rotation.
+package builtin.terraform.AWS0065
+
+deny[res] {
+    some name, k in object.get(object.get(input, "resource", {}), "aws_kms_key", {})
+    object.get(k, "enable_key_rotation", false) != true
+    res := result.new(sprintf("KMS key %q does not rotate automatically", [name]), k)
+}
