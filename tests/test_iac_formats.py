@@ -425,3 +425,70 @@ def test_trace_flag_attaches_rego_traces(tmp_path):
         for m in r.get("Misconfigurations", [])
     ]
     assert not any(untraced)
+
+
+def test_tfvars_override_defaults(tmp_path):
+    """terraform.tfvars flips a safe default to insecure; the root-dir
+    evaluation supersedes the defaults-only per-file scan."""
+    import contextlib
+    import io
+
+    from trivy_tpu.cli import main
+
+    root = tmp_path / "infra"
+    root.mkdir()
+    (root / "main.tf").write_text(
+        'variable "enc" { default = true }\n'
+        'resource "aws_ebs_volume" "d" { encrypted = var.enc }\n'
+    )
+    (root / "terraform.tfvars").write_text("enc = false\n")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(["config", "--format", "json", str(root)])
+    assert rc == 0
+    report = json.loads(buf.getvalue())
+    fails = {
+        m["ID"]
+        for r in report["Results"] or []
+        for m in r.get("Misconfigurations", [])
+        if m["Status"] == "FAIL"
+    }
+    assert "AVD-AWS-0026" in fails
+
+
+def test_tfvars_precedence_and_module_args(tmp_path):
+    """auto.tfvars wins over terraform.tfvars; tfvars values flow into
+    caller-side module arguments; child-dir tfvars are ignored."""
+    import contextlib
+    import io
+
+    from trivy_tpu.cli import main
+
+    root = tmp_path / "infra"
+    (root / "m").mkdir(parents=True)
+    (root / "m" / "main.tf").write_text(
+        'variable "e" { default = true }\n'
+        'resource "aws_ebs_volume" "d" { encrypted = var.e }\n'
+    )
+    # stray child-dir tfvars: must NOT spawn an evaluation
+    (root / "m" / "terraform.tfvars").write_text("e = false\n")
+    (root / "main.tf").write_text(
+        'variable "secure" { default = true }\n'
+        'module "m" { source = "./m"\n  e = var.secure }\n'
+    )
+    # terraform.tfvars says true, auto.tfvars (loads later) says false
+    (root / "terraform.tfvars").write_text("secure = true\n")
+    (root / "a.auto.tfvars").write_text("secure = false\n")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(["config", "--format", "json", str(root)])
+    assert rc == 0
+    report = json.loads(buf.getvalue())
+    fails = {
+        (r["Target"], m["ID"])
+        for r in report["Results"] or []
+        for m in r.get("Misconfigurations", [])
+        if m["Status"] == "FAIL"
+    }
+    # auto.tfvars secure=false -> module arg e=false -> child FAILs
+    assert ("m/main.tf", "AVD-AWS-0026") in fails

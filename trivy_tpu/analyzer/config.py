@@ -196,15 +196,22 @@ class TerraformModulePostAnalyzer(PostAnalyzer):
         return "terraform-module"
 
     def version(self) -> int:
-        return 2
+        return 3  # v3: tfvars participation (cache keys must change)
 
     def required(self, file_path: str, size: int, mode: int) -> bool:
         # .tf only: the expansion below reads HCL syntax (module calls in
         # .tf.json are out of scope, so those files are not buffered).
+        # terraform.tfvars / *.auto.tfvars join the composite FS so root
+        # directories evaluate with their variable assignments.
+        name = file_path.rsplit("/", 1)[-1]
+        if name == "terraform.tfvars" or name.endswith(".auto.tfvars"):
+            return size < 1 << 20
         return file_path.endswith(".tf") and size < 1 << 20
 
     @staticmethod
-    def _resolved_calls(docs: list[dict]) -> dict[str, dict]:
+    def _resolved_calls(
+        docs: list[dict], overrides: dict | None = None
+    ) -> dict[str, dict]:
         """Module blocks with arguments resolved in the CALLER's scope.
 
         Caller-side expressions (encrypt = var.secure) must resolve
@@ -216,7 +223,7 @@ class TerraformModulePostAnalyzer(PostAnalyzer):
 
         from trivy_tpu.iac.hcl import terraform_docs_input
 
-        resolved = terraform_docs_input(docs)
+        resolved = terraform_docs_input(docs, overrides)
         calls: dict[str, dict] = {}
         for name, blk in (resolved.get("module") or {}).items():
             if not isinstance(blk, dict):
@@ -241,7 +248,14 @@ class TerraformModulePostAnalyzer(PostAnalyzer):
 
         logger = logging.getLogger(__name__)
         by_dir: dict[str, dict[str, dict]] = {}  # dir -> path -> parsed doc
+        tfvars_files: dict[str, list[str]] = {}  # dir -> tfvars paths
         for path in fs.paths():
+            name = path.rsplit("/", 1)[-1]
+            if name == "terraform.tfvars" or name.endswith(".auto.tfvars"):
+                tfvars_files.setdefault(posixpath.dirname(path), []).append(
+                    path
+                )
+                continue
             if not path.endswith(".tf"):
                 continue
             try:
@@ -250,13 +264,70 @@ class TerraformModulePostAnalyzer(PostAnalyzer):
                 continue
             by_dir.setdefault(posixpath.dirname(path), {})[path] = doc
 
-        # child dir -> list of per-instantiation evaluated Misconfigurations
-        per_child: dict[str, list] = {}
+        # Terraform's variable precedence: terraform.tfvars loads first,
+        # then *.auto.tfvars in lexical order (later wins).
+        tfvars_by_dir: dict[str, dict] = {}
+        for d, paths in tfvars_files.items():
+            merged: dict = {}
+            for path in sorted(
+                paths,
+                key=lambda p: (
+                    0 if p.rsplit("/", 1)[-1] == "terraform.tfvars" else 1,
+                    p,
+                ),
+            ):
+                try:
+                    doc = parse_hcl(fs.read(path).decode("utf-8", "replace"))
+                except Exception:
+                    continue
+                merged.update(
+                    {k: v for k, v in doc.items() if not k.startswith("__")}
+                )
+            if merged:
+                tfvars_by_dir[d] = merged
+
+        # Resolve every dir's module calls first (tfvars participate in
+        # the caller's variable scope) to learn which dirs are module
+        # sources: terraform loads tfvars only for the ROOT module, so a
+        # stray tfvars inside a referenced child dir must not spawn an
+        # evaluation no real configuration runs.
+        calls_by_dir: dict[str, dict[str, dict]] = {}
+        child_dirs: set[str] = set()
         for parent_dir, docs_by_path in sorted(by_dir.items()):
             try:
-                calls = self._resolved_calls(list(docs_by_path.values()))
+                calls = self._resolved_calls(
+                    list(docs_by_path.values()),
+                    overrides=tfvars_by_dir.get(parent_dir),
+                )
             except Exception:
+                calls = {}
+            calls_by_dir[parent_dir] = calls
+            for blk in calls.values():
+                source = str(blk.get("source", ""))
+                if source.startswith(("./", "../")):
+                    d = posixpath.normpath(
+                        posixpath.join(parent_dir, source)
+                    )
+                    child_dirs.add("" if d == "." else d)
+
+        # child dir -> list of per-instantiation evaluated Misconfigurations
+        per_child: dict[str, list] = {}
+        # Root dirs with tfvars evaluate as instantiations of themselves
+        # (ScannerWithTFVarsPaths, terraform scanner options).
+        for d, values in sorted(tfvars_by_dir.items()):
+            if d not in by_dir or d in child_dirs:
                 continue
+            try:
+                doc = terraform_docs_input(
+                    [by_dir[d][p] for p in sorted(by_dir[d])],
+                    overrides=values,
+                )
+            except Exception as e:
+                logger.warning("tfvars evaluation failed for %s: %s", d, e)
+                continue
+            mc = shared_scanner().evaluate(d or ".", "terraform", [doc])
+            per_child.setdefault(d, []).append(mc)
+        for parent_dir, calls in sorted(calls_by_dir.items()):
             for name, blk in sorted(calls.items()):
                 source = str(blk.get("source", ""))
                 if not source.startswith(("./", "../")):
