@@ -492,3 +492,67 @@ def test_tfvars_precedence_and_module_args(tmp_path):
     }
     # auto.tfvars secure=false -> module arg e=false -> child FAILs
     assert ("m/main.tf", "AVD-AWS-0026") in fails
+
+
+def test_child_dir_tfvars_do_not_leak_to_grandchildren(tmp_path):
+    """A stray tfvars in a referenced child dir must not flip the child's
+    own module-call arguments (terraform loads root tfvars only)."""
+    import contextlib
+    import io
+
+    from trivy_tpu.cli import main
+
+    root = tmp_path / "infra"
+    (root / "m" / "gm").mkdir(parents=True)
+    (root / "m" / "gm" / "main.tf").write_text(
+        'variable "enc" { default = true }\n'
+        'resource "aws_ebs_volume" "d" { encrypted = var.enc }\n'
+    )
+    (root / "m" / "main.tf").write_text(
+        'variable "e" { default = true }\n'
+        'module "gm" { source = "./gm"\n  enc = var.e }\n'
+    )
+    (root / "m" / "terraform.tfvars").write_text("e = false\n")  # stray
+    (root / "main.tf").write_text('module "m" { source = "./m" }\n')
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(["config", "--format", "json", str(root)])
+    assert rc == 0
+    report = json.loads(buf.getvalue())
+    fails = {
+        (r["Target"], m["ID"])
+        for r in report["Results"] or []
+        for m in r.get("Misconfigurations", [])
+        if m["Status"] == "FAIL"
+    }
+    # real terraform ignores m/terraform.tfvars: gm evaluates enc=true
+    assert ("m/gm/main.tf", "AVD-AWS-0026") not in fails
+
+
+def test_tfvars_keep_per_file_targets(tmp_path):
+    """An unrelated tfvars must not migrate findings to main.tf."""
+    import contextlib
+    import io
+
+    from trivy_tpu.cli import main
+
+    root = tmp_path / "infra"
+    root.mkdir()
+    (root / "main.tf").write_text('variable "x" { default = 1 }\n')
+    (root / "s3.tf").write_text(
+        'resource "aws_ebs_volume" "d" { encrypted = false }\n'
+    )
+    (root / "terraform.tfvars").write_text("x = 2\n")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(["config", "--format", "json", str(root)])
+    assert rc == 0
+    report = json.loads(buf.getvalue())
+    fails = {
+        (r["Target"], m["ID"])
+        for r in report["Results"] or []
+        for m in r.get("Misconfigurations", [])
+        if m["Status"] == "FAIL"
+    }
+    assert ("s3.tf", "AVD-AWS-0026") in fails  # finding stays on its file
+    assert ("main.tf", "AVD-AWS-0026") not in fails

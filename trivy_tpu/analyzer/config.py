@@ -181,6 +181,11 @@ class HelmPostAnalyzer(PostAnalyzer):
         return AnalysisResult(misconfigs=misconfigs)
 
 
+def _is_tfvars(name: str) -> bool:
+    """Auto-loaded variable files (terraform's own load set)."""
+    return name == "terraform.tfvars" or name.endswith(".auto.tfvars")
+
+
 class TerraformModulePostAnalyzer(PostAnalyzer):
     """Terraform module expansion (pkg/iac/scanners/terraform executor):
     a `module` block with a local relative source evaluates the child
@@ -203,8 +208,7 @@ class TerraformModulePostAnalyzer(PostAnalyzer):
         # .tf.json are out of scope, so those files are not buffered).
         # terraform.tfvars / *.auto.tfvars join the composite FS so root
         # directories evaluate with their variable assignments.
-        name = file_path.rsplit("/", 1)[-1]
-        if name == "terraform.tfvars" or name.endswith(".auto.tfvars"):
+        if _is_tfvars(file_path.rsplit("/", 1)[-1]):
             return size < 1 << 20
         return file_path.endswith(".tf") and size < 1 << 20
 
@@ -247,11 +251,15 @@ class TerraformModulePostAnalyzer(PostAnalyzer):
         from trivy_tpu.misconf.types import Misconfiguration
 
         logger = logging.getLogger(__name__)
+
+        def norm_child(parent: str, source: str) -> str:
+            d = posixpath.normpath(posixpath.join(parent, source))
+            return "" if d == "." else d
+
         by_dir: dict[str, dict[str, dict]] = {}  # dir -> path -> parsed doc
         tfvars_files: dict[str, list[str]] = {}  # dir -> tfvars paths
         for path in fs.paths():
-            name = path.rsplit("/", 1)[-1]
-            if name == "terraform.tfvars" or name.endswith(".auto.tfvars"):
+            if _is_tfvars(path.rsplit("/", 1)[-1]):
                 tfvars_files.setdefault(posixpath.dirname(path), []).append(
                     path
                 )
@@ -286,57 +294,71 @@ class TerraformModulePostAnalyzer(PostAnalyzer):
             if merged:
                 tfvars_by_dir[d] = merged
 
-        # Resolve every dir's module calls first (tfvars participate in
-        # the caller's variable scope) to learn which dirs are module
-        # sources: terraform loads tfvars only for the ROOT module, so a
-        # stray tfvars inside a referenced child dir must not spawn an
-        # evaluation no real configuration runs.
+        # Two passes over module calls.  Pass A: resolve WITHOUT tfvars to
+        # learn which dirs are module sources (module `source` must be a
+        # literal, so tfvars cannot change the topology).  Pass B:
+        # re-resolve ROOT dirs only with their tfvars — terraform loads
+        # tfvars for the root module alone, so a stray tfvars inside a
+        # referenced child dir must influence neither its own evaluation
+        # nor its grandchild module arguments.
         calls_by_dir: dict[str, dict[str, dict]] = {}
         child_dirs: set[str] = set()
         for parent_dir, docs_by_path in sorted(by_dir.items()):
             try:
-                calls = self._resolved_calls(
-                    list(docs_by_path.values()),
-                    overrides=tfvars_by_dir.get(parent_dir),
-                )
+                calls = self._resolved_calls(list(docs_by_path.values()))
             except Exception:
                 calls = {}
             calls_by_dir[parent_dir] = calls
             for blk in calls.values():
                 source = str(blk.get("source", ""))
                 if source.startswith(("./", "../")):
-                    d = posixpath.normpath(
-                        posixpath.join(parent_dir, source)
-                    )
-                    child_dirs.add("" if d == "." else d)
+                    child_dirs.add(norm_child(parent_dir, source))
+        for parent_dir, values in sorted(tfvars_by_dir.items()):
+            if parent_dir in child_dirs or parent_dir not in by_dir:
+                continue
+            try:
+                calls_by_dir[parent_dir] = self._resolved_calls(
+                    list(by_dir[parent_dir].values()), overrides=values
+                )
+            except Exception:
+                pass
 
+        misconfigs = []
         # child dir -> list of per-instantiation evaluated Misconfigurations
         per_child: dict[str, list] = {}
-        # Root dirs with tfvars evaluate as instantiations of themselves
-        # (ScannerWithTFVarsPaths, terraform scanner options).
+        # Root dirs with tfvars evaluate PER FILE with the dir-wide
+        # variable scope + tfvars (ScannerWithTFVarsPaths): findings keep
+        # their own file's Target instead of migrating to main.tf.
         for d, values in sorted(tfvars_by_dir.items()):
             if d not in by_dir or d in child_dirs:
                 continue
-            try:
-                doc = terraform_docs_input(
-                    [by_dir[d][p] for p in sorted(by_dir[d])],
-                    overrides=values,
-                )
-            except Exception as e:
-                logger.warning("tfvars evaluation failed for %s: %s", d, e)
-                continue
-            mc = shared_scanner().evaluate(d or ".", "terraform", [doc])
-            per_child.setdefault(d, []).append(mc)
+            dir_vars: dict = {}
+            for doc in by_dir[d].values():
+                for vname, blk in (doc.get("variable") or {}).items():
+                    if isinstance(blk, dict) and "default" in blk:
+                        dir_vars[vname] = blk["default"]
+            dir_vars.update(
+                {k: v for k, v in values.items() if not k.startswith("__")}
+            )
+            for p in sorted(by_dir[d]):
+                try:
+                    doc = terraform_docs_input(
+                        [by_dir[d][p]], overrides=dir_vars
+                    )
+                except Exception as e:
+                    logger.warning(
+                        "tfvars evaluation failed for %s: %s", p, e
+                    )
+                    continue
+                mc = shared_scanner().evaluate(p, "terraform", [doc])
+                if mc.failures or mc.successes:
+                    misconfigs.append(mc)
         for parent_dir, calls in sorted(calls_by_dir.items()):
             for name, blk in sorted(calls.items()):
                 source = str(blk.get("source", ""))
                 if not source.startswith(("./", "../")):
                     continue  # registry/remote modules are out of scope
-                child_dir = posixpath.normpath(
-                    posixpath.join(parent_dir, source)
-                )
-                if child_dir == ".":
-                    child_dir = ""
+                child_dir = norm_child(parent_dir, source)
                 child_docs = by_dir.get(child_dir)
                 if not child_docs:
                     continue
@@ -356,7 +378,6 @@ class TerraformModulePostAnalyzer(PostAnalyzer):
                 )
                 per_child.setdefault(child_dir, []).append(mc)
 
-        misconfigs = []
         for child_dir, mcs in sorted(per_child.items()):
             child_paths = sorted(by_dir.get(child_dir, {}))
             if not child_paths:
