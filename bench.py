@@ -41,14 +41,14 @@ BACKEND = os.environ.get("BENCH_BACKEND", "auto")
 
 def gate_corpus(corpus, analyzer):
     """Reference analyzer gating: Required() (size/skip dirs/exts/allow
-    paths) + binary sniff + \r strip.  Returns (scan_items, index_map)."""
+    paths, batched) + binary sniff + \r strip.  Returns (scan_items,
+    index_map)."""
     from trivy_tpu.analyzer.secret import is_binary
 
+    req = analyzer.required_batch([(p, len(c)) for p, c in corpus])
     items, idx = [], []
     for i, (path, content) in enumerate(corpus):
-        if not analyzer.required(path, len(content), 0o644):
-            continue
-        if is_binary(content):
+        if not req[i] or is_binary(content):
             continue
         items.append((path, content.replace(b"\r", b"")))
         idx.append(i)

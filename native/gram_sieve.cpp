@@ -227,6 +227,12 @@ void scan_files_impl(const uint8_t* stream, int64_t n,
 
     int32_t cur = 0;
     int64_t next_start = F > 1 ? file_starts[1] : INT64_MAX;
+    // Stream position of the last screen-passing window attributed to the
+    // open file — updated for deduped (seen/recent) windows too, so it is a
+    // sound upper bound on the last gram occurrence even when that
+    // occurrence's resolution was dropped as a repeat.  on_close receives it
+    // for walk-end trimming (engine/redfa.py).
+    int64_t last_pass = -1;
     uint32_t recent[4] = {0xFFFFFFFFu, 0xFFFFFFFFu, 0xFFFFFFFFu, 0xFFFFFFFFu};
     int recent_at = 0;
     uint32_t seen_w[64];
@@ -236,10 +242,12 @@ void scan_files_impl(const uint8_t* stream, int64_t n,
         const int32_t prev = cur;
         while (cur + 1 < F && i >= file_starts[cur + 1]) ++cur;
         if (cur != prev) {
-            on_close(prev);
+            on_close(prev, last_pass);
+            last_pass = i;
             next_start = cur + 1 < F ? file_starts[cur + 1] : INT64_MAX;
             recent[0] = recent[1] = recent[2] = recent[3] = 0xFFFFFFFFu;
         } else {
+            if (i > last_pass) last_pass = i;
             const uint32_t si0 = (w * kHashMul) >> 26;
             if (seen_file[si0] == cur && seen_w[si0] == w) return;
         }
@@ -250,9 +258,13 @@ void scan_files_impl(const uint8_t* stream, int64_t n,
         recent_at = (recent_at + 1) & 3;
         // Exact resolution: binary search in each mask group's sorted value
         // range (duplicate (mask, val) grams from different probes share a
-        // run).
+        // run).  The group's own membership table screens first — the tri
+        // pre-screen only constrains bytes 0-2, so windows whose byte 3
+        // breaks a full-width gram (~3% of all windows on source text, vs
+        // ~0.4% true hits) die here on one bloom load instead of a search.
         for (size_t k = 0; k < ngroups; ++k) {
             const uint32_t x = w & gp[k].mask;
+            if (!table_probe(gp[k], x)) continue;
             int32_t lo = gp[k].start, hi = gp[k].end;
             while (lo < hi) {
                 const int32_t mid = (lo + hi) >> 1;
@@ -311,10 +323,19 @@ void scan_files_impl(const uint8_t* stream, int64_t n,
             // recently resolved window are pure re-resolution — drop them
             // vectorized (the dominant case: keyword runs).  Not applied
             // across file boundaries, where attribution must restart.
+            const __mmask16 m0 = m;
             m &= ~_mm512_cmpeq_epi32_mask(w, _mm512_set1_epi32((int32_t)recent[0]));
             m &= ~_mm512_cmpeq_epi32_mask(w, _mm512_set1_epi32((int32_t)recent[1]));
             m &= ~_mm512_cmpeq_epi32_mask(w, _mm512_set1_epi32((int32_t)recent[2]));
             m &= ~_mm512_cmpeq_epi32_mask(w, _mm512_set1_epi32((int32_t)recent[3]));
+            if (m0 != m) {
+                // Dropped lanes are still screen passes of the open file:
+                // fold the highest into last_pass so walk-end trimming
+                // cannot understate the final gram occurrence.
+                const int64_t dp =
+                    i + (31 - __builtin_clz((uint32_t)(m0 & ~m)));
+                if (dp > last_pass) last_pass = dp;
+            }
             if (!m) continue;
         }
         uint32_t wv[16];
@@ -367,7 +388,7 @@ void scan_files_impl(const uint8_t* stream, int64_t n,
         resolve(i, w);
     }
 #endif
-    on_close(cur);
+    on_close(cur, last_pass);
 }
 
 }  // namespace
@@ -383,7 +404,7 @@ void gram_sieve_files(const uint8_t* stream, int64_t n,
     scan_files_impl(
         stream, n, file_starts, F, masks, vals, G,
         [&](int32_t f, int32_t g, int64_t) { out[(size_t)f * G + g] = 1; },
-        [](int32_t) {});
+        [](int32_t, int64_t) {});
 }
 
 // Fused scan: sieve + per-file candidate-rule resolution in one pass.
@@ -405,8 +426,10 @@ void gram_sieve_files(const uint8_t* stream, int64_t n,
 //       per-rule conjuncts, each an OR-list of probe ids
 //
 // Returns the number of pairs found; writes at most `cap` pairs to
-// out_pairs as (file, rule) int32 couples.  A return > cap means the caller
-// must retry with a larger buffer.
+// out_pairs as (file, rule, first_hit, last_hit) int32 quads — the hit
+// columns are window-start offsets (within the file) of the first and last
+// screen-passing window, the walk-trim hints for dfa_verify_pairs.  A
+// return > cap means the caller must retry with a larger buffer.
 int64_t gram_sieve_scan(const uint8_t* stream, int64_t n,
                         const int64_t* file_starts, int32_t F,
                         const uint32_t* masks, const uint32_t* vals, int32_t G,
@@ -431,9 +454,10 @@ int64_t gram_sieve_scan(const uint8_t* stream, int64_t n,
             first_hit = (int32_t)(pos - file_starts[f]);
         }
     };
-    auto on_close = [&](int32_t f) {
+    auto on_close = [&](int32_t f, int64_t last_pass) {
         if (!any_hit) return;
         any_hit = false;
+        const int32_t last_hit = (int32_t)(last_pass - file_starts[f]);
         memset(cnt.data(), 0, (size_t)P * 4);
         for (int32_t w2 = 0; w2 < W; ++w2)
             if (win_hit[w2]) ++cnt[window_probe[w2]];
@@ -454,9 +478,10 @@ int64_t gram_sieve_scan(const uint8_t* stream, int64_t n,
             }
             if (!ok) continue;
             if (found < cap) {
-                out_pairs[found * 3] = f;
-                out_pairs[found * 3 + 1] = r;
-                out_pairs[found * 3 + 2] = first_hit;
+                out_pairs[found * 4] = f;
+                out_pairs[found * 4 + 1] = r;
+                out_pairs[found * 4 + 2] = first_hit;
+                out_pairs[found * 4 + 3] = last_hit;
             }
             ++found;
         }
@@ -476,6 +501,7 @@ int64_t gram_sieve_scan(const uint8_t* stream, int64_t n,
 void dfa_verify_pairs(const uint8_t* stream, const int64_t* file_starts,
                       const int64_t* file_lens, const int32_t* pair_file,
                       const int32_t* pair_rule, const int32_t* pair_hint,
+                      const int32_t* pair_hint_last,
                       int64_t npairs,
                       const int32_t* prefix_bound,  // [R]; INT32_MAX = no trim
                       const uint8_t* mode,          // [R]
@@ -497,17 +523,25 @@ void dfa_verify_pairs(const uint8_t* stream, const int64_t* file_starts,
         const uint8_t* lut = cls_luts + (size_t)r * 256;
         const uint8_t* sok = start_ok + (size_t)r * 256;
         const int32_t f = pair_file[k];
-        // Sound walk-start trim: any match contains a gram occurrence, and
-        // the file's first gram hit is at pair_hint; a bounded-length rule's
-        // match can start at most prefix_bound before it.
+        // Sound walk trims: any match contains a gram occurrence, the
+        // file's gram hits span [pair_hint, pair_hint_last], and a
+        // bounded-length rule's match starts at most prefix_bound before
+        // its gram occurrence and ends at most prefix_bound after it
+        // (prefix_bound is max_len of the whole regex).
         int64_t skip = 0;
+        int64_t walk_end = file_lens[f];
         if (pair_hint && prefix_bound[r] != INT32_MAX) {
             skip = (int64_t)pair_hint[k] - prefix_bound[r];
             if (skip < 0) skip = 0;
             if (skip > file_lens[f]) skip = file_lens[f];
+            if (pair_hint_last) {
+                const int64_t e =
+                    (int64_t)pair_hint_last[k] + prefix_bound[r] + 8;
+                if (e < walk_end) walk_end = e;
+            }
         }
         const uint8_t* p = stream + file_starts[f] + skip;
-        const uint8_t* end = stream + file_starts[f] + file_lens[f];
+        const uint8_t* end = stream + file_starts[f] + walk_end;
         uint8_t ok = 0;
         // In the start state, fast-forward to the next byte that can begin
         // a match (the RE2 memchr trick): on miss-dominated files almost

@@ -236,3 +236,127 @@ def test_shared_empty_secret_stays_empty(engine):
             assert not r.findings and not r.file_path
     assert any(r is _EMPTY_SECRET for r in first)
     assert not _EMPTY_SECRET.findings and not _EMPTY_SECRET.file_path
+
+
+@needs_native
+def test_walk_end_trim_secret_after_repeated_windows(engine, oracle):
+    """r3 walk-end trim hazard: the file's LAST gram occurrence repeats an
+    earlier window byte-for-byte, so its resolution is dropped by the
+    seen-set dedup — the end hint must still cover it (last_pass tracks
+    screen passes, not resolutions), or the match at the end of the file
+    falls outside the clipped DFA walk and the finding is lost."""
+    secret = b'ghp_' + b"Q" * 36
+    filler = (b"x = 1\n" * 40)
+    # 'ghp_' window fires early (no match), repeats at the end (match).
+    content = b'g = "ghp_none"\n' + filler + b'token = "' + secret + b'"\n'
+    _assert_parity(engine, oracle, [("end.py", content)])
+    # Same shape with the repeat inside one AVX block's recent-filter span.
+    content2 = b'ghp_x ghp_x ghp_x token = "' + secret + b'"\n'
+    _assert_parity(engine, oracle, [("end2.py", content2)])
+
+
+@needs_native
+def test_walk_end_trim_secret_far_from_first_hit(engine, oracle):
+    """A match megabytes after the first gram hit: the end hint (last
+    screen pass) must extend the walk to it."""
+    secret = b"AKIA" + b"Z" * 16
+    content = (
+        b"aws_thing = 1\n" + b"int filler_line = 0;\n" * 40000
+        + b"key = " + secret + b"\n"
+    )
+    _assert_parity(engine, oracle, [("far.cfg", content)])
+
+
+def test_allow_paths_batch_matches_per_path(engine):
+    """Batched multiline allow_paths == per-path allow_path over paths
+    exercising every builtin allow rule plus misses."""
+    rs = engine.ruleset
+    paths = [
+        "src/app/main.py", "vendor/lib/a.go", "usr/share/doc/x",
+        "docs/README.md", "a/test/b.py", "node_modules/x/y.js",
+        "usr/local/go/src/fmt/print.go", "var/log/anaconda/x.log",
+        "examples/demo.py", "deep/locales/en/msg.po", "plain.txt",
+        "opt/yarn-v1.22.0/bin/yarn", "usr/lib/gems/specs/a",
+        "testdata.md", "md.not", "a-test-file.c", "xtest/notmatch",
+    ]
+    got = rs.allow_paths(paths)
+    want = [rs.allow_path(p) for p in paths]
+    assert got == want
+
+
+def test_allow_paths_batch_falls_back_on_unsafe_patterns():
+    """A negated class could match across the newline join; allow_paths
+    must detect it and fall back to exact per-path evaluation."""
+    from trivy_tpu.engine.goregex import compile_str
+    from trivy_tpu.rules.model import AllowRule, RuleSet, build_batch_allow_path
+
+    unsafe = AllowRule(
+        id="u", description="", regex=None, regex_src="",
+        path=compile_str(r"a[^b]c"), path_src=r"a[^b]c",
+    )
+    assert build_batch_allow_path([unsafe]) is None
+    rs = RuleSet(rules=[], allow_rules=[unsafe])
+    paths = ["axc/file.txt", "abc/file.txt", "plain.py"]
+    assert rs.allow_paths(paths) == [rs.allow_path(p) for p in paths]
+
+
+def test_allow_paths_newline_in_path_falls_back(engine):
+    rs = engine.ruleset
+    paths = ["ok/vendor/x.go", "weird\nvendor/name", "plain.c"]
+    assert rs.allow_paths(paths) == [rs.allow_path(p) for p in paths]
+
+
+def test_required_batch_matches_required():
+    """Batched claim pass == per-file required() on paths exercising every
+    gate: size, skip dirs (component-exact), skip files, skip exts
+    (including splitext's leading-dot corner), allow paths."""
+    from trivy_tpu.analyzer.secret import SecretAnalyzer
+
+    a = SecretAnalyzer()
+    cases = [
+        ("src/main.py", 100), ("tiny.py", 5), ("a/.git/config", 80),
+        ("x/node_modules/p/index.js", 80), ("node_modules", 80),
+        ("my.git/file.py", 80), ("go.sum", 80), ("sub/go.mod", 80),
+        ("img/logo.png", 500), (".png", 500), ("a/..png", 500),
+        ("archive.tar", 80), ("doc/readme.md", 80), ("vendor/lib/a.go", 80),
+        ("test/unit.py", 80), ("w.pyc", 80), ("pnpm-lock.yaml", 80),
+        ("deep/usr/share/x", 80), ("usr/share/x", 80),
+    ]
+    got = a.required_batch(cases)
+    want = [a.required(p, s, 0o644) for p, s in cases]
+    assert got == want
+
+
+def test_batch_safe_exact_newline_detection():
+    """Review repro: escapes and class ranges that consume a newline must
+    be rejected; common path patterns must stay batch-safe."""
+    from trivy_tpu.rules.model import _batch_safe
+
+    unsafe = [
+        "o\x0abar", r"o\x0abar", r"a[\t-\r]b", r"a[^b]c", r"\s+", r"x\W",
+        r"(?s)a.c", r"(?s:a.c)", r"\Ausr/", r"end\Z", "lit\nnl",
+    ]
+    safe = [
+        r"(^test|\/test|-test|_test|\.test)", r"\.md$", r"\/vendor\/",
+        r"^usr\/(?:share|include|lib)\/", r"^opt\/yarn-v[\d.]+\/",
+        r"a.c", r"(a|b)+x?", r"(?i)readme", r"\bword\b",
+    ]
+    for p in unsafe:
+        assert not _batch_safe(p), p
+    for p in safe:
+        assert _batch_safe(p), p
+
+
+def test_allow_paths_newline_escape_rule_falls_back():
+    """End-to-end: a rule whose path regex consumes \\x0a must not let the
+    batch join fabricate an allow verdict."""
+    from trivy_tpu.engine.goregex import compile_str
+    from trivy_tpu.rules.model import AllowRule, RuleSet
+
+    r = AllowRule(
+        id="nl", description="", regex=None, regex_src="",
+        path=compile_str("o\x0abar"), path_src="o\x0abar",
+    )
+    rs = RuleSet(rules=[], allow_rules=[r])
+    paths = ["xfoo", "bar.py", "plain.c"]
+    assert rs.allow_paths(paths) == [rs.allow_path(p) for p in paths] == [False]*3

@@ -310,12 +310,27 @@ class AnalyzerGroup:
         from trivy_tpu import deadline
 
         claims: dict[int, list[FileEntry]] = {i: [] for i in range(len(self.analyzers))}
-        for entry in entries:
+        entries = list(entries)  # metadata + lazy openers only
+        # Analyzers exposing required_batch (the secret analyzer: batched
+        # allow-path regex) answer the claim pass for all entries at once.
+        batch_req: dict[int, list[bool]] = {}
+        for i, a in enumerate(self.analyzers):
+            if disabled and a.type() in disabled:
+                continue
+            rb = getattr(a, "required_batch", None)
+            if rb is not None:
+                batch_req[i] = rb([(e.path, e.size) for e in entries])
+        for k, entry in enumerate(entries):
             deadline.check()
             for i, a in enumerate(self.analyzers):
                 if disabled and a.type() in disabled:
                     continue
-                if a.required(entry.path, entry.size, entry.mode):
+                br = batch_req.get(i)
+                if (
+                    br[k]
+                    if br is not None
+                    else a.required(entry.path, entry.size, entry.mode)
+                ):
                     claims[i].append(entry)
             for j, p in enumerate(self.post_analyzers):
                 if disabled and p.type() in disabled:

@@ -34,6 +34,10 @@ SKIP_FILES = {
     "Gemfile.lock",
 }
 SKIP_DIRS = {".git", "node_modules"}
+# Component-exact substring needles for the batched claim pass: dir
+# components are always followed by "/" in a full path, the basename never
+# is (derived so SKIP_DIRS edits propagate).
+_SKIP_DIR_NEEDLES = tuple(f"/{d}/" for d in SKIP_DIRS)
 SKIP_EXTS = {
     ".jpg", ".png", ".gif", ".doc", ".pdf", ".bin", ".svg", ".socket",
     ".deb", ".rpm", ".zip", ".gz", ".gzip", ".tar", ".pyc",
@@ -137,6 +141,45 @@ class SecretAnalyzer(BatchAnalyzer):
         eng = self.engine
         ruleset = getattr(eng, "ruleset", None)
         return bool(ruleset and ruleset.allow_path(file_path))
+
+    def required_batch(self, files: list[tuple[str, int]]) -> list[bool]:
+        """required() over a corpus in one pass — identical verdicts, but
+        the allow-path gate runs as one batched multiline search
+        (RuleSet.allow_paths) and the dir/file gates as C-speed substring
+        tests instead of per-file path splitting; the rare endswith hit
+        falls back to splitext for exact parity (secret.go:115-153)."""
+        ruleset = getattr(self.engine, "ruleset", None)
+        if ruleset is not None:
+            allowed = ruleset.allow_paths([p for p, _ in files])
+        else:
+            allowed = [False] * len(files)
+        skip_ext_tuple = tuple(SKIP_EXTS)
+        cfg_skips = self._config_skip_paths
+        sep = os.sep
+        out = []
+        for (path, size), al in zip(files, allowed):
+            if size < 10 or al:
+                out.append(False)
+                continue
+            p = path.replace(sep, "/") if sep != "/" else path
+            slashed = "/" + p
+            if any(nd in slashed for nd in _SKIP_DIR_NEEDLES):
+                out.append(False)
+                continue
+            base = p.rsplit("/", 1)[-1]
+            if base in SKIP_FILES:
+                out.append(False)
+                continue
+            if cfg_skips and p in cfg_skips:
+                out.append(False)
+                continue
+            if base.endswith(skip_ext_tuple) and (
+                os.path.splitext(base)[1] in SKIP_EXTS
+            ):
+                out.append(False)
+                continue
+            out.append(True)
+        return out
 
     @staticmethod
     def _effective_path(inp: AnalysisInput) -> str:

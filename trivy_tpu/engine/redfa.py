@@ -322,9 +322,15 @@ class DfaVerifier:
         pair_file: np.ndarray,
         pair_rule: np.ndarray,
         pair_hint: np.ndarray | None = None,
+        pair_hint_last: np.ndarray | None = None,
     ) -> np.ndarray:
         """uint8[N]: 1 when the pair's rule matches somewhere in the file
-        (or has no automaton and must be confirmed by the oracle)."""
+        (or has no automaton and must be confirmed by the oracle).
+
+        `pair_hint`/`pair_hint_last`: per-pair offsets of the file's first
+        and last screen-passing window; for rules with a finite
+        prefix_bound the walk is clipped to
+        [hint - bound, hint_last + bound + slack] (see dfa_verify_pairs)."""
         n = len(pair_file)
         out = np.ones(n, dtype=np.uint8)
         if n == 0 or not self.compiled:
@@ -336,12 +342,18 @@ class DfaVerifier:
         pair_rule = np.ascontiguousarray(pair_rule, dtype=np.int32)
         if pair_hint is not None:
             pair_hint = np.ascontiguousarray(pair_hint, dtype=np.int32)
+        if pair_hint_last is not None:
+            pair_hint_last = np.ascontiguousarray(pair_hint_last, dtype=np.int32)
         if lib is not None and hasattr(lib, "dfa_verify_pairs"):
             lib.dfa_verify_pairs(
                 stream.ctypes.data,
                 file_starts.ctypes.data, file_lens.ctypes.data,
                 pair_file.ctypes.data, pair_rule.ctypes.data,
-                pair_hint.ctypes.data if pair_hint is not None else None, n,
+                pair_hint.ctypes.data if pair_hint is not None else None,
+                pair_hint_last.ctypes.data
+                if pair_hint is not None and pair_hint_last is not None
+                else None,
+                n,
                 self.prefix_bound.ctypes.data,
                 self.mode.ctypes.data, self.luts.ctypes.data,
                 self.trans_blob.ctypes.data, self.trans_off.ctypes.data,
@@ -363,12 +375,18 @@ class DfaVerifier:
             f = int(pair_file[k])
             lo = int(file_starts[f])
             skip = 0
+            walk_end = int(file_lens[f])
             if pair_hint is not None and self.prefix_bound[r] != np.iinfo(np.int32).max:
                 skip = min(
                     max(int(pair_hint[k]) - int(self.prefix_bound[r]), 0),
-                    int(file_lens[f]),
+                    walk_end,
                 )
-            cls = self.luts[r][stream[lo + skip : lo + int(file_lens[f])]]
+                if pair_hint_last is not None:
+                    walk_end = min(
+                        walk_end,
+                        int(pair_hint_last[k]) + int(self.prefix_bound[r]) + 8,
+                    )
+            cls = self.luts[r][stream[lo + skip : lo + walk_end]]
             c = int(self.n_classes[r])
             ok = 0
             if mode == MODE_DFA:

@@ -110,6 +110,7 @@ def load_native() -> ctypes.CDLL | None:
             lib.dfa_verify_pairs.argtypes = [
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p,
                 ctypes.c_int64,
                 ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_void_p,

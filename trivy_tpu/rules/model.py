@@ -133,6 +133,113 @@ def allow_rules_allow(rules: list[AllowRule], match: bytes) -> bool:
     return any(r.regex is not None and r.regex.search(match) for r in rules)
 
 
+def _batch_safe(pat: str) -> bool:
+    """True iff the pattern can be evaluated against "\n"-joined paths with
+    per-path semantics: no construct can consume a newline (so no match
+    spans a join boundary — checked exactly on the sre parse tree, which
+    catches \\x0a, octal escapes, and class ranges like [\\t-\\r] that a
+    source-text heuristic misses), no dotall, and no absolute anchors
+    (\\A/\\Z change meaning under re.MULTILINE).  Unknown constructs and
+    parse failures are unsafe — a false negative only costs the per-path
+    fallback."""
+    try:
+        import re._parser as sre  # Python >= 3.11
+    except ImportError:  # pragma: no cover
+        import sre_parse as sre  # type: ignore[no-redef]
+    try:
+        tree = sre.parse(pat)
+    except Exception:
+        return False
+    if tree.state.flags & re.DOTALL:
+        return False
+    nl = 10
+
+    def leaf_safe(op, av) -> bool:
+        name = str(op)
+        if name == "LITERAL":
+            return av != nl
+        if name == "NOT_LITERAL":
+            return False  # matches everything but one char, incl. \n
+        if name == "RANGE":
+            return not (av[0] <= nl <= av[1])
+        if name == "CATEGORY":
+            return str(av) in ("CATEGORY_DIGIT", "CATEGORY_WORD")
+        if name == "NEGATE":
+            return False  # negated class: conservatively newline-capable
+        if name == "ANY":
+            return True  # '.' without DOTALL (checked above)
+        if name == "AT":
+            return str(av) not in ("AT_BEGINNING_STRING", "AT_END_STRING")
+        return False
+
+    def walk(items) -> bool:
+        for op, av in items:
+            name = str(op)
+            if name == "IN":
+                if not all(leaf_safe(iop, iav) for iop, iav in av):
+                    return False
+            elif name in ("LITERAL", "NOT_LITERAL", "ANY", "AT"):
+                if not leaf_safe(op, av):
+                    return False
+            elif name == "SUBPATTERN":
+                _g, add_flags, _del_flags, sub = av
+                if add_flags & re.DOTALL or not walk(sub):
+                    return False
+            elif name in ("MAX_REPEAT", "MIN_REPEAT", "POSSESSIVE_REPEAT"):
+                if not walk(av[2]):
+                    return False
+            elif name == "BRANCH":
+                if not all(walk(b) for b in av[1]):
+                    return False
+            elif name in ("ASSERT", "ASSERT_NOT"):
+                if not walk(av[1]):
+                    return False
+            elif name == "ATOMIC_GROUP":
+                if not walk(av):
+                    return False
+            elif name == "GROUPREF":
+                continue  # repeats an already-vetted group's match
+            elif name == "GROUPREF_EXISTS":
+                _g, yes, no = av
+                if not walk(yes) or (no is not None and not walk(no)):
+                    return False
+            else:
+                return False
+        return True
+
+    return walk(tree)
+
+
+def build_batch_allow_path(
+    rules: list[AllowRule],
+) -> "re.Pattern[str] | None":
+    """Combined allow-path alternation compiled for BATCH mode: one
+    re.MULTILINE search over newline-joined paths answers allow_path for a
+    whole corpus (each path is one line; `^`/`$` anchor per line exactly as
+    they anchor a single path).  Returns None — callers fall back to
+    per-path allow_path — when any pattern could match a newline or carries
+    an absolute anchor (see _BATCH_UNSAFE)."""
+    pats = []
+    for r in rules:
+        if r.path is None:
+            continue
+        if not r.path_src:
+            return None
+        try:
+            p = goregex.go_to_python(r.path_src)
+        except goregex.GoRegexError:
+            return None
+        if not _batch_safe(p):
+            return None
+        pats.append("(?:%s)" % p)
+    if not pats:
+        return None
+    try:
+        return re.compile("|".join(pats), re.MULTILINE)
+    except re.error:
+        return None
+
+
 @dataclass
 class SecretConfig:
     """scanner.go:28-42 Config (the trivy-secret.yaml schema)."""
@@ -160,6 +267,12 @@ class RuleSet:
     _combined_built: bool = field(
         default=False, init=False, repr=False, compare=False
     )
+    _batch_allow_path: "re.Pattern[str] | None" = field(
+        default=None, init=False, repr=False, compare=False
+    )
+    _batch_built: bool = field(
+        default=False, init=False, repr=False, compare=False
+    )
 
     def allow(self, match: bytes) -> bool:
         return allow_rules_allow(self.allow_rules, match)
@@ -173,6 +286,33 @@ class RuleSet:
         if self._combined_allow_path is not None:
             return self._combined_allow_path.search(path) is not None
         return allow_rules_allow_path(self.allow_rules, path)
+
+    def allow_paths(self, paths: list[str]) -> list[bool]:
+        """allow_path over a whole corpus: one multiline search of the
+        newline-joined paths (then map match offsets back to lines) instead
+        of one regex call per path — ~20x fewer interpreter round-trips on
+        a 100k-file scan.  Exact fallback to the per-path loop when a
+        pattern is batch-unsafe or a path embeds a newline."""
+        if not paths:
+            return []
+        if not any(r.path is not None for r in self.allow_rules):
+            return [False] * len(paths)
+        if not self._batch_built:
+            self._batch_allow_path = build_batch_allow_path(self.allow_rules)
+            self._batch_built = True
+        rx = self._batch_allow_path
+        joined = "\n".join(paths)
+        if rx is None or joined.count("\n") != len(paths) - 1:
+            return [self.allow_path(p) for p in paths]
+        import bisect
+        from itertools import accumulate
+
+        starts = [0]
+        starts.extend(accumulate(len(p) + 1 for p in paths[:-1]))
+        out = [False] * len(paths)
+        for m in rx.finditer(joined):
+            out[bisect.bisect_right(starts, m.start()) - 1] = True
+        return out
 
 
 def convert_severity(severity: str) -> str:
