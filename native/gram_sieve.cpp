@@ -492,6 +492,43 @@ int64_t gram_sieve_scan(const uint8_t* stream, int64_t n,
     return found;
 }
 
+namespace {
+
+// Fast-forward to the next byte that can leave the rule's start state.
+// Start sets are tiny in practice (83/86 builtin rules have 1-2 bytes):
+// one byte -> glibc memchr (vectorized), 2-4 bytes -> AVX-512 compares,
+// else the generic table walk.  sb/nsb: explicit start-byte list (nsb 0
+// when the set is too large to enumerate).
+inline const uint8_t* skip_to_start(const uint8_t* p, const uint8_t* end,
+                                    const uint8_t* sok, const uint8_t* sb,
+                                    int32_t nsb) {
+    if (nsb == 1) {
+        const void* q = memchr(p, sb[0], (size_t)(end - p));
+        return q ? (const uint8_t*)q : end;
+    }
+#ifdef TRIVY_TPU_AVX512
+    if (nsb >= 2 && nsb <= 4) {
+        const __m512i v0 = _mm512_set1_epi8((char)sb[0]);
+        const __m512i v1 = _mm512_set1_epi8((char)sb[1]);
+        const __m512i v2 = _mm512_set1_epi8((char)sb[nsb > 2 ? 2 : 1]);
+        const __m512i v3 = _mm512_set1_epi8((char)sb[nsb > 3 ? 3 : 1]);
+        while (p + 64 <= end) {
+            const __m512i v = _mm512_loadu_si512(p);
+            const __mmask64 m = _mm512_cmpeq_epi8_mask(v, v0) |
+                                _mm512_cmpeq_epi8_mask(v, v1) |
+                                _mm512_cmpeq_epi8_mask(v, v2) |
+                                _mm512_cmpeq_epi8_mask(v, v3);
+            if (m) return p + __builtin_ctzll(m);
+            p += 64;
+        }
+    }
+#endif
+    while (p < end && !sok[*p]) ++p;
+    return p;
+}
+
+}  // namespace
+
 // Automaton verification of candidate (file, rule) pairs (engine/redfa.py).
 // mode[r]: 0 = no automaton (stay verified=1, oracle confirms), 1 = search
 // DFA (one class lookup + one transition lookup per byte), 2 = bit-parallel
@@ -513,7 +550,10 @@ void dfa_verify_pairs(const uint8_t* stream, const int64_t* file_starts,
                       const uint64_t* cmask_blob, const int64_t* cmask_off,
                       const uint64_t* nfa_first, const uint64_t* nfa_last,
                       const uint8_t* start_ok,      // [R, 256]: byte can leave
-                      uint8_t* out_verified) {      //   the start state
+                                                    //   the start state
+                      const uint8_t* start_bytes,   // [R, 4] enumerated set
+                      const int32_t* start_nbytes,  // [R]; 0 = use start_ok
+                      uint8_t* out_verified) {
     for (int64_t k = 0; k < npairs; ++k) {
         const int32_t r = pair_rule[k];
         if (mode[r] == 0) {
@@ -543,14 +583,16 @@ void dfa_verify_pairs(const uint8_t* stream, const int64_t* file_starts,
         const uint8_t* p = stream + file_starts[f] + skip;
         const uint8_t* end = stream + file_starts[f] + walk_end;
         uint8_t ok = 0;
+        const uint8_t* sb = start_bytes + (size_t)r * 4;
+        const int32_t nsb = start_nbytes[r];
         // In the start state, fast-forward to the next byte that can begin
-        // a match (the RE2 memchr trick): on miss-dominated files almost
-        // every byte is skipped at ~1 table load instead of an automaton
-        // step.  The skip run re-engages whenever the automaton falls back
-        // to its start state.
+        // a match (the RE2 memchr trick, vectorized — see skip_to_start):
+        // on miss-dominated files almost every byte is skipped at memchr
+        // speed instead of an automaton step.  The skip run re-engages
+        // whenever the automaton falls back to its start state.
 #define TRIVY_TPU_SKIP_RUN()                                   \
         do {                                                   \
-            while (p < end && !sok[*p]) ++p;                   \
+            p = skip_to_start(p, end, sok, sb, nsb);           \
         } while (0)
         if (mode[r] == 1) {
             const uint16_t* trans = trans_blob + trans_off[r];

@@ -133,6 +133,29 @@ def probe_gram_windows(
         )
         if abs(far[1] - best[1]) >= 2:
             chosen.append(far)
+        else:
+            # No window sits >= 2 from the best one (6-byte factors have
+            # starts 0..2 only), but a pair across the whole span may:
+            # "twitch" -> "twit" AND "itch", which a containing word like
+            # "switch" cannot satisfy — its best-scored window "witc" alone
+            # fires on essentially every C file.  Overlap keeps soundness
+            # (every factor occurrence contains all its sub-windows).
+            pairs = [
+                (a, b)
+                for i, a in enumerate(scored)
+                for b in scored[i + 1 :]
+                if abs(a[1] - b[1]) >= 2
+            ]
+            if pairs:
+                chosen = list(
+                    max(
+                        pairs,
+                        key=lambda ab: (
+                            abs(ab[0][1] - ab[1][1]),
+                            ab[0][0] + ab[1][0],
+                        ),
+                    )
+                )
 
     return [_window_variants(plan) for _score, _start, plan in chosen]
 

@@ -301,6 +301,16 @@ class DfaVerifier:
                 coff += nfa.classmask.size
         self.compiled = int((self.mode != MODE_NONE).sum())
         self.luts = luts
+        # Enumerated start sets for the vectorized skip (memchr / AVX
+        # compares in skip_to_start); nbytes 0 = set too large, generic
+        # table walk.
+        self.start_bytes = np.zeros((r, 4), dtype=np.uint8)
+        self.start_nbytes = np.zeros(r, dtype=np.int32)
+        for i in range(r):
+            bs = np.flatnonzero(self.start_ok[i])
+            if 0 < len(bs) <= 4:
+                self.start_bytes[i, : len(bs)] = bs
+                self.start_nbytes[i] = len(bs)
         self.trans_blob = (
             np.concatenate(trans_parts) if trans_parts else np.zeros(0, np.uint16)
         )
@@ -363,6 +373,7 @@ class DfaVerifier:
                 self.cmask_blob.ctypes.data, self.cmask_off.ctypes.data,
                 self.nfa_first.ctypes.data, self.nfa_last.ctypes.data,
                 self.start_ok.ctypes.data,
+                self.start_bytes.ctypes.data, self.start_nbytes.ctypes.data,
                 out.ctypes.data,
             )
             return out

@@ -120,7 +120,9 @@ def load_native() -> ctypes.CDLL | None:
                 ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p,
             ]
             lib.dfa_verify_pairs.restype = None
             _lib = lib

@@ -210,6 +210,78 @@ def _batch_safe(pat: str) -> bool:
     return walk(tree)
 
 
+def _required_literals(pat: str) -> tuple[list[str], bool] | None:
+    """(literals, case_insensitive) such that every match of `pat` contains
+    at least one of the literals, or None when no useful factor exists.
+
+    Drives the batch allow-path fast path: literal occurrences are located
+    in the newline-joined corpus at C speed (str.find), and the exact
+    original pattern runs only on the few candidate lines — per-path
+    semantics are untouched, so anchors, \\Z, and newline-capable classes
+    need no special casing.  Conservative: a None only costs the slower
+    fallback tier."""
+    try:
+        import re._parser as sre  # Python >= 3.11
+    except ImportError:  # pragma: no cover
+        import sre_parse as sre  # type: ignore[no-redef]
+    try:
+        tree = sre.parse(pat)
+    except Exception:
+        return None
+
+    def walk(items) -> set[str] | None:
+        """Best alternative-set for one sequence (None = nothing usable)."""
+        candidates: list[set[str]] = []
+        run: list[str] = []
+
+        def flush():
+            if len(run) >= 3:
+                candidates.append({"".join(run)})
+            run.clear()
+
+        for op, av in items:
+            name = str(op)
+            if name == "LITERAL":
+                run.append(chr(av))
+                continue
+            flush()
+            if name == "SUBPATTERN":
+                _g, add_flags, _del_flags, sub = av
+                if add_flags:  # scoped flags change literal semantics
+                    continue
+                sub_alts = walk(sub)
+                if sub_alts:
+                    candidates.append(sub_alts)
+            elif name == "BRANCH":
+                bs = [walk(b) for b in av[1]]
+                if all(b for b in bs):
+                    candidates.append(set().union(*bs))
+            elif name in ("MAX_REPEAT", "MIN_REPEAT", "POSSESSIVE_REPEAT"):
+                lo = av[0]
+                if lo >= 1:
+                    sub_alts = walk(av[2])
+                    if sub_alts:
+                        candidates.append(sub_alts)
+            # everything else (IN, ANY, AT, ...) just breaks the run
+        flush()
+        if not candidates:
+            return None
+        return max(candidates, key=lambda s: min(len(x) for x in s))
+
+    alts = walk(tree)
+    if not alts or min(len(a) for a in alts) < 3:
+        return None
+    # A member containing another member is redundant (finding the shorter
+    # one covers it).
+    slim = [
+        a for a in alts if not any(b != a and b in a for b in alts)
+    ]
+    ci = bool(tree.state.flags & re.IGNORECASE)
+    if ci:
+        slim = [a.lower() for a in slim]
+    return slim, ci
+
+
 def build_batch_allow_path(
     rules: list[AllowRule],
 ) -> "re.Pattern[str] | None":
@@ -267,11 +339,8 @@ class RuleSet:
     _combined_built: bool = field(
         default=False, init=False, repr=False, compare=False
     )
-    _batch_allow_path: "re.Pattern[str] | None" = field(
+    _path_strats: "list[tuple[AllowRule, str, object]] | None" = field(
         default=None, init=False, repr=False, compare=False
-    )
-    _batch_built: bool = field(
-        default=False, init=False, repr=False, compare=False
     )
 
     def allow(self, match: bytes) -> bool:
@@ -287,31 +356,90 @@ class RuleSet:
             return self._combined_allow_path.search(path) is not None
         return allow_rules_allow_path(self.allow_rules, path)
 
+    def _build_path_strats(self) -> "list[tuple[AllowRule, str, object]]":
+        """Per path-rule batch strategy, best first:
+        "lit":  required literal factors exist — find them in the joined
+                corpus at C speed, run the EXACT per-path regex only on
+                candidate lines (anchors/\\Z/newline classes need no care).
+        "scan": no literals, but the pattern provably cannot consume a
+                newline — one re.MULTILINE finditer over the joined text.
+        "per":  exact per-path loop."""
+        strats: list[tuple[AllowRule, str, object]] = []
+        for r in self.allow_rules:
+            if r.path is None:
+                continue
+            src = r.path_src
+            if src:
+                try:  # literal harvest from what r.path was compiled from
+                    src = goregex.go_to_python(src)
+                except goregex.GoRegexError:
+                    src = ""
+            lits = _required_literals(src) if src else None
+            if lits is not None:
+                strats.append((r, "lit", lits))
+                continue
+            scan_rx = build_batch_allow_path([r]) if r.path_src else None
+            if scan_rx is not None:
+                strats.append((r, "scan", scan_rx))
+            else:
+                strats.append((r, "per", None))
+        return strats
+
     def allow_paths(self, paths: list[str]) -> list[bool]:
-        """allow_path over a whole corpus: one multiline search of the
-        newline-joined paths (then map match offsets back to lines) instead
-        of one regex call per path — ~20x fewer interpreter round-trips on
-        a 100k-file scan.  Exact fallback to the per-path loop when a
-        pattern is batch-unsafe or a path embeds a newline."""
+        """allow_path over a whole corpus in (mostly) C time: literal
+        factors of each allow pattern are located in the newline-joined
+        path text via str.find, and the exact pattern runs only on the few
+        candidate lines — ~25x cheaper than a per-path regex call at 100k
+        files, with byte-identical verdicts (scanner.go:200-207)."""
         if not paths:
             return []
         if not any(r.path is not None for r in self.allow_rules):
             return [False] * len(paths)
-        if not self._batch_built:
-            self._batch_allow_path = build_batch_allow_path(self.allow_rules)
-            self._batch_built = True
-        rx = self._batch_allow_path
+        if self._path_strats is None:
+            self._path_strats = self._build_path_strats()
         joined = "\n".join(paths)
-        if rx is None or joined.count("\n") != len(paths) - 1:
+        if joined.count("\n") != len(paths) - 1:  # newline inside a path
             return [self.allow_path(p) for p in paths]
         import bisect
         from itertools import accumulate
 
         starts = [0]
-        starts.extend(accumulate(len(p) + 1 for p in paths[:-1]))
+        starts.extend(accumulate(len(p) + 1 for p in paths))
         out = [False] * len(paths)
-        for m in rx.finditer(joined):
-            out[bisect.bisect_right(starts, m.start()) - 1] = True
+        lowered: str | None = None
+        for rule, kind, payload in self._path_strats:
+            rx = rule.path
+            if kind == "lit":
+                lits, ci = payload  # type: ignore[misc]
+                if ci:
+                    if lowered is None:
+                        lowered = joined.lower()
+                    if len(lowered) != len(joined):
+                        # lower() changed lengths (e.g. U+0130): find()
+                        # offsets would misalign with `starts` — exact
+                        # per-path evaluation for this rule instead.
+                        for i, p in enumerate(paths):
+                            if not out[i] and rx.search(p):
+                                out[i] = True
+                        continue
+                    hay = lowered
+                else:
+                    hay = joined
+                for lit in lits:
+                    pos = hay.find(lit)
+                    while pos >= 0:
+                        li = bisect.bisect_right(starts, pos) - 1
+                        if not out[li] and rx.search(paths[li]):
+                            out[li] = True
+                        # Same line, same verdict: resume at the next line.
+                        pos = hay.find(lit, starts[li + 1])
+            elif kind == "scan":
+                for m in payload.finditer(joined):  # type: ignore[union-attr]
+                    out[bisect.bisect_right(starts, m.start()) - 1] = True
+            else:
+                for i, p in enumerate(paths):
+                    if not out[i] and rx.search(p):
+                        out[i] = True
         return out
 
 
