@@ -208,11 +208,11 @@ def test_aws_scan_runs_terraform_checks(aws_endpoint):
     [mc] = scanner.scan()
     failed = {(f.check_id, f.message) for f in mc.failures}
     ids = {c for c, _ in failed}
-    assert "AVD-AWS-0086" in ids  # public ACL on public-logs
+    assert "AVD-AWS-0092" in ids  # public ACL on public-logs
     assert "AVD-AWS-0009" in ids  # instance with public IP
     assert "AVD-AWS-0028" in ids  # IMDSv1 allowed
     # the locked-down bucket passes the ACL check (only public-logs flagged)
-    acl_msgs = [m for c, m in failed if c == "AVD-AWS-0086"]
+    acl_msgs = [m for c, m in failed if c == "AVD-AWS-0092"]
     assert all("public-logs" in m for m in acl_msgs)
 
 
@@ -239,7 +239,7 @@ def test_aws_cli_surface(aws_endpoint):
         for r in doc["Results"]
         for m in r.get("Failures", [])
     }
-    assert "AVD-AWS-0086" in ids
+    assert "AVD-AWS-0092" in ids
 
 
 def test_rds_and_iam_adapters(aws_endpoint):

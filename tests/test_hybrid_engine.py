@@ -360,3 +360,26 @@ def test_allow_paths_newline_escape_rule_falls_back():
     rs = RuleSet(rules=[], allow_rules=[r])
     paths = ["xfoo", "bar.py", "plain.c"]
     assert rs.allow_paths(paths) == [rs.allow_path(p) for p in paths] == [False]*3
+
+
+def test_allow_paths_case_insensitive_literal_tier():
+    """(?i) allow rules reach the literal fast path (review r3): literals
+    harvested from the translator's scoped (?i:...) group, searched in a
+    lowered haystack, verdicts still exact."""
+    from trivy_tpu.engine.goregex import compile_str, go_to_python
+    from trivy_tpu.rules.model import AllowRule, RuleSet, _required_literals
+
+    src = go_to_python(r"(?i)SeCreTs\/")
+    lits = _required_literals(src)
+    assert lits is not None and lits[1] is True
+    assert "secrets/" in lits[0][0] or lits[0] == ["secrets"]
+
+    r = AllowRule(
+        id="ci", description="", regex=None, regex_src="",
+        path=compile_str(r"(?i)SeCreTs\/"), path_src=r"(?i)SeCreTs\/",
+    )
+    rs = RuleSet(rules=[], allow_rules=[r])
+    [(rule, kind, payload)] = rs._build_path_strats()
+    assert kind == "lit"
+    paths = ["a/SECRETS/f.txt", "b/secrets/g.txt", "c/SeCrEtS/h.txt", "d/other.txt"]
+    assert rs.allow_paths(paths) == [rs.allow_path(p) for p in paths] == [True, True, True, False]

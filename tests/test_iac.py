@@ -285,7 +285,7 @@ def scanner():
 def test_builtin_corpus_loads(scanner):
     ids = {c.check_id for c in scanner.checks}
     assert len(scanner.checks) >= 30
-    assert {"DS001", "DS002", "KSV001", "KSV017", "AVD-AWS-0086",
+    assert {"DS001", "DS002", "KSV001", "KSV017", "AVD-AWS-0092",
             "AVD-AWS-0107"} <= ids
     # every check carries metadata
     for c in scanner.checks:
@@ -307,9 +307,9 @@ resource "aws_db_instance" "db" {
     mc = scanner.scan("main.tf", tf)
     failed = {f.check_id for f in mc.failures}
     passed = {s.check_id for s in mc.successes}
-    assert "AVD-AWS-0086" in failed
+    assert "AVD-AWS-0092" in failed
     assert "AVD-AWS-0080" in passed
-    acl_fail = next(f for f in mc.failures if f.check_id == "AVD-AWS-0086")
+    acl_fail = next(f for f in mc.failures if f.check_id == "AVD-AWS-0092")
     assert acl_fail.start_line == 2
     assert "public-read" in acl_fail.message
 
@@ -445,7 +445,7 @@ def test_tf_json_supported(scanner):
 }"""
     mc = scanner.scan("main.tf.json", tfjson)
     assert mc is not None
-    assert "AVD-AWS-0086" in {f.check_id for f in mc.failures}
+    assert "AVD-AWS-0092" in {f.check_id for f in mc.failures}
 
 
 def test_broken_check_is_not_green(tmp_path):

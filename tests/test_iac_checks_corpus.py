@@ -1,0 +1,383 @@
+"""Table-driven coverage for the expanded builtin check corpus (r3).
+
+One failing and one passing fixture per check, run through the real
+IacScanner file path (detection -> parse -> rego), mirroring the
+reference's per-check test layout in the trivy-checks bundle.
+"""
+
+import pytest
+
+from trivy_tpu.iac.engine import IacScanner, load_checks
+
+
+@pytest.fixture(scope="module")
+def scanner():
+    return IacScanner()
+
+
+def _ids(mc):
+    return {f.check_id for f in (mc.failures if mc else [])}
+
+
+# (check_id, file_name, failing_content, passing_content)
+TF_CASES = [
+    (
+        "AVD-AWS-0086",
+        'resource "aws_s3_bucket_public_access_block" "b" {\n  block_public_acls = false\n}\n',
+        'resource "aws_s3_bucket_public_access_block" "b" {\n  block_public_acls = true\n  block_public_policy = true\n  ignore_public_acls = true\n  restrict_public_buckets = true\n}\n',
+    ),
+    (
+        "AVD-AWS-0087",
+        'resource "aws_s3_bucket_public_access_block" "b" {\n  block_public_policy = false\n}\n',
+        'resource "aws_s3_bucket_public_access_block" "b" {\n  block_public_acls = true\n  block_public_policy = true\n  ignore_public_acls = true\n  restrict_public_buckets = true\n}\n',
+    ),
+    (
+        "AVD-AWS-0091",
+        'resource "aws_s3_bucket_public_access_block" "b" {\n  ignore_public_acls = false\n}\n',
+        'resource "aws_s3_bucket_public_access_block" "b" {\n  block_public_acls = true\n  block_public_policy = true\n  ignore_public_acls = true\n  restrict_public_buckets = true\n}\n',
+    ),
+    (
+        "AVD-AWS-0093",
+        'resource "aws_s3_bucket_public_access_block" "b" {\n  restrict_public_buckets = false\n}\n',
+        'resource "aws_s3_bucket_public_access_block" "b" {\n  block_public_acls = true\n  block_public_policy = true\n  ignore_public_acls = true\n  restrict_public_buckets = true\n}\n',
+    ),
+    (
+        "AVD-AWS-0094",
+        'resource "aws_s3_bucket" "b" {\n  bucket = "x"\n}\n',
+        'resource "aws_s3_bucket" "b" {\n  bucket = "x"\n}\nresource "aws_s3_bucket_public_access_block" "b" {\n  block_public_acls = true\n  block_public_policy = true\n  ignore_public_acls = true\n  restrict_public_buckets = true\n}\n',
+    ),
+    (
+        "AVD-AWS-0089",
+        'resource "aws_s3_bucket" "b" {\n  bucket = "x"\n}\n',
+        'resource "aws_s3_bucket" "b" {\n  bucket = "x"\n  logging {\n    target_bucket = "logs"\n  }\n}\n',
+    ),
+    (
+        "AVD-AWS-0132",
+        'resource "aws_s3_bucket" "b" {\n  server_side_encryption_configuration {\n    rule {\n      apply_server_side_encryption_by_default {\n        sse_algorithm = "AES256"\n      }\n    }\n  }\n}\n',
+        'resource "aws_s3_bucket" "b" {\n  server_side_encryption_configuration {\n    rule {\n      apply_server_side_encryption_by_default {\n        sse_algorithm = "aws:kms"\n        kms_master_key_id = "key-arn"\n      }\n    }\n  }\n}\n',
+    ),
+    (
+        "AVD-AWS-0017",
+        'resource "aws_cloudwatch_log_group" "g" {\n  name = "x"\n}\n',
+        'resource "aws_cloudwatch_log_group" "g" {\n  name = "x"\n  kms_key_id = "key"\n}\n',
+    ),
+    (
+        "AVD-AWS-0077",
+        'resource "aws_db_instance" "d" {\n  backup_retention_period = 0\n}\n',
+        'resource "aws_db_instance" "d" {\n  backup_retention_period = 7\n}\n',
+    ),
+    (
+        "AVD-AWS-0104",
+        'resource "aws_security_group" "sg" {\n  description = "x"\n  egress {\n    cidr_blocks = ["0.0.0.0/0"]\n  }\n}\n',
+        'resource "aws_security_group" "sg" {\n  description = "x"\n  egress {\n    cidr_blocks = ["10.0.0.0/8"]\n  }\n}\n',
+    ),
+    (
+        "AVD-AWS-0099",
+        'resource "aws_security_group" "sg" {\n  name = "x"\n}\n',
+        'resource "aws_security_group" "sg" {\n  name = "x"\n  description = "does things"\n}\n',
+    ),
+    (
+        "AVD-AWS-0057",
+        'resource "aws_iam_policy" "p" {\n'
+        '  policy = "{\\"Statement\\": [{\\"Effect\\": \\"Allow\\", \\"Action\\": \\"*\\", \\"Resource\\": \\"*\\"}]}"\n'
+        "}\n",
+        'resource "aws_iam_policy" "p" {\n'
+        '  policy = "{\\"Statement\\": [{\\"Effect\\": \\"Allow\\", \\"Action\\": \\"s3:GetObject\\", \\"Resource\\": \\"arn:x\\"}]}"\n'
+        "}\n",
+    ),
+    (
+        "AVD-AWS-0030",
+        'resource "aws_ecr_repository" "r" {\n  name = "x"\n}\n',
+        'resource "aws_ecr_repository" "r" {\n  name = "x"\n  image_scanning_configuration {\n    scan_on_push = true\n  }\n}\n',
+    ),
+    (
+        "AVD-AWS-0031",
+        'resource "aws_ecr_repository" "r" {\n  image_tag_mutability = "MUTABLE"\n}\n',
+        'resource "aws_ecr_repository" "r" {\n  image_tag_mutability = "IMMUTABLE"\n}\n',
+    ),
+    (
+        "AVD-AWS-0033",
+        'resource "aws_ecr_repository" "r" {\n  name = "x"\n}\n',
+        'resource "aws_ecr_repository" "r" {\n  encryption_configuration {\n    encryption_type = "KMS"\n  }\n}\n',
+    ),
+    (
+        "AVD-AWS-0038",
+        'resource "aws_eks_cluster" "c" {\n  name = "x"\n}\n',
+        'resource "aws_eks_cluster" "c" {\n  enabled_cluster_log_types = ["api", "audit"]\n}\n',
+    ),
+    (
+        "AVD-AWS-0039",
+        'resource "aws_eks_cluster" "c" {\n  vpc_config {\n    endpoint_public_access = true\n    public_access_cidrs = ["0.0.0.0/0"]\n  }\n}\n',
+        'resource "aws_eks_cluster" "c" {\n  vpc_config {\n    endpoint_public_access = true\n    public_access_cidrs = ["10.0.0.0/8"]\n  }\n}\n',
+    ),
+    (
+        "AVD-AWS-0040",
+        'resource "aws_eks_cluster" "c" {\n  vpc_config {\n    endpoint_public_access = true\n  }\n}\n',
+        'resource "aws_eks_cluster" "c" {\n  vpc_config {\n    endpoint_public_access = false\n  }\n}\n',
+    ),
+    (
+        "AVD-AWS-0095",
+        'resource "aws_sns_topic" "t" {\n  name = "x"\n}\n',
+        'resource "aws_sns_topic" "t" {\n  kms_master_key_id = "key"\n}\n',
+    ),
+    (
+        "AVD-AWS-0096",
+        'resource "aws_sqs_queue" "q" {\n  name = "x"\n}\n',
+        'resource "aws_sqs_queue" "q" {\n  sqs_managed_sse_enabled = true\n}\n',
+    ),
+    (
+        "AVD-AWS-0097",
+        'resource "aws_sqs_queue_policy" "p" {\n'
+        '  policy = "{\\"Statement\\": [{\\"Effect\\": \\"Allow\\", \\"Action\\": \\"*\\"}]}"\n'
+        "}\n",
+        'resource "aws_sqs_queue_policy" "p" {\n'
+        '  policy = "{\\"Statement\\": [{\\"Effect\\": \\"Allow\\", \\"Action\\": \\"sqs:SendMessage\\"}]}"\n'
+        "}\n",
+    ),
+    (
+        "AVD-AWS-0024",
+        'resource "aws_dynamodb_table" "t" {\n  name = "x"\n}\n',
+        'resource "aws_dynamodb_table" "t" {\n  point_in_time_recovery {\n    enabled = true\n  }\n}\n',
+    ),
+    (
+        "AVD-AWS-0025",
+        'resource "aws_dynamodb_table" "t" {\n  server_side_encryption {\n    enabled = true\n  }\n}\n',
+        'resource "aws_dynamodb_table" "t" {\n  server_side_encryption {\n    enabled = true\n    kms_key_arn = "arn:aws:kms:x"\n  }\n}\n',
+    ),
+    (
+        "AVD-AWS-0010",
+        'resource "aws_cloudfront_distribution" "d" {\n  enabled = true\n}\n',
+        'resource "aws_cloudfront_distribution" "d" {\n  logging_config {\n    bucket = "logs"\n  }\n}\n',
+    ),
+    (
+        "AVD-AWS-0012",
+        'resource "aws_cloudfront_distribution" "d" {\n  default_cache_behavior {\n    viewer_protocol_policy = "allow-all"\n  }\n}\n',
+        'resource "aws_cloudfront_distribution" "d" {\n  default_cache_behavior {\n    viewer_protocol_policy = "redirect-to-https"\n  }\n}\n',
+    ),
+    (
+        "AVD-AWS-0013",
+        'resource "aws_cloudfront_distribution" "d" {\n  viewer_certificate {\n    minimum_protocol_version = "TLSv1"\n  }\n}\n',
+        'resource "aws_cloudfront_distribution" "d" {\n  viewer_certificate {\n    minimum_protocol_version = "TLSv1.2_2021"\n  }\n}\n',
+    ),
+    (
+        "AVD-AWS-0064",
+        'resource "aws_kinesis_stream" "s" {\n  name = "x"\n}\n',
+        'resource "aws_kinesis_stream" "s" {\n  encryption_type = "KMS"\n}\n',
+    ),
+    (
+        "AVD-AWS-0037",
+        'resource "aws_efs_file_system" "f" {\n  creation_token = "x"\n}\n',
+        'resource "aws_efs_file_system" "f" {\n  encrypted = true\n}\n',
+    ),
+    (
+        "AVD-AWS-0131",
+        'resource "aws_instance" "i" {\n  root_block_device {\n    volume_size = 10\n  }\n}\n',
+        'resource "aws_instance" "i" {\n  root_block_device {\n    encrypted = true\n  }\n}\n',
+    ),
+    (
+        "AVD-AZU-0008",
+        'resource "azurerm_storage_account" "sa" {\n  enable_https_traffic_only = false\n}\n',
+        'resource "azurerm_storage_account" "sa" {\n  enable_https_traffic_only = true\n}\n',
+    ),
+    (
+        "AVD-AZU-0007",
+        'resource "azurerm_storage_account" "sa" {\n  allow_blob_public_access = true\n}\n',
+        'resource "azurerm_storage_account" "sa" {\n  allow_blob_public_access = false\n}\n',
+    ),
+]
+
+
+@pytest.mark.parametrize("check_id,bad,good", TF_CASES, ids=[c[0] for c in TF_CASES])
+def test_terraform_checks(scanner, check_id, bad, good):
+    assert check_id in _ids(scanner.scan("main.tf", bad.encode()))
+    assert check_id not in _ids(scanner.scan("main.tf", good.encode()))
+
+
+CFN_HEADER = "AWSTemplateFormatVersion: '2010-09-09'\nResources:\n"
+
+CFN_CASES = [
+    (
+        "AVD-AWS-0095",
+        "  T:\n    Type: AWS::SNS::Topic\n    Properties:\n      TopicName: x\n",
+        "  T:\n    Type: AWS::SNS::Topic\n    Properties:\n      KmsMasterKeyId: key\n",
+    ),
+    (
+        "AVD-AWS-0096",
+        "  Q:\n    Type: AWS::SQS::Queue\n    Properties:\n      QueueName: x\n",
+        "  Q:\n    Type: AWS::SQS::Queue\n    Properties:\n      SqsManagedSseEnabled: true\n",
+    ),
+    (
+        "AVD-AWS-0012",
+        "  D:\n    Type: AWS::CloudFront::Distribution\n    Properties:\n      DistributionConfig:\n        DefaultCacheBehavior:\n          ViewerProtocolPolicy: allow-all\n",
+        "  D:\n    Type: AWS::CloudFront::Distribution\n    Properties:\n      DistributionConfig:\n        DefaultCacheBehavior:\n          ViewerProtocolPolicy: https-only\n        Logging:\n          Bucket: logs\n",
+    ),
+    (
+        "AVD-AWS-0010",
+        "  D:\n    Type: AWS::CloudFront::Distribution\n    Properties:\n      DistributionConfig:\n        Enabled: true\n",
+        "  D:\n    Type: AWS::CloudFront::Distribution\n    Properties:\n      DistributionConfig:\n        Logging:\n          Bucket: logs\n",
+    ),
+    (
+        "AVD-AWS-0024",
+        "  T:\n    Type: AWS::DynamoDB::Table\n    Properties:\n      TableName: x\n",
+        "  T:\n    Type: AWS::DynamoDB::Table\n    Properties:\n      PointInTimeRecoverySpecification:\n        PointInTimeRecoveryEnabled: true\n",
+    ),
+    (
+        "AVD-AWS-0017",
+        "  G:\n    Type: AWS::Logs::LogGroup\n    Properties:\n      LogGroupName: x\n",
+        "  G:\n    Type: AWS::Logs::LogGroup\n    Properties:\n      KmsKeyId: key\n",
+    ),
+    (
+        "AVD-AWS-0037",
+        "  F:\n    Type: AWS::EFS::FileSystem\n    Properties:\n      Encrypted: false\n",
+        "  F:\n    Type: AWS::EFS::FileSystem\n    Properties:\n      Encrypted: true\n",
+    ),
+    (
+        "AVD-AWS-0057",
+        "  P:\n    Type: AWS::IAM::Policy\n    Properties:\n      PolicyDocument:\n        Statement:\n          - Effect: Allow\n            Action: '*'\n",
+        "  P:\n    Type: AWS::IAM::Policy\n    Properties:\n      PolicyDocument:\n        Statement:\n          - Effect: Allow\n            Action: 's3:GetObject'\n",
+    ),
+    (
+        "AVD-AWS-0030",
+        "  R:\n    Type: AWS::ECR::Repository\n    Properties:\n      RepositoryName: x\n",
+        "  R:\n    Type: AWS::ECR::Repository\n    Properties:\n      ImageScanningConfiguration:\n        ScanOnPush: true\n",
+    ),
+    (
+        "AVD-AWS-0064",
+        "  S:\n    Type: AWS::Kinesis::Stream\n    Properties:\n      ShardCount: 1\n",
+        "  S:\n    Type: AWS::Kinesis::Stream\n    Properties:\n      StreamEncryption:\n        EncryptionType: KMS\n",
+    ),
+]
+
+
+@pytest.mark.parametrize("check_id,bad,good", CFN_CASES, ids=[c[0] for c in CFN_CASES])
+def test_cloudformation_checks(scanner, check_id, bad, good):
+    assert check_id in _ids(scanner.scan("stack.yaml", (CFN_HEADER + bad).encode()))
+    assert check_id not in _ids(scanner.scan("stack.yaml", (CFN_HEADER + good).encode()))
+
+
+DOCKER_CASES = [
+    (
+        "DS007",
+        'FROM alpine:3.18\nENTRYPOINT ["a"]\nENTRYPOINT ["b"]\n',
+        'FROM alpine:3.18\nENTRYPOINT ["a"]\n',
+    ),
+    (
+        "DS008",
+        "FROM alpine:3.18\nEXPOSE 99999\n",
+        "FROM alpine:3.18\nEXPOSE 8080\n",
+    ),
+    (
+        "DS011",
+        "FROM alpine:3.18\nCOPY a.txt b.txt /dest\n",
+        "FROM alpine:3.18\nCOPY a.txt b.txt /dest/\n",
+    ),
+    (
+        "DS012",
+        "FROM alpine:3.18 AS build\nFROM debian:12 AS build\n",
+        "FROM alpine:3.18 AS build\nFROM debian:12 AS run\n",
+    ),
+    (
+        "DS014",
+        "FROM alpine:3.18\nRUN wget http://x/a\nRUN curl http://x/b\n",
+        "FROM alpine:3.18\nRUN curl http://x/a && curl http://x/b\n",
+    ),
+    (
+        "DS020",
+        "FROM opensuse/leap\nRUN zypper install -y vim\n",
+        "FROM opensuse/leap\nRUN zypper install -y vim && zypper clean\n",
+    ),
+    (
+        "DS023",
+        "FROM alpine:3.18\nHEALTHCHECK CMD a\nHEALTHCHECK CMD b\n",
+        "FROM alpine:3.18\nHEALTHCHECK CMD a\n",
+    ),
+    (
+        "DS024",
+        "FROM debian:12\nRUN apt-get update && apt-get dist-upgrade -y\n",
+        "FROM debian:12\nRUN apt-get update && apt-get install -y vim\n",
+    ),
+]
+
+
+@pytest.mark.parametrize("check_id,bad,good", DOCKER_CASES, ids=[c[0] for c in DOCKER_CASES])
+def test_dockerfile_checks(scanner, check_id, bad, good):
+    assert check_id in _ids(scanner.scan("Dockerfile", bad.encode()))
+    assert check_id not in _ids(scanner.scan("Dockerfile", good.encode()))
+
+
+POD_HEADER = "apiVersion: v1\nkind: Pod\nmetadata:\n  name: p\n"
+
+K8S_CASES = [
+    (
+        "KSV002",
+        POD_HEADER + "spec:\n  containers:\n    - name: app\n      image: x\n",
+        "apiVersion: v1\nkind: Pod\nmetadata:\n  name: p\n  annotations:\n    container.apparmor.security.beta.kubernetes.io/app: runtime/default\nspec:\n  containers:\n    - name: app\n      image: x\n",
+    ),
+    (
+        "KSV005",
+        POD_HEADER + "spec:\n  containers:\n    - name: app\n      securityContext:\n        capabilities:\n          add: [SYS_ADMIN]\n",
+        POD_HEADER + "spec:\n  containers:\n    - name: app\n      securityContext:\n        capabilities:\n          add: [CHOWN]\n",
+    ),
+    (
+        "KSV006",
+        POD_HEADER + "spec:\n  containers:\n    - name: app\n  volumes:\n    - name: sock\n      hostPath:\n        path: /var/run/docker.sock\n",
+        POD_HEADER + "spec:\n  containers:\n    - name: app\n  volumes:\n    - name: data\n      hostPath:\n        path: /data\n",
+    ),
+    (
+        "KSV008",
+        POD_HEADER + "spec:\n  hostIPC: true\n  containers:\n    - name: app\n",
+        POD_HEADER + "spec:\n  containers:\n    - name: app\n",
+    ),
+    (
+        "KSV015",
+        POD_HEADER + "spec:\n  containers:\n    - name: app\n      resources:\n        limits:\n          cpu: 100m\n",
+        POD_HEADER + "spec:\n  containers:\n    - name: app\n      resources:\n        requests:\n          cpu: 100m\n",
+    ),
+    (
+        "KSV016",
+        POD_HEADER + "spec:\n  containers:\n    - name: app\n      resources: {}\n",
+        POD_HEADER + "spec:\n  containers:\n    - name: app\n      resources:\n        requests:\n          memory: 64Mi\n",
+    ),
+    (
+        "KSV020",
+        POD_HEADER + "spec:\n  containers:\n    - name: app\n      securityContext:\n        runAsUser: 1000\n",
+        POD_HEADER + "spec:\n  containers:\n    - name: app\n      securityContext:\n        runAsUser: 20000\n",
+    ),
+    (
+        "KSV021",
+        POD_HEADER + "spec:\n  containers:\n    - name: app\n      securityContext:\n        runAsGroup: 100\n",
+        POD_HEADER + "spec:\n  containers:\n    - name: app\n      securityContext:\n        runAsGroup: 30000\n",
+    ),
+    (
+        "KSV022",
+        POD_HEADER + "spec:\n  containers:\n    - name: app\n      securityContext:\n        capabilities:\n          add: [NET_ADMIN]\n",
+        POD_HEADER + "spec:\n  containers:\n    - name: app\n      securityContext:\n        capabilities:\n          add: [CHOWN]\n",
+    ),
+    (
+        "KSV024",
+        POD_HEADER + "spec:\n  containers:\n    - name: app\n      ports:\n        - containerPort: 80\n          hostPort: 80\n",
+        POD_HEADER + "spec:\n  containers:\n    - name: app\n      ports:\n        - containerPort: 80\n",
+    ),
+    (
+        "KSV030",
+        POD_HEADER + "spec:\n  containers:\n    - name: app\n      image: x\n",
+        POD_HEADER + "spec:\n  securityContext:\n    seccompProfile:\n      type: RuntimeDefault\n  containers:\n    - name: app\n      image: x\n",
+    ),
+]
+
+
+@pytest.mark.parametrize("check_id,bad,good", K8S_CASES, ids=[c[0] for c in K8S_CASES])
+def test_kubernetes_checks(scanner, check_id, bad, good):
+    assert check_id in _ids(scanner.scan("pod.yaml", bad.encode()))
+    assert check_id not in _ids(scanner.scan("pod.yaml", good.encode()))
+
+
+def test_corpus_size_and_unique_ids_per_type():
+    checks = load_checks()
+    assert len(checks) >= 107
+    seen = set()
+    for c in checks:
+        key = (c.input_type, c.check_id)
+        assert key not in seen, key
+        seen.add(key)
+        assert c.severity in {"LOW", "MEDIUM", "HIGH", "CRITICAL"}, c.check_id

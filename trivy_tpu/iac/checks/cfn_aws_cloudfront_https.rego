@@ -1,0 +1,30 @@
+# METADATA
+# title: CloudFront distribution allows unencrypted communications
+# custom:
+#   id: AVD-AWS-0012
+#   severity: HIGH
+#   recommended_action: Set ViewerProtocolPolicy to redirect-to-https or https-only.
+package builtin.cloudformation.AWS0012
+
+behaviors[pair] {
+    some name, r in object.get(input, "Resources", {})
+    object.get(r, "Type", "") == "AWS::CloudFront::Distribution"
+    cfg := object.get(object.get(r, "Properties", {}), "DistributionConfig", {})
+    b := object.get(cfg, "DefaultCacheBehavior", null)
+    is_object(b)
+    pair := {"name": name, "b": b}
+}
+
+behaviors[pair] {
+    some name, r in object.get(input, "Resources", {})
+    object.get(r, "Type", "") == "AWS::CloudFront::Distribution"
+    cfg := object.get(object.get(r, "Properties", {}), "DistributionConfig", {})
+    b := object.get(cfg, "CacheBehaviors", [])[_]
+    pair := {"name": name, "b": b}
+}
+
+deny[res] {
+    some pair in behaviors
+    object.get(pair.b, "ViewerProtocolPolicy", "allow-all") == "allow-all"
+    res := result.new(sprintf("CloudFront distribution %q allows plain HTTP", [pair.name]), pair.b)
+}

@@ -1,0 +1,15 @@
+# METADATA
+# title: CloudFront distribution has no access logging
+# custom:
+#   id: AVD-AWS-0010
+#   severity: MEDIUM
+#   recommended_action: Add a Logging config to the distribution.
+package builtin.cloudformation.AWS0010
+
+deny[res] {
+    some name, r in object.get(input, "Resources", {})
+    object.get(r, "Type", "") == "AWS::CloudFront::Distribution"
+    cfg := object.get(object.get(r, "Properties", {}), "DistributionConfig", {})
+    not object.get(cfg, "Logging", null)
+    res := result.new(sprintf("CloudFront distribution %q has no access logging", [name]), r)
+}

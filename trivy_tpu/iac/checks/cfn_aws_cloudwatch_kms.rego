@@ -1,0 +1,14 @@
+# METADATA
+# title: CloudWatch log group is not encrypted with a customer key
+# custom:
+#   id: AVD-AWS-0017
+#   severity: LOW
+#   recommended_action: Set KmsKeyId on the log group.
+package builtin.cloudformation.AWS0017
+
+deny[res] {
+    some name, r in object.get(input, "Resources", {})
+    object.get(r, "Type", "") == "AWS::Logs::LogGroup"
+    object.get(object.get(r, "Properties", {}), "KmsKeyId", "") == ""
+    res := result.new(sprintf("Log group %q is not encrypted with a customer managed key", [name]), r)
+}

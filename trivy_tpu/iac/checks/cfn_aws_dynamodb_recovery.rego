@@ -1,0 +1,15 @@
+# METADATA
+# title: DynamoDB table has no point-in-time recovery
+# custom:
+#   id: AVD-AWS-0024
+#   severity: MEDIUM
+#   recommended_action: Enable PointInTimeRecoveryEnabled.
+package builtin.cloudformation.AWS0024
+
+deny[res] {
+    some name, r in object.get(input, "Resources", {})
+    object.get(r, "Type", "") == "AWS::DynamoDB::Table"
+    p := object.get(r, "Properties", {})
+    object.get(object.get(p, "PointInTimeRecoverySpecification", {}), "PointInTimeRecoveryEnabled", false) != true
+    res := result.new(sprintf("DynamoDB table %q does not enable point-in-time recovery", [name]), r)
+}

@@ -1,0 +1,15 @@
+# METADATA
+# title: ECR repository does not scan images on push
+# custom:
+#   id: AVD-AWS-0030
+#   severity: HIGH
+#   recommended_action: Set ImageScanningConfiguration.ScanOnPush true.
+package builtin.cloudformation.AWS0030
+
+deny[res] {
+    some name, r in object.get(input, "Resources", {})
+    object.get(r, "Type", "") == "AWS::ECR::Repository"
+    p := object.get(r, "Properties", {})
+    object.get(object.get(p, "ImageScanningConfiguration", {}), "ScanOnPush", false) != true
+    res := result.new(sprintf("ECR repository %q does not scan images on push", [name]), r)
+}

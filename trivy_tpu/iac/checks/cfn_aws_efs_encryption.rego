@@ -1,0 +1,14 @@
+# METADATA
+# title: EFS file system is not encrypted
+# custom:
+#   id: AVD-AWS-0037
+#   severity: HIGH
+#   recommended_action: Set Encrypted true.
+package builtin.cloudformation.AWS0037
+
+deny[res] {
+    some name, r in object.get(input, "Resources", {})
+    object.get(r, "Type", "") == "AWS::EFS::FileSystem"
+    object.get(object.get(r, "Properties", {}), "Encrypted", false) != true
+    res := result.new(sprintf("EFS file system %q is not encrypted", [name]), r)
+}

@@ -1,0 +1,30 @@
+# METADATA
+# title: IAM policy allows wildcard actions
+# custom:
+#   id: AVD-AWS-0057
+#   severity: HIGH
+#   recommended_action: Scope IAM policy actions and resources narrowly.
+package builtin.cloudformation.AWS0057
+
+stmts[trip] {
+    some name, r in object.get(input, "Resources", {})
+    object.get(r, "Type", "") in ["AWS::IAM::Policy", "AWS::IAM::ManagedPolicy"]
+    doc := object.get(object.get(r, "Properties", {}), "PolicyDocument", {})
+    s := object.get(doc, "Statement", [])[_]
+    trip := {"name": name, "s": s, "r": r}
+}
+
+deny[res] {
+    some trip in stmts
+    object.get(trip.s, "Effect", "Allow") == "Allow"
+    object.get(trip.s, "Action", "") == "*"
+    res := result.new(sprintf("IAM policy %q allows all actions (*)", [trip.name]), trip.r)
+}
+
+deny[res] {
+    some trip in stmts
+    object.get(trip.s, "Effect", "Allow") == "Allow"
+    a := object.get(trip.s, "Action", [])[_]
+    a == "*"
+    res := result.new(sprintf("IAM policy %q allows all actions (*)", [trip.name]), trip.r)
+}

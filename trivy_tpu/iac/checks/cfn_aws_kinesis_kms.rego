@@ -1,0 +1,14 @@
+# METADATA
+# title: Kinesis stream is not encrypted
+# custom:
+#   id: AVD-AWS-0064
+#   severity: HIGH
+#   recommended_action: Add a StreamEncryption block with KMS.
+package builtin.cloudformation.AWS0064
+
+deny[res] {
+    some name, r in object.get(input, "Resources", {})
+    object.get(r, "Type", "") == "AWS::Kinesis::Stream"
+    object.get(object.get(object.get(r, "Properties", {}), "StreamEncryption", {}), "EncryptionType", "NONE") != "KMS"
+    res := result.new(sprintf("Kinesis stream %q is not encrypted", [name]), r)
+}

@@ -1,0 +1,14 @@
+# METADATA
+# title: SNS topic is not encrypted
+# custom:
+#   id: AVD-AWS-0095
+#   severity: HIGH
+#   recommended_action: Set KmsMasterKeyId on the topic.
+package builtin.cloudformation.AWS0095
+
+deny[res] {
+    some name, r in object.get(input, "Resources", {})
+    object.get(r, "Type", "") == "AWS::SNS::Topic"
+    object.get(object.get(r, "Properties", {}), "KmsMasterKeyId", "") == ""
+    res := result.new(sprintf("SNS topic %q is not encrypted at rest", [name]), r)
+}

@@ -1,0 +1,16 @@
+# METADATA
+# title: SQS queue is not encrypted
+# custom:
+#   id: AVD-AWS-0096
+#   severity: HIGH
+#   recommended_action: Set KmsMasterKeyId or SqsManagedSseEnabled.
+package builtin.cloudformation.AWS0096
+
+deny[res] {
+    some name, r in object.get(input, "Resources", {})
+    object.get(r, "Type", "") == "AWS::SQS::Queue"
+    p := object.get(r, "Properties", {})
+    object.get(p, "KmsMasterKeyId", "") == ""
+    object.get(p, "SqsManagedSseEnabled", false) != true
+    res := result.new(sprintf("SQS queue %q is not encrypted at rest", [name]), r)
+}

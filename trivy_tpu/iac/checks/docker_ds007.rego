@@ -1,0 +1,14 @@
+# METADATA
+# title: Multiple ENTRYPOINT instructions in one stage
+# custom:
+#   id: DS007
+#   severity: CRITICAL
+#   recommended_action: Keep only the last ENTRYPOINT per stage.
+package builtin.dockerfile.DS007
+
+deny[res] {
+    stage := input.Stages[_]
+    n := count([c | c := stage.Commands[_]; c.Cmd == "entrypoint"])
+    n > 1
+    res := result.new(sprintf("Stage has %d ENTRYPOINT instructions; only the last applies", [n]), stage)
+}

@@ -1,0 +1,16 @@
+# METADATA
+# title: Exposed port out of range
+# custom:
+#   id: DS008
+#   severity: CRITICAL
+#   recommended_action: Expose ports between 0 and 65535 only.
+package builtin.dockerfile.DS008
+
+deny[res] {
+    cmd := input.Stages[_].Commands[_]
+    cmd.Cmd == "expose"
+    port := cmd.Value[_]
+    p := to_number(split(port, "/")[0])
+    p > 65535
+    res := result.new(sprintf("Exposed port %v is out of range (0-65535)", [port]), cmd)
+}

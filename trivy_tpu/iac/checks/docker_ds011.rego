@@ -1,0 +1,16 @@
+# METADATA
+# title: COPY with multiple sources needs a directory destination
+# custom:
+#   id: DS011
+#   severity: CRITICAL
+#   recommended_action: End the COPY destination with "/" when copying multiple sources.
+package builtin.dockerfile.DS011
+
+deny[res] {
+    cmd := input.Stages[_].Commands[_]
+    cmd.Cmd == "copy"
+    count(cmd.Value) > 2
+    dest := cmd.Value[count(cmd.Value) - 1]
+    not endswith(dest, "/")
+    res := result.new(sprintf("COPY with %d sources requires the destination %q to end with \"/\"", [count(cmd.Value) - 1, dest]), cmd)
+}

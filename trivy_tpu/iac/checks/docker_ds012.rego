@@ -1,0 +1,23 @@
+# METADATA
+# title: Duplicate stage alias
+# custom:
+#   id: DS012
+#   severity: CRITICAL
+#   recommended_action: Give each FROM ... AS stage a unique alias.
+package builtin.dockerfile.DS012
+
+aliases[pair] {
+    cmd := input.Stages[_].Commands[_]
+    cmd.Cmd == "from"
+    count(cmd.Value) >= 3
+    lower(cmd.Value[1]) == "as"
+    pair := {"i": cmd.Stage, "alias": lower(cmd.Value[2]), "cmd": cmd}
+}
+
+deny[res] {
+    some a in aliases
+    some b in aliases
+    a.i < b.i
+    a.alias == b.alias
+    res := result.new(sprintf("Stage alias %q is used more than once", [a.alias]), b.cmd)
+}

@@ -1,0 +1,24 @@
+# METADATA
+# title: Both wget and curl are used
+# custom:
+#   id: DS014
+#   severity: LOW
+#   recommended_action: Standardize on either wget or curl.
+package builtin.dockerfile.DS014
+
+tools[pair] {
+    cmd := input.Stages[_].Commands[_]
+    cmd.Cmd == "run"
+    some tool in ["wget", "curl"]
+    some part in split(concat(" ", cmd.Value), " ")
+    part == tool
+    pair := {"tool": tool, "cmd": cmd}
+}
+
+deny[res] {
+    some a in tools
+    some b in tools
+    a.tool == "wget"
+    b.tool == "curl"
+    res := result.new("Use either wget or curl, not both", b.cmd)
+}

@@ -1,0 +1,17 @@
+# METADATA
+# title: zypper used without "zypper clean"
+# custom:
+#   id: DS020
+#   severity: HIGH
+#   recommended_action: Add "zypper clean" after zypper install layers.
+package builtin.dockerfile.DS020
+
+deny[res] {
+    cmd := input.Stages[_].Commands[_]
+    cmd.Cmd == "run"
+    line := concat(" ", cmd.Value)
+    contains(line, "zypper install")
+    not contains(line, "zypper clean")
+    not contains(line, "zypper cc")
+    res := result.new("zypper install without a zypper clean in the same layer", cmd)
+}

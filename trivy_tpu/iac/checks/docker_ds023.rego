@@ -1,0 +1,13 @@
+# METADATA
+# title: Multiple HEALTHCHECK instructions
+# custom:
+#   id: DS023
+#   severity: CRITICAL
+#   recommended_action: Keep a single HEALTHCHECK instruction.
+package builtin.dockerfile.DS023
+
+deny[res] {
+    n := count([c | c := input.Stages[_].Commands[_]; c.Cmd == "healthcheck"])
+    n > 1
+    res := result.new(sprintf("%d HEALTHCHECK instructions; only one applies", [n]), {})
+}

@@ -1,0 +1,14 @@
+# METADATA
+# title: apt-get dist-upgrade used
+# custom:
+#   id: DS024
+#   severity: HIGH
+#   recommended_action: Avoid dist-upgrade in images; rebuild from an updated base instead.
+package builtin.dockerfile.DS024
+
+deny[res] {
+    cmd := input.Stages[_].Commands[_]
+    cmd.Cmd == "run"
+    contains(concat(" ", cmd.Value), "dist-upgrade")
+    res := result.new("Do not use apt-get dist-upgrade in a Dockerfile", cmd)
+}

@@ -1,0 +1,50 @@
+# METADATA
+# title: Default AppArmor profile not set
+# custom:
+#   id: KSV002
+#   severity: MEDIUM
+#   recommended_action: Annotate the pod with container.apparmor.security.beta.kubernetes.io/<name>: runtime/default.
+package builtin.kubernetes.KSV002
+
+containers[c] {
+    c := input.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.initContainers[_]
+}
+
+has_annotation(name) {
+    some k, v in object.get(object.get(input, "metadata", {}), "annotations", {})
+    startswith(k, "container.apparmor.security.beta.kubernetes.io/")
+    endswith(k, name)
+}
+
+has_annotation(name) {
+    some k, v in object.get(object.get(object.get(object.get(input, "spec", {}), "template", {}), "metadata", {}), "annotations", {})
+    startswith(k, "container.apparmor.security.beta.kubernetes.io/")
+    endswith(k, name)
+}
+
+deny[res] {
+    some c in containers
+    name := object.get(c, "name", "")
+    not has_annotation(name)
+    res := result.new(sprintf("Container %q does not specify an AppArmor profile", [name]), c)
+}

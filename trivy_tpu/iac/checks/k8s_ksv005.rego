@@ -1,0 +1,38 @@
+# METADATA
+# title: SYS_ADMIN capability added
+# custom:
+#   id: KSV005
+#   severity: HIGH
+#   recommended_action: Remove SYS_ADMIN from securityContext.capabilities.add.
+package builtin.kubernetes.KSV005
+
+containers[c] {
+    c := input.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.initContainers[_]
+}
+
+deny[res] {
+    some c in containers
+    cap := object.get(object.get(object.get(c, "securityContext", {}), "capabilities", {}), "add", [])[_]
+    cap == "SYS_ADMIN"
+    res := result.new(sprintf("Container %q adds the SYS_ADMIN capability", [object.get(c, "name", "?")]), c)
+}

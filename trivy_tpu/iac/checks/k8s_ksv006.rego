@@ -1,0 +1,27 @@
+# METADATA
+# title: Docker socket mounted into the pod
+# custom:
+#   id: KSV006
+#   severity: HIGH
+#   recommended_action: Do not mount /var/run/docker.sock.
+package builtin.kubernetes.KSV006
+
+pods[p] {
+    p := input.spec
+    object.get(p, "containers", null)
+}
+
+pods[p] {
+    p := input.spec.template.spec
+}
+
+pods[p] {
+    p := input.spec.jobTemplate.spec.template.spec
+}
+
+deny[res] {
+    some p in pods
+    v := object.get(p, "volumes", [])[_]
+    object.get(object.get(v, "hostPath", {}), "path", "") == "/var/run/docker.sock"
+    res := result.new(sprintf("Volume %q mounts the docker socket", [object.get(v, "name", "?")]), v)
+}

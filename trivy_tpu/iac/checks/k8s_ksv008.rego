@@ -1,0 +1,26 @@
+# METADATA
+# title: Pod shares the host IPC namespace
+# custom:
+#   id: KSV008
+#   severity: HIGH
+#   recommended_action: Set hostIPC to false.
+package builtin.kubernetes.KSV008
+
+pods[p] {
+    p := input.spec
+    object.get(p, "containers", null)
+}
+
+pods[p] {
+    p := input.spec.template.spec
+}
+
+pods[p] {
+    p := input.spec.jobTemplate.spec.template.spec
+}
+
+deny[res] {
+    some p in pods
+    object.get(p, "hostIPC", false) == true
+    res := result.new("Pod shares the host IPC namespace", p)
+}

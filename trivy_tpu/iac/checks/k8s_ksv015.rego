@@ -1,0 +1,37 @@
+# METADATA
+# title: cpu requests not specified
+# custom:
+#   id: KSV015
+#   severity: LOW
+#   recommended_action: Set resources.requests.cpu.
+package builtin.kubernetes.KSV015
+
+containers[c] {
+    c := input.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.initContainers[_]
+}
+
+deny[res] {
+    some c in containers
+    not object.get(object.get(object.get(c, "resources", {}), "requests", {}), "cpu", null)
+    res := result.new(sprintf("Container %q should set resources.requests.cpu", [object.get(c, "name", "?")]), c)
+}

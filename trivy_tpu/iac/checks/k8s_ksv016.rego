@@ -1,0 +1,37 @@
+# METADATA
+# title: memory requests not specified
+# custom:
+#   id: KSV016
+#   severity: LOW
+#   recommended_action: Set resources.requests.memory.
+package builtin.kubernetes.KSV016
+
+containers[c] {
+    c := input.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.initContainers[_]
+}
+
+deny[res] {
+    some c in containers
+    not object.get(object.get(object.get(c, "resources", {}), "requests", {}), "memory", null)
+    res := result.new(sprintf("Container %q should set resources.requests.memory", [object.get(c, "name", "?")]), c)
+}

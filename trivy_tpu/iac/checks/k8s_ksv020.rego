@@ -1,0 +1,39 @@
+# METADATA
+# title: Container runs with a low user ID
+# custom:
+#   id: KSV020
+#   severity: LOW
+#   recommended_action: Set securityContext.runAsUser > 10000.
+package builtin.kubernetes.KSV020
+
+containers[c] {
+    c := input.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.initContainers[_]
+}
+
+deny[res] {
+    some c in containers
+    v := object.get(object.get(c, "securityContext", {}), "runAsUser", null)
+    is_number(v)
+    v <= 10000
+    res := result.new(sprintf("Container %q runs with a low user ID (%v)", [object.get(c, "name", "?"), v]), c)
+}

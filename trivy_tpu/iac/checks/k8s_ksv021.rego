@@ -1,0 +1,39 @@
+# METADATA
+# title: Container runs with a low group ID
+# custom:
+#   id: KSV021
+#   severity: LOW
+#   recommended_action: Set securityContext.runAsGroup > 10000.
+package builtin.kubernetes.KSV021
+
+containers[c] {
+    c := input.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.initContainers[_]
+}
+
+deny[res] {
+    some c in containers
+    v := object.get(object.get(c, "securityContext", {}), "runAsGroup", null)
+    is_number(v)
+    v <= 10000
+    res := result.new(sprintf("Container %q runs with a low group ID (%v)", [object.get(c, "name", "?"), v]), c)
+}

@@ -1,0 +1,40 @@
+# METADATA
+# title: Non-default capabilities added
+# custom:
+#   id: KSV022
+#   severity: MEDIUM
+#   recommended_action: Avoid adding capabilities beyond the default set.
+package builtin.kubernetes.KSV022
+
+containers[c] {
+    c := input.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.initContainers[_]
+}
+
+allowed := ["AUDIT_WRITE", "CHOWN", "DAC_OVERRIDE", "FOWNER", "FSETID", "KILL", "MKNOD", "NET_BIND_SERVICE", "SETFCAP", "SETGID", "SETPCAP", "SETUID", "SYS_CHROOT"]
+
+deny[res] {
+    some c in containers
+    cap := object.get(object.get(object.get(c, "securityContext", {}), "capabilities", {}), "add", [])[_]
+    not cap in allowed
+    res := result.new(sprintf("Container %q adds non-default capability %q", [object.get(c, "name", "?"), cap]), c)
+}

@@ -1,0 +1,38 @@
+# METADATA
+# title: Container binds a host port
+# custom:
+#   id: KSV024
+#   severity: HIGH
+#   recommended_action: Do not set hostPort on container ports.
+package builtin.kubernetes.KSV024
+
+containers[c] {
+    c := input.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.initContainers[_]
+}
+
+deny[res] {
+    some c in containers
+    port := object.get(c, "ports", [])[_]
+    object.get(port, "hostPort", null)
+    res := result.new(sprintf("Container %q binds host port %v", [object.get(c, "name", "?"), object.get(port, "hostPort", 0)]), c)
+}

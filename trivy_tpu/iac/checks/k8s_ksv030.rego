@@ -1,0 +1,48 @@
+# METADATA
+# title: Runtime default seccomp profile not set
+# custom:
+#   id: KSV030
+#   severity: LOW
+#   recommended_action: Set securityContext.seccompProfile.type to RuntimeDefault.
+package builtin.kubernetes.KSV030
+
+containers[c] {
+    c := input.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.initContainers[_]
+}
+
+pod_seccomp_ok {
+    t := object.get(object.get(object.get(input, "spec", {}), "securityContext", {}), "seccompProfile", {})
+    object.get(t, "type", "") in ["RuntimeDefault", "Localhost"]
+}
+
+pod_seccomp_ok {
+    t := object.get(object.get(object.get(object.get(object.get(input, "spec", {}), "template", {}), "spec", {}), "securityContext", {}), "seccompProfile", {})
+    object.get(t, "type", "") in ["RuntimeDefault", "Localhost"]
+}
+
+deny[res] {
+    some c in containers
+    not object.get(object.get(object.get(c, "securityContext", {}), "seccompProfile", {}), "type", "") in ["RuntimeDefault", "Localhost"]
+    not pod_seccomp_ok
+    res := result.new(sprintf("Container %q does not set a seccomp profile", [object.get(c, "name", "?")]), c)
+}

@@ -1,0 +1,26 @@
+# METADATA
+# title: CloudFront distribution allows unencrypted communications
+# custom:
+#   id: AVD-AWS-0012
+#   severity: HIGH
+#   recommended_action: Set viewer_protocol_policy to redirect-to-https or https-only.
+package builtin.terraform.AWS0012
+
+behaviors[pair] {
+    some name, d in object.get(object.get(input, "resource", {}), "aws_cloudfront_distribution", {})
+    b := object.get(d, "default_cache_behavior", null)
+    is_object(b)
+    pair := {"name": name, "b": b}
+}
+
+behaviors[pair] {
+    some name, d in object.get(object.get(input, "resource", {}), "aws_cloudfront_distribution", {})
+    b := object.get(d, "ordered_cache_behavior", [])[_]
+    pair := {"name": name, "b": b}
+}
+
+deny[res] {
+    some pair in behaviors
+    object.get(pair.b, "viewer_protocol_policy", "allow-all") == "allow-all"
+    res := result.new(sprintf("CloudFront distribution %q allows plain HTTP", [pair.name]), pair.b)
+}

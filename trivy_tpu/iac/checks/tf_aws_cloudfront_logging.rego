@@ -1,0 +1,13 @@
+# METADATA
+# title: CloudFront distribution has no access logging
+# custom:
+#   id: AVD-AWS-0010
+#   severity: MEDIUM
+#   recommended_action: Add a logging_config block.
+package builtin.terraform.AWS0010
+
+deny[res] {
+    some name, d in object.get(object.get(input, "resource", {}), "aws_cloudfront_distribution", {})
+    not object.get(d, "logging_config", null)
+    res := result.new(sprintf("CloudFront distribution %q has no access logging", [name]), d)
+}

@@ -1,0 +1,15 @@
+# METADATA
+# title: CloudFront distribution uses an outdated TLS policy
+# custom:
+#   id: AVD-AWS-0013
+#   severity: HIGH
+#   recommended_action: Set minimum_protocol_version to TLSv1.2_2021.
+package builtin.terraform.AWS0013
+
+deny[res] {
+    some name, d in object.get(object.get(input, "resource", {}), "aws_cloudfront_distribution", {})
+    cert := object.get(d, "viewer_certificate", {})
+    object.get(cert, "cloudfront_default_certificate", false) != true
+    not object.get(cert, "minimum_protocol_version", "TLSv1") in ["TLSv1.2_2018", "TLSv1.2_2019", "TLSv1.2_2021"]
+    res := result.new(sprintf("CloudFront distribution %q uses an outdated minimum TLS version", [name]), d)
+}

@@ -1,0 +1,13 @@
+# METADATA
+# title: CloudWatch log group is not encrypted with a customer key
+# custom:
+#   id: AVD-AWS-0017
+#   severity: LOW
+#   recommended_action: Set kms_key_id on the log group.
+package builtin.terraform.AWS0017
+
+deny[res] {
+    some name, g in object.get(object.get(input, "resource", {}), "aws_cloudwatch_log_group", {})
+    object.get(g, "kms_key_id", "") == ""
+    res := result.new(sprintf("Log group %q is not encrypted with a customer managed key", [name]), g)
+}

@@ -1,0 +1,22 @@
+# METADATA
+# title: DynamoDB table is not encrypted with a customer key
+# custom:
+#   id: AVD-AWS-0025
+#   severity: LOW
+#   recommended_action: Enable server_side_encryption with a KMS key.
+package builtin.terraform.AWS0025
+
+deny[res] {
+    some name, t in object.get(object.get(input, "resource", {}), "aws_dynamodb_table", {})
+    sse := object.get(t, "server_side_encryption", {})
+    object.get(sse, "enabled", false) != true
+    res := result.new(sprintf("DynamoDB table %q does not use customer managed encryption", [name]), t)
+}
+
+deny[res] {
+    some name, t in object.get(object.get(input, "resource", {}), "aws_dynamodb_table", {})
+    sse := object.get(t, "server_side_encryption", {})
+    object.get(sse, "enabled", false) == true
+    object.get(sse, "kms_key_arn", "") == ""
+    res := result.new(sprintf("DynamoDB table %q encryption does not use a customer managed key", [name]), t)
+}

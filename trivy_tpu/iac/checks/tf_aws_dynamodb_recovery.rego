@@ -1,0 +1,13 @@
+# METADATA
+# title: DynamoDB table has no point-in-time recovery
+# custom:
+#   id: AVD-AWS-0024
+#   severity: MEDIUM
+#   recommended_action: Enable point_in_time_recovery.
+package builtin.terraform.AWS0024
+
+deny[res] {
+    some name, t in object.get(object.get(input, "resource", {}), "aws_dynamodb_table", {})
+    object.get(object.get(t, "point_in_time_recovery", {}), "enabled", false) != true
+    res := result.new(sprintf("DynamoDB table %q does not enable point-in-time recovery", [name]), t)
+}

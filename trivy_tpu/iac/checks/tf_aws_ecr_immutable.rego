@@ -1,0 +1,13 @@
+# METADATA
+# title: ECR repository allows mutable image tags
+# custom:
+#   id: AVD-AWS-0031
+#   severity: HIGH
+#   recommended_action: Set image_tag_mutability to IMMUTABLE.
+package builtin.terraform.AWS0031
+
+deny[res] {
+    some name, r in object.get(object.get(input, "resource", {}), "aws_ecr_repository", {})
+    object.get(r, "image_tag_mutability", "MUTABLE") != "IMMUTABLE"
+    res := result.new(sprintf("ECR repository %q allows mutable image tags", [name]), r)
+}

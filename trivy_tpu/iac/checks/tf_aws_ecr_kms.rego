@@ -1,0 +1,13 @@
+# METADATA
+# title: ECR repository is not encrypted with a customer key
+# custom:
+#   id: AVD-AWS-0033
+#   severity: LOW
+#   recommended_action: Use encryption_configuration with encryption_type KMS.
+package builtin.terraform.AWS0033
+
+deny[res] {
+    some name, r in object.get(object.get(input, "resource", {}), "aws_ecr_repository", {})
+    object.get(object.get(r, "encryption_configuration", {}), "encryption_type", "AES256") != "KMS"
+    res := result.new(sprintf("ECR repository %q is not encrypted with a customer managed KMS key", [name]), r)
+}

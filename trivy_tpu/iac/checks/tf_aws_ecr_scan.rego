@@ -1,0 +1,13 @@
+# METADATA
+# title: ECR repository does not scan images on push
+# custom:
+#   id: AVD-AWS-0030
+#   severity: HIGH
+#   recommended_action: Set image_scanning_configuration.scan_on_push true.
+package builtin.terraform.AWS0030
+
+deny[res] {
+    some name, r in object.get(object.get(input, "resource", {}), "aws_ecr_repository", {})
+    object.get(object.get(r, "image_scanning_configuration", {}), "scan_on_push", false) != true
+    res := result.new(sprintf("ECR repository %q does not scan images on push", [name]), r)
+}

@@ -1,0 +1,13 @@
+# METADATA
+# title: EFS file system is not encrypted
+# custom:
+#   id: AVD-AWS-0037
+#   severity: HIGH
+#   recommended_action: Set encrypted = true.
+package builtin.terraform.AWS0037
+
+deny[res] {
+    some name, fs in object.get(object.get(input, "resource", {}), "aws_efs_file_system", {})
+    object.get(fs, "encrypted", false) != true
+    res := result.new(sprintf("EFS file system %q is not encrypted", [name]), fs)
+}

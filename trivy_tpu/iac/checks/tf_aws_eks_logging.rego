@@ -1,0 +1,13 @@
+# METADATA
+# title: EKS cluster does not enable control plane logging
+# custom:
+#   id: AVD-AWS-0038
+#   severity: MEDIUM
+#   recommended_action: Set enabled_cluster_log_types.
+package builtin.terraform.AWS0038
+
+deny[res] {
+    some name, c in object.get(object.get(input, "resource", {}), "aws_eks_cluster", {})
+    count(object.get(c, "enabled_cluster_log_types", [])) == 0
+    res := result.new(sprintf("EKS cluster %q has no control plane log types enabled", [name]), c)
+}

@@ -1,0 +1,13 @@
+# METADATA
+# title: EKS cluster endpoint public access is enabled
+# custom:
+#   id: AVD-AWS-0040
+#   severity: CRITICAL
+#   recommended_action: Set vpc_config.endpoint_public_access false.
+package builtin.terraform.AWS0040
+
+deny[res] {
+    some name, c in object.get(object.get(input, "resource", {}), "aws_eks_cluster", {})
+    object.get(object.get(c, "vpc_config", {}), "endpoint_public_access", true) == true
+    res := result.new(sprintf("EKS cluster %q enables public endpoint access", [name]), c)
+}

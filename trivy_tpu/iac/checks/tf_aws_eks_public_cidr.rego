@@ -1,0 +1,16 @@
+# METADATA
+# title: EKS cluster endpoint is reachable from 0.0.0.0/0
+# custom:
+#   id: AVD-AWS-0039
+#   severity: CRITICAL
+#   recommended_action: Restrict public_access_cidrs.
+package builtin.terraform.AWS0039
+
+deny[res] {
+    some name, c in object.get(object.get(input, "resource", {}), "aws_eks_cluster", {})
+    vpc := object.get(c, "vpc_config", {})
+    object.get(vpc, "endpoint_public_access", true) == true
+    cidr := object.get(vpc, "public_access_cidrs", ["0.0.0.0/0"])[_]
+    cidr == "0.0.0.0/0"
+    res := result.new(sprintf("EKS cluster %q endpoint is publicly reachable from 0.0.0.0/0", [name]), c)
+}

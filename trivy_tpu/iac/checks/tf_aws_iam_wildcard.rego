@@ -1,0 +1,37 @@
+# METADATA
+# title: IAM policy allows wildcard actions
+# custom:
+#   id: AVD-AWS-0057
+#   severity: HIGH
+#   recommended_action: Scope IAM policy actions and resources narrowly.
+package builtin.terraform.AWS0057
+
+policies[pair] {
+    some type in ["aws_iam_policy", "aws_iam_role_policy", "aws_iam_user_policy", "aws_iam_group_policy"]
+    some name, p in object.get(object.get(input, "resource", {}), type, {})
+    raw := object.get(p, "policy", "")
+    is_string(raw)
+    doc := json.unmarshal(raw)
+    pair := {"name": name, "doc": doc, "p": p}
+}
+
+stmts[trip] {
+    some pair in policies
+    s := object.get(pair.doc, "Statement", [])[_]
+    trip := {"name": pair.name, "s": s, "p": pair.p}
+}
+
+deny[res] {
+    some trip in stmts
+    object.get(trip.s, "Effect", "Allow") == "Allow"
+    action := object.get(trip.s, "Action", [])[_]
+    action == "*"
+    res := result.new(sprintf("IAM policy %q allows all actions (*)", [trip.name]), trip.p)
+}
+
+deny[res] {
+    some trip in stmts
+    object.get(trip.s, "Effect", "Allow") == "Allow"
+    object.get(trip.s, "Action", "") == "*"
+    res := result.new(sprintf("IAM policy %q allows all actions (*)", [trip.name]), trip.p)
+}

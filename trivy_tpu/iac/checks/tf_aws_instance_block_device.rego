@@ -1,0 +1,33 @@
+# METADATA
+# title: Instance has an unencrypted block device
+# custom:
+#   id: AVD-AWS-0131
+#   severity: HIGH
+#   recommended_action: Set encrypted = true on root and EBS block devices.
+package builtin.terraform.AWS0131
+
+devices[pair] {
+    some name, i in object.get(object.get(input, "resource", {}), "aws_instance", {})
+    d := object.get(i, "root_block_device", null)
+    is_object(d)
+    pair := {"name": name, "d": d}
+}
+
+devices[pair] {
+    some name, i in object.get(object.get(input, "resource", {}), "aws_instance", {})
+    d := object.get(i, "ebs_block_device", [])[_]
+    pair := {"name": name, "d": d}
+}
+
+devices[pair] {
+    some name, i in object.get(object.get(input, "resource", {}), "aws_instance", {})
+    d := object.get(i, "ebs_block_device", null)
+    is_object(d)
+    pair := {"name": name, "d": d}
+}
+
+deny[res] {
+    some pair in devices
+    object.get(pair.d, "encrypted", false) != true
+    res := result.new(sprintf("Instance %q has an unencrypted block device", [pair.name]), pair.d)
+}

@@ -1,0 +1,13 @@
+# METADATA
+# title: Kinesis stream is not encrypted
+# custom:
+#   id: AVD-AWS-0064
+#   severity: HIGH
+#   recommended_action: Set encryption_type KMS with a key.
+package builtin.terraform.AWS0064
+
+deny[res] {
+    some name, s in object.get(object.get(input, "resource", {}), "aws_kinesis_stream", {})
+    object.get(s, "encryption_type", "NONE") != "KMS"
+    res := result.new(sprintf("Kinesis stream %q is not encrypted", [name]), s)
+}

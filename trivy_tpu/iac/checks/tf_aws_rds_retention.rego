@@ -1,0 +1,14 @@
+# METADATA
+# title: RDS backup retention is disabled
+# custom:
+#   id: AVD-AWS-0077
+#   severity: MEDIUM
+#   recommended_action: Set backup_retention_period to at least 1.
+package builtin.terraform.AWS0077
+
+deny[res] {
+    some type in ["aws_db_instance", "aws_rds_cluster"]
+    some name, db in object.get(object.get(input, "resource", {}), type, {})
+    object.get(db, "backup_retention_period", null) == 0
+    res := result.new(sprintf("%s %q disables backups (backup_retention_period = 0)", [type, name]), db)
+}

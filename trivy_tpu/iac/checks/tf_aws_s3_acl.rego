@@ -1,10 +1,10 @@
 # METADATA
 # title: S3 bucket has a public ACL
 # custom:
-#   id: AVD-AWS-0086
+#   id: AVD-AWS-0092
 #   severity: HIGH
 #   recommended_action: Remove public-read/public-read-write ACLs.
-package builtin.terraform.AWS0086
+package builtin.terraform.AWS0092
 
 deny[res] {
     some name, b in object.get(object.get(input, "resource", {}), "aws_s3_bucket", {})

@@ -1,0 +1,28 @@
+# METADATA
+# title: S3 encryption does not use a customer managed key
+# custom:
+#   id: AVD-AWS-0132
+#   severity: HIGH
+#   recommended_action: Set kms_master_key_id on the bucket encryption rule.
+package builtin.terraform.AWS0132
+
+sse_rules[pair] {
+    some name, b in object.get(object.get(input, "resource", {}), "aws_s3_bucket", {})
+    sse := object.get(b, "server_side_encryption_configuration", null)
+    is_object(sse)
+    pair := {"name": name, "rule": object.get(sse, "rule", {})}
+}
+
+sse_rules[pair] {
+    some name, b in object.get(object.get(input, "resource", {}), "aws_s3_bucket_server_side_encryption_configuration", {})
+    r := object.get(b, "rule", null)
+    is_object(r)
+    pair := {"name": name, "rule": r}
+}
+
+deny[res] {
+    some pair in sse_rules
+    d := object.get(pair.rule, "apply_server_side_encryption_by_default", {})
+    object.get(d, "kms_master_key_id", "") == ""
+    res := result.new(sprintf("S3 encryption for %q does not use a customer managed KMS key", [pair.name]), pair.rule)
+}

@@ -1,0 +1,14 @@
+# METADATA
+# title: S3 bucket does not have logging enabled
+# custom:
+#   id: AVD-AWS-0089
+#   severity: MEDIUM
+#   recommended_action: Add a logging block or aws_s3_bucket_logging resource.
+package builtin.terraform.AWS0089
+
+deny[res] {
+    some name, b in object.get(object.get(input, "resource", {}), "aws_s3_bucket", {})
+    not object.get(b, "logging", null)
+    count([n | some n, _l in object.get(object.get(input, "resource", {}), "aws_s3_bucket_logging", {})]) == 0
+    res := result.new(sprintf("S3 bucket %q does not have logging enabled", [name]), b)
+}

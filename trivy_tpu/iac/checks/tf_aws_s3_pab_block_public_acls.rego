@@ -1,0 +1,13 @@
+# METADATA
+# title: S3 Access Block does not block public ACLs
+# custom:
+#   id: AVD-AWS-0086
+#   severity: HIGH
+#   recommended_action: Set block_public_acls true.
+package builtin.terraform.AWS0086
+
+deny[res] {
+    some name, b in object.get(object.get(input, "resource", {}), "aws_s3_bucket_public_access_block", {})
+    object.get(b, "block_public_acls", false) != true
+    res := result.new(sprintf("Public access block %q should set block_public_acls to true", [name]), b)
+}

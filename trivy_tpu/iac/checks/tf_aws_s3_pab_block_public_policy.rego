@@ -1,0 +1,13 @@
+# METADATA
+# title: S3 Access Block does not block public policies
+# custom:
+#   id: AVD-AWS-0087
+#   severity: HIGH
+#   recommended_action: Set block_public_policy true.
+package builtin.terraform.AWS0087
+
+deny[res] {
+    some name, b in object.get(object.get(input, "resource", {}), "aws_s3_bucket_public_access_block", {})
+    object.get(b, "block_public_policy", false) != true
+    res := result.new(sprintf("Public access block %q should set block_public_policy to true", [name]), b)
+}

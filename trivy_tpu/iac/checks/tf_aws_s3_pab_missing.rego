@@ -1,0 +1,13 @@
+# METADATA
+# title: S3 bucket has no public access block
+# custom:
+#   id: AVD-AWS-0094
+#   severity: LOW
+#   recommended_action: Define an aws_s3_bucket_public_access_block for the bucket.
+package builtin.terraform.AWS0094
+
+deny[res] {
+    some name, b in object.get(object.get(input, "resource", {}), "aws_s3_bucket", {})
+    count([n | some n, _p in object.get(object.get(input, "resource", {}), "aws_s3_bucket_public_access_block", {})]) == 0
+    res := result.new(sprintf("S3 bucket %q does not have a public access block", [name]), b)
+}

@@ -1,0 +1,13 @@
+# METADATA
+# title: S3 Access Block does not restrict public buckets
+# custom:
+#   id: AVD-AWS-0093
+#   severity: HIGH
+#   recommended_action: Set restrict_public_buckets true.
+package builtin.terraform.AWS0093
+
+deny[res] {
+    some name, b in object.get(object.get(input, "resource", {}), "aws_s3_bucket_public_access_block", {})
+    object.get(b, "restrict_public_buckets", false) != true
+    res := result.new(sprintf("Public access block %q should set restrict_public_buckets to true", [name]), b)
+}

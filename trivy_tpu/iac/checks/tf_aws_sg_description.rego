@@ -1,0 +1,13 @@
+# METADATA
+# title: Security group has no description
+# custom:
+#   id: AVD-AWS-0099
+#   severity: LOW
+#   recommended_action: Add a description to the security group.
+package builtin.terraform.AWS0099
+
+deny[res] {
+    some name, sg in object.get(object.get(input, "resource", {}), "aws_security_group", {})
+    object.get(sg, "description", "") == ""
+    res := result.new(sprintf("Security group %q has no description", [name]), sg)
+}

@@ -1,0 +1,35 @@
+# METADATA
+# title: Security group allows egress to 0.0.0.0/0
+# custom:
+#   id: AVD-AWS-0104
+#   severity: CRITICAL
+#   recommended_action: Restrict egress CIDR ranges.
+package builtin.terraform.AWS0104
+
+egress_blocks[pair] {
+    some name, sg in object.get(object.get(input, "resource", {}), "aws_security_group", {})
+    eg := object.get(sg, "egress", [])
+    is_array(eg)
+    blk := eg[_]
+    pair := {"name": name, "blk": blk}
+}
+
+egress_blocks[pair] {
+    some name, sg in object.get(object.get(input, "resource", {}), "aws_security_group", {})
+    blk := object.get(sg, "egress", null)
+    is_object(blk)
+    pair := {"name": name, "blk": blk}
+}
+
+egress_blocks[pair] {
+    some name, r in object.get(object.get(input, "resource", {}), "aws_security_group_rule", {})
+    object.get(r, "type", "") == "egress"
+    pair := {"name": name, "blk": r}
+}
+
+deny[res] {
+    some pair in egress_blocks
+    cidr := object.get(pair.blk, "cidr_blocks", [])[_]
+    cidr in ["0.0.0.0/0", "::/0"]
+    res := result.new(sprintf("Security group %q allows egress to %s", [pair.name, cidr]), pair.blk)
+}

@@ -1,0 +1,13 @@
+# METADATA
+# title: SNS topic is not encrypted
+# custom:
+#   id: AVD-AWS-0095
+#   severity: HIGH
+#   recommended_action: Set kms_master_key_id on the topic.
+package builtin.terraform.AWS0095
+
+deny[res] {
+    some name, t in object.get(object.get(input, "resource", {}), "aws_sns_topic", {})
+    object.get(t, "kms_master_key_id", "") == ""
+    res := result.new(sprintf("SNS topic %q is not encrypted at rest", [name]), t)
+}

@@ -1,0 +1,14 @@
+# METADATA
+# title: SQS queue is not encrypted
+# custom:
+#   id: AVD-AWS-0096
+#   severity: HIGH
+#   recommended_action: Set kms_master_key_id or sqs_managed_sse_enabled.
+package builtin.terraform.AWS0096
+
+deny[res] {
+    some name, q in object.get(object.get(input, "resource", {}), "aws_sqs_queue", {})
+    object.get(q, "kms_master_key_id", "") == ""
+    object.get(q, "sqs_managed_sse_enabled", false) != true
+    res := result.new(sprintf("SQS queue %q is not encrypted at rest", [name]), q)
+}

@@ -1,0 +1,32 @@
+# METADATA
+# title: SQS queue policy allows wildcard actions
+# custom:
+#   id: AVD-AWS-0097
+#   severity: HIGH
+#   recommended_action: Scope queue policy actions narrowly.
+package builtin.terraform.AWS0097
+
+docs[pair] {
+    some name, p in object.get(object.get(input, "resource", {}), "aws_sqs_queue_policy", {})
+    raw := object.get(p, "policy", "")
+    is_string(raw)
+    doc := json.unmarshal(raw)
+    pair := {"name": name, "doc": doc, "p": p}
+}
+
+deny[res] {
+    some pair in docs
+    s := object.get(pair.doc, "Statement", [])[_]
+    object.get(s, "Effect", "Allow") == "Allow"
+    object.get(s, "Action", "") in ["*", "sqs:*"]
+    res := result.new(sprintf("SQS queue policy %q allows wildcard actions", [pair.name]), pair.p)
+}
+
+deny[res] {
+    some pair in docs
+    s := object.get(pair.doc, "Statement", [])[_]
+    object.get(s, "Effect", "Allow") == "Allow"
+    a := object.get(s, "Action", [])[_]
+    a in ["*", "sqs:*"]
+    res := result.new(sprintf("SQS queue policy %q allows wildcard actions", [pair.name]), pair.p)
+}

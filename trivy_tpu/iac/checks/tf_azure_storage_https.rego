@@ -1,0 +1,13 @@
+# METADATA
+# title: Storage account allows insecure (HTTP) transfer
+# custom:
+#   id: AVD-AZU-0008
+#   severity: HIGH
+#   recommended_action: Set enable_https_traffic_only true.
+package builtin.terraform.AZU0008
+
+deny[res] {
+    some name, sa in object.get(object.get(input, "resource", {}), "azurerm_storage_account", {})
+    object.get(sa, "enable_https_traffic_only", true) == false
+    res := result.new(sprintf("Storage account %q allows insecure transfer", [name]), sa)
+}

@@ -1,0 +1,19 @@
+# METADATA
+# title: Storage account allows public blob access
+# custom:
+#   id: AVD-AZU-0007
+#   severity: HIGH
+#   recommended_action: Set allow_blob_public_access false.
+package builtin.terraform.AZU0007
+
+deny[res] {
+    some name, sa in object.get(object.get(input, "resource", {}), "azurerm_storage_account", {})
+    object.get(sa, "allow_blob_public_access", false) == true
+    res := result.new(sprintf("Storage account %q allows public blob access", [name]), sa)
+}
+
+deny[res] {
+    some name, sa in object.get(object.get(input, "resource", {}), "azurerm_storage_account", {})
+    object.get(sa, "allow_nested_items_to_be_public", false) == true
+    res := result.new(sprintf("Storage account %q allows public blob access", [name]), sa)
+}

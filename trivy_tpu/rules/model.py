@@ -228,9 +228,11 @@ def _required_literals(pat: str) -> tuple[list[str], bool] | None:
         tree = sre.parse(pat)
     except Exception:
         return None
+    has_ci = False
 
     def walk(items) -> set[str] | None:
         """Best alternative-set for one sequence (None = nothing usable)."""
+        nonlocal has_ci
         candidates: list[set[str]] = []
         run: list[str] = []
 
@@ -247,7 +249,19 @@ def _required_literals(pat: str) -> tuple[list[str], bool] | None:
             flush()
             if name == "SUBPATTERN":
                 _g, add_flags, _del_flags, sub = av
-                if add_flags:  # scoped flags change literal semantics
+                if add_flags == re.IGNORECASE and not _del_flags:
+                    # (?i:...) — the translator's form for Go's (?i).
+                    # Literals inside are usable case-insensitively; the
+                    # whole harvest then runs against a lowered haystack
+                    # (a superset filter for any case-sensitive literals,
+                    # and every candidate line is re-verified with the
+                    # exact pattern).
+                    sub_alts = walk(sub)
+                    if sub_alts:
+                        has_ci = True
+                        candidates.append(sub_alts)
+                    continue
+                if add_flags:  # other scoped flags change semantics
                     continue
                 sub_alts = walk(sub)
                 if sub_alts:
@@ -276,7 +290,7 @@ def _required_literals(pat: str) -> tuple[list[str], bool] | None:
     slim = [
         a for a in alts if not any(b != a and b in a for b in alts)
     ]
-    ci = bool(tree.state.flags & re.IGNORECASE)
+    ci = has_ci or bool(tree.state.flags & re.IGNORECASE)
     if ci:
         slim = [a.lower() for a in slim]
     return slim, ci
