@@ -139,6 +139,30 @@ class _FakeAws(BaseHTTPRequestHandler):
             }}
         elif target.endswith("GetKeyRotationStatus"):
             out = {"KeyRotationEnabled": body.get("KeyId") == "key-2"}
+        elif target.endswith("DescribeRepositories"):
+            out = {"repositories": [
+                {"repositoryName": "app",
+                 "imageScanningConfiguration": {"scanOnPush": False},
+                 "imageTagMutability": "MUTABLE"},
+                {"repositoryName": "hardened",
+                 "imageScanningConfiguration": {"scanOnPush": True},
+                 "imageTagMutability": "IMMUTABLE",
+                 "encryptionConfiguration": {"encryptionType": "KMS"}},
+            ]}
+        elif target.endswith("ListTables"):
+            out = {"TableNames": ["orders"]}
+        elif target.endswith("DescribeTable"):
+            out = {"Table": {"SSEDescription": {"Status": "DISABLED"}}}
+        elif target.endswith("DescribeContinuousBackups"):
+            out = {"ContinuousBackupsDescription": {
+                "PointInTimeRecoveryDescription": {
+                    "PointInTimeRecoveryStatus": "DISABLED"}}}
+        elif target.endswith("ListStreams"):
+            out = {"StreamNames": ["events"]}
+        elif target.endswith("DescribeStreamSummary"):
+            out = {"StreamDescriptionSummary": {"EncryptionType": "NONE"}}
+        elif target.endswith("DescribeLogGroups"):
+            out = {"logGroups": [{"logGroupName": "/app/prod"}]}
         else:
             self.send_response(400)
             self.end_headers()
@@ -149,8 +173,60 @@ class _FakeAws(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    def _send_json(self, obj, status: int = 200):
+        data = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.end_headers()
+        self.wfile.write(data)
+
     def do_GET(self):  # noqa: N802
         path, _, query = self.path.partition("?")
+        if path == "/" and "Action=ListTopics" in query:
+            return self._send("""<?xml version="1.0"?>
+<ListTopicsResponse><ListTopicsResult><Topics>
+  <member><TopicArn>arn:aws:sns:us-east-1:1:alerts</TopicArn></member>
+</Topics></ListTopicsResult></ListTopicsResponse>""")
+        if path == "/" and "Action=GetTopicAttributes" in query:
+            return self._send("""<?xml version="1.0"?>
+<GetTopicAttributesResponse><GetTopicAttributesResult><Attributes>
+  <entry><key>DisplayName</key><value>alerts</value></entry>
+</Attributes></GetTopicAttributesResult></GetTopicAttributesResponse>""")
+        if path == "/" and "Action=ListQueues" in query:
+            return self._send("""<?xml version="1.0"?>
+<ListQueuesResponse><ListQueuesResult>
+  <QueueUrl>https://sqs.us-east-1.amazonaws.com/1/jobs</QueueUrl>
+</ListQueuesResult></ListQueuesResponse>""")
+        if path == "/" and "Action=GetQueueAttributes" in query:
+            return self._send("""<?xml version="1.0"?>
+<GetQueueAttributesResponse><GetQueueAttributesResult>
+  <Attribute><Name>SqsManagedSseEnabled</Name><Value>false</Value></Attribute>
+</GetQueueAttributesResult></GetQueueAttributesResponse>""")
+        if path == "/clusters":
+            return self._send_json({"clusters": ["prod"]})
+        if path == "/clusters/prod":
+            return self._send_json({"cluster": {
+                "resourcesVpcConfig": {"endpointPublicAccess": True,
+                                       "publicAccessCidrs": ["0.0.0.0/0"]},
+                "logging": {"clusterLogging": [
+                    {"types": ["api"], "enabled": False}]},
+            }})
+        if path == "/2015-02-01/file-systems":
+            return self._send_json({"FileSystems": [
+                {"FileSystemId": "fs-01", "Encrypted": False}]})
+        if path == "/2020-05-31/distribution":
+            return self._send("""<?xml version="1.0"?>
+<DistributionList><Items><DistributionSummary>
+  <Id>E123</Id>
+</DistributionSummary></Items></DistributionList>""")
+        if path == "/2020-05-31/distribution/E123/config":
+            return self._send("""<?xml version="1.0"?>
+<DistributionConfig>
+  <DefaultCacheBehavior><ViewerProtocolPolicy>allow-all</ViewerProtocolPolicy></DefaultCacheBehavior>
+  <ViewerCertificate><MinimumProtocolVersion>TLSv1</MinimumProtocolVersion>
+    <CloudFrontDefaultCertificate>false</CloudFrontDefaultCertificate></ViewerCertificate>
+  <Logging><Enabled>false</Enabled></Logging>
+</DistributionConfig>""")
         if path == "/" and "Action=DescribeInstances" in query:
             return self._send(DESCRIBE_INSTANCES)
         if path == "/" and "Action=DescribeVolumes" in query:
@@ -218,7 +294,7 @@ def test_aws_scan_runs_terraform_checks(aws_endpoint):
 
 def test_unsupported_service_is_loud(aws_endpoint):
     with pytest.raises(AwsError):
-        AwsScanner(services=["dynamodb"], endpoint=aws_endpoint).scan()
+        AwsScanner(services=["glacier"], endpoint=aws_endpoint).scan()
 
 
 def test_aws_cli_surface(aws_endpoint):
@@ -312,3 +388,33 @@ def test_cloudtrail_absence_fails(aws_endpoint, monkeypatch):
     results = scanner.scan()
     ids = {f.check_id for mc in results for f in mc.failures}
     assert "AVD-AWS-0014" in ids
+
+
+def test_new_service_adapters_feed_checks(aws_endpoint):
+    """r3 breadth: sns/sqs/ecr/eks/dynamodb/cloudfront/efs/kinesis/logs
+    adapters feed the shared terraform corpus; each misconfigured fake
+    resource trips its check."""
+    scanner = AwsScanner(
+        services=["sns", "sqs", "ecr", "eks", "dynamodb", "cloudfront",
+                  "efs", "kinesis", "logs"],
+        endpoint=aws_endpoint,
+    )
+    results = scanner.scan()
+    assert results
+    ids = {f.check_id for mc in results for f in mc.failures}
+    assert {"AVD-AWS-0095", "AVD-AWS-0096", "AVD-AWS-0030", "AVD-AWS-0031",
+            "AVD-AWS-0040", "AVD-AWS-0039", "AVD-AWS-0038", "AVD-AWS-0024",
+            "AVD-AWS-0012", "AVD-AWS-0013", "AVD-AWS-0010", "AVD-AWS-0037",
+            "AVD-AWS-0064", "AVD-AWS-0017"} <= ids, ids
+    # hardened ECR repo passes scan/immutability; messages name the bad one
+    msgs = [f.message for mc in results for f in mc.failures
+            if f.check_id in ("AVD-AWS-0030", "AVD-AWS-0031")]
+    assert all("app" in m for m in msgs)
+
+
+def test_eks_adapter_shapes(aws_endpoint):
+    scanner = AwsScanner(services=["eks"], endpoint=aws_endpoint)
+    res = scanner.adapt_eks(scanner._api("eks"))
+    prod = res["aws_eks_cluster"]["prod"]
+    assert prod["vpc_config"]["endpoint_public_access"] is True
+    assert prod["enabled_cluster_log_types"] == []

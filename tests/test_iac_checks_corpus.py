@@ -184,6 +184,21 @@ TF_CASES = [
         'resource "azurerm_storage_account" "sa" {\n  allow_blob_public_access = true\n}\n',
         'resource "azurerm_storage_account" "sa" {\n  allow_blob_public_access = false\n}\n',
     ),
+    (
+        "AVD-AWS-0104",
+        'resource "aws_security_group" "sg" {\n  description = "x"\n  egress {\n    ipv6_cidr_blocks = ["::/0"]\n  }\n}\n',
+        'resource "aws_security_group" "sg" {\n  description = "x"\n  egress {\n    ipv6_cidr_blocks = ["fd00::/8"]\n  }\n}\n',
+    ),
+    (
+        "AVD-AWS-0107",
+        'resource "aws_security_group" "sg" {\n  description = "x"\n  ingress {\n    ipv6_cidr_blocks = ["::/0"]\n  }\n}\n',
+        'resource "aws_security_group" "sg" {\n  description = "x"\n  ingress {\n    ipv6_cidr_blocks = ["fd00::/8"]\n  }\n}\n',
+    ),
+    (
+        "AVD-AZU-0007",
+        'resource "azurerm_storage_account" "sa" {\n  name = "x"\n}\n',
+        'resource "azurerm_storage_account" "sa" {\n  allow_nested_items_to_be_public = false\n}\n',
+    ),
 ]
 
 

@@ -25,7 +25,11 @@ from trivy_tpu.cache.s3 import S3Client, S3Error
 
 logger = logging.getLogger(__name__)
 
-SUPPORTED_SERVICES = ("s3", "ec2", "rds", "iam", "cloudtrail", "kms")
+SUPPORTED_SERVICES = (
+    "s3", "ec2", "rds", "iam", "cloudtrail", "kms",
+    "sns", "sqs", "ecr", "eks", "dynamodb", "cloudfront", "efs",
+    "kinesis", "logs",
+)
 
 
 class AwsError(RuntimeError):
@@ -74,9 +78,21 @@ class _AwsApi(S3Client):
         except ET.ParseError as e:
             raise AwsError(f"aws: bad XML from {path_and_query}: {e}") from e
 
+    @staticmethod
+    def _decode_json(status: int, payload: bytes, what: str) -> dict:
+        import json as _json
+
+        if status >= 400:
+            raise AwsError(f"aws: {what}: HTTP {status}: {payload[:200]!r}")
+        try:
+            out = _json.loads(payload or b"{}")
+        except ValueError as e:
+            raise AwsError(f"aws: bad JSON from {what}: {e}") from e
+        return out if isinstance(out, dict) else {}
+
     def call_json(self, target: str, body: dict) -> dict:
-        """JSON-protocol service call (CloudTrail/KMS): POST / with the
-        x-amz-target routing header, amz-json-1.1 body."""
+        """JSON-protocol service call (CloudTrail/KMS/DynamoDB/Kinesis):
+        POST / with the x-amz-target routing header, amz-json-1.1 body."""
         import json as _json
 
         data = _json.dumps(body).encode()
@@ -92,15 +108,15 @@ class _AwsApi(S3Client):
             )
         except S3Error as e:
             raise AwsError(str(e)) from e
-        if status >= 400:
-            raise AwsError(
-                f"aws: {target}: HTTP {status}: {payload[:200]!r}"
-            )
+        return self._decode_json(status, payload, target)
+
+    def call_rest_json(self, method: str, path: str) -> dict:
+        """REST-JSON service call (EKS/EFS-style GET APIs)."""
         try:
-            out = _json.loads(payload or b"{}")
-        except ValueError as e:
-            raise AwsError(f"aws: bad JSON from {target}: {e}") from e
-        return out if isinstance(out, dict) else {}
+            status, payload = self._request(method, path)
+        except S3Error as e:
+            raise AwsError(str(e)) from e
+        return self._decode_json(status, payload, f"{method} {path}")
 
 
 @dataclass
@@ -114,11 +130,19 @@ class AwsScanner:
         import os
 
         endpoint = self.endpoint or os.environ.get("AWS_ENDPOINT_URL", "")
+        # SigV4 signing name when it differs from the service key.
+        sign = {"efs": "elasticfilesystem"}.get(service, service)
         if not endpoint:
             region = self.region or os.environ.get("AWS_REGION", "us-east-1")
-            endpoint = f"https://{service}.{region}.amazonaws.com"
+            if service == "cloudfront":
+                # Global control plane (no regional hostnames).
+                endpoint = "https://cloudfront.amazonaws.com"
+            elif service == "ecr":
+                endpoint = f"https://api.ecr.{region}.amazonaws.com"
+            else:
+                endpoint = f"https://{sign}.{region}.amazonaws.com"
         return _AwsApi(
-            bucket="", region=self.region, endpoint=endpoint, service=service
+            bucket="", region=self.region, endpoint=endpoint, service=sign
         )
 
     # -- adapters ----------------------------------------------------------
@@ -386,6 +410,369 @@ class AwsScanner:
                 logger.warning("kms key %s: %s", key_id, e)
                 self.errors.append(f"kms key {key_id}: {e}")
         return {"aws_kms_key": keys} if keys else {}
+
+    def _query_paged(
+        self, api: _AwsApi, base: str, item_tag: str
+    ) -> list[str]:
+        """Collect `item_tag` texts across NextToken pages of a Query-XML
+        list action (a degraded page is an error, never a silent pass)."""
+        from urllib.parse import quote
+
+        out: list[str] = []
+        token = None
+        while True:
+            url = base if token is None else (
+                f"{base}&NextToken={quote(token, safe='')}"
+            )
+            root = api.call("GET", url)
+            if root is None:
+                return out
+            out.extend(
+                el.text
+                for el in root.iter()
+                if _strip_ns(el.tag) == item_tag and el.text
+            )
+            token = next(
+                (
+                    el.text
+                    for el in root.iter()
+                    if _strip_ns(el.tag) == "NextToken" and el.text
+                ),
+                None,
+            )
+            if not token:
+                return out
+
+    def adapt_sns(self, api: _AwsApi) -> dict:
+        """ListTopics (paginated) + GetTopicAttributes -> aws_sns_topic."""
+        topics: dict[str, dict] = {}
+        arns = self._query_paged(
+            api, "/?Action=ListTopics&Version=2010-03-31", "TopicArn"
+        )
+        from urllib.parse import quote
+
+        for arn in arns:
+            name = arn.rsplit(":", 1)[-1]
+            topics[name] = {"kms_master_key_id": ""}
+            try:
+                attrs = api.call(
+                    "GET",
+                    "/?Action=GetTopicAttributes&Version=2010-03-31"
+                    f"&TopicArn={quote(arn, safe='')}",
+                )
+            except AwsError as e:
+                self.errors.append(f"sns topic {name}: {e}")
+                continue
+            for entry in attrs.iter() if attrs is not None else []:
+                if _strip_ns(entry.tag) != "entry":
+                    continue
+                k, v = _find(entry, "key"), _find(entry, "value")
+                if k is not None and k.text == "KmsMasterKeyId":
+                    topics[name]["kms_master_key_id"] = (
+                        v.text if v is not None and v.text else ""
+                    )
+        return {"aws_sns_topic": topics} if topics else {}
+
+    def adapt_sqs(self, api: _AwsApi) -> dict:
+        """ListQueues (paginated) + GetQueueAttributes -> aws_sqs_queue."""
+        urls = self._query_paged(
+            api, "/?Action=ListQueues&Version=2012-11-05", "QueueUrl"
+        )
+        from urllib.parse import quote, urlparse
+
+        queues: dict[str, dict] = {}
+        for url in urls:
+            name = urlparse(url).path.rsplit("/", 1)[-1]
+            q = {"kms_master_key_id": "", "sqs_managed_sse_enabled": False}
+            queues[name] = q
+            try:
+                attrs = api.call(
+                    "GET",
+                    f"/?Action=GetQueueAttributes&Version=2012-11-05"
+                    f"&QueueUrl={quote(url, safe='')}&AttributeName.1=All",
+                )
+            except AwsError as e:
+                self.errors.append(f"sqs queue {name}: {e}")
+                continue
+            for attr in attrs.iter() if attrs is not None else []:
+                if _strip_ns(attr.tag) != "Attribute":
+                    continue
+                k, v = _find(attr, "Name"), _find(attr, "Value")
+                if k is None or v is None:
+                    continue
+                if k.text == "KmsMasterKeyId":
+                    q["kms_master_key_id"] = v.text or ""
+                elif k.text == "SqsManagedSseEnabled":
+                    q["sqs_managed_sse_enabled"] = v.text == "true"
+        return {"aws_sqs_queue": queues} if queues else {}
+
+    def adapt_ecr(self, api: _AwsApi) -> dict:
+        """DescribeRepositories (paginated) -> aws_ecr_repository."""
+        repos: dict[str, dict] = {}
+        token = None
+        while True:
+            req: dict = {"nextToken": token} if token else {}
+            out = api.call_json(
+                "AmazonEC2ContainerRegistry_V20150921.DescribeRepositories",
+                req,
+            )
+            for r in out.get("repositories") or []:
+                name = r.get("repositoryName", "")
+                if not name:
+                    continue
+                enc = r.get("encryptionConfiguration") or {}
+                repos[name] = {
+                    "image_scanning_configuration": {
+                        "scan_on_push": bool(
+                            (r.get("imageScanningConfiguration") or {}).get(
+                                "scanOnPush"
+                            )
+                        )
+                    },
+                    "image_tag_mutability": r.get(
+                        "imageTagMutability", "MUTABLE"
+                    ),
+                    "encryption_configuration": {
+                        "encryption_type": enc.get("encryptionType", "AES256")
+                    },
+                }
+            token = out.get("nextToken")
+            if not token:
+                break
+        return {"aws_ecr_repository": repos} if repos else {}
+
+    def adapt_eks(self, api: _AwsApi) -> dict:
+        """ListClusters (paginated) + DescribeCluster -> aws_eks_cluster."""
+        from urllib.parse import quote
+
+        names: list[str] = []
+        token = None
+        while True:
+            path = "/clusters" if token is None else (
+                f"/clusters?nextToken={quote(token, safe='')}"
+            )
+            out = api.call_rest_json("GET", path)
+            names.extend(out.get("clusters") or [])
+            token = out.get("nextToken")
+            if not token:
+                break
+        clusters: dict[str, dict] = {}
+        for name in names:
+            try:
+                c = api.call_rest_json("GET", f"/clusters/{name}").get(
+                    "cluster"
+                ) or {}
+            except AwsError as e:
+                self.errors.append(f"eks cluster {name}: {e}")
+                continue
+            vpc = c.get("resourcesVpcConfig") or {}
+            log_types: list[str] = []
+            for grp in (c.get("logging") or {}).get("clusterLogging") or []:
+                if grp.get("enabled"):
+                    log_types.extend(grp.get("types") or [])
+            clusters[name] = {
+                "vpc_config": {
+                    "endpoint_public_access": bool(
+                        vpc.get("endpointPublicAccess", True)
+                    ),
+                    "public_access_cidrs": vpc.get("publicAccessCidrs")
+                    or ["0.0.0.0/0"],
+                },
+                "enabled_cluster_log_types": log_types,
+            }
+        return {"aws_eks_cluster": clusters} if clusters else {}
+
+    def adapt_dynamodb(self, api: _AwsApi) -> dict:
+        """ListTables (paginated) + DescribeTable +
+        DescribeContinuousBackups -> aws_dynamodb_table resources."""
+        names: list[str] = []
+        start = None
+        while True:
+            req: dict = (
+                {"ExclusiveStartTableName": start} if start else {}
+            )
+            out = api.call_json("DynamoDB_20120810.ListTables", req)
+            names.extend(out.get("TableNames") or [])
+            start = out.get("LastEvaluatedTableName")
+            if not start:
+                break
+        tables: dict[str, dict] = {}
+        for name in names:
+            t: dict = {
+                "server_side_encryption": {"enabled": False, "kms_key_arn": ""},
+                "point_in_time_recovery": {"enabled": False},
+            }
+            tables[name] = t
+            try:
+                desc = api.call_json(
+                    "DynamoDB_20120810.DescribeTable", {"TableName": name}
+                ).get("Table") or {}
+                sse = desc.get("SSEDescription") or {}
+                t["server_side_encryption"] = {
+                    "enabled": sse.get("Status") == "ENABLED",
+                    "kms_key_arn": sse.get("KMSMasterKeyArn", ""),
+                }
+                backups = api.call_json(
+                    "DynamoDB_20120810.DescribeContinuousBackups",
+                    {"TableName": name},
+                ).get("ContinuousBackupsDescription") or {}
+                pitr = backups.get("PointInTimeRecoveryDescription") or {}
+                t["point_in_time_recovery"] = {
+                    "enabled": pitr.get("PointInTimeRecoveryStatus")
+                    == "ENABLED"
+                }
+            except AwsError as e:
+                self.errors.append(f"dynamodb table {name}: {e}")
+        return {"aws_dynamodb_table": tables} if tables else {}
+
+    def adapt_cloudfront(self, api: _AwsApi) -> dict:
+        """ListDistributions (Marker-paginated) + GetDistributionConfig ->
+        aws_cloudfront_distribution resources."""
+        from urllib.parse import quote
+
+        ids: list[str] = []
+        marker = None
+        while True:
+            path = "/2020-05-31/distribution" if marker is None else (
+                f"/2020-05-31/distribution?Marker={quote(marker, safe='')}"
+            )
+            root = api.call("GET", path)
+            if root is None:
+                break
+            ids.extend(
+                _find(s, "Id").text
+                for s in root.iter()
+                if _strip_ns(s.tag) == "DistributionSummary"
+                and _find(s, "Id") is not None
+            )
+            truncated = next(
+                (
+                    el.text == "true"
+                    for el in root.iter()
+                    if _strip_ns(el.tag) == "IsTruncated"
+                ),
+                False,
+            )
+            marker = next(
+                (
+                    el.text
+                    for el in root.iter()
+                    if _strip_ns(el.tag) == "NextMarker" and el.text
+                ),
+                None,
+            )
+            if not truncated or not marker:
+                break
+        dists: dict[str, dict] = {}
+        for dist_id in ids:
+            try:
+                cfg = api.call(
+                    "GET", f"/2020-05-31/distribution/{dist_id}/config"
+                )
+            except AwsError as e:
+                self.errors.append(f"cloudfront {dist_id}: {e}")
+                continue
+            if cfg is None:
+                continue
+            d: dict = {}
+            beh = _find(cfg, "DefaultCacheBehavior")
+            if beh is not None:
+                vpp = _find(beh, "ViewerProtocolPolicy")
+                d["default_cache_behavior"] = {
+                    "viewer_protocol_policy": (
+                        vpp.text if vpp is not None and vpp.text else "allow-all"
+                    )
+                }
+            cert = _find(cfg, "ViewerCertificate")
+            if cert is not None:
+                mpv = _find(cert, "MinimumProtocolVersion")
+                default_cert = _find(cert, "CloudFrontDefaultCertificate")
+                d["viewer_certificate"] = {
+                    "minimum_protocol_version": (
+                        mpv.text if mpv is not None and mpv.text else "TLSv1"
+                    ),
+                    "cloudfront_default_certificate": (
+                        default_cert is not None
+                        and default_cert.text == "true"
+                    ),
+                }
+            logging_el = _find(cfg, "Logging")
+            enabled = (
+                _find(logging_el, "Enabled") if logging_el is not None else None
+            )
+            if enabled is not None and enabled.text == "true":
+                bucket = _find(logging_el, "Bucket")
+                d["logging_config"] = {
+                    "bucket": bucket.text if bucket is not None else ""
+                }
+            dists[dist_id] = d
+        return {"aws_cloudfront_distribution": dists} if dists else {}
+
+    def adapt_efs(self, api: _AwsApi) -> dict:
+        """DescribeFileSystems (Marker-paginated) -> aws_efs_file_system."""
+        from urllib.parse import quote
+
+        systems: dict[str, dict] = {}
+        marker = None
+        while True:
+            path = "/2015-02-01/file-systems" if marker is None else (
+                f"/2015-02-01/file-systems?Marker={quote(marker, safe='')}"
+            )
+            out = api.call_rest_json("GET", path)
+            for fs in out.get("FileSystems") or []:
+                fsid = fs.get("FileSystemId", "")
+                if fsid:
+                    systems[fsid] = {"encrypted": bool(fs.get("Encrypted"))}
+            marker = out.get("NextMarker")
+            if not marker:
+                break
+        return {"aws_efs_file_system": systems} if systems else {}
+
+    def adapt_kinesis(self, api: _AwsApi) -> dict:
+        """ListStreams (paginated) + DescribeStreamSummary ->
+        aws_kinesis_stream resources."""
+        names: list[str] = []
+        start = None
+        while True:
+            req: dict = (
+                {"ExclusiveStartStreamName": start} if start else {}
+            )
+            out = api.call_json("Kinesis_20131202.ListStreams", req)
+            page = out.get("StreamNames") or []
+            names.extend(page)
+            if not out.get("HasMoreStreams") or not page:
+                break
+            start = page[-1]
+        streams: dict[str, dict] = {}
+        for name in names:
+            streams[name] = {"encryption_type": "NONE"}
+            try:
+                desc = api.call_json(
+                    "Kinesis_20131202.DescribeStreamSummary",
+                    {"StreamName": name},
+                ).get("StreamDescriptionSummary") or {}
+                streams[name]["encryption_type"] = desc.get(
+                    "EncryptionType", "NONE"
+                )
+            except AwsError as e:
+                self.errors.append(f"kinesis stream {name}: {e}")
+        return {"aws_kinesis_stream": streams} if streams else {}
+
+    def adapt_logs(self, api: _AwsApi) -> dict:
+        """DescribeLogGroups -> aws_cloudwatch_log_group resources."""
+        groups: dict[str, dict] = {}
+        token = None
+        while True:
+            req: dict = {"nextToken": token} if token else {}
+            out = api.call_json("Logs_20140328.DescribeLogGroups", req)
+            for g in out.get("logGroups") or []:
+                name = g.get("logGroupName", "")
+                if name:
+                    groups[name] = {"kms_key_id": g.get("kmsKeyId", "")}
+            token = out.get("nextToken")
+            if not token:
+                break
+        return {"aws_cloudwatch_log_group": groups} if groups else {}
 
     # -- scan --------------------------------------------------------------
 

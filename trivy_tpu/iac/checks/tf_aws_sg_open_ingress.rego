@@ -29,7 +29,8 @@ ingress_blocks[pair] {
 
 deny[res] {
     some pair in ingress_blocks
-    cidr := object.get(pair.blk, "cidr_blocks", [])[_]
+    some field in ["cidr_blocks", "ipv6_cidr_blocks"]
+    cidr := object.get(pair.blk, field, [])[_]
     cidr in ["0.0.0.0/0", "::/0"]
     res := result.new(sprintf("Security group %q allows ingress from %s", [pair.name, cidr]), pair.blk)
 }

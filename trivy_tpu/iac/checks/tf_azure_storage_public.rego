@@ -3,7 +3,7 @@
 # custom:
 #   id: AVD-AZU-0007
 #   severity: HIGH
-#   recommended_action: Set allow_blob_public_access false.
+#   recommended_action: Set allow_nested_items_to_be_public (or allow_blob_public_access) false.
 package builtin.terraform.AZU0007
 
 deny[res] {
@@ -16,4 +16,13 @@ deny[res] {
     some name, sa in object.get(object.get(input, "resource", {}), "azurerm_storage_account", {})
     object.get(sa, "allow_nested_items_to_be_public", false) == true
     res := result.new(sprintf("Storage account %q allows public blob access", [name]), sa)
+}
+
+# azurerm v3 defaults allow_nested_items_to_be_public to TRUE: an account
+# that sets neither attribute deploys public-capable and must fail.
+deny[res] {
+    some name, sa in object.get(object.get(input, "resource", {}), "azurerm_storage_account", {})
+    object.get(sa, "allow_blob_public_access", "absent") == "absent"
+    object.get(sa, "allow_nested_items_to_be_public", "absent") == "absent"
+    res := result.new(sprintf("Storage account %q allows public blob access by provider default", [name]), sa)
 }
