@@ -178,7 +178,7 @@ void gram_sieve(const uint8_t* rows, int64_t T, int64_t L,
 // (cheaper than the tri hash when testing one position at a time).
 //
 // Dedup: keyword occurrences repeat the same 4-byte window dozens of times
-// per file; a 64-entry direct-mapped seen-set (stamped with the file
+// per file; a 256-entry direct-mapped seen-set (stamped with the file
 // ordinal) and a 4-entry vectorized `recent` filter drop re-resolutions.
 // Both reset when attribution crosses a file boundary.
 //
@@ -235,9 +235,9 @@ void scan_files_impl(const uint8_t* stream, int64_t n,
     int64_t last_pass = -1;
     uint32_t recent[4] = {0xFFFFFFFFu, 0xFFFFFFFFu, 0xFFFFFFFFu, 0xFFFFFFFFu};
     int recent_at = 0;
-    uint32_t seen_w[64];
-    int32_t seen_file[64];
-    for (int k = 0; k < 64; ++k) seen_file[k] = -1;
+    uint32_t seen_w[256];
+    int32_t seen_file[256];
+    for (int k = 0; k < 256; ++k) seen_file[k] = -1;
     auto resolve = [&](int64_t i, uint32_t w) {
         const int32_t prev = cur;
         while (cur + 1 < F && i >= file_starts[cur + 1]) ++cur;
@@ -248,10 +248,10 @@ void scan_files_impl(const uint8_t* stream, int64_t n,
             recent[0] = recent[1] = recent[2] = recent[3] = 0xFFFFFFFFu;
         } else {
             if (i > last_pass) last_pass = i;
-            const uint32_t si0 = (w * kHashMul) >> 26;
+            const uint32_t si0 = (w * kHashMul) >> 24;
             if (seen_file[si0] == cur && seen_w[si0] == w) return;
         }
-        const uint32_t si = (w * kHashMul) >> 26;
+        const uint32_t si = (w * kHashMul) >> 24;
         seen_w[si] = w;
         seen_file[si] = cur;
         recent[recent_at] = w;
@@ -262,6 +262,9 @@ void scan_files_impl(const uint8_t* stream, int64_t n,
         // pre-screen only constrains bytes 0-2, so windows whose byte 3
         // breaks a full-width gram (~3% of all windows on source text, vs
         // ~0.4% true hits) die here on one bloom load instead of a search.
+        // (A per-(file, masked-value) stamp-dedup table was tried here and
+        // REGRESSED ~40%: the MB-scale stamp arrays evict the L1/L2-hot
+        // bloom tables, costing more than the skipped binary searches.)
         for (size_t k = 0; k < ngroups; ++k) {
             const uint32_t x = w & gp[k].mask;
             if (!table_probe(gp[k], x)) continue;

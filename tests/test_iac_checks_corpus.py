@@ -199,6 +199,11 @@ TF_CASES = [
         'resource "azurerm_storage_account" "sa" {\n  name = "x"\n}\n',
         'resource "azurerm_storage_account" "sa" {\n  allow_nested_items_to_be_public = false\n}\n',
     ),
+    (
+        "AVD-GCP-0007",
+        'resource "google_project_iam_binding" "b" {\n  role = "roles/editor"\n  members = ["serviceAccount:ci@x.iam.gserviceaccount.com"]\n}\n',
+        'resource "google_project_iam_binding" "b" {\n  role = "roles/editor"\n  members = ["user:dev@example.com"]\n}\n',
+    ),
 ]
 
 
@@ -389,7 +394,7 @@ def test_kubernetes_checks(scanner, check_id, bad, good):
 
 def test_corpus_size_and_unique_ids_per_type():
     checks = load_checks()
-    assert len(checks) >= 107
+    assert len(checks) >= 108
     seen = set()
     for c in checks:
         key = (c.input_type, c.check_id)

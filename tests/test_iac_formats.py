@@ -556,3 +556,126 @@ def test_tfvars_keep_per_file_targets(tmp_path):
     }
     assert ("s3.tf", "AVD-AWS-0026") in fails  # finding stays on its file
     assert ("main.tf", "AVD-AWS-0026") not in fails
+
+
+def test_registry_module_resolved_via_init_manifest(tmp_path):
+    """r3: a registry-source module call resolves through the
+    `terraform init` manifest (.terraform/modules/modules.json) to its
+    downloaded directory; caller arguments flow in.  No manifest entry ->
+    the call is skipped (no network fetch ever happens)."""
+    import contextlib
+    import io
+
+    from trivy_tpu.cli import main
+
+    root = tmp_path / "infra"
+    moddir = root / ".terraform" / "modules" / "vol"
+    moddir.mkdir(parents=True)
+    (moddir / "main.tf").write_text(textwrap.dedent(
+        """
+        variable "encrypt" { default = true }
+        resource "aws_ebs_volume" "data" {
+          size      = 10
+          encrypted = var.encrypt
+        }
+        """
+    ))
+    (root / ".terraform" / "modules" / "modules.json").write_text(json.dumps({
+        "Modules": [
+            {"Key": "", "Source": "", "Dir": "."},
+            {"Key": "vol",
+             "Source": "registry.terraform.io/acme/vol/aws",
+             "Version": "1.2.3",
+             "Dir": ".terraform/modules/vol"},
+        ]
+    }))
+    (root / "main.tf").write_text(textwrap.dedent(
+        """
+        module "vol" {
+          source  = "acme/vol/aws"
+          version = "1.2.3"
+          encrypt = false
+        }
+        module "missing" {
+          source = "acme/absent/aws"
+        }
+        """
+    ))
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(["config", "--format", "json", str(root)])
+    assert rc == 0
+    report = json.loads(buf.getvalue())
+    by_target = {
+        r["Target"]: {
+            m["ID"]: m["Status"] for m in r.get("Misconfigurations", [])
+        }
+        for r in report["Results"] or []
+    }
+    target = ".terraform/modules/vol/main.tf"
+    # defaults alone would PASS; the registry call's encrypt=false FAILs
+    assert by_target[target]["AVD-AWS-0026"] == "FAIL"
+
+
+def test_nested_registry_module_via_dotted_manifest_key(tmp_path):
+    """r3 review: a downloaded module calling a registry module of its own
+    resolves through the dotted manifest key ('vol.child'); caller args
+    flow through both hops."""
+    import contextlib
+    import io
+
+    from trivy_tpu.cli import main
+
+    root = tmp_path / "infra"
+    vol = root / ".terraform" / "modules" / "vol"
+    child = root / ".terraform" / "modules" / "vol.child"
+    vol.mkdir(parents=True)
+    child.mkdir(parents=True)
+    (vol / "main.tf").write_text(textwrap.dedent(
+        """
+        variable "encrypt" { default = true }
+        module "child" {
+          source  = "acme/child/aws"
+          encrypt = var.encrypt
+        }
+        """
+    ))
+    (child / "main.tf").write_text(textwrap.dedent(
+        """
+        variable "encrypt" { default = true }
+        resource "aws_ebs_volume" "data" {
+          size      = 10
+          encrypted = var.encrypt
+        }
+        """
+    ))
+    (root / ".terraform" / "modules" / "modules.json").write_text(json.dumps({
+        "Modules": [
+            {"Key": "vol", "Source": "registry.terraform.io/acme/vol/aws",
+             "Dir": ".terraform/modules/vol"},
+            {"Key": "vol.child",
+             "Source": "registry.terraform.io/acme/child/aws",
+             "Dir": ".terraform/modules/vol.child"},
+        ]
+    }))
+    (root / "main.tf").write_text(textwrap.dedent(
+        """
+        module "vol" {
+          source  = "acme/vol/aws"
+          encrypt = false
+        }
+        """
+    ))
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(["config", "--format", "json", str(root)])
+    assert rc == 0
+    report = json.loads(buf.getvalue())
+    by_target = {
+        r["Target"]: {
+            m["ID"]: m["Status"] for m in r.get("Misconfigurations", [])
+        }
+        for r in report["Results"] or []
+    }
+    target = ".terraform/modules/vol.child/main.tf"
+    assert by_target[target]["AVD-AWS-0026"] == "FAIL"

@@ -186,6 +186,14 @@ def _is_tfvars(name: str) -> bool:
     return name == "terraform.tfvars" or name.endswith(".auto.tfvars")
 
 
+_INIT_MANIFEST = ".terraform/modules/modules.json"
+
+
+def _is_init_manifest(path: str) -> bool:
+    # Component-exact: a dir literally named "x.terraform" must not match.
+    return path == _INIT_MANIFEST or path.endswith("/" + _INIT_MANIFEST)
+
+
 class TerraformModulePostAnalyzer(PostAnalyzer):
     """Terraform module expansion (pkg/iac/scanners/terraform executor):
     a `module` block with a local relative source evaluates the child
@@ -201,14 +209,18 @@ class TerraformModulePostAnalyzer(PostAnalyzer):
         return "terraform-module"
 
     def version(self) -> int:
-        return 3  # v3: tfvars participation (cache keys must change)
+        return 4  # v4: terraform-init manifest module resolution
 
     def required(self, file_path: str, size: int, mode: int) -> bool:
         # .tf only: the expansion below reads HCL syntax (module calls in
         # .tf.json are out of scope, so those files are not buffered).
         # terraform.tfvars / *.auto.tfvars join the composite FS so root
-        # directories evaluate with their variable assignments.
+        # directories evaluate with their variable assignments, and
+        # `terraform init` module manifests join so registry/git module
+        # calls resolve to their downloaded directories.
         if _is_tfvars(file_path.rsplit("/", 1)[-1]):
+            return size < 1 << 20
+        if _is_init_manifest(file_path):
             return size < 1 << 20
         return file_path.endswith(".tf") and size < 1 << 20
 
@@ -256,9 +268,53 @@ class TerraformModulePostAnalyzer(PostAnalyzer):
             d = posixpath.normpath(posixpath.join(parent, source))
             return "" if d == "." else d
 
+        def manifest_child(parent_dir: str, call_name: str) -> str:
+            """Downloaded dir for a registry/git call: top-level calls use
+            the bare manifest key; calls made from inside a downloaded
+            module use the dotted key ("vol.child")."""
+            entries = manifests.get(parent_dir)
+            if entries is not None:
+                return entries.get(call_name, "")
+            rk = manifest_dirs.get(parent_dir)
+            if rk is not None:
+                root, key = rk
+                return manifests.get(root, {}).get(
+                    f"{key}.{call_name}", ""
+                )
+            return ""
+
         by_dir: dict[str, dict[str, dict]] = {}  # dir -> path -> parsed doc
         tfvars_files: dict[str, list[str]] = {}  # dir -> tfvars paths
+        # `terraform init` manifests: root dir -> {module key -> module dir},
+        # plus the reverse dir -> (root, key) index so calls made FROM a
+        # downloaded module resolve their nested registry children through
+        # the dotted manifest keys ("vol.child").  This is how registry/git
+        # module sources resolve offline — the reference evaluates the
+        # downloaded .terraform/modules tree the same way
+        # (pkg/iac/scanners/terraform); no network fetch here.
+        import json as _json
+
+        manifests: dict[str, dict[str, str]] = {}
+        manifest_dirs: dict[str, tuple[str, str]] = {}
         for path in fs.paths():
+            if _is_init_manifest(path):
+                root = path[: -len(_INIT_MANIFEST)].rstrip("/")
+                try:
+                    doc = _json.loads(fs.read(path).decode("utf-8", "replace"))
+                    entries = {}
+                    for m in doc.get("Modules") or []:
+                        key, mdir = m.get("Key", ""), m.get("Dir", "")
+                        if key and mdir and mdir not in (".", ""):
+                            full = posixpath.normpath(
+                                posixpath.join(root, mdir)
+                            )
+                            entries[key] = full
+                            manifest_dirs[full] = (root, key)
+                    if entries:
+                        manifests[root] = entries
+                except Exception:
+                    pass
+                continue
             if _is_tfvars(path.rsplit("/", 1)[-1]):
                 tfvars_files.setdefault(posixpath.dirname(path), []).append(
                     path
@@ -309,10 +365,14 @@ class TerraformModulePostAnalyzer(PostAnalyzer):
             except Exception:
                 calls = {}
             calls_by_dir[parent_dir] = calls
-            for blk in calls.values():
+            for cname, blk in calls.items():
                 source = str(blk.get("source", ""))
                 if source.startswith(("./", "../")):
                     child_dirs.add(norm_child(parent_dir, source))
+                elif source:
+                    mdir = manifest_child(parent_dir, cname)
+                    if mdir:
+                        child_dirs.add(mdir)
         for parent_dir, values in sorted(tfvars_by_dir.items()):
             if parent_dir in child_dirs or parent_dir not in by_dir:
                 continue
@@ -353,30 +413,61 @@ class TerraformModulePostAnalyzer(PostAnalyzer):
                 mc = shared_scanner().evaluate(p, "terraform", [doc])
                 if mc.failures or mc.successes:
                     misconfigs.append(mc)
+        # Worklist over module instantiations so caller arguments flow
+        # through CHAINS (root -> vol -> child): evaluating a child under
+        # its effective arguments also re-resolves the child's own module
+        # calls under those arguments and enqueues the grandchildren.
+        # Dedup on (child dir, effective args) bounds recursion/cycles.
+        work: list[tuple[str, str, dict]] = []
         for parent_dir, calls in sorted(calls_by_dir.items()):
             for name, blk in sorted(calls.items()):
-                source = str(blk.get("source", ""))
-                if not source.startswith(("./", "../")):
-                    continue  # registry/remote modules are out of scope
+                work.append((parent_dir, name, blk))
+        seen_inst: set = set()
+        budget = 2048  # runaway-cycle backstop
+        while work and budget > 0:
+            budget -= 1
+            parent_dir, name, blk = work.pop(0)
+            source = str(blk.get("source", ""))
+            if source.startswith(("./", "../")):
                 child_dir = norm_child(parent_dir, source)
-                child_docs = by_dir.get(child_dir)
-                if not child_docs:
+            else:
+                # Registry/git sources resolve through the
+                # `terraform init` manifest (incl. dotted keys for
+                # nested calls); without an entry (no init, or never
+                # downloaded) the call is skipped — module downloads
+                # are never performed here.
+                child_dir = manifest_child(parent_dir, name)
+                if not child_dir:
                     continue
-                try:
-                    doc = terraform_docs_input(
-                        [child_docs[p] for p in sorted(child_docs)],
-                        overrides=blk,
-                    )
-                except Exception as e:
-                    logger.warning(
-                        "module %s (%s) failed to evaluate: %s",
-                        name, child_dir, e,
-                    )
-                    continue
-                mc = shared_scanner().evaluate(
-                    child_dir or ".", "terraform", [doc]
+            child_docs = by_dir.get(child_dir)
+            if not child_docs:
+                continue
+            inst_key = (
+                child_dir,
+                tuple(sorted((k, repr(v)) for k, v in blk.items())),
+            )
+            if inst_key in seen_inst:
+                continue
+            seen_inst.add(inst_key)
+            docs_sorted = [child_docs[p] for p in sorted(child_docs)]
+            try:
+                doc = terraform_docs_input(docs_sorted, overrides=blk)
+            except Exception as e:
+                logger.warning(
+                    "module %s (%s) failed to evaluate: %s",
+                    name, child_dir, e,
                 )
-                per_child.setdefault(child_dir, []).append(mc)
+                continue
+            mc = shared_scanner().evaluate(
+                child_dir or ".", "terraform", [doc]
+            )
+            per_child.setdefault(child_dir, []).append(mc)
+            try:
+                sub_calls = self._resolved_calls(docs_sorted, overrides=blk)
+            except Exception:
+                sub_calls = {}
+            for sname, sblk in sorted(sub_calls.items()):
+                work.append((child_dir, sname, sblk))
 
         for child_dir, mcs in sorted(per_child.items()):
             child_paths = sorted(by_dir.get(child_dir, {}))

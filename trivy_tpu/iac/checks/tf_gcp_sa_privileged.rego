@@ -1,0 +1,34 @@
+# METADATA
+# title: Service accounts should not have roles assigned with excessive privileges
+# custom:
+#   id: AVD-GCP-0007
+#   severity: HIGH
+#   recommended_action: Assign service accounts a minimal set of permissions.
+package builtin.terraform.GCP0007
+
+bindings[pair] {
+    some type in [
+        "google_project_iam_member", "google_organization_iam_member",
+        "google_folder_iam_member",
+    ]
+    some name, b in object.get(object.get(input, "resource", {}), type, {})
+    member := object.get(b, "member", "")
+    pair := {"name": name, "b": b, "members": [member]}
+}
+
+bindings[pair] {
+    some type in [
+        "google_project_iam_binding", "google_organization_iam_binding",
+        "google_folder_iam_binding",
+    ]
+    some name, b in object.get(object.get(input, "resource", {}), type, {})
+    pair := {"name": name, "b": b, "members": object.get(b, "members", [])}
+}
+
+deny[res] {
+    some pair in bindings
+    object.get(pair.b, "role", "") in ["roles/owner", "roles/editor"]
+    m := pair.members[_]
+    startswith(m, "serviceAccount:")
+    res := result.new(sprintf("Service account is granted a privileged role (%s)", [object.get(pair.b, "role", "")]), pair.b)
+}
