@@ -186,7 +186,9 @@ def main() -> None:
     engine.warmup()
 
     mono = bench_corpus.make_monorepo_corpus(N_FILES)
-    detail, results, scan_items, _ = bench_corpus_config(mono, engine)
+    detail, results, scan_items, _ = bench_corpus_config(
+        mono, engine, trials=4
+    )
     # Oracle rate is per gated item; corpus-basis files/s scales by the
     # corpus-to-gated ratio (gating itself is negligible next to scanning).
     detail["oracle_files_per_sec"] = round(

@@ -383,3 +383,28 @@ def test_allow_paths_case_insensitive_literal_tier():
     assert kind == "lit"
     paths = ["a/SECRETS/f.txt", "b/secrets/g.txt", "c/SeCrEtS/h.txt", "d/other.txt"]
     assert rs.allow_paths(paths) == [rs.allow_path(p) for p in paths] == [True, True, True, False]
+
+
+def test_required_batch_joined_fast_path_parity():
+    """The joined C-speed gate must agree with the per-file loop on
+    adversarial paths (dot-basenames, skip-file names as dirs, exts in
+    dirnames, multiple hits per line)."""
+    from trivy_tpu.analyzer.secret import SecretAnalyzer
+
+    a = SecretAnalyzer()
+    cases = [
+        ("ok/app.py", 100), ("t.png", 50), (".png", 50), ("d/..png", 50),
+        ("go.mod/inner.py", 80),          # skip-file name as a DIR
+        ("x/go.mod", 80), ("go.sum", 80),
+        ("pkg.tar/readme.txt", 80),       # ext mid-path, not basename
+        ("a/.git/x", 80), ("b.git/x", 80), ("node_modules", 80),
+        ("x/node_modules/y", 80), ("deep/.gitignore", 80),
+        ("weird.gz", 80), ("multi.png.txt", 80), ("z/.deb", 80),
+        ("vendor/lib/x.go", 80), ("usr/share/doc/x", 80),
+    ]
+    fast = a.required_batch(cases)
+    loop = a._required_batch_loop(
+        cases, a.engine.ruleset.allow_paths([p for p, _ in cases])
+    )
+    single = [a.required(p, s, 0o644) for p, s in cases]
+    assert fast == loop == single

@@ -142,17 +142,11 @@ class SecretAnalyzer(BatchAnalyzer):
         ruleset = getattr(eng, "ruleset", None)
         return bool(ruleset and ruleset.allow_path(file_path))
 
-    def required_batch(self, files: list[tuple[str, int]]) -> list[bool]:
-        """required() over a corpus in one pass — identical verdicts, but
-        the allow-path gate runs as one batched multiline search
-        (RuleSet.allow_paths) and the dir/file gates as C-speed substring
-        tests instead of per-file path splitting; the rare endswith hit
-        falls back to splitext for exact parity (secret.go:115-153)."""
-        ruleset = getattr(self.engine, "ruleset", None)
-        if ruleset is not None:
-            allowed = ruleset.allow_paths([p for p, _ in files])
-        else:
-            allowed = [False] * len(files)
+    def _required_batch_loop(
+        self, files: list[tuple[str, int]], allowed: list[bool]
+    ) -> list[bool]:
+        """Per-file gate loop (the exact reference order of checks); used
+        when the joined fast path cannot apply."""
         skip_ext_tuple = tuple(SKIP_EXTS)
         cfg_skips = self._config_skip_paths
         sep = os.sep
@@ -179,6 +173,57 @@ class SecretAnalyzer(BatchAnalyzer):
                 out.append(False)
                 continue
             out.append(True)
+        return out
+
+    def required_batch(self, files: list[tuple[str, int]]) -> list[bool]:
+        """required() over a corpus in one pass — identical verdicts, with
+        every gate running at C speed (secret.go:115-153):
+
+        - allow paths: RuleSet.allow_paths (literal-find tiers)
+        - skip dirs / skip files / skip exts: str.find of component-exact
+          needles over the newline-joined "/"-prefixed paths; the rare
+          ext hit is re-verified with splitext so leading-dot basenames
+          (".png") keep the reference's semantics
+
+        A per-file Python loop here was ~1us x files — the single largest
+        cost of the gating pass at 100k files."""
+        ruleset = getattr(self.engine, "ruleset", None)
+        if ruleset is not None:
+            allowed = ruleset.allow_paths([p for p, _ in files])
+        else:
+            allowed = [False] * len(files)
+        sep = os.sep
+        if self._config_skip_paths or any(
+            "\n" in p or (sep != "/" and sep in p) for p, _ in files
+        ):
+            return self._required_batch_loop(files, allowed)
+
+        from trivy_tpu.rules.model import iter_needle_lines, joined_lines
+
+        n = len(files)
+        out = [True] * n
+        for i, ((_p, size), al) in enumerate(zip(files, allowed)):
+            if size < 10 or al:
+                out[i] = False
+        slashed = ["/" + p for p, _ in files]
+        joined, starts = joined_lines(slashed)
+
+        def mark(needle: str, verify=None) -> None:
+            for li in iter_needle_lines(joined, starts, needle):
+                if out[li] and (verify is None or verify(li)):
+                    out[li] = False
+
+        for d in SKIP_DIRS:
+            mark(f"/{d}/")
+        for fname in SKIP_FILES:
+            mark(f"/{fname}\n")
+
+        def ext_ok(li: int) -> bool:
+            base = slashed[li].rsplit("/", 1)[-1]
+            return os.path.splitext(base)[1] in SKIP_EXTS
+
+        for ext in SKIP_EXTS:
+            mark(f"{ext}\n", verify=ext_ok)
         return out
 
     @staticmethod

@@ -296,6 +296,31 @@ def _required_literals(pat: str) -> tuple[list[str], bool] | None:
     return slim, ci
 
 
+def joined_lines(paths: list[str]) -> tuple[str, list[int]]:
+    """Newline-joined text + line-start offsets for the batched literal
+    scans (allow_paths, SecretAnalyzer.required_batch).  The trailing
+    newline lets end-anchored needles ("x.png\\n") match the last line."""
+    from itertools import accumulate
+
+    joined = "\n".join(paths) + "\n"
+    starts = [0]
+    starts.extend(accumulate(len(p) + 1 for p in paths))
+    return joined, starts
+
+
+def iter_needle_lines(joined: str, starts: list[int], needle: str):
+    """Indices of lines containing `needle`, each line yielded once (the
+    scan resumes at the next line start after a hit — same line, same
+    verdict)."""
+    import bisect
+
+    pos = joined.find(needle)
+    while pos >= 0:
+        li = bisect.bisect_right(starts, pos) - 1
+        yield li
+        pos = joined.find(needle, starts[li + 1])
+
+
 def build_batch_allow_path(
     rules: list[AllowRule],
 ) -> "re.Pattern[str] | None":
@@ -411,15 +436,13 @@ class RuleSet:
             return [False] * len(paths)
         if self._path_strats is None:
             self._path_strats = self._build_path_strats()
-        joined = "\n".join(paths)
-        if joined.count("\n") != len(paths) - 1:  # newline inside a path
+        if any("\n" in p for p in paths):  # newline inside a path
             return [self.allow_path(p) for p in paths]
         import bisect
-        from itertools import accumulate
 
-        starts = [0]
-        starts.extend(accumulate(len(p) + 1 for p in paths))
-        out = [False] * len(paths)
+        n = len(paths)
+        joined, starts = joined_lines(paths)
+        out = [False] * n
         lowered: str | None = None
         for rule, kind, payload in self._path_strats:
             rx = rule.path
@@ -440,16 +463,14 @@ class RuleSet:
                 else:
                     hay = joined
                 for lit in lits:
-                    pos = hay.find(lit)
-                    while pos >= 0:
-                        li = bisect.bisect_right(starts, pos) - 1
+                    for li in iter_needle_lines(hay, starts, lit):
                         if not out[li] and rx.search(paths[li]):
                             out[li] = True
-                        # Same line, same verdict: resume at the next line.
-                        pos = hay.find(lit, starts[li + 1])
             elif kind == "scan":
                 for m in payload.finditer(joined):  # type: ignore[union-attr]
-                    out[bisect.bisect_right(starts, m.start()) - 1] = True
+                    li = bisect.bisect_right(starts, m.start()) - 1
+                    if li < n:
+                        out[li] = True
             else:
                 for i, p in enumerate(paths):
                     if not out[i] and rx.search(p):
