@@ -1,0 +1,159 @@
+"""Minimal bbolt file WRITER for test fixtures.
+
+Serializes a nested dict (bytes values = KV pairs, dict values = child
+buckets) into the bbolt on-disk layout trivy_tpu.db.bolt reads: two meta
+pages, an empty freelist, one leaf page per non-inline bucket (fixtures
+stay under one page), inline child buckets where bbolt would inline them
+(no sub-buckets).  Independent of the reader so layout mistakes fail the
+round-trip tests instead of cancelling out — every offset below follows
+the bbolt source layout, not the reader's code.
+"""
+
+from __future__ import annotations
+
+import struct
+
+PAGE_SIZE = 4096
+MAGIC = 0xED0CDAED
+FLAG_BRANCH = 0x01
+FLAG_LEAF = 0x02
+FLAG_META = 0x04
+FLAG_FREELIST = 0x10
+BUCKET_LEAF = 0x01
+
+
+def _fnv64a(data: bytes) -> int:
+    h = 0xCBF29CE484222325
+    for b in data:
+        h ^= b
+        h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def _page_header(pgid: int, flags: int, count: int, overflow: int = 0) -> bytes:
+    return struct.pack("<QHHI", pgid, flags, count, overflow)
+
+
+def _leaf_page_bytes(
+    pgid: int, entries: list[tuple[int, bytes, bytes]]
+) -> bytes:
+    """entries: (elem_flags, key, value), MUST be sorted by key."""
+    n = len(entries)
+    hdr = _page_header(pgid, FLAG_LEAF, n)
+    elems = b""
+    data = b""
+    data_base = 16 * n  # element array length; data follows it
+    cursor = data_base
+    for i, (flags, key, val) in enumerate(entries):
+        elem_off = 16 * i
+        pos = cursor - elem_off
+        elems += struct.pack("<IIII", flags, pos, len(key), len(val))
+        data += key + val
+        cursor += len(key) + len(val)
+    return hdr + elems + data
+
+
+def _branch_page_bytes(
+    pgid: int, entries: list[tuple[bytes, int]]
+) -> bytes:
+    """entries: (first_key_of_child, child_pgid), sorted by key."""
+    n = len(entries)
+    hdr = _page_header(pgid, FLAG_BRANCH, n)
+    elems = b""
+    data = b""
+    cursor = 16 * n
+    for i, (key, child) in enumerate(entries):
+        elem_off = 16 * i
+        pos = cursor - elem_off
+        elems += struct.pack("<IIQ", pos, len(key), child)
+        data += key
+        cursor += len(key)
+    return hdr + elems + data
+
+
+class _Builder:
+    def __init__(self):
+        self.pages: dict[int, bytes] = {}
+        self.next_pgid = 3  # 0,1 meta; 2 freelist
+
+    def alloc(self) -> int:
+        pgid = self.next_pgid
+        self.next_pgid += 1
+        return pgid
+
+    def bucket_value(self, d: dict) -> bytes:
+        """Serialized bucket header (+ inline page when bbolt would
+        inline: no sub-buckets and small)."""
+        has_sub = any(isinstance(v, dict) for v in d.values())
+        if not has_sub:
+            inline = _leaf_page_bytes(
+                0, [(0, k, v) for k, v in sorted(d.items())]
+            )
+            if 16 + len(inline) < PAGE_SIZE // 4:
+                return struct.pack("<QQ", 0, 0) + inline
+        pgid = self.write_bucket_pages(d)
+        return struct.pack("<QQ", pgid, 0)
+
+    def write_bucket_pages(self, d: dict, split: int = 0) -> int:
+        """Write this bucket as real pages; `split` > 0 forces the KV set
+        into `split` leaf pages under a branch root (exercises branch
+        descend in the reader)."""
+        entries = []
+        for k, v in sorted(d.items()):
+            if isinstance(v, dict):
+                entries.append((BUCKET_LEAF, k, self.bucket_value(v)))
+            else:
+                entries.append((0, k, v))
+        size = 16 + sum(16 + len(k) + len(v) for _f, k, v in entries)
+        if size > PAGE_SIZE and split <= 1:
+            split = (size + PAGE_SIZE // 2 - 1) // (PAGE_SIZE // 2)
+        if split > 1 and len(entries) >= split:
+            per = (len(entries) + split - 1) // split
+            children = []
+            for i in range(0, len(entries), per):
+                chunk = entries[i : i + per]
+                pgid = self.alloc()
+                self.pages[pgid] = _leaf_page_bytes(pgid, chunk)
+                children.append((chunk[0][1], pgid))
+            root = self.alloc()
+            self.pages[root] = _branch_page_bytes(root, children)
+            return root
+        pgid = self.alloc()
+        self.pages[pgid] = _leaf_page_bytes(pgid, entries)
+        return pgid
+
+
+def build_bolt(root: dict, split_root: int = 0) -> bytes:
+    """Serialize `root` (nested dict of bytes->bytes|dict) to a bbolt file."""
+    b = _Builder()
+    for _k, v in root.items():
+        assert isinstance(v, dict), "top-level entries must be buckets"
+    if split_root:
+        root_pgid = b.write_bucket_pages(root, split=split_root)
+    else:
+        root_pgid = b.write_bucket_pages(root)
+
+    total_pages = b.next_pgid
+    out = bytearray(total_pages * PAGE_SIZE)
+
+    # meta pages 0 and 1 (page 1 wins with the higher txid)
+    for pgno, txid in ((0, 0), (1, 1)):
+        meta = struct.pack(
+            "<IIIIQQQQQ",
+            MAGIC, 2, PAGE_SIZE, 0,
+            root_pgid, 0,  # root bucket {root, sequence}
+            2,             # freelist pgid
+            total_pages,   # high-water mark
+            txid,
+        )
+        meta += struct.pack("<Q", _fnv64a(meta))
+        page = _page_header(pgno, FLAG_META, 0) + meta
+        out[pgno * PAGE_SIZE : pgno * PAGE_SIZE + len(page)] = page
+
+    fl = _page_header(2, FLAG_FREELIST, 0)
+    out[2 * PAGE_SIZE : 2 * PAGE_SIZE + len(fl)] = fl
+
+    for pgid, page in b.pages.items():
+        assert len(page) <= PAGE_SIZE, "fixture page overflow"
+        out[pgid * PAGE_SIZE : pgid * PAGE_SIZE + len(page)] = page
+    return bytes(out)

@@ -1,0 +1,231 @@
+"""BoltDB reader tests: round-trip against the independent fixture writer,
+branch-page descend, inline buckets, and real-trivy-db consumption through
+BoltVulnDB (pkg/db/db.go analogue)."""
+
+import json
+
+import pytest
+
+from bolt_fixture import build_bolt
+from trivy_tpu.db.bolt import Bolt, BoltError
+
+
+def test_roundtrip_kv_and_nested_buckets():
+    db = Bolt(build_bolt({
+        b"alpine 3.17": {
+            b"musl": {b"CVE-2023-0001": b'{"FixedVersion": "1.2.4-r1"}'},
+            b"zlib": {b"CVE-2022-0002": b'{"FixedVersion": "1.2.13-r0"}'},
+        },
+        b"vulnerability": {
+            b"CVE-2023-0001": b'{"Title": "musl thing", "Severity": 3}',
+        },
+        b"data-source": {b"alpine 3.17": b'{"ID": "alpine"}'},
+    }))
+    assert db.bucket(b"alpine 3.17", b"musl").get(b"CVE-2023-0001") == (
+        b'{"FixedVersion": "1.2.4-r1"}'
+    )
+    assert db.bucket(b"alpine 3.17", b"nope") is None
+    assert db.bucket(b"missing") is None
+    assert db.bucket(b"vulnerability").get(b"CVE-2023-0001").startswith(b"{")
+    # a KV key is not a bucket, a bucket key is not a KV
+    assert db.bucket(b"vulnerability").bucket(b"CVE-2023-0001") is None
+    assert db.bucket(b"alpine 3.17").get(b"musl") is None
+    names = [k for k, _ in db.buckets()]
+    assert names == sorted([b"alpine 3.17", b"vulnerability", b"data-source"])
+    pkgs = [k for k, _ in db.bucket(b"alpine 3.17").buckets()]
+    assert pkgs == [b"musl", b"zlib"]
+
+
+def test_branch_page_descend_and_walk():
+    big = {b"pkg-%04d" % i: b"v%d" % i for i in range(200)}
+    db = Bolt(build_bolt({b"npm": big}))
+    assert db.bucket(b"npm").get(b"pkg-0123") == b"v123"
+
+    # now an explicitly split ROOT bucket (branch page at the top)
+    many_buckets = {
+        b"bucket-%03d" % i: {b"k": b"v%d" % i} for i in range(64)
+    }
+    db2 = Bolt(build_bolt(many_buckets, split_root=4))
+    assert db2.bucket(b"bucket-000").get(b"k") == b"v0"
+    assert db2.bucket(b"bucket-063").get(b"k") == b"v63"
+    assert db2.bucket(b"bucket-031", b"x") is None
+    assert len([k for k, _ in db2.buckets()]) == 64
+
+
+def test_invalid_file_rejected():
+    with pytest.raises(BoltError):
+        Bolt(b"\x00" * 16384)
+    with pytest.raises(BoltError):
+        Bolt(b"short")
+
+
+def test_bolt_vulndb_reads_real_schema(tmp_path):
+    """BoltVulnDB consumes a trivy-db-shaped bbolt file: int severity
+    enums, language PatchedVersions/VulnerableVersions, detail
+    enrichment from the vulnerability bucket."""
+    from trivy_tpu.db.vulndb import load_db
+
+    detail = {
+        "Title": "musl: oob",
+        "Description": "bad",
+        "Severity": 3,
+        "VendorSeverity": {"nvd": 3, "redhat": 2},
+        "CVSS": {"nvd": {"V3Score": 7.5}},
+        "References": ["https://x"],
+    }
+    blob = build_bolt({
+        b"alpine 3.17": {
+            b"musl": {b"CVE-2023-0001": b'{"FixedVersion": "1.2.4-r1"}'},
+        },
+        b"pip::GitHub Security Advisory": {
+            b"flask": {
+                b"GHSA-1": json.dumps({
+                    "PatchedVersions": ["2.2.5"],
+                    "VulnerableVersions": ["<2.2.5"],
+                }).encode(),
+            },
+        },
+        b"vulnerability": {
+            b"CVE-2023-0001": json.dumps(detail).encode(),
+        },
+    })
+    (tmp_path / "trivy.db").write_bytes(blob)
+    (tmp_path / "metadata.json").write_text('{"Version": 2}')
+    db = load_db(str(tmp_path))
+    assert type(db).__name__ == "BoltVulnDB"
+    [adv] = db.advisories("alpine 3.17", "musl")
+    assert adv.vulnerability_id == "CVE-2023-0001"
+    assert adv.fixed_version == "1.2.4-r1"
+    assert adv.severity == "HIGH"
+    assert adv.title == "musl: oob"
+    assert adv.severity_sources == {"nvd": "HIGH", "redhat": "MEDIUM"}
+    assert adv.cvss_score == 7.5
+    [ghsa] = db.advisories("pip::GitHub Security Advisory", "flask")
+    assert ghsa.fixed_version == "2.2.5"
+    assert ghsa.vulnerable_versions == "<2.2.5"
+    assert db.advisories("alpine 3.17", "zlib") == []
+    assert db.metadata() == {"Version": 2}
+
+
+def test_bbolt_db_end_to_end_rootfs_scan(tmp_path):
+    """A trivy-db-format bbolt file drives a full rootfs vuln scan via the
+    CLI (pkg/db/db.go consumption path)."""
+    import contextlib
+    import io
+
+    from trivy_tpu.cli import main
+
+    rootfs = tmp_path / "rootfs"
+    (rootfs / "etc").mkdir(parents=True)
+    (rootfs / "lib" / "apk" / "db").mkdir(parents=True)
+    (rootfs / "etc" / "os-release").write_text(
+        'ID=alpine\nVERSION_ID=3.17.2\n'
+    )
+    (rootfs / "lib" / "apk" / "db" / "installed").write_text(
+        "C:Q1abcdef\nP:musl\nV:1.2.3-r4\nA:x86_64\n\n"
+    )
+    dbdir = tmp_path / "db"
+    dbdir.mkdir()
+    (dbdir / "trivy.db").write_bytes(build_bolt({
+        b"alpine 3.17": {
+            b"musl": {b"CVE-2023-0001": b'{"FixedVersion": "1.2.3-r5"}'},
+        },
+        b"vulnerability": {
+            b"CVE-2023-0001": json.dumps(
+                {"Title": "musl oob", "Severity": 4}
+            ).encode(),
+        },
+    }))
+    (dbdir / "metadata.json").write_text('{"Version": 2}')
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main([
+            "rootfs", "--scanners", "vuln", "--db-dir", str(dbdir),
+            "--skip-db-update", "--format", "json", str(rootfs),
+        ])
+    assert rc == 0
+    r = json.loads(buf.getvalue())
+    vulns = [
+        (v["VulnerabilityID"], v["PkgName"], v["FixedVersion"], v["Severity"])
+        for res in r.get("Results", [])
+        for v in res.get("Vulnerabilities", [])
+    ]
+    assert ("CVE-2023-0001", "musl", "1.2.3-r5", "CRITICAL") in vulns
+
+
+def test_language_ecosystem_prefix_buckets(tmp_path):
+    """Detectors query by plain ecosystem name ('pip'); real trivy-db
+    language buckets are 'pip::<data source>' — the prefix scan must find
+    them and merge across multiple data sources."""
+    from trivy_tpu.db.vulndb import load_db
+
+    blob = build_bolt({
+        b"pip::GitHub Security Advisory Pip": {
+            b"flask": {b"GHSA-1": b'{"PatchedVersions": ["2.2.5"]}'},
+        },
+        b"pip::OSV": {
+            b"flask": {b"PYSEC-9": b'{"PatchedVersions": ["2.2.4"]}'},
+        },
+        b"pipx::other": {  # different ecosystem: must NOT match 'pip'
+            b"flask": {b"NOPE-1": b'{"PatchedVersions": ["9"]}'},
+        },
+        b"vulnerability": {},
+    })
+    (tmp_path / "trivy.db").write_bytes(blob)
+    db = load_db(str(tmp_path))
+    ids = {a.vulnerability_id for a in db.advisories("pip", "flask")}
+    assert ids == {"GHSA-1", "PYSEC-9"}
+
+
+def test_meta1_located_at_page_size(tmp_path):
+    """A torn meta 0 must not brick the file: meta 1 lives at pageSize and
+    is found by probing."""
+    data = bytearray(build_bolt({b"b": {b"k": b"v"}}))
+    data[16] ^= 0xFF  # corrupt meta 0's magic
+    db = Bolt(bytes(data))
+    assert db.bucket(b"b").get(b"k") == b"v"
+
+
+def test_stale_trivy_db_removed_on_download(tmp_path, monkeypatch):
+    """db/client.py download() drops a pre-existing trivy.db when the
+    fresh artifact ships JSON buckets only (load_db would otherwise keep
+    serving the stale bolt file)."""
+    import io
+    import tarfile
+
+    from trivy_tpu.db import client as client_mod
+
+    (tmp_path / "trivy.db").write_bytes(build_bolt({b"x": {b"k": b"v"}}))
+
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        data = b'{"alpine": {}}'
+        info = tarfile.TarInfo("alpine_3.17.json")
+        info.size = len(data)
+        tf.addfile(info, io.BytesIO(data))
+        meta = b'{"Version": 2}'
+        info = tarfile.TarInfo("metadata.json")
+        info.size = len(meta)
+        tf.addfile(info, io.BytesIO(meta))
+    buf.seek(0)
+
+    class _FakeArt:
+        def __init__(self, *a, **kw):
+            pass
+
+        def download_layer(self, media_type):
+            import contextlib
+
+            @contextlib.contextmanager
+            def cm():
+                yield buf
+
+            return cm()
+
+    import trivy_tpu.oci as oci_mod
+
+    monkeypatch.setattr(oci_mod, "OciArtifact", _FakeArt)
+    c = client_mod.DBClient(db_dir=str(tmp_path), repository="example/db")
+    c.download()
+    assert not (tmp_path / "trivy.db").exists()
+    assert (tmp_path / "alpine_3.17.json").exists()

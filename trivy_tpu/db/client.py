@@ -146,14 +146,24 @@ class DBClient:
             pass
         os.makedirs(self.db_dir, exist_ok=True)
         art = OciArtifact(self.repository, insecure=self.insecure)
+        extracted: set[str] = set()
         with art.download_layer(MEDIA_TYPE) as blob:
             with tarfile.open(fileobj=blob, mode="r:*") as tf:
                 for member in tf.getmembers():
                     if not member.isfile() or ".." in member.name:
                         continue
                     name = os.path.basename(member.name)
+                    extracted.add(name)
                     with open(os.path.join(self.db_dir, name), "wb") as out:
                         out.write(tf.extractfile(member).read())
+        # A pre-existing trivy.db takes priority in load_db; if this
+        # artifact did not ship one, drop the stale copy so the fresh
+        # bucket files are what scans actually read.
+        if "trivy.db" not in extracted:
+            try:
+                os.unlink(os.path.join(self.db_dir, "trivy.db"))
+            except OSError:
+                pass
         meta = self.metadata() or Metadata(version=SCHEMA_VERSION)
         meta.downloaded_at = (
             self._now().isoformat().replace("+00:00", "Z")

@@ -98,11 +98,15 @@ class VulnDB:
         return bucket
 
     def metadata(self) -> dict[str, Any]:
-        path = os.path.join(self.db_dir, "metadata.json")
-        if os.path.exists(path):
-            with open(path, encoding="utf-8") as f:
-                return json.load(f)
-        return {}
+        return _read_metadata(self.db_dir)
+
+
+def _read_metadata(db_dir: str) -> dict[str, Any]:
+    path = os.path.join(db_dir, "metadata.json")
+    if os.path.exists(path):
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    return {}
 
 
 def build_db(
@@ -125,7 +129,120 @@ def build_db(
         json.dump(meta, f)
 
 
-def load_db(db_dir: str) -> VulnDB | None:
-    if db_dir and os.path.isdir(db_dir):
-        return VulnDB(db_dir)
-    return None
+_SEVERITY_ENUM = ["UNKNOWN", "LOW", "MEDIUM", "HIGH", "CRITICAL"]
+
+
+def _sev_str(v: Any) -> str:
+    """trivy-db serializes severities as int enums; tolerate strings."""
+    if isinstance(v, int) and 0 <= v < len(_SEVERITY_ENUM):
+        return _SEVERITY_ENUM[v]
+    if isinstance(v, str):
+        return v
+    return ""
+
+
+class BoltVulnDB:
+    """Get-side interface over a REAL trivy-db file (`trivy.db`, bbolt).
+
+    Bucket schema (trivy-db v2): <source bucket> -> <package> ->
+    {vulnID: advisory JSON}; root "vulnerability" -> {vulnID: detail JSON}
+    enriches severity/title/references.  Read through trivy_tpu.db.bolt —
+    the artifact the reference downloads drops in unchanged."""
+
+    def __init__(self, db_dir: str):
+        from trivy_tpu.db.bolt import Bolt
+
+        self.db_dir = db_dir
+        self._bolt = Bolt.open(os.path.join(db_dir, "trivy.db"))
+        self._details: dict[str, dict] = {}
+        self._vuln_bucket = self._bolt.bucket(b"vulnerability")
+        # Language buckets are "<ecosystem>::<data source name>"
+        # (trivy-db bucket.go); the detectors query by plain ecosystem, so
+        # resolve each source to every matching bucket once.
+        self._source_buckets: dict[str, list[bytes]] = {}
+        self._top_names: list[bytes] | None = None
+
+    def _buckets_for(self, source: str) -> list[bytes]:
+        hit = self._source_buckets.get(source)
+        if hit is not None:
+            return hit
+        if self._top_names is None:
+            self._top_names = [name for name, _b in self._bolt.buckets()]
+        want = source.encode()
+        prefix = want + b"::"
+        names = [
+            n for n in self._top_names if n == want or n.startswith(prefix)
+        ]
+        self._source_buckets[source] = names
+        return names
+
+    def _detail(self, vuln_id: str) -> dict:
+        if vuln_id in self._details:
+            return self._details[vuln_id]
+        out: dict = {}
+        if self._vuln_bucket is not None:
+            raw = self._vuln_bucket.get(vuln_id.encode())
+            if raw:
+                try:
+                    out = json.loads(raw)
+                except ValueError:
+                    out = {}
+        self._details[vuln_id] = out
+        return out
+
+    def advisories(self, source: str, pkg_name: str) -> list[Advisory]:
+        out: list[Advisory] = []
+        for bname in self._buckets_for(source):
+            bucket = self._bolt.bucket(bname, pkg_name.encode())
+            if bucket is not None:
+                self._collect(bucket, out)
+        return out
+
+    def _collect(self, bucket, out: list[Advisory]) -> None:
+        for vid_b, raw in bucket.items():
+            vid = vid_b.decode("utf-8", "replace")
+            try:
+                d = json.loads(raw)
+            except ValueError:
+                continue
+            det = self._detail(vid)
+            fixed = d.get("FixedVersion", "")
+            patched = d.get("PatchedVersions") or []
+            if not fixed and patched:
+                fixed = ", ".join(patched)
+            vulnerable = " || ".join(d.get("VulnerableVersions") or [])
+            cvss = 0.0
+            for src in ("nvd", "redhat", "ghsa"):
+                sc = (det.get("CVSS") or {}).get(src) or {}
+                if sc.get("V3Score"):
+                    cvss = float(sc["V3Score"])
+                    break
+            out.append(
+                Advisory(
+                    vulnerability_id=vid,
+                    fixed_version=fixed,
+                    vulnerable_versions=vulnerable,
+                    severity=_sev_str(
+                        d.get("Severity", det.get("Severity", 0))
+                    ),
+                    title=det.get("Title", ""),
+                    description=det.get("Description", ""),
+                    references=list(det.get("References") or []),
+                    cvss_score=cvss,
+                    severity_sources={
+                        k: _sev_str(v)
+                        for k, v in (det.get("VendorSeverity") or {}).items()
+                    },
+                )
+            )
+
+    def metadata(self) -> dict[str, Any]:
+        return _read_metadata(self.db_dir)
+
+
+def load_db(db_dir: str) -> "VulnDB | BoltVulnDB | None":
+    if not db_dir or not os.path.isdir(db_dir):
+        return None
+    if os.path.exists(os.path.join(db_dir, "trivy.db")):
+        return BoltVulnDB(db_dir)
+    return VulnDB(db_dir)
