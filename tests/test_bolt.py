@@ -229,3 +229,36 @@ def test_stale_trivy_db_removed_on_download(tmp_path, monkeypatch):
     c.download()
     assert not (tmp_path / "trivy.db").exists()
     assert (tmp_path / "alpine_3.17.json").exists()
+
+
+def test_os_bucket_aliases_real_trivy_db_names(tmp_path):
+    """Internal 'redhat 8' / 'amazon 2' / 'cbl-mariner 2' sources find the
+    real trivy-db bucket names (review r3: exact-match found nothing)."""
+    from trivy_tpu.db.vulndb import load_db
+
+    adv = b'{"FixedVersion": "1-2"}'
+    blob = build_bolt({
+        b"Red Hat Enterprise Linux 8": {b"openssl": {b"CVE-R": adv}},
+        b"amazon linux 2": {b"curl": {b"CVE-A": adv}},
+        b"Oracle Linux 8": {b"bash": {b"CVE-O": adv}},
+        b"Photon OS 3.0": {b"glibc": {b"CVE-P": adv}},
+        b"CBL-Mariner 2.0": {b"zlib": {b"CVE-M": adv}},
+        b"vulnerability": {},
+    })
+    (tmp_path / "trivy.db").write_bytes(blob)
+    db = load_db(str(tmp_path))
+    assert [a.vulnerability_id for a in db.advisories("redhat 8", "openssl")] == ["CVE-R"]
+    assert [a.vulnerability_id for a in db.advisories("amazon 2", "curl")] == ["CVE-A"]
+    assert [a.vulnerability_id for a in db.advisories("oracle 8", "bash")] == ["CVE-O"]
+    assert [a.vulnerability_id for a in db.advisories("photon 3", "glibc")] == ["CVE-P"]
+    assert [a.vulnerability_id for a in db.advisories("cbl-mariner 2", "zlib")] == ["CVE-M"]
+    # no cross-talk
+    assert db.advisories("redhat 9", "openssl") == []
+
+
+def test_corrupt_trivy_db_degrades_with_fallback(tmp_path, caplog):
+    from trivy_tpu.db.vulndb import load_db
+
+    (tmp_path / "trivy.db").write_bytes(b"\x00" * 16384)
+    db = load_db(str(tmp_path))
+    assert type(db).__name__ == "VulnDB"  # JSON fallback, not a crash

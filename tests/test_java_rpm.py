@@ -339,3 +339,111 @@ def test_e2e_rhel_rootfs_and_java_app(tmp_path):
     assert ("rhel", "CVE-2023-0286") in {(t, i) for t, i in found} or (
         "redhat", "CVE-2023-0286") in found, found
     assert any(i == "CVE-2021-44228" for _t, i in found), found
+
+
+def test_sqlite_javadb_real_format(tmp_path):
+    """r3: a real trivy-java-db SQLite file (indices table, BLOB sha1)
+    serves sha1 -> GAV lookups and most-frequent-group artifactId search
+    (pkg/javadb/client.go:135,149)."""
+    import sqlite3
+
+    from trivy_tpu.javadb import SqliteJavaDB, set_default_javadb_dir, open_default_javadb
+
+    path = tmp_path / "trivy-java.db"
+    conn = sqlite3.connect(str(path))
+    conn.execute(
+        "CREATE TABLE indices(group_id TEXT, artifact_id TEXT, "
+        "version TEXT, sha1 BLOB, archive_type TEXT)"
+    )
+    sha = "aa" * 20
+    conn.execute(
+        "INSERT INTO indices VALUES (?, ?, ?, ?, ?)",
+        ("org.apache.logging.log4j", "log4j-core", "2.14.1",
+         bytes.fromhex(sha), "jar"),
+    )
+    for gid in ("javax.servlet", "jstl", "jstl"):
+        conn.execute(
+            "INSERT INTO indices VALUES (?, ?, ?, ?, ?)",
+            (gid, "jstl", "1.2", b"\x01" * 20, "jar"),
+        )
+    conn.commit()
+    conn.close()
+
+    db = SqliteJavaDB(str(tmp_path))
+    assert db.lookup(sha) == (
+        "org.apache.logging.log4j", "log4j-core", "2.14.1"
+    )
+    assert db.lookup("bb" * 20) is None
+    assert db.lookup("nothex!") is None
+    assert db.search_by_artifact_id("jstl", "1.2") == "jstl"
+    assert db.search_by_artifact_id("absent", "1") is None
+
+    set_default_javadb_dir(str(tmp_path))
+    try:
+        assert type(open_default_javadb()).__name__ == "SqliteJavaDB"
+    finally:
+        set_default_javadb_dir("")
+
+
+def test_jar_filename_groupid_recovery_via_sqlite_javadb(tmp_path):
+    """A bare artifact-version.jar with no digest hit recovers its groupId
+    through SearchByArtifactID (client.go:149)."""
+    import io
+    import sqlite3
+    import zipfile
+
+    from trivy_tpu.analyzer.java import parse_jar
+    from trivy_tpu.javadb import SqliteJavaDB
+
+    conn = sqlite3.connect(str(tmp_path / "trivy-java.db"))
+    conn.execute(
+        "CREATE TABLE indices(group_id TEXT, artifact_id TEXT, "
+        "version TEXT, sha1 BLOB, archive_type TEXT)"
+    )
+    conn.execute(
+        "INSERT INTO indices VALUES (?, ?, ?, ?, ?)",
+        ("com.acme", "widget", "1.4", b"\x02" * 20, "jar"),
+    )
+    conn.commit()
+    conn.close()
+
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        zf.writestr("com/acme/W.class", b"\xca\xfe\xba\xbe")
+    db = SqliteJavaDB(str(tmp_path))
+    pkgs = parse_jar(buf.getvalue(), "libs/widget-1.4.jar", db)
+    assert [(p.name, p.version) for p in pkgs] == [("com.acme:widget", "1.4")]
+
+
+def test_javadb_shard_refresh_drops_stale_sqlite(tmp_path, monkeypatch):
+    import io
+    import tarfile
+
+    import trivy_tpu.javadb as jdb
+    import trivy_tpu.oci as oci_mod
+
+    (tmp_path / "trivy-java.db").write_bytes(b"stale")
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        data = b"{}"
+        info = tarfile.TarInfo("java-aa.json")
+        info.size = len(data)
+        tf.addfile(info, io.BytesIO(data))
+    buf.seek(0)
+
+    class _FakeArt:
+        def __init__(self, *a, **kw):
+            pass
+
+        def download_layer(self, media_type):
+            import contextlib
+
+            @contextlib.contextmanager
+            def cm():
+                yield buf
+
+            return cm()
+
+    monkeypatch.setattr(oci_mod, "OciArtifact", _FakeArt)
+    jdb.download_javadb(str(tmp_path))
+    assert not (tmp_path / "trivy-java.db").exists()

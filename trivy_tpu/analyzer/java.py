@@ -98,6 +98,14 @@ def parse_jar(
         else:
             pkg = _from_manifest_or_name(manifest, file_path)
             if pkg is not None:
+                # SearchByArtifactID fallback (client.go:149): a DB that
+                # indexes by artifactId (the SQLite trivy-java-db) can
+                # recover the groupId for a bare artifact-version name.
+                search = getattr(javadb, "search_by_artifact_id", None)
+                if search is not None and ":" not in pkg.name:
+                    gid = search(pkg.name, pkg.version)
+                    if gid:
+                        pkg.name = f"{gid}:{pkg.name}"
                 out.append(pkg)
     return out
 

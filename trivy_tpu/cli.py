@@ -190,6 +190,11 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
         "--token", default=_env_default("token", ""),
         help="server auth token",
     )
+    p.add_argument(
+        "--server-wire", default=_env_default("server-wire", "json"),
+        choices=["json", "protobuf"],
+        help="Twirp wire format for client mode",
+    )
     p.add_argument("--db-dir", default=_env_default("db-dir", ""),
                    help="vulnerability DB directory")
     p.add_argument(
@@ -289,6 +294,7 @@ def _options_from_args(args: argparse.Namespace) -> Options:
         secret_backend=args.secret_backend,
         ignore_file=args.ignorefile if os.path.exists(args.ignorefile) else "",
         server_addr=args.server,
+        server_wire=getattr(args, "server_wire", "json"),
         token=args.token,
         db_dir=args.db_dir,
         list_all_packages=args.list_all_pkgs,

@@ -55,6 +55,7 @@ class Options:
     ignore_file: str = ""
     disabled_analyzers: list[str] = field(default_factory=list)
     server_addr: str = ""  # non-empty => client mode (remote driver)
+    server_wire: str = "json"  # Twirp wire format: json | protobuf
     token: str = ""
     db_dir: str = ""  # vulnerability DB directory (trivy-db analogue)
     list_all_packages: bool = False
@@ -84,7 +85,7 @@ def init_cache(options: Options) -> ArtifactCache:
         # cache; the server owns the applier and detectors.
         from trivy_tpu.rpc.client import RemoteCache
 
-        return RemoteCache(options.server_addr, options.token)
+        return RemoteCache(options.server_addr, options.token, wire=options.server_wire)
     backend = options.cache_backend
     if backend.startswith(("redis://", "rediss://")):
         from trivy_tpu.cache.redis import RedisCache
@@ -241,7 +242,7 @@ def _build_scanner(options: Options, target_kind: str, cache: ArtifactCache) -> 
     if options.server_addr:
         from trivy_tpu.rpc.client import RemoteDriver
 
-        driver = RemoteDriver(options.server_addr, options.token)
+        driver = RemoteDriver(options.server_addr, options.token, wire=options.server_wire)
     else:
         driver = LocalDriver(cache, vuln_detector=_init_vuln_scanner(options))
     return Scanner(artifact=artifact, driver=driver)

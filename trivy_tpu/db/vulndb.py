@@ -129,16 +129,32 @@ def build_db(
         json.dump(meta, f)
 
 
-_SEVERITY_ENUM = ["UNKNOWN", "LOW", "MEDIUM", "HIGH", "CRITICAL"]
-
-
 def _sev_str(v: Any) -> str:
     """trivy-db serializes severities as int enums; tolerate strings."""
-    if isinstance(v, int) and 0 <= v < len(_SEVERITY_ENUM):
-        return _SEVERITY_ENUM[v]
+    from trivy_tpu.result.filter import SEVERITIES
+
+    if isinstance(v, int) and 0 <= v < len(SEVERITIES):
+        return SEVERITIES[v]
     if isinstance(v, str):
         return v
     return ""
+
+
+# Internal detector source prefix -> real trivy-db OS bucket template.
+# The detectors build "redhat 8"-style sources (detector/ospkg.py); real
+# trivy-db names several OS buckets differently.  Candidates are matched
+# case-insensitively, with a ".0"-tolerant prefix (mariner "2" vs
+# "CBL-Mariner 2.0").
+_OS_BUCKET_ALIASES = {
+    "redhat": "Red Hat Enterprise Linux {v}",
+    "centos": "CentOS {v}",
+    "amazon": "amazon linux {v}",
+    "oracle": "Oracle Linux {v}",
+    "photon": "Photon OS {v}",
+    "cbl-mariner": "CBL-Mariner {v}",
+    "suse": "SUSE Linux Enterprise {v}",
+    "opensuse-leap": "openSUSE Leap {v}",
+}
 
 
 class BoltVulnDB:
@@ -173,6 +189,25 @@ class BoltVulnDB:
         names = [
             n for n in self._top_names if n == want or n.startswith(prefix)
         ]
+        if not names:
+            # OS bucket alias pass (exact internal name matched nothing).
+            cands = {source.lower()}
+            word, _, ver = source.partition(" ")
+            tmpl = _OS_BUCKET_ALIASES.get(word)
+            if tmpl and ver:
+                cands.add(tmpl.format(v=ver).lower())
+            names = [
+                n
+                for n in self._top_names
+                if n.decode("utf-8", "replace").lower() in cands
+                or any(
+                    n.decode("utf-8", "replace").lower() == f"{c}.0"
+                    or n.decode("utf-8", "replace").lower().startswith(
+                        f"{c}."
+                    )
+                    for c in cands
+                )
+            ]
         self._source_buckets[source] = names
         return names
 
@@ -244,5 +279,18 @@ def load_db(db_dir: str) -> "VulnDB | BoltVulnDB | None":
     if not db_dir or not os.path.isdir(db_dir):
         return None
     if os.path.exists(os.path.join(db_dir, "trivy.db")):
-        return BoltVulnDB(db_dir)
+        from trivy_tpu.db.bolt import BoltError
+
+        try:
+            return BoltVulnDB(db_dir)
+        except (BoltError, OSError) as e:
+            # A torn download must degrade with a pointer, not kill every
+            # scan with a traceback.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "trivy.db unreadable (%s); falling back to JSON buckets — "
+                "re-download with --db-repository to repair",
+                e,
+            )
     return VulnDB(db_dir)

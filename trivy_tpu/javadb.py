@@ -24,11 +24,73 @@ def set_default_javadb_dir(path: str) -> None:
     _default_dir = path
 
 
-def open_default_javadb() -> "JavaDB | None":
+def open_default_javadb() -> "JavaDB | SqliteJavaDB | None":
     d = _default_dir or os.environ.get("TRIVY_TPU_JAVA_DB_DIR", "")
     if d and os.path.isdir(d):
+        if os.path.exists(os.path.join(d, "trivy-java.db")):
+            return SqliteJavaDB(d)
         return JavaDB(d)
     return None
+
+
+class SqliteJavaDB:
+    """Get side over a REAL trivy-java-db file (`trivy-java.db`, SQLite —
+    the artifact pkg/javadb/client.go downloads; schema: table
+    indices(group_id, artifact_id, version, sha1 BLOB, archive_type)).
+    Read with the stdlib sqlite3 module in read-only mode."""
+
+    def __init__(self, db_dir: str):
+        import sqlite3
+
+        self.db_dir = db_dir
+        path = os.path.join(db_dir, "trivy-java.db")
+        self._conn = sqlite3.connect(
+            f"file:{path}?mode=ro&immutable=1", uri=True
+        )
+
+    def lookup(self, sha1: str) -> tuple[str, str, str] | None:
+        """SearchBySHA1 (client.go:135): digest -> (g, a, v).  sha1 is
+        stored as a BLOB of raw bytes."""
+        try:
+            blob = bytes.fromhex(sha1)
+        except ValueError:
+            return None
+        cur = self._conn.execute(
+            "SELECT group_id, artifact_id, version FROM indices "
+            "WHERE sha1 = ?",
+            (blob,),
+        )
+        row = cur.fetchone()
+        if row is None:
+            # Some builds store the hex string instead of raw bytes.
+            row = self._conn.execute(
+                "SELECT group_id, artifact_id, version FROM indices "
+                "WHERE sha1 = ?",
+                (sha1,),
+            ).fetchone()
+        if row is None:
+            return None
+        return str(row[0]), str(row[1]), str(row[2])
+
+    def search_by_artifact_id(
+        self, artifact_id: str, version: str
+    ) -> str | None:
+        """SearchByArtifactID (client.go:149): the most frequent group_id
+        among jar-type indices for this artifactId (ties: smallest)."""
+        rows = self._conn.execute(
+            "SELECT group_id FROM indices "
+            "WHERE artifact_id = ? AND version = ? AND archive_type = 'jar' "
+            "ORDER BY group_id",
+            (artifact_id, version),
+        ).fetchall()
+        if not rows:
+            return None
+        counts: dict[str, int] = {}
+        for (gid,) in rows:
+            counts[gid] = counts.get(gid, 0) + 1
+        # Most frequent group wins; the reference leaves ties to Go map
+        # order — resolve deterministically to the smallest group id.
+        return sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))[0][0]
 
 
 class JavaDB:
@@ -83,14 +145,23 @@ def download_javadb(
 
     os.makedirs(db_dir, exist_ok=True)
     art = OciArtifact(repository, insecure=insecure)
+    extracted: set[str] = set()
     with art.download_layer(JAVA_DB_MEDIA_TYPE) as blob:
         with tarfile.open(fileobj=blob, mode="r:*") as tf:
             for member in tf.getmembers():
                 if not member.isfile() or ".." in member.name:
                     continue
                 name = os.path.basename(member.name)
+                extracted.add(name)
                 with open(os.path.join(db_dir, name), "wb") as out:
                     out.write(tf.extractfile(member).read())
+    # open_default_javadb prefers trivy-java.db; a shard-only refresh must
+    # not leave a stale SQLite index shadowing it (db/client.py contract).
+    if "trivy-java.db" not in extracted:
+        try:
+            os.unlink(os.path.join(db_dir, "trivy-java.db"))
+        except OSError:
+            pass
     stamp = datetime.datetime.now(datetime.timezone.utc).isoformat()
     with open(os.path.join(db_dir, "metadata.json"), "w", encoding="utf-8") as f:
         json.dump({"DownloadedAt": stamp}, f)

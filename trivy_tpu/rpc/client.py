@@ -33,6 +33,10 @@ class RpcClient:
     addr: str  # host:port
     token: str = ""
     headers: dict[str, str] = field(default_factory=dict)
+    # "json" (default) or "protobuf": the two Twirp wire formats.  The
+    # protobuf wire is byte-compatible with the reference's Go client
+    # (rpc/{scanner,cache}/service.proto field numbers).
+    wire: str = "json"
 
     def call(self, path: str, payload: dict) -> dict:
         # Accept both bare "host:port" and full "http(s)://host:port" forms
@@ -41,11 +45,20 @@ class RpcClient:
         if not base.startswith(("http://", "https://")):
             base = f"http://{base}"
         url = f"{base}{path}"
-        body = json.dumps(payload).encode()
+        if self.wire == "protobuf":
+            from trivy_tpu.rpc import protowire
+
+            if not protowire.available():
+                raise RpcError("protobuf wire unavailable (no protoc/runtime)")
+            body = protowire.encode_request(path, payload)
+            ctype = "application/protobuf"
+        else:
+            body = json.dumps(payload).encode()
+            ctype = "application/json"
         last: Exception | None = None
         for attempt in range(MAX_RETRIES):
             req = urllib.request.Request(
-                url, data=body, headers={"Content-Type": "application/json"}
+                url, data=body, headers={"Content-Type": ctype}
             )
             if self.token:
                 req.add_header(TOKEN_HEADER, self.token)
@@ -53,7 +66,12 @@ class RpcClient:
                 req.add_header(k, v)
             try:
                 with urllib.request.urlopen(req, timeout=300) as resp:
-                    return json.loads(resp.read())
+                    raw = resp.read()
+                    if self.wire == "protobuf":
+                        from trivy_tpu.rpc import protowire
+
+                        return protowire.decode_response(path, raw)
+                    return json.loads(raw)
             except urllib.error.HTTPError as e:
                 if 400 <= e.code < 500:  # deterministic; non-retryable
                     raise RpcError(f"{path}: HTTP {e.code}: {e.read()!r}") from e
@@ -70,9 +88,10 @@ class RemoteDriver(Driver):
 
     addr: str
     token: str = ""
+    wire: str = "json"  # or "protobuf" (reference Go client wire)
 
     def scan(self, target, artifact_id, blob_ids, options: ScanOptions):
-        client = RpcClient(self.addr, self.token)
+        client = RpcClient(self.addr, self.token, wire=self.wire)
         resp = client.call(
             "/twirp/trivy.scanner.v1.Scanner/Scan",
             {
@@ -94,8 +113,8 @@ class RemoteCache(ArtifactCache):
     """pkg/cache/remote.go: Put side goes to the server; Get side is absent on
     the client (the server owns the applier), mirroring NopCache-wrapping."""
 
-    def __init__(self, addr: str, token: str = ""):
-        self.client = RpcClient(addr, token)
+    def __init__(self, addr: str, token: str = "", wire: str = "json"):
+        self.client = RpcClient(addr, token, wire=wire)
 
     def put_artifact(self, artifact_id: str, info: ArtifactInfo) -> None:
         self.client.call(

@@ -1,0 +1,82 @@
+"""protoc-backed generation of the Twirp wire bindings.
+
+The .proto sources in rpc/proto/ are the wire contract (see their header
+notes); this module compiles them once per source-hash into the user cache
+dir with the system protoc and imports the generated modules.  Absent
+protoc or the google.protobuf runtime, load() returns None and the RPC
+layer stays JSON-only (the Twirp spec's other wire format).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import sys
+import threading
+
+_PROTO_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "proto")
+_SOURCES = ["common.proto", "scanner.proto", "cache.proto"]
+
+_lock = threading.Lock()
+_mods: dict | None = None
+_failed = False
+
+
+def _cache_dir(h: str) -> str:
+    return os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+        "trivy_tpu",
+        "protogen",
+        h,
+    )
+
+
+def load() -> dict | None:
+    """{"common": common_pb2, "scanner": scanner_pb2, "cache": cache_pb2}
+    or None when bindings cannot be built in this environment."""
+    global _mods, _failed
+    if _mods is not None or _failed:
+        return _mods
+    with _lock:
+        if _mods is not None or _failed:
+            return _mods
+        try:
+            import google.protobuf  # noqa: F401
+        except ImportError:
+            _failed = True
+            return None
+        h = hashlib.sha256()
+        for s in _SOURCES:
+            with open(os.path.join(_PROTO_DIR, s), "rb") as f:
+                h.update(f.read())
+        out = _cache_dir(h.hexdigest()[:16])
+        marker = os.path.join(out, "common_pb2.py")
+        if not os.path.exists(marker):
+            os.makedirs(out, exist_ok=True)
+            try:
+                subprocess.run(
+                    ["protoc", f"-I{_PROTO_DIR}", f"--python_out={out}"]
+                    + _SOURCES,
+                    check=True,
+                    capture_output=True,
+                    timeout=60,
+                )
+            except (OSError, subprocess.SubprocessError):
+                _failed = True
+                return None
+        if out not in sys.path:
+            sys.path.insert(0, out)
+        try:
+            import cache_pb2
+            import common_pb2
+            import scanner_pb2
+        except Exception:
+            _failed = True
+            return None
+        _mods = {
+            "common": common_pb2,
+            "scanner": scanner_pb2,
+            "cache": cache_pb2,
+        }
+        return _mods

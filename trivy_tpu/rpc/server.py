@@ -207,12 +207,42 @@ def _make_handler(server: ScanServer):
             if method is None:
                 send(404, {"error": f"no such rpc: {self.path}"})
                 return
+            # Twirp wire negotiation: protobuf requests get protobuf
+            # responses (the reference Go client's default); everything
+            # else stays JSON.  Twirp errors are JSON in both modes.
+            ctype = self.headers.get("Content-Type", "")
+            proto_mode = ctype.split(";")[0].strip() in (
+                "application/protobuf", "application/x-protobuf",
+            )
             try:
+                if proto_mode:
+                    from trivy_tpu.rpc import protowire
+
+                    if not protowire.available():
+                        send(415, {"error": "protobuf wire unavailable"})
+                        return
+                    req = protowire.decode_request(method, raw)
+                    out = getattr(server, method)(req)
+                    data = protowire.encode_response(method, out)
+                    server.metrics.observe(
+                        method, 200, _time.monotonic() - start
+                    )
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/protobuf")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
                 req = json.loads(raw or b"{}")
                 send(200, getattr(server, method)(req))
             except BlobNotFoundError as e:
                 send(422, {"error": str(e)})  # deterministic; don't retry
             except (KeyError, json.JSONDecodeError) as e:
+                send(400, {"error": f"bad request: {e}"})
+            except ValueError as e:
+                # protobuf DecodeError subclasses ValueError: a malformed
+                # body is the client's fault (Twirp: malformed = 400, not
+                # a retryable 5xx).
                 send(400, {"error": f"bad request: {e}"})
             except Exception as e:  # one bad request must not kill the server
                 send(500, {"error": str(e)})
