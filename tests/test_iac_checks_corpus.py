@@ -200,6 +200,31 @@ TF_CASES = [
         'resource "azurerm_storage_account" "sa" {\n  allow_nested_items_to_be_public = false\n}\n',
     ),
     (
+        "AVD-AWS-0016",
+        'resource "aws_cloudtrail" "t" {\n  name = "x"\n  is_multi_region_trail = true\n}\n',
+        'resource "aws_cloudtrail" "t" {\n  is_multi_region_trail = true\n  enable_log_file_validation = true\n}\n',
+    ),
+    (
+        "AVD-AWS-0015",
+        'resource "aws_cloudtrail" "t" {\n  name = "x"\n}\n',
+        'resource "aws_cloudtrail" "t" {\n  kms_key_id = "key"\n}\n',
+    ),
+    (
+        "AVD-AWS-0052",
+        'resource "aws_lb" "l" {\n  name = "x"\n}\n',
+        'resource "aws_lb" "l" {\n  drop_invalid_header_fields = true\n}\n',
+    ),
+    (
+        "AVD-AWS-0053",
+        'resource "aws_lb" "l" {\n  name = "x"\n}\n',
+        'resource "aws_lb" "l" {\n  internal = true\n}\n',
+    ),
+    (
+        "AVD-AWS-0054",
+        'resource "aws_lb_listener" "l" {\n  protocol = "HTTP"\n}\n',
+        'resource "aws_lb_listener" "l" {\n  protocol = "HTTP"\n  default_action {\n    type = "redirect"\n    redirect {\n      protocol = "HTTPS"\n    }\n  }\n}\n',
+    ),
+    (
         "AVD-GCP-0007",
         'resource "google_project_iam_binding" "b" {\n  role = "roles/editor"\n  members = ["serviceAccount:ci@x.iam.gserviceaccount.com"]\n}\n',
         'resource "google_project_iam_binding" "b" {\n  role = "roles/editor"\n  members = ["user:dev@example.com"]\n}\n',
@@ -394,7 +419,7 @@ def test_kubernetes_checks(scanner, check_id, bad, good):
 
 def test_corpus_size_and_unique_ids_per_type():
     checks = load_checks()
-    assert len(checks) >= 108
+    assert len(checks) >= 113
     seen = set()
     for c in checks:
         key = (c.input_type, c.check_id)

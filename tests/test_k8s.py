@@ -71,6 +71,29 @@ class _FakeAPI(BaseHTTPRequestHandler):
             self.send_response(401)
             self.end_headers()
             return
+        if self.path == "/version":
+            body = json.dumps({"gitVersion": "v1.28.4"}).encode()
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if self.path == "/api/v1/nodes":
+            body = json.dumps({"items": [{
+                "metadata": {"name": "node-1", "labels": {
+                    "node-role.kubernetes.io/control-plane": ""}},
+                "status": {"nodeInfo": {
+                    "architecture": "amd64",
+                    "kernelVersion": "6.1.0",
+                    "osImage": "Ubuntu 22.04.3 LTS",
+                    "operatingSystem": "linux",
+                    "kubeletVersion": "v1.28.4",
+                    "containerRuntimeVersion": "containerd://1.7.2",
+                }},
+            }]}).encode()
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(body)
+            return
         items: list = []
         if self.path == "/api/v1/pods":
             items = [OWNED_POD, STANDALONE_POD]
@@ -102,14 +125,14 @@ def api_server():
     srv.shutdown()
 
 
-def _write_kubeconfig(tmp_path, server: str) -> str:
+def _write_kubeconfig(tmp_path, server: str, token: str = "") -> str:
     cfg = {
         "current-context": "test",
         "contexts": [
             {"name": "test", "context": {"cluster": "c1", "user": "u1"}}
         ],
         "clusters": [{"name": "c1", "cluster": {"server": server}}],
-        "users": [{"name": "u1", "user": {"token": _FakeAPI.token}}],
+        "users": [{"name": "u1", "user": {"token": token or _FakeAPI.token}}],
     }
     path = tmp_path / "kubeconfig"
     path.write_text(yaml.safe_dump(cfg))
@@ -196,3 +219,104 @@ def test_k8s_image_scan_failure_tolerated(tmp_path, api_server):
     dep = next(r for r in report.resources if r.kind == "Deployment")
     assert dep.error  # registry.example is unreachable
     assert any(res.misconfigurations for res in dep.results)  # misconf kept
+
+
+def test_kbom_cyclonedx(tmp_path, api_server):
+    """k8s --format cyclonedx emits the cluster bill of materials
+    (scanner.go clusterInfoToReportResources analogue): cluster root,
+    node + OS + kubelet + runtime components, workload images,
+    dependency wiring."""
+    import contextlib
+    import io
+
+    from trivy_tpu.cli import main
+
+    path = _write_kubeconfig(tmp_path, api_server)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main([
+            "k8s", "cluster", "--kubeconfig", path,
+            "--format", "cyclonedx",
+        ])
+    assert rc == 0
+    bom = json.loads(buf.getvalue())
+    assert bom["bomFormat"] == "CycloneDX" and bom["specVersion"] == "1.5"
+    root = bom["metadata"]["component"]
+    assert root["type"] == "platform" and root["version"] == "v1.28.4"
+    by_name = {c["name"]: c for c in bom["components"]}
+    assert by_name["node-1"]["type"] == "platform"
+    props = {p["name"]: p["value"] for p in by_name["node-1"]["properties"]}
+    assert props["trivy-tpu:resource:nodeRole"] == "master"
+    assert by_name["k8s.io/kubelet"]["version"] == "v1.28.4"
+    assert by_name["containerd"]["version"] == "1.7.2"
+    assert by_name["ubuntu"]["type"] == "operating-system"
+    assert by_name["ubuntu"]["version"] == "22.04.3 LTS"
+    # workload images present as container components with oci purls
+    containers = [c for c in bom["components"] if c["type"] == "container"]
+    assert containers and all(c["purl"].startswith("pkg:oci/") for c in containers)
+    # node depends on kubelet/runtime/os; root depends on node + images
+    deps = {d["ref"]: d["dependsOn"] for d in bom["dependencies"]}
+    node_ref = by_name["node-1"]["bom-ref"]
+    assert node_ref in deps[root["bom-ref"]]
+    assert by_name["k8s.io/kubelet"]["bom-ref"] in deps[node_ref]
+
+
+def test_kbom_multinode_dedups_shared_components(tmp_path, api_server, monkeypatch):
+    """r3 review: shared node software must appear once — CycloneDX
+    requires unique bom-refs."""
+    from trivy_tpu.k8s.client import KubeClient
+    from trivy_tpu.k8s.kbom import build_kbom
+
+    path = _write_kubeconfig(tmp_path, api_server)
+    from trivy_tpu.k8s.client import load_kubeconfig as _lk
+
+    auth = _lk(path)
+    kc = KubeClient(auth)
+    orig_get = kc.get
+
+    def fake_get(p):
+        doc = orig_get(p)
+        if p == "/api/v1/nodes":
+            import copy
+            second = copy.deepcopy(doc["items"][0])
+            second["metadata"]["name"] = "node-2"
+            second["metadata"]["labels"] = {}
+            doc["items"].append(second)
+        return doc
+
+    kc.get = fake_get
+    bom = build_kbom(kc, cluster_name="c")
+    refs = [c["bom-ref"] for c in bom["components"]]
+    assert len(refs) == len(set(refs)), refs
+    names = [c["name"] for c in bom["components"]]
+    assert names.count("k8s.io/kubelet") == 1
+    assert {"node-1", "node-2"} <= set(names)
+
+
+def test_kbom_auth_failure_is_loud(tmp_path, api_server):
+    """An expired token must not produce a healthy empty BOM (rc 0)."""
+    import contextlib
+    import io
+
+    from trivy_tpu.cli import main
+
+    path = _write_kubeconfig(tmp_path, api_server, token="wrong-token")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main([
+            "k8s", "cluster", "--kubeconfig", path,
+            "--format", "cyclonedx",
+        ])
+    assert rc == 2
+    assert not buf.getvalue().strip()
+
+
+def test_kbom_os_image_multiword():
+    from trivy_tpu.k8s.kbom import _split_os_image
+
+    assert _split_os_image("Red Hat Enterprise Linux 8.6") == (
+        "red hat enterprise linux", "8.6"
+    )
+    assert _split_os_image("Ubuntu 22.04.3 LTS") == ("ubuntu", "22.04.3 LTS")
+    assert _split_os_image("Amazon Linux 2") == ("amazon linux", "2")
+    assert _split_os_image("Bottlerocket") == ("bottlerocket", "")

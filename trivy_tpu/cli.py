@@ -386,6 +386,23 @@ def _k8s_command(args) -> int:
     try:
         auth = load_kubeconfig(args.kubeconfig, args.context)
         client = KubeClient(auth)
+        if args.format == "cyclonedx":
+            # KBOM mode (scanner.go:63-70): emit the cluster bill of
+            # materials instead of scan findings.
+            import json as _json
+
+            from trivy_tpu.k8s.kbom import build_kbom
+
+            ns = "" if args.k8s_target == "cluster" else args.k8s_target
+            doc = build_kbom(client, cluster_name=auth.server, namespace=ns)
+            if args.output:
+                with open(args.output, "w", encoding="utf-8") as f:
+                    _json.dump(doc, f, indent=2)
+                    f.write("\n")
+            else:
+                _json.dump(doc, sys.stdout, indent=2)
+                print()
+            return 0
         namespace = "" if args.k8s_target == "cluster" else args.k8s_target
         resources = client.list_workloads(namespace=namespace)
     except KubeConfigError as e:

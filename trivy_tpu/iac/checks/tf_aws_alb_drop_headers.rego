@@ -1,0 +1,15 @@
+# METADATA
+# title: Load balancer does not drop invalid headers
+# custom:
+#   id: AVD-AWS-0052
+#   severity: HIGH
+#   recommended_action: Set drop_invalid_header_fields true.
+package builtin.terraform.AWS0052
+
+deny[res] {
+    some type in ["aws_lb", "aws_alb"]
+    some name, lb in object.get(object.get(input, "resource", {}), type, {})
+    object.get(lb, "load_balancer_type", "application") == "application"
+    object.get(lb, "drop_invalid_header_fields", false) != true
+    res := result.new(sprintf("Load balancer %q does not drop invalid headers", [name]), lb)
+}

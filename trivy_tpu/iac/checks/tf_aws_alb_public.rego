@@ -1,0 +1,14 @@
+# METADATA
+# title: Load balancer is exposed publicly
+# custom:
+#   id: AVD-AWS-0053
+#   severity: HIGH
+#   recommended_action: Set internal = true unless public exposure is required.
+package builtin.terraform.AWS0053
+
+deny[res] {
+    some type in ["aws_lb", "aws_alb", "aws_elb"]
+    some name, lb in object.get(object.get(input, "resource", {}), type, {})
+    object.get(lb, "internal", false) != true
+    res := result.new(sprintf("Load balancer %q is exposed publicly", [name]), lb)
+}

@@ -1,19 +1,13 @@
 # METADATA
-# title: CloudTrail is not multi-region or lacks log file validation
+# title: CloudTrail is not a multi-region trail
 # custom:
 #   id: AVD-AWS-0014
 #   severity: MEDIUM
-#   recommended_action: Enable multi-region trails with log validation.
+#   recommended_action: Set is_multi_region_trail true.
 package builtin.terraform.AWS0014
 
 deny[res] {
     some name, t in object.get(object.get(input, "resource", {}), "aws_cloudtrail", {})
     object.get(t, "is_multi_region_trail", false) != true
     res := result.new(sprintf("CloudTrail %q is not a multi-region trail", [name]), t)
-}
-
-deny[res] {
-    some name, t in object.get(object.get(input, "resource", {}), "aws_cloudtrail", {})
-    object.get(t, "enable_log_file_validation", false) != true
-    res := result.new(sprintf("CloudTrail %q does not validate log files", [name]), t)
 }

@@ -1,0 +1,13 @@
+# METADATA
+# title: CloudTrail is not encrypted with a customer key
+# custom:
+#   id: AVD-AWS-0015
+#   severity: HIGH
+#   recommended_action: Set kms_key_id on the trail.
+package builtin.terraform.AWS0015
+
+deny[res] {
+    some name, t in object.get(object.get(input, "resource", {}), "aws_cloudtrail", {})
+    object.get(t, "kms_key_id", "") == ""
+    res := result.new(sprintf("CloudTrail %q is not encrypted with a customer managed key", [name]), t)
+}

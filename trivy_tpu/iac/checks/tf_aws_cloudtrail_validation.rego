@@ -1,0 +1,13 @@
+# METADATA
+# title: CloudTrail does not validate log files
+# custom:
+#   id: AVD-AWS-0016
+#   severity: HIGH
+#   recommended_action: Set enable_log_file_validation true.
+package builtin.terraform.AWS0016
+
+deny[res] {
+    some name, t in object.get(object.get(input, "resource", {}), "aws_cloudtrail", {})
+    object.get(t, "enable_log_file_validation", false) != true
+    res := result.new(sprintf("CloudTrail %q does not validate log files", [name]), t)
+}

@@ -1,0 +1,33 @@
+# METADATA
+# title: Load balancer listener uses plain HTTP
+# custom:
+#   id: AVD-AWS-0054
+#   severity: CRITICAL
+#   recommended_action: Use HTTPS or redirect HTTP to HTTPS.
+package builtin.terraform.AWS0054
+
+listeners[pair] {
+    some type in ["aws_lb_listener", "aws_alb_listener"]
+    some name, l in object.get(object.get(input, "resource", {}), type, {})
+    pair := {"name": name, "l": l}
+}
+
+redirects_to_https(l) {
+    da := object.get(l, "default_action", null)
+    is_object(da)
+    object.get(da, "type", "") == "redirect"
+    object.get(object.get(da, "redirect", {}), "protocol", "") == "HTTPS"
+}
+
+redirects_to_https(l) {
+    da := object.get(l, "default_action", [])[_]
+    object.get(da, "type", "") == "redirect"
+    object.get(object.get(da, "redirect", {}), "protocol", "") == "HTTPS"
+}
+
+deny[res] {
+    some pair in listeners
+    upper(object.get(pair.l, "protocol", "HTTP")) == "HTTP"
+    not redirects_to_https(pair.l)
+    res := result.new(sprintf("Listener %q uses plain HTTP", [pair.name]), pair.l)
+}
