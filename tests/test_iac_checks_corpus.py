@@ -217,7 +217,7 @@ TF_CASES = [
     (
         "AVD-AWS-0053",
         'resource "aws_lb" "l" {\n  name = "x"\n}\n',
-        'resource "aws_lb" "l" {\n  internal = true\n}\n',
+        'resource "aws_lb" "l" {\n  load_balancer_type = "gateway"\n}\n',
     ),
     (
         "AVD-AWS-0054",

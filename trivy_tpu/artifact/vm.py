@@ -49,9 +49,13 @@ class VMArtifact:
 
     def inspect(self) -> ArtifactReference:
         digest = self._image_digest()
+        # walker-version component: bump when partition/LV traversal
+        # changes what a scan can see (v2: LVM2 linear LV support) —
+        # cached empty results from older walkers must not stick.
         versions = (
             json.dumps(self.group.analyzer_versions(), sort_keys=True)
             + self.group.options.cache_key_extra
+            + "|vm-walker:2"
         )
         size = os.path.getsize(self.target)
         blob_ids: list[str] = []
@@ -83,20 +87,60 @@ class VMArtifact:
 
     def _inspect_partition(self, img, part) -> BlobInfo:
         if is_lvm(img, part.offset):
-            logger.warning(
-                "partition %d is an LVM physical volume; LVM is not "
-                "supported and the partition is skipped", part.index,
-            )
-            return BlobInfo()
+            # LVM physical volume: map its linear logical volumes and walk
+            # each ext filesystem found inside (vm.go:195 / go-lvm).
+            from trivy_tpu.vm.lvm import LVReader, LvmError, logical_volumes
+
+            try:
+                lvs = logical_volumes(img, part.offset)
+            except LvmError as e:
+                logger.warning(
+                    "partition %d: unreadable LVM metadata (%s); skipped",
+                    part.index, e,
+                )
+                return BlobInfo()
+            merged = BlobInfo()
+            scanned = 0
+            for lv in lvs:
+                view = LVReader(img, lv)
+                if not is_ext(view, 0):
+                    logger.info(
+                        "LV %s/%s holds no ext filesystem; skipped",
+                        lv.vg_name, lv.name,
+                    )
+                    continue
+                scanned += 1
+                merged = self._merge_blob(
+                    merged, self._inspect_ext(view, 0, f"LV {lv.name}")
+                )
+            if not scanned:
+                logger.warning(
+                    "partition %d: no readable linear LVs", part.index
+                )
+            return merged
         if not is_ext(img, part.offset):
             logger.info(
                 "partition %d holds no ext filesystem; skipped", part.index
             )
             return BlobInfo()
+        return self._inspect_ext(img, part.offset, f"partition {part.index}")
+
+    @staticmethod
+    def _merge_blob(into: BlobInfo, other: BlobInfo) -> BlobInfo:
+        into.os = into.os or other.os
+        into.package_infos.extend(other.package_infos)
+        into.applications.extend(other.applications)
+        into.secrets.extend(other.secrets)
+        into.licenses.extend(other.licenses)
+        into.misconfigurations.extend(other.misconfigurations)
+        into.custom_resources.extend(other.custom_resources)
+        return into
+
+    def _inspect_ext(self, img, offset: int, what: str) -> BlobInfo:
         try:
-            reader = Ext4Reader(img, part.offset)
+            reader = Ext4Reader(img, offset)
         except Ext4Error as e:
-            logger.warning("partition %d: %s", part.index, e)
+            logger.warning("%s: %s", what, e)
             return BlobInfo()
 
         def entries():

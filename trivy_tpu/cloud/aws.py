@@ -359,6 +359,7 @@ class AwsScanner:
                 "enable_log_file_validation": bool(
                     t.get("LogFileValidationEnabled")
                 ),
+                "kms_key_id": t.get("KmsKeyId", ""),
             }
         if not trails:
             # No audit logging at all must FAIL the trail checks, not

@@ -9,6 +9,7 @@ package builtin.terraform.AWS0053
 deny[res] {
     some type in ["aws_lb", "aws_alb", "aws_elb"]
     some name, lb in object.get(object.get(input, "resource", {}), type, {})
+    object.get(lb, "load_balancer_type", "application") != "gateway"
     object.get(lb, "internal", false) != true
     res := result.new(sprintf("Load balancer %q is exposed publicly", [name]), lb)
 }

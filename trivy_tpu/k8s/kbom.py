@@ -15,7 +15,7 @@ from __future__ import annotations
 import uuid
 from typing import Any
 
-from trivy_tpu.k8s.client import KubeClient, KubeConfigError
+from trivy_tpu.k8s.client import KubeClient
 from trivy_tpu.k8s.scanner import _images_of, _owned
 
 
@@ -44,11 +44,7 @@ def _image_purl(image: str) -> tuple[str, str, str]:
     if not name or "/" in tag:  # no tag present
         name, tag = base, ""
     repo = name.rsplit("/", 1)[-1]
-    qualifiers = []
-    if digest:
-        version = digest
-    else:
-        version = tag
+    version = digest or tag
     purl = f"pkg:oci/{repo}"
     if version:
         purl += f"@{version.replace(':', '%3A')}"
@@ -107,8 +103,10 @@ def build_kbom(
                 "kernelVersion": info.get("kernelVersion", ""),
                 "nodeRole": (
                     "master"
-                    if "node-role.kubernetes.io/control-plane"
-                    in (meta.get("labels") or {})
+                    if {
+                        "node-role.kubernetes.io/control-plane",
+                        "node-role.kubernetes.io/master",  # legacy kubeadm
+                    } & set(meta.get("labels") or {})
                     else "worker"
                 ),
                 "operatingSystem": info.get("operatingSystem", ""),
