@@ -213,11 +213,53 @@ std::vector<uint64_t> build_tri_screen(const uint32_t* masks,
     return tri_bits;
 }
 
+// Fold per-file buffers into one contiguous case-folded stream (with
+// >= 4 zero gap bytes between files) inside a reusable thread-local
+// scratch.  Replaces the caller-side pack copy: one write pass total.
+// Returns the stream length; *out_starts receives per-file offsets.
+const uint8_t* fold_files(const uint8_t** file_ptrs, const int64_t* lens,
+                          int32_t F, int64_t* out_starts, int64_t* out_n) {
+    static thread_local std::vector<uint8_t> folded;
+    int64_t total = 4;
+    for (int32_t f = 0; f < F; ++f) total += lens[f] + 4;
+    if ((int64_t)folded.size() < total) folded.resize(total);
+    uint8_t* dst = folded.data();
+    int64_t pos = 0;
+    for (int32_t f = 0; f < F; ++f) {
+        out_starts[f] = pos;
+        const uint8_t* src = file_ptrs[f];
+        const int64_t n = lens[f];
+        int64_t i = 0;
+#ifdef TRIVY_TPU_AVX512
+        const __m512i vA = _mm512_set1_epi8('A');
+        const __m512i v26 = _mm512_set1_epi8(26);
+        const __m512i v32 = _mm512_set1_epi8(32);
+        for (; i + 64 <= n; i += 64) {
+            const __m512i v = _mm512_loadu_si512(src + i);
+            const __mmask64 up =
+                _mm512_cmplt_epu8_mask(_mm512_sub_epi8(v, vA), v26);
+            _mm512_storeu_si512(dst + pos + i,
+                                _mm512_mask_add_epi8(v, up, v, v32));
+        }
+#endif
+        for (; i < n; ++i) {
+            uint8_t b = src[i];
+            dst[pos + i] = b + ((uint8_t)((uint8_t)(b - 'A') < 26) << 5);
+        }
+        pos += n;
+        memset(dst + pos, 0, 4);
+        pos += 4;
+    }
+    *out_n = pos;
+    return dst;
+}
+
 template <class OnGram, class OnFileClose>
 void scan_files_impl(const uint8_t* stream, int64_t n,
                      const int64_t* file_starts, int32_t F,
                      const uint32_t* masks, const uint32_t* vals, int32_t G,
-                     OnGram&& on_gram, OnFileClose&& on_close) {
+                     OnGram&& on_gram, OnFileClose&& on_close,
+                     bool prefolded = false) {
     if (n < 4 || G <= 0 || F <= 0) return;
     std::vector<MaskGroup> groups = build_groups(masks, vals, G);
     const MaskGroup* gp = groups.data();
@@ -279,11 +321,15 @@ void scan_files_impl(const uint8_t* stream, int64_t n,
     };
 
 #ifdef TRIVY_TPU_AVX512
-    // Reused scratch: a fresh buffer per call would pay ~n bytes of page
-    // faults (the sieve is called once per ~32MB chunk).
-    static thread_local std::vector<uint8_t> folded;
-    if ((int64_t)folded.size() < n) folded.resize(n);
-    {
+    // Fold pass (skipped when the caller already folded, e.g. via
+    // fold_files).  Reused scratch: a fresh buffer per call would pay ~n
+    // bytes of page faults (the sieve is called once per ~32MB chunk).
+    const uint8_t* fp;
+    if (prefolded) {
+        fp = stream;
+    } else {
+        static thread_local std::vector<uint8_t> folded;
+        if ((int64_t)folded.size() < n) folded.resize(n);
         const __m512i vA = _mm512_set1_epi8('A');
         const __m512i v26 = _mm512_set1_epi8(26);
         const __m512i v32 = _mm512_set1_epi8(32);
@@ -299,8 +345,8 @@ void scan_files_impl(const uint8_t* stream, int64_t n,
             uint8_t b = stream[i];
             folded[i] = b + ((uint8_t)((uint8_t)(b - 'A') < 26) << 5);
         }
+        fp = folded.data();
     }
-    const uint8_t* fp = folded.data();
     const __m512i vmul = _mm512_set1_epi32((int32_t)kHashMul);
     const __m512i vtri = _mm512_set1_epi32(0xFFFFFF);
     const __m512i v31 = _mm512_set1_epi32(31);
@@ -495,6 +541,75 @@ int64_t gram_sieve_scan(const uint8_t* stream, int64_t n,
     return found;
 }
 
+// Per-file-pointer form of gram_sieve_scan: folds straight from the
+// caller's file buffers (no packed-stream copy on the caller's side) and
+// writes the computed per-file start offsets to out_starts so the caller
+// can address the hint columns.  Same output contract as gram_sieve_scan.
+int64_t gram_sieve_scan_files(
+    const uint8_t** file_ptrs, const int64_t* lens, int32_t F,
+    const uint32_t* masks, const uint32_t* vals, int32_t G,
+    const int32_t* gram_window, int32_t W,
+    const int32_t* window_probe,
+    const int32_t* probe_n_windows, int32_t P,
+    const int32_t* gate_ptr, const int32_t* gate_probes,
+    const int32_t* rule_conj_ptr, const int32_t* conj_ptr,
+    const int32_t* conj_probes, int32_t R,
+    int64_t* out_starts, int32_t* out_pairs, int64_t cap) {
+    int64_t n = 0;
+    const uint8_t* stream = fold_files(file_ptrs, lens, F, out_starts, &n);
+
+    std::vector<uint8_t> win_hit(W, 0);
+    std::vector<uint8_t> probe_hit(P, 0);
+    std::vector<int32_t> cnt(P, 0);
+    bool any_hit = false;
+    int32_t first_hit = 0;
+    int64_t found = 0;
+
+    auto on_gram = [&](int32_t f, int32_t g, int64_t pos) {
+        win_hit[gram_window[g]] = 1;
+        if (!any_hit) {
+            any_hit = true;
+            first_hit = (int32_t)(pos - out_starts[f]);
+        }
+    };
+    auto on_close = [&](int32_t f, int64_t last_pass) {
+        if (!any_hit) return;
+        any_hit = false;
+        const int32_t last_hit = (int32_t)(last_pass - out_starts[f]);
+        memset(cnt.data(), 0, (size_t)P * 4);
+        for (int32_t w2 = 0; w2 < W; ++w2)
+            if (win_hit[w2]) ++cnt[window_probe[w2]];
+        memset(win_hit.data(), 0, (size_t)W);
+        for (int32_t p = 0; p < P; ++p)
+            probe_hit[p] = cnt[p] == probe_n_windows[p];
+        for (int32_t r = 0; r < R; ++r) {
+            bool ok = gate_ptr[r] == gate_ptr[r + 1];
+            for (int32_t k = gate_ptr[r]; !ok && k < gate_ptr[r + 1]; ++k)
+                ok = probe_hit[gate_probes[k]];
+            if (!ok) continue;
+            for (int32_t c = rule_conj_ptr[r];
+                 ok && c < rule_conj_ptr[r + 1]; ++c) {
+                bool chit = false;
+                for (int32_t k = conj_ptr[c]; !chit && k < conj_ptr[c + 1]; ++k)
+                    chit = probe_hit[conj_probes[k]];
+                ok = chit;
+            }
+            if (!ok) continue;
+            if (found < cap) {
+                out_pairs[found * 4] = f;
+                out_pairs[found * 4 + 1] = r;
+                out_pairs[found * 4 + 2] = first_hit;
+                out_pairs[found * 4 + 3] = last_hit;
+            }
+            ++found;
+        }
+    };
+
+    scan_files_impl(stream, n, out_starts, F, masks, vals, G, on_gram,
+                    on_close, /*prefolded=*/true);
+    return found;
+}
+
 namespace {
 
 // Fast-forward to the next byte that can leave the rule's start state.
@@ -532,13 +647,20 @@ inline const uint8_t* skip_to_start(const uint8_t* p, const uint8_t* end,
 
 }  // namespace
 
+}  // extern "C"
+
+namespace {
+
 // Automaton verification of candidate (file, rule) pairs (engine/redfa.py).
 // mode[r]: 0 = no automaton (stay verified=1, oracle confirms), 1 = search
 // DFA (one class lookup + one transition lookup per byte), 2 = bit-parallel
 // NFA-64 (rules whose subset construction explodes, e.g. counted runs whose
 // alphabet overlaps their prefix: AKIA[A-Z0-9]{16}).  Early exit on the
-// first accepting step.
-void dfa_verify_pairs(const uint8_t* stream, const int64_t* file_starts,
+// first accepting step.  FileAt(f) -> base pointer of file f's ORIGINAL
+// (unfolded) bytes; shared by the packed-stream and per-file-pointer
+// entry points below.
+template <class FileAt>
+void dfa_verify_impl(FileAt&& file_at,
                       const int64_t* file_lens, const int32_t* pair_file,
                       const int32_t* pair_rule, const int32_t* pair_hint,
                       const int32_t* pair_hint_last,
@@ -583,8 +705,9 @@ void dfa_verify_pairs(const uint8_t* stream, const int64_t* file_starts,
                 if (e < walk_end) walk_end = e;
             }
         }
-        const uint8_t* p = stream + file_starts[f] + skip;
-        const uint8_t* end = stream + file_starts[f] + walk_end;
+        const uint8_t* fbase = file_at(f);
+        const uint8_t* p = fbase + skip;
+        const uint8_t* end = fbase + walk_end;
         uint8_t ok = 0;
         const uint8_t* sb = start_bytes + (size_t)r * 4;
         const int32_t nsb = start_nbytes[r];
@@ -640,6 +763,68 @@ void dfa_verify_pairs(const uint8_t* stream, const int64_t* file_starts,
 #undef TRIVY_TPU_SKIP_RUN
         out_verified[k] = ok;
     }
+}
+
+}  // namespace
+
+extern "C" {
+
+void dfa_verify_pairs(const uint8_t* stream, const int64_t* file_starts,
+                      const int64_t* file_lens, const int32_t* pair_file,
+                      const int32_t* pair_rule, const int32_t* pair_hint,
+                      const int32_t* pair_hint_last,
+                      int64_t npairs,
+                      const int32_t* prefix_bound,
+                      const uint8_t* mode,
+                      const uint8_t* cls_luts,
+                      const uint16_t* trans_blob, const int64_t* trans_off,
+                      const uint8_t* accept_blob, const int64_t* accept_off,
+                      const int32_t* n_classes,
+                      const uint64_t* follow_blob, const int64_t* follow_off,
+                      const uint64_t* cmask_blob, const int64_t* cmask_off,
+                      const uint64_t* nfa_first, const uint64_t* nfa_last,
+                      const uint8_t* start_ok,
+                      const uint8_t* start_bytes,
+                      const int32_t* start_nbytes,
+                      uint8_t* out_verified) {
+    dfa_verify_impl(
+        [&](int32_t f) { return stream + file_starts[f]; },
+        file_lens, pair_file, pair_rule, pair_hint, pair_hint_last, npairs,
+        prefix_bound, mode, cls_luts, trans_blob, trans_off, accept_blob,
+        accept_off, n_classes, follow_blob, follow_off, cmask_blob,
+        cmask_off, nfa_first, nfa_last, start_ok, start_bytes, start_nbytes,
+        out_verified);
+}
+
+// Per-file-pointer form: walks the caller's ORIGINAL file buffers (the
+// sieve's folded scratch must never be verified against — case-sensitive
+// rules need real bytes).
+void dfa_verify_pairs_files(
+                      const uint8_t** file_ptrs,
+                      const int64_t* file_lens, const int32_t* pair_file,
+                      const int32_t* pair_rule, const int32_t* pair_hint,
+                      const int32_t* pair_hint_last,
+                      int64_t npairs,
+                      const int32_t* prefix_bound,
+                      const uint8_t* mode,
+                      const uint8_t* cls_luts,
+                      const uint16_t* trans_blob, const int64_t* trans_off,
+                      const uint8_t* accept_blob, const int64_t* accept_off,
+                      const int32_t* n_classes,
+                      const uint64_t* follow_blob, const int64_t* follow_off,
+                      const uint64_t* cmask_blob, const int64_t* cmask_off,
+                      const uint64_t* nfa_first, const uint64_t* nfa_last,
+                      const uint8_t* start_ok,
+                      const uint8_t* start_bytes,
+                      const int32_t* start_nbytes,
+                      uint8_t* out_verified) {
+    dfa_verify_impl(
+        [&](int32_t f) { return file_ptrs[f]; },
+        file_lens, pair_file, pair_rule, pair_hint, pair_hint_last, npairs,
+        prefix_bound, mode, cls_luts, trans_blob, trans_off, accept_blob,
+        accept_off, n_classes, follow_blob, follow_off, cmask_blob,
+        cmask_off, nfa_first, nfa_last, start_ok, start_bytes, start_nbytes,
+        out_verified);
 }
 
 int32_t contains_folded(const uint8_t* hay, int64_t n, const uint8_t* needle,

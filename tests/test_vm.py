@@ -306,3 +306,25 @@ def test_corrupt_lvm_metadata_warns_and_skips(tmp_path):
     with contextlib.redirect_stdout(buf):
         rc = main(["vm", "--scanners", "secret", "--format", "json", str(p)])
     assert rc == 0
+
+
+def test_lvm_junk_values_surface_as_lvmerror():
+    """r3 review: parseable metadata with junk values must be LvmError,
+    not ValueError/TypeError."""
+    from trivy_tpu.vm import lvm as lvm_mod
+    from trivy_tpu.vm.lvm import LvmError, logical_volumes
+
+    def fake_read(img, base):
+        return ('vg {\nextent_size = "8x"\nphysical_volumes {\npv0 {\n'
+                'pe_start = 2048\n}\n}\nlogical_volumes {\nroot {\n'
+                'segment1 {\nstart_extent = 0\nextent_count = 1\n'
+                'type = "striped"\nstripe_count = 1\n'
+                'stripes = [\n"pv0", "x"\n]\n}\n}\n}\n}\n')
+
+    orig = lvm_mod.read_metadata_text
+    lvm_mod.read_metadata_text = fake_read
+    try:
+        with pytest.raises(LvmError):
+            logical_volumes(io.BytesIO(b"\x00" * 8192), 0)
+    finally:
+        lvm_mod.read_metadata_text = orig

@@ -134,6 +134,7 @@ class VMArtifact:
         into.licenses.extend(other.licenses)
         into.misconfigurations.extend(other.misconfigurations)
         into.custom_resources.extend(other.custom_resources)
+        into.build_info = into.build_info or other.build_info
         return into
 
     def _inspect_ext(self, img, offset: int, what: str) -> BlobInfo:

@@ -117,7 +117,6 @@ class HybridSecretEngine(TpuSecretEngine):
         from trivy_tpu.native import load_native
 
         self._native_ok = load_native() is not None
-        self._scratch: np.ndarray | None = None
         (
             self._norm_masks,
             self._norm_vals,
@@ -207,14 +206,16 @@ class HybridSecretEngine(TpuSecretEngine):
     # ------------------------------------------------------------------
 
     def _sieve_chunk(self, contents: list[bytes]):
-        """Pack a chunk into the reusable scratch stream and run the fused
-        native scan.  Returns (pairs, stream, starts, lens): verified
-        candidate (file, rule) pairs [N, 2] int32 ordered by file then rule
-        (the native scan's first/last hint columns are consumed by the
-        verify stage here and dropped), plus the stream context the DFA
-        verify stage walks.  The stream view aliases the scratch buffer —
-        it is valid only until the next _sieve_chunk call (the single
-        sieve worker runs chunks strictly in sequence)."""
+        """Run the fused native scan over the chunk's file buffers
+        directly (gram_sieve_scan_files folds straight from them — no
+        packed-stream copy exists on this path).  Returns (pairs, stream,
+        starts, lens): verified candidate (file, rule) pairs [N, 2] int32
+        ordered by file then rule (the native scan's first/last hint
+        columns are consumed by the verify stage here and dropped);
+        `stream` is None — the DFA verify walks the ORIGINAL buffers via
+        the same pointer array."""
+        import ctypes
+
         from trivy_tpu.native import load_native
 
         t0 = time.perf_counter()
@@ -222,26 +223,10 @@ class HybridSecretEngine(TpuSecretEngine):
         lens = np.fromiter(
             (len(c) for c in contents), dtype=np.int64, count=nfiles
         )
-        starts = np.zeros(nfiles, dtype=np.int64)
-        if nfiles > 1:
-            np.cumsum(lens[:-1] + GAP, out=starts[1:])
-        n = int(starts[-1] + lens[-1] + GAP) if nfiles else GAP
-        scr = self._scratch
-        if scr is None or len(scr) < n:
-            # Fresh zeroed buffer (an eighth of slack absorbs chunk jitter);
-            # reuse thereafter — a bytes-join per chunk was the second
-            # largest host phase (fresh 32MB allocations fault in pages
-            # every chunk).
-            self._scratch = scr = np.zeros(n + (n >> 3), dtype=np.uint8)
-        else:
-            # Stale bytes from the previous chunk survive only in the
-            # inter-file gaps; file spans are overwritten below.
-            ends = starts + lens
-            scr[(ends[:, None] + np.arange(GAP)).ravel()] = 0
-        for s, c in zip(starts.tolist(), contents):
-            if c:
-                scr[s : s + len(c)] = np.frombuffer(c, dtype=np.uint8)
-        stream = scr[:n]
+        # Pointer array straight at the bytes objects' buffers (no copy;
+        # `contents` stays referenced for the duration of both calls).
+        ptr_arr = (ctypes.c_char_p * nfiles)(*contents)
+        starts = np.zeros(nfiles, dtype=np.int64)  # filled by the C scan
         self.stats.pack_s += time.perf_counter() - t0
 
         t0 = time.perf_counter()
@@ -249,9 +234,9 @@ class HybridSecretEngine(TpuSecretEngine):
         cap = max(1024, 4 * nfiles)
         while True:
             out = np.empty((cap, 4), dtype=np.int32)
-            found = lib.gram_sieve_scan(
-                stream.ctypes.data, len(stream),
-                starts.ctypes.data, nfiles,
+            found = lib.gram_sieve_scan_files(
+                ctypes.cast(ptr_arr, ctypes.c_void_p),
+                lens.ctypes.data, nfiles,
                 self._norm_masks.ctypes.data, self._norm_vals.ctypes.data,
                 len(self._norm_masks),
                 self._gw_norm.ctypes.data, len(self._window_probe_i32),
@@ -260,6 +245,7 @@ class HybridSecretEngine(TpuSecretEngine):
                 self._gate_ptr.ctypes.data, self._gate_probes.ctypes.data,
                 self._rule_conj_ptr.ctypes.data, self._conj_ptr.ctypes.data,
                 self._conj_probes.ctypes.data, len(self.pset.plans),
+                starts.ctypes.data,
                 out.ctypes.data, cap,
             )
             if found <= cap:
@@ -269,18 +255,18 @@ class HybridSecretEngine(TpuSecretEngine):
 
         pairs = out[: int(found)]
         if self._dfa_verifier is not None and len(pairs):
-            # Automaton verify in the same worker: the stream is hot in
-            # cache and the walk releases the GIL like the sieve.  Columns
-            # 2/3 are the file's first/last screen-pass offsets — sound
-            # walk-start and walk-end trims for bounded-length rules.
+            # Automaton verify in the same worker over the ORIGINAL file
+            # buffers (case-sensitive rules must not see folded bytes).
+            # Columns 2/3 are the file's first/last screen-pass offsets —
+            # sound walk-start and walk-end trims for bounded rules.
             t0 = time.perf_counter()
-            ok = self._dfa_verifier.verify_pairs(
-                stream, starts, lens,
+            ok = self._dfa_verifier.verify_pairs_files(
+                ptr_arr, lens,
                 pairs[:, 0], pairs[:, 1], pairs[:, 2], pairs[:, 3],
             )
             pairs = pairs[ok.astype(bool)]
             self.stats.verify_s += time.perf_counter() - t0
-        return pairs[:, :2], stream, starts, lens
+        return pairs[:, :2], None, starts, lens
 
     def _chunks(self, items: list[tuple[str, bytes]]):
         """Split items into contiguous chunks of ~chunk_bytes."""

@@ -364,20 +364,78 @@ class DfaVerifier:
                 if pair_hint is not None and pair_hint_last is not None
                 else None,
                 n,
-                self.prefix_bound.ctypes.data,
-                self.mode.ctypes.data, self.luts.ctypes.data,
-                self.trans_blob.ctypes.data, self.trans_off.ctypes.data,
-                self.accept_blob.ctypes.data, self.accept_off.ctypes.data,
-                self.n_classes.ctypes.data,
-                self.follow_blob.ctypes.data, self.follow_off.ctypes.data,
-                self.cmask_blob.ctypes.data, self.cmask_off.ctypes.data,
-                self.nfa_first.ctypes.data, self.nfa_last.ctypes.data,
-                self.start_ok.ctypes.data,
-                self.start_bytes.ctypes.data, self.start_nbytes.ctypes.data,
+                *self._table_args(),
                 out.ctypes.data,
             )
             return out
         # Pure-Python fallback (slow; used only without a native toolchain)
+        self._python_walk(
+            stream, file_starts, file_lens, pair_file, pair_rule,
+            pair_hint, pair_hint_last, out, n,
+        )
+        return out
+
+    def verify_pairs_files(
+        self,
+        file_ptrs,
+        file_lens: np.ndarray,
+        pair_file: np.ndarray,
+        pair_rule: np.ndarray,
+        pair_hint: np.ndarray | None = None,
+        pair_hint_last: np.ndarray | None = None,
+    ) -> np.ndarray:
+        """verify_pairs over per-file ORIGINAL buffers (a ctypes pointer
+        array): no packed stream exists on this path (the sieve folds
+        straight from the file buffers).  Native-only — the hybrid engine
+        only takes this path when the library loaded."""
+        n = len(pair_file)
+        out = np.ones(n, dtype=np.uint8)
+        if n == 0 or not self.compiled:
+            return out
+        from trivy_tpu.native import load_native
+
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("verify_pairs_files requires the native lib")
+        pair_file = np.ascontiguousarray(pair_file, dtype=np.int32)
+        pair_rule = np.ascontiguousarray(pair_rule, dtype=np.int32)
+        if pair_hint is not None:
+            pair_hint = np.ascontiguousarray(pair_hint, dtype=np.int32)
+        if pair_hint_last is not None:
+            pair_hint_last = np.ascontiguousarray(pair_hint_last, dtype=np.int32)
+        import ctypes
+
+        lib.dfa_verify_pairs_files(
+            ctypes.cast(file_ptrs, ctypes.c_void_p),
+            file_lens.ctypes.data,
+            pair_file.ctypes.data, pair_rule.ctypes.data,
+            pair_hint.ctypes.data if pair_hint is not None else None,
+            pair_hint_last.ctypes.data
+            if pair_hint is not None and pair_hint_last is not None
+            else None,
+            n,
+            *self._table_args(),
+            out.ctypes.data,
+        )
+        return out
+
+    def _table_args(self) -> tuple:
+        """The rule-table argument tail shared by both native entry points
+        (order must match the C signatures — one definition, two calls)."""
+        return (
+            self.prefix_bound.ctypes.data,
+            self.mode.ctypes.data, self.luts.ctypes.data,
+            self.trans_blob.ctypes.data, self.trans_off.ctypes.data,
+            self.accept_blob.ctypes.data, self.accept_off.ctypes.data,
+            self.n_classes.ctypes.data,
+            self.follow_blob.ctypes.data, self.follow_off.ctypes.data,
+            self.cmask_blob.ctypes.data, self.cmask_off.ctypes.data,
+            self.nfa_first.ctypes.data, self.nfa_last.ctypes.data,
+            self.start_ok.ctypes.data,
+            self.start_bytes.ctypes.data, self.start_nbytes.ctypes.data,
+        )
+
+    def _python_walk(self, stream, file_starts, file_lens, pair_file, pair_rule, pair_hint, pair_hint_last, out, n):
         for k in range(n):
             r = int(pair_rule[k])
             mode = self.mode[r]

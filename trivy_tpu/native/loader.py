@@ -107,6 +107,19 @@ def load_native() -> ctypes.CDLL | None:
                 ctypes.c_void_p, ctypes.c_int64,           # out_pairs, cap
             ]
             lib.gram_sieve_scan.restype = ctypes.c_int64
+            lib.gram_sieve_scan_files.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,  # ptrs, lens, F
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,  # grams
+                ctypes.c_void_p, ctypes.c_int32,           # gram_window, W
+                ctypes.c_void_p,                           # window_probe
+                ctypes.c_void_p, ctypes.c_int32,           # probe_n_windows, P
+                ctypes.c_void_p, ctypes.c_void_p,          # gate CSR
+                ctypes.c_void_p, ctypes.c_void_p,          # conj CSR ptrs
+                ctypes.c_void_p, ctypes.c_int32,           # conj_probes, R
+                ctypes.c_void_p,                           # out_starts
+                ctypes.c_void_p, ctypes.c_int64,           # out_pairs, cap
+            ]
+            lib.gram_sieve_scan_files.restype = ctypes.c_int64
             lib.dfa_verify_pairs.argtypes = [
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
@@ -125,6 +138,24 @@ def load_native() -> ctypes.CDLL | None:
                 ctypes.c_void_p,
             ]
             lib.dfa_verify_pairs.restype = None
+            lib.dfa_verify_pairs_files.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p,          # file_ptrs, lens
+                ctypes.c_void_p, ctypes.c_void_p,          # pair_file, pair_rule
+                ctypes.c_void_p, ctypes.c_void_p,          # hints first/last
+                ctypes.c_int64,
+                ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p,
+            ]
+            lib.dfa_verify_pairs_files.restype = None
             _lib = lib
         except OSError:
             _lib_failed = True

@@ -174,14 +174,21 @@ class LinearLV:
 def logical_volumes(img, base: int) -> list[LinearLV]:
     """Linear LVs of the PV at `base`; non-linear segment types are
     skipped (raising only when nothing is readable at all is the walker's
-    call — it logs per-LV).  Corrupt metadata of ANY shape surfaces as
-    LvmError so the VM walker can warn-and-skip instead of crashing."""
+    call — it logs per-LV).  Corrupt metadata of ANY shape — unparseable
+    text OR parseable text with junk values (stripes = ["pv0", "x"]) —
+    surfaces as LvmError so the VM walker can warn-and-skip."""
     try:
-        cfg = parse_lvm_config(read_metadata_text(img, base))
+        return _logical_volumes_unchecked(img, base)
     except LvmError:
         raise
-    except (IndexError, KeyError, ValueError, struct.error, OSError) as e:
+    except (
+        IndexError, KeyError, ValueError, TypeError, struct.error, OSError
+    ) as e:
         raise LvmError(f"corrupt LVM metadata: {e!r}") from e
+
+
+def _logical_volumes_unchecked(img, base: int) -> list[LinearLV]:
+    cfg = parse_lvm_config(read_metadata_text(img, base))
     vgs = [(k, v) for k, v in cfg.items() if isinstance(v, dict)]
     out: list[LinearLV] = []
     for vg_name, vg in vgs:
