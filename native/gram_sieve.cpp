@@ -169,12 +169,12 @@ void gram_sieve(const uint8_t* rows, int64_t T, int64_t L,
 // gram is kept; engine/hybrid.py normalizes and keeps the permutation) and
 // sorted by (mask, value) so mask groups are contiguous.
 //
-// Screen: a 2^18-bit bloom over the folded byte triple (bytes 0-2 of the
+// Screen: a 2^20-bit bloom over the folded byte triple (bytes 0-2 of the
 // window) — text pairs like "ke"/"se" are common, full keyword triples are
 // not (measured: pair screen passes ~28% on source text, the tri screen
 // ~5%).  Masked-out positions admit every byte value.  The AVX-512 path
 // tests 16 overlapping windows per iteration with a gather from the
-// 32KB L1-resident table; the scalar path adds a 64K-bit pair pre-screen
+// 128KB L2-resident table (2^18 measured ~1.5% collision passes on the ~4k inserted patterns — the extra resolves cost more than the larger gathers); the scalar path adds a 64K-bit pair pre-screen
 // (cheaper than the tri hash when testing one position at a time).
 //
 // Dedup: keyword occurrences repeat the same 4-byte window dozens of times
@@ -192,7 +192,7 @@ void gram_sieve(const uint8_t* rows, int64_t T, int64_t L,
 
 namespace {
 
-constexpr int kTriBits = 18;
+constexpr int kTriBits = 20;
 
 std::vector<uint64_t> build_tri_screen(const uint32_t* masks,
                                        const uint32_t* vals, int32_t G) {
@@ -440,6 +440,86 @@ void scan_files_impl(const uint8_t* stream, int64_t n,
     on_close(cur, last_pass);
 }
 
+// Shared candidate-resolution state for the two fused-scan entry points
+// (one definition — the packed-stream and per-file-pointer forms must
+// never desynchronize; see dfa_verify_impl for the same pattern).
+struct CandidateSink {
+    const int64_t* file_starts;
+    const int32_t* gram_window;
+    int32_t W;
+    const int32_t* window_probe;
+    const int32_t* probe_n_windows;
+    int32_t P;
+    const int32_t* gate_ptr;
+    const int32_t* gate_probes;
+    const int32_t* rule_conj_ptr;
+    const int32_t* conj_ptr;
+    const int32_t* conj_probes;
+    int32_t R;
+    int32_t* out_pairs;
+    int64_t cap;
+    std::vector<uint8_t> win_hit;
+    std::vector<uint8_t> probe_hit;
+    std::vector<int32_t> cnt;
+    bool any_hit = false;
+    int32_t first_hit = 0;  // first gram-hit offset within the open file
+    int64_t found = 0;
+
+    CandidateSink(const int64_t* starts, const int32_t* gw, int32_t w,
+                  const int32_t* wp, const int32_t* pnw, int32_t p,
+                  const int32_t* gp_, const int32_t* gpr,
+                  const int32_t* rcp, const int32_t* cp,
+                  const int32_t* cpr, int32_t r,
+                  int32_t* out, int64_t c)
+        : file_starts(starts), gram_window(gw), W(w), window_probe(wp),
+          probe_n_windows(pnw), P(p), gate_ptr(gp_), gate_probes(gpr),
+          rule_conj_ptr(rcp), conj_ptr(cp), conj_probes(cpr), R(r),
+          out_pairs(out), cap(c), win_hit(w, 0), probe_hit(p, 0),
+          cnt(p, 0) {}
+
+    void on_gram(int32_t f, int32_t g, int64_t pos) {
+        win_hit[gram_window[g]] = 1;
+        if (!any_hit) {
+            any_hit = true;
+            first_hit = (int32_t)(pos - file_starts[f]);
+        }
+    }
+
+    void on_close(int32_t f, int64_t last_pass) {
+        if (!any_hit) return;
+        any_hit = false;
+        const int32_t last_hit = (int32_t)(last_pass - file_starts[f]);
+        memset(cnt.data(), 0, (size_t)P * 4);
+        for (int32_t w2 = 0; w2 < W; ++w2)
+            if (win_hit[w2]) ++cnt[window_probe[w2]];
+        memset(win_hit.data(), 0, (size_t)W);
+        for (int32_t p = 0; p < P; ++p)
+            probe_hit[p] = cnt[p] == probe_n_windows[p];
+        for (int32_t r = 0; r < R; ++r) {
+            bool ok = gate_ptr[r] == gate_ptr[r + 1];
+            for (int32_t k = gate_ptr[r]; !ok && k < gate_ptr[r + 1]; ++k)
+                ok = probe_hit[gate_probes[k]];
+            if (!ok) continue;
+            for (int32_t c = rule_conj_ptr[r];
+                 ok && c < rule_conj_ptr[r + 1]; ++c) {
+                bool chit = false;
+                for (int32_t k = conj_ptr[c]; !chit && k < conj_ptr[c + 1];
+                     ++k)
+                    chit = probe_hit[conj_probes[k]];
+                ok = chit;
+            }
+            if (!ok) continue;
+            if (found < cap) {
+                out_pairs[found * 4] = f;
+                out_pairs[found * 4 + 1] = r;
+                out_pairs[found * 4 + 2] = first_hit;
+                out_pairs[found * 4 + 3] = last_hit;
+            }
+            ++found;
+        }
+    }
+};
+
 }  // namespace
 
 extern "C" {
@@ -489,57 +569,17 @@ int64_t gram_sieve_scan(const uint8_t* stream, int64_t n,
                         const int32_t* rule_conj_ptr, const int32_t* conj_ptr,
                         const int32_t* conj_probes, int32_t R,
                         int32_t* out_pairs, int64_t cap) {
-    std::vector<uint8_t> win_hit(W, 0);
-    std::vector<uint8_t> probe_hit(P, 0);
-    std::vector<int32_t> cnt(P, 0);
-    bool any_hit = false;
-    int32_t first_hit = 0;  // first gram-hit offset within the open file
-    int64_t found = 0;
-
-    auto on_gram = [&](int32_t f, int32_t g, int64_t pos) {
-        win_hit[gram_window[g]] = 1;
-        if (!any_hit) {
-            any_hit = true;
-            first_hit = (int32_t)(pos - file_starts[f]);
-        }
-    };
-    auto on_close = [&](int32_t f, int64_t last_pass) {
-        if (!any_hit) return;
-        any_hit = false;
-        const int32_t last_hit = (int32_t)(last_pass - file_starts[f]);
-        memset(cnt.data(), 0, (size_t)P * 4);
-        for (int32_t w2 = 0; w2 < W; ++w2)
-            if (win_hit[w2]) ++cnt[window_probe[w2]];
-        memset(win_hit.data(), 0, (size_t)W);
-        for (int32_t p = 0; p < P; ++p)
-            probe_hit[p] = cnt[p] == probe_n_windows[p];
-        for (int32_t r = 0; r < R; ++r) {
-            bool ok = gate_ptr[r] == gate_ptr[r + 1];
-            for (int32_t k = gate_ptr[r]; !ok && k < gate_ptr[r + 1]; ++k)
-                ok = probe_hit[gate_probes[k]];
-            if (!ok) continue;
-            for (int32_t c = rule_conj_ptr[r];
-                 ok && c < rule_conj_ptr[r + 1]; ++c) {
-                bool chit = false;
-                for (int32_t k = conj_ptr[c]; !chit && k < conj_ptr[c + 1]; ++k)
-                    chit = probe_hit[conj_probes[k]];
-                ok = chit;
-            }
-            if (!ok) continue;
-            if (found < cap) {
-                out_pairs[found * 4] = f;
-                out_pairs[found * 4 + 1] = r;
-                out_pairs[found * 4 + 2] = first_hit;
-                out_pairs[found * 4 + 3] = last_hit;
-            }
-            ++found;
-        }
-    };
-
-    scan_files_impl(stream, n, file_starts, F, masks, vals, G, on_gram,
-                    on_close);
-    return found;
+    CandidateSink sink(
+        file_starts, gram_window, W, window_probe, probe_n_windows, P,
+        gate_ptr, gate_probes, rule_conj_ptr, conj_ptr, conj_probes, R,
+        out_pairs, cap);
+    scan_files_impl(
+        stream, n, file_starts, F, masks, vals, G,
+        [&](int32_t f, int32_t g, int64_t pos) { sink.on_gram(f, g, pos); },
+        [&](int32_t f, int64_t lp) { sink.on_close(f, lp); });
+    return sink.found;
 }
+
 
 // Per-file-pointer form of gram_sieve_scan: folds straight from the
 // caller's file buffers (no packed-stream copy on the caller's side) and
@@ -557,57 +597,16 @@ int64_t gram_sieve_scan_files(
     int64_t* out_starts, int32_t* out_pairs, int64_t cap) {
     int64_t n = 0;
     const uint8_t* stream = fold_files(file_ptrs, lens, F, out_starts, &n);
-
-    std::vector<uint8_t> win_hit(W, 0);
-    std::vector<uint8_t> probe_hit(P, 0);
-    std::vector<int32_t> cnt(P, 0);
-    bool any_hit = false;
-    int32_t first_hit = 0;
-    int64_t found = 0;
-
-    auto on_gram = [&](int32_t f, int32_t g, int64_t pos) {
-        win_hit[gram_window[g]] = 1;
-        if (!any_hit) {
-            any_hit = true;
-            first_hit = (int32_t)(pos - out_starts[f]);
-        }
-    };
-    auto on_close = [&](int32_t f, int64_t last_pass) {
-        if (!any_hit) return;
-        any_hit = false;
-        const int32_t last_hit = (int32_t)(last_pass - out_starts[f]);
-        memset(cnt.data(), 0, (size_t)P * 4);
-        for (int32_t w2 = 0; w2 < W; ++w2)
-            if (win_hit[w2]) ++cnt[window_probe[w2]];
-        memset(win_hit.data(), 0, (size_t)W);
-        for (int32_t p = 0; p < P; ++p)
-            probe_hit[p] = cnt[p] == probe_n_windows[p];
-        for (int32_t r = 0; r < R; ++r) {
-            bool ok = gate_ptr[r] == gate_ptr[r + 1];
-            for (int32_t k = gate_ptr[r]; !ok && k < gate_ptr[r + 1]; ++k)
-                ok = probe_hit[gate_probes[k]];
-            if (!ok) continue;
-            for (int32_t c = rule_conj_ptr[r];
-                 ok && c < rule_conj_ptr[r + 1]; ++c) {
-                bool chit = false;
-                for (int32_t k = conj_ptr[c]; !chit && k < conj_ptr[c + 1]; ++k)
-                    chit = probe_hit[conj_probes[k]];
-                ok = chit;
-            }
-            if (!ok) continue;
-            if (found < cap) {
-                out_pairs[found * 4] = f;
-                out_pairs[found * 4 + 1] = r;
-                out_pairs[found * 4 + 2] = first_hit;
-                out_pairs[found * 4 + 3] = last_hit;
-            }
-            ++found;
-        }
-    };
-
-    scan_files_impl(stream, n, out_starts, F, masks, vals, G, on_gram,
-                    on_close, /*prefolded=*/true);
-    return found;
+    CandidateSink sink(
+        out_starts, gram_window, W, window_probe, probe_n_windows, P,
+        gate_ptr, gate_probes, rule_conj_ptr, conj_ptr, conj_probes, R,
+        out_pairs, cap);
+    scan_files_impl(
+        stream, n, out_starts, F, masks, vals, G,
+        [&](int32_t f, int32_t g, int64_t pos) { sink.on_gram(f, g, pos); },
+        [&](int32_t f, int64_t lp) { sink.on_close(f, lp); },
+        /*prefolded=*/true);
+    return sink.found;
 }
 
 namespace {

@@ -339,3 +339,43 @@ def test_client_server_vuln_scan(tmp_path, rootfs, fixture_db):
     finally:
         httpd.shutdown()
         httpd.server_close()
+
+
+def test_suse_and_opensuse_drivers(tmp_path):
+    """SLES and openSUSE Leap detection (detect.go:43-44): family strings
+    from the os-release analyzer map to suse buckets; the BoltVulnDB alias
+    resolves the real trivy-db names."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from bolt_fixture import build_bolt
+
+    from trivy_tpu.atypes import OS, Package
+    from trivy_tpu.db.vulndb import load_db
+    from trivy_tpu.detector.ospkg import OSPkgDetector
+
+    blob = build_bolt({
+        b"SUSE Linux Enterprise 15.4": {
+            b"libopenssl1_1": {b"SUSE-CVE-1": b'{"FixedVersion": "1.1.1l-1"}'},
+        },
+        b"openSUSE Leap 15.5": {
+            b"curl": {b"SUSE-CVE-2": b'{"FixedVersion": "8.0.1-1"}'},
+        },
+        b"vulnerability": {},
+    })
+    (tmp_path / "trivy.db").write_bytes(blob)
+    db = load_db(str(tmp_path))
+    det = OSPkgDetector(db)
+    assert det.supported("suse linux enterprise server")
+    assert det.supported("opensuse-leap")
+
+    vulns = det.detect(
+        OS(family="suse linux enterprise server", name="15.4"),
+        [Package(name="libopenssl1_1", version="1.1.1k-1", src_name="openssl")],
+    )
+    assert [v.vulnerability_id for v in vulns] == ["SUSE-CVE-1"]
+    vulns = det.detect(
+        OS(family="opensuse-leap", name="15.5"),
+        [Package(name="curl", version="7.9.0-1")],
+    )
+    assert [v.vulnerability_id for v in vulns] == ["SUSE-CVE-2"]

@@ -53,6 +53,17 @@ EOL_DATES: dict[str, dict[str, _dt.datetime]] = {
         "1": _d(2023, 12, 31), "2": _d(2026, 6, 30), "2022": _d(2026, 6, 30),
         "2023": _d(2028, 3, 15),
     },
+    "suse linux enterprise server": {
+        "12.5": _d(2024, 10, 31), "15": _d(2019, 12, 31),
+        "15.1": _d(2021, 1, 31), "15.2": _d(2021, 12, 31),
+        "15.3": _d(2022, 12, 31), "15.4": _d(2023, 12, 31),
+        "15.5": _d(2028, 12, 31),
+    },
+    "opensuse-leap": {
+        "15.0": _d(2019, 12, 3), "15.1": _d(2020, 11, 30),
+        "15.2": _d(2021, 11, 30), "15.3": _d(2022, 11, 30),
+        "15.4": _d(2023, 11, 30), "15.5": _d(2024, 12, 31),
+    },
     "fedora": {
         "37": _d(2023, 12, 5), "38": _d(2024, 5, 21), "39": _d(2024, 11, 26),
         "40": _d(2025, 5, 28), "41": _d(2025, 12, 2),

@@ -32,6 +32,11 @@ _DRIVERS: dict[str, tuple[str, str, int]] = {
     "photon": ("photon", "rpm", 1),
     "cbl-mariner": ("cbl-mariner", "rpm", 1),
     "fedora": ("fedora", "rpm", 1),
+    # SUSE family (detect.go:43-44; trivy-db buckets "SUSE Linux
+    # Enterprise 15.4" / "openSUSE Leap 15.4" resolve through the
+    # BoltVulnDB alias map)
+    "suse linux enterprise server": ("suse", "rpm", 2),
+    "opensuse-leap": ("opensuse-leap", "rpm", 2),
 }
 
 

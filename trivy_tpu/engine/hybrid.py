@@ -266,7 +266,7 @@ class HybridSecretEngine(TpuSecretEngine):
             )
             pairs = pairs[ok.astype(bool)]
             self.stats.verify_s += time.perf_counter() - t0
-        return pairs[:, :2], None, starts, lens
+        return pairs[:, :2]
 
     def _chunks(self, items: list[tuple[str, bytes]]):
         """Split items into contiguous chunks of ~chunk_bytes."""
@@ -321,7 +321,7 @@ class HybridSecretEngine(TpuSecretEngine):
                 lo, hi, fut = pending.popleft()
                 deadline.check()
                 self._finish_chunk(
-                    items, lo, hi, fut.result()[0], results, allowed_pos
+                    items, lo, hi, fut.result(), results, allowed_pos
                 )
         except BaseException:
             # On deadline/interrupt, drop queued chunks so shutdown only
