@@ -121,3 +121,32 @@ def test_compliance_exit_code(tmp_path):
             str(tmp_path),
         ])
     assert rc == 3
+
+
+def test_builtin_specs_resolve_known_checks():
+    """Every builtin compliance spec loads and every referenced IaC check
+    ID exists in the corpus (secret rule IDs resolve through the secret
+    ruleset instead)."""
+    import os
+
+    from trivy_tpu.compliance import spec as spec_mod
+    from trivy_tpu.compliance.spec import load_spec
+    from trivy_tpu.iac.engine import load_checks
+    from trivy_tpu.rules.builtin import BUILTIN_RULES
+
+    iac_ids = {c.check_id for c in load_checks()}
+    secret_ids = {r.id for r in BUILTIN_RULES}
+    names = sorted(
+        f[:-5]
+        for f in os.listdir(spec_mod._BUILTIN_DIR)
+        if f.endswith(".yaml")
+    )
+    assert {"docker-cis-1.6.0", "k8s-nsa-1.0", "k8s-pss-baseline-0.1",
+            "aws-cis-1.4"} <= set(names)
+    for name in names:
+        spec = load_spec(name)
+        for control in spec.controls:
+            for check_id in control.checks:
+                assert check_id in iac_ids or check_id in secret_ids, (
+                    name, control.id, check_id,
+                )

@@ -411,6 +411,30 @@ K8S_CASES = [
 ]
 
 
+K8S_CASES.extend([
+    (
+        "KSV025",
+        POD_HEADER + "spec:\n  containers:\n    - name: app\n      securityContext:\n        seLinuxOptions:\n          type: spc_t\n",
+        POD_HEADER + "spec:\n  containers:\n    - name: app\n      securityContext:\n        seLinuxOptions:\n          type: container_t\n",
+    ),
+    (
+        "KSV103",
+        POD_HEADER + "spec:\n  containers:\n    - name: app\n      securityContext:\n        windowsOptions:\n          hostProcess: true\n",
+        POD_HEADER + "spec:\n  containers:\n    - name: app\n      securityContext: {}\n",
+    ),
+    (
+        "KSV025",
+        POD_HEADER + "spec:\n  securityContext:\n    seLinuxOptions:\n      role: sysadm_r\n  containers:\n    - name: app\n",
+        POD_HEADER + "spec:\n  securityContext:\n    seLinuxOptions:\n      type: container_t\n  containers:\n    - name: app\n",
+    ),
+    (
+        "KSV103",
+        "apiVersion: batch/v1\nkind: CronJob\nmetadata:\n  name: c\nspec:\n  jobTemplate:\n    spec:\n      template:\n        spec:\n          securityContext:\n            windowsOptions:\n              hostProcess: true\n          containers:\n            - name: app\n",
+        "apiVersion: batch/v1\nkind: CronJob\nmetadata:\n  name: c\nspec:\n  jobTemplate:\n    spec:\n      template:\n        spec:\n          containers:\n            - name: app\n",
+    ),
+])
+
+
 @pytest.mark.parametrize("check_id,bad,good", K8S_CASES, ids=[c[0] for c in K8S_CASES])
 def test_kubernetes_checks(scanner, check_id, bad, good):
     assert check_id in _ids(scanner.scan("pod.yaml", bad.encode()))
@@ -419,7 +443,7 @@ def test_kubernetes_checks(scanner, check_id, bad, good):
 
 def test_corpus_size_and_unique_ids_per_type():
     checks = load_checks()
-    assert len(checks) >= 113
+    assert len(checks) >= 115
     seen = set()
     for c in checks:
         key = (c.input_type, c.check_id)

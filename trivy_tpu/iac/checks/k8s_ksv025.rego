@@ -1,0 +1,73 @@
+# METADATA
+# title: Custom SELinux options set
+# custom:
+#   id: KSV025
+#   severity: MEDIUM
+#   recommended_action: Do not set seLinuxOptions user/role, and keep type to the container defaults.
+package builtin.kubernetes.KSV025
+
+containers[c] {
+    c := input.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.initContainers[_]
+}
+
+allowed_types := ["", "container_t", "container_init_t", "container_kvm_t"]
+
+deny[res] {
+    some c in containers
+    opts := object.get(object.get(c, "securityContext", {}), "seLinuxOptions", {})
+    not object.get(opts, "type", "") in allowed_types
+    res := result.new(sprintf("Container %q sets a custom SELinux type", [object.get(c, "name", "?")]), c)
+}
+
+deny[res] {
+    some c in containers
+    opts := object.get(object.get(c, "securityContext", {}), "seLinuxOptions", {})
+    some field in ["user", "role"]
+    object.get(opts, field, "") != ""
+    res := result.new(sprintf("Container %q sets SELinux %s", [object.get(c, "name", "?"), field]), c)
+}
+
+pod_selinux[opts] {
+    opts := object.get(object.get(object.get(input, "spec", {}), "securityContext", {}), "seLinuxOptions", {})
+}
+
+pod_selinux[opts] {
+    opts := object.get(object.get(object.get(object.get(object.get(input, "spec", {}), "template", {}), "spec", {}), "securityContext", {}), "seLinuxOptions", {})
+}
+
+pod_selinux[opts] {
+    opts := object.get(object.get(object.get(object.get(object.get(object.get(object.get(input, "spec", {}), "jobTemplate", {}), "spec", {}), "template", {}), "spec", {}), "securityContext", {}), "seLinuxOptions", {})
+}
+
+deny[res] {
+    some opts in pod_selinux
+    not object.get(opts, "type", "") in allowed_types
+    res := result.new("Pod sets a custom SELinux type", opts)
+}
+
+deny[res] {
+    some opts in pod_selinux
+    some field in ["user", "role"]
+    object.get(opts, field, "") != ""
+    res := result.new(sprintf("Pod sets SELinux %s", [field]), opts)
+}

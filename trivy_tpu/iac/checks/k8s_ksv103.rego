@@ -1,0 +1,52 @@
+# METADATA
+# title: Windows HostProcess container
+# custom:
+#   id: KSV103
+#   severity: HIGH
+#   recommended_action: Do not set windowsOptions.hostProcess true.
+package builtin.kubernetes.KSV103
+
+containers[c] {
+    c := input.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.template.spec.initContainers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.containers[_]
+}
+
+containers[c] {
+    c := input.spec.jobTemplate.spec.template.spec.initContainers[_]
+}
+
+deny[res] {
+    some c in containers
+    object.get(object.get(object.get(c, "securityContext", {}), "windowsOptions", {}), "hostProcess", false) == true
+    res := result.new(sprintf("Container %q runs as a Windows HostProcess", [object.get(c, "name", "?")]), c)
+}
+
+deny[res] {
+    object.get(object.get(object.get(input, "spec", {}), "securityContext", {}), "windowsOptions", {}).hostProcess == true
+    res := result.new("Pod runs Windows HostProcess containers", input.spec)
+}
+
+deny[res] {
+    object.get(object.get(object.get(object.get(object.get(input, "spec", {}), "template", {}), "spec", {}), "securityContext", {}), "windowsOptions", {}).hostProcess == true
+    res := result.new("Pod runs Windows HostProcess containers", input.spec)
+}
+
+deny[res] {
+    object.get(object.get(object.get(object.get(object.get(object.get(object.get(input, "spec", {}), "jobTemplate", {}), "spec", {}), "template", {}), "spec", {}), "securityContext", {}), "windowsOptions", {}).hostProcess == true
+    res := result.new("Pod runs Windows HostProcess containers", input.spec)
+}
