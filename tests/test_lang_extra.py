@@ -314,3 +314,113 @@ def test_end_to_end_pub_vuln(tmp_path):
         for v in r.get("Vulnerabilities", [])
     ]
     assert vulns == ["CVE-2020-35669"]
+
+
+def _analyze(cls, path, content):
+    from trivy_tpu.analyzer.core import AnalysisInput
+
+    a = cls()
+    assert a.required(path, len(content), 0o644)
+    return a.analyze(AnalysisInput(
+        dir="/", file_path=path, size=len(content), mode=0o644,
+        content=content,
+    ))
+
+
+def test_gemspec_analyzer():
+    from trivy_tpu.analyzer.lang_extra import GemspecAnalyzer
+
+    content = b'''# -*- encoding: utf-8 -*-
+Gem::Specification.new do |s|
+  s.name = "rake".freeze
+  s.version = "13.0.6"
+  s.licenses = ["MIT".freeze]
+end
+'''
+    res = _analyze(
+        GemspecAnalyzer,
+        "usr/lib/ruby/gems/3.1.0/specifications/rake-13.0.6.gemspec",
+        content,
+    )
+    [app] = res.applications
+    assert app.app_type == "gemspec"
+    assert [(p.name, p.version, p.licenses) for p in app.packages] == [
+        ("rake", "13.0.6", ["MIT"])
+    ]
+    a = GemspecAnalyzer()
+    assert not a.required("src/project.gemspec", 10, 0o644)  # not installed
+    assert not a.required("vendor/api_specifications/x.gemspec", 1, 0o644)
+
+
+def test_dotnet_deps_analyzer():
+    import json
+
+    from trivy_tpu.analyzer.lang_extra import DotnetDepsAnalyzer
+
+    doc = {"libraries": {
+        "Newtonsoft.Json/13.0.1": {"type": "package"},
+        "MyApp/1.0.0": {"type": "project"},
+    }}
+    res = _analyze(
+        DotnetDepsAnalyzer, "app/MyApp.deps.json", json.dumps(doc).encode()
+    )
+    [app] = res.applications
+    assert [(p.name, p.version) for p in app.packages] == [
+        ("Newtonsoft.Json", "13.0.1")
+    ]
+
+
+def test_packages_props_analyzer():
+    from trivy_tpu.analyzer.lang_extra import PackagesPropsAnalyzer
+
+    content = b'''<Project>
+  <ItemGroup>
+    <PackageVersion Version="3.1.1" Include="Serilog" />
+    <PackageVersion Include="xunit" Version="2.6.0" />
+    <PackageVersion Include="Skipped" Version="$(XunitVersion)" />
+  </ItemGroup>
+</Project>
+'''
+    res = _analyze(
+        PackagesPropsAnalyzer, "src/Directory.Packages.props", content
+    )
+    [app] = res.applications
+    assert [(p.name, p.version) for p in app.packages] == [
+        ("Serilog", "3.1.1"), ("xunit", "2.6.0"),
+    ]
+
+
+def test_node_pkg_analyzer():
+    from trivy_tpu.analyzer.lang_extra import NodePkgAnalyzer
+
+    res = _analyze(
+        NodePkgAnalyzer,
+        "app/node_modules/lodash/package.json",
+        b'{"name": "lodash", "version": "4.17.21", "license": "MIT"}',
+    )
+    [app] = res.applications
+    assert [(p.name, p.version, p.licenses) for p in app.packages] == [
+        ("lodash", "4.17.21", ["MIT"])
+    ]
+    a = NodePkgAnalyzer()
+    assert not a.required("app/package.json", 10, 0o644)  # project manifest
+    assert not a.required("app/my_node_modules/x/package.json", 10, 0o644)
+
+
+def test_julia_manifest_analyzer():
+    from trivy_tpu.analyzer.lang_extra import JuliaManifestAnalyzer
+
+    content = b'''julia_version = "1.9.0"
+manifest_format = "2.0"
+
+[[deps.JSON]]
+uuid = "682c06a0-de6a-54ab-a142-c8b1cf79cde6"
+version = "0.21.4"
+
+[[deps.Libdl]]
+uuid = "8f399da3-3557-5675-b5ff-fb832c97cbdb"
+'''
+    res = _analyze(JuliaManifestAnalyzer, "proj/Manifest.toml", content)
+    [app] = res.applications
+    assert app.app_type == "julia"
+    assert [(p.name, p.version) for p in app.packages] == [("JSON", "0.21.4")]

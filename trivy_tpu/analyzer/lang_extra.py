@@ -266,3 +266,180 @@ register_analyzer(PubLockAnalyzer)
 register_analyzer(MixLockAnalyzer)
 register_analyzer(CocoaPodsAnalyzer)
 register_analyzer(SwiftAnalyzer)
+
+
+class _PathAnalyzer(Analyzer):
+    """Analyzer with TYPE/VERSION class attrs; subclasses define
+    required() and analyze()."""
+
+    TYPE = ""
+    VERSION = 1
+
+    def version(self) -> int:
+        return self.VERSION
+
+    def type(self) -> str:
+        return self.TYPE
+
+
+def _components(file_path: str) -> list[str]:
+    return file_path.replace(os.sep, "/").split("/")
+
+
+class GemspecAnalyzer(_PathAnalyzer):
+    """Installed gem specifications (ruby/gemspec/parse.go): .gemspec files
+    under a specifications/ directory carry `s.name = "x"` /
+    `s.version = "1.2"` assignments (quoted or .freeze forms)."""
+
+    TYPE = "gemspec"
+
+    def required(self, file_path: str, size: int, mode: int) -> bool:
+        return (
+            file_path.endswith(".gemspec")
+            and "specifications" in _components(file_path)[:-1]
+        )
+
+    _NAME_RE = re.compile(
+        rb'\.name\s*=\s*["\']([^"\']+)["\']'
+    )
+    _VERSION_RE = re.compile(
+        rb'\.version\s*=\s*(?:Gem::Version\.new\()?["\']([^"\']+)["\']'
+    )
+    _LICENSE_RE = re.compile(
+        rb'\.licenses?\s*=\s*\[?["\']([^"\']+)["\']'
+    )
+
+    def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
+        name = self._NAME_RE.search(inp.content)
+        version = self._VERSION_RE.search(inp.content)
+        if not name or not version:
+            return None
+        pkg = _pkg(
+            name.group(1).decode("utf-8", "replace"),
+            version.group(1).decode("utf-8", "replace"),
+        )
+        lic = self._LICENSE_RE.search(inp.content)
+        if lic:
+            pkg.licenses = [lic.group(1).decode("utf-8", "replace")]
+        return _app(self.TYPE, inp.file_path, [pkg])
+
+
+class DotnetDepsAnalyzer(_PathAnalyzer):
+    """.deps.json runtime dependency files (dotnet/core_deps/parse.go):
+    libraries keyed "Name/Version" with type "package" (case-insensitive,
+    as the reference's EqualFold)."""
+
+    TYPE = "dotnet-core"
+
+    def required(self, file_path: str, size: int, mode: int) -> bool:
+        return file_path.endswith(".deps.json")
+
+    def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
+        try:
+            doc = json.loads(inp.content)
+        except ValueError as e:
+            logger.warning("deps.json %s: %s", inp.file_path, e)
+            return None
+        pkgs = []
+        for key, lib in (doc.get("libraries") or {}).items():
+            if not isinstance(lib, dict) or str(lib.get("type", "")).lower() != "package":
+                continue
+            name, _, ver = key.partition("/")
+            if name and ver:
+                pkgs.append(_pkg(name, ver))
+        if not pkgs:
+            return None
+        return _app(self.TYPE, inp.file_path, pkgs)
+
+
+class PackagesPropsAnalyzer(_PathAnalyzer):
+    """Central package management props files (dotnet packages_props
+    parser): <PackageVersion Include="x" Version="1.2"/> items, any
+    attribute order; $()-interpolated values are skipped."""
+
+    TYPE = "packages-props"
+
+    def required(self, file_path: str, size: int, mode: int) -> bool:
+        base = os.path.basename(file_path).lower()
+        return base in ("directory.packages.props", "packages.props")
+
+    _ELEM_RE = re.compile(
+        rb"<Package(?:Version|Reference)\s([^>]*?)/?>", re.IGNORECASE
+    )
+    _ATTR_RE = re.compile(rb"""(\w+)\s*=\s*["']([^"']*)["']""")
+
+    def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
+        pkgs = []
+        for m in self._ELEM_RE.finditer(inp.content):
+            attrs = {
+                k.lower(): v
+                for k, v in self._ATTR_RE.findall(m.group(1))
+            }
+            name = attrs.get(b"include", b"")
+            ver = attrs.get(b"version", b"")
+            if name and ver and b"$" not in name and b"$" not in ver:
+                pkgs.append(_pkg(name.decode(), ver.decode()))
+        if not pkgs:
+            return None
+        return _app(self.TYPE, inp.file_path, pkgs)
+
+
+class NodePkgAnalyzer(_PathAnalyzer):
+    """Installed node packages (nodejs/packagejson parser): package.json
+    under node_modules/ carries the installed package's own name/version.
+    Scoped to node_modules (unlike the reference's any-package.json) so the
+    npm composite-FS post-analyzer keeps owning project manifests."""
+
+    TYPE = "node-pkg"
+
+    def required(self, file_path: str, size: int, mode: int) -> bool:
+        parts = _components(file_path)
+        return parts[-1] == "package.json" and "node_modules" in parts[:-1]
+
+    def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
+        try:
+            doc = json.loads(inp.content)
+        except ValueError:
+            return None
+        name = doc.get("name", "")
+        ver = doc.get("version", "")
+        if not isinstance(name, str) or not name or not isinstance(ver, str):
+            return None
+        pkg = _pkg(name, ver)
+        lic = doc.get("license")
+        if isinstance(lic, str) and lic:
+            pkg.licenses = [lic]
+        elif isinstance(lic, dict) and lic.get("type"):
+            pkg.licenses = [lic["type"]]
+        return _app(self.TYPE, inp.file_path, [pkg])
+
+
+class JuliaManifestAnalyzer(_FileNameAnalyzer):
+    """Julia Manifest.toml (julia/manifest/parse.go): [[deps.Name]]
+    entries with version (stdlib entries without version are skipped)."""
+
+    FILE_NAME = "Manifest.toml"
+    TYPE = "julia"
+
+    def parse(self, content: bytes) -> list[Package]:
+        import tomllib
+
+        doc = tomllib.loads(content.decode("utf-8", "replace"))
+        deps = doc.get("deps") or {
+            k: v for k, v in doc.items() if isinstance(v, list)
+        }
+        pkgs = []
+        for name, entries in deps.items():
+            if not isinstance(entries, list):
+                continue
+            for e in entries:
+                if isinstance(e, dict) and e.get("version"):
+                    pkgs.append(_pkg(name, str(e["version"])))
+        return pkgs
+
+
+register_analyzer(GemspecAnalyzer)
+register_analyzer(DotnetDepsAnalyzer)
+register_analyzer(PackagesPropsAnalyzer)
+register_analyzer(NodePkgAnalyzer)
+register_analyzer(JuliaManifestAnalyzer)

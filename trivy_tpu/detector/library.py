@@ -42,6 +42,9 @@ _ECOSYSTEMS: dict[str, tuple[str, str]] = {
     "conan": ("conan", "generic"),
     "swift": ("swift", "generic"),
     "cocoapods": ("cocoapods", "generic"),
+    "dotnet-core": ("nuget", "semver"),
+    "packages-props": ("nuget", "semver"),
+    "julia": ("julia", "semver"),
     # conda-pkg / conda-environment: SBOM-only, no vuln DB (driver.go:75-77)
 }
 

@@ -17,6 +17,9 @@ _PURL_TYPES = {
     "composer": "composer",
     "bundler": "gem",
     "nuget": "nuget",
+    "dotnet-core": "nuget",
+    "packages-props": "nuget",
+    "julia": "julia",
     "pom": "maven",
     "gradle": "maven",
     "jar": "maven",
