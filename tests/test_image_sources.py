@@ -89,6 +89,8 @@ def _fake_image():
 
 class _FakeRegistry(BaseHTTPRequestHandler):
     manifest: dict = {}
+    manifests: dict = {}   # digest/tag -> manifest (fallback: .manifest)
+    referrers: dict = {}   # subject digest -> OCI index doc
     blobs: dict = {}
     require_token = False
     issued_token = "testtoken123"
@@ -118,10 +120,27 @@ class _FakeRegistry(BaseHTTPRequestHandler):
             )
             self.end_headers()
             return
-        if "/manifests/" in self.path:
-            body = json.dumps(self.manifest).encode()
+        if "/referrers/" in self.path:
+            digest = self.path.rsplit("/", 1)[-1]
+            doc = self.referrers.get(digest)
+            if doc is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = json.dumps(doc).encode()
             self.send_response(200)
-            self.send_header("Content-Type", self.manifest.get("mediaType", ""))
+            self.send_header(
+                "Content-Type", "application/vnd.oci.image.index.v1+json"
+            )
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if "/manifests/" in self.path:
+            target = self.path.rsplit("/", 1)[-1]
+            doc = self.manifests.get(target, self.manifest)
+            body = json.dumps(doc).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", doc.get("mediaType", ""))
             self.end_headers()
             self.wfile.write(body)
             return
@@ -240,3 +259,137 @@ def test_base_layer_cache_keys_differ(registry):
     art = ImageArtifact("test/app:1", MemoryCache(), source=src)
     d = src.diff_ids[0]
     assert art._layer_key(d, ()) != art._layer_key(d, ("secret",))
+
+
+def test_remote_sbom_referrers_short_circuit(registry):
+    """--sbom-sources oci: a CycloneDX SBOM attached via OCI referrers
+    replaces the layer walk (image.go:92-98, remote_sbom.go); without the
+    flag the layers are scanned as usual."""
+    from trivy_tpu.analyzer.core import AnalyzerOptions
+    from trivy_tpu.ftypes import ArtifactType
+
+    sbom_doc = {
+        "bomFormat": "CycloneDX",
+        "specVersion": "1.5",
+        "components": [{
+            "type": "library",
+            "name": "flask",
+            "version": "2.0.1",
+            "purl": "pkg:pypi/flask@2.0.1",
+        }],
+    }
+    sbom_blob = json.dumps(sbom_doc).encode()
+    sbom_manifest = {
+        "schemaVersion": 2,
+        "mediaType": "application/vnd.oci.image.manifest.v1+json",
+        "artifactType": "application/vnd.cyclonedx+json",
+        "layers": [{
+            "mediaType": "application/vnd.cyclonedx+json",
+            "digest": _digest(sbom_blob),
+            "size": len(sbom_blob),
+        }],
+    }
+    raw_image_manifest = json.dumps(_FakeRegistry.manifest).encode()
+    image_digest = _digest(raw_image_manifest)
+    sbom_manifest_digest = _digest(json.dumps(sbom_manifest).encode())
+    _FakeRegistry.blobs[_digest(sbom_blob)] = sbom_blob
+    _FakeRegistry.manifests[sbom_manifest_digest] = sbom_manifest
+    _FakeRegistry.referrers[image_digest] = {
+        "schemaVersion": 2,
+        "mediaType": "application/vnd.oci.image.index.v1+json",
+        "manifests": [{
+            "mediaType": "application/vnd.oci.image.manifest.v1+json",
+            "artifactType": "application/vnd.cyclonedx+json",
+            "digest": sbom_manifest_digest,
+            "size": 1,
+        }],
+    }
+    try:
+        src = RegistryClient(insecure=True).fetch_image(f"{registry}/test/app:1")
+        cache = MemoryCache()
+        art = ImageArtifact(
+            "test/app:1", cache, source=src,
+            analyzer_options=AnalyzerOptions(sbom_sources=["oci"]),
+        )
+        ref = art.inspect()
+        assert ref.artifact_type == ArtifactType.CYCLONEDX.value
+        blob = cache.get_blob(ref.blob_ids[0])
+        pkgs = [
+            (p.name, p.version)
+            for pi in blob.package_infos
+            for p in pi.packages
+        ] + [
+            (p.name, p.version)
+            for app in blob.applications
+            for p in app.packages
+        ]
+        assert ("flask", "2.0.1") in pkgs
+
+        # without the flag: normal layer scan (image artifact type)
+        src2 = RegistryClient(insecure=True).fetch_image(f"{registry}/test/app:1")
+        art2 = ImageArtifact("test/app:1", MemoryCache(), source=src2)
+        ref2 = art2.inspect()
+        assert ref2.artifact_type != ArtifactType.CYCLONEDX.value
+    finally:
+        _FakeRegistry.referrers.clear()
+        _FakeRegistry.manifests.clear()
+
+
+def test_remote_sbom_tag_schema_fallback(registry):
+    """Registries without the referrers API fall back to the sha256-<hex>
+    tag schema (go-containerregistry remote.Referrers behavior)."""
+    from trivy_tpu.analyzer.core import AnalyzerOptions
+    from trivy_tpu.ftypes import ArtifactType
+
+    sbom_doc = {
+        "bomFormat": "CycloneDX", "specVersion": "1.5",
+        "components": [{"type": "library", "name": "requests",
+                        "version": "2.31.0",
+                        "purl": "pkg:pypi/requests@2.31.0"}],
+    }
+    sbom_blob = json.dumps(sbom_doc).encode()
+    sbom_manifest = {
+        "schemaVersion": 2,
+        "mediaType": "application/vnd.oci.image.manifest.v1+json",
+        "artifactType": "application/vnd.cyclonedx+json",
+        "layers": [{"mediaType": "application/vnd.cyclonedx+json",
+                    "digest": _digest(sbom_blob), "size": len(sbom_blob)}],
+    }
+    raw_image_manifest = json.dumps(_FakeRegistry.manifest).encode()
+    image_digest = _digest(raw_image_manifest)
+    sbom_manifest_digest = _digest(json.dumps(sbom_manifest).encode())
+    fallback_index = {
+        "schemaVersion": 2,
+        "mediaType": "application/vnd.oci.image.index.v1+json",
+        "manifests": [{
+            "mediaType": "application/vnd.oci.image.manifest.v1+json",
+            "artifactType": "application/vnd.cyclonedx+json",
+            "digest": sbom_manifest_digest, "size": 1,
+        }],
+    }
+    _FakeRegistry.blobs[_digest(sbom_blob)] = sbom_blob
+    _FakeRegistry.manifests[sbom_manifest_digest] = sbom_manifest
+    # NO referrers API entry; only the fallback tag:
+    _FakeRegistry.manifests[image_digest.replace(":", "-")] = fallback_index
+    try:
+        src = RegistryClient(insecure=True).fetch_image(f"{registry}/test/app:1")
+        cache = MemoryCache()
+        art = ImageArtifact(
+            "test/app:1", cache, source=src,
+            analyzer_options=AnalyzerOptions(sbom_sources=["oci"]),
+        )
+        ref = art.inspect()
+        assert ref.artifact_type == ArtifactType.CYCLONEDX.value
+        blob = cache.get_blob(ref.blob_ids[0])
+        pkgs = [
+            (p.name, p.version)
+            for app in blob.applications
+            for p in app.packages
+        ] + [
+            (p.name, p.version)
+            for pi in blob.package_infos
+            for p in pi.packages
+        ]
+        assert ("requests", "2.31.0") in pkgs
+    finally:
+        _FakeRegistry.manifests.clear()

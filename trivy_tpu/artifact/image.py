@@ -19,10 +19,13 @@ from __future__ import annotations
 
 import hashlib
 import json
+import logging
 import os
 import tarfile
 import tempfile
 from dataclasses import dataclass
+
+logger = logging.getLogger(__name__)
 
 from trivy_tpu.analyzer.core import AnalyzerGroup, AnalyzerOptions
 from trivy_tpu.atypes import ArtifactInfo, ArtifactReference, BlobInfo
@@ -40,6 +43,10 @@ class ImageSource:
     layers: list  # list of callables -> file object
     repo_tags: list[str]
     repo_digests: list[str]
+    # Registry sources attach a callable returning the image's OCI-referrer
+    # CycloneDX SBOM (or None) — the remote-SBOM short-circuit input
+    # (remote_sbom.go).
+    sbom_fetcher: object | None = None
     # Holds a tempfile.TemporaryDirectory for OCI-in-tar extraction; its
     # finalizer removes the extracted blobs when the source is collected.
     _tmpdir: object | None = None
@@ -188,7 +195,39 @@ class ImageArtifact:
         h.update(self.group.options.cache_key_extra.encode())
         return "sha256:" + h.hexdigest()
 
+    def _try_remote_sbom(self) -> ArtifactReference | None:
+        """Remote-SBOM short-circuit (image.go:92-98 + remote_sbom.go): a
+        CycloneDX SBOM attached via OCI referrers replaces the layer walk
+        entirely — packages come from the attestation, not re-analysis."""
+        fetcher = getattr(self.source, "sbom_fetcher", None)
+        if fetcher is None:
+            return None
+        doc = fetcher()
+        if not doc:
+            return None
+        from trivy_tpu.sbom.cyclonedx import decode
+
+        try:
+            detail = decode(doc)
+        except Exception as e:
+            logger.warning("OCI-referrer SBOM undecodable: %s", e)
+            return None
+        logger.info("Found SBOM in the OCI referrers; skipping layer scan")
+        from trivy_tpu.artifact.sbom import build_sbom_reference
+
+        return build_sbom_reference(
+            detail,
+            json.dumps(doc, sort_keys=True).encode(),
+            self.cache,
+            self.target,
+            ArtifactType.CYCLONEDX,
+        )
+
     def inspect(self) -> ArtifactReference:
+        if "oci" in (self.group.options.sbom_sources or []):
+            ref = self._try_remote_sbom()
+            if ref is not None:
+                return ref
         src = self.source
         diff_ids = src.diff_ids
         # Base layers skip secret scanning (image.go:100-102, 209-213): the

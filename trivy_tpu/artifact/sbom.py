@@ -24,6 +24,31 @@ def detect_format(data: dict) -> str:
     raise ValueError("unrecognized SBOM format (expected CycloneDX or SPDX JSON)")
 
 
+def build_sbom_reference(
+    detail, raw: bytes, cache, name: str, artifact_type: "ArtifactType"
+) -> "ArtifactReference":
+    """Decoded SBOM detail -> cached blob + artifact reference; the single
+    decode-to-reference tail shared by the sbom artifact and the image
+    remote-SBOM short-circuit."""
+    blob = BlobInfo(
+        os=detail.os,
+        package_infos=(
+            [PackageInfo(file_path="", packages=detail.packages)]
+            if detail.packages
+            else []
+        ),
+        applications=list(detail.applications),
+    )
+    blob_id = "sha256:" + hashlib.sha256(raw).hexdigest()
+    cache.put_blob(blob_id, blob)
+    return ArtifactReference(
+        name=name,
+        artifact_type=artifact_type.value,
+        id=blob_id,
+        blob_ids=[blob_id],
+    )
+
+
 class SbomArtifact:
     """artifact/sbom/sbom.go Artifact."""
 
@@ -45,23 +70,8 @@ class SbomArtifact:
 
             artifact_type = ArtifactType.SPDX
         detail = decode(data)
-
-        blob = BlobInfo(
-            os=detail.os,
-            package_infos=(
-                [PackageInfo(file_path="", packages=detail.packages)]
-                if detail.packages
-                else []
-            ),
-            applications=list(detail.applications),
-        )
-        blob_id = "sha256:" + hashlib.sha256(raw.encode()).hexdigest()
-        self.cache.put_blob(blob_id, blob)
-        return ArtifactReference(
-            name=self.target,
-            artifact_type=artifact_type.value,
-            id=blob_id,
-            blob_ids=[blob_id],
+        return build_sbom_reference(
+            detail, raw.encode(), self.cache, self.target, artifact_type
         )
 
     def clean(self, ref: ArtifactReference) -> None:

@@ -42,7 +42,15 @@ def resolve_image(ref: str, insecure_registry: bool = False):
         ("podman", podman_image),
     ):
         try:
-            return source(ref)
+            src = source(ref)
+            # Referrer SBOMs live in the registry regardless of which hop
+            # supplied the bytes (remote_sbom.go looks up by name): attach
+            # a lazy fetcher so --sbom-sources oci works for daemon images.
+            if getattr(src, "sbom_fetcher", None) is None:
+                src.sbom_fetcher = RegistryClient(
+                    insecure=insecure_registry
+                ).sbom_fetcher_for(ref)
+            return src
         except SourceUnavailable as e:
             errors.append(f"{name}: {e}")
     try:

@@ -24,9 +24,12 @@ import shutil
 import re
 import tempfile
 import urllib.error
+import logging
 import urllib.parse
 import urllib.request
 from dataclasses import dataclass, field
+
+logger = logging.getLogger(__name__)
 
 MANIFEST_ACCEPT = ", ".join(
     [
@@ -226,12 +229,82 @@ class RegistryClient:
         f.seek(0)
         return f
 
+    # CycloneDX artifact types the reference accepts for OCI-referrer
+    # SBOMs (remote_sbom.go).
+    _SBOM_ARTIFACT_TYPES = (
+        "application/vnd.cyclonedx+json",
+        "application/vnd.cyclonedx",
+    )
+
+    def get_referrers(self, ref: Reference, digest: str) -> dict:
+        """OCI 1.1 referrers index for `digest`, falling back to the
+        referrers TAG schema (`sha256-<hex>`) on registries without the
+        API — the same chain go-containerregistry's remote.Referrers walks
+        for the reference.  {} when neither exists."""
+        base = f"{self._scheme(ref.registry)}://{ref.registry}/v2/{ref.repository}"
+        accept = "application/vnd.oci.image.index.v1+json"
+        for path in (
+            f"{base}/referrers/{digest}",
+            f"{base}/manifests/{digest.replace(':', '-')}",
+        ):
+            try:
+                raw, _ = self._request(
+                    path, {"Accept": accept}, ref.repository
+                )
+                doc = json.loads(raw)
+            except (RegistryError, ValueError):
+                continue
+            if isinstance(doc, dict) and doc.get("manifests") is not None:
+                return doc
+        return {}
+
+    def fetch_sbom_referrer(self, ref: Reference, digest: str) -> dict | None:
+        """A CycloneDX SBOM attached to `digest` via OCI referrers, decoded
+        (remote_sbom.go:61-114), or None when absent/undecodable."""
+        for desc in self.get_referrers(ref, digest).get("manifests") or []:
+            if desc.get("artifactType") not in self._SBOM_ARTIFACT_TYPES:
+                continue
+            try:
+                raw, _ = self._request(
+                    f"{self._scheme(ref.registry)}://{ref.registry}/v2/"
+                    f"{ref.repository}/manifests/{desc['digest']}",
+                    {"Accept": MANIFEST_ACCEPT},
+                    ref.repository,
+                )
+                manifest = json.loads(raw)
+                layers = manifest.get("layers") or []
+                if not layers:
+                    continue
+                with self.get_blob(ref, layers[0]["digest"]) as f:
+                    return json.loads(f.read())
+            except (RegistryError, ValueError, KeyError) as e:
+                logger.warning("OCI-referrer SBOM unusable: %s", e)
+        return None
+
+    def subject_digest(self, ref: Reference) -> str:
+        """The digest SBOM referrers attach to: the user-supplied digest,
+        or the digest of whatever the tag resolves to FIRST (the index for
+        multi-arch images — cosign et al. attach to that, not to the
+        platform child; remote_sbom.go uses the repo digest the same
+        way)."""
+        from trivy_tpu.artifact.image import _sha256_hex
+
+        if ref.digest:
+            return ref.digest
+        base = f"{self._scheme(ref.registry)}://{ref.registry}/v2/{ref.repository}"
+        raw, headers = self._request(
+            f"{base}/manifests/{ref.tag}",
+            {"Accept": MANIFEST_ACCEPT},
+            ref.repository,
+        )
+        return headers.get("Docker-Content-Digest") or _sha256_hex(raw)
+
     def fetch_image(self, ref_str: str):
         """Resolve a reference into an ImageSource (artifact/image.py)."""
         from trivy_tpu.artifact.image import ImageSource, _sha256_hex
 
         ref = parse_reference(ref_str)
-        manifest, _raw = self.get_manifest(ref)
+        manifest, _raw_manifest = self.get_manifest(ref)
         with self.get_blob(ref, manifest["config"]["digest"]) as f:
             raw_config = f.read()
         layers = [
@@ -244,4 +317,21 @@ class RegistryClient:
             layers=layers,
             repo_tags=[f"{ref.repository}:{ref.tag}"] if not ref.digest else [],
             repo_digests=[ref.name] if ref.digest else [],
+            sbom_fetcher=self.sbom_fetcher_for(ref_str),
         )
+
+    def sbom_fetcher_for(self, ref_str: str):
+        """A zero-argument callable resolving the reference's OCI-referrer
+        SBOM on demand (None on any failure) — attached to ImageSources
+        from ANY resolution hop (daemon/podman included: the referrers
+        live in the registry regardless of where the bytes came from)."""
+
+        def fetch():
+            try:
+                ref = parse_reference(ref_str)
+                return self.fetch_sbom_referrer(ref, self.subject_digest(ref))
+            except (RegistryError, ValueError) as e:
+                logger.debug("no OCI-referrer SBOM for %s: %s", ref_str, e)
+                return None
+
+        return fetch
