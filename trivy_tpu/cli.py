@@ -191,6 +191,14 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
         help="server auth token",
     )
     p.add_argument(
+        "--username", default=_env_default("username", ""),
+        help="private registry username (TRIVY_TPU_USERNAME)",
+    )
+    p.add_argument(
+        "--password", default=_env_default("password", ""),
+        help="private registry password (prefer the env var)",
+    )
+    p.add_argument(
         "--server-wire", default=_env_default("server-wire", "json"),
         choices=["json", "protobuf"],
         help="Twirp wire format for client mode",
@@ -294,6 +302,8 @@ def _options_from_args(args: argparse.Namespace) -> Options:
         secret_backend=args.secret_backend,
         ignore_file=args.ignorefile if os.path.exists(args.ignorefile) else "",
         server_addr=args.server,
+        username=getattr(args, "username", ""),
+        password=getattr(args, "password", ""),
         server_wire=getattr(args, "server_wire", "json"),
         token=args.token,
         db_dir=args.db_dir,

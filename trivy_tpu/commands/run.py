@@ -74,6 +74,8 @@ class Options:
     trace: bool = False  # --trace (rego traces on misconfig findings)
     config_check: list[str] = field(default_factory=list)  # --config-check dirs
     insecure_registry: bool = False  # plain-http registry pulls
+    username: str = ""  # private-registry basic/bearer credentials
+    password: str = ""
     db_repository: str = ""  # OCI ref for the vuln DB (--db-repository)
     java_db_repository: str = ""  # OCI ref for the Java index DB
     skip_db_update: bool = False
@@ -206,6 +208,8 @@ def _build_scanner(options: Options, target_kind: str, cache: ArtifactCache) -> 
             source = resolve_image(
                 options.target,
                 insecure_registry=getattr(options, "insecure_registry", False),
+                username=getattr(options, "username", ""),
+                password=getattr(options, "password", ""),
             )
         artifact = ImageArtifact(
             options.target,

@@ -27,7 +27,12 @@ __all__ = [
 ]
 
 
-def resolve_image(ref: str, insecure_registry: bool = False):
+def resolve_image(
+    ref: str,
+    insecure_registry: bool = False,
+    username: str = "",
+    password: str = "",
+):
     """Resolution chain (image.go:26): local archive path, then daemon ->
     containerd -> podman -> registry; raises with every source's error when
     all fail, like the reference's errs join."""
@@ -48,13 +53,16 @@ def resolve_image(ref: str, insecure_registry: bool = False):
             # a lazy fetcher so --sbom-sources oci works for daemon images.
             if getattr(src, "sbom_fetcher", None) is None:
                 src.sbom_fetcher = RegistryClient(
-                    insecure=insecure_registry
+                    insecure=insecure_registry,
+                    username=username, password=password,
                 ).sbom_fetcher_for(ref)
             return src
         except SourceUnavailable as e:
             errors.append(f"{name}: {e}")
     try:
-        return RegistryClient(insecure=insecure_registry).fetch_image(ref)
+        return RegistryClient(
+            insecure=insecure_registry, username=username, password=password
+        ).fetch_image(ref)
     except RegistryError as e:
         errors.append(f"registry: {e}")
     raise RegistryError(
