@@ -94,6 +94,7 @@ class _FakeRegistry(BaseHTTPRequestHandler):
     blobs: dict = {}
     require_token = False
     issued_token = "testtoken123"
+    seen_auth: list = []   # (path-kind, Authorization) pairs, in order
 
     def log_message(self, *a):  # noqa: D102
         pass
@@ -104,6 +105,10 @@ class _FakeRegistry(BaseHTTPRequestHandler):
         return self.headers.get("Authorization") == f"Bearer {self.issued_token}"
 
     def do_GET(self):  # noqa: N802
+        kind = "/token" if self.path.startswith("/token") else self.path
+        type(self).seen_auth.append(
+            (kind, self.headers.get("Authorization", ""))
+        )
         if self.path.startswith("/token"):
             body = json.dumps({"token": self.issued_token}).encode()
             self.send_response(200)
@@ -393,6 +398,29 @@ def test_remote_sbom_tag_schema_fallback(registry):
         assert ("requests", "2.31.0") in pkgs
     finally:
         _FakeRegistry.manifests.clear()
+
+
+def test_basic_then_bearer_challenge_sequence(registry):
+    """The exact token-issuing-registry handshake: the client attaches
+    Basic preemptively, the registry 401s with a Bearer challenge, and the
+    client must trade the Basic credentials for a token at the realm and
+    retry — go-containerregistry's keychain flow (remote.go:15).  Regression
+    for the bug where a preemptive Basic header suppressed the round-trip."""
+    _FakeRegistry.require_token = True
+    _FakeRegistry.seen_auth = []
+    try:
+        client = RegistryClient(insecure=True, username="u", password="p")
+        manifest, _ = client.get_manifest(parse_reference(f"{registry}/test/app:1"))
+        assert manifest.get("layers")
+        auths = _FakeRegistry.seen_auth
+        # manifest GET with Basic → 401; /token GET carries Basic; retry Bearer
+        assert any(a.startswith("Basic ") for _, a in auths if _ != "/token")
+        token_auths = [a for p, a in auths if p == "/token"]
+        assert token_auths and token_auths[0].startswith("Basic ")
+        assert any(a.startswith("Bearer ") for _, a in auths)
+    finally:
+        _FakeRegistry.require_token = False
+        _FakeRegistry.seen_auth = []
 
 
 def test_private_registry_basic_auth(registry):

@@ -105,29 +105,48 @@ class RegistryClient:
             return "http"
         return "https"
 
-    def _request(
-        self, url: str, headers: dict[str, str], token_scope: str
-    ) -> tuple[bytes, dict[str, str]]:
-        hdrs = dict(headers)
+    def _basic_credential(self) -> str:
+        return base64.b64encode(
+            f"{self.username}:{self.password}".encode()
+        ).decode()
+
+    def _auth_headers(self, token_scope: str) -> dict[str, str]:
+        """Authorization header for a scope: a cached Bearer token wins;
+        otherwise Basic credentials are attached preemptively."""
         tok = self._tokens.get(token_scope)
         if tok:
-            hdrs["Authorization"] = f"Bearer {tok}"
-        elif self.username:
-            cred = base64.b64encode(
-                f"{self.username}:{self.password}".encode()
-            ).decode()
-            hdrs["Authorization"] = f"Basic {cred}"
+            return {"Authorization": f"Bearer {tok}"}
+        if self.username:
+            return {"Authorization": f"Basic {self._basic_credential()}"}
+        return {}
+
+    def _request(
+        self,
+        url: str,
+        headers: dict[str, str],
+        token_scope: str,
+        _retried: bool = False,
+    ) -> tuple[bytes, dict[str, str]]:
+        hdrs = dict(headers) | self._auth_headers(token_scope)
         req = urllib.request.Request(url, headers=hdrs)
         try:
             with urllib.request.urlopen(req, timeout=60) as resp:
                 return resp.read(), dict(resp.headers)
         except urllib.error.HTTPError as e:
-            if e.code == 401 and "Authorization" not in hdrs:
+            # A Bearer challenge triggers the token round-trip even when
+            # Basic credentials were preemptively attached — token-issuing
+            # registries (Docker Hub, GHCR) 401 the Basic attempt and
+            # expect the client to trade those credentials for a token at
+            # the realm, which is go-containerregistry's keychain flow
+            # (pkg/fanal/image/remote.go:15).  One retry only.
+            if e.code == 401 and not _retried:
                 challenge = e.headers.get("WWW-Authenticate", "")
                 token = self._fetch_token(challenge)
                 if token:
                     self._tokens[token_scope] = token
-                    return self._request(url, headers, token_scope)
+                    return self._request(
+                        url, headers, token_scope, _retried=True
+                    )
             raise RegistryError(f"registry: GET {url}: HTTP {e.code}") from e
         except urllib.error.URLError as e:
             raise RegistryError(f"registry: GET {url}: {e.reason}") from e
@@ -148,10 +167,7 @@ class RegistryClient:
         url = realm + ("?" + "&".join(query) if query else "")
         headers = {}
         if self.username:
-            cred = base64.b64encode(
-                f"{self.username}:{self.password}".encode()
-            ).decode()
-            headers["Authorization"] = f"Basic {cred}"
+            headers["Authorization"] = f"Basic {self._basic_credential()}"
         req = urllib.request.Request(url, headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=60) as resp:
@@ -196,30 +212,23 @@ class RegistryClient:
             raise RegistryError("registry: empty manifest index")
         return best
 
-    def get_blob(self, ref: Reference, digest: str):
+    def get_blob(self, ref: Reference, digest: str, _retried: bool = False):
         """Stream a blob into a spooled temp file; returns the open file
         positioned at 0 (caller owns/closes it).  Streaming keeps multi-GB
         layers out of resident memory."""
         base = f"{self._scheme(ref.registry)}://{ref.registry}/v2/{ref.repository}"
         url = f"{base}/blobs/{digest}"
-        hdrs: dict[str, str] = {}
-        tok = self._tokens.get(ref.repository)
-        if tok:
-            hdrs["Authorization"] = f"Bearer {tok}"
-        elif self.username:
-            cred = base64.b64encode(
-                f"{self.username}:{self.password}".encode()
-            ).decode()
-            hdrs["Authorization"] = f"Basic {cred}"
-        req = urllib.request.Request(url, headers=hdrs)
+        req = urllib.request.Request(
+            url, headers=self._auth_headers(ref.repository)
+        )
         try:
             resp = urllib.request.urlopen(req, timeout=300)
         except urllib.error.HTTPError as e:
-            if e.code == 401 and "Authorization" not in hdrs:
+            if e.code == 401 and not _retried:
                 token = self._fetch_token(e.headers.get("WWW-Authenticate", ""))
                 if token:
                     self._tokens[ref.repository] = token
-                    return self.get_blob(ref, digest)
+                    return self.get_blob(ref, digest, _retried=True)
             raise RegistryError(f"registry: GET {url}: HTTP {e.code}") from e
         except urllib.error.URLError as e:
             raise RegistryError(f"registry: GET {url}: {e.reason}") from e
