@@ -233,6 +233,55 @@ def make_kernel_corpus(
     return out
 
 
+def _near_miss(rng: np.random.Generator, kind: int) -> bytes:
+    """A credential-adjacent line that passes the keyword/gram screen but
+    fails the full regex — the shape that makes the verify stage do real
+    work (the reference pays its regex loop on exactly these lines)."""
+    kind = kind % 6
+    if kind == 0:  # AKIA prefix, too short for [A-Z0-9]{16}
+        return b"arn_hint = AKIA" + _rand_chars(rng, _UPPER_DIGIT, 8) + b"...\n"
+    if kind == 1:  # ghp_ prefix, 12 chars instead of 36
+        return b"token_stub: ghp_" + _rand_chars(rng, _B62, 12) + b"\n"
+    if kind == 2:  # sk_live_ too short
+        return b"stripe_test = sk_live_" + _rand_chars(rng, _B62[:50], 6) + b"\n"
+    if kind == 3:  # slack webhook path too short
+        return b"url: https://hooks.slack.com/services/TEAM/HOOK\n"
+    if kind == 4:  # private-key header inside prose, no key body
+        return b"# docs mention BEGIN RSA PRIVATE KEY marker format\n"
+    return b"ACCESS_KEY_ID placeholder, fill with AKIA value later\n"
+
+
+def make_hitdense_corpus(
+    n_files: int = 20_000, seed: int = 13, planted_every: int = 50
+) -> list[tuple[str, bytes]]:
+    """Hit-dense config/infra tree: .env/yaml/tf files where most files
+    carry several credential-adjacent near-miss lines (gram-sieve
+    candidates that fail the full regex) and ~2% carry true secrets.  This
+    is the verify-bound regime: sieve selectivity is low by construction,
+    so throughput is set by the verify stage (host DFA vs device NFA)."""
+    rng = np.random.default_rng(seed)
+    pool = _build_pool(rng, "py", 4 << 20)
+    sizes = _file_sizes(rng, n_files, median=1500.0, sigma=0.9)
+    exts = (".env", ".yaml", ".tf", ".py", ".conf")
+    out = []
+    planted = 0
+    misses = 0
+    for i in range(n_files):
+        path = f"deploy/env{i % 61}/cfg{i}{exts[i % len(exts)]}"
+        body = _slice_pool(pool, rng, int(sizes[i]))
+        n_miss = int(rng.integers(2, 8))
+        lines = []
+        for _ in range(n_miss):
+            lines.append(_near_miss(rng, misses))
+            misses += 1
+        if planted_every and i % planted_every == 7:
+            lines.append(planted_secret(rng, planted))
+            planted += 1
+        cut = body.rfind(b"\n", 0, len(body) // 2) + 1
+        out.append((path, body[:cut] + b"".join(lines) + body[cut:]))
+    return out
+
+
 def make_monorepo_corpus(
     n_files: int = 100_000, seed: int = 11, planted_every: int = 200
 ) -> list[tuple[str, bytes]]:

@@ -57,6 +57,7 @@ class SieveStats:
     bytes: int = 0
     tiles: int = 0
     candidate_pairs: int = 0
+    device_pairs: int = 0  # candidate lanes verified on the device NFA
     confirmed_findings: int = 0
     # Wall-clock per phase (seconds), accumulated across scan_batch calls:
     # host pack, sieve (device dispatch+execute+fetch, or native host scan),

@@ -214,6 +214,29 @@ def compile_search_nfa64(rule: Rule) -> RuleNfa64 | None:
 
 MODE_NONE, MODE_DFA, MODE_NFA = 0, 1, 2
 
+NO_TRIM = np.iinfo(np.int32).max  # sentinel: unbounded match, no walk trim
+
+
+def compute_prefix_bounds(rules: list[Rule], trimmable) -> np.ndarray:
+    """int32[R] walk-trim bound per rule (NO_TRIM = none): a trimmable
+    rule's match contains a gram occurrence and is at most max_len(regex)
+    long, so its walk clips to [first_hint - bound, last_hint + bound + 8]
+    (the dfa_verify_pairs formula).  Shared by the host DfaVerifier and
+    the device NfaVerifier — refutation soundness depends on both using
+    the identical clip."""
+    out = np.full(len(rules), NO_TRIM, dtype=np.int32)
+    if trimmable is None:
+        return out
+    for i, rule in enumerate(rules):
+        if not (rule.regex_src and trimmable[i]):
+            continue
+        try:
+            ml = max_len(parse_ir(goregex.go_to_python(rule.regex_src)))
+        except (UnsupportedRegex, goregex.GoRegexError):
+            continue
+        out[i] = min(ml, NO_TRIM - 1)
+    return out
+
 
 class DfaVerifier:
     """Batched (file, rule) match-existence verification over a byte stream.
@@ -226,14 +249,16 @@ class DfaVerifier:
     the native library is unavailable).
     """
 
-    def __init__(self, rules: list[Rule], trimmable=None):
+    def __init__(self, rules: list[Rule], trimmable=None, prefix_bounds=None):
         """`trimmable`: optional bool[R] - rule r's walk may start at the
         file's first gram hit minus max_len.  Sound ONLY when every match
         of r contains a gram-backed factor occurrence, i.e. the rule has
         an anchor conjunct whose probes ALL carry grams (the engine
         computes this from its probe/gram sets).  Without it, no trim is
         applied: a match can occur before the file's first gram hit when
-        candidacy came from gram-less (always-hit) probes."""
+        candidacy came from gram-less (always-hit) probes.
+        `prefix_bounds`: precomputed compute_prefix_bounds output (the
+        engine shares one array between this and the device verifier)."""
         self.num_rules = len(rules)
         r = self.num_rules
         luts = np.zeros((r, 256), dtype=np.uint8)
@@ -254,20 +279,15 @@ class DfaVerifier:
         # bytes that cannot.
         self.start_ok = np.zeros((r, 256), dtype=np.uint8)
         # Walk-start trim bound: a match can begin at most max_len(regex)
-        # bytes before the file's first gram hit; INT32_MAX = unbounded
+        # bytes before the file's first gram hit; NO_TRIM = unbounded
         # match length, no trim.
-        self.prefix_bound = np.full(r, np.iinfo(np.int32).max, dtype=np.int32)
+        self.prefix_bound = (
+            np.asarray(prefix_bounds, dtype=np.int32)
+            if prefix_bounds is not None
+            else compute_prefix_bounds(rules, trimmable)
+        )
         toff = aoff = foff = coff = 0
         for i, rule in enumerate(rules):
-            if rule.regex_src and trimmable is not None and trimmable[i]:
-                try:
-                    ml = max_len(
-                        parse_ir(goregex.go_to_python(rule.regex_src))
-                    )
-                except (UnsupportedRegex, goregex.GoRegexError):
-                    ml = None
-                if ml is not None:
-                    self.prefix_bound[i] = min(ml, np.iinfo(np.int32).max - 1)
             dfa = compile_search_dfa(rule)
             if dfa is not None:
                 self.mode[i] = MODE_DFA
