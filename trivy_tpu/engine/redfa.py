@@ -234,7 +234,8 @@ def compute_prefix_bounds(rules: list[Rule], trimmable) -> np.ndarray:
             ml = max_len(parse_ir(goregex.go_to_python(rule.regex_src)))
         except (UnsupportedRegex, goregex.GoRegexError):
             continue
-        out[i] = min(ml, NO_TRIM - 1)
+        if ml is not None:  # None = unbounded match length
+            out[i] = min(ml, NO_TRIM - 1)
     return out
 
 
