@@ -302,7 +302,7 @@ def main() -> None:
                 1,
             )
             kdetail["parity_checked_files"] = assert_parity(
-                kitems, kresults, "sample"
+                kitems, kresults, PARITY
             )
             detail["kernel"] = kdetail
             del kern

@@ -179,8 +179,11 @@ void gram_sieve(const uint8_t* rows, int64_t T, int64_t L,
 //
 // Dedup: keyword occurrences repeat the same 4-byte window dozens of times
 // per file; a 256-entry direct-mapped seen-set (stamped with the file
-// ordinal) and a 4-entry vectorized `recent` filter drop re-resolutions.
-// Both reset when attribution crosses a file boundary.
+// ordinal) and an 8-entry vectorized `recent` filter drop re-resolutions.
+// Both reset when attribution crosses a file boundary.  With the per-hit
+// class confirm, only position-independent outcomes enter either filter
+// (see pos_dep in resolve); a 1024-entry value->gram-list cache absorbs
+// the re-resolutions of position-dependent windows.
 //
 // Attribution is exactly per file: file_starts are monotonic positions in
 // the joined stream (files separated by >= 4 zero bytes so no window spans
@@ -254,12 +257,48 @@ const uint8_t* fold_files(const uint8_t** file_ptrs, const int64_t* lens,
     return dst;
 }
 
+// Per-hit probe-class confirm: a gram hit at `pos` stands only when the
+// owning probe's FULL class sequence matches at the gram's alignment.
+// Masked grams are coarse (a hex-class byte is unmaskable: "sk??" fires on
+// "task_struct"); the class bitmaps recover the LUT shift-AND sieve's
+// precision for one AND per byte.  `stream` may be folded or raw — bytes
+// fold per-read (idempotent) and bitmaps hold folded members.  Sequences
+// that would cross a file boundary hit the >= 4 zero gap bytes and fail
+// (no class admits NUL); start/end guards cover the stream edges.
+//
+// Returns +1 pass; -1 fail decided INSIDE the window's own 4 bytes (the
+// outcome is a function of the window value alone, so the caller may
+// dedup/cache it); 0 fail decided by surrounding bytes (position-
+// dependent: the same value may confirm elsewhere).
+inline int confirm_hit(const uint8_t* stream, int64_t n, int64_t pos,
+                       int32_t g, const uint8_t* cls_blob,
+                       const int32_t* cls_start, const int32_t* cls_len,
+                       const int32_t* cls_align) {
+    const int64_t s = pos - cls_align[g];
+    const int32_t len = cls_len[g];
+    if (s < 0 || s + len > n) return 0;
+    const uint8_t* bm = cls_blob + (size_t)cls_start[g] * 32;
+    for (int32_t j = 0; j < len; ++j) {
+        uint8_t b = stream[s + j];
+        b += (uint8_t)((uint8_t)(b - 'A') < 26) << 5;
+        if (!((bm[j * 32 + (b >> 3)] >> (b & 7)) & 1u)) {
+            const int64_t fj = s + j;
+            return (fj >= pos && fj < pos + 4) ? -1 : 0;
+        }
+    }
+    return 1;
+}
+
 template <class OnGram, class OnFileClose>
 void scan_files_impl(const uint8_t* stream, int64_t n,
                      const int64_t* file_starts, int32_t F,
                      const uint32_t* masks, const uint32_t* vals, int32_t G,
                      OnGram&& on_gram, OnFileClose&& on_close,
-                     bool prefolded = false) {
+                     bool prefolded = false,
+                     const uint8_t* cls_blob = nullptr,
+                     const int32_t* cls_start = nullptr,
+                     const int32_t* cls_len = nullptr,
+                     const int32_t* cls_align = nullptr) {
     if (n < 4 || G <= 0 || F <= 0) return;
     std::vector<MaskGroup> groups = build_groups(masks, vals, G);
     const MaskGroup* gp = groups.data();
@@ -275,11 +314,25 @@ void scan_files_impl(const uint8_t* stream, int64_t n,
     // occurrence's resolution was dropped as a repeat.  on_close receives it
     // for walk-end trimming (engine/redfa.py).
     int64_t last_pass = -1;
-    uint32_t recent[4] = {0xFFFFFFFFu, 0xFFFFFFFFu, 0xFFFFFFFFu, 0xFFFFFFFFu};
+    uint32_t recent[8] = {0xFFFFFFFFu, 0xFFFFFFFFu, 0xFFFFFFFFu, 0xFFFFFFFFu,
+                          0xFFFFFFFFu, 0xFFFFFFFFu, 0xFFFFFFFFu, 0xFFFFFFFFu};
     int recent_at = 0;
     uint32_t seen_w[256];
     int32_t seen_file[256];
     for (int k = 0; k < 256; ++k) seen_file[k] = -1;
+    // Value -> gram-list cache (position- and file-independent: the group
+    // binary searches depend only on the window VALUE).  With the per-hit
+    // class confirm, windows whose grams fail confirm cannot enter the
+    // per-file seen table (the same value may confirm elsewhere), so their
+    // every occurrence re-resolves — this cache turns those repeats into
+    // one lookup + the early-exit confirm instead of the binary searches.
+    struct VCache {
+        uint32_t w;
+        int8_t n;  // matched gram count, -1 = empty slot, -2 = overflow
+        int32_t g[3];
+    };
+    std::vector<VCache> vcache(1024);
+    for (auto& e : vcache) e.n = -1;
     auto resolve = [&](int64_t i, uint32_t w) {
         const int32_t prev = cur;
         while (cur + 1 < F && i >= file_starts[cur + 1]) ++cur;
@@ -287,17 +340,12 @@ void scan_files_impl(const uint8_t* stream, int64_t n,
             on_close(prev, last_pass);
             last_pass = i;
             next_start = cur + 1 < F ? file_starts[cur + 1] : INT64_MAX;
-            recent[0] = recent[1] = recent[2] = recent[3] = 0xFFFFFFFFu;
+            for (int rk = 0; rk < 8; ++rk) recent[rk] = 0xFFFFFFFFu;
         } else {
             if (i > last_pass) last_pass = i;
             const uint32_t si0 = (w * kHashMul) >> 24;
             if (seen_file[si0] == cur && seen_w[si0] == w) return;
         }
-        const uint32_t si = (w * kHashMul) >> 24;
-        seen_w[si] = w;
-        seen_file[si] = cur;
-        recent[recent_at] = w;
-        recent_at = (recent_at + 1) & 3;
         // Exact resolution: binary search in each mask group's sorted value
         // range (duplicate (mask, val) grams from different probes share a
         // run).  The group's own membership table screens first — the tri
@@ -307,16 +355,67 @@ void scan_files_impl(const uint8_t* stream, int64_t n,
         // (A per-(file, masked-value) stamp-dedup table was tried here and
         // REGRESSED ~40%: the MB-scale stamp arrays evict the L1/L2-hot
         // bloom tables, costing more than the skipped binary searches.)
-        for (size_t k = 0; k < ngroups; ++k) {
-            const uint32_t x = w & gp[k].mask;
-            if (!table_probe(gp[k], x)) continue;
-            int32_t lo = gp[k].start, hi = gp[k].end;
-            while (lo < hi) {
-                const int32_t mid = (lo + hi) >> 1;
-                if (vals[mid] < x) lo = mid + 1; else hi = mid;
-            }
-            for (int32_t g = lo; g < gp[k].end && vals[g] == x; ++g)
+        bool pos_dep = false;  // a gram's confirm failed HERE — the same
+                               // window elsewhere may confirm, so its
+                               // resolution must not be cached/deduped
+        const uint32_t vi = (w * kHashMul) >> 22;
+        VCache& vc = vcache[vi];
+        if (vc.n >= 0 && vc.w == w) {
+            for (int8_t k = 0; k < vc.n; ++k) {
+                const int32_t g = vc.g[k];
+                if (cls_blob != nullptr) {
+                    const int cv = confirm_hit(stream, n, i, g, cls_blob,
+                                               cls_start, cls_len, cls_align);
+                    if (cv <= 0) {
+                        pos_dep |= cv == 0;
+                        continue;
+                    }
+                }
                 on_gram(cur, g, i);
+            }
+        } else {
+            int8_t cnt = 0;
+            int32_t gl[3];
+            for (size_t k = 0; k < ngroups; ++k) {
+                const uint32_t x = w & gp[k].mask;
+                if (!table_probe(gp[k], x)) continue;
+                int32_t lo = gp[k].start, hi = gp[k].end;
+                while (lo < hi) {
+                    const int32_t mid = (lo + hi) >> 1;
+                    if (vals[mid] < x) lo = mid + 1; else hi = mid;
+                }
+                for (int32_t g = lo; g < gp[k].end && vals[g] == x; ++g) {
+                    if (cnt >= 0) {
+                        if (cnt < 3) gl[cnt] = g;
+                        cnt = cnt < 3 ? (int8_t)(cnt + 1) : (int8_t)-2;
+                    }
+                    if (cls_blob != nullptr) {
+                        const int cv = confirm_hit(stream, n, i, g, cls_blob,
+                                                   cls_start, cls_len,
+                                                   cls_align);
+                        if (cv <= 0) {
+                            pos_dep |= cv == 0;
+                            continue;
+                        }
+                    }
+                    on_gram(cur, g, i);
+                }
+            }
+            if (cnt >= 0) {
+                vc.w = w;
+                vc.n = cnt;
+                for (int8_t k = 0; k < cnt; ++k) vc.g[k] = gl[k];
+            }
+        }
+        if (!pos_dep) {
+            // Position-independent outcome (every matched gram confirmed,
+            // or none matched at all): repeats of this window in this file
+            // are pure re-resolution — cache/dedup them.
+            const uint32_t si = (w * kHashMul) >> 24;
+            seen_w[si] = w;
+            seen_file[si] = cur;
+            recent[recent_at] = w;
+            recent_at = (recent_at + 1) & 7;
         }
     };
 
@@ -377,6 +476,10 @@ void scan_files_impl(const uint8_t* stream, int64_t n,
             m &= ~_mm512_cmpeq_epi32_mask(w, _mm512_set1_epi32((int32_t)recent[1]));
             m &= ~_mm512_cmpeq_epi32_mask(w, _mm512_set1_epi32((int32_t)recent[2]));
             m &= ~_mm512_cmpeq_epi32_mask(w, _mm512_set1_epi32((int32_t)recent[3]));
+            m &= ~_mm512_cmpeq_epi32_mask(w, _mm512_set1_epi32((int32_t)recent[4]));
+            m &= ~_mm512_cmpeq_epi32_mask(w, _mm512_set1_epi32((int32_t)recent[5]));
+            m &= ~_mm512_cmpeq_epi32_mask(w, _mm512_set1_epi32((int32_t)recent[6]));
+            m &= ~_mm512_cmpeq_epi32_mask(w, _mm512_set1_epi32((int32_t)recent[7]));
             if (m0 != m) {
                 // Dropped lanes are still screen passes of the open file:
                 // fold the highest into last_pass so walk-end trimming
@@ -568,6 +671,8 @@ int64_t gram_sieve_scan(const uint8_t* stream, int64_t n,
                         const int32_t* gate_ptr, const int32_t* gate_probes,
                         const int32_t* rule_conj_ptr, const int32_t* conj_ptr,
                         const int32_t* conj_probes, int32_t R,
+                        const uint8_t* cls_blob, const int32_t* cls_start,
+                        const int32_t* cls_len, const int32_t* cls_align,
                         int32_t* out_pairs, int64_t cap) {
     CandidateSink sink(
         file_starts, gram_window, W, window_probe, probe_n_windows, P,
@@ -576,7 +681,8 @@ int64_t gram_sieve_scan(const uint8_t* stream, int64_t n,
     scan_files_impl(
         stream, n, file_starts, F, masks, vals, G,
         [&](int32_t f, int32_t g, int64_t pos) { sink.on_gram(f, g, pos); },
-        [&](int32_t f, int64_t lp) { sink.on_close(f, lp); });
+        [&](int32_t f, int64_t lp) { sink.on_close(f, lp); },
+        /*prefolded=*/false, cls_blob, cls_start, cls_len, cls_align);
     return sink.found;
 }
 
@@ -594,6 +700,8 @@ int64_t gram_sieve_scan_files(
     const int32_t* gate_ptr, const int32_t* gate_probes,
     const int32_t* rule_conj_ptr, const int32_t* conj_ptr,
     const int32_t* conj_probes, int32_t R,
+    const uint8_t* cls_blob, const int32_t* cls_start,
+    const int32_t* cls_len, const int32_t* cls_align,
     int64_t* out_starts, int32_t* out_pairs, int64_t cap) {
     int64_t n = 0;
     const uint8_t* stream = fold_files(file_ptrs, lens, F, out_starts, &n);
@@ -605,7 +713,7 @@ int64_t gram_sieve_scan_files(
         stream, n, out_starts, F, masks, vals, G,
         [&](int32_t f, int32_t g, int64_t pos) { sink.on_gram(f, g, pos); },
         [&](int32_t f, int64_t lp) { sink.on_close(f, lp); },
-        /*prefolded=*/true);
+        /*prefolded=*/true, cls_blob, cls_start, cls_len, cls_align);
     return sink.found;
 }
 

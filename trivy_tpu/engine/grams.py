@@ -102,10 +102,13 @@ def _window_variants(plan: list[_Position]) -> list[tuple[int, int]]:
 
 def probe_gram_windows(
     classes: tuple[int, ...], max_windows: int = 2
-) -> list[list[tuple[int, int]]]:
+) -> list[tuple[int, list[tuple[int, int]]]]:
     """Select up to `max_windows` windows of the probe; each returns its
-    (mask, val) uint32 variants.  A probe occurrence fires EVERY selected
-    window (AND semantics across windows, OR across a window's variants).
+    (start, variants): the window's byte offset within the probe's class
+    sequence (the per-hit probe-class confirm in the C scan aligns with
+    it) and its (mask, val) uint32 variants.  A probe occurrence fires
+    EVERY selected window (AND semantics across windows, OR across a
+    window's variants).
 
     Single-window selection by letter-frequency score alone is fragile: the
     best-scored window of "atlassian" is "lass", a substring of "class",
@@ -157,13 +160,13 @@ def probe_gram_windows(
                     )
                 )
 
-    return [_window_variants(plan) for _score, _start, plan in chosen]
+    return [(start, _window_variants(plan)) for _score, start, plan in chosen]
 
 
 def probe_grams(classes: tuple[int, ...]) -> list[tuple[int, int]]:
     """Backward-compatible single-window form: the best window's variants."""
     windows = probe_gram_windows(classes, max_windows=1)
-    return windows[0] if windows else []
+    return windows[0][1] if windows else []
 
 
 @dataclass
@@ -180,6 +183,7 @@ class GramSet:
     gram_probe: np.ndarray  # [G] int32 — owning probe index
     gram_window: np.ndarray  # [G] int32 — owning window index
     window_probe: np.ndarray  # [W] int32 — window's probe index
+    window_start: np.ndarray  # [W] int32 — window offset within its probe
     probe_has_gram: np.ndarray  # [P] bool
     num_probes: int
     _wmember: np.ndarray = field(init=False, repr=False)  # [G, W] f32 0/1
@@ -240,6 +244,7 @@ def build_gram_set(pset: ProbeSet) -> GramSet:
     gram_probe: list[int] = []
     gram_window: list[int] = []
     window_probe: list[int] = []
+    window_start: list[int] = []
     has = np.zeros(len(pset.probes), dtype=bool)
 
     for p, probe in enumerate(pset.probes):
@@ -247,9 +252,10 @@ def build_gram_set(pset: ProbeSet) -> GramSet:
         if not windows:
             continue
         has[p] = True
-        for variants in windows:
+        for wstart, variants in windows:
             wid = len(window_probe)
             window_probe.append(p)
+            window_start.append(wstart)
             for mask, val in variants:
                 masks.append(mask)
                 vals.append(val)
@@ -274,6 +280,7 @@ def build_gram_set(pset: ProbeSet) -> GramSet:
         gram_probe=gram_probe_a,
         gram_window=gram_window_a,
         window_probe=np.array(window_probe, dtype=np.int32),
+        window_start=np.array(window_start, dtype=np.int32),
         probe_has_gram=has,
         num_probes=len(pset.probes),
     )

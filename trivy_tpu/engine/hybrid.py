@@ -111,29 +111,33 @@ def _link_is_wide() -> bool:
 
 def normalize_grams(
     masks: np.ndarray, vals: np.ndarray
-) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Strip leading masked-out bytes so byte 0 of every gram is kept, then
     sort by (mask, val) so mask groups are contiguous.
 
-    Returns (norm_masks, norm_vals, perm) with perm mapping sorted-normalized
-    index -> original gram index (callers scatter hits back with
-    ``orig[:, perm] = hits_norm``).  Anchoring at the first kept byte shifts
-    each gram's match position by the stripped prefix length — irrelevant for
-    per-file attribution, which the C++ sieve resolves by anchor position.
+    Returns (norm_masks, norm_vals, perm, strip) with perm mapping
+    sorted-normalized index -> original gram index (callers scatter hits
+    back with ``orig[:, perm] = hits_norm``) and strip[k] the stripped
+    prefix length of sorted-normalized gram k.  Anchoring at the first kept
+    byte shifts each gram's match position by the stripped prefix length —
+    per-file attribution resolves by anchor position, and the per-hit
+    probe-class confirm adds strip to the window's probe offset.
     """
     g = len(masks)
     if g == 0:
-        return masks, vals, np.zeros(0, dtype=np.int64)
+        return masks, vals, np.zeros(0, dtype=np.int64), np.zeros(0, np.int32)
     nm = masks.astype(np.uint64).copy()
     nv = vals.astype(np.uint64).copy()
+    strip = np.zeros(g, dtype=np.int32)
     for _ in range(3):
         shift = (nm != 0) & (nm & 0xFF == 0)
         nm[shift] >>= np.uint64(8)
         nv[shift] >>= np.uint64(8)
+        strip[shift] += 1
     nm = nm.astype(np.uint32)
     nv = nv.astype(np.uint32)
     perm = np.lexsort((nv, nm)).astype(np.int64)
-    return nm[perm], nv[perm], perm
+    return nm[perm], nv[perm], perm, strip[perm]
 
 
 class HybridSecretEngine(TpuSecretEngine):
@@ -151,6 +155,7 @@ class HybridSecretEngine(TpuSecretEngine):
         chunk_bytes: int = DEFAULT_CHUNK_BYTES,
         verify: str = "auto",
         mesh=None,
+        probe_confirm: bool = True,
     ):
         super().__init__(ruleset=ruleset, config=config, sieve="native")
         self.chunk_bytes = chunk_bytes
@@ -210,7 +215,9 @@ class HybridSecretEngine(TpuSecretEngine):
             self._norm_masks,
             self._norm_vals,
             self._norm_perm,
+            self._norm_strip,
         ) = normalize_grams(self.gset.masks, self.gset.vals)
+        self.probe_confirm = probe_confirm
         # Rules that are candidates even with zero gram hits (all their
         # gating probes are gram-less): resolved once on an all-zero row.
         zero = np.zeros((1, self.gset.num_grams), dtype=bool)
@@ -269,6 +276,58 @@ class HybridSecretEngine(TpuSecretEngine):
         self._rule_conj_ptr = np.array(rule_conj_ptr, dtype=np.int32)
         self._conj_ptr = np.array(conj_ptr, dtype=np.int32)
         self._conj_probes = np.array(conj_probes, dtype=np.int32)
+        self._build_confirm_tables()
+
+    def _build_confirm_tables(self) -> None:
+        """Per-hit probe-class confirm tables (gram_sieve.cpp confirm_hit):
+        each gram carries its probe's FULL class sequence as case-folded
+        256-bit membership bitmaps plus the gram anchor's offset within
+        that sequence.  The C scan rejects screen hits whose surrounding
+        bytes break the class sequence — the precision the LUT shift-AND
+        sieve has and coarse masked grams lack (a hex-class position is
+        unmaskable as a gram but one AND away as a bitmap; 'task_struct'
+        stops claiming twilio-api-key at byte 3)."""
+        g = len(self._norm_perm)
+        self._gram_cls_start = np.zeros(g, dtype=np.int32)
+        self._gram_cls_len = np.zeros(g, dtype=np.int32)
+        self._gram_align = np.zeros(g, dtype=np.int32)
+        self._cls_blob = np.zeros(0, dtype=np.uint8)
+        if not self.probe_confirm:
+            return
+        from trivy_tpu.engine.grams import fold_members
+
+        p_count = len(self.pset.probes)
+        cls_off = np.zeros(p_count, dtype=np.int32)
+        cls_len = np.zeros(p_count, dtype=np.int32)
+        blobs: list[np.ndarray] = []
+        total = 0
+        need = set(
+            int(self.gset.window_probe[self.gset.gram_window[orig]])
+            for orig in self._norm_perm
+        )
+        for p in range(p_count):
+            if p not in need:
+                continue
+            classes = self.pset.probes[p].classes
+            cls_off[p] = total
+            cls_len[p] = len(classes)
+            bmap = np.zeros((len(classes), 32), dtype=np.uint8)
+            for j, bs in enumerate(classes):
+                for fb in fold_members(bs):
+                    bmap[j, fb >> 3] |= 1 << (fb & 7)
+            blobs.append(bmap.reshape(-1))
+            total += len(classes)
+        self._cls_blob = (
+            np.concatenate(blobs) if blobs else np.zeros(0, dtype=np.uint8)
+        )
+        for k, orig in enumerate(self._norm_perm):
+            w = int(self.gset.gram_window[orig])
+            p = int(self.gset.window_probe[w])
+            self._gram_cls_start[k] = cls_off[p]
+            self._gram_cls_len[k] = cls_len[p]
+            self._gram_align[k] = (
+                int(self.gset.window_start[w]) + int(self._norm_strip[k])
+            )
 
     # ------------------------------------------------------------------
 
@@ -300,12 +359,12 @@ class HybridSecretEngine(TpuSecretEngine):
     def _sieve_chunk(self, contents: list[bytes]):
         """Run the fused native scan over the chunk's file buffers
         directly (gram_sieve_scan_files folds straight from them — no
-        packed-stream copy exists on this path).  Returns (pairs, stream,
-        starts, lens): verified candidate (file, rule) pairs [N, 2] int32
-        ordered by file then rule (the native scan's first/last hint
-        columns are consumed by the verify stage here and dropped);
-        `stream` is None — the DFA verify walks the ORIGINAL buffers via
-        the same pointer array."""
+        packed-stream copy exists on this path).  Returns (pairs,
+        dev_mask, ptr_arr, lens): UNVERIFIED candidate (file, rule,
+        first_hint, last_hint) quads [N, 4] int32 ordered by file then
+        rule, a bool[N] marking device-eligible lanes, and the pointer
+        array + lengths the verify stage walks (_finish_chunk runs the
+        host automaton verify; device lanes verify at end of scan)."""
         import ctypes
 
         from trivy_tpu.native import load_native
@@ -337,6 +396,10 @@ class HybridSecretEngine(TpuSecretEngine):
                 self._gate_ptr.ctypes.data, self._gate_probes.ctypes.data,
                 self._rule_conj_ptr.ctypes.data, self._conj_ptr.ctypes.data,
                 self._conj_probes.ctypes.data, len(self.pset.plans),
+                self._cls_blob.ctypes.data if self.probe_confirm else None,
+                self._gram_cls_start.ctypes.data,
+                self._gram_cls_len.ctypes.data,
+                self._gram_align.ctypes.data,
                 starts.ctypes.data,
                 out.ctypes.data, cap,
             )
@@ -351,25 +414,13 @@ class HybridSecretEngine(TpuSecretEngine):
             if self._nfa_verifier is not None
             else np.zeros(len(pairs), dtype=bool)
         )
-        host = ~dev
-        if self._dfa_verifier is not None and host.any():
-            # Automaton verify in the same worker over the ORIGINAL file
-            # buffers (case-sensitive rules must not see folded bytes).
-            # Columns 2/3 are the file's first/last screen-pass offsets —
-            # sound walk-start and walk-end trims for bounded rules.  With
-            # a device verifier present, only its pass-through lanes walk
-            # here; the rest verify on device in _finish_chunk.
-            t0 = time.perf_counter()
-            sub = pairs[host]
-            ok = self._dfa_verifier.verify_pairs_files(
-                ptr_arr, lens,
-                sub[:, 0], sub[:, 1], sub[:, 2], sub[:, 3],
-            )
-            keep = np.ones(len(pairs), dtype=bool)
-            keep[host] = ok.astype(bool)
-            pairs, dev = pairs[keep], dev[keep]
-            self.stats.verify_s += time.perf_counter() - t0
-        return pairs, dev
+        # The automaton verify runs in _finish_chunk on the MAIN thread
+        # (the ctypes call drops the GIL, so it overlaps the worker's
+        # sieve of the next chunk — on verify-heavy corpora this turns
+        # wall-clock from sieve+verify into max(sieve, verify+confirm)).
+        # ptr_arr/lens travel along: the verify walks the ORIGINAL file
+        # buffers (case-sensitive rules must not see folded bytes).
+        return pairs, dev, ptr_arr, lens
 
     def _chunks(self, items: list[tuple[str, bytes]]):
         """Split items into contiguous chunks of ~chunk_bytes."""
@@ -451,12 +502,29 @@ class HybridSecretEngine(TpuSecretEngine):
         items: list[tuple[str, bytes]],
         lo: int,
         hi: int,
-        sieved: tuple[np.ndarray, np.ndarray],
+        sieved: tuple[np.ndarray, np.ndarray, object, np.ndarray],
         results: list,
         allowed_pos: np.ndarray,
         dev_lanes: list[np.ndarray] | None = None,
     ) -> None:
-        scan_pairs, dev_mask = sieved
+        scan_pairs, dev_mask, ptr_arr, lens = sieved
+        host = ~dev_mask
+        if self._dfa_verifier is not None and host.any():
+            # Host automaton verify over the chunk's original buffers.
+            # Columns 2/3 are the file's first/last screen-pass offsets —
+            # sound walk-start and walk-end trims for bounded rules.  With
+            # a device verifier present, only its pass-through lanes walk
+            # here; the rest verify on device at end of scan.
+            t0 = time.perf_counter()
+            sub = scan_pairs[host]
+            ok = self._dfa_verifier.verify_pairs_files(
+                ptr_arr, lens,
+                sub[:, 0], sub[:, 1], sub[:, 2], sub[:, 3],
+            )
+            keep = np.ones(len(scan_pairs), dtype=bool)
+            keep[host] = ok.astype(bool)
+            scan_pairs, dev_mask = scan_pairs[keep], dev_mask[keep]
+            self.stats.verify_s += time.perf_counter() - t0
         dev_files: set[int] = set()
         if dev_mask.any():
             # Files with >= 1 device-destined lane defer entirely to the

@@ -104,6 +104,8 @@ def load_native() -> ctypes.CDLL | None:
                 ctypes.c_void_p, ctypes.c_void_p,          # gate CSR
                 ctypes.c_void_p, ctypes.c_void_p,          # conj CSR ptrs
                 ctypes.c_void_p, ctypes.c_int32,           # conj_probes, R
+                ctypes.c_void_p, ctypes.c_void_p,          # cls_blob, cls_start
+                ctypes.c_void_p, ctypes.c_void_p,          # cls_len, cls_align
                 ctypes.c_void_p, ctypes.c_int64,           # out_pairs, cap
             ]
             lib.gram_sieve_scan.restype = ctypes.c_int64
@@ -116,6 +118,8 @@ def load_native() -> ctypes.CDLL | None:
                 ctypes.c_void_p, ctypes.c_void_p,          # gate CSR
                 ctypes.c_void_p, ctypes.c_void_p,          # conj CSR ptrs
                 ctypes.c_void_p, ctypes.c_int32,           # conj_probes, R
+                ctypes.c_void_p, ctypes.c_void_p,          # cls_blob, cls_start
+                ctypes.c_void_p, ctypes.c_void_p,          # cls_len, cls_align
                 ctypes.c_void_p,                           # out_starts
                 ctypes.c_void_p, ctypes.c_int64,           # out_pairs, cap
             ]
