@@ -206,7 +206,10 @@ class TpuSecretEngine:
         return [-(-b // align) * align for b in caps]
 
     def warmup(self) -> None:
-        """Compile every row-bucket shape ahead of timed scanning."""
+        """Compile every row-bucket shape and build the host verifier
+        ahead of timed scanning (the DFA table build costs ~0.7s and must
+        not land inside the first scan)."""
+        self._host_verifier()
         if self.sieve == "native":
             from trivy_tpu.native import load_native
 
@@ -329,6 +332,50 @@ class TpuSecretEngine:
         self.stats.candidate_s += _time.perf_counter() - t0
         return cand
 
+    def _host_verifier(self):
+        """Lazily-built host automaton verifier (engine/redfa.py): the
+        same claim-killer the hybrid runs between its sieve and the
+        oracle.  The gram-level candidate matrix has no per-hit class
+        precision, so common-substring rules (twilio-api-key's 'SK')
+        claim broadly; one C walk per (file, rule) pair keeps those out
+        of the ~100us/pair Python oracle confirm."""
+        if not hasattr(self, "_dfa_verifier_cache"):
+            from trivy_tpu.native import load_native
+
+            self._dfa_verifier_cache = None
+            if load_native() is not None:
+                from trivy_tpu.engine.redfa import DfaVerifier
+
+                self._dfa_verifier_cache = DfaVerifier(self.ruleset.rules)
+        return self._dfa_verifier_cache
+
+    def _verify_candidates(
+        self, items: list[tuple[str, bytes]], cand: np.ndarray
+    ) -> np.ndarray:
+        """Drop candidate (file, rule) pairs the host automaton refutes."""
+        verifier = self._host_verifier()
+        if verifier is None:
+            return cand
+        import ctypes
+        import time as _time
+
+        t0 = _time.perf_counter()
+        fis, ris = np.nonzero(cand)
+        if len(fis):
+            contents = [c for _, c in items]
+            lens = np.fromiter(
+                (len(c) for c in contents), dtype=np.int64, count=len(items)
+            )
+            ptr_arr = (ctypes.c_char_p * len(items))(*contents)
+            ok = verifier.verify_pairs_files(
+                ptr_arr, lens,
+                fis.astype(np.int32), ris.astype(np.int32),
+            )
+            cand = cand.copy()
+            cand[fis[~ok.astype(bool)], ris[~ok.astype(bool)]] = False
+        self.stats.verify_s += _time.perf_counter() - t0
+        return cand
+
     def scan_batch(self, items: list[tuple[str, bytes]]) -> list[Secret]:
         """Scan (path, content) blobs; returns per-file Secret results."""
         import time as _time
@@ -339,6 +386,7 @@ class TpuSecretEngine:
         self.stats.bytes += sum(len(c) for _, c in items)
 
         cand = self._candidates([c for _, c in items])
+        cand = self._verify_candidates(items, cand)
 
         t0 = _time.perf_counter()
         results: list[Secret] = []
