@@ -440,3 +440,92 @@ def test_private_registry_basic_auth(registry):
         assert (o.username, o.password) == ("u", "p")
     finally:
         _FakeRegistry.require_token = False
+
+
+# --- containerd content-store source ---------------------------------------
+
+
+def _containerd_root(tmp_path, image_names, manifest, blobs, index=None):
+    """Build a containerd on-disk layout: meta.db (bolt_fixture writer) +
+    content-store blobs."""
+    from bolt_fixture import build_bolt
+
+    root = tmp_path / "containerd"
+    blob_dir = root / "io.containerd.content.v1.content" / "blobs" / "sha256"
+    blob_dir.mkdir(parents=True)
+    raw_manifest = json.dumps(manifest).encode()
+    mdigest = _digest(raw_manifest)
+    all_blobs = dict(blobs)
+    all_blobs[mdigest] = raw_manifest
+    target = mdigest
+    if index is not None:
+        raw_index = json.dumps(index(mdigest)).encode()
+        all_blobs[_digest(raw_index)] = raw_index
+        target = _digest(raw_index)
+    for digest, data in all_blobs.items():
+        (blob_dir / digest.split(":")[1]).write_bytes(data)
+    images = {
+        name.encode(): {b"target": {b"digest": target.encode()}}
+        for name in image_names
+    }
+    meta = {b"v1": {b"k8s.io": {b"images": images}}}
+    meta_dir = root / "io.containerd.metadata.v1.bolt"
+    meta_dir.mkdir(parents=True)
+    (meta_dir / "meta.db").write_bytes(build_bolt(meta))
+    return str(root)
+
+
+def test_containerd_source(tmp_path):
+    from trivy_tpu.image.containerd import containerd_image
+
+    manifest, blobs = _fake_image()
+    root = _containerd_root(
+        tmp_path, ["docker.io/library/testapp:1.0"], manifest, blobs
+    )
+    src = containerd_image("testapp:1.0", root=root)
+    assert len(src.diff_ids) == 2
+    with src.layers[0]() as f:
+        names = tarfile.open(fileobj=f, mode="r:*").getnames()
+    assert names == ["etc/base.conf"]
+    assert src.repo_tags == ["docker.io/library/testapp:1.0"]
+
+
+def test_containerd_source_index_and_chain(tmp_path, monkeypatch):
+    """Multi-arch index resolution + the resolve_image chain picking the
+    containerd hop via CONTAINERD_ROOT."""
+    manifest, blobs = _fake_image()
+
+    def index(mdigest):
+        return {
+            "schemaVersion": 2,
+            "mediaType": "application/vnd.oci.image.index.v1+json",
+            "manifests": [
+                {
+                    "digest": "sha256:" + "0" * 64,
+                    "platform": {"os": "linux", "architecture": "arm64"},
+                },
+                {
+                    "digest": mdigest,
+                    "platform": {"os": "linux", "architecture": "amd64"},
+                },
+            ],
+        }
+
+    root = _containerd_root(
+        tmp_path, ["ghcr.io/org/app:2"], manifest, blobs, index=index
+    )
+    monkeypatch.setenv("CONTAINERD_ROOT", root)
+    src = resolve_image("ghcr.io/org/app:2")
+    assert len(src.diff_ids) == 2
+
+
+def test_containerd_missing_blob_is_source_unavailable(tmp_path):
+    from trivy_tpu.image.containerd import containerd_image
+    from trivy_tpu.image.daemon import SourceUnavailable
+
+    manifest, blobs = _fake_image()
+    blobs = dict(blobs)
+    blobs.pop(manifest["layers"][1]["digest"])  # damage the store
+    root = _containerd_root(tmp_path, ["docker.io/library/x:1"], manifest, blobs)
+    with pytest.raises(SourceUnavailable):
+        containerd_image("x:1", root=root)

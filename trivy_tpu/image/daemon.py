@@ -1,11 +1,11 @@
-"""Container-runtime daemon image sources (docker / podman).
+"""Container-runtime daemon image sources (docker / podman / containerd).
 
 The local end of the reference's resolution chain
-(pkg/fanal/image/daemon.go:12,24,35): ask a running engine to export the
-image as a docker-save archive over its HTTP-over-unix-socket API, then
-parse it with the existing archive loader.  containerd's API is gRPC and is
-not spoken here; the chain reports it unavailable and moves on, exactly how
-the reference degrades when a runtime is absent.
+(pkg/fanal/image/daemon.go:12,24,35): docker and podman export the image
+as a docker-save archive over their HTTP-over-unix-socket APIs, parsed by
+the existing archive loader; containerd resolves through its on-disk
+content store + boltdb metadata directly (image/containerd.py) — no gRPC
+needed for the read-only case.
 """
 
 from __future__ import annotations
@@ -105,6 +105,6 @@ def podman_image(image_ref: str):
 
 
 def containerd_image(image_ref: str):
-    raise SourceUnavailable(
-        "containerd requires gRPC; not supported by this build"
-    )
+    from trivy_tpu.image.containerd import containerd_image as _impl
+
+    return _impl(image_ref)

@@ -50,6 +50,26 @@ class RegistryError(RuntimeError):
     pass
 
 
+def pick_platform(
+    index: dict, os_name: str, arch: str, error_cls=RuntimeError
+) -> dict:
+    """Select the index entry for (os, arch), falling back to the first
+    entry when none matches exactly — shared by the registry and
+    containerd sources so platform-selection quirks stay in one place."""
+    best = None
+    for desc in index.get("manifests", []):
+        plat = desc.get("platform") or {}
+        if (
+            plat.get("os", os_name) == os_name
+            and plat.get("architecture", arch) == arch
+        ):
+            return desc
+        best = best or desc
+    if best is None:
+        raise error_cls("empty manifest index")
+    return best
+
+
 @dataclass
 class Reference:
     """A parsed image reference (registry/repository:tag@digest)."""
@@ -198,19 +218,9 @@ class RegistryClient:
         return manifest, raw
 
     def _pick_platform(self, index: dict) -> dict:
-        best = None
-        for desc in index.get("manifests", []):
-            plat = desc.get("platform") or {}
-            if (
-                plat.get("os", self.platform_os) == self.platform_os
-                and plat.get("architecture", self.platform_arch)
-                == self.platform_arch
-            ):
-                return desc
-            best = best or desc
-        if best is None:
-            raise RegistryError("registry: empty manifest index")
-        return best
+        return pick_platform(
+            index, self.platform_os, self.platform_arch, RegistryError
+        )
 
     def get_blob(self, ref: Reference, digest: str, _retried: bool = False):
         """Stream a blob into a spooled temp file; returns the open file
