@@ -58,6 +58,35 @@ STANDALONE_POD = {
 }
 
 
+WILDCARD_ROLE = {
+    "apiVersion": "rbac.authorization.k8s.io/v1",
+    "kind": "ClusterRole",
+    "metadata": {"name": "god-mode"},
+    "rules": [{"apiGroups": ["*"], "resources": ["*"], "verbs": ["*"]}],
+}
+
+SECRETS_ROLE = {
+    "apiVersion": "rbac.authorization.k8s.io/v1",
+    "kind": "Role",
+    "metadata": {"name": "secret-editor", "namespace": "prod"},
+    "rules": [
+        {"apiGroups": [""], "resources": ["secrets"], "verbs": ["update"]}
+    ],
+}
+
+ADMIN_BINDING = {
+    "apiVersion": "rbac.authorization.k8s.io/v1",
+    "kind": "ClusterRoleBinding",
+    "metadata": {"name": "everyone-is-admin"},
+    "roleRef": {
+        "apiGroup": "rbac.authorization.k8s.io",
+        "kind": "ClusterRole",
+        "name": "cluster-admin",
+    },
+    "subjects": [{"kind": "Group", "name": "system:authenticated"}],
+}
+
+
 class _FakeAPI(BaseHTTPRequestHandler):
     token = "sekret-token"
     seen_auth: list = []
@@ -103,6 +132,18 @@ class _FakeAPI(BaseHTTPRequestHandler):
             items = [OWNED_POD]
         elif self.path.startswith("/apis/apps/v1/namespaces/prod/deployments"):
             items = [PRIVILEGED_DEPLOY]
+        elif self.path == "/apis/rbac.authorization.k8s.io/v1/clusterroles":
+            items = [WILDCARD_ROLE]
+        elif self.path == \
+                "/apis/rbac.authorization.k8s.io/v1/clusterrolebindings":
+            items = [ADMIN_BINDING]
+        elif "rolebindings" in self.path:  # before the roles prefix match
+            items = []
+        elif self.path in (
+            "/apis/rbac.authorization.k8s.io/v1/roles",
+            "/apis/rbac.authorization.k8s.io/v1/namespaces/prod/roles",
+        ):
+            items = [SECRETS_ROLE]
         elif "replicasets" in self.path or "statefulsets" in self.path or \
                 "daemonsets" in self.path or "jobs" in self.path or \
                 "cronjobs" in self.path:
@@ -320,3 +361,64 @@ def test_kbom_os_image_multiword():
     assert _split_os_image("Ubuntu 22.04.3 LTS") == ("ubuntu", "22.04.3 LTS")
     assert _split_os_image("Amazon Linux 2") == ("amazon linux", "2")
     assert _split_os_image("Bottlerocket") == ("bottlerocket", "")
+
+
+def test_rbac_enumeration_and_scan(tmp_path, api_server):
+    """--scanners rbac: RBAC kinds enumerate, risky rules produce
+    misconfigurations, and the report splits them into RBACAssessment
+    (report.go:147-201 semantics)."""
+    from trivy_tpu.k8s.client import select_kinds
+
+    auth = load_kubeconfig(_write_kubeconfig(tmp_path, api_server))
+    kinds = select_kinds([], rbac=True)
+    resources = KubeClient(auth).list_workloads(kinds=kinds)
+    rbac_kinds = {r["kind"] for r in resources} & {
+        "Role", "ClusterRole", "ClusterRoleBinding"
+    }
+    assert rbac_kinds == {"Role", "ClusterRole", "ClusterRoleBinding"}
+    report = K8sScanner(scanners=["rbac"]).scan(resources, "c")
+    by_name = {}
+    for res in report.resources:
+        ids = {
+            m.check_id
+            for r in res.results
+            for m in getattr(r, "misconfigurations", []) or []
+        }
+        by_name[res.name] = ids
+    assert "KSV044" in by_name.get("god-mode", set())
+    assert "KSV041" in by_name.get("secret-editor", set())
+    assert "KSV111" in by_name.get("everyone-is-admin", set())
+    # workload rows carry no results under the rbac-only scanner
+    doc = report.to_json(full=True)
+    assert {r["Name"] for r in doc["RBACAssessment"]} == {
+        "god-mode", "secret-editor", "everyone-is-admin"
+    }
+    assert all(
+        r["Name"] not in ("god-mode", "secret-editor", "everyone-is-admin")
+        for r in doc["Resources"]
+    )
+
+
+def test_include_kinds_filter(tmp_path, api_server):
+    """--include-kinds restricts enumeration to the named kinds; unknown
+    kinds are a loud config error."""
+    from trivy_tpu.k8s.client import KubeConfigError, select_kinds
+
+    auth = load_kubeconfig(_write_kubeconfig(tmp_path, api_server))
+    kinds = select_kinds(["clusterrole", "Pod"], rbac=False)
+    resources = KubeClient(auth).list_workloads(kinds=kinds)
+    assert {r["kind"] for r in resources} == {"Pod", "ClusterRole"}
+    with pytest.raises(KubeConfigError):
+        select_kinds(["Gateway"], rbac=False)
+
+
+def test_namespace_scope_keeps_cluster_scoped_rbac(tmp_path, api_server):
+    """A namespace-scoped scan still lists ClusterRole/ClusterRoleBinding
+    at cluster scope (they have no namespaced collection)."""
+    from trivy_tpu.k8s.client import select_kinds
+
+    auth = load_kubeconfig(_write_kubeconfig(tmp_path, api_server))
+    kinds = select_kinds([], rbac=True)
+    resources = KubeClient(auth).list_workloads(namespace="prod", kinds=kinds)
+    kinds_seen = {r["kind"] for r in resources}
+    assert "ClusterRole" in kinds_seen and "Role" in kinds_seen

@@ -413,13 +413,21 @@ def _k8s_command(args) -> int:
                 _json.dump(doc, sys.stdout, indent=2)
                 print()
             return 0
+        from trivy_tpu.k8s.client import select_kinds
+
+        scanners = [s for s in args.scanners.split(",") if s]
+        kinds = select_kinds(
+            [k for k in args.include_kinds.split(",") if k],
+            rbac="rbac" in scanners,
+            workloads=bool({"misconfig", "vuln", "secret"} & set(scanners)),
+        )
         namespace = "" if args.k8s_target == "cluster" else args.k8s_target
-        resources = client.list_workloads(namespace=namespace)
+        resources = client.list_workloads(namespace=namespace, kinds=kinds)
     except KubeConfigError as e:
         print(f"trivy-tpu: {e}", file=sys.stderr)
         return 2
     scanner = K8sScanner(
-        scanners=[s for s in args.scanners.split(",") if s],
+        scanners=scanners,
         insecure_registry=args.insecure,
         db_dir=args.db_dir,
     )
@@ -570,7 +578,13 @@ def build_parser() -> argparse.ArgumentParser:
     p_k8s.add_argument("--context", default="")
     p_k8s.add_argument(
         "--scanners", default=_env_default("scanners", "misconfig"),
-        help="comma-separated: misconfig,vuln,secret",
+        help="comma-separated: misconfig,vuln,secret,rbac",
+    )
+    p_k8s.add_argument(
+        "--include-kinds", default=_env_default("include-kinds", ""),
+        help="comma-separated kind names to enumerate (Pod, Deployment, "
+             "Role, ClusterRoleBinding, ...); default: workloads, plus "
+             "RBAC kinds when the rbac scanner is enabled",
     )
     p_k8s.add_argument("-f", "--format", default=_env_default("format", "table"))
     p_k8s.add_argument("-o", "--output", default="")

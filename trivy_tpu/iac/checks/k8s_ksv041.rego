@@ -1,0 +1,25 @@
+# METADATA
+# title: Role permits management of secrets
+# custom:
+#   id: KSV041
+#   severity: CRITICAL
+#   recommended_action: Remove secrets from the role's resources, or restrict verbs to get on named secrets.
+package builtin.kubernetes.KSV041
+
+rbac_kind {
+    input.kind == "Role"
+}
+
+rbac_kind {
+    input.kind == "ClusterRole"
+}
+
+manage_verbs := ["create", "update", "patch", "delete", "deletecollection", "impersonate", "*"]
+
+deny[res] {
+    rbac_kind
+    rule := input.rules[_]
+    rule.resources[_] == "secrets"
+    rule.verbs[_] == manage_verbs[_]
+    res := result.new(sprintf("%s %q permits managing secrets", [input.kind, input.metadata.name]), rule)
+}

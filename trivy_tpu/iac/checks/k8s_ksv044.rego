@@ -1,0 +1,23 @@
+# METADATA
+# title: Role permits wildcard verb on wildcard resource
+# custom:
+#   id: KSV044
+#   severity: CRITICAL
+#   recommended_action: Enumerate the verbs and resources the role actually needs instead of '*'.
+package builtin.kubernetes.KSV044
+
+rbac_kind {
+    input.kind == "Role"
+}
+
+rbac_kind {
+    input.kind == "ClusterRole"
+}
+
+deny[res] {
+    rbac_kind
+    rule := input.rules[_]
+    rule.verbs[_] == "*"
+    rule.resources[_] == "*"
+    res := result.new(sprintf("%s %q permits all verbs on all resources", [input.kind, input.metadata.name]), rule)
+}

@@ -1,0 +1,21 @@
+# METADATA
+# title: Binding grants the cluster-admin role
+# custom:
+#   id: KSV111
+#   severity: CRITICAL
+#   recommended_action: Bind a narrowly-scoped role instead of cluster-admin.
+package builtin.kubernetes.KSV111
+
+binding_kind {
+    input.kind == "RoleBinding"
+}
+
+binding_kind {
+    input.kind == "ClusterRoleBinding"
+}
+
+deny[res] {
+    binding_kind
+    input.roleRef.name == "cluster-admin"
+    res := result.new(sprintf("%s %q binds cluster-admin", [input.kind, input.metadata.name]), input.roleRef)
+}

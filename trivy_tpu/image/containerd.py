@@ -152,7 +152,10 @@ def containerd_image(
                     f"containerd content store missing layer {ldigest}"
                 )
             layers.append(lambda d=ldigest: _open_blob(root, d))
-    except (KeyError, ValueError) as e:
+    except (KeyError, ValueError, TypeError, AttributeError) as e:
+        # TypeError/AttributeError cover blobs whose JSON parses to a
+        # non-dict (store corruption, digest reassigned to a non-manifest
+        # artifact) — still "this source can't serve it", not a crash.
         raise SourceUnavailable(
             f"containerd: unusable image metadata for {resolved!r}: "
             f"{type(e).__name__}: {e}"

@@ -4,7 +4,14 @@ The reference rides the trivy-kubernetes library; this client speaks the
 API directly with stdlib HTTP: kubeconfig contexts resolve to (server,
 auth) where auth is a bearer token, basic credentials, or client
 certificates (an mTLS ssl context).  Enumerated kinds mirror the
-reference's artifact list (workloads first; RBAC via --include-kinds).
+reference's artifact list: workloads always, RBAC resources
+(Role/RoleBinding/ClusterRole/ClusterRoleBinding) when the rbac scanner
+is on or ``--include-kinds`` names them (pkg/k8s/commands/cluster.go).
+
+Divergence: the reference's node-collector job (a privileged pod it
+schedules to collect kubelet/node file facts) is NOT implemented — this
+build never mutates the cluster; node inventory comes read-only from the
+KBOM path (k8s/kbom.py nodeInfo).
 """
 
 from __future__ import annotations
@@ -30,6 +37,41 @@ WORKLOAD_KINDS = (
     ("Job", "/apis/batch/v1", "jobs"),
     ("CronJob", "/apis/batch/v1", "cronjobs"),
 )
+
+_RBAC_API = "/apis/rbac.authorization.k8s.io/v1"
+RBAC_KINDS = (
+    # (kind, api path, collection); ClusterRole(Binding) are cluster-scoped
+    ("Role", _RBAC_API, "roles"),
+    ("RoleBinding", _RBAC_API, "rolebindings"),
+    ("ClusterRole", _RBAC_API, "clusterroles"),
+    ("ClusterRoleBinding", _RBAC_API, "clusterrolebindings"),
+)
+_CLUSTER_SCOPED = {"ClusterRole", "ClusterRoleBinding"}
+
+
+def select_kinds(
+    include_kinds: list[str] | None, rbac: bool, workloads: bool = True
+):
+    """Resolve the enumerated kind tuples from ``--include-kinds`` (kind
+    names, case-insensitive).  Empty: workload kinds when any workload
+    scanner is active, RBAC kinds when the rbac scanner is on — an
+    rbac-only scan must not list every pod in a large cluster just to
+    print guaranteed-empty rows."""
+    universe = WORKLOAD_KINDS + RBAC_KINDS
+    if include_kinds:
+        wanted = {k.strip().lower() for k in include_kinds if k.strip()}
+        unknown = wanted - {k.lower() for k, _a, _c in universe}
+        if unknown:
+            raise KubeConfigError(
+                f"--include-kinds: unknown kinds {sorted(unknown)}"
+            )
+        return tuple(t for t in universe if t[0].lower() in wanted)
+    out: tuple = ()
+    if workloads:
+        out += WORKLOAD_KINDS
+    if rbac:
+        out += RBAC_KINDS
+    return out
 
 
 class KubeConfigError(RuntimeError):
@@ -172,11 +214,13 @@ class KubeClient:
     def list_workloads(
         self, namespace: str = "", kinds: tuple = WORKLOAD_KINDS
     ) -> list[dict]:
-        """All workload resources (cluster-wide or one namespace), each a
-        full resource dict with kind/metadata/spec."""
+        """All resources of `kinds` (cluster-wide or one namespace), each
+        a full resource dict with kind/metadata/spec.  Cluster-scoped
+        kinds (ClusterRole/ClusterRoleBinding) always enumerate at the
+        cluster level — a namespace filter cannot apply to them."""
         out: list[dict] = []
         for kind, api, collection in kinds:
-            if namespace:
+            if namespace and kind not in _CLUSTER_SCOPED:
                 path = f"{api}/namespaces/{namespace}/{collection}"
             else:
                 path = f"{api}/{collection}"

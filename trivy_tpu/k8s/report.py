@@ -55,17 +55,36 @@ class K8sResource:
         return out
 
 
+RBAC_RESOURCE_KINDS = frozenset(
+    {"Role", "RoleBinding", "ClusterRole", "ClusterRoleBinding"}
+)
+
+
+def rbac_resource(res: "K8sResource") -> bool:
+    """The reference's rbacResource split (pkg/k8s/report/report.go:201):
+    RBAC kinds report under a separate 'RBAC Assessment' section."""
+    return res.kind in RBAC_RESOURCE_KINDS
+
+
 @dataclass
 class K8sReport:
     cluster_name: str = ""
     resources: list[K8sResource] = field(default_factory=list)
 
     def to_json(self, full: bool = False) -> dict:
-        return {
+        out = {
             "SchemaVersion": 2,
             "ClusterName": self.cluster_name,
-            "Resources": [r.to_json(full) for r in self.resources],
+            "Resources": [
+                r.to_json(full)
+                for r in self.resources
+                if not rbac_resource(r)
+            ],
         }
+        rbac = [r.to_json(full) for r in self.resources if rbac_resource(r)]
+        if rbac:
+            out["RBACAssessment"] = rbac
+        return out
 
 
 def write_k8s_report(
@@ -77,26 +96,41 @@ def write_k8s_report(
         out.write("\n")
         return
     out.write(f"\nCluster: {report.cluster_name or '(unnamed)'}\n")
-    header = (
-        f"{'Namespace':12} {'Kind':12} {'Name':28} "
-        f"{'Vuln C/H/M/L':14} {'Misconf C/H/M/L':16} {'Secrets':8}\n"
-    )
-    out.write(header)
-    out.write("-" * len(header) + "\n")
-    for res in report.resources:
-        counts = res.counts()
 
-        def fmt4(klass: str) -> str:
-            c = counts.get(klass, {})
-            return "/".join(
-                str(c.get(s, 0)) for s in ("CRITICAL", "HIGH", "MEDIUM", "LOW")
-            )
-
-        secrets = sum(counts.get("Secrets", {}).values())
-        out.write(
-            f"{res.namespace:12} {res.kind:12} {res.name:28} "
-            f"{fmt4('Vulnerabilities'):14} {fmt4('Misconfigurations'):16} "
-            f"{secrets:<8}\n"
+    def write_rows(resources, title):
+        if not resources:
+            return
+        out.write(f"\n{title}\n")
+        header = (
+            f"{'Namespace':12} {'Kind':12} {'Name':28} "
+            f"{'Vuln C/H/M/L':14} {'Misconf C/H/M/L':16} {'Secrets':8}\n"
         )
-        if res.error:
-            out.write(f"    error: {res.error}\n")
+        out.write(header)
+        out.write("-" * len(header) + "\n")
+        for res in resources:
+            counts = res.counts()
+
+            def fmt4(klass: str) -> str:
+                c = counts.get(klass, {})
+                return "/".join(
+                    str(c.get(s, 0))
+                    for s in ("CRITICAL", "HIGH", "MEDIUM", "LOW")
+                )
+
+            secrets = sum(counts.get("Secrets", {}).values())
+            out.write(
+                f"{res.namespace:12} {res.kind:12} {res.name:28} "
+                f"{fmt4('Vulnerabilities'):14} {fmt4('Misconfigurations'):16} "
+                f"{secrets:<8}\n"
+            )
+            if res.error:
+                out.write(f"    error: {res.error}\n")
+
+    write_rows(
+        [r for r in report.resources if not rbac_resource(r)],
+        "Workload Assessment",
+    )
+    write_rows(
+        [r for r in report.resources if rbac_resource(r)],
+        "RBAC Assessment",
+    )

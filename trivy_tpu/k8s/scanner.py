@@ -79,9 +79,14 @@ class K8sScanner:
                 name=meta.get("name", ""),
             )
             try:
-                if "misconfig" in self.scanners:
+                from trivy_tpu.k8s.report import RBAC_RESOURCE_KINDS
+
+                is_rbac = res.kind in RBAC_RESOURCE_KINDS
+                if ("misconfig" in self.scanners) or (
+                    is_rbac and "rbac" in self.scanners
+                ):
                     res.results.extend(self._scan_manifest(resource))
-                if {"vuln", "secret"} & set(self.scanners):
+                if not is_rbac and {"vuln", "secret"} & set(self.scanners):
                     for image in _images_of(resource):
                         res.results.extend(
                             self._scan_image(image, scanned_images)
