@@ -1,0 +1,27 @@
+# METADATA
+# title: ":latest tag used"
+# custom:
+#   id: DS001
+#   avd_id: AVD-DS-0001
+#   severity: MEDIUM
+#   recommended_action: "Pin the image version."
+#   input:
+#     selector:
+#     - type: dockerfile
+package builtin.dockerfile.DS001
+
+import data.lib.docker
+
+image_tag(image) = tag {
+    parts := split(image, ":")
+    count(parts) > 1
+    tag := parts[count(parts) - 1]
+} else = "latest"
+
+deny[res] {
+    instruction := docker.from[_]
+    image := instruction.Value[0]
+    image != "scratch"
+    image_tag(image) == "latest"
+    res := result.new(sprintf("Specify a tag in the image reference %q", [image]), instruction)
+}

@@ -1,0 +1,19 @@
+# METADATA
+# title: "Port 22 exposed"
+# custom:
+#   id: DS004
+#   avd_id: AVD-DS-0004
+#   severity: MEDIUM
+#   recommended_action: "Do not expose port 22."
+#   input:
+#     selector:
+#     - type: dockerfile
+package builtin.dockerfile.DS004
+
+deny[res] {
+    instruction := input.Stages[_].Commands[_]
+    instruction.Cmd == "expose"
+    port := instruction.Value[_]
+    split(port, "/")[0] == "22"
+    res := result.new("Do not expose port 22 (SSH)", instruction)
+}

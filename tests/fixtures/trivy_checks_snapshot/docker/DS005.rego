@@ -1,0 +1,27 @@
+# METADATA
+# title: "ADD used instead of COPY"
+# custom:
+#   id: DS005
+#   avd_id: AVD-DS-0005
+#   severity: LOW
+#   recommended_action: "Use COPY instead of ADD unless extraction is required."
+#   input:
+#     selector:
+#     - type: dockerfile
+package builtin.dockerfile.DS005
+
+import rego.v1
+import data.lib.docker
+
+is_archive(path) if {
+    some suffix in [".tar", ".tar.gz", ".tgz", ".tar.bz2"]
+    endswith(path, suffix)
+}
+
+deny contains res if {
+    some instruction in docker.add
+    src := instruction.Value[0]
+    not is_archive(src)
+    not startswith(src, "http")
+    res := result.new(sprintf("Use COPY instead of ADD for %q", [src]), instruction)
+}

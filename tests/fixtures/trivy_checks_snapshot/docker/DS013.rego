@@ -1,0 +1,20 @@
+# METADATA
+# title: "WORKDIR path not absolute"
+# custom:
+#   id: DS013
+#   avd_id: AVD-DS-0013
+#   severity: HIGH
+#   recommended_action: "Use an absolute WORKDIR path."
+#   input:
+#     selector:
+#     - type: dockerfile
+package builtin.dockerfile.DS013
+
+deny[res] {
+    instruction := input.Stages[_].Commands[_]
+    instruction.Cmd == "workdir"
+    path := instruction.Value[0]
+    not startswith(path, "/")
+    not contains(path, "$")
+    res := result.new(sprintf("WORKDIR path %q should be absolute", [path]), instruction)
+}

@@ -1,0 +1,22 @@
+# METADATA
+# title: "apk add without --no-cache"
+# custom:
+#   id: DS025
+#   avd_id: AVD-DS-0025
+#   severity: HIGH
+#   recommended_action: "Add --no-cache to apk add."
+#   input:
+#     selector:
+#     - type: dockerfile
+package builtin.dockerfile.DS025
+
+import rego.v1
+import data.lib.docker
+
+deny contains res if {
+    some instruction in docker.run
+    cmd := concat(" ", instruction.Value)
+    contains(cmd, "apk add")
+    not contains(cmd, "--no-cache")
+    res := result.new("Add '--no-cache' to 'apk add'", instruction)
+}

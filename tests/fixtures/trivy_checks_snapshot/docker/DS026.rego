@@ -1,0 +1,20 @@
+# METADATA
+# title: "No HEALTHCHECK defined"
+# custom:
+#   id: DS026
+#   avd_id: AVD-DS-0026
+#   severity: LOW
+#   recommended_action: "Add a HEALTHCHECK instruction."
+#   input:
+#     selector:
+#     - type: dockerfile
+package builtin.dockerfile.DS026
+
+has_healthcheck {
+    input.Stages[_].Commands[_].Cmd == "healthcheck"
+}
+
+deny[res] {
+    not has_healthcheck
+    res := result.new("Add a HEALTHCHECK instruction to verify container health", {})
+}

@@ -1,0 +1,25 @@
+# METADATA
+# title: "Default capabilities: some containers do not drop all"
+# custom:
+#   id: KSV003
+#   avd_id: AVD-KSV-0003
+#   severity: LOW
+#   recommended_action: "Add 'ALL' to 'containers[].securityContext.capabilities.drop'."
+#   input:
+#     selector:
+#     - type: kubernetes
+package builtin.kubernetes.KSV003
+
+import data.lib.kubernetes
+
+has_drop_all(container) {
+    caps := container.securityContext.capabilities.drop
+    lower(caps[_]) == "all"
+}
+
+deny[res] {
+    container := kubernetes.containers[_]
+    not has_drop_all(container)
+    msg := sprintf("Container %q of %s %q should add 'ALL' to 'securityContext.capabilities.drop'", [object.get(container, "name", "?"), kubernetes.kind, kubernetes.name])
+    res := result.new(msg, container)
+}

@@ -1,0 +1,19 @@
+# METADATA
+# title: "Access to host network"
+# custom:
+#   id: KSV009
+#   avd_id: AVD-KSV-0009
+#   severity: HIGH
+#   recommended_action: "Do not set 'spec.hostNetwork' to true."
+#   input:
+#     selector:
+#     - type: kubernetes
+package builtin.kubernetes.KSV009
+
+import data.lib.kubernetes
+
+deny[res] {
+    kubernetes.pod_spec.hostNetwork == true
+    msg := sprintf("%s %q should not set 'spec.hostNetwork' to true", [kubernetes.kind, kubernetes.name])
+    res := result.new(msg, kubernetes.pod_spec)
+}

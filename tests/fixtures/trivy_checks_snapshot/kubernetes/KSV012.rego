@@ -1,0 +1,31 @@
+# METADATA
+# title: "Runs as root user"
+# description: "'runAsNonRoot' forces the running image to run as a non-root user to ensure least privileges."
+# scope: package
+# schemas:
+# - input: schema["kubernetes"]
+# related_resources:
+# - https://kubesec.io/basics/containers-securitycontext-runasnonroot-true/
+# custom:
+#   id: KSV012
+#   avd_id: AVD-KSV-0012
+#   severity: MEDIUM
+#   short_code: no-root
+#   recommended_action: "Set 'containers[].securityContext.runAsNonRoot' to true."
+#   input:
+#     selector:
+#     - type: kubernetes
+package builtin.kubernetes.KSV012
+
+import data.lib.kubernetes
+
+fails_non_root(container) {
+    not container.securityContext.runAsNonRoot == true
+}
+
+deny[res] {
+    container := kubernetes.containers[_]
+    fails_non_root(container)
+    msg := kubernetes.format(sprintf("Container %q of %s %q should set 'securityContext.runAsNonRoot' to true", [object.get(container, "name", "?"), kubernetes.kind, kubernetes.name]))
+    res := result.new(msg, container)
+}

@@ -1,0 +1,22 @@
+# METADATA
+# title: "Privileged container"
+# description: "Privileged containers share namespaces with the host system."
+# custom:
+#   id: KSV017
+#   avd_id: AVD-KSV-0017
+#   severity: HIGH
+#   short_code: no-privileged-containers
+#   recommended_action: "Change 'containers[].securityContext.privileged' to 'false'."
+#   input:
+#     selector:
+#     - type: kubernetes
+package builtin.kubernetes.KSV017
+
+import data.lib.kubernetes
+
+deny[res] {
+    container := kubernetes.containers[_]
+    kubernetes.is_privileged(container)
+    msg := sprintf("Container %q of %s %q should set 'securityContext.privileged' to false", [object.get(container, "name", "?"), kubernetes.kind, kubernetes.name])
+    res := result.new(msg, container)
+}

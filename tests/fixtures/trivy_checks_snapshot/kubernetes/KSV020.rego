@@ -1,0 +1,20 @@
+# METADATA
+# title: "Runs with a low user ID"
+# custom:
+#   id: KSV020
+#   avd_id: AVD-KSV-0020
+#   severity: MEDIUM
+#   recommended_action: "Set 'containers[].securityContext.runAsUser' to a value >= 10000."
+#   input:
+#     selector:
+#     - type: kubernetes
+package builtin.kubernetes.KSV020
+
+import data.lib.kubernetes
+
+deny[res] {
+    container := kubernetes.containers[_]
+    container.securityContext.runAsUser < 10000
+    msg := sprintf("Container %q of %s %q should set 'securityContext.runAsUser' >= 10000", [object.get(container, "name", "?"), kubernetes.kind, kubernetes.name])
+    res := result.new(msg, container)
+}

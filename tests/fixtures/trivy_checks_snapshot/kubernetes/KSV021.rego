@@ -1,0 +1,22 @@
+# METADATA
+# title: "Runs with a high-range group ID"
+# custom:
+#   id: KSV021
+#   avd_id: AVD-KSV-0021
+#   severity: MEDIUM
+#   recommended_action: "Set 'containers[].securityContext.runAsGroup' to a value >= 10000."
+#   input:
+#     selector:
+#     - type: kubernetes
+package builtin.kubernetes.KSV021
+
+import rego.v1
+import data.lib.kubernetes
+
+deny contains res if {
+    some container in kubernetes.containers
+    group := container.securityContext.runAsGroup
+    group < 10000
+    msg := sprintf("Container %q of %s %q should set 'securityContext.runAsGroup' >= 10000", [object.get(container, "name", "?"), kubernetes.kind, kubernetes.name])
+    res := result.new(msg, container)
+}

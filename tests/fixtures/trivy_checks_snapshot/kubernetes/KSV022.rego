@@ -1,0 +1,23 @@
+# METADATA
+# title: "Non-default capabilities added"
+# custom:
+#   id: KSV022
+#   avd_id: AVD-KSV-0022
+#   severity: MEDIUM
+#   recommended_action: "Remove non-default capabilities from 'containers[].securityContext.capabilities.add'."
+#   input:
+#     selector:
+#     - type: kubernetes
+package builtin.kubernetes.KSV022
+
+import data.lib.kubernetes
+
+allowed := ["AUDIT_WRITE", "CHOWN", "KILL", "NET_BIND_SERVICE", "SETGID", "SETUID"]
+
+deny[res] {
+    container := kubernetes.containers[_]
+    cap := kubernetes.added_capabilities(container)[_]
+    not cap in allowed
+    msg := sprintf("Container %q of %s %q adds non-default capability %q", [object.get(container, "name", "?"), kubernetes.kind, kubernetes.name, cap])
+    res := result.new(msg, container)
+}

@@ -1,0 +1,20 @@
+# METADATA
+# title: "hostPath volumes mounted"
+# custom:
+#   id: KSV023
+#   avd_id: AVD-KSV-0023
+#   severity: MEDIUM
+#   recommended_action: "Do not mount hostPath volumes."
+#   input:
+#     selector:
+#     - type: kubernetes
+package builtin.kubernetes.KSV023
+
+import data.lib.kubernetes
+
+deny[res] {
+    volume := kubernetes.pod_spec.volumes[_]
+    volume.hostPath
+    msg := sprintf("%s %q should not mount hostPath volume %q", [kubernetes.kind, kubernetes.name, object.get(volume, "name", "?")])
+    res := result.new(msg, volume)
+}

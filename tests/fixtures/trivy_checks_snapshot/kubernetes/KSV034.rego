@@ -1,0 +1,28 @@
+# METADATA
+# title: "Container images from public registries"
+# custom:
+#   id: KSV034
+#   avd_id: AVD-KSV-0034
+#   severity: MEDIUM
+#   recommended_action: "Use images from a trusted private registry."
+#   input:
+#     selector:
+#     - type: kubernetes
+package builtin.kubernetes.KSV034
+
+import rego.v1
+import data.lib.kubernetes
+
+trusted := ["registry.internal.example/"]
+
+from_trusted(image) if {
+    some prefix in trusted
+    startswith(image, prefix)
+}
+
+deny contains res if {
+    some container in kubernetes.containers
+    not from_trusted(container.image)
+    msg := sprintf("Container %q of %s %q pulls %q from an untrusted registry", [object.get(container, "name", "?"), kubernetes.kind, kubernetes.name, container.image])
+    res := result.new(msg, container)
+}

@@ -1,0 +1,20 @@
+# METADATA
+# title: "Workload deployed into the kube-system namespace"
+# custom:
+#   id: KSV037
+#   avd_id: AVD-KSV-0037
+#   severity: MEDIUM
+#   recommended_action: "Deploy workloads outside kube-system."
+#   input:
+#     selector:
+#     - type: kubernetes
+package builtin.kubernetes.KSV037
+
+import data.lib.kubernetes
+
+deny[res] {
+    input.metadata.namespace == "kube-system"
+    kubernetes.is_controller
+    msg := sprintf("%s %q should not be deployed into kube-system", [kubernetes.kind, kubernetes.name])
+    res := result.new(msg, input.metadata)
+}

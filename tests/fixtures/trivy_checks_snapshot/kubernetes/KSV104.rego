@@ -1,0 +1,26 @@
+# METADATA
+# title: "Seccomp profile unconfined"
+# custom:
+#   id: KSV104
+#   avd_id: AVD-KSV-0104
+#   severity: MEDIUM
+#   recommended_action: "Set a seccomp profile of RuntimeDefault or Localhost."
+#   input:
+#     selector:
+#     - type: kubernetes
+package builtin.kubernetes.KSV104
+
+import data.lib.kubernetes
+
+profile_of(container) = p {
+    p := container.securityContext.seccompProfile.type
+} else = p {
+    p := kubernetes.pod_spec.securityContext.seccompProfile.type
+} else = "Undefined"
+
+deny[res] {
+    container := kubernetes.containers[_]
+    profile_of(container) == "Unconfined"
+    msg := sprintf("Container %q of %s %q must not run with an Unconfined seccomp profile", [object.get(container, "name", "?"), kubernetes.kind, kubernetes.name])
+    res := result.new(msg, container)
+}

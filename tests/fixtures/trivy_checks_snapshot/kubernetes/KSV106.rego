@@ -1,0 +1,27 @@
+# METADATA
+# title: "Container capabilities must only include NET_BIND_SERVICE"
+# custom:
+#   id: KSV106
+#   avd_id: AVD-KSV-0106
+#   severity: LOW
+#   recommended_action: "Drop ALL and add only NET_BIND_SERVICE."
+#   input:
+#     selector:
+#     - type: kubernetes
+package builtin.kubernetes.KSV106
+
+import rego.v1
+import data.lib.kubernetes
+
+restricted_ok(container) if {
+    every cap in kubernetes.added_capabilities(container) {
+        cap == "NET_BIND_SERVICE"
+    }
+}
+
+deny contains res if {
+    some container in kubernetes.containers
+    not restricted_ok(container)
+    msg := sprintf("Container %q of %s %q adds capabilities beyond NET_BIND_SERVICE", [object.get(container, "name", "?"), kubernetes.kind, kubernetes.name])
+    res := result.new(msg, container)
+}

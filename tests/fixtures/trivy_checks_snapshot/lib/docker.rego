@@ -1,0 +1,23 @@
+# Reconstruction of trivy-checks lib/docker.rego helper shapes (see
+# lib/kubernetes.rego header for why this is a reconstruction).
+package lib.docker
+
+from[instruction] {
+    instruction := input.Stages[_].Commands[_]
+    instruction.Cmd == "from"
+}
+
+run[instruction] {
+    instruction := input.Stages[_].Commands[_]
+    instruction.Cmd == "run"
+}
+
+user[instruction] {
+    instruction := input.Stages[_].Commands[_]
+    instruction.Cmd == "user"
+}
+
+add[instruction] {
+    instruction := input.Stages[_].Commands[_]
+    instruction.Cmd == "add"
+}

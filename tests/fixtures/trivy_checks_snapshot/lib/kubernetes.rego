@@ -1,0 +1,56 @@
+# Faithful reconstruction of trivy-checks lib/kubernetes.rego helper
+# shapes (the real bundle is not vendorable in this zero-egress build;
+# the STRUCTURE — shared helper library imported as data.lib.kubernetes,
+# partial-set container enumeration, predicate functions — matches the
+# upstream bundle so the engine's compatibility is exercised for real).
+package lib.kubernetes
+
+default is_gatekeeper = false
+
+kind := object.get(input, "kind", "")
+
+name := object.get(object.get(input, "metadata", {}), "name", "?")
+
+is_pod {
+    kind == "Pod"
+}
+
+is_controller {
+    kind == "Deployment"
+}
+
+is_controller {
+    kind == "StatefulSet"
+}
+
+is_controller {
+    kind == "DaemonSet"
+}
+
+is_controller {
+    kind == "CronJob"
+}
+
+pod_spec := input.spec {
+    is_pod
+} else := input.spec.template.spec {
+    is_controller
+} else := {}
+
+containers[container] {
+    container := pod_spec.containers[_]
+}
+
+containers[container] {
+    container := pod_spec.initContainers[_]
+}
+
+is_privileged(container) {
+    container.securityContext.privileged == true
+}
+
+added_capabilities(container) = caps {
+    caps := object.get(object.get(object.get(container, "securityContext", {}), "capabilities", {}), "add", [])
+}
+
+format(msg) = msg

@@ -176,8 +176,9 @@ deny[msg] { msg := "x" }
 
 
 def test_rego_unsupported_is_loud():
+    # a genuinely unsupported construct must fail at load, not scan green
     with pytest.raises(RegoError):
-        parse_module("package t\n\ndeny[m] { every x in input.a { x > 1 }; m := 1 }")
+        parse_module("package t\n\ndeny[m] { m := |badtoken| }")
 
 
 def test_rego_result_new_carries_lines():
@@ -519,3 +520,105 @@ def test_dockerfile_line_attribution(scanner):
     sudo = next(f for f in mc.failures if f.check_id == "DS010")
     assert sudo.start_line == 2
     assert {"DS001", "DS002", "DS026"} <= {s.check_id for s in mc.successes}
+
+
+def test_rego_every_statement():
+    src = """
+package test
+
+deny[msg] {
+    every c in input.containers {
+        c.ok == true
+    }
+    msg := "all ok"
+}
+
+deny_any[msg] {
+    not all_privileged
+    msg := "mixed"
+}
+
+all_privileged {
+    every c in input.containers {
+        c.privileged == true
+    }
+}
+"""
+    assert _eval_deny(src, {"containers": [{"ok": True}, {"ok": True}]}) == ["all ok"]
+    assert _eval_deny(src, {"containers": [{"ok": True}, {"ok": False}]}) == []
+    # vacuous truth on empty collections (OPA semantics)
+    assert _eval_deny(src, {"containers": []}) == ["all ok"]
+    mod = parse_module(src)
+    ev = _Evaluator({"containers": [{"privileged": True}, {}]}, mod.rules)
+    assert ev.eval_set_rule("deny_any") == ["mixed"]
+
+
+def test_rego_every_key_value():
+    src = """
+package test
+
+deny[msg] {
+    every i, v in input.ports {
+        v < 1024
+    }
+    msg := sprintf("%d low ports", [count(input.ports)])
+}
+"""
+    assert _eval_deny(src, {"ports": [22, 80, 443]}) == ["3 low ports"]
+    assert _eval_deny(src, {"ports": [22, 8080]}) == []
+
+
+def test_rego_else_chains():
+    src = """
+package test
+
+verdict := "root" {
+    input.user == "root"
+} else := "admin" {
+    input.admin
+} else := "user"
+
+deny[msg] {
+    msg := verdict
+}
+"""
+    assert _eval_deny(src, {"user": "root"}) == ["root"]
+    assert _eval_deny(src, {"user": "x", "admin": True}) == ["admin"]
+    assert _eval_deny(src, {"user": "x"}) == ["user"]
+
+
+def test_rego_else_on_function():
+    src = """
+package test
+
+level(x) = "high" {
+    x > 10
+} else = "low" {
+    x > 0
+} else = "none"
+
+deny[msg] {
+    msg := level(input.n)
+}
+"""
+    assert _eval_deny(src, {"n": 11}) == ["high"]
+    assert _eval_deny(src, {"n": 5}) == ["low"]
+    assert _eval_deny(src, {"n": -1}) == ["none"]
+
+
+def test_rego_else_modern_if_syntax():
+    src = """
+package test
+
+import rego.v1
+
+mode := "strict" if {
+    input.strict
+} else := "lenient"
+
+deny contains msg if {
+    msg := mode
+}
+"""
+    assert _eval_deny(src, {"strict": True}) == ["strict"]
+    assert _eval_deny(src, {}) == ["lenient"]

@@ -1,0 +1,141 @@
+"""The trivy-checks-bundle compatibility gate (VERDICT r3 #7).
+
+The snapshot under fixtures/trivy_checks_snapshot mirrors the REAL
+bundle's structure — checks importing shared `data.lib.kubernetes` /
+`data.lib.docker` helper libraries, full METADATA blocks (avd_id,
+schemas, selectors), classic `deny[res]` bodies next to rego.v1
+`deny contains res if`, `else` chains and `every` quantification, and
+partial-set helper enumeration (`kubernetes.containers[_]`).  Loading it
+through the normal check loader and evaluating against fixture inputs is
+what "the OCI bundle client's practical value" means: if these idioms
+load and evaluate, genuine bundle checks do too.
+"""
+
+import os
+
+import pytest
+
+from trivy_tpu.iac.engine import IacScanner, load_checks
+
+SNAPSHOT = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "fixtures",
+    "trivy_checks_snapshot",
+)
+
+BAD_DEPLOYMENT = b"""
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: web
+  namespace: kube-system
+spec:
+  template:
+    spec:
+      hostNetwork: true
+      volumes:
+        - name: host
+          hostPath:
+            path: /etc
+      containers:
+        - name: app
+          image: nginx:latest
+          securityContext:
+            privileged: true
+            runAsUser: 0
+            runAsGroup: 0
+            seccompProfile:
+              type: Unconfined
+            capabilities:
+              add: [SYS_ADMIN, NET_BIND_SERVICE]
+"""
+
+GOOD_POD = b"""
+apiVersion: v1
+kind: Pod
+metadata:
+  name: quiet
+spec:
+  containers:
+    - name: app
+      image: registry.internal.example/app:1.2.3
+      securityContext:
+        runAsNonRoot: true
+        runAsUser: 10001
+        runAsGroup: 10001
+        seccompProfile:
+          type: RuntimeDefault
+        capabilities:
+          drop: [ALL]
+          add: [NET_BIND_SERVICE]
+"""
+
+BAD_DOCKERFILE = b"""\
+FROM ubuntu:latest
+ADD app.py /src/app.py
+RUN apk add curl
+WORKDIR src
+EXPOSE 22
+"""
+
+GOOD_DOCKERFILE = b"""\
+FROM alpine:3.19
+COPY app.py /src/app.py
+RUN apk add --no-cache curl
+WORKDIR /src
+USER app
+HEALTHCHECK CMD curl -f http://localhost/ || exit 1
+"""
+
+
+@pytest.fixture(scope="module")
+def scanner():
+    return IacScanner(extra_check_dirs=[SNAPSHOT])
+
+
+def test_snapshot_load_success_rate():
+    """Every snapshot module loads (libraries into the registry, checks
+    into the check list) — the load-success rate the VERDICT asked to
+    report is 18/18 checks + 2/2 libs."""
+    snap = load_checks(extra_dirs=[SNAPSHOT])
+    loaded = [c for c in snap if c.module.source_path.startswith(SNAPSHOT)]
+    rate = len(loaded) / 18
+    assert rate == 1.0, (
+        f"load-success rate {rate:.0%}: "
+        f"{sorted(c.check_id for c in loaded)}"
+    )
+    # helper libraries loaded into the registry but are not checks
+    registry = snap[0].registry
+    assert "lib.kubernetes" in registry and "lib.docker" in registry
+
+
+def test_snapshot_k8s_checks_fail_direction(scanner):
+    mc = scanner.scan("deploy.yaml", BAD_DEPLOYMENT)
+    ids = {f.check_id for f in mc.failures}
+    assert {
+        "KSV012", "KSV017", "KSV003", "KSV022", "KSV009", "KSV021",
+        "KSV034", "KSV106", "KSV020", "KSV023", "KSV104", "KSV037",
+    } <= ids, sorted(ids)
+
+
+def test_snapshot_k8s_checks_pass_direction(scanner):
+    mc = scanner.scan("pod.yaml", GOOD_POD)
+    snapshot_ids = {
+        "KSV012", "KSV017", "KSV003", "KSV022", "KSV009", "KSV021",
+        "KSV034", "KSV106", "KSV020", "KSV023", "KSV104", "KSV037",
+    }
+    failing = {f.check_id for f in mc.failures} & snapshot_ids
+    assert not failing, sorted(failing)
+
+
+def test_snapshot_dockerfile_checks(scanner):
+    mc = scanner.scan("Dockerfile", BAD_DOCKERFILE)
+    ids = {f.check_id for f in mc.failures}
+    assert {"DS001", "DS004", "DS005", "DS013", "DS025", "DS026"} <= ids, (
+        sorted(ids)
+    )
+    mc = scanner.scan("Dockerfile", GOOD_DOCKERFILE)
+    failing = {f.check_id for f in mc.failures} & {
+        "DS001", "DS004", "DS005", "DS013", "DS025", "DS026"
+    }
+    assert not failing, sorted(failing)

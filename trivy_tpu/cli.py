@@ -416,6 +416,15 @@ def _k8s_command(args) -> int:
         from trivy_tpu.k8s.client import select_kinds
 
         scanners = [s for s in args.scanners.split(",") if s]
+        unknown = set(scanners) - {"misconfig", "vuln", "secret", "rbac"}
+        if unknown:
+            # A typo'd scanner must not read as a clean cluster.
+            print(
+                f"trivy-tpu: unknown k8s scanners {sorted(unknown)} "
+                "(expected misconfig,vuln,secret,rbac)",
+                file=sys.stderr,
+            )
+            return 2
         kinds = select_kinds(
             [k for k in args.include_kinds.split(",") if k],
             rbac="rbac" in scanners,

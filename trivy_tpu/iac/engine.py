@@ -40,6 +40,9 @@ class Check:
     severity: str
     resolution: str
     input_type: str  # dockerfile | kubernetes | terraform
+    # package -> module for every module loaded alongside this check —
+    # `import data.lib.kubernetes` helper libraries resolve through it.
+    registry: dict = None  # type: ignore[assignment]
 
 
 def _input_type_of(package: str) -> str | None:
@@ -61,34 +64,46 @@ def _input_type_of(package: str) -> str | None:
 
 
 def load_checks(extra_dirs: list[str] | None = None) -> list[Check]:
+    """Parse every .rego under the check dirs (recursively — bundles nest
+    checks in per-service subtrees).  Modules without a deny rule or a
+    recognizable input type (e.g. `lib.*` helper libraries) load into the
+    shared registry so checks can `import data.lib.kubernetes` them, but
+    produce no Check rows themselves."""
     checks: list[Check] = []
+    registry: dict[str, RegoModule] = {}
     dirs = [_CHECK_DIR] + list(extra_dirs or [])
+    modules: list[RegoModule] = []
     for d in dirs:
         if not os.path.isdir(d):
             continue
-        for name in sorted(os.listdir(d)):
-            if not name.endswith(".rego"):
-                continue
-            path = os.path.join(d, name)
-            with open(path, "r", encoding="utf-8") as f:
-                src = f.read()
-            mod = parse_module(src, source_path=path)
-            itype = _input_type_of(mod.package)
-            if itype is None or "deny" not in mod.rules:
-                continue
-            md = mod.metadata or {}
-            custom = md.get("custom") or {}
-            checks.append(
-                Check(
-                    module=mod,
-                    check_id=custom.get("id", mod.package.rsplit(".", 1)[-1]),
-                    title=md.get("title", ""),
-                    description=md.get("description", ""),
-                    severity=str(custom.get("severity", "MEDIUM")).upper(),
-                    resolution=custom.get("recommended_action", ""),
-                    input_type=itype,
-                )
+        for root, _sub, files in sorted(os.walk(d)):
+            for name in sorted(files):
+                if not name.endswith(".rego") or name.endswith("_test.rego"):
+                    continue
+                path = os.path.join(root, name)
+                with open(path, "r", encoding="utf-8") as f:
+                    src = f.read()
+                mod = parse_module(src, source_path=path)
+                registry[mod.package] = mod
+                modules.append(mod)
+    for mod in modules:
+        itype = _input_type_of(mod.package)
+        if itype is None or "deny" not in mod.rules:
+            continue
+        md = mod.metadata or {}
+        custom = md.get("custom") or {}
+        checks.append(
+            Check(
+                module=mod,
+                check_id=custom.get("id", mod.package.rsplit(".", 1)[-1]),
+                title=md.get("title", ""),
+                description=md.get("description", ""),
+                severity=str(custom.get("severity", "MEDIUM")).upper(),
+                resolution=custom.get("recommended_action", ""),
+                input_type=itype,
+                registry=registry,
             )
+        )
     return checks
 
 
@@ -235,7 +250,11 @@ class IacScanner:
             traces: list[str] = []
             broken = False
             for di, doc in enumerate(inputs):
-                ev = _Evaluator(doc, check.module.rules)
+                ev = _Evaluator(
+                    doc, check.module.rules,
+                    registry=check.registry,
+                    imports=check.module.imports,
+                )
                 try:
                     denies = ev.eval_set_rule("deny")
                 except Exception as e:  # noqa: BLE001 — any check crash

@@ -17,10 +17,13 @@ failing policy is loud, not silently green):
   * rules: partial sets ``deny[msg] { ... }`` and the modern
     ``deny contains msg if { ... }``; complete rules ``name := expr``,
     ``name = expr { body }``, ``name { body }``; ``default name := v``;
-    single-clause functions ``f(x) { ... }`` / ``f(x) = y { ... }``;
-    multiple bodies per rule name (OR semantics); ``else`` is NOT supported
+    functions ``f(x) { ... }`` / ``f(x) = y { ... }``; multiple bodies
+    per rule name (OR semantics); ``else`` chains on complete rules,
+    boolean rules, and functions (first satisfiable link wins)
   * statements: ``x := e``, ``some x in e``, ``some k, v in e``, ``not e``,
-    boolean expressions, comparisons (== != < <= > >=), unification ``=``
+    ``every x in e { ... }`` / ``every k, v in e { ... }`` (universal
+    quantification, vacuously true on empty collections), boolean
+    expressions, comparisons (== != < <= > >=), unification ``=``
     treated as equality when both sides are bound
   * expressions: input/data references with fields, ``[...]`` indexing,
     ``[_]`` wildcard iteration (backtracks), array/object/set literals,
@@ -186,6 +189,17 @@ class St_Not:
 
 
 @dataclass
+class St_Every:
+    """Universal quantification: every x in coll { body } — succeeds when
+    the body is satisfiable for EVERY element (vacuously true on empty
+    collections, OPA semantics); bindings do not escape."""
+
+    vars: list[str]
+    expr: Any
+    body: list[Any]
+
+
+@dataclass
 class St_Expr:
     expr: Any
 
@@ -196,6 +210,9 @@ class RuleClause:
     value: Any | None  # complete-rule value expr
     body: list[Any]
     args: list[str] | None = None  # function parameters
+    # `else` chain link: evaluated only when THIS clause's body fails
+    # (complete rules and functions; illegal on partial sets in rego).
+    else_clause: "RuleClause | None" = None
 
 
 @dataclass
@@ -208,12 +225,28 @@ class Rule:
     is_func: bool = False
 
 
+class _SetVal(list):
+    """A partial-set rule's result: ``s[x]`` binds x to MEMBERS (rego set
+    semantics), unlike a plain list where ``arr[i]`` binds the index."""
+
+
+@dataclass
+class _ModuleVal:
+    """An imported module referenced as a value (``import data.lib.k8s``
+    binds alias -> this); field access resolves the module's rules."""
+
+    module: "RegoModule"
+
+
 @dataclass
 class RegoModule:
     package: str
     rules: dict[str, Rule]
     metadata: dict[str, Any]
     source_path: str = ""
+    # alias -> imported package path ("kubernetes" -> "lib.kubernetes");
+    # resolved against an evaluator's module registry at eval time.
+    imports: dict[str, str] = field(default_factory=dict)
 
 
 # ---------------------------------------------------------------------------
@@ -433,7 +466,14 @@ class _Parser:
             self.expect("kw", "in")
             return St_Some(names, self.parse_expr())
         if self.at("kw", "every"):
-            raise RegoError("rego: 'every' is not supported")
+            self.next()
+            names = [self.expect("name").text]
+            while self.eat("punct", ","):
+                names.append(self.expect("name").text)
+            self.expect("kw", "in")
+            expr = self.parse_expr()
+            body = self.parse_block_body()
+            return St_Every(names, expr, body)
         # assignment or expression
         save = self.i
         t = self.peek()
@@ -499,6 +539,31 @@ def _parse_metadata_comment(block: list[str]) -> dict[str, Any]:
     return out
 
 
+def _parse_else_chain(p: "_Parser", clause: RuleClause) -> RuleClause:
+    """Attach `else [:= value] [if] { body }` links to a clause."""
+    cur = clause
+    while p.at("kw", "else"):
+        p.next()
+        value = None
+        if p.eat("punct", ":=") or p.eat("punct", "="):
+            value = p.parse_expr()
+        if p.eat("kw", "if"):
+            body = (
+                p.parse_block_body()
+                if p.at("punct", "{")
+                else [p.parse_statement()]
+            )
+        elif p.at("punct", "{"):
+            body = p.parse_block_body()
+        else:
+            body = []
+        cur.else_clause = RuleClause(
+            key=None, value=value, body=body, args=clause.args
+        )
+        cur = cur.else_clause
+    return clause
+
+
 def parse_module(src: str, source_path: str = "") -> RegoModule:
     toks = _tokenize(src)
     p = _Parser(toks)
@@ -531,14 +596,20 @@ def parse_module(src: str, source_path: str = "") -> RegoModule:
             rules[name] = Rule(name=name)
         return rules[name]
 
+    imports: dict[str, str] = {}
     while not p.at("eof"):
         if p.eat("kw", "import"):
-            # consume the dotted path (and optional alias); semantics ignored
-            p.next()
+            # `import data.lib.kubernetes [as alias]` binds alias (default:
+            # last segment) to the package path for cross-module rule
+            # references; `rego.v1` / `future.keywords.*` are no-ops.
+            parts = [p.next().text]
             while p.eat("punct", "."):
-                p.next()
+                parts.append(p.next().text)
+            alias = ""
             if p.eat("kw", "as"):
-                p.next()
+                alias = p.expect("name").text
+            if parts[0] == "data" and len(parts) > 1:
+                imports[alias or parts[-1]] = ".".join(parts[1:])
             continue
         if p.eat("kw", "default"):
             name = p.expect("name").text
@@ -566,9 +637,15 @@ def parse_module(src: str, source_path: str = "") -> RegoModule:
             value = None
             if p.eat("punct", "=") or p.eat("punct", ":="):
                 value = p.parse_expr()
+            p.eat("kw", "if")  # rego.v1: f(x) [= v] if { body }
             body = p.parse_block_body() if p.at("punct", "{") else []
             r.is_func = True
-            r.clauses.append(RuleClause(key=None, value=value, body=body, args=args))
+            r.clauses.append(
+                _parse_else_chain(
+                    p,
+                    RuleClause(key=None, value=value, body=body, args=args),
+                )
+            )
             continue
 
         if p.at("punct", "["):  # partial set/object: deny[msg] { ... }
@@ -605,7 +682,11 @@ def parse_module(src: str, source_path: str = "") -> RegoModule:
                 body = p.parse_block_body()
             else:
                 body = []
-            r.clauses.append(RuleClause(key=None, value=value, body=body))
+            r.clauses.append(
+                _parse_else_chain(
+                    p, RuleClause(key=None, value=value, body=body)
+                )
+            )
             continue
 
         if p.eat("kw", "if"):
@@ -613,12 +694,20 @@ def parse_module(src: str, source_path: str = "") -> RegoModule:
                 body = p.parse_block_body()
             else:
                 body = [p.parse_statement()]
-            r.clauses.append(RuleClause(key=None, value=Lit(True), body=body))
+            r.clauses.append(
+                _parse_else_chain(
+                    p, RuleClause(key=None, value=Lit(True), body=body)
+                )
+            )
             continue
 
         if p.at("punct", "{"):  # boolean rule: name { body }
             body = p.parse_block_body()
-            r.clauses.append(RuleClause(key=None, value=Lit(True), body=body))
+            r.clauses.append(
+                _parse_else_chain(
+                    p, RuleClause(key=None, value=Lit(True), body=body)
+                )
+            )
             continue
 
         raise RegoError(f"rego: cannot parse rule {name!r} at line {t.line}")
@@ -633,7 +722,8 @@ def parse_module(src: str, source_path: str = "") -> RegoModule:
             pass
 
     return RegoModule(
-        package=package, rules=rules, metadata=metadata, source_path=source_path
+        package=package, rules=rules, metadata=metadata,
+        source_path=source_path, imports=imports,
     )
 
 
@@ -679,12 +769,44 @@ def _sprintf(fmt: str, args: list[Any]) -> str:
 class _Evaluator:
     MAX_STEPS = 200_000
 
-    def __init__(self, input_doc: Any, rules: dict[str, Rule], data: Any | None = None):
+    def __init__(
+        self,
+        input_doc: Any,
+        rules: dict[str, Rule],
+        data: Any | None = None,
+        registry: dict[str, "RegoModule"] | None = None,
+        imports: dict[str, str] | None = None,
+    ):
         self.input = input_doc
         self.rules = rules
         self.data = data or {}
+        self.registry = registry or {}
+        self.imports = imports or {}
         self._cache: dict[str, Any] = {}
+        self._mod_evals: dict[str, "_Evaluator"] = {}
         self._steps = 0
+
+    def _module_eval(self, mod: "RegoModule") -> "_Evaluator":
+        """Sub-evaluator for an imported module: same input/data/registry,
+        the module's own rules and imports; cached per package."""
+        ev = self._mod_evals.get(mod.package)
+        if ev is None:
+            ev = _Evaluator(
+                self.input, mod.rules, self.data,
+                registry=self.registry, imports=mod.imports,
+            )
+            ev._mod_evals = self._mod_evals  # share the cache (cycles safe)
+            self._mod_evals[mod.package] = ev
+        return ev
+
+    def _module_rule_value(self, mod: "RegoModule", name: str) -> Any:
+        ev = self._module_eval(mod)
+        rule = mod.rules.get(name)
+        if rule is None:
+            raise _Undefined()
+        if rule.is_set:
+            return _SetVal(ev.eval_set_rule(name))
+        return ev.eval_complete_rule(name)
 
     # -- entry points ------------------------------------------------------
 
@@ -709,35 +831,47 @@ class _Evaluator:
         if rule is None:
             raise _Undefined()
         if rule.is_set:
-            val = set_like = self.eval_set_rule(name)
-            self._cache[name] = set_like
+            val = _SetVal(self.eval_set_rule(name))
+            self._cache[name] = val
             return val
         for clause in rule.clauses:
-            for env in self.eval_body(clause.body, {}):
-                try:
-                    v = self.eval_expr(clause.value, env)
-                except _Undefined:
-                    continue
-                self._cache[name] = v
-                return v
+            try:
+                v = self._eval_clause_chain(clause, {})
+            except _Undefined:
+                continue
+            self._cache[name] = v
+            return v
         if rule.has_default:
             v = self.eval_expr(rule.default, {})
             self._cache[name] = v
             return v
         raise _Undefined()
 
+    def _eval_clause_chain(self, clause: RuleClause, env0: dict) -> Any:
+        """Value of the first link in a clause's else chain whose body is
+        satisfiable (the whole chain fails -> _Undefined)."""
+        link: RuleClause | None = clause
+        while link is not None:
+            for env in self.eval_body(link.body, dict(env0)):
+                if link.value is None:
+                    return True
+                try:
+                    return self.eval_expr(link.value, env)
+                except _Undefined:
+                    continue
+            link = link.else_clause
+        raise _Undefined()
+
     def call_function(self, rule: Rule, args: list[Any]) -> Any:
         for clause in rule.clauses:
             if clause.args is None or len(clause.args) != len(args):
                 continue
-            env = dict(zip(clause.args, args))
-            for e2 in self.eval_body(clause.body, env):
-                if clause.value is None:
-                    return True
-                try:
-                    return self.eval_expr(clause.value, e2)
-                except _Undefined:
-                    continue
+            try:
+                return self._eval_clause_chain(
+                    clause, dict(zip(clause.args, args))
+                )
+            except _Undefined:
+                continue
         raise _Undefined()
 
     # -- body evaluation ---------------------------------------------------
@@ -764,6 +898,27 @@ class _Evaluator:
             try:
                 for coll, env2 in self.eval_iter(st.expr, env):
                     yield from self._iterate_some(st.vars, coll, env2)
+            except _Undefined:
+                return
+        elif isinstance(st, St_Every):
+            try:
+                for coll, env2 in self.eval_iter(st.expr, env):
+                    if not isinstance(coll, (list, tuple, dict)):
+                        # OPA raises a type error on non-collection
+                        # domains; vacuous success would read malformed
+                        # input as green.
+                        raise RegoError(
+                            "rego: 'every' domain is not a collection"
+                        )
+                    ok = True
+                    for env_e in self._iterate_some(st.vars, coll, env2):
+                        if not any(
+                            True for _ in self.eval_body(st.body, env_e)
+                        ):
+                            ok = False
+                            break
+                    if ok:
+                        yield env2  # bindings do not escape `every`
             except _Undefined:
                 return
         elif isinstance(st, St_Not):
@@ -843,6 +998,11 @@ class _Evaluator:
                 return self.data
             if expr.name in self.rules:
                 return self.eval_complete_rule(expr.name)
+            if expr.name in self.imports:
+                mod = self.registry.get(self.imports[expr.name])
+                if mod is None:
+                    raise _Undefined()
+                return _ModuleVal(mod)
             raise _Undefined()
         if isinstance(expr, Wildcard):
             raise RegoError("rego: wildcard outside reference")
@@ -888,6 +1048,16 @@ class _Evaluator:
                 yield value, e
                 return
             seg, rest = path[0], path[1:]
+            if isinstance(value, _ModuleVal):
+                # imported-module field: resolve the rule in that module
+                if not isinstance(seg, str):
+                    return
+                try:
+                    rv = self._module_rule_value(value.module, seg)
+                except _Undefined:
+                    return
+                yield from walk(rv, rest, e)
+                return
             if isinstance(seg, Wildcard):
                 if isinstance(value, dict):
                     for v in value.values():
@@ -896,6 +1066,24 @@ class _Evaluator:
                     for v in value:
                         yield from walk(v, rest, e)
                 return
+            # `coll[x]` with x unbound BINDS x (rego semantics): set members
+            # for partial-set results, keys for objects, indices for arrays.
+            if (
+                isinstance(seg, Var)
+                and seg.name not in e
+                and seg.name not in self.rules
+                and seg.name not in self.imports
+            ):
+                if isinstance(value, _SetVal):
+                    for v in value:
+                        yield from walk(v, rest, {**e, seg.name: v})
+                elif isinstance(value, dict):
+                    for k, v in value.items():
+                        yield from walk(v, rest, {**e, seg.name: k})
+                elif isinstance(value, (list, tuple)):
+                    for i, v in enumerate(value):
+                        yield from walk(v, rest, {**e, seg.name: i})
+                return
             if isinstance(seg, str):
                 key: Any = seg
             else:
@@ -903,6 +1091,11 @@ class _Evaluator:
                     key = self.eval_expr(seg, e)
                 except _Undefined:
                     return
+            if isinstance(value, _SetVal):
+                # bound lookup on a set: membership, yields the member
+                if key in value:
+                    yield from walk(key, rest, e)
+                return
             if isinstance(value, dict):
                 if key in value:
                     yield from walk(value[key], rest, e)
@@ -960,6 +1153,15 @@ class _Evaluator:
         rule = self.rules.get(name)
         if rule is not None and rule.is_func:
             return self.call_function(rule, args)
+        if "." in name:
+            # imported-module function: kubernetes.isPrivileged(c)
+            alias, _, fname = name.partition(".")
+            if alias in self.imports:
+                mod = self.registry.get(self.imports[alias])
+                frule = mod.rules.get(fname) if mod else None
+                if frule is None:
+                    raise _Undefined()
+                return self._module_eval(mod).call_function(frule, args)
         fn = _BUILTINS.get(name)
         if fn is None:
             raise RegoError(f"rego: unknown function {name!r}")

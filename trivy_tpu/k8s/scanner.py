@@ -15,7 +15,7 @@ import json
 import logging
 from dataclasses import dataclass, field
 
-from trivy_tpu.k8s.report import K8sReport, K8sResource
+from trivy_tpu.k8s.report import RBAC_RESOURCE_KINDS, K8sReport, K8sResource
 
 logger = logging.getLogger(__name__)
 
@@ -79,8 +79,6 @@ class K8sScanner:
                 name=meta.get("name", ""),
             )
             try:
-                from trivy_tpu.k8s.report import RBAC_RESOURCE_KINDS
-
                 is_rbac = res.kind in RBAC_RESOURCE_KINDS
                 if ("misconfig" in self.scanners) or (
                     is_rbac and "rbac" in self.scanners
