@@ -447,3 +447,146 @@ def test_javadb_shard_refresh_drops_stale_sqlite(tmp_path, monkeypatch):
     monkeypatch.setattr(oci_mod, "OciArtifact", _FakeArt)
     jdb.download_javadb(str(tmp_path))
     assert not (tmp_path / "trivy-java.db").exists()
+
+
+# ---------------------------------------------------------------------------
+# BerkeleyDB hash rpmdb (CentOS <= 8 Packages)
+# ---------------------------------------------------------------------------
+
+
+def build_bdb_packages(
+    blobs: list[bytes], pagesize: int = 4096, endian: str = "<",
+    inline_small: bool = False,
+) -> bytes:
+    """Test-only BDB hash writer matching the on-disk layout db/bdb.py
+    reads: metadata page, one hash page of (key, value) slot pairs, and
+    overflow chains for off-page values.  Independent of the reader so
+    layout mistakes fail loudly rather than cancelling out."""
+    e = endian
+    ph = 26
+    pages: list[bytearray] = []
+
+    def page(ptype: int) -> bytearray:
+        p = bytearray(pagesize)
+        p[25] = ptype
+        struct.pack_into(e + "I", p, 8, len(pages))
+        return p
+
+    meta = page(8)  # hash metadata page type
+    struct.pack_into(e + "I", meta, 12, 0x00061561)
+    struct.pack_into(e + "I", meta, 16, 9)           # version
+    struct.pack_into(e + "I", meta, 20, pagesize)
+    pages.append(meta)
+
+    hashp = page(13)  # sorted hash page
+    pages.append(hashp)
+    slots: list[int] = []
+    tail = pagesize  # entries allocate from the page end downward
+
+    def alloc(entry: bytes) -> int:
+        nonlocal tail
+        tail -= len(entry)
+        hashp[tail : tail + len(entry)] = entry
+        return tail
+
+    overflow_start = 2
+    chains: list[bytes] = []
+    for i, blob in enumerate(blobs):
+        slots.append(alloc(b"\x01" + struct.pack(e + "I", i)))  # key
+        if inline_small and len(blob) < 512:
+            slots.append(alloc(b"\x01" + blob))
+            continue
+        pgno = overflow_start + sum(
+            -(-len(c) // (pagesize - ph)) for c in chains
+        )
+        chains.append(blob)
+        slots.append(
+            alloc(struct.pack(e + "BxxxII", 3, pgno, len(blob)))  # H_OFFPAGE
+        )
+    struct.pack_into(e + "H", hashp, 20, len(slots))
+    for i, off in enumerate(slots):
+        struct.pack_into(e + "H", hashp, ph + 2 * i, off)
+
+    for blob in chains:
+        chunks = [
+            blob[o : o + pagesize - ph]
+            for o in range(0, len(blob), pagesize - ph)
+        ] or [b""]
+        for ci, chunk in enumerate(chunks):
+            p = page(7)  # overflow
+            if ci + 1 < len(chunks):
+                struct.pack_into(e + "I", p, 16, len(pages) + 1)  # next
+            else:
+                struct.pack_into(e + "H", p, 22, len(chunk))  # used bytes
+            p[ph : ph + len(chunk)] = chunk
+            pages.append(p)
+
+    struct.pack_into(e + "I", pages[0], 32, len(pages) - 1)  # last_pgno
+    return bytes(b"".join(pages))
+
+
+BASH_HDR = {
+    1000: "bash",
+    1001: "4.2.46",
+    1002: "35.el7_9",
+    1022: "x86_64",
+    1044: "bash-4.2.46-35.el7_9.src.rpm",
+    1014: "GPLv3+",
+}
+
+
+def test_bdb_rpmdb_offpage_values():
+    """CentOS-7-style Packages: header blobs as off-page overflow chains,
+    including one spanning multiple overflow pages."""
+    from trivy_tpu.analyzer.pkg_rpm import parse_rpmdb_bdb
+
+    big = dict(OPENSSL_HDR)
+    big[5000] = "x" * 9000  # force a multi-page overflow chain
+    data = build_bdb_packages(
+        [encode_header_blob(BASH_HDR), encode_header_blob(big)]
+    )
+    pkgs = parse_rpmdb_bdb(data)
+    assert [(p.name, p.version, p.release) for p in pkgs] == [
+        ("bash", "4.2.46", "35.el7_9"),
+        ("openssl-libs", "3.0.7", "16.el9"),
+    ]
+    assert pkgs[0].src_name == "bash"
+
+
+def test_bdb_rpmdb_big_endian_and_inline():
+    from trivy_tpu.analyzer.pkg_rpm import parse_rpmdb_bdb
+
+    data = build_bdb_packages(
+        [encode_header_blob(BASH_HDR)], endian=">", inline_small=True
+    )
+    pkgs = parse_rpmdb_bdb(data)
+    assert [(p.name, p.version) for p in pkgs] == [("bash", "4.2.46")]
+
+
+def test_bdb_rpmdb_via_analyzer_path():
+    """The analyzer claims var/lib/rpm/Packages and routes BDB content to
+    the BDB parser; ndb still warn-skips."""
+    from trivy_tpu.analyzer.core import AnalysisInput
+    from trivy_tpu.analyzer.pkg_rpm import RpmDbAnalyzer
+
+    a = RpmDbAnalyzer()
+    assert a.required("var/lib/rpm/Packages", 1024, 0o644)
+    assert not a.required("var/lib/rpm/Packages.db", 1024, 0o644)  # ndb
+    data = build_bdb_packages([encode_header_blob(BASH_HDR)])
+    res = a.analyze(
+        AnalysisInput(
+            file_path="var/lib/rpm/Packages", content=data,
+            dir="/", size=len(data), mode=0o644,
+        )
+    )
+    pkgs = res.package_infos[0].packages
+    assert [(p.name, p.epoch) for p in pkgs] == [("bash", 0)]
+
+
+def test_bdb_rpmdb_corrupt_is_empty_not_crash():
+    from trivy_tpu.analyzer.pkg_rpm import parse_rpmdb_bdb
+
+    data = bytearray(build_bdb_packages([encode_header_blob(BASH_HDR)]))
+    struct.pack_into("<H", data, 4096 + 28, 0xFFFF)  # wreck the value slot
+    assert parse_rpmdb_bdb(bytes(data)) == []
+    assert parse_rpmdb_bdb(b"\x00" * 600) == []

@@ -1,11 +1,13 @@
 """RPM database analyzer (pkg/fanal/analyzer/pkg/rpm/rpm.go).
 
 Reads the rpmdb of RHEL-family images.  Modern databases (RHEL9+, Fedora,
-recent Amazon Linux) are sqlite — parsed here with the stdlib sqlite3
-module plus a from-scratch rpm header-blob decoder (the store format: two
-big-endian counts, an index of 16-byte (tag, type, offset, count) entries,
-then the data region).  Legacy BerkeleyDB (`Packages`) and ndb databases
-log a warning and are skipped — a documented divergence; the reference
+recent Amazon Linux) are sqlite — parsed with the stdlib sqlite3 module;
+legacy BerkeleyDB hash databases (`Packages` on RHEL/CentOS <= 8, Amazon
+Linux 2) read through the from-scratch BDB reader (trivy_tpu/db/bdb.py).
+Both feed the same rpm header-blob decoder (the store format: two
+big-endian counts, an index of 16-byte (tag, type, offset, count)
+entries, then the data region).  Only ndb (`Packages.db`, SLE 15 /
+openSUSE Tumbleweed) remains a warn-and-skip divergence; the reference
 links go-rpmdb for all three formats.
 """
 
@@ -33,10 +35,12 @@ _SQLITE_PATHS = (
     "var/lib/rpm/rpmdb.sqlite",
     "usr/lib/sysimage/rpm/rpmdb.sqlite",
 )
-_LEGACY_PATHS = (
+_BDB_PATHS = (
     "var/lib/rpm/Packages",
-    "var/lib/rpm/Packages.db",
     "usr/lib/sysimage/rpm/Packages",
+)
+_NDB_PATHS = (
+    "var/lib/rpm/Packages.db",
     "usr/lib/sysimage/rpm/Packages.db",
 )
 
@@ -97,24 +101,9 @@ def _src_name(sourcerpm: str) -> str:
     return s
 
 
-def parse_rpmdb_sqlite(content: bytes) -> list[Package]:
-    """The sqlite rpmdb: table Packages(hnum, blob) of header stores."""
-    with tempfile.NamedTemporaryFile(suffix=".sqlite", delete=False) as tmp:
-        tmp.write(content)
-        path = tmp.name
-    try:
-        conn = sqlite3.connect(path)
-        try:
-            rows = conn.execute("SELECT blob FROM Packages").fetchall()
-        finally:
-            conn.close()
-    except sqlite3.DatabaseError:
-        return []
-    finally:
-        os.unlink(path)
-
+def _packages_from_blobs(blobs) -> list[Package]:
     out: list[Package] = []
-    for (blob,) in rows:
+    for blob in blobs:
         hdr = parse_header_blob(blob)
         name = hdr.get(_TAG_NAME, "")
         version = hdr.get(_TAG_VERSION, "")
@@ -140,28 +129,63 @@ def parse_rpmdb_sqlite(content: bytes) -> list[Package]:
     return out
 
 
+def parse_rpmdb_sqlite(content: bytes) -> list[Package]:
+    """The sqlite rpmdb: table Packages(hnum, blob) of header stores."""
+    with tempfile.NamedTemporaryFile(suffix=".sqlite", delete=False) as tmp:
+        tmp.write(content)
+        path = tmp.name
+    try:
+        conn = sqlite3.connect(path)
+        try:
+            rows = conn.execute("SELECT blob FROM Packages").fetchall()
+        finally:
+            conn.close()
+    except sqlite3.DatabaseError:
+        return []
+    finally:
+        os.unlink(path)
+    return _packages_from_blobs(blob for (blob,) in rows)
+
+
+def parse_rpmdb_bdb(content: bytes) -> list[Package]:
+    """The BDB hash rpmdb (CentOS <= 8 `Packages`): one header blob per
+    stored value."""
+    from trivy_tpu.db.bdb import BdbError, BdbHashReader
+
+    try:
+        return _packages_from_blobs(BdbHashReader(content).values())
+    except BdbError as e:
+        logger.warning("unreadable BerkeleyDB rpm database: %s", e)
+        return []
+
+
 class RpmDbAnalyzer(Analyzer):
     def type(self) -> str:
         return RPM
 
     def version(self) -> int:
-        return 1
+        return 2  # v2: BerkeleyDB hash Packages parsed (was warn-skip)
 
     def required(self, file_path: str, size: int, mode: int) -> bool:
         p = file_path.lstrip("/")
-        if p in _LEGACY_PATHS:
-            # Warn at claim time so the (often large) BerkeleyDB/ndb file is
-            # never read into memory just to be discarded.
+        if p in _NDB_PATHS:
+            # Warn at claim time so the (often large) ndb file is never
+            # read into memory just to be discarded.
             logger.warning(
-                "legacy rpm database format at %s (BerkeleyDB/ndb) is not "
-                "supported; packages from it are not reported",
+                "ndb rpm database format at %s is not supported; "
+                "packages from it are not reported",
                 file_path,
             )
             return False
-        return p in _SQLITE_PATHS
+        return p in _SQLITE_PATHS or p in _BDB_PATHS
 
     def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
-        pkgs = parse_rpmdb_sqlite(inp.content)
+        from trivy_tpu.db.bdb import is_bdb_hash
+
+        if is_bdb_hash(inp.content):
+            pkgs = parse_rpmdb_bdb(inp.content)
+        else:
+            pkgs = parse_rpmdb_sqlite(inp.content)
         if not pkgs:
             return None
         return AnalysisResult(

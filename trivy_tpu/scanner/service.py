@@ -66,6 +66,12 @@ class LocalDriver(Driver):
             results.extend(
                 self.vuln_detector.detect(target, detail, options)  # type: ignore[attr-defined]
             )
+        elif getattr(options, "list_all_packages", False):
+            # No vulnerability DB, but the caller wants the package
+            # inventory (SBOM formats, --list-all-pkgs): emit the package
+            # results without detection — SBOM generation must not require
+            # a DB download (run.go format handling).
+            results.extend(self._packages_to_results(target, detail, options))
 
         if SCANNER_SECRET in options.scanners:
             results.extend(self._secrets_to_results(detail))
@@ -85,6 +91,25 @@ class LocalDriver(Driver):
         )
 
         return results, detail.os
+
+    @staticmethod
+    def _packages_to_results(target, detail, options) -> list[Result]:
+        """Package inventory rows with no vulnerabilities (DB-less SBOM);
+        same shapes and pkg_types gating as VulnerabilityScanner.detect."""
+        from trivy_tpu.scanner.vuln import (
+            has_os_pkgs,
+            lang_pkgs_result,
+            os_pkgs_result,
+        )
+
+        pkg_types = getattr(options, "pkg_types", ["os", "library"])
+        out: list[Result] = []
+        if "os" in pkg_types and has_os_pkgs(detail):
+            out.append(os_pkgs_result(target, detail, [], detail.packages))
+        if "library" in pkg_types:
+            for app in detail.applications:
+                out.append(lang_pkgs_result(app, [], app.packages))
+        return out
 
     @staticmethod
     def _secrets_to_results(detail) -> list[Result]:

@@ -39,6 +39,40 @@ def init_vuln_scanner(
     return VulnerabilityScanner(db)
 
 
+def os_pkgs_result(target: str, detail, vulns, packages) -> Result:
+    """The OS-packages result shape — one definition so the DB-less
+    inventory path (service.py) and detection agree on target naming."""
+    return Result(
+        target=f"{target} ({detail.os.family} {detail.os.name})",
+        result_class=ResultClass.OS_PKGS,
+        result_type=detail.os.family,
+        vulnerabilities=sorted(
+            vulns, key=lambda v: (v.pkg_name, v.vulnerability_id)
+        ),
+        packages=list(packages),
+    )
+
+
+def lang_pkgs_result(app, vulns, packages) -> Result:
+    return Result(
+        target=app.file_path or app.app_type,
+        result_class=ResultClass.LANG_PKGS,
+        result_type=app.app_type,
+        vulnerabilities=sorted(
+            vulns, key=lambda v: (v.pkg_name, v.vulnerability_id)
+        ),
+        packages=list(packages),
+    )
+
+
+def has_os_pkgs(detail) -> bool:
+    return (
+        detail.os is not None
+        and not detail.os.is_empty()
+        and bool(detail.packages)
+    )
+
+
 @dataclass
 class VulnerabilityScanner:
     db: VulnDB
@@ -46,29 +80,15 @@ class VulnerabilityScanner:
     def detect(self, target: str, detail: ArtifactDetail, options) -> list[Result]:
         results: list[Result] = []
         pkg_types = getattr(options, "pkg_types", ["os", "library"])
+        list_all = getattr(options, "list_all_packages", False)
 
-        if (
-            "os" in pkg_types
-            and detail.os is not None
-            and not detail.os.is_empty()
-            and detail.packages
-        ):
+        if "os" in pkg_types and has_os_pkgs(detail):
             vulns = OSPkgDetector(self.db).detect(detail.os, detail.packages)
-            if vulns or getattr(options, "list_all_packages", False):
+            if vulns or list_all:
                 results.append(
-                    Result(
-                        target=f"{target} ({detail.os.family} {detail.os.name})",
-                        result_class=ResultClass.OS_PKGS,
-                        result_type=detail.os.family,
-                        vulnerabilities=sorted(
-                            vulns,
-                            key=lambda v: (v.pkg_name, v.vulnerability_id),
-                        ),
-                        packages=(
-                            list(detail.packages)
-                            if getattr(options, "list_all_packages", False)
-                            else []
-                        ),
+                    os_pkgs_result(
+                        target, detail, vulns,
+                        detail.packages if list_all else [],
                     )
                 )
 
@@ -76,21 +96,11 @@ class VulnerabilityScanner:
             detector = LibraryDetector(self.db)
             for app in detail.applications:
                 vulns = detector.detect_app(app)
-                if not vulns and not getattr(options, "list_all_packages", False):
+                if not vulns and not list_all:
                     continue
                 results.append(
-                    Result(
-                        target=app.file_path or app.app_type,
-                        result_class=ResultClass.LANG_PKGS,
-                        result_type=app.app_type,
-                        vulnerabilities=sorted(
-                            vulns, key=lambda v: (v.pkg_name, v.vulnerability_id)
-                        ),
-                        packages=(
-                            list(app.packages)
-                            if getattr(options, "list_all_packages", False)
-                            else []
-                        ),
+                    lang_pkgs_result(
+                        app, vulns, app.packages if list_all else []
                     )
                 )
         return results
