@@ -351,6 +351,18 @@ def main() -> None:
         except Exception as e:
             detail["device_engine"] = {"error": f"{type(e).__name__}: {e}"}
 
+    try:
+        import resource
+        import sys as _sys
+
+        # ru_maxrss is KiB on Linux, bytes on macOS
+        div = 1 << 20 if _sys.platform == "darwin" else 1024
+        detail["peak_rss_mb"] = round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / div, 1
+        )
+    except Exception:
+        pass
+
     files_per_sec = detail["files_per_sec"]
     print(
         json.dumps(

@@ -62,7 +62,10 @@ class SecretScannerOption:
     """analyzer.SecretScannerOption."""
 
     config_path: str = ""
-    backend: str = "tpu"  # "tpu" (device sieve) or "cpu" (oracle)
+    # "auto" (hybrid: host sieve + cost-gated device verify — the product
+    # default; never boots a device runtime by itself), "tpu" (all-device
+    # sieve), "cpu" (oracle).
+    backend: str = "auto"
 
 
 @dataclass
@@ -396,12 +399,27 @@ class AnalyzerGroup:
 
 
 MAX_BATCH_BYTES = 256 << 20  # per device-batch host residency cap
+# Entries above this analyze in their own singleton slice: a near-100MiB
+# file must not stack on top of a quarter-gigabyte of batchmates (the
+# fanal cached-file role, pkg/fanal/walker/cached_file.go — the spill
+# itself lives at the source layer here: registry blobs arrive as
+# disk-backed SpooledTemporaryFiles, daemon exports as temp tars, and
+# layer/fs openers re-read lazily from those seekable stores, so slices
+# are the only place whole contents are resident).
+BIG_ENTRY_BYTES = 32 << 20
 
 
 def _byte_bounded(entries: list[FileEntry], max_bytes: int):
     group: list[FileEntry] = []
     total = 0
     for e in entries:
+        if e.size > BIG_ENTRY_BYTES:
+            # Big entries slice alone; the in-progress small-file group
+            # keeps accumulating (results are merged+sorted, so yield
+            # order is not load-bearing, and fragmenting small batches
+            # around each big file would waste per-batch dispatch).
+            yield [e]
+            continue
         if group and total + e.size > max_bytes:
             yield group
             group, total = [], 0

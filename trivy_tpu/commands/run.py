@@ -51,7 +51,7 @@ class Options:
     skip_files: list[str] = field(default_factory=list)
     skip_dirs: list[str] = field(default_factory=list)
     secret_config: str = "trivy-secret.yaml"
-    secret_backend: str = "tpu"
+    secret_backend: str = "auto"  # hybrid; never boots a device runtime by itself
     ignore_file: str = ""
     disabled_analyzers: list[str] = field(default_factory=list)
     server_addr: str = ""  # non-empty => client mode (remote driver)

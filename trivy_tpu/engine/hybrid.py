@@ -51,8 +51,27 @@ GAP = 4  # zero bytes between files: no 4-byte window spans two files
 
 
 def _tpu_default_backend() -> bool:
-    """True when jax's default backend is a TPU (cheap after first call)."""
+    """True when jax is ALREADY initialized in this process and its
+    default backend is a TPU.  The guard is deliberate: importing jax
+    here would boot the TPU runtime (libtpu measured at ~4.5GB host RSS
+    and seconds of init) just to ask whether a chip exists — a host-only
+    scan must never pay that.  Processes that already use the device
+    (the all-device engine, meshed scans) have paid it, and only they
+    get the device verify seat by default."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return False
     try:
+        from jax._src import xla_bridge
+
+        # Backend registry cache: populated only after something in this
+        # process actually initialized a backend (ran a computation /
+        # queried devices).  jax merely being imported (transitively via
+        # flax/optax in an embedding app) must not trigger init here —
+        # jax.default_backend() itself would boot the runtime.
+        if not getattr(xla_bridge, "_backends", None):
+            return False
         import jax
 
         return jax.default_backend() == "tpu"
