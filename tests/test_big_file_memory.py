@@ -87,8 +87,9 @@ art = ImageArtifact(
 )
 ref = art.inspect()
 peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+div = (1 << 20) if sys.platform == "darwin" else 1024  # ru_maxrss units
 print(json.dumps({
-    "base_mb": base / 1024, "peak_mb": peak / 1024,
+    "base_mb": base / div, "peak_mb": peak / div,
     "blob_ids": len(ref.blob_ids),
 }))
 """

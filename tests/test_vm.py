@@ -328,3 +328,59 @@ def test_lvm_junk_values_surface_as_lvmerror():
             logical_volumes(io.BytesIO(b"\x00" * 8192), 0)
     finally:
         lvm_mod.read_metadata_text = orig
+
+
+# ---------------------------------------------------------------------------
+# XFS
+# ---------------------------------------------------------------------------
+
+
+def _xfs_files():
+    big = (b"line of filler text for a multi-extent file\n" * 400)[:12000]
+    return {
+        "readme.txt": b"hello from xfs root\n",
+        "etc/system-release": b"Amazon Linux release 2 (Karoo)\n",
+        "etc/app.env": b"AWS_ACCESS_KEY_ID=AKIAQ7R2MX4PLW9ZKB57\n",
+        "opt/data0.txt": b"alpha\n",
+        "opt/data1.txt": b"beta\n",
+        "opt/data2.txt": b"gamma\n",
+        "opt/big.log": big,
+    }
+
+
+def test_xfs_reader_walk(tmp_path):
+    import io
+
+    from xfs_fixture import build_xfs
+
+    from trivy_tpu.vm.xfs import XfsReader, is_xfs
+
+    files = _xfs_files()
+    img = io.BytesIO(build_xfs(files))
+    assert is_xfs(img)
+    reader = XfsReader(img)
+    walked = {e.path: e for e in reader.walk()}
+    assert set(walked) == set(files)
+    for path, content in files.items():
+        assert walked[path].size == len(content), path
+        assert walked[path].opener() == content, path
+    assert walked["etc/system-release"].mode == 0o644
+
+
+def test_xfs_in_partitioned_disk(tmp_path):
+    """A full VM artifact scan over an MBR disk whose partition holds
+    XFS: os detection + secrets come out, like the ext4 path."""
+    from xfs_fixture import build_xfs
+
+    from trivy_tpu.artifact.vm import VMArtifact
+    from trivy_tpu.analyzer.core import AnalyzerOptions
+    from trivy_tpu.cache.store import MemoryCache
+
+    disk = _wrap_mbr(tmp_path, build_xfs(_xfs_files()))
+    cache = MemoryCache()
+    art = VMArtifact(str(disk), cache, analyzer_options=AnalyzerOptions())
+    ref = art.inspect()
+    blob = cache.get_blob(ref.blob_ids[0])
+    assert blob.os is not None and blob.os.family == "amazon"
+    secrets = [f.rule_id for s in blob.secrets for f in s.findings]
+    assert "aws-access-key-id" in secrets

@@ -1,0 +1,212 @@
+"""Minimal XFS v5 image WRITER for test fixtures.
+
+Builds a single-AG filesystem with the on-disk layouts trivy_tpu.vm.xfs
+reads: v5 superblock, v3 inodes at their arithmetic locations,
+short-form and single-block ("XDB3") and multi-block ("XDD3")
+directories, and extent-list regular files.  Written independently of
+the reader, following the xfs_format.h layouts, so mistakes fail the
+round-trip tests instead of cancelling out.
+"""
+
+from __future__ import annotations
+
+import struct
+
+BLOCK = 4096
+INODE_SIZE = 512
+INOPBLOG = 3  # 8 inodes per 4k block
+AGBLOCKS = 256  # 1MB AG
+AGBLKLOG = 8
+ROOTINO = 64  # agbno 8, index 0
+
+
+class _Image:
+    def __init__(self):
+        self.buf = bytearray(AGBLOCKS * BLOCK)
+        self.next_ino = ROOTINO
+        self.next_block = 16  # data blocks start here; inodes at 8..15
+
+    def alloc_ino(self) -> int:
+        ino = self.next_ino
+        self.next_ino += 1
+        return ino
+
+    def alloc_blocks(self, n: int) -> int:
+        b = self.next_block
+        self.next_block += n
+        return b
+
+    def write_block(self, bno: int, data: bytes) -> None:
+        assert len(data) <= BLOCK
+        self.buf[bno * BLOCK : bno * BLOCK + len(data)] = data
+
+    def inode_offset(self, ino: int) -> int:
+        agbno = ino >> INOPBLOG
+        idx = ino & ((1 << INOPBLOG) - 1)
+        return agbno * BLOCK + idx * INODE_SIZE
+
+    def write_inode(
+        self, ino: int, mode: int, fmt: int, size: int, fork: bytes,
+        nextents: int = 0,
+    ) -> None:
+        raw = bytearray(INODE_SIZE)
+        struct.pack_into(">H", raw, 0, 0x494E)  # "IN"
+        struct.pack_into(">H", raw, 2, mode)
+        raw[4] = 3  # version 3
+        raw[5] = fmt
+        struct.pack_into(">Q", raw, 56, size)
+        struct.pack_into(">I", raw, 76, nextents)
+        raw[176 : 176 + len(fork)] = fork
+        off = self.inode_offset(ino)
+        self.buf[off : off + INODE_SIZE] = raw
+
+
+def _extent_rec(fileoff: int, fsbno: int, count: int) -> bytes:
+    l0 = (fileoff << 9) | (fsbno >> 43)
+    l1 = ((fsbno & ((1 << 43) - 1)) << 21) | count
+    return struct.pack(">QQ", l0, l1)
+
+
+def _sf_dir(parent: int, entries: list[tuple[str, int]]) -> bytes:
+    out = bytearray()
+    out.append(len(entries))
+    out.append(0)  # i8count: 4-byte inumbers
+    out += struct.pack(">I", parent)
+    for name, ino in entries:
+        nb = name.encode()
+        out.append(len(nb))
+        out += b"\x00\x00"  # offset (hash ordering hint; unused by reads)
+        out += nb
+        out.append(2 if ino_is_dir.get(ino) else 1)  # ftype
+        out += struct.pack(">I", ino)
+    return bytes(out)
+
+
+ino_is_dir: dict[int, bool] = {}
+
+
+def _data_entries(entries: list[tuple[str, int]], start: int, end: int) -> bytes:
+    out = bytearray()
+    for name, ino in entries:
+        nb = name.encode()
+        elen = (8 + 1 + len(nb) + 1 + 2 + 7) & ~7
+        rec = bytearray(elen)
+        struct.pack_into(">Q", rec, 0, ino)
+        rec[8] = len(nb)
+        rec[9 : 9 + len(nb)] = nb
+        rec[9 + len(nb)] = 2 if ino_is_dir.get(ino) else 1
+        struct.pack_into(">H", rec, elen - 2, start + len(out))
+        out += rec
+    free = end - start - len(out)
+    if free >= 8:
+        unused = bytearray(free)
+        struct.pack_into(">H", unused, 0, 0xFFFF)
+        struct.pack_into(">H", unused, 2, free)
+        out += unused
+    return bytes(out)
+
+
+def build_xfs(files: dict[str, bytes]) -> bytes:
+    """files: path -> content.  Layout: / is short-form; /etc is a
+    single-block (XDB3) dir; /opt is a multi-block (XDD3) dir with a
+    leaf extent; everything under those three roots."""
+    img = _Image()
+    ino_is_dir.clear()
+
+    root_ino = img.alloc_ino()
+    etc_ino = img.alloc_ino()
+    opt_ino = img.alloc_ino()
+    ino_is_dir[root_ino] = ino_is_dir[etc_ino] = ino_is_dir[opt_ino] = True
+
+    groups: dict[str, list[tuple[str, int]]] = {"": [], "etc": [], "opt": []}
+    contents: dict[int, bytes] = {}
+    for path, content in files.items():
+        top, _, rest = path.partition("/")
+        ino = img.alloc_ino()
+        contents[ino] = content
+        if rest and top in ("etc", "opt"):
+            groups[top].append((rest, ino))
+        else:
+            groups[""].append((path, ino))
+
+    # regular files: extent lists (split the last one into two extents
+    # when it spans blocks, to exercise multi-extent reads)
+    for ino, content in contents.items():
+        nblocks = max(1, -(-len(content) // BLOCK))
+        if nblocks > 1:
+            b1 = img.alloc_blocks(1)
+            b2 = img.alloc_blocks(nblocks - 1)
+            img.write_block(b1, content[:BLOCK])
+            for k in range(nblocks - 1):
+                img.write_block(
+                    b2 + k, content[BLOCK + k * BLOCK : BLOCK + (k + 1) * BLOCK]
+                )
+            fork = _extent_rec(0, b1, 1) + _extent_rec(1, b2, nblocks - 1)
+            img.write_inode(
+                ino, 0o100644, 2, len(content), fork, nextents=2
+            )
+        else:
+            b = img.alloc_blocks(1)
+            img.write_block(b, content)
+            img.write_inode(
+                ino, 0o100644, 2, len(content),
+                _extent_rec(0, b, 1), nextents=1,
+            )
+
+    # /etc: single-block dir (XDB3: header, entries, leaf + tail at end)
+    etc_block = img.alloc_blocks(1)
+    blk = bytearray(BLOCK)
+    struct.pack_into(">I", blk, 0, 0x58444233)  # XDB3
+    n = len(groups["etc"]) + 2
+    tail_leaf = n * 8 + 8
+    data_end = BLOCK - tail_leaf
+    ents = [(".", etc_ino), ("..", root_ino)] + groups["etc"]
+    blk[64:data_end] = _data_entries(ents, 64, data_end)[: data_end - 64]
+    struct.pack_into(">I", blk, BLOCK - 8, n)  # tail.count
+    img.write_block(etc_block, blk)
+    img.write_inode(
+        etc_ino, 0o040755, 2, BLOCK, _extent_rec(0, etc_block, 1), nextents=1
+    )
+
+    # /opt: multi-block dir — two XDD3 data blocks + one leaf block the
+    # reader must skip (fileoff at the 32GB leaf offset)
+    d1 = img.alloc_blocks(1)
+    d2 = img.alloc_blocks(1)
+    leafb = img.alloc_blocks(1)
+    half = len(groups["opt"]) // 2
+    for bno, ents in (
+        (d1, [(".", opt_ino), ("..", root_ino)] + groups["opt"][:half]),
+        (d2, groups["opt"][half:]),
+    ):
+        blk = bytearray(BLOCK)
+        struct.pack_into(">I", blk, 0, 0x58444433)  # XDD3
+        blk[64:] = _data_entries(ents, 64, BLOCK)[: BLOCK - 64]
+        img.write_block(bno, blk)
+    img.write_block(leafb, b"\x00" * BLOCK)  # leaf: lookup metadata only
+    leaf_fo = (32 << 30) // BLOCK
+    fork = (
+        _extent_rec(0, d1, 1)
+        + _extent_rec(1, d2, 1)
+        + _extent_rec(leaf_fo, leafb, 1)
+    )
+    img.write_inode(opt_ino, 0o040755, 2, 2 * BLOCK, fork, nextents=3)
+
+    # / root: short-form
+    root_entries = [("etc", etc_ino), ("opt", opt_ino)] + groups[""]
+    sf = _sf_dir(root_ino, root_entries)
+    img.write_inode(root_ino, 0o040755, 1, len(sf), sf)
+
+    # superblock (only fields at their real offsets)
+    sb = bytearray(512)
+    struct.pack_into(">I", sb, 0, 0x58465342)  # XFSB
+    struct.pack_into(">I", sb, 4, BLOCK)
+    struct.pack_into(">Q", sb, 56, ROOTINO)
+    struct.pack_into(">I", sb, 84, AGBLOCKS)
+    struct.pack_into(">I", sb, 88, 1)  # agcount
+    struct.pack_into(">H", sb, 104, INODE_SIZE)
+    struct.pack_into(">H", sb, 106, 1 << INOPBLOG)
+    sb[123] = INOPBLOG
+    sb[124] = AGBLKLOG
+    sb[192] = 0  # dirblklog
+    img.buf[: len(sb)] = sb
+    return bytes(img.buf)

@@ -1,8 +1,9 @@
 """VM disk-image artifact (pkg/fanal/artifact/vm/vm.go).
 
-Walks every ext partition of a raw disk image through the analyzer group,
-producing one blob per partition keyed on the image digest + partition
-offset + analyzer versions (the content-addressed cache contract)."""
+Walks every ext/XFS partition of a raw disk image through the analyzer
+group, producing one blob per partition keyed on the image digest +
+partition offset + analyzer versions (the content-addressed cache
+contract)."""
 
 from __future__ import annotations
 
@@ -55,7 +56,7 @@ class VMArtifact:
         versions = (
             json.dumps(self.group.analyzer_versions(), sort_keys=True)
             + self.group.options.cache_key_extra
-            + "|vm-walker:2"
+            + "|vm-walker:3"  # v3: XFS partitions/LVs walked
         )
         size = os.path.getsize(self.target)
         blob_ids: list[str] = []
@@ -101,29 +102,34 @@ class VMArtifact:
                 return BlobInfo()
             merged = BlobInfo()
             scanned = 0
+            from trivy_tpu.vm.xfs import is_xfs
+
             for lv in lvs:
                 view = LVReader(img, lv)
-                if not is_ext(view, 0):
+                if not (is_ext(view, 0) or is_xfs(view, 0)):
                     logger.info(
-                        "LV %s/%s holds no ext filesystem; skipped",
+                        "LV %s/%s holds no ext/XFS filesystem; skipped",
                         lv.vg_name, lv.name,
                     )
                     continue
                 scanned += 1
                 merged = self._merge_blob(
-                    merged, self._inspect_ext(view, 0, f"LV {lv.name}")
+                    merged, self._inspect_fs(view, 0, f"LV {lv.name}")
                 )
             if not scanned:
                 logger.warning(
                     "partition %d: no readable linear LVs", part.index
                 )
             return merged
-        if not is_ext(img, part.offset):
+        from trivy_tpu.vm.xfs import is_xfs
+
+        if not (is_ext(img, part.offset) or is_xfs(img, part.offset)):
             logger.info(
-                "partition %d holds no ext filesystem; skipped", part.index
+                "partition %d holds no ext/XFS filesystem; skipped",
+                part.index,
             )
             return BlobInfo()
-        return self._inspect_ext(img, part.offset, f"partition {part.index}")
+        return self._inspect_fs(img, part.offset, f"partition {part.index}")
 
     @staticmethod
     def _merge_blob(into: BlobInfo, other: BlobInfo) -> BlobInfo:
@@ -137,15 +143,34 @@ class VMArtifact:
         into.build_info = into.build_info or other.build_info
         return into
 
-    def _inspect_ext(self, img, offset: int, what: str) -> BlobInfo:
+    def _inspect_fs(self, img, offset: int, what: str) -> BlobInfo:
+        """Walk one ext or XFS filesystem through the analyzer group."""
+        from trivy_tpu.vm.xfs import XfsError, XfsReader, is_xfs
+
         try:
-            reader = Ext4Reader(img, offset)
-        except Ext4Error as e:
+            if is_xfs(img, offset):
+                reader = XfsReader(img, offset)
+            else:
+                reader = Ext4Reader(img, offset)
+        except (Ext4Error, XfsError) as e:
             logger.warning("%s: %s", what, e)
             return BlobInfo()
 
         def entries():
-            for e in reader.walk():
+            # Structural failures mid-walk (btree dirs, corrupt entries)
+            # end THIS filesystem's walk loudly with whatever was already
+            # yielded — one bad directory must not abort the disk scan;
+            # per-FILE opener failures are handled downstream (OSError
+            # tolerance in _read_inputs).
+            it = reader.walk()
+            while True:
+                try:
+                    e = next(it)
+                except StopIteration:
+                    return
+                except (Ext4Error, XfsError) as err:
+                    logger.warning("%s: walk aborted: %s", what, err)
+                    return
                 yield FileEntry(
                     path=e.path, size=e.size, mode=e.mode, opener=e.opener
                 )

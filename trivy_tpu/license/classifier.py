@@ -8,9 +8,11 @@ the corpus is a [L, D] matrix built once, and classifying a whole scan's
 worth of license files is a single [F, D] x [D, L] matmul — MXU work,
 batched, static shapes — with cosine scores as confidences.
 
-Corpus sources: the distribution's canonical texts under
-/usr/share/common-licenses plus embedded templates for the short
-permissive licenses (MIT/ISC/BSD are standardized wordings).
+Corpus sources, in override order: embedded short templates, the PACKAGED
+canonical corpus (trivy_tpu/license/corpus/*.txt — 24 SPDX texts shipped
+with the framework, so `--license-full` works without any OS-provided
+corpus; license texts are freely redistributable), then whatever the
+host's /usr/share/common-licenses adds on top.
 """
 
 from __future__ import annotations
@@ -167,14 +169,34 @@ class Match:
 class FullTextClassifier:
     """Corpus matrix built once; classification is one batched matmul."""
 
+    PACKAGED_DIR = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "corpus"
+    )
+
     def __init__(self, extra: dict[str, str] | None = None):
         corpus: dict[str, str] = dict(_EMBEDDED)
+        # Packaged canonical texts (filename = SPDX id): the classifier
+        # must work without OS-provided corpora (VERDICT r3 #10).
+        if os.path.isdir(self.PACKAGED_DIR):
+            for fname in sorted(os.listdir(self.PACKAGED_DIR)):
+                if not fname.endswith(".txt"):
+                    continue
+                try:
+                    with open(
+                        os.path.join(self.PACKAGED_DIR, fname),
+                        encoding="utf-8", errors="replace",
+                    ) as f:
+                        # embedded templates are the canonical wordings;
+                        # packaged files fill in everything they lack
+                        corpus.setdefault(fname[:-4], f.read())
+                except OSError:
+                    continue
         if os.path.isdir(_SYSTEM_DIR):
             for fname, spdx in _SYSTEM_LICENSES.items():
                 path = os.path.join(_SYSTEM_DIR, fname)
                 try:
                     with open(path, encoding="utf-8", errors="replace") as f:
-                        corpus[spdx] = f.read()
+                        corpus.setdefault(spdx, f.read())
                 except OSError:
                     continue
         corpus.update(extra or {})
