@@ -571,7 +571,7 @@ def test_bdb_rpmdb_via_analyzer_path():
 
     a = RpmDbAnalyzer()
     assert a.required("var/lib/rpm/Packages", 1024, 0o644)
-    assert not a.required("var/lib/rpm/Packages.db", 1024, 0o644)  # ndb
+    assert a.required("var/lib/rpm/Packages.db", 1024, 0o644)  # ndb
     data = build_bdb_packages([encode_header_blob(BASH_HDR)])
     res = a.analyze(
         AnalysisInput(
@@ -590,3 +590,76 @@ def test_bdb_rpmdb_corrupt_is_empty_not_crash():
     struct.pack_into("<H", data, 4096 + 28, 0xFFFF)  # wreck the value slot
     assert parse_rpmdb_bdb(bytes(data)) == []
     assert parse_rpmdb_bdb(b"\x00" * 600) == []
+
+
+# ---------------------------------------------------------------------------
+# ndb rpmdb (SLE 15 / Tumbleweed Packages.db)
+# ---------------------------------------------------------------------------
+
+
+def build_ndb_packages(blobs: list[bytes]) -> bytes:
+    """Test-only ndb writer following rpm's lib/backend/ndb/rpmpkg.c
+    layout (independent of the reader)."""
+    slot_npages = 1
+    out = bytearray(slot_npages * 4096)
+    # 32-byte header: magic, version, generation, slotnpages, nextpkgidx
+    struct.pack_into("<IIIII", out, 0, 0x506D7052, 0, 1, slot_npages,
+                     len(blobs) + 1)
+    # every slot carries the Slot magic; free ones keep index 0
+    for off in range(32, slot_npages * 4096, 16):
+        struct.pack_into("<IIII", out, off, 0x746F6C53, 0, 0, 0)
+    body = bytearray()
+    base_blk = (slot_npages * 4096) // 16
+    for i, blob in enumerate(blobs):
+        index = i + 1
+        blkoff = base_blk + len(body) // 16
+        blkcnt = -(-(16 + len(blob)) // 16)
+        struct.pack_into(
+            "<IIII", out, 32 + 16 * i, 0x746F6C53, index, blkoff, blkcnt
+        )
+        rec = bytearray(blkcnt * 16)
+        struct.pack_into("<IIII", rec, 0, 0x53626C42, index, 1, len(blob))
+        rec[16 : 16 + len(blob)] = blob
+        body += rec
+    return bytes(out) + bytes(body)
+
+
+def test_ndb_rpmdb_values():
+    from trivy_tpu.analyzer.pkg_rpm import parse_rpmdb_ndb
+
+    data = build_ndb_packages(
+        [encode_header_blob(BASH_HDR), encode_header_blob(OPENSSL_HDR)]
+    )
+    pkgs = parse_rpmdb_ndb(data)
+    assert [(p.name, p.version) for p in pkgs] == [
+        ("bash", "4.2.46"), ("openssl-libs", "3.0.7"),
+    ]
+
+
+def test_ndb_rpmdb_via_analyzer():
+    from trivy_tpu.analyzer.core import AnalysisInput
+    from trivy_tpu.analyzer.pkg_rpm import RpmDbAnalyzer
+
+    a = RpmDbAnalyzer()
+    assert a.required("var/lib/rpm/Packages.db", 1024, 0o644)
+    data = build_ndb_packages([encode_header_blob(BASH_HDR)])
+    res = a.analyze(
+        AnalysisInput(
+            file_path="var/lib/rpm/Packages.db", content=data,
+            dir="/", size=len(data), mode=0o644,
+        )
+    )
+    assert [(p.name, p.version) for p in res.package_infos[0].packages] == [
+        ("bash", "4.2.46")
+    ]
+
+
+def test_ndb_rpmdb_corrupt_is_empty_not_crash():
+    from trivy_tpu.analyzer.pkg_rpm import parse_rpmdb_ndb
+
+    data = bytearray(build_ndb_packages([encode_header_blob(BASH_HDR)]))
+    struct.pack_into("<I", data, 4096, 0xDEAD)  # wreck the blob magic
+    assert parse_rpmdb_ndb(bytes(data)) == []
+    data2 = bytearray(build_ndb_packages([encode_header_blob(BASH_HDR)]))
+    struct.pack_into("<I", data2, 48, 0)  # torn slot: magic zeroed
+    assert parse_rpmdb_ndb(bytes(data2)) == []

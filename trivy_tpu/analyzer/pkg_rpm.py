@@ -4,11 +4,11 @@ Reads the rpmdb of RHEL-family images.  Modern databases (RHEL9+, Fedora,
 recent Amazon Linux) are sqlite — parsed with the stdlib sqlite3 module;
 legacy BerkeleyDB hash databases (`Packages` on RHEL/CentOS <= 8, Amazon
 Linux 2) read through the from-scratch BDB reader (trivy_tpu/db/bdb.py).
-Both feed the same rpm header-blob decoder (the store format: two
-big-endian counts, an index of 16-byte (tag, type, offset, count)
-entries, then the data region).  Only ndb (`Packages.db`, SLE 15 /
-openSUSE Tumbleweed) remains a warn-and-skip divergence; the reference
-links go-rpmdb for all three formats.
+ndb databases (`Packages.db`, SLE 15 / openSUSE Tumbleweed) read through
+trivy_tpu/db/ndb.py.  All three feed the same rpm header-blob decoder
+(the store format: two big-endian counts, an index of 16-byte (tag,
+type, offset, count) entries, then the data region), matching the
+reference's go-rpmdb coverage.
 """
 
 from __future__ import annotations
@@ -159,31 +159,36 @@ def parse_rpmdb_bdb(content: bytes) -> list[Package]:
         return []
 
 
+def parse_rpmdb_ndb(content: bytes) -> list[Package]:
+    """The ndb rpmdb (SLE 15 / Tumbleweed `Packages.db`)."""
+    from trivy_tpu.db.ndb import NdbError, NdbReader
+
+    try:
+        return _packages_from_blobs(NdbReader(content).values())
+    except NdbError as e:
+        logger.warning("unreadable ndb rpm database: %s", e)
+        return []
+
+
 class RpmDbAnalyzer(Analyzer):
     def type(self) -> str:
         return RPM
 
     def version(self) -> int:
-        return 2  # v2: BerkeleyDB hash Packages parsed (was warn-skip)
+        return 3  # v2: BDB hash parsed; v3: ndb Packages.db parsed
 
     def required(self, file_path: str, size: int, mode: int) -> bool:
         p = file_path.lstrip("/")
-        if p in _NDB_PATHS:
-            # Warn at claim time so the (often large) ndb file is never
-            # read into memory just to be discarded.
-            logger.warning(
-                "ndb rpm database format at %s is not supported; "
-                "packages from it are not reported",
-                file_path,
-            )
-            return False
-        return p in _SQLITE_PATHS or p in _BDB_PATHS
+        return p in _SQLITE_PATHS or p in _BDB_PATHS or p in _NDB_PATHS
 
     def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
         from trivy_tpu.db.bdb import is_bdb_hash
+        from trivy_tpu.db.ndb import is_ndb
 
         if is_bdb_hash(inp.content):
             pkgs = parse_rpmdb_bdb(inp.content)
+        elif is_ndb(inp.content):
+            pkgs = parse_rpmdb_ndb(inp.content)
         else:
             pkgs = parse_rpmdb_sqlite(inp.content)
         if not pkgs:
