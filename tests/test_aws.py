@@ -117,7 +117,16 @@ class _FakeAws(BaseHTTPRequestHandler):
         n = int(self.headers.get("Content-Length", 0))
         body = json.loads(self.rfile.read(n) or b"{}")
         target = self.headers.get("X-Amz-Target", "")
-        if target.endswith("DescribeTrails"):
+        if target.endswith("ListClusters"):
+            out = {"clusterArns": ["arn:aws:ecs:us-east-1:1:cluster/prod"]}
+        elif target.endswith("DescribeClusters"):
+            out = {"clusters": [{
+                "clusterName": "prod",
+                "settings": [
+                    {"name": "containerInsights", "value": "disabled"}
+                ],
+            }]}
+        elif target.endswith("DescribeTrails"):
             out = {"trailList": [{
                 "Name": "main-trail",
                 "IsMultiRegionTrail": False,
@@ -233,6 +242,26 @@ class _FakeAws(BaseHTTPRequestHandler):
             return self._send(DESCRIBE_VOLUMES)
         if path == "/" and "Action=DescribeSecurityGroups" in query:
             return self._send(DESCRIBE_SGS)
+        if path == "/2015-03-31/functions/":
+            body = json.dumps({"Functions": [
+                {"FunctionName": "ship-logs",
+                 "TracingConfig": {"Mode": "PassThrough"}},
+                {"FunctionName": "traced-fn",
+                 "TracingConfig": {"Mode": "Active"}},
+            ]})
+            data = body.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(data)
+            return
+        if path == "/" and "Action=DescribeClusters" in query:
+            return self._send(
+                "<DescribeClustersResponse><Clusters><Cluster>"
+                "<ClusterIdentifier>warehouse</ClusterIdentifier>"
+                "<Encrypted>false</Encrypted>"
+                "</Cluster></Clusters></DescribeClustersResponse>"
+            )
         if path == "/" and "Action=DescribeDBInstances" in query:
             return self._send(DESCRIBE_DBS)
         if path == "/" and "Action=GetAccountPasswordPolicy" in query:
@@ -418,3 +447,19 @@ def test_eks_adapter_shapes(aws_endpoint):
     prod = res["aws_eks_cluster"]["prod"]
     assert prod["vpc_config"]["endpoint_public_access"] is True
     assert prod["enabled_cluster_log_types"] == []
+
+
+def test_lambda_redshift_ecs_adapters(aws_endpoint):
+    from trivy_tpu.cloud.aws import AwsScanner
+
+    scanner = AwsScanner(
+        services=["lambda", "redshift", "ecs"], endpoint=aws_endpoint
+    )
+    results = scanner.scan()
+    ids = {f.check_id for mc in results for f in mc.failures}
+    assert "AVD-AWS-0066" in ids  # untraced lambda
+    assert "AVD-AWS-0084" in ids  # unencrypted redshift
+    assert "AVD-AWS-0034" in ids  # no container insights
+    # the traced function must not fire the lambda check
+    msgs = " ".join(f.message for mc in results for f in mc.failures)
+    assert "ship-logs" in msgs and "traced-fn" not in msgs

@@ -142,6 +142,7 @@ def test_builtin_specs_resolve_known_checks():
         if f.endswith(".yaml")
     )
     assert {"docker-cis-1.6.0", "k8s-nsa-1.0", "k8s-pss-baseline-0.1",
+            "k8s-pss-restricted-0.1", "k8s-cis-1.23", "aws-cis-1.2",
             "aws-cis-1.4"} <= set(names)
     for name in names:
         spec = load_spec(name)

@@ -165,6 +165,21 @@ TF_CASES = [
         'resource "aws_kinesis_stream" "s" {\n  encryption_type = "KMS"\n}\n',
     ),
     (
+        "AVD-AWS-0066",
+        'resource "aws_lambda_function" "f" {\n  function_name = "x"\n}\n',
+        'resource "aws_lambda_function" "f" {\n  tracing_config {\n    mode = "Active"\n  }\n}\n',
+    ),
+    (
+        "AVD-AWS-0084",
+        'resource "aws_redshift_cluster" "c" {\n  cluster_identifier = "x"\n}\n',
+        'resource "aws_redshift_cluster" "c" {\n  encrypted = true\n}\n',
+    ),
+    (
+        "AVD-AWS-0034",
+        'resource "aws_ecs_cluster" "c" {\n  name = "x"\n}\n',
+        'resource "aws_ecs_cluster" "c" {\n  setting {\n    name = "containerInsights"\n    value = "enabled"\n  }\n}\n',
+    ),
+    (
         "AVD-AWS-0037",
         'resource "aws_efs_file_system" "f" {\n  creation_token = "x"\n}\n',
         'resource "aws_efs_file_system" "f" {\n  encrypted = true\n}\n',

@@ -28,7 +28,7 @@ logger = logging.getLogger(__name__)
 SUPPORTED_SERVICES = (
     "s3", "ec2", "rds", "iam", "cloudtrail", "kms",
     "sns", "sqs", "ecr", "eks", "dynamodb", "cloudfront", "efs",
-    "kinesis", "logs",
+    "kinesis", "logs", "lambda", "redshift", "ecs",
 )
 
 
@@ -774,6 +774,114 @@ class AwsScanner:
             if not token:
                 break
         return {"aws_cloudwatch_log_group": groups} if groups else {}
+
+    def adapt_lambda(self, api: _AwsApi) -> dict:
+        """ListFunctions (REST JSON, Marker-paginated) ->
+        aws_lambda_function resources."""
+        from urllib.parse import quote
+
+        fns: dict[str, dict] = {}
+        marker = None
+        while True:
+            path = "/2015-03-31/functions/"
+            if marker:
+                path += f"?Marker={quote(marker, safe='')}"
+            out = api.call_rest_json("GET", path)
+            for f in out.get("Functions") or []:
+                name = f.get("FunctionName", "")
+                if not name:
+                    continue
+                tracing = f.get("TracingConfig") or {}
+                fns[name] = {
+                    "tracing_config": {
+                        "mode": tracing.get("Mode", "PassThrough")
+                    }
+                }
+            marker = out.get("NextMarker")
+            if not marker:
+                break
+        return {"aws_lambda_function": fns} if fns else {}
+
+    def adapt_redshift(self, api: _AwsApi) -> dict:
+        """DescribeClusters (Marker-paginated Query XML) ->
+        aws_redshift_cluster resources."""
+        from urllib.parse import quote
+
+        clusters: dict[str, dict] = {}
+        marker = None
+        while True:
+            url = "/?Action=DescribeClusters&Version=2012-12-01"
+            if marker:
+                url += f"&Marker={quote(marker, safe='')}"
+            root = api.call("GET", url)
+            if root is None:
+                break
+            for item in root.iter():
+                if _strip_ns(item.tag) != "Cluster":
+                    continue
+                ident = _find(item, "ClusterIdentifier")
+                if ident is None or not ident.text:
+                    continue
+                enc = _find(item, "Encrypted")
+                clusters[ident.text] = {
+                    "encrypted": enc is not None and enc.text == "true"
+                }
+            nxt = next(
+                (
+                    el.text
+                    for el in root.iter()
+                    if _strip_ns(el.tag) == "Marker" and el.text
+                ),
+                None,
+            )
+            if not nxt or nxt == marker:
+                break
+            marker = nxt
+        return {"aws_redshift_cluster": clusters} if clusters else {}
+
+    def adapt_ecs(self, api: _AwsApi) -> dict:
+        """ListClusters + DescribeClusters (JSON protocol, SETTINGS
+        included; 100-ARN describe batches) -> aws_ecs_cluster
+        resources.  Per-cluster describe failures are recorded in
+        self.errors — a degraded page is an error, never a silent pass."""
+        arns: list[str] = []
+        token = None
+        while True:
+            req: dict = {"nextToken": token} if token else {}
+            out = api.call_json(
+                "AmazonEC2ContainerServiceV20141113.ListClusters", req
+            )
+            arns.extend(out.get("clusterArns") or [])
+            token = out.get("nextToken")
+            if not token:
+                break
+        if not arns:
+            return {}
+        clusters: dict[str, dict] = {}
+        for off in range(0, len(arns), 100):  # DescribeClusters cap
+            out = api.call_json(
+                "AmazonEC2ContainerServiceV20141113.DescribeClusters",
+                {"clusters": arns[off : off + 100], "include": ["SETTINGS"]},
+            )
+            for fail in out.get("failures") or []:
+                self.errors.append(
+                    f"ecs cluster {fail.get('arn', '?')}: "
+                    f"{fail.get('reason', 'describe failure')}"
+                )
+            for c in out.get("clusters") or []:
+                name = c.get("clusterName", "")
+                if not name:
+                    continue
+                clusters[name] = {
+                    "setting": [
+                        {
+                            "name": s.get("name", ""),
+                            "value": s.get("value", ""),
+                        }
+                        for s in c.get("settings") or []
+                    ]
+                }
+        return {"aws_ecs_cluster": clusters} if clusters else {}
 
     # -- scan --------------------------------------------------------------
 

@@ -83,6 +83,6 @@ class NdbReader:
                 )
             if byte0 + 16 + blen > len(self.data):
                 raise NdbError(f"ndb: blob {index} truncated")
-            if blen > blkcnt * _BLK:
+            if 16 + blen > blkcnt * _BLK:  # span includes the blob header
                 raise NdbError(f"ndb: blob {index} longer than its blocks")
             yield bytes(self.data[byte0 + 16 : byte0 + 16 + blen])

@@ -1,0 +1,24 @@
+# METADATA
+# title: ECS cluster without Container Insights
+# custom:
+#   id: AVD-AWS-0034
+#   severity: LOW
+#   recommended_action: Add setting { name = "containerInsights", value = "enabled" }.
+package builtin.terraform.aws.AVD_AWS_0034
+
+insights_enabled(c) {
+    s := c.setting[_]
+    s.name == "containerInsights"
+    s.value == "enabled"
+}
+
+insights_enabled(c) {
+    c.setting.name == "containerInsights"
+    c.setting.value == "enabled"
+}
+
+deny[res] {
+    c := input.resource.aws_ecs_cluster[name]
+    not insights_enabled(c)
+    res := result.new(sprintf("ECS cluster %q should enable Container Insights", [name]), c)
+}

@@ -1,0 +1,13 @@
+# METADATA
+# title: Lambda function without active X-Ray tracing
+# custom:
+#   id: AVD-AWS-0066
+#   severity: LOW
+#   recommended_action: Set tracing_config.mode to Active.
+package builtin.terraform.aws.AVD_AWS_0066
+
+deny[res] {
+    fn := input.resource.aws_lambda_function[name]
+    not fn.tracing_config.mode == "Active"
+    res := result.new(sprintf("Lambda function %q should have tracing_config.mode Active", [name]), fn)
+}

@@ -1,0 +1,13 @@
+# METADATA
+# title: Redshift cluster without at-rest encryption
+# custom:
+#   id: AVD-AWS-0084
+#   severity: HIGH
+#   recommended_action: Set encrypted = true (with a KMS key) on the cluster.
+package builtin.terraform.aws.AVD_AWS_0084
+
+deny[res] {
+    c := input.resource.aws_redshift_cluster[name]
+    not c.encrypted == true
+    res := result.new(sprintf("Redshift cluster %q is not encrypted at rest", [name]), c)
+}
