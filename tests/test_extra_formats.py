@@ -316,3 +316,47 @@ def test_csaf_relationship_chain_fixpoint(tmp_path):
     doc = load_vex(str(path))
     assert doc.suppressed("CVE-2099-1000", "pkg:npm/lodash@4.17.20")
     assert not doc.suppressed("CVE-2099-2000", "pkg:npm/lodash@4.17.20")
+
+
+def test_spdx_tag_value_roundtrip(tmp_path):
+    """--format spdx emits tag-value; the sbom artifact reads it back
+    (sbom.go's SPDXVersion text sniff) with packages intact."""
+    import io
+
+    from trivy_tpu.ftypes import Metadata, Report, Result, ResultClass
+    from trivy_tpu.atypes import Package
+    from trivy_tpu.report.writer import write_report
+    from trivy_tpu.artifact.sbom import SbomArtifact
+    from trivy_tpu.cache.store import MemoryCache
+
+    report = Report(
+        artifact_name="demo",
+        artifact_type="filesystem",
+        metadata=Metadata(os_family="alpine", os_name="3.19"),
+        results=[
+            Result(
+                target="lib/requirements.txt",
+                result_class=ResultClass.LANG_PKGS,
+                result_type="pip",
+                packages=[Package(id="requests@2.31.0", name="requests", version="2.31.0")],
+            )
+        ],
+    )
+    buf = io.StringIO()
+    write_report(report, fmt="spdx", out=buf)
+    text = buf.getvalue()
+    assert text.startswith("SPDXVersion: SPDX-2.3")
+    assert "PackageName: requests" in text and "PackageVersion: 2.31.0" in text
+
+    path = tmp_path / "demo.spdx"
+    path.write_text(text)
+    cache = MemoryCache()
+    ref = SbomArtifact(str(path), cache).inspect()
+    blob = cache.get_blob(ref.blob_ids[0])
+    pkgs = [
+        (p.name, p.version)
+        for app in blob.applications
+        for p in app.packages
+    ]
+    assert ("requests", "2.31.0") in pkgs, pkgs
+    assert blob.os is not None and blob.os.family == "alpine"

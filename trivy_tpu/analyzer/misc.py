@@ -40,21 +40,31 @@ class SbomFileAnalyzer(Analyzer):
         return file_path.lower().endswith(_SBOM_SUFFIXES) and size < 8 << 20
 
     def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
-        try:
-            doc = json.loads(inp.content)
-        except ValueError:
-            return None
-        # Format auto-detection (sbom.DetectFormat)
-        if doc.get("bomFormat") == "CycloneDX":
-            from trivy_tpu.sbom.cyclonedx import decode
-        elif doc.get("spdxVersion"):
-            from trivy_tpu.sbom.spdx import decode
+        text = inp.content.decode("utf-8", "replace")
+        from trivy_tpu.sbom.spdx import decode_tag_value, is_tag_value
+
+        if is_tag_value(text):
+            # tag-value SPDX files ship embedded too
+            try:
+                detail = decode_tag_value(text)
+            except Exception:
+                return None
         else:
-            return None
-        try:
-            detail = decode(doc)
-        except Exception:
-            return None
+            try:
+                doc = json.loads(inp.content)
+            except ValueError:
+                return None
+            # Format auto-detection (sbom.DetectFormat)
+            if doc.get("bomFormat") == "CycloneDX":
+                from trivy_tpu.sbom.cyclonedx import decode
+            elif doc.get("spdxVersion"):
+                from trivy_tpu.sbom.spdx import decode
+            else:
+                return None
+            try:
+                detail = decode(doc)
+            except Exception:
+                return None
         apps = list(detail.applications)
         # Bitnami layout: jars listed in opt/bitnami SBOMs exist next to the
         # SBOM file; anchor the application path there (sbom.go:45-57).

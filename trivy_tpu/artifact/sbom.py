@@ -59,6 +59,17 @@ class SbomArtifact:
     def inspect(self) -> ArtifactReference:
         with open(self.target, encoding="utf-8") as f:
             raw = f.read()
+        from trivy_tpu.sbom.spdx import is_tag_value
+
+        if is_tag_value(raw):
+            # SPDX tag-value input (sbom.go's text sniff)
+            from trivy_tpu.sbom.spdx import decode_tag_value
+
+            detail = decode_tag_value(raw)
+            return build_sbom_reference(
+                detail, raw.encode(), self.cache, self.target,
+                ArtifactType.SPDX,
+            )
         data = json.loads(raw)
         fmt = detect_format(data)
         if fmt == "cyclonedx":

@@ -369,7 +369,7 @@ def _run_with_timeout(options: Options, target_kind: str) -> int:
 
 
 def _run_inner(options: Options, target_kind: str) -> int:
-    if options.format in ("cyclonedx", "spdx-json"):
+    if options.format in ("cyclonedx", "spdx", "spdx-json"):
         # SBOM outputs list every package (run.go format handling).
         options.list_all_packages = True
     if options.format == "template" and not options.template:

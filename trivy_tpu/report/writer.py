@@ -11,7 +11,7 @@ from trivy_tpu.report.table import write_table
 from trivy_tpu.report.sarif import to_sarif
 
 FORMATS = [
-    "table", "json", "sarif", "cyclonedx", "spdx-json", "template",
+    "table", "json", "sarif", "cyclonedx", "spdx", "spdx-json", "template",
     "github", "cosign-vuln",
 ]
 
@@ -41,6 +41,10 @@ def write_report(
 
         json.dump(encode_report(report), out, indent=2)
         out.write("\n")
+    elif fmt == "spdx":
+        from trivy_tpu.sbom.spdx import encode_tag_value
+
+        out.write(encode_tag_value(report))
     elif fmt == "template":
         from trivy_tpu.report.extra import write_template
 

@@ -56,6 +56,97 @@ def encode_report(report: Report) -> dict[str, Any]:
     }
 
 
+def encode_tag_value(report: Report) -> str:
+    """The SPDX tag-value rendering (the reference's `--format spdx`,
+    pkg/report FormatSPDX): the same document the JSON encoder builds,
+    serialized as `Tag: value` stanzas separated by blank lines."""
+    doc = encode_report(report)
+    lines = [
+        f"SPDXVersion: {doc['spdxVersion']}",
+        f"DataLicense: {doc['dataLicense']}",
+        f"SPDXID: {doc['SPDXID']}",
+        f"DocumentName: {doc['name']}",
+        f"Creator: {doc['creationInfo']['creators'][0]}",
+        f"Created: {doc['creationInfo']['created']}",
+    ]
+    for pkg in doc["packages"]:
+        lines.append("")
+        lines.append(f"PackageName: {pkg['name']}")
+        lines.append(f"SPDXID: {pkg['SPDXID']}")
+        if pkg.get("versionInfo"):
+            lines.append(f"PackageVersion: {pkg['versionInfo']}")
+        lines.append(f"PackageDownloadLocation: {pkg['downloadLocation']}")
+        if pkg.get("licenseConcluded"):
+            lines.append(f"PackageLicenseConcluded: {pkg['licenseConcluded']}")
+        if pkg.get("primaryPackagePurpose"):
+            lines.append(
+                f"PrimaryPackagePurpose: {pkg['primaryPackagePurpose']}"
+            )
+        for ref in pkg.get("externalRefs") or []:
+            lines.append(
+                "ExternalRef: "
+                f"{ref['referenceCategory']} {ref['referenceType']} "
+                f"{ref['referenceLocator']}"
+            )
+    return "\n".join(lines) + "\n"
+
+
+def is_tag_value(text: str) -> bool:
+    """True when the first non-comment, non-blank line is the tag-value
+    version stanza (sbom.go's text sniff, tolerant of comment headers the
+    parser itself accepts)."""
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        return line.startswith("SPDXVersion:")
+    return False
+
+
+def decode_tag_value(text: str) -> ArtifactDetail:
+    """SPDX tag-value input -> the same document dict the JSON decoder
+    consumes (packages with purl externalRefs / OS purpose), then the
+    shared decode."""
+    packages: list[dict[str, Any]] = []
+    doc: dict[str, Any] = {"packages": packages}
+    cur: dict[str, Any] | None = None
+    in_text = False
+    for raw in text.splitlines():
+        line = raw.strip()
+        if in_text:
+            # multi-line <text>...</text> value: free text, never tags
+            if "</text>" in line:
+                in_text = False
+            continue
+        if not line or line.startswith("#"):
+            continue
+        tag, _, value = line.partition(":")
+        if "<text>" in value and "</text>" not in value:
+            in_text = True
+            continue
+        value = value.strip()
+        if tag == "DocumentName":
+            doc["name"] = value
+        elif tag == "PackageName":
+            cur = {"name": value}
+            packages.append(cur)
+        elif cur is not None and tag == "PackageVersion":
+            cur["versionInfo"] = value
+        elif cur is not None and tag == "PrimaryPackagePurpose":
+            cur["primaryPackagePurpose"] = value
+        elif cur is not None and tag == "ExternalRef":
+            parts = value.split()
+            if len(parts) == 3:
+                cur.setdefault("externalRefs", []).append(
+                    {
+                        "referenceCategory": parts[0],
+                        "referenceType": parts[1],
+                        "referenceLocator": parts[2],
+                    }
+                )
+    return decode(doc)
+
+
 def decode(doc: dict[str, Any]) -> ArtifactDetail:
     detail = ArtifactDetail()
     apps: dict[str, Application] = {}
