@@ -117,7 +117,11 @@ def oracle_baseline(scan_items, subset: int) -> float:
     return len(sample) / dt
 
 
-def assert_parity(scan_items, results, scope: str) -> int:
+def assert_parity(scan_items, results, scope: str) -> tuple[int, float]:
+    """Oracle-vs-engine findings parity; returns (files checked, oracle
+    seconds).  With scope='full' the timing doubles as the MEASURED
+    full-corpus oracle baseline — no extrapolation (the oracle runs over
+    every gated file anyway to prove parity)."""
     from trivy_tpu.engine.oracle import OracleScanner
 
     oracle = OracleScanner()
@@ -126,15 +130,18 @@ def assert_parity(scan_items, results, scope: str) -> int:
     else:
         indices = range(0, len(scan_items), max(1, len(scan_items) // 5000))
     checked = 0
+    oracle_s = 0.0
     for i in indices:
         p, c = scan_items[i]
+        t0 = time.perf_counter()
         want = oracle.scan(p, c)
+        oracle_s += time.perf_counter() - t0
         got = results[i]
         assert [f.to_json() for f in got.findings] == [
             f.to_json() for f in want.findings
         ], f"parity mismatch on {p}"
         checked += 1
-    return checked
+    return checked, oracle_s
 
 
 def bench_rule_scaling(n_rules: int = 500, n_files: int = 10000) -> dict:
@@ -265,7 +272,9 @@ def bench_verify_backends(n_files: int) -> dict:
         results_by_mode[mode] = (results, items)
     if "device" in results_by_mode:
         results, items = results_by_mode["device"]
-        out["device_parity_checked"] = assert_parity(items, results, "sample")
+        out["device_parity_checked"], _ = assert_parity(
+            items, results, "sample"
+        )
     if (
         isinstance(out.get("dfa"), dict)
         and isinstance(out.get("device"), dict)
@@ -298,15 +307,24 @@ def main() -> None:
         mono, engine, trials=4
     )
     detail["verify"] = getattr(engine, "verify", None)
-    # Oracle rate is per gated item; corpus-basis files/s scales by the
-    # corpus-to-gated ratio (gating itself is negligible next to scanning).
-    detail["oracle_files_per_sec"] = round(
-        oracle_baseline(scan_items, ORACLE_SUBSET)
-        * len(mono)
-        / max(len(scan_items), 1),
-        1,
+    detail["parity_checked_files"], oracle_s = assert_parity(
+        scan_items, results, PARITY
     )
-    detail["parity_checked_files"] = assert_parity(scan_items, results, PARITY)
+    # Corpus-basis oracle rate.  With full parity the oracle just ran
+    # over EVERY gated file — that timing IS the baseline, measured, not
+    # extrapolated (VERDICT r3 weak #7); the sampled-subset estimate only
+    # backs the sample-parity mode.
+    if PARITY == "full" and oracle_s > 0:
+        detail["oracle_files_per_sec"] = round(len(mono) / oracle_s, 1)
+        detail["oracle_baseline_basis"] = "measured-full-corpus"
+    else:
+        detail["oracle_files_per_sec"] = round(
+            oracle_baseline(scan_items, ORACLE_SUBSET)
+            * len(mono)
+            / max(len(scan_items), 1),
+            1,
+        )
+        detail["oracle_baseline_basis"] = f"sampled-{ORACLE_SUBSET}"
     del mono
 
     if KERNEL:
@@ -315,15 +333,22 @@ def main() -> None:
             kdetail, kresults, kitems, _ = bench_corpus_config(
                 kern, engine, trials=2
             )
-            kdetail["oracle_files_per_sec"] = round(
-                oracle_baseline(kitems, ORACLE_SUBSET)
-                * len(kern)
-                / max(len(kitems), 1),
-                1,
-            )
-            kdetail["parity_checked_files"] = assert_parity(
+            kdetail["parity_checked_files"], koracle_s = assert_parity(
                 kitems, kresults, PARITY
             )
+            if PARITY == "full" and koracle_s > 0:
+                kdetail["oracle_files_per_sec"] = round(
+                    len(kern) / koracle_s, 1
+                )
+                kdetail["oracle_baseline_basis"] = "measured-full-corpus"
+            else:
+                kdetail["oracle_files_per_sec"] = round(
+                    oracle_baseline(kitems, ORACLE_SUBSET)
+                    * len(kern)
+                    / max(len(kitems), 1),
+                    1,
+                )
+                kdetail["oracle_baseline_basis"] = f"sampled-{ORACLE_SUBSET}"
             detail["kernel"] = kdetail
             del kern
         except Exception as e:  # secondary config must not sink the bench

@@ -40,42 +40,30 @@ class SbomFileAnalyzer(Analyzer):
         return file_path.lower().endswith(_SBOM_SUFFIXES) and size < 8 << 20
 
     def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
-        text = inp.content.decode("utf-8", "replace")
-        from trivy_tpu.sbom.spdx import decode_tag_value, is_tag_value
+        from trivy_tpu.sbom import decode_sbom
 
-        if is_tag_value(text):
-            # tag-value SPDX files ship embedded too
-            try:
-                detail = decode_tag_value(text)
-            except Exception:
-                return None
-        else:
-            try:
-                doc = json.loads(inp.content)
-            except ValueError:
-                return None
-            # Format auto-detection (sbom.DetectFormat)
-            if doc.get("bomFormat") == "CycloneDX":
-                from trivy_tpu.sbom.cyclonedx import decode
-            elif doc.get("spdxVersion"):
-                from trivy_tpu.sbom.spdx import decode
-            else:
-                return None
-            try:
-                detail = decode(doc)
-            except Exception:
-                return None
+        try:
+            detail, _fmt = decode_sbom(inp.content.decode("utf-8", "replace"))
+        except Exception:
+            return None
         apps = list(detail.applications)
         # Bitnami layout: jars listed in opt/bitnami SBOMs exist next to the
         # SBOM file; anchor the application path there (sbom.go:45-57).
         for app in apps:
             if not app.file_path:
                 app.file_path = inp.file_path
-        if not apps and not detail.package_infos:
+        # OS packages (apk/deb/rpm purls) land in detail.packages; wrap
+        # them like build_sbom_reference does so they are not dropped.
+        pkg_infos = list(detail.package_infos)
+        if detail.packages:
+            from trivy_tpu.atypes import PackageInfo
+
+            pkg_infos.append(
+                PackageInfo(file_path=inp.file_path, packages=detail.packages)
+            )
+        if not apps and not pkg_infos:
             return None
-        return AnalysisResult(
-            package_infos=list(detail.package_infos), applications=apps
-        )
+        return AnalysisResult(package_infos=pkg_infos, applications=apps)
 
 
 # ---------------------------------------------------------------------------

@@ -15,15 +15,6 @@ from trivy_tpu.cache.store import ArtifactCache
 from trivy_tpu.ftypes import ArtifactType
 
 
-def detect_format(data: dict) -> str:
-    """pkg/sbom/sbom.go Decode format sniff."""
-    if data.get("bomFormat") == "CycloneDX":
-        return "cyclonedx"
-    if str(data.get("spdxVersion", "")).startswith("SPDX-"):
-        return "spdx"
-    raise ValueError("unrecognized SBOM format (expected CycloneDX or SPDX JSON)")
-
-
 def build_sbom_reference(
     detail, raw: bytes, cache, name: str, artifact_type: "ArtifactType"
 ) -> "ArtifactReference":
@@ -57,30 +48,14 @@ class SbomArtifact:
         self.cache = cache
 
     def inspect(self) -> ArtifactReference:
+        from trivy_tpu.sbom import decode_sbom
+
         with open(self.target, encoding="utf-8") as f:
             raw = f.read()
-        from trivy_tpu.sbom.spdx import is_tag_value
-
-        if is_tag_value(raw):
-            # SPDX tag-value input (sbom.go's text sniff)
-            from trivy_tpu.sbom.spdx import decode_tag_value
-
-            detail = decode_tag_value(raw)
-            return build_sbom_reference(
-                detail, raw.encode(), self.cache, self.target,
-                ArtifactType.SPDX,
-            )
-        data = json.loads(raw)
-        fmt = detect_format(data)
-        if fmt == "cyclonedx":
-            from trivy_tpu.sbom.cyclonedx import decode
-
-            artifact_type = ArtifactType.CYCLONEDX
-        else:
-            from trivy_tpu.sbom.spdx import decode
-
-            artifact_type = ArtifactType.SPDX
-        detail = decode(data)
+        detail, fmt = decode_sbom(raw)
+        artifact_type = (
+            ArtifactType.CYCLONEDX if fmt == "cyclonedx" else ArtifactType.SPDX
+        )
         return build_sbom_reference(
             detail, raw.encode(), self.cache, self.target, artifact_type
         )

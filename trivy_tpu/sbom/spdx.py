@@ -95,7 +95,7 @@ def is_tag_value(text: str) -> bool:
     """True when the first non-comment, non-blank line is the tag-value
     version stanza (sbom.go's text sniff, tolerant of comment headers the
     parser itself accepts)."""
-    for raw in text.splitlines():
+    for raw in text[:2048].splitlines():  # the stanza leads the document
         line = raw.strip()
         if not line or line.startswith("#"):
             continue
