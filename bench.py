@@ -16,10 +16,10 @@ frequencies, binaries, vendored/test subtrees; see its module docstring):
 
 The timed pipeline is the product path, matching the reference's analyzer
 gating (pkg/fanal/analyzer/secret/secret.go Required + IsBinary): skip-dirs/
-exts/allow-paths first, binary sniff, \r strip, then the engine.  The oracle
-baseline gets the identical gating, measured on >= 5k files (not 300) and
-extrapolated; the parity check runs the oracle over every file of the
-primary corpus.
+exts/allow-paths first, binary sniff, \r strip, then the engine.  With
+full-scope parity (the default) the oracle baseline is MEASURED over every
+gated file — the parity pass runs the oracle anyway and its timing is the
+baseline (detail.oracle_baseline_basis records the basis per config).
 """
 
 from __future__ import annotations
