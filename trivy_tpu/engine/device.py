@@ -151,6 +151,9 @@ class TpuSecretEngine:
                 )
 
                 sieve_obj = PallasGramSieve(self.gset.masks, self.gset.vals)
+                # Kernel output bits are over distinct (mask, val) pairs;
+                # _candidates expands them back to gset gram order.
+                self._pallas_obj = sieve_obj
                 if mesh is not None:
                     self._sieve_fn = make_sharded_pallas_sieve(mesh, sieve_obj)
                     # Every shard must tile into whole Pallas blocks.
@@ -324,10 +327,17 @@ class TpuSecretEngine:
             self.stats.sieve_s += _time.perf_counter() - t0
 
         t0 = _time.perf_counter()
-        file_words = batch.file_hits(word_hits)  # [F, Gw]
-        gram_hits = (
+        file_words = batch.file_hits(word_hits)  # [F, Gw] (or [F, Dw] pallas)
+        bits = (
             (file_words[:, :, None] >> np.arange(32, dtype=np.uint32)) & 1
-        ).astype(bool).reshape(len(file_words), -1)[:, : self.gset.num_grams]
+        ).astype(bool).reshape(len(file_words), -1)
+        pallas_obj = getattr(self, "_pallas_obj", None)
+        if pallas_obj is not None:
+            # Pallas words are over distinct (mask, val) pairs; expand back
+            # to the gset's per-gram attribution order.
+            gram_hits = pallas_obj.expand_bool(bits[:, : pallas_obj.num_distinct])
+        else:
+            gram_hits = bits[:, : self.gset.num_grams]
         cand = self.candidate_matrix_bool(self.gset.probe_hits_bool(gram_hits))
         self.stats.candidate_s += _time.perf_counter() - t0
         return cand

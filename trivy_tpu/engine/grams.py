@@ -266,9 +266,11 @@ def build_gram_set(pset: ProbeSet) -> GramSet:
     vals_a = np.array(vals, dtype=np.uint32)
     gram_probe_a = np.array(gram_probe, dtype=np.int32)
     gram_window_a = np.array(gram_window, dtype=np.int32)
-    # Sort grams by (mask, val) so kernels can hoist `w & mask` across runs
-    # of equal masks (ops/gram_sieve_pallas.py); per-gram arrays permute
-    # together, so attribution is unaffected.
+    # Sort grams by (mask, val) for a deterministic layout; per-gram arrays
+    # permute together, so attribution is unaffected.  (Kernels no longer
+    # require this order — PallasGramSieve re-sorts via dedupe_grams — but
+    # the numpy/native sieves and tests rely on a stable, reproducible
+    # gram order across processes.)
     if len(masks_a):
         perm = np.lexsort((vals_a, masks_a))
         masks_a, vals_a = masks_a[perm], vals_a[perm]
