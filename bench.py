@@ -28,6 +28,8 @@ import json
 import os
 import time
 
+import numpy as np
+
 import bench_corpus
 
 N_FILES = int(os.environ.get("BENCH_FILES", "100000"))
@@ -99,6 +101,8 @@ def bench_corpus_config(corpus, engine, trials=3):
         detail["candidate_pairs"] = best_stats.candidate_pairs
         if getattr(best_stats, "device_pairs", 0):
             detail["device_pairs"] = best_stats.device_pairs
+        if getattr(best_stats, "device_dispatches", 0):
+            detail["device_dispatches"] = best_stats.device_dispatches
     return detail, results, items, idx
 
 
@@ -191,6 +195,233 @@ def bench_rule_scaling(n_rules: int = 500, n_files: int = 10000) -> dict:
     }
 
 
+def bench_kernel_exec() -> dict:
+    """On-device exec rate of the production Pallas sieve kernel, link
+    excluded: the input stays resident and the kernel loops on-device
+    (lax.fori_loop, input varied per iteration so nothing hoists), so the
+    per-iteration slope between two loop counts is pure kernel exec.
+    The naive per-dispatch timing this replaces was dominated by the
+    relay's ~100ms fixed dispatch cost and under-reported the kernel by
+    ~30x (round-4 "170 MB/s" was a measurement artifact)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from trivy_tpu.engine.grams import build_gram_set
+    from trivy_tpu.engine.probes import build_probe_set
+    from trivy_tpu.ops.gram_sieve_pallas import PallasGramSieve
+    from trivy_tpu.rules.model import build_ruleset
+
+    if jax.devices()[0].platform != "tpu":
+        return {"skipped": "no tpu"}
+    gs = build_gram_set(build_probe_set(build_ruleset().rules))
+    out: dict = {
+        "method": (
+            "on-device fori_loop slope (k=102 vs 302), resident input, "
+            "best-of-3, np.asarray forced"
+        ),
+        "distinct_grams": int(
+            PallasGramSieve(gs.masks, gs.vals).num_distinct
+        ),
+    }
+    t_rows, length = 4096, 4096
+    rows = np.random.default_rng(0).integers(
+        32, 127, size=(t_rows, length), dtype=np.uint8
+    )
+    rows_d = jax.device_put(rows)
+    nbytes = t_rows * length
+    for impl in ("bitplane", "window"):
+        sieve = PallasGramSieve(gs.masks, gs.vals, impl=impl)
+
+        def many(k):
+            @jax.jit
+            def f(r):
+                def body(i, acc):
+                    return acc | sieve(r ^ (i % 2).astype(jnp.uint8))
+
+                return lax.fori_loop(
+                    0, k, body,
+                    jnp.zeros((t_rows, sieve.n_words), jnp.uint32),
+                )
+
+            return f
+
+        ka, kb = (102, 302) if impl == "bitplane" else (22, 102)
+        fa, fb = many(ka), many(kb)
+        np.asarray(fa(rows_d))
+        np.asarray(fb(rows_d))
+        was, wbs = [], []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(fa(rows_d))
+            was.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            np.asarray(fb(rows_d))
+            wbs.append(time.perf_counter() - t0)
+        per = (min(wbs) - min(was)) / (kb - ka)
+        key = (
+            "device_kernel_exec_mb_per_sec"
+            if impl == "bitplane"
+            else "window_kernel_exec_mb_per_sec"
+        )
+        out[key] = round(nbytes / per / 1e6, 1)
+        out[f"{impl}_per_16mb_ms"] = round(per * 1e3, 3)
+    return out
+
+
+def bench_license(n_files: int = 2000, n_license: int = 300) -> dict:
+    """BASELINE config #5's second scanner: the license classifier
+    (--scanners secret,license).  A corpus of source-shaped files with
+    `n_license` real SPDX license texts mixed in runs through the batched
+    hashed-trigram matmul classifier; correctness = every planted text
+    classifies to its SPDX id."""
+    import importlib.resources as ir
+
+    from trivy_tpu.license.classifier import shared_classifier
+
+    clf = shared_classifier()
+    corpus_names = list(clf.names)
+    texts: list[str] = []
+    want: list[str | None] = []
+    base = bench_corpus.make_monorepo_corpus(n_files, planted_every=0)
+    from trivy_tpu.license import corpus as corpus_pkg
+
+    raw = {}
+    for name in corpus_names:
+        try:
+            raw[name] = (
+                ir.files(corpus_pkg) / f"{name}.txt"
+            ).read_text(errors="replace")
+        except OSError:
+            continue
+    names_avail = sorted(raw)
+    for i, (_p, c) in enumerate(base):
+        if i < n_license:
+            name = names_avail[i % len(names_avail)]
+            texts.append(raw[name])
+            want.append(name)
+        else:
+            texts.append(c.decode("latin-1"))
+            want.append(None)
+    t0 = time.perf_counter()
+    got = clf.classify_batch(texts)
+    dt = time.perf_counter() - t0
+    correct = sum(
+        1
+        for g, w in zip(got, want)
+        if w is not None and g is not None and g.license == w
+    )
+    false_pos = sum(
+        1 for g, w in zip(got, want) if w is None and g is not None
+    )
+    return {
+        "files": len(texts),
+        "license_texts": n_license,
+        "classified_correct": correct,
+        "false_positives": false_pos,
+        "corpus_licenses": len(corpus_names),
+        "files_per_sec": round(len(texts) / dt, 1),
+        "wall_s": round(dt, 3),
+    }
+
+
+def bench_image(n_layers: int = 20, files_per_layer: int = 50) -> dict:
+    """BASELINE config #2 shape: the container-image path — docker-archive
+    load, per-layer unpack, applier squash (whiteouts/opaque), analyzer
+    batch, secret scan — over ~n_layers x files_per_layer blobs."""
+    import hashlib
+    import io
+    import json as _json
+    import tarfile
+    import tempfile
+
+    from trivy_tpu.cli import Options
+    from trivy_tpu.commands.run import run as run_cmd
+
+    rng = np.random.default_rng(11)
+
+    def layer_tar(files: dict[str, bytes]) -> bytes:
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tf:
+            for name, data in files.items():
+                info = tarfile.TarInfo(name)
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+        return buf.getvalue()
+
+    planted = 0
+    layers = []
+    for li in range(n_layers):
+        files = {}
+        for fi in range(files_per_layer):
+            body = rng.integers(
+                97, 122, size=int(rng.integers(200, 4000)), dtype=np.uint8
+            ).tobytes()
+            if (li * files_per_layer + fi) % 97 == 0:
+                body += (
+                    b"\nAWS_ACCESS_KEY_ID=AKIA"
+                    + (b"%016d" % li).replace(b"0", b"Q")
+                    + b"\n"
+                )
+                planted += 1
+            files[f"srv/l{li}/f{fi}.txt"] = body
+        layers.append(layer_tar(files))
+
+    diff_ids = ["sha256:" + hashlib.sha256(l).hexdigest() for l in layers]
+    config = {
+        "architecture": "amd64",
+        "os": "linux",
+        "rootfs": {"type": "layers", "diff_ids": diff_ids},
+        "history": [{"created_by": f"RUN s{i}"} for i in range(n_layers)],
+    }
+    raw_config = _json.dumps(config).encode()
+    config_name = hashlib.sha256(raw_config).hexdigest() + ".json"
+    manifest = [
+        {
+            "Config": config_name,
+            "RepoTags": ["bench/app:latest"],
+            "Layers": [f"l{i}/layer.tar" for i in range(n_layers)],
+        }
+    ]
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "image.tar")
+        with tarfile.open(path, "w") as tf:
+            for name, data in [
+                (config_name, raw_config),
+                ("manifest.json", _json.dumps(manifest).encode()),
+            ] + [(f"l{i}/layer.tar", l) for i, l in enumerate(layers)]:
+                info = tarfile.TarInfo(name)
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+        out_path = os.path.join(td, "report.json")
+        best = float("inf")
+        for _ in range(2):
+            opts = Options(
+                target=path,
+                scanners=["secret"],
+                format="json",
+                output=out_path,
+                cache_backend="memory",
+            )
+            t0 = time.perf_counter()
+            code = run_cmd(opts, "image")
+            best = min(best, time.perf_counter() - t0)
+        report = _json.loads(open(out_path).read())
+    blobs = n_layers * files_per_layer
+    findings = sum(
+        len(r.get("Secrets") or []) for r in report.get("Results") or []
+    )
+    assert code == 0 and findings >= planted, (code, findings, planted)
+    return {
+        "layers": n_layers,
+        "blobs": blobs,
+        "planted": planted,
+        "findings": findings,
+        "wall_s": round(best, 3),
+        "blobs_per_sec": round(blobs / best, 1),
+    }
+
+
 def bench_device_engine(n_files: int = 10000) -> dict:
     """The Pallas/XLA device engine on a monorepo subset, with the same
     accounting as the primary config (gating inside the timed region,
@@ -220,13 +451,30 @@ def bench_device_engine(n_files: int = 10000) -> dict:
         "link_rtt_s": round(rtt, 4),
     }
     if mb_s > 0:
-        floor_s = tile_bytes / (mb_s * 1e6)
+        # The link floor counts transfer time AND the fixed per-dispatch
+        # round-trip (dispatches do not overlap on the relay).
+        dispatches = detail.get("device_dispatches", 0)
+        floor_s = tile_bytes / (mb_s * 1e6) + dispatches * rtt
+        out["device_dispatches"] = dispatches
         out["link_floor_s"] = round(floor_s, 3)
-        # Fraction of the sieve phase explained by the link alone: ~1.0
-        # means the engine is transfer-bound and the kernel is free.
-        sieve_s = (detail.get("phases") or {}).get("sieve_s")
-        if sieve_s:
-            out["link_bound_fraction"] = round(floor_s / sieve_s, 3)
+    # Measured transfer/exec decomposition (one sync-timed pass — does
+    # not trust the probe's rate estimate, which drifts on the relay):
+    # link_bound_fraction is the share of device wall that is pure h2d.
+    from trivy_tpu.engine.device import SieveStats
+
+    os.environ["TRIVY_TPU_SYNC_TIMING"] = "1"
+    try:
+        engine.stats = SieveStats()
+        analyzer = _make_analyzer(engine)
+        scan_items, _ = gate_corpus(corpus, analyzer)
+        engine.scan_batch(scan_items)
+        h2d, ex = engine.stats.h2d_s, engine.stats.exec_s
+        out["sieve_h2d_s"] = round(h2d, 3)
+        out["sieve_exec_fetch_s"] = round(ex, 3)
+        if h2d + ex > 0:
+            out["link_bound_fraction"] = round(h2d / (h2d + ex), 3)
+    finally:
+        os.environ.pop("TRIVY_TPU_SYNC_TIMING", None)
     return out
 
 
@@ -269,6 +517,21 @@ def bench_verify_backends(n_files: int) -> dict:
         }
         if "device_pairs" in d:
             out[mode]["device_pairs"] = d["device_pairs"]
+        if mode == "device" and eng._nfa_verifier is not None:
+            ss = getattr(eng._nfa_verifier, "stream_stats", None)
+            if ss:
+                out[mode]["stream"] = {
+                    k: (round(v, 3) if isinstance(v, float) else v)
+                    for k, v in ss.items()
+                }
+                if mb_s > 0:
+                    # candidate spans must cross the link once each way
+                    # (hit bitmaps back), plus per-dispatch round-trips —
+                    # the irreducible cost of device verify on this host
+                    floor = ss["span_bytes"] / (mb_s * 1e6) + (
+                        ss["dispatches"] + 1
+                    ) * rtt
+                    out[mode]["verify_link_floor_s"] = round(floor, 3)
         results_by_mode[mode] = (results, items)
     if "device" in results_by_mode:
         results, items = results_by_mode["device"]
@@ -307,6 +570,12 @@ def main() -> None:
         mono, engine, trials=4
     )
     detail["verify"] = getattr(engine, "verify", None)
+    # Host-speed dispersion (the 1-core bench CPU drifts +-40% between
+    # runs): three oracle samples bound the noise the vs_baseline
+    # multiple inherits, so round-over-round comparisons are judgeable.
+    detail["oracle_subset_dispersion"] = [
+        round(oracle_baseline(scan_items, 1500), 1) for _ in range(3)
+    ]
     detail["parity_checked_files"], oracle_s = assert_parity(
         scan_items, results, PARITY
     )
@@ -375,6 +644,26 @@ def main() -> None:
             detail["device_engine"] = bench_device_engine()
         except Exception as e:
             detail["device_engine"] = {"error": f"{type(e).__name__}: {e}"}
+        # Link-independent kernel exec (the number that transfers to
+        # PCIe/ICI-attached deployments).
+        try:
+            detail["kernel_exec"] = bench_kernel_exec()
+        except Exception as e:
+            detail["kernel_exec"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if os.environ.get("BENCH_LICENSE", "1") == "1":
+        # BASELINE config #5's second scanner (--scanners secret,license).
+        try:
+            detail["license"] = bench_license()
+        except Exception as e:
+            detail["license"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if os.environ.get("BENCH_IMAGE", "1") == "1":
+        # BASELINE config #2: the container-image path end to end.
+        try:
+            detail["image"] = bench_image()
+        except Exception as e:
+            detail["image"] = {"error": f"{type(e).__name__}: {e}"}
 
     try:
         import resource

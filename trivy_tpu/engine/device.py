@@ -24,6 +24,7 @@ production path (~5x faster exec, no gathers).
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 
 import numpy as np
@@ -46,7 +47,10 @@ from trivy_tpu.scanner.packing import (
 TILE_BUCKETS = (512, 4096)
 # The TPU link has a large fixed per-call latency (~100ms through the axon
 # relay); the Pallas path uses few, huge calls so the fixed cost amortizes.
-TILE_BUCKETS_PALLAS = (4096, 32768)
+# Granular buckets matter on narrow links: padding a 7k-row batch up to a
+# 32k-row bucket would quadruple the bytes crossing the link (each bucket
+# shape compiles once per process; warmup covers them all).
+TILE_BUCKETS_PALLAS = (4096, 8192, 16384, 32768)
 
 GRAM_OVERLAP = 3  # gram window (4) - 1
 
@@ -69,6 +73,14 @@ class SieveStats:
     candidate_s: float = 0.0
     verify_s: float = 0.0
     confirm_s: float = 0.0
+    # Device dispatch count for the sieve phase (link-floor accounting:
+    # each dispatch pays the link round-trip on relay-attached chips).
+    device_dispatches: int = 0
+    # Populated only under TRIVY_TPU_SYNC_TIMING=1 (bench decomposition):
+    # measured h2d transfer vs on-device exec+fetch, separated by a forced
+    # sync between them.  Production keeps transfers/exec pipelined.
+    h2d_s: float = 0.0
+    exec_s: float = 0.0
 
     def phases(self) -> dict:
         out = {
@@ -276,7 +288,8 @@ class TpuSecretEngine:
                 rows = np.concatenate(
                     [rows, np.zeros((fit - total, rows.shape[1]), np.uint8)]
                 )
-            return np.asarray(self._sieve_fn(jnp.asarray(rows)))[:total]
+            self.stats.device_dispatches += 1
+            return self._dispatch_rows(rows)[:total]
         # Chunk into fixed max-bucket-row batches: one compiled shape,
         # pipelined h2d/compute across chunks (dispatch is async; results
         # materialize only at the end).
@@ -287,8 +300,35 @@ class TpuSecretEngine:
                 part = np.concatenate(
                     [part, np.zeros((max_rows - len(part), part.shape[1]), np.uint8)]
                 )
-            chunks.append(self._sieve_fn(jnp.asarray(part)))
+            if os.environ.get("TRIVY_TPU_SYNC_TIMING"):
+                chunks.append(self._dispatch_rows(part))
+            else:
+                chunks.append(self._sieve_fn(jnp.asarray(part)))
+            self.stats.device_dispatches += 1
         return np.concatenate([np.asarray(c) for c in chunks])[:total]
+
+    def _dispatch_rows(self, rows: np.ndarray) -> np.ndarray:
+        """One sieve dispatch.  Under TRIVY_TPU_SYNC_TIMING=1 the h2d
+        transfer is forced to complete (a 1-element fetch round-trip —
+        block_until_ready returns early on relay links) before the kernel
+        runs, splitting stats.h2d_s from stats.exec_s; bench uses this to
+        measure how link-bound the all-device engine really is without
+        trusting a probe's rate estimate."""
+        import time as _time
+
+        import jax
+        import jax.numpy as jnp
+
+        if not os.environ.get("TRIVY_TPU_SYNC_TIMING"):
+            return np.asarray(self._sieve_fn(jnp.asarray(rows)))
+        t0 = _time.perf_counter()
+        dev = jax.device_put(rows)
+        np.asarray(dev[:1, :1])  # forced round-trip: transfer is done
+        self.stats.h2d_s += _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        out = np.asarray(self._sieve_fn(dev))
+        self.stats.exec_s += _time.perf_counter() - t0
+        return out
 
     def _candidates(self, contents: list[bytes]) -> np.ndarray:
         """[F, R] bool candidate matrix for a content batch."""

@@ -642,12 +642,15 @@ class HybridSecretEngine(TpuSecretEngine):
         then oracle confirm of the surviving (file, rule) sets."""
         t0 = time.perf_counter()
         unver = lanes[lanes[:, 4] == 0]
-        contents = [items[int(g)][1] for g in unver[:, 0]]
+        # Lanes of the same file share one contents entry so the stream
+        # verifier can ship each file's span once (multi-rule dedupe).
+        ufiles, inv = np.unique(unver[:, 0], return_inverse=True)
+        contents = [items[int(g)][1] for g in ufiles]
         lens = np.fromiter(
             (len(c) for c in contents), dtype=np.int64, count=len(contents)
         )
         sub = unver[:, :4].copy()
-        sub[:, 0] = np.arange(len(unver))
+        sub[:, 0] = inv
         ok = self._nfa_verifier.verify_lanes(contents, sub, lens)
         self.stats.device_pairs += len(unver)
         surviving = np.concatenate(

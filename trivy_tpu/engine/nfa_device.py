@@ -60,6 +60,29 @@ GROUP_BUCKETS = (8, 16, 32, 64, 128)  # all divisible by the 8-device mesh
 LANES_PER_GROUP = 64
 _NO_TRIM = np.iinfo(np.int32).max
 
+# Dense multi-rule stream (the production dispatch shape).  The link is
+# the scarce resource, device exec is nearly free, so: each FILE's span
+# (the union of its candidate pairs' walk windows) crosses the link
+# exactly ONCE, packed back-to-back with one 0x00 separator into fixed
+# rows of RAW BYTES, and every distinct candidate rule's automaton runs
+# sequentially over the same resident rows inside a single dispatch
+# (lax.map over stacked per-rule tensors).  Per-rule accept tensors are
+# per-BYTE ([256, 64], class translation folded in at build, byte 0
+# forced dead), so the host never class-translates lanes in Python.
+# Output is one hit flag per rule per 32-position block (device-side OR
+# over the block), keeping d2h at R/32nd of h2d.  Spans containing a
+# real 0x00 byte, spans longer than the jumbo row, and rules beyond the
+# per-dispatch rule stack take the padded class-bucket path.
+STREAM_TIERS = (512, 4096, 16384)  # row lengths; spans take the smallest fit
+STREAM_ROW_LEN = STREAM_TIERS[1]  # compat alias (tests, docs)
+JUMBO_ROW_LEN = STREAM_TIERS[2]
+STREAM_BLOCK = 32  # positions OR-compressed into one output flag
+RULE_STACK_BUCKETS = (4, 8, 16, 32)  # jit-stable per-dispatch rule counts
+# Small batches dispatch narrow G without a mesh; meshed runs keep the
+# 8-divisible buckets.
+STREAM_GROUP_BUCKETS = (1, 2, 4) + GROUP_BUCKETS
+PAD_CLASS = 63
+
 
 class NfaVerifier:
     def __init__(self, rules, mesh=None, trimmable=None, prefix_bounds=None):
@@ -84,6 +107,10 @@ class NfaVerifier:
             for n in nfas
         ]
         self.has_nfa = np.array([n is not None for n in nfas], dtype=bool)
+        # Stream machinery: per-rule raw-byte tensors build lazily and
+        # cache for the process lifetime.
+        self._nfas = nfas
+        self._byte_tensor_cache: dict[int, tuple] = {}
         r = self.num_rules
         # Dense per-rule tensors, padded to 64 positions / 64 classes.
         self.follow = np.zeros((r, 64, 64), dtype=np.float32)
@@ -175,6 +202,27 @@ class NfaVerifier:
                 np.zeros(g, dtype=np.int32),
             )
             self._run(classes_t, gids, *tensors).block_until_ready()
+        # multi-rule stream shapes: the two big row tiers at the largest
+        # group chunk, a mid-size rule stack (TPU path only — the CPU
+        # gather variant compiles in milliseconds on first use)
+        jdt = self._compute_dtype()
+        if jdt == jnp.bfloat16:
+            rb = RULE_STACK_BUCKETS[1]
+            zt = lambda *s: jnp.zeros(s, jdt)
+            for length in STREAM_TIERS[1:]:
+                bd = self._put_stream(
+                    np.zeros(
+                        (
+                            length // STREAM_BLOCK, STREAM_BLOCK,
+                            GROUP_BUCKETS[-1], LANES_PER_GROUP,
+                        ),
+                        dtype=np.uint8,
+                    )
+                )
+                self._run_stream_multi(
+                    bd, zt(rb, 64, 64), zt(rb, 256, 64), zt(rb, 64),
+                    zt(rb, 64),
+                ).block_until_ready()
 
     @staticmethod
     @jax.jit
@@ -215,6 +263,90 @@ class NfaVerifier:
         )
         return matched
 
+    @staticmethod
+    @jax.jit
+    def _run_stream_multi(bytes_t, follow, accept_b, first, last):
+        """bytes_t [Lo, 32, G, Bg] uint8 RAW BYTES x per-rule tensors
+        stacked on a leading R axis -> hit flags [R, Lo, G, Bg] uint8:
+        1 iff a match of rule slot r ends in positions [32j, 32j+32) of
+        that row.
+
+        Every rule's automaton scans the SAME resident byte rows
+        (lax.map over the rule stack) — the bytes cross the link once no
+        matter how many rules claim a file, which is the whole economics
+        of the stream path (exec is cheap, transfers are not).  The
+        automaton consumes raw bytes through the per-byte accept tensor
+        (accept_b[r, byte, state] — class translation folded in at
+        build), state carries across 32-blocks, and byte 0x00 is forced
+        dead so the one-byte span separators reset matching."""
+        return NfaVerifier._stream_multi_impl(
+            bytes_t, follow, accept_b, first, last, onehot=True
+        )
+
+    @staticmethod
+    @jax.jit
+    def _run_stream_multi_gather(bytes_t, follow, accept_b, first, last):
+        """CPU variant of _run_stream_multi: the per-byte accept lookup
+        is a gather (fast on CPU) instead of the one-hot matmul the MXU
+        wants; results are identical."""
+        return NfaVerifier._stream_multi_impl(
+            bytes_t, follow, accept_b, first, last, onehot=False
+        )
+
+    @staticmethod
+    def _stream_multi_impl(bytes_t, follow, accept_b, first, last, onehot):
+        dt = follow.dtype
+        one = dt.type(1)
+
+        def per_rule(tens):
+            f, a, fs, ls = tens  # [64,64] [256,64] [64] [64]
+            fsb = fs[None, None, :]
+            lsb = ls[None, None, :]
+
+            def blk_step(state, blk):  # blk [32, G, Bg]
+                hit0 = jnp.zeros(state.shape[:2], dtype=bool)
+
+                def inner(i, sh):
+                    st, hit = sh
+                    if onehot:
+                        oh = jax.nn.one_hot(blk[i], 256, dtype=dt)
+                        cmask = jnp.einsum(
+                            "gbc,cs->gbs", oh, a,
+                            preferred_element_type=dt,
+                        )
+                    else:
+                        cmask = a[blk[i]]  # [G, Bg, 64] gather
+                    reach = jnp.einsum(
+                        "gbp,pq->gbq", st, f, preferred_element_type=dt
+                    )
+                    nxt = jnp.minimum(
+                        jnp.minimum(reach + fsb, one) * cmask, one
+                    )
+                    return nxt, hit | ((nxt * lsb).sum(-1) > 0)
+
+                st, hit = jax.lax.fori_loop(
+                    0, blk.shape[0], inner, (state, hit0)
+                )
+                return st, hit.astype(jnp.uint8)
+
+            init = jnp.zeros(bytes_t.shape[2:4] + (64,), dt)
+            _st, ys = jax.lax.scan(blk_step, init, bytes_t)
+            return ys  # [Lo, G, Bg] uint8
+
+        flags = jax.lax.map(per_rule, (follow, accept_b, first, last))
+        # pack 8 rule slots per byte: d2h shrinks R/ceil(R/8)-fold
+        r = flags.shape[0]
+        rp = -(-r // 8)
+        pad = jnp.zeros((rp * 8 - r,) + flags.shape[1:], flags.dtype)
+        grouped = jnp.concatenate([flags, pad]).reshape(
+            (rp, 8) + flags.shape[1:]
+        )
+        w8 = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], jnp.uint8)
+        return jnp.einsum(
+            "pk...,k->p...", grouped, w8,
+            preferred_element_type=jnp.int32,
+        ).astype(jnp.uint8)  # [ceil(R/8), Lo, G, Bg]
+
     # ------------------------------------------------------------------
 
     def _windows(self, pairs: np.ndarray, lens: np.ndarray):
@@ -250,19 +382,309 @@ class NfaVerifier:
     ) -> np.ndarray:
         """bool[N] keep-mask for device-eligible lanes.  contents[i] is the
         full file bytes for pairs[i, 0]; the lane ships only its clipped
-        walk window.  Lanes sort by (window bucket, rule), pack into
-        [G, LANES_PER_GROUP] groups per length bucket, and dispatch once
-        per (bucket, group-chunk) — dispatch count stays O(buckets), not
-        O(lanes), which matters when the link round-trip is the fixed
-        cost."""
+        walk window.
+
+        Production path (stream): windows <= STREAM_ROW_LEN pack densely
+        into fixed rows per rule — link bytes track the actual window
+        bytes, the whole batch rides O(1) fixed-shape dispatches, and the
+        device returns per-position hit bitmaps the host maps back to
+        lanes.  Jumbo windows and all-64-class rules take the padded
+        bucket path."""
         n = len(pairs)
         keep = np.zeros(n, dtype=bool)
         if n == 0:
             return keep
         start, stop = self._windows(pairs, lens)
         wlen = stop - start
+        stream = self.has_nfa[pairs[:, 1]] & (wlen <= STREAM_TIERS[-1])
+        s_idx = np.flatnonzero(stream)
+        if len(s_idx):
+            self._verify_stream(contents, pairs, start, stop, s_idx, keep)
+        rest = np.flatnonzero(~stream)
+        if len(rest):
+            self._verify_padded(contents, pairs, start, stop, rest, keep)
+        return keep
+
+    def _rule_byte_tensors(self, r: int):
+        """(follow [64,64], accept_b [256,64], first [64], last [64]) f32
+        for rule r, raw-byte accept (class translation folded in), byte 0
+        forced dead; cached per rule."""
+        cached = self._byte_tensor_cache.get(r)
+        if cached is not None:
+            return cached
+        nfa = self._nfas[r]
+        m = len(nfa.follow)
+        follow = np.zeros((64, 64), np.float32)
+        for p in range(m):
+            w = int(nfa.follow[p])
+            q = 0
+            while w:
+                if w & 1:
+                    follow[p, q] = 1.0
+                w >>= 1
+                q += 1
+        byte_masks = nfa.classmask[nfa.byte_class]  # [256] uint64
+        accept_b = np.zeros((256, 64), np.float32)
+        for q in range(m):
+            accept_b[:, q] = (
+                (byte_masks >> np.uint64(q)) & np.uint64(1)
+            ).astype(np.float32)
+        accept_b[0, :] = 0.0  # 0x00 = the universal dead separator
+        first = np.zeros(64, np.float32)
+        last = np.zeros(64, np.float32)
+        fw, lw = int(nfa.first), int(nfa.last)
+        for q in range(m):
+            if fw >> q & 1:
+                first[q] = 1.0
+            if lw >> q & 1:
+                last[q] = 1.0
+        out = (follow, accept_b, first, last)
+        self._byte_tensor_cache[r] = out
+        return out
+
+    def _verify_stream(
+        self, contents, pairs, start, stop, s_idx, keep
+    ) -> None:
+        """Multi-rule stream dispatch: pairs group by FILE, each file's
+        single SPAN of raw bytes (covering every candidate pair's window)
+        packs into fixed rows, and every distinct candidate rule scans
+        the same resident rows inside one dispatch.  Verdict: pair (f, r)
+        survives iff rule r's flag is set for any 32-position block
+        overlapping the pair's own window inside the span
+        (block-granular over-approx; the oracle confirm is exact)."""
+        import time as _time
+
+        tiers = STREAM_TIERS
+        st = self.stream_stats = {
+            "lanes": int(len(s_idx)), "span_bytes": 0,
+            "rows": [0] * len(tiers),
+            "rules": 0, "dispatches": 0, "overflow_lanes": 0,
+            "assemble_s": 0.0, "dispatch_s": 0.0, "fetch_map_s": 0.0,
+        }
+        t0 = _time.perf_counter()
+        overflow: list[int] = []  # lanes for the padded path
+
+        # distinct rules on the stream, most-claimed first; rules beyond
+        # the largest jit-stable stack fall back to the padded path
+        rvals, rcounts = np.unique(pairs[s_idx, 1], return_counts=True)
+        if len(rvals) > RULE_STACK_BUCKETS[-1]:
+            keep_rules = rvals[
+                np.argsort(-rcounts)[: RULE_STACK_BUCKETS[-1]]
+            ]
+        else:
+            keep_rules = rvals
+        rule_slot = {int(r): i for i, r in enumerate(np.sort(keep_rules))}
+        st["rules"] = len(rule_slot)
+
+        order = s_idx[np.argsort(pairs[s_idx, 0], kind="stable")]
+        rows_buf: list[list[np.ndarray]] = [[] for _ in tiers]
+        # flat per-lane placement (vectorized verdict resolution):
+        # lane id, tier, row, rule slot, first/last 32-block of its window
+        lv_lane: list[int] = []
+        lv_tier: list[int] = []
+        lv_row: list[int] = []
+        lv_slot: list[int] = []
+        lv_b0: list[int] = []
+        lv_b1: list[int] = []
+        open_row = [(-1, ln + 1) for ln in tiers]
+        pos = 0
+        while pos < len(order):
+            end = pos
+            f0 = pairs[order[pos], 0]
+            while end < len(order) and pairs[order[end], 0] == f0:
+                end += 1
+            lanes_f = [
+                int(li)
+                for li in order[pos:end]
+                if int(pairs[li, 1]) in rule_slot
+            ]
+            overflow.extend(
+                int(li)
+                for li in order[pos:end]
+                if int(pairs[li, 1]) not in rule_slot
+            )
+            pos = end
+            if not lanes_f:
+                continue
+            content = np.frombuffer(
+                contents[int(pairs[lanes_f[0], 0])], dtype=np.uint8
+            )
+            s = int(min(start[li] for li in lanes_f))
+            e = int(max(stop[li] for li in lanes_f))
+            span = content[s:e]
+            tier = next(
+                (t for t, ln in enumerate(tiers) if len(span) <= ln), -1
+            )
+            if tier < 0 or (span == 0).any():
+                # oversize span, or contains the dead separator byte:
+                # the padded class path verifies these exactly
+                overflow.extend(lanes_f)
+                continue
+            length = tiers[tier]
+            cur, cpos = open_row[tier]
+            if cur < 0 or cpos + len(span) > length:
+                rows_buf[tier].append(np.zeros(length, np.uint8))
+                cur, cpos = len(rows_buf[tier]) - 1, 0
+            rows_buf[tier][cur][cpos : cpos + len(span)] = span
+            for li in lanes_f:
+                rs0 = cpos + int(start[li]) - s
+                rs1 = cpos + int(stop[li]) - s
+                lv_lane.append(li)
+                lv_tier.append(tier)
+                lv_row.append(cur)
+                lv_slot.append(rule_slot[int(pairs[li, 1])])
+                lv_b0.append(rs0 // STREAM_BLOCK)
+                lv_b1.append(-(-rs1 // STREAM_BLOCK))
+            # one 0x00 separator byte between spans
+            open_row[tier] = (cur, cpos + len(span) + 1)
+            st["span_bytes"] += len(span)
+        st["rows"] = [len(b) for b in rows_buf]
+        st["overflow_lanes"] = len(overflow)
+        st["assemble_s"] = _time.perf_counter() - t0
+
+        if not any(rows_buf) and not overflow:
+            return
+
+        t0 = _time.perf_counter()
+        if not any(rows_buf):
+            # only overflow lanes: padded path handles everything
+            self._verify_padded(
+                contents, pairs, start, stop,
+                np.asarray(overflow, dtype=np.int64), keep,
+            )
+            return
+        # stack per-rule byte tensors (shared by both row tiers)
+        rb = next(
+            (b for b in RULE_STACK_BUCKETS if len(rule_slot) <= b),
+            RULE_STACK_BUCKETS[-1],
+        )
+        fol = np.zeros((rb, 64, 64), np.float32)
+        acc = np.zeros((rb, 256, 64), np.float32)
+        fst = np.zeros((rb, 64), np.float32)
+        lst = np.zeros((rb, 64), np.float32)
+        for r, slot in rule_slot.items():
+            f_, a_, s_, l_ = self._rule_byte_tensors(r)
+            fol[slot], acc[slot], fst[slot], lst[slot] = f_, a_, s_, l_
+        jdt = self._compute_dtype()
+        _, _, rep = self._shardings()
+        tens = tuple(
+            jax.device_put(jnp.asarray(t, jdt), rep)
+            if rep is not None
+            else jnp.asarray(t, jdt)
+            for t in (fol, acc, fst, lst)
+        )
+
+        run = (
+            self._run_stream_multi
+            if jdt == jnp.bfloat16
+            else self._run_stream_multi_gather
+        )
+        gbuckets = (
+            GROUP_BUCKETS if self.mesh is not None else STREAM_GROUP_BUCKETS
+        )
+        in_flight = []
+        for tier, length in enumerate(tiers):
+            n_rows = len(rows_buf[tier])
+            if not n_rows:
+                continue
+            gi = 0
+            while gi * LANES_PER_GROUP < n_rows:
+                remaining = -(-(n_rows - gi * LANES_PER_GROUP) // LANES_PER_GROUP)
+                gcap = next(
+                    (g for g in gbuckets if remaining <= g),
+                    gbuckets[-1],
+                )
+                row_lo = gi * LANES_PER_GROUP
+                row_hi = min(row_lo + gcap * LANES_PER_GROUP, n_rows)
+                gi += gcap
+                rows_arr = np.zeros(
+                    (gcap * LANES_PER_GROUP, length), dtype=np.uint8
+                )
+                for k, row in enumerate(range(row_lo, row_hi)):
+                    rows_arr[k] = rows_buf[tier][row]
+                # [G*Bg, L] -> [Lo, 32, G, Bg]
+                bytes_t = np.ascontiguousarray(
+                    rows_arr.reshape(
+                        gcap, LANES_PER_GROUP, length // STREAM_BLOCK,
+                        STREAM_BLOCK,
+                    ).transpose(2, 3, 0, 1)
+                )
+                bd = self._put_stream(bytes_t)
+                in_flight.append(
+                    (tier, row_lo, row_hi, run(bd, *tens))
+                )
+                st["dispatches"] += 1
+        st["dispatch_s"] = _time.perf_counter() - t0
+
+        # Overflow lanes run on the padded path WHILE the stream
+        # dispatches above are in flight (they were issued async), so the
+        # two device phases overlap instead of serializing round-trips.
+        if overflow:
+            self._verify_padded(
+                contents, pairs, start, stop,
+                np.asarray(overflow, dtype=np.int64), keep,
+            )
+
+        t0 = _time.perf_counter()
+        la_lane = np.asarray(lv_lane, dtype=np.int64)
+        la_tier = np.asarray(lv_tier, dtype=np.int8)
+        la_row = np.asarray(lv_row, dtype=np.int64)
+        la_slot = np.asarray(lv_slot, dtype=np.int32)
+        la_b0 = np.asarray(lv_b0, dtype=np.int64)
+        la_b1 = np.asarray(lv_b1, dtype=np.int64)
+        for tier, row_lo, row_hi, out in in_flight:
+            packed = np.asarray(out)  # [ceil(R/8), Lo, gcap, Bg] uint8
+            rp_, lo_, g_, bg_ = packed.shape
+            m = (
+                (la_tier == tier)
+                & (la_row >= row_lo)
+                & (la_row < row_hi)
+            )
+            if not m.any():
+                continue
+            # [P, Lo, G, Bg] -> [P, rows, Lo]; per used rule slot, extract
+            # its bit plane and cumsum blocks so "any hit block in
+            # [b0, b1)" is one vectorized compare per slot
+            h = packed.transpose(0, 2, 3, 1).reshape(rp_, g_ * bg_, lo_)
+            rows_rel = la_row[m] - row_lo
+            mslot = la_slot[m]
+            mlane = la_lane[m]
+            mb0 = la_b0[m]
+            mb1 = la_b1[m]
+            cs = np.zeros((g_ * bg_, lo_ + 1), dtype=np.uint16)
+            for slot in np.unique(mslot):
+                sm = mslot == slot
+                bits = (h[slot // 8] >> (slot % 8)) & 1
+                np.cumsum(bits, axis=1, dtype=np.uint16, out=cs[:, 1:])
+                rr = rows_rel[sm]
+                hit = cs[rr, mb1[sm]] > cs[rr, mb0[sm]]
+                keep[mlane[sm][hit]] = True
+        st["fetch_map_s"] = _time.perf_counter() - t0
+
+    def _put_stream(self, bytes_t: np.ndarray):
+        """Device placement for the 4D stream operand ([Lo, 32, G, Bg]:
+        G is the sharded axis)."""
+        if self.mesh is None:
+            return jnp.asarray(bytes_t)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        axes = tuple(self.mesh.axis_names)
+        return jax.device_put(
+            bytes_t, NamedSharding(self.mesh, P(None, None, axes, None))
+        )
+
+    def _verify_padded(
+        self, contents, pairs, start, stop, lane_idx, keep
+    ) -> None:
+        """Bucket-padded dispatch for jumbo windows / 64-class rules:
+        lanes sort by (window bucket, rule), pack into
+        [G, LANES_PER_GROUP] groups per length bucket, one dispatch per
+        (bucket, group-chunk)."""
+        wlen = stop - start
         bucket = np.searchsorted(np.array(LEN_BUCKETS), wlen, side="left")
-        order = np.lexsort((pairs[:, 1], bucket))
+        order = lane_idx[
+            np.lexsort((pairs[lane_idx, 1], bucket[lane_idx]))
+        ]
         tensors = self._device_tensors()
         # Phase 1: assemble + dispatch every (bucket, group-chunk) — JAX
         # dispatch is async, so transfers and executions of later chunks
@@ -271,9 +693,9 @@ class NfaVerifier:
         pos = 0
         while pos < len(order):
             bk = bucket[order[pos]]
-            end = int(
-                np.searchsorted(bucket[order], bk, side="right")
-            )
+            end = pos
+            while end < len(order) and bucket[order[end]] == bk:
+                end += 1
             lanes = order[pos:end]
             pos = end
             length = LEN_BUCKETS[bk]
@@ -298,20 +720,19 @@ class NfaVerifier:
                     (gcap, LANES_PER_GROUP, length), dtype=np.uint8
                 )
                 gids = np.zeros(gcap, dtype=np.int32)
-                for g, lane_idx in enumerate(chunk):
-                    r = int(pairs[lane_idx[0], 1])
+                for g, lane_arr in enumerate(chunk):
+                    r = int(pairs[lane_arr[0], 1])
                     gids[g] = r
                     lut = self.luts[r]
-                    for b, li in enumerate(lane_idx):
-                        data = np.frombuffer(contents[li], dtype=np.uint8)[
-                            start[li] : stop[li]
-                        ]
+                    for b, li in enumerate(lane_arr):
+                        data = np.frombuffer(
+                            contents[int(pairs[li, 0])], dtype=np.uint8
+                        )[start[li] : stop[li]]
                         classes[g, b, : len(data)] = lut[data]
                 classes_t = np.ascontiguousarray(classes.transpose(2, 0, 1))
                 cd, gd = self._put(classes_t, gids)
                 in_flight.append((chunk, self._run(cd, gd, *tensors)))
         for chunk, out in in_flight:
             matched = np.asarray(out)
-            for g, lane_idx in enumerate(chunk):
-                keep[lane_idx] = matched[g, : len(lane_idx)]
-        return keep
+            for g, lane_arr in enumerate(chunk):
+                keep[lane_arr] = matched[g, : len(lane_arr)]

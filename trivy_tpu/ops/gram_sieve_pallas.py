@@ -367,10 +367,16 @@ class PallasGramSieve:
 
     def _pack_w(self, length: int):
         if length not in self._weights:
+            import ml_dtypes
+
+            # Cached as NUMPY bfloat16 (not jnp): __call__ may run under an
+            # outer jit trace, where jnp.asarray would produce a tracer —
+            # caching that leaks it into later traces.  As numpy operands
+            # they convert at dispatch (or fold to constants under jit).
             wlo, whi = _pack_weights(length)
             self._weights[length] = (
-                jnp.asarray(wlo, jnp.bfloat16),
-                jnp.asarray(whi, jnp.bfloat16),
+                wlo.astype(ml_dtypes.bfloat16),
+                whi.astype(ml_dtypes.bfloat16),
             )
         return self._weights[length]
 
