@@ -463,7 +463,7 @@ package user.dockerfile.BRK001
 
 deny[res] {
     cmd := input.Stages[_].Commands[_]
-    net.cidr_contains("10.0.0.0/8", cmd.Value[0])
+    http.send({"method": "get", "url": cmd.Value[0]})
     res := result.new("x", cmd)
 }
 """

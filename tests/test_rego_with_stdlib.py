@@ -1,0 +1,215 @@
+"""Round-5 rego surface: `with` modifiers and the widened stdlib
+(net.cidr_*, time.*, regex.*, strings.*, json.patch, aggregates),
+exercised both directly and through the user-check loader end to end
+(VERDICT r4 item 5)."""
+
+import pytest
+
+from trivy_tpu.iac.engine import IacScanner
+from trivy_tpu.iac.rego import RegoEngine, RegoError
+
+
+def _deny(src: str, input_doc, data=None):
+    eng = RegoEngine()
+    mod = eng.load(src)
+    return eng.eval_deny(mod, input_doc, data)
+
+
+# --- with ------------------------------------------------------------------
+
+
+def test_with_overrides_input_path():
+    src = """
+package t
+is_root { input.user == "root" }
+deny[msg] {
+    is_root with input.user as "root"
+    msg := "mocked root fires"
+}
+deny[msg] {
+    not is_root with input.user as "alice"
+    msg := "mocked alice is not root"
+}
+"""
+    out = _deny(src, {"user": "nobody"})
+    assert sorted(out) == ["mocked alice is not root", "mocked root fires"]
+
+
+def test_with_overrides_whole_input_and_data():
+    src = """
+package t
+limit := data.config.max
+deny[msg] {
+    v := limit with data.config.max as 3
+    v == 3
+    w := input.n with input as {"n": 9}
+    w == 9
+    msg := "with rebinds both documents"
+}
+"""
+    assert _deny(src, {"n": 1}, {"config": {"max": 10}}) == [
+        "with rebinds both documents"
+    ]
+
+
+def test_with_restores_outer_documents():
+    src = """
+package t
+deny[msg] {
+    x := input.v with input.v as 5
+    x == 5
+    input.v == 1
+    msg := "outer input untouched"
+}
+"""
+    assert _deny(src, {"v": 1}) == ["outer input untouched"]
+
+
+def test_with_bad_target_is_load_error():
+    with pytest.raises(RegoError, match="'with' target"):
+        RegoEngine().load(
+            'package t\ndeny[m] { true with foo.bar as 1\n m := "x" }'
+        )
+
+
+def test_user_check_using_with_end_to_end(tmp_path):
+    """The OPA-test idiom inside a user check dir: the check mocks parts
+    of its own input to guard helper behavior, then evaluates the real
+    document — it must load and produce the right verdict."""
+    d = tmp_path / "checks"
+    d.mkdir()
+    (d / "mocked.rego").write_text(
+        """# METADATA
+# title: latest tag (self-tested via with)
+# custom:
+#   id: USR901
+#   severity: HIGH
+package user.dockerfile.USR901
+
+uses_latest {
+    cmd := input.Stages[_].Commands[_]
+    cmd.Cmd == "from"
+    endswith(cmd.Value[0], ":latest")
+}
+
+deny[res] {
+    # helper self-check under a mocked document: if the mock does not
+    # fire, the check is broken and stays silent (sound default)
+    uses_latest with input.Stages as [{"Commands": [{"Cmd": "from", "Value": ["x:latest"]}]}]
+    uses_latest
+    cmd := input.Stages[_].Commands[_]
+    cmd.Cmd == "from"
+    res := result.new("image uses :latest", cmd)
+}
+"""
+    )
+    s = IacScanner(extra_check_dirs=[str(d)])
+    mc = s.scan("Dockerfile", b"FROM nginx:latest\n")
+    assert any(f.check_id == "USR901" for f in mc.failures)
+    mc = s.scan("Dockerfile", b"FROM nginx:1.25\n")
+    assert not any(f.check_id == "USR901" for f in mc.failures)
+
+
+# --- stdlib ---------------------------------------------------------------
+
+
+def test_net_cidr_check_verdicts(tmp_path):
+    d = tmp_path / "checks"
+    d.mkdir()
+    (d / "cidr.rego").write_text(
+        """# METADATA
+# title: open ingress
+# custom:
+#   id: USR902
+#   severity: CRITICAL
+package user.terraform.USR902
+
+deny[res] {
+    ingress := input.resource.aws_security_group[_].ingress
+    cidr := ingress.cidr_blocks[_]
+    not net.cidr_contains("10.0.0.0/8", cidr)
+    res := result.new(sprintf("ingress %s outside the private range", [cidr]), ingress)
+}
+"""
+    )
+    s = IacScanner(extra_check_dirs=[str(d)])
+    bad = b"""
+resource "aws_security_group" "sg" {
+  ingress {
+    cidr_blocks = ["0.0.0.0/0"]
+  }
+}
+"""
+    good = b"""
+resource "aws_security_group" "sg" {
+  ingress {
+    cidr_blocks = ["10.2.0.0/16"]
+  }
+}
+"""
+    assert any(
+        f.check_id == "USR902" for f in s.scan("main.tf", bad).failures
+    )
+    assert not any(
+        f.check_id == "USR902" for f in s.scan("main.tf", good).failures
+    )
+
+
+def test_time_family():
+    src = """
+package t
+deny[msg] {
+    t := time.parse_rfc3339_ns("2024-03-10T12:30:45Z")
+    [y, m, d] := time.date(t)
+    [hh, mm, ss] := time.clock(t)
+    y == 2024; m == 3; d == 10; hh == 12; mm == 30; ss == 45
+    t2 := time.add_date(t, 1, 1, 1)
+    [y2, m2, d2] := time.date(t2)
+    y2 == 2025; m2 == 4; d2 == 11
+    time.now_ns() > t
+    msg := "time ok"
+}
+"""
+    assert _deny(src, {}) == ["time ok"]
+
+
+def test_regex_strings_json_families():
+    src = """
+package t
+deny[msg] {
+    regex.find_n("[a-z]+", "ab cd ef", 2) == ["ab", "cd"]
+    regex.split("-", "a-b-c") == ["a", "b", "c"]
+    regex.replace("a1b2", "[0-9]", "#") == "a#b#"
+    regex.is_valid("[a-z]")
+    not regex.is_valid("[")
+    strings.reverse("abc") == "cba"
+    strings.count("banana", "an") == 2
+    strings.any_prefix_match(["app-1", "svc"], ["app-"])
+    d := json.patch({"a": [1, 2]}, [{"op": "add", "path": "/a/-", "value": 3}])
+    d.a == [1, 2, 3]
+    msg := "families ok"
+}
+"""
+    assert _deny(src, {}) == ["families ok"]
+
+
+def test_aggregates_objects_units():
+    src = """
+package t
+deny[msg] {
+    sum([1, 2, 3]) == 6
+    max([4, 9, 2]) == 9
+    sort([3, 1, 2]) == [1, 2, 3]
+    numbers.range(1, 3) == [1, 2, 3]
+    object.union({"a": 1}, {"b": 2}) == {"a": 1, "b": 2}
+    object.remove({"a": 1, "b": 2}, ["a"]) == {"b": 2}
+    ks := object.keys({"a": 1})
+    "a" in ks
+    units.parse_bytes("2Ki") == 2048
+    units.parse_bytes("1G") == 1000000000
+    crypto.sha256("x") == "2d711642b726b04401627ca9fbac32f5c8530fb1903cc4db02258717921a4881"
+    base64.decode(base64.encode("hi")) == "hi"
+    msg := "aggregates ok"
+}
+"""
+    assert _deny(src, {}) == ["aggregates ok"]

