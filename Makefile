@@ -1,0 +1,23 @@
+# Developer/CI entry points.  Everything runs on the virtual CPU mesh
+# unless the environment points JAX at real hardware.
+
+PY ?= python
+
+.PHONY: test smoke bench
+
+# Tier-1: the suite the driver holds the repo to (fast, CPU, no slow marks).
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider
+
+# CI smoke: tiny-corpus bench.py --smoke on CPU (pipeline depth 2) via the
+# slow-marked subprocess test, which asserts the single-JSON-line contract
+# and nonzero h2d overlap accounting.
+smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest \
+		tests/test_bench_smoke.py::test_bench_smoke_subprocess \
+		-q -p no:cacheprovider
+
+# Full benchmark (honest corpora; on CPU this takes a while).
+bench:
+	$(PY) bench.py

@@ -26,6 +26,15 @@ from __future__ import annotations
 
 import json
 import os
+import sys
+
+SMOKE = "--smoke" in sys.argv
+if SMOKE:
+    # CI smoke: tiny corpus on CPU, pipeline depth 2, heavy sections off.
+    # Env is pinned before anything can import jax.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("TRIVY_TPU_PIPELINE_DEPTH", "2")
+
 import time
 
 import numpy as np
@@ -42,6 +51,19 @@ DEVICE = os.environ.get("BENCH_DEVICE", "1") == "1"
 HITDENSE = os.environ.get("BENCH_HITDENSE", "1") == "1"
 HITDENSE_FILES = int(os.environ.get("BENCH_HITDENSE_FILES", "20000"))
 BACKEND = os.environ.get("BENCH_BACKEND", "auto")
+if SMOKE:
+    N_FILES = 400
+    RULE_SCALING = False
+    KERNEL = False
+    HITDENSE_FILES = 200
+    os.environ.setdefault("BENCH_LICENSE", "0")
+    os.environ.setdefault("BENCH_IMAGE", "0")
+
+# The calling harness records only the trailing 2000 bytes of stdout
+# (r04/r05 recorded "parsed": null because the one JSON line outgrew the
+# tail window).  The final line stays under this budget; full detail goes
+# to BENCH_DETAIL_FILE.
+MAX_LINE_BYTES = 1900
 
 
 def gate_corpus(corpus, analyzer):
@@ -422,30 +444,44 @@ def bench_image(n_layers: int = 20, files_per_layer: int = 50) -> dict:
     }
 
 
-def bench_device_engine(n_files: int = 10000) -> dict:
+def bench_device_engine(
+    n_files: int = 10000, max_batch_tiles: int | None = None
+) -> dict:
     """The Pallas/XLA device engine on a monorepo subset, with the same
     accounting as the primary config (gating inside the timed region,
     corpus-basis files/s) — plus the link-economics accounting the
     all-device design is bounded by: every gated byte crosses the
     host->device link once, so wall >= bytes_on_link / link rate.  On
     relay-attached chips that floor, not the kernel, is the ceiling
-    (VERDICT r3 #4); the numbers below make the bound checkable."""
+    (VERDICT r3 #4); the numbers below make the bound checkable.
+
+    Also measures the chunked pipeline against its own serial baseline
+    (depth=1, dedupe off) and the resident-LRU rescan.  Comparison
+    engines run with the resident cache OFF so best-of-N trials measure
+    cold-link walls, not rescans."""
     from trivy_tpu.engine.device import TpuSecretEngine
     from trivy_tpu.engine.hybrid import probe_link
 
+    kw: dict = {}
+    if max_batch_tiles is not None:
+        kw["max_batch_tiles"] = max_batch_tiles
     corpus = bench_corpus.make_monorepo_corpus(n_files)
-    engine = TpuSecretEngine()
+    engine = TpuSecretEngine(resident_chunks=0, **kw)
     engine.warmup()
     detail, _results, _items, _ = bench_corpus_config(corpus, engine, trials=2)
     tile_bytes = engine.stats.tiles * engine.tile_len
     mb_s, rtt = probe_link()
+    ph = detail.get("phases") or {}
     out = {
         "files": detail["files"],
         "files_per_sec": detail["files_per_sec"],
         "mb_per_sec": detail["mb_per_sec"],
         "findings": detail["findings"],
         "platform": _device_platform(),
-        "phases": detail.get("phases"),
+        "phases": ph,
+        "pipeline_depth": ph.get("pipeline_depth", 0),
+        "h2d_overlap_s": ph.get("h2d_overlap_s", 0.0),
+        "dedupe_saved_bytes": ph.get("dedupe_saved_bytes", 0),
         "bytes_on_link": tile_bytes,
         "link_mb_per_sec": round(mb_s, 1),
         "link_rtt_s": round(rtt, 4),
@@ -457,6 +493,42 @@ def bench_device_engine(n_files: int = 10000) -> dict:
         floor_s = tile_bytes / (mb_s * 1e6) + dispatches * rtt
         out["device_dispatches"] = dispatches
         out["link_floor_s"] = round(floor_s, 3)
+
+    # Serial baseline: same engine, pipeline depth 1, no dedupe — the
+    # pre-pipeline dispatch discipline.  Pipelined wall must not exceed it.
+    serial = TpuSecretEngine(
+        pipeline_depth=1, dedupe=False, resident_chunks=0, **kw
+    )
+    serial.warmup()
+    sdetail, _, _, _ = bench_corpus_config(corpus, serial, trials=2)
+    out["serial_wall_s"] = sdetail["wall_s"]
+    out["pipelined_wall_s"] = detail["wall_s"]
+    if detail["wall_s"] > 0:
+        out["pipeline_speedup"] = round(sdetail["wall_s"] / detail["wall_s"], 3)
+
+    # Resident-LRU rescan: a second scan of identical content serves
+    # chunks from device-resident buffers without re-crossing the link.
+    try:
+        from trivy_tpu.engine.device import SieveStats
+
+        res = TpuSecretEngine(**kw)
+        res.warmup()
+        scan_items, _ = gate_corpus(corpus, _make_analyzer(res))
+        t0 = time.perf_counter()
+        res.scan_batch(scan_items)
+        cold = time.perf_counter() - t0
+        res.stats = SieveStats()
+        t0 = time.perf_counter()
+        res.scan_batch(scan_items)
+        warm = time.perf_counter() - t0
+        out["resident_rescan"] = {
+            "cold_wall_s": round(cold, 3),
+            "warm_wall_s": round(warm, 3),
+            "resident_hits": res.stats.resident_hits,
+            "speedup": round(cold / warm, 2) if warm > 0 else None,
+        }
+    except Exception as e:
+        out["resident_rescan"] = {"error": f"{type(e).__name__}: {e}"}
     # Measured transfer/exec decomposition (one sync-timed pass — does
     # not trust the probe's rate estimate, which drifts on the relay):
     # link_bound_fraction is the share of device wall that is pure h2d.
@@ -559,6 +631,83 @@ def _device_platform() -> str:
         return "unavailable"
 
 
+def _compact_detail(detail: dict) -> dict:
+    """Headline subset of `detail` small enough for the tail-captured
+    stdout line; the full structure lives in the side file."""
+    c = {
+        k: detail[k]
+        for k in (
+            "files", "scanned_files", "wall_s", "files_per_sec",
+            "mb_per_sec", "findings", "verify", "parity_checked_files",
+            "oracle_files_per_sec", "oracle_baseline_basis", "smoke",
+        )
+        if k in detail
+    }
+    de = detail.get("device_engine")
+    if isinstance(de, dict):
+        c["device_engine"] = {
+            k: de[k]
+            for k in (
+                "files_per_sec", "serial_wall_s", "pipelined_wall_s",
+                "pipeline_speedup", "pipeline_depth", "h2d_overlap_s",
+                "dedupe_saved_bytes", "resident_rescan",
+                "link_bound_fraction", "link_floor_s", "error",
+            )
+            if k in de
+        }
+    vb = detail.get("verify_backend")
+    if isinstance(vb, dict):
+        vc = {
+            k: vb[k] for k in ("device_vs_dfa", "error") if k in vb
+        }
+        dev = vb.get("device")
+        if isinstance(dev, dict) and isinstance(dev.get("stream"), dict):
+            s = dev["stream"]
+            vc["stream"] = {
+                k: s[k]
+                for k in (
+                    "dispatches", "pipeline_depth", "h2d_overlap_s",
+                    "assemble_s", "dispatch_s", "fetch_map_s",
+                )
+                if k in s
+            }
+        if vc:
+            c["verify_backend"] = vc
+    return c
+
+
+def _emit(detail: dict, error: str | None = None) -> None:
+    """Print exactly one well-formed JSON line, guaranteed to parse and to
+    fit the harness's 2000-byte stdout tail.  Full detail goes to
+    BENCH_DETAIL_FILE (default BENCH_DETAIL.json) next to the repo."""
+    payload: dict = {
+        "metric": "secret_scan_files_per_sec",
+        "value": detail.get("files_per_sec"),
+        "unit": "files/s",
+    }
+    if detail.get("oracle_files_per_sec") and detail.get("files_per_sec"):
+        payload["vs_baseline"] = round(
+            detail["files_per_sec"] / detail["oracle_files_per_sec"], 2
+        )
+    if error is not None:
+        payload["error"] = error[:400]
+    detail_path = os.environ.get("BENCH_DETAIL_FILE", "BENCH_DETAIL.json")
+    try:
+        with open(detail_path, "w") as f:
+            json.dump(detail, f, indent=2, default=str)
+        payload["detail_file"] = detail_path
+    except OSError:
+        pass
+    payload["detail"] = _compact_detail(detail)
+    line = json.dumps(payload, separators=(",", ":"), default=str)
+    if len(line.encode()) > MAX_LINE_BYTES:
+        payload["detail"] = {"truncated": True}
+        line = json.dumps(payload, separators=(",", ":"), default=str)
+    json.loads(line)  # the one line must parse — validate before printing
+    sys.stdout.write(line + "\n")
+    sys.stdout.flush()
+
+
 def main() -> None:
     from trivy_tpu.engine.hybrid import make_secret_engine
 
@@ -641,7 +790,15 @@ def main() -> None:
         # number is link-economics context (README "hybrid path"), not
         # the headline — the hybrid keeps bytes host-side by design.
         try:
-            detail["device_engine"] = bench_device_engine()
+            if SMOKE:
+                # Small corpus + small buckets so the batch still splits
+                # into several chunks: the pipeline (depth 2) must show
+                # nonzero overlap accounting even on CPU.
+                detail["device_engine"] = bench_device_engine(
+                    n_files=2000, max_batch_tiles=512
+                )
+            else:
+                detail["device_engine"] = bench_device_engine()
         except Exception as e:
             detail["device_engine"] = {"error": f"{type(e).__name__}: {e}"}
         # Link-independent kernel exec (the number that transfers to
@@ -677,27 +834,20 @@ def main() -> None:
     except Exception:
         pass
 
-    files_per_sec = detail["files_per_sec"]
-    print(
-        json.dumps(
-            {
-                "metric": "secret_scan_files_per_sec",
-                "value": files_per_sec,
-                "unit": "files/s",
-                "vs_baseline": round(
-                    files_per_sec / detail["oracle_files_per_sec"], 2
-                ),
-                "detail": detail,
-            }
-        ),
-        flush=True,
-    )
+    if SMOKE:
+        detail["smoke"] = True
+    _emit(detail)
 
 
 if __name__ == "__main__":
-    main()
+    code = 0
+    try:
+        main()
+    except BaseException as e:  # the one JSON line must emit regardless
+        _emit({}, error=f"{type(e).__name__}: {e}")
+        code = 1
     # Interpreter teardown can hang in the accelerator client (observed:
     # the axon relay blocks shutdown after device sections ran, leaving
     # the caller's pipe with a truncated line).  The JSON is flushed;
     # exit without running teardown.
-    os._exit(0)
+    os._exit(code)

@@ -1,0 +1,168 @@
+"""Packing edge cases and content-digest blob dedupe (scanner/packing.py).
+
+Covers the shapes the monorepo/container corpora actually produce: empty
+files, files far larger than the biggest row bucket, and heavily-duplicated
+batches (vendored trees, container layers) through dedupe_blobs.
+"""
+
+import numpy as np
+import pytest
+
+from trivy_tpu.scanner.packing import (
+    DedupeResult,
+    PackedBatch,
+    dedupe_blobs,
+    pack,
+    pack_dense,
+)
+
+SECRET = b"AWS_ACCESS_KEY_ID=AKIAQ6FAKEKEY1234567\n"
+
+
+# ---------------------------------------------------------------- dedupe
+
+
+def test_dedupe_no_duplicates_is_identity():
+    contents = [b"alpha", b"beta", b"gamma"]
+    dd = dedupe_blobs(contents)
+    assert dd.num_unique == 3
+    assert not dd.any_duplicates()
+    assert dd.saved_bytes == 0
+    np.testing.assert_array_equal(dd.unique_index, [0, 1, 2])
+    np.testing.assert_array_equal(dd.inverse, [0, 1, 2])
+
+
+def test_dedupe_all_duplicates_fans_out_to_every_alias():
+    blob = b"the same bytes in every slot" * 7
+    contents = [blob] * 6
+    dd = dedupe_blobs(contents)
+    assert dd.num_unique == 1
+    assert dd.any_duplicates()
+    assert dd.saved_bytes == 5 * len(blob)
+    np.testing.assert_array_equal(dd.unique_index, [0])
+    np.testing.assert_array_equal(dd.inverse, np.zeros(6, dtype=np.int64))
+    # fan_out replicates per-unique results to all aliases, order-stable
+    fanned = dd.fan_out(["only-result"])
+    assert fanned == ["only-result"] * 6
+    arr = dd.fan_out(np.array([[1, 2]]))
+    assert arr.shape == (6, 2)
+
+
+def test_dedupe_mixed_order_stable():
+    a, b, c = b"aaaa", b"bbbb", b"cccc"
+    contents = [a, b, a, c, b, a]
+    dd = dedupe_blobs(contents)
+    # unique blobs keep first-occurrence order
+    np.testing.assert_array_equal(dd.unique_index, [0, 1, 3])
+    np.testing.assert_array_equal(dd.inverse, [0, 1, 0, 2, 1, 0])
+    assert dd.saved_bytes == len(a) * 2 + len(b)
+    # per-unique array results land back on the right aliases
+    per_unique = np.array([10, 20, 30])
+    np.testing.assert_array_equal(
+        dd.fan_out(per_unique), [10, 20, 10, 30, 20, 10]
+    )
+
+
+def test_dedupe_zero_length_blobs():
+    contents = [b"", b"x", b"", b""]
+    dd = dedupe_blobs(contents)
+    assert dd.num_unique == 2
+    # empty blobs dedupe too (digest of b"" is stable); saved bytes is 0
+    # for them but the alias fan-out still collapses the scan work
+    np.testing.assert_array_equal(dd.inverse, [0, 1, 0, 0])
+    assert dd.saved_bytes == 0
+
+
+def test_dedupe_empty_batch():
+    dd = dedupe_blobs([])
+    assert dd.num_unique == 0
+    assert len(dd.inverse) == 0
+    assert not dd.any_duplicates()
+
+
+def test_dedupe_result_roundtrip_through_candidate_matrix():
+    # the engine's usage pattern: candidates over unique rows, then
+    # cand[inverse] must equal candidates computed over the full batch
+    contents = [b"u0", b"u1", b"u0", b"u2", b"u1"]
+    dd = dedupe_blobs(contents)
+    cand_unique = np.array([[1, 0], [0, 1], [1, 1]], dtype=bool)
+    full = cand_unique[dd.inverse]
+    assert full.shape == (5, 2)
+    np.testing.assert_array_equal(full[0], full[2])
+    np.testing.assert_array_equal(full[1], full[4])
+
+
+# ---------------------------------------------------------------- packing
+
+
+def test_pack_zero_length_blob_gets_a_tile():
+    batch = pack([b"", b"abc"], tile_len=64, overlap=4)
+    assert isinstance(batch, PackedBatch)
+    assert batch.num_files == 2
+    # the empty file still owns one (all-zero) tile so indices stay aligned
+    assert (batch.tile_file >= 0).sum() == 2
+    hits = np.zeros((len(batch.tiles), 1), dtype=np.uint32)
+    out = batch.file_hits(hits)
+    assert out.shape == (2, 1)
+
+
+def test_pack_dense_zero_length_blob_no_rows():
+    batch = pack_dense([b"", b"abcd" * 64], row_len=128, overlap=8)
+    assert batch.num_files == 2
+    # empty file maps to no rows: hi < lo
+    assert batch.file_row_hi[0] < batch.file_row_lo[0]
+    hits = np.ones((len(batch.rows), 1), dtype=np.uint32)
+    out = batch.file_hits(hits)
+    assert out[0, 0] == 0  # nothing attributes to the empty file
+    assert out[1, 0] == 1
+
+
+def test_pack_blob_larger_than_bucket_spans_tiles():
+    # one blob much larger than tile_len must split into overlapping
+    # tiles that all attribute back to file 0, with the overlap region
+    # duplicated so no window straddles a seam undetected
+    tile_len, overlap = 256, 16
+    blob = bytes(range(256)) * 8  # 2048 bytes
+    batch = pack([blob], tile_len=tile_len, overlap=overlap)
+    n_tiles = int((batch.tile_file == 0).sum())
+    assert n_tiles > 1
+    stride = tile_len - overlap
+    data = np.frombuffer(blob, dtype=np.uint8)
+    for t in range(n_tiles):
+        chunk = data[t * stride : t * stride + tile_len]
+        np.testing.assert_array_equal(batch.tiles[t, : len(chunk)], chunk)
+
+
+def test_pack_dense_blob_larger_than_bucket():
+    row_len, overlap = 128, 8
+    blob = (b"z" * 50 + SECRET) * 40  # ~3.5 KB >> row_len
+    batch = pack_dense([blob], row_len=row_len, overlap=overlap)
+    lo, hi = int(batch.file_row_lo[0]), int(batch.file_row_hi[0])
+    assert hi - lo + 1 > 1  # spans many rows
+    # every byte of the blob appears in some row
+    stride = row_len - overlap
+    recon = bytearray()
+    for r in range(len(batch.rows)):
+        recon.extend(batch.rows[r][: stride if r < len(batch.rows) - 1 else row_len])
+    assert bytes(recon[: len(blob)]) == blob
+
+
+def test_engine_dedupe_parity_on_all_duplicate_batch():
+    # end-to-end: a batch whose blobs are all identical must produce
+    # per-file findings identical to the dedupe-off engine, order-stable
+    from trivy_tpu.engine.device import TpuSecretEngine
+
+    content = b"config\n" + SECRET + b"tail\n"
+    items = [(f"srv/app{i}/cfg.txt", content) for i in range(8)]
+    eng_dd = TpuSecretEngine(tile_len=512, dedupe=True)
+    eng_no = TpuSecretEngine(tile_len=512, dedupe=False)
+    got = eng_dd.scan_batch(items)
+    want = eng_no.scan_batch(items)
+    assert eng_dd.stats.dedupe_saved_bytes == 7 * len(content)
+    for g, w in zip(got, want):
+        assert g.file_path == w.file_path
+        assert [f.to_json() for f in g.findings] == [
+            f.to_json() for f in w.findings
+        ]
+    # findings stay per-file even though the bytes deduped to one blob
+    assert sum(len(r.findings) for r in got) == 8

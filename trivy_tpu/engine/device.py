@@ -37,6 +37,7 @@ from trivy_tpu.rules.model import RuleSet, SecretConfig, build_ruleset
 from trivy_tpu.scanner.packing import (
     DEFAULT_OVERLAP,
     DEFAULT_TILE_LEN,
+    dedupe_blobs,
     pack,
     pack_dense,
 )
@@ -81,6 +82,14 @@ class SieveStats:
     # sync between them.  Production keeps transfers/exec pipelined.
     h2d_s: float = 0.0
     exec_s: float = 0.0
+    # Chunk-pipeline accounting (engine/pipeline.py): finish-stage wall
+    # that ran while later chunks were staged/executing (transfer hidden
+    # behind compute), content-digest dedupe savings, resident-LRU chunk
+    # hits, and the depth the run used.
+    h2d_overlap_s: float = 0.0
+    dedupe_saved_bytes: int = 0
+    resident_hits: int = 0
+    pipeline_depth: int = 0
 
     def phases(self) -> dict:
         out = {
@@ -91,6 +100,13 @@ class SieveStats:
         }
         if self.verify_s:
             out["verify_s"] = round(self.verify_s, 4)
+        if self.pipeline_depth:
+            out["pipeline_depth"] = self.pipeline_depth
+            out["h2d_overlap_s"] = round(self.h2d_overlap_s, 4)
+        if self.dedupe_saved_bytes:
+            out["dedupe_saved_bytes"] = self.dedupe_saved_bytes
+        if self.resident_hits:
+            out["resident_hits"] = self.resident_hits
         return out
 
 
@@ -108,7 +124,15 @@ class TpuSecretEngine:
         max_batch_tiles: int | None = None,
         sieve: str = "gram",
         kernel: str = "auto",
+        pipeline_depth: int | None = None,
+        dedupe: bool = True,
+        resident_chunks: int | None = None,
     ):
+        from trivy_tpu.engine.pipeline import (
+            ResidentChunkCache,
+            default_depth,
+        )
+
         self._max_tiles_explicit = max_batch_tiles is not None
         if max_batch_tiles is None:
             max_batch_tiles = self.DEFAULT_MAX_BATCH_TILES
@@ -119,6 +143,12 @@ class TpuSecretEngine:
         self.max_batch_tiles = max_batch_tiles
         self.sieve = sieve
         self.stats = SieveStats()
+        self.pipeline_depth = (
+            pipeline_depth if pipeline_depth is not None else default_depth()
+        )
+        self.dedupe = dedupe
+        self._resident = ResidentChunkCache(resident_chunks)
+        self._sieve_donated = None
         self._mesh = mesh
         self._tile_buckets = TILE_BUCKETS
         self._tile_align = (
@@ -274,38 +304,117 @@ class TpuSecretEngine:
         conj_ok = (~self._conj_any[None] | conj_hit).all(-1)
         return gate_ok & conj_ok
 
+    @staticmethod
+    def _pad_chunk(rows: np.ndarray, off: int, max_rows: int) -> np.ndarray:
+        part = rows[off : off + max_rows]
+        if len(part) < max_rows:
+            part = np.concatenate(
+                [part, np.zeros((max_rows - len(part), part.shape[1]), np.uint8)]
+            )
+        return np.ascontiguousarray(part)
+
+    def _exec_fn(self):
+        """Sieve callable for pipelined dispatch.  On TPU the row buffer is
+        donated so XLA reuses the staging allocation in place of an extra
+        device-side copy; on other backends donation is a silent no-op
+        warning, so the plain callable runs."""
+        if self._sieve_donated is None:
+            import jax
+
+            fn = self._sieve_fn
+            if jax.default_backend() == "tpu":
+                fn = jax.jit(lambda r: self._sieve_fn(r), donate_argnums=0)
+            self._sieve_donated = fn
+        return self._sieve_donated
+
+    def _resident_dispatch(self, part: np.ndarray) -> np.ndarray:
+        """One synchronous dispatch through the resident-chunk LRU: a
+        digest-identical chunk never re-crosses the link."""
+        from trivy_tpu.engine.pipeline import chunk_digest
+
+        digest = None
+        # Sync-timing passes measure the raw link; a resident hit would
+        # skip the transfer being measured.
+        if self._resident.capacity and not os.environ.get(
+            "TRIVY_TPU_SYNC_TIMING"
+        ):
+            digest = chunk_digest(part)
+            hit = self._resident.get(digest)
+            if hit is not None:
+                self.stats.resident_hits += 1
+                return hit
+        self.stats.device_dispatches += 1
+        out = self._dispatch_rows(part)
+        if digest is not None:
+            self._resident.put(digest, out)
+        return out
+
     def _sieve_rows(self, rows: np.ndarray) -> np.ndarray:
         """Run the device sieve over fixed-shape row chunks; returns the
         per-row packed hit words [T, W]."""
-        import jax.numpy as jnp
+        import jax
+
+        from trivy_tpu.engine.pipeline import ChunkPipeline, chunk_digest
 
         buckets = self._buckets()
         max_rows = buckets[-1]
         total = len(rows)
         fit = next((b for b in buckets if total <= b), None)
         if fit is not None:
-            if total < fit:
-                rows = np.concatenate(
-                    [rows, np.zeros((fit - total, rows.shape[1]), np.uint8)]
+            return self._resident_dispatch(self._pad_chunk(rows, 0, fit))[
+                :total
+            ]
+        if os.environ.get("TRIVY_TPU_SYNC_TIMING"):
+            # Forced-sync decomposition (bench's h2d/exec split): serial by
+            # design so the phase boundary stays measurable.
+            chunks = []
+            for off in range(0, total, max_rows):
+                self.stats.device_dispatches += 1
+                chunks.append(
+                    self._dispatch_rows(self._pad_chunk(rows, off, max_rows))
                 )
+            return np.concatenate(chunks)[:total]
+
+        # Chunked pipeline (engine/pipeline.py): h2d staging of chunk N+1
+        # (async device_put) overlaps exec of chunk N (donated buffer on
+        # TPU) and the d2h fetch of chunk N-1, bounded at pipeline_depth
+        # chunks in flight; digest-unchanged chunks come from the resident
+        # LRU without touching the link at all.
+        n_chunks = -(-total // max_rows)
+        outs: list = [None] * n_chunks
+        exec_fn = self._exec_fn()
+
+        def stage(ci):
+            part = self._pad_chunk(rows, ci * max_rows, max_rows)
+            if self._resident.capacity:
+                digest = chunk_digest(part)
+                hit = self._resident.get(digest)
+                if hit is not None:
+                    return (digest, hit, True)
+                return (digest, jax.device_put(part), False)
+            return (None, jax.device_put(part), False)
+
+        def execute(ci, staged):
+            digest, dev, hit = staged
+            if hit:
+                self.stats.resident_hits += 1
+                return (digest, dev, True)
             self.stats.device_dispatches += 1
-            return self._dispatch_rows(rows)[:total]
-        # Chunk into fixed max-bucket-row batches: one compiled shape,
-        # pipelined h2d/compute across chunks (dispatch is async; results
-        # materialize only at the end).
-        chunks = []
-        for off in range(0, total, max_rows):
-            part = rows[off : off + max_rows]
-            if len(part) < max_rows:
-                part = np.concatenate(
-                    [part, np.zeros((max_rows - len(part), part.shape[1]), np.uint8)]
-                )
-            if os.environ.get("TRIVY_TPU_SYNC_TIMING"):
-                chunks.append(self._dispatch_rows(part))
-            else:
-                chunks.append(self._sieve_fn(jnp.asarray(part)))
-            self.stats.device_dispatches += 1
-        return np.concatenate([np.asarray(c) for c in chunks])[:total]
+            return (digest, exec_fn(dev), False)
+
+        def finish(ci, handle):
+            digest, out, hit = handle
+            out = np.asarray(out)
+            if not hit and digest is not None:
+                self._resident.put(digest, out)
+            outs[ci] = out
+
+        pipe = ChunkPipeline(
+            stage, execute, finish, depth=self.pipeline_depth
+        )
+        pipe.run(range(n_chunks))
+        self.stats.h2d_overlap_s += pipe.stats.h2d_overlap_s
+        return np.concatenate(outs)[:total]
 
     def _dispatch_rows(self, rows: np.ndarray) -> np.ndarray:
         """One sieve dispatch.  Under TRIVY_TPU_SYNC_TIMING=1 the h2d
@@ -434,9 +543,30 @@ class TpuSecretEngine:
             return []
         self.stats.files += len(items)
         self.stats.bytes += sum(len(c) for _, c in items)
+        self.stats.pipeline_depth = self.pipeline_depth
 
-        cand = self._candidates([c for _, c in items])
-        cand = self._verify_candidates(items, cand)
+        # Content-digest dedupe in front of the link: sieve/verify run over
+        # distinct blobs only, candidates fan back out to every alias (the
+        # byte-exact confirm below stays per (path, content) — path gating
+        # is per-file).
+        contents = [c for _, c in items]
+        scan_items = items
+        dd = None
+        if self.dedupe and len(items) > 1:
+            t0 = _time.perf_counter()
+            dd = dedupe_blobs(contents)
+            self.stats.pack_s += _time.perf_counter() - t0
+            if dd.any_duplicates():
+                self.stats.dedupe_saved_bytes += dd.saved_bytes
+                scan_items = [items[int(i)] for i in dd.unique_index]
+                contents = [c for _, c in scan_items]
+            else:
+                dd = None
+
+        cand = self._candidates(contents)
+        cand = self._verify_candidates(scan_items, cand)
+        if dd is not None:
+            cand = cand[dd.inverse]
 
         t0 = _time.perf_counter()
         results: list[Secret] = []

@@ -453,13 +453,18 @@ class NfaVerifier:
         overlapping the pair's own window inside the span
         (block-granular over-approx; the oracle confirm is exact)."""
         import time as _time
+        from collections import deque
 
+        from trivy_tpu.engine.pipeline import default_depth
+
+        depth = default_depth()
         tiers = STREAM_TIERS
         st = self.stream_stats = {
             "lanes": int(len(s_idx)), "span_bytes": 0,
             "rows": [0] * len(tiers),
             "rules": 0, "dispatches": 0, "overflow_lanes": 0,
             "assemble_s": 0.0, "dispatch_s": 0.0, "fetch_map_s": 0.0,
+            "pipeline_depth": depth, "h2d_overlap_s": 0.0,
         }
         t0 = _time.perf_counter()
         overflow: list[int] = []  # lanes for the padded path
@@ -478,6 +483,96 @@ class NfaVerifier:
 
         order = s_idx[np.argsort(pairs[s_idx, 0], kind="stable")]
         rows_buf: list[list[np.ndarray]] = [[] for _ in tiers]
+        flushed = [0] * len(tiers)
+
+        # Pipelined dispatch machinery: a full max-group block of rows
+        # dispatches DURING assembly (same dispatch granularity as before,
+        # so the per-dispatch fixed relay cost is unchanged — only the
+        # serialization goes away), and fetches run bounded-depth so d2h
+        # of dispatch N-1 overlaps exec/transfer of N.  The r05 stream
+        # serialized assemble (0.39s) -> dispatch (1.89s) -> fetch_map
+        # (1.48s); these stages now overlap.
+        jdt = self._compute_dtype()
+        run = (
+            self._run_stream_multi
+            if jdt == jnp.bfloat16
+            else self._run_stream_multi_gather
+        )
+        gbuckets = (
+            GROUP_BUCKETS if self.mesh is not None else STREAM_GROUP_BUCKETS
+        )
+        flush_rows = gbuckets[-1] * LANES_PER_GROUP
+        tens = None
+        in_flight: deque = deque()
+        fetched: list[tuple] = []
+
+        def _build_tensors():
+            # stack per-rule byte tensors (shared by all row tiers)
+            nonlocal tens
+            rb = next(
+                (b for b in RULE_STACK_BUCKETS if len(rule_slot) <= b),
+                RULE_STACK_BUCKETS[-1],
+            )
+            fol = np.zeros((rb, 64, 64), np.float32)
+            acc = np.zeros((rb, 256, 64), np.float32)
+            fst = np.zeros((rb, 64), np.float32)
+            lst = np.zeros((rb, 64), np.float32)
+            for r, slot in rule_slot.items():
+                f_, a_, s_, l_ = self._rule_byte_tensors(r)
+                fol[slot], acc[slot], fst[slot], lst[slot] = f_, a_, s_, l_
+            _, _, rep = self._shardings()
+            tens = tuple(
+                jax.device_put(jnp.asarray(t, jdt), rep)
+                if rep is not None
+                else jnp.asarray(t, jdt)
+                for t in (fol, acc, fst, lst)
+            )
+
+        def _fetch_one():
+            tier_, lo_, hi_, out = in_flight.popleft()
+            tf = _time.perf_counter()
+            packed = np.asarray(out)
+            dtf = _time.perf_counter() - tf
+            st["fetch_map_s"] += dtf
+            if in_flight:  # later dispatches were in flight while we waited
+                st["h2d_overlap_s"] += dtf
+            fetched.append((tier_, lo_, hi_, packed))
+
+        def _flush_range(tier, row_lo, row_hi):
+            """Dispatch rows [row_lo, row_hi) of `tier` in group-bucket
+            chunks, fetching oldest results once `depth` are in flight."""
+            td = _time.perf_counter()
+            if tens is None:
+                _build_tensors()
+            length = tiers[tier]
+            gi = row_lo
+            while gi < row_hi:
+                remaining = -(-(row_hi - gi) // LANES_PER_GROUP)
+                gcap = next(
+                    (g for g in gbuckets if remaining <= g), gbuckets[-1]
+                )
+                lo = gi
+                hi = min(lo + gcap * LANES_PER_GROUP, row_hi)
+                gi = hi
+                rows_arr = np.zeros(
+                    (gcap * LANES_PER_GROUP, length), dtype=np.uint8
+                )
+                for k, row in enumerate(range(lo, hi)):
+                    rows_arr[k] = rows_buf[tier][row]
+                # [G*Bg, L] -> [Lo, 32, G, Bg]
+                bytes_t = np.ascontiguousarray(
+                    rows_arr.reshape(
+                        gcap, LANES_PER_GROUP, length // STREAM_BLOCK,
+                        STREAM_BLOCK,
+                    ).transpose(2, 3, 0, 1)
+                )
+                bd = self._put_stream(bytes_t)
+                in_flight.append((tier, lo, hi, run(bd, *tens)))
+                st["dispatches"] += 1
+                while len(in_flight) > depth:
+                    _fetch_one()
+            st["dispatch_s"] += _time.perf_counter() - td
+
         # flat per-lane placement (vectorized verdict resolution):
         # lane id, tier, row, rule slot, first/last 32-block of its window
         lv_lane: list[int] = []
@@ -538,14 +633,19 @@ class NfaVerifier:
             # one 0x00 separator byte between spans
             open_row[tier] = (cur, cpos + len(span) + 1)
             st["span_bytes"] += len(span)
+            # Rows strictly before `cur` are closed; once a full max-group
+            # block of them has accumulated, dispatch it now so the device
+            # chews on it while assembly continues.
+            if cur - flushed[tier] >= flush_rows:
+                _flush_range(tier, flushed[tier], flushed[tier] + flush_rows)
+                flushed[tier] += flush_rows
         st["rows"] = [len(b) for b in rows_buf]
         st["overflow_lanes"] = len(overflow)
-        st["assemble_s"] = _time.perf_counter() - t0
+        # in-assembly flush time is dispatch time, not assembly time
+        st["assemble_s"] = (_time.perf_counter() - t0) - st["dispatch_s"]
 
         if not any(rows_buf) and not overflow:
             return
-
-        t0 = _time.perf_counter()
         if not any(rows_buf):
             # only overflow lanes: padded path handles everything
             self._verify_padded(
@@ -553,68 +653,10 @@ class NfaVerifier:
                 np.asarray(overflow, dtype=np.int64), keep,
             )
             return
-        # stack per-rule byte tensors (shared by both row tiers)
-        rb = next(
-            (b for b in RULE_STACK_BUCKETS if len(rule_slot) <= b),
-            RULE_STACK_BUCKETS[-1],
-        )
-        fol = np.zeros((rb, 64, 64), np.float32)
-        acc = np.zeros((rb, 256, 64), np.float32)
-        fst = np.zeros((rb, 64), np.float32)
-        lst = np.zeros((rb, 64), np.float32)
-        for r, slot in rule_slot.items():
-            f_, a_, s_, l_ = self._rule_byte_tensors(r)
-            fol[slot], acc[slot], fst[slot], lst[slot] = f_, a_, s_, l_
-        jdt = self._compute_dtype()
-        _, _, rep = self._shardings()
-        tens = tuple(
-            jax.device_put(jnp.asarray(t, jdt), rep)
-            if rep is not None
-            else jnp.asarray(t, jdt)
-            for t in (fol, acc, fst, lst)
-        )
-
-        run = (
-            self._run_stream_multi
-            if jdt == jnp.bfloat16
-            else self._run_stream_multi_gather
-        )
-        gbuckets = (
-            GROUP_BUCKETS if self.mesh is not None else STREAM_GROUP_BUCKETS
-        )
-        in_flight = []
-        for tier, length in enumerate(tiers):
-            n_rows = len(rows_buf[tier])
-            if not n_rows:
-                continue
-            gi = 0
-            while gi * LANES_PER_GROUP < n_rows:
-                remaining = -(-(n_rows - gi * LANES_PER_GROUP) // LANES_PER_GROUP)
-                gcap = next(
-                    (g for g in gbuckets if remaining <= g),
-                    gbuckets[-1],
-                )
-                row_lo = gi * LANES_PER_GROUP
-                row_hi = min(row_lo + gcap * LANES_PER_GROUP, n_rows)
-                gi += gcap
-                rows_arr = np.zeros(
-                    (gcap * LANES_PER_GROUP, length), dtype=np.uint8
-                )
-                for k, row in enumerate(range(row_lo, row_hi)):
-                    rows_arr[k] = rows_buf[tier][row]
-                # [G*Bg, L] -> [Lo, 32, G, Bg]
-                bytes_t = np.ascontiguousarray(
-                    rows_arr.reshape(
-                        gcap, LANES_PER_GROUP, length // STREAM_BLOCK,
-                        STREAM_BLOCK,
-                    ).transpose(2, 3, 0, 1)
-                )
-                bd = self._put_stream(bytes_t)
-                in_flight.append(
-                    (tier, row_lo, row_hi, run(bd, *tens))
-                )
-                st["dispatches"] += 1
-        st["dispatch_s"] = _time.perf_counter() - t0
+        # remainder rows (below the flush threshold) per tier
+        for tier in range(len(tiers)):
+            if flushed[tier] < len(rows_buf[tier]):
+                _flush_range(tier, flushed[tier], len(rows_buf[tier]))
 
         # Overflow lanes run on the padded path WHILE the stream
         # dispatches above are in flight (they were issued async), so the
@@ -625,6 +667,9 @@ class NfaVerifier:
                 np.asarray(overflow, dtype=np.int64), keep,
             )
 
+        while in_flight:
+            _fetch_one()
+
         t0 = _time.perf_counter()
         la_lane = np.asarray(lv_lane, dtype=np.int64)
         la_tier = np.asarray(lv_tier, dtype=np.int8)
@@ -632,8 +677,8 @@ class NfaVerifier:
         la_slot = np.asarray(lv_slot, dtype=np.int32)
         la_b0 = np.asarray(lv_b0, dtype=np.int64)
         la_b1 = np.asarray(lv_b1, dtype=np.int64)
-        for tier, row_lo, row_hi, out in in_flight:
-            packed = np.asarray(out)  # [ceil(R/8), Lo, gcap, Bg] uint8
+        for tier, row_lo, row_hi, packed in fetched:
+            # packed: [ceil(R/8), Lo, gcap, Bg] uint8
             rp_, lo_, g_, bg_ = packed.shape
             m = (
                 (la_tier == tier)
@@ -659,7 +704,7 @@ class NfaVerifier:
                 rr = rows_rel[sm]
                 hit = cs[rr, mb1[sm]] > cs[rr, mb0[sm]]
                 keep[mlane[sm][hit]] = True
-        st["fetch_map_s"] = _time.perf_counter() - t0
+        st["fetch_map_s"] += _time.perf_counter() - t0
 
     def _put_stream(self, bytes_t: np.ndarray):
         """Device placement for the 4D stream operand ([Lo, 32, G, Bg]:

@@ -1,0 +1,193 @@
+"""Bounded-depth chunk pipeline: overlap h2d staging, device exec, d2h fetch.
+
+The device path is link-starved, not compute-starved (BENCH r05:
+`link_bound_fraction` 0.933 on the 10k device-engine config — 1.03s of h2d
+against 0.07s of exec+fetch, while the Pallas kernel itself sustains ~30 GB/s
+on-device).  The link floor only binds wall-clock if nothing else runs while
+bytes move, so the fix is structural, not a faster kernel: split a scan batch
+into fixed-bucket chunks and keep three stages in flight at once —
+
+  stage   h2d staging of chunk N+1 (async `jax.device_put`, never
+          `block_until_ready` before exec needs the buffer)
+  exec    device exec of chunk N (donated input on TPU so XLA reuses the
+          staging allocation instead of copying)
+  finish  d2h fetch + host confirm of chunk N-1
+
+`ChunkPipeline` is the small scheduler both device engines drive
+(`engine/device.py::TpuSecretEngine._sieve_rows`, the stream verifier in
+`engine/nfa_device.py`) and that `HybridSecretEngine.scan_batch` uses in
+place of its hand-rolled two-deep sieve deque.  Depth is bounded (default
+2 chunks in flight beyond the one being finished) so host and device
+memory stay O(depth * chunk), and a chunk that raises drains the pipeline
+cleanly: queued work is cancelled, the in-flight tail is dropped, and the
+exception propagates.
+
+`ResidentChunkCache` is the companion device-side dedupe: a bounded LRU of
+sieve results keyed by packed-chunk content digest (interface mirrors
+`trivy_tpu/cache/store.py::ArtifactCache.missing_blobs`), so a rescan of a
+mostly-unchanged corpus ships only changed rows across the link.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass
+from typing import Callable, Iterable
+
+DEFAULT_DEPTH = 2
+DEFAULT_RESIDENT_CHUNKS = 32
+
+
+def default_depth() -> int:
+    """Pipeline depth: chunks staged/executing beyond the one finishing.
+    1 = fully serial (stage, exec, finish each chunk before the next).
+    TRIVY_TPU_PIPELINE_DEPTH overrides (bench serial-vs-pipelined A/B)."""
+    try:
+        return max(1, int(os.environ.get("TRIVY_TPU_PIPELINE_DEPTH", "")))
+    except ValueError:
+        return DEFAULT_DEPTH
+
+
+@dataclass
+class PipelineStats:
+    """Per-run accounting, merged into SieveStats by the engines."""
+
+    depth: int = 0
+    chunks: int = 0
+    stage_s: float = 0.0  # host-side issue cost of staging (async h2d)
+    finish_s: float = 0.0  # d2h fetch + host confirm
+    # Finish time during which >= 1 LATER chunk was staged or executing —
+    # the transfer/compute wall-clock the pipeline actually hid.  Serial
+    # depth=1 runs report 0 here by construction.
+    h2d_overlap_s: float = 0.0
+
+
+class ChunkPipeline:
+    """Three-stage bounded scheduler over an ordered chunk sequence.
+
+    stage(chunk)            -> staged   issue async work (device_put / worker
+                                        submit); must not block on the device
+    execute(chunk, staged)  -> handle   issue the async device exec (or pass
+                                        `staged` through for host pipelines)
+    finish(chunk, handle)   -> None     block on the handle, fetch, confirm
+
+    Chunks finish strictly in submission order (engines write results into
+    order-indexed slots, and the hybrid's oracle confirm must see files in
+    corpus order for byte-identical findings).  `cancel(chunk, handle)` is
+    called for never-finished in-flight chunks when a stage raises.
+    """
+
+    def __init__(
+        self,
+        stage: Callable,
+        execute: Callable,
+        finish: Callable,
+        depth: int | None = None,
+        cancel: Callable | None = None,
+    ):
+        self._stage = stage
+        self._execute = execute
+        self._finish = finish
+        self._cancel = cancel
+        self.stats = PipelineStats(depth=depth or default_depth())
+
+    def run(self, chunks: Iterable) -> None:
+        depth = self.stats.depth
+        inflight: deque = deque()
+        try:
+            for chunk in chunks:
+                while len(inflight) >= depth:
+                    self._finish_one(inflight)
+                t0 = time.perf_counter()
+                staged = self._stage(chunk)
+                self.stats.stage_s += time.perf_counter() - t0
+                inflight.append((chunk, self._execute(chunk, staged)))
+                self.stats.chunks += 1
+            while inflight:
+                self._finish_one(inflight)
+        except BaseException:
+            # Drain cleanly: drop (and cancel) whatever is still in flight
+            # so the caller's partial results stay consistent and worker
+            # pools shut down without finishing abandoned chunks.
+            if self._cancel is not None:
+                for chunk, handle in inflight:
+                    try:
+                        self._cancel(chunk, handle)
+                    except Exception:
+                        pass
+            inflight.clear()
+            raise
+
+    def _finish_one(self, inflight: deque) -> None:
+        chunk, handle = inflight.popleft()
+        overlapped = len(inflight) > 0  # later chunks staged/executing now
+        t0 = time.perf_counter()
+        self._finish(chunk, handle)
+        dt = time.perf_counter() - t0
+        self.stats.finish_s += dt
+        if overlapped:
+            self.stats.h2d_overlap_s += dt
+
+
+def chunk_digest(buf) -> str:
+    """Content digest of a packed chunk (any buffer-protocol object);
+    keys the ResidentChunkCache the way blob digests key ArtifactCache."""
+    return hashlib.blake2b(memoryview(buf), digest_size=16).hexdigest()
+
+
+class ResidentChunkCache:
+    """Bounded LRU of per-chunk sieve results keyed by chunk digest.
+
+    The device-resident analogue of the blob-level ArtifactCache: a rescan
+    whose packed chunks digest identically never re-ships those rows (the
+    cached hit words ARE the chunk's device output, so neither the h2d
+    transfer nor the dispatch happens again).  Interface mirrors
+    `ArtifactCache.missing_blobs` so callers can diff before staging.
+    """
+
+    def __init__(self, capacity: int | None = None):
+        if capacity is None:
+            try:
+                capacity = int(
+                    os.environ.get("TRIVY_TPU_RESIDENT_CHUNKS", "")
+                )
+            except ValueError:
+                capacity = DEFAULT_RESIDENT_CHUNKS
+        self.capacity = max(0, capacity)
+        self._lru: OrderedDict[str, object] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    def get(self, digest: str):
+        """Cached chunk result or None; a hit refreshes LRU order."""
+        if self.capacity == 0:
+            return None
+        val = self._lru.get(digest)
+        if val is None:
+            self.misses += 1
+            return None
+        self._lru.move_to_end(digest)
+        self.hits += 1
+        return val
+
+    def put(self, digest: str, value) -> None:
+        if self.capacity == 0:
+            return
+        self._lru[digest] = value
+        self._lru.move_to_end(digest)
+        while len(self._lru) > self.capacity:
+            self._lru.popitem(last=False)
+
+    def missing_chunks(self, digests: Iterable[str]) -> list[str]:
+        """ArtifactCache.missing_blobs shape: digests NOT resident (these
+        are the rows a rescan must actually ship)."""
+        return [d for d in digests if d not in self._lru]
+
+    def clear(self) -> None:
+        self._lru.clear()
