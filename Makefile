@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test smoke bench
+.PHONY: test smoke bench checks-corpus
 
 # Tier-1: the suite the driver holds the repo to (fast, CPU, no slow marks).
 test:
@@ -21,3 +21,12 @@ smoke:
 # Full benchmark (honest corpora; on CPU this takes a while).
 bench:
 	$(PY) bench.py
+
+# The check corpora: every builtin IaC check and every snapshot cloud
+# check must keep a fail + pass fixture pair (the cloud corpus includes
+# a drift test that fails when a snapshot check gains no fixture).
+checks-corpus:
+	JAX_PLATFORMS=cpu $(PY) -m pytest \
+		tests/test_iac_checks_corpus.py tests/test_cloud_checks_corpus.py \
+		tests/test_trivy_checks_snapshot.py \
+		-q -p no:cacheprovider

@@ -1,0 +1,26 @@
+# METADATA
+# title: Cloudtrail should be enabled in all regions regardless of where your AWS resources are generally homed
+# description: When creating Cloudtrail in the AWS Management Console the trail is configured by default to be multi-region, this is not the case with the Terraform resource. Cloudtrail should cover the full AWS account to ensure you can track changes in regions you are not actively operating in.
+# related_resources:
+#   - https://docs.aws.amazon.com/awscloudtrail/latest/userguide/receive-cloudtrail-log-files-from-multiple-regions.html
+# custom:
+#   id: AVD-AWS-0014
+#   avd_id: AVD-AWS-0014
+#   provider: aws
+#   service: cloudtrail
+#   severity: MEDIUM
+#   short_code: enable-all-regions
+#   recommended_action: Enable Cloudtrail in all regions
+#   input:
+#     selector:
+#       - type: cloud
+#         subtypes:
+#           - service: cloudtrail
+#             provider: aws
+package builtin.aws.cloudtrail.aws0014
+
+deny[res] {
+	trail := input.aws.cloudtrail.trails[_]
+	not trail.ismultiregion.value
+	res := result.new("Trail is not enabled across all regions.", trail.ismultiregion)
+}

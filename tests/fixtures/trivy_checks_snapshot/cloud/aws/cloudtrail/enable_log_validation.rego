@@ -1,0 +1,26 @@
+# METADATA
+# title: Cloudtrail log validation should be enabled to prevent tampering of log data
+# description: Log validation should be activated on Cloudtrail logs to prevent the tampering of the underlying data in the S3 bucket. It is feasible that a rogue actor compromising an AWS account might want to modify the log data to remove trace of their actions.
+# related_resources:
+#   - https://docs.aws.amazon.com/awscloudtrail/latest/userguide/cloudtrail-log-file-validation-intro.html
+# custom:
+#   id: AVD-AWS-0016
+#   avd_id: AVD-AWS-0016
+#   provider: aws
+#   service: cloudtrail
+#   severity: HIGH
+#   short_code: enable-log-validation
+#   recommended_action: Turn on log validation for Cloudtrail
+#   input:
+#     selector:
+#       - type: cloud
+#         subtypes:
+#           - service: cloudtrail
+#             provider: aws
+package builtin.aws.cloudtrail.aws0016
+
+deny[res] {
+	trail := input.aws.cloudtrail.trails[_]
+	not trail.enablelogfilevalidation.value
+	res := result.new("Trail does not have log validation enabled.", trail.enablelogfilevalidation)
+}

@@ -1,0 +1,26 @@
+# METADATA
+# title: CloudTrail should use Customer managed keys to encrypt the logs
+# description: Using Customer managed keys provides comprehensive control over cryptographic keys, enabling management of policies, permissions, and rotation, thus enhancing security and compliance measures for sensitive AWS environments.
+# related_resources:
+#   - https://docs.aws.amazon.com/awscloudtrail/latest/userguide/encrypting-cloudtrail-log-files-with-aws-kms.html
+# custom:
+#   id: AVD-AWS-0015
+#   avd_id: AVD-AWS-0015
+#   provider: aws
+#   service: cloudtrail
+#   severity: HIGH
+#   short_code: encryption-customer-managed-key
+#   recommended_action: Use Customer managed key
+#   input:
+#     selector:
+#       - type: cloud
+#         subtypes:
+#           - service: cloudtrail
+#             provider: aws
+package builtin.aws.cloudtrail.aws0015
+
+deny[res] {
+	trail := input.aws.cloudtrail.trails[_]
+	trail.kmskeyid.value == ""
+	res := result.new("CloudTrail does not use a customer managed key to encrypt the logs.", trail.kmskeyid)
+}

@@ -1,0 +1,26 @@
+# METADATA
+# title: Missing description for security group.
+# description: Security groups should include a description for auditing purposes. Simplifies auditing, debugging, and managing security groups.
+# related_resources:
+#   - https://www.cloudconformity.com/knowledge-base/aws/EC2/security-group-rules-description.html
+# custom:
+#   id: AVD-AWS-0099
+#   avd_id: AVD-AWS-0099
+#   provider: aws
+#   service: ec2
+#   severity: LOW
+#   short_code: add-description-to-security-group
+#   recommended_action: Add descriptions for all security groups
+#   input:
+#     selector:
+#       - type: cloud
+#         subtypes:
+#           - service: ec2
+#             provider: aws
+package builtin.aws.ec2.aws0099
+
+deny[res] {
+	group := input.aws.ec2.securitygroups[_]
+	group.description.value == ""
+	res := result.new("Security group does not have a description.", group)
+}

@@ -1,0 +1,33 @@
+# METADATA
+# title: Instance with unencrypted block device.
+# description: Block devices should be encrypted to ensure sensitive data is held securely at rest.
+# related_resources:
+#   - https://docs.aws.amazon.com/AWSEC2/latest/UserGuide/RootDeviceStorage.html
+# custom:
+#   id: AVD-AWS-0131
+#   avd_id: AVD-AWS-0131
+#   provider: aws
+#   service: ec2
+#   severity: HIGH
+#   short_code: enable-at-rest-encryption
+#   recommended_action: Turn on encryption for all block devices
+#   input:
+#     selector:
+#       - type: cloud
+#         subtypes:
+#           - service: ec2
+#             provider: aws
+package builtin.aws.ec2.aws0131
+
+deny[res] {
+	instance := input.aws.ec2.instances[_]
+	not instance.rootblockdevice.encrypted.value
+	res := result.new("Root block device is not encrypted.", instance.rootblockdevice)
+}
+
+deny[res] {
+	instance := input.aws.ec2.instances[_]
+	device := instance.ebsblockdevices[_]
+	not device.encrypted.value
+	res := result.new("EBS block device is not encrypted.", device)
+}

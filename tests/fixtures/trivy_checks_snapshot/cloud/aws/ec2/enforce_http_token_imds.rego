@@ -1,0 +1,27 @@
+# METADATA
+# title: aws_instance should activate session tokens for Instance Metadata Service.
+# description: IMDS v2 (Instance Metadata Service) introduced session authentication tokens which improve security when talking to IMDS.
+# related_resources:
+#   - https://docs.aws.amazon.com/AWSEC2/latest/UserGuide/configuring-instance-metadata-service.html
+# custom:
+#   id: AVD-AWS-0028
+#   avd_id: AVD-AWS-0028
+#   provider: aws
+#   service: ec2
+#   severity: HIGH
+#   short_code: enforce-http-token-imds
+#   recommended_action: Enable HTTP token requirement for IMDS
+#   input:
+#     selector:
+#       - type: cloud
+#         subtypes:
+#           - service: ec2
+#             provider: aws
+package builtin.aws.ec2.aws0028
+
+deny[res] {
+	instance := input.aws.ec2.instances[_]
+	instance.metadataoptions.httpendpoint.value == "enabled"
+	instance.metadataoptions.httptokens.value != "required"
+	res := result.new("Instance does not require IMDS access to require a token", instance.metadataoptions.httptokens)
+}

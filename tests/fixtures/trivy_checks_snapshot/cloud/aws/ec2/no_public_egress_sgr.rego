@@ -1,0 +1,30 @@
+# METADATA
+# title: An egress security group rule allows traffic to /0.
+# description: Opening up ports to connect out to the public internet is generally to be avoided. You should restrict access to IP addresses or ranges that are explicitly required where possible.
+# related_resources:
+#   - https://docs.aws.amazon.com/vpc/latest/userguide/VPC_SecurityGroups.html
+# custom:
+#   id: AVD-AWS-0104
+#   avd_id: AVD-AWS-0104
+#   provider: aws
+#   service: ec2
+#   severity: CRITICAL
+#   short_code: no-public-egress-sgr
+#   recommended_action: Set a more restrictive cidr range
+#   input:
+#     selector:
+#       - type: cloud
+#         subtypes:
+#           - service: ec2
+#             provider: aws
+package builtin.aws.ec2.aws0104
+
+import data.lib.cidr
+
+deny[res] {
+	group := input.aws.ec2.securitygroups[_]
+	rule := group.egressrules[_]
+	block := rule.cidrs[_]
+	cidr.is_public(block.value)
+	res := result.new(sprintf("Security group rule allows egress to multiple public internet addresses: %q.", [block.value]), block)
+}

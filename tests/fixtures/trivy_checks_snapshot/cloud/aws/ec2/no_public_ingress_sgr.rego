@@ -1,0 +1,30 @@
+# METADATA
+# title: An ingress security group rule allows traffic from /0.
+# description: Opening up ports to the public internet is generally to be avoided. You should restrict access to IP addresses or ranges that explicitly require it where possible.
+# related_resources:
+#   - https://docs.aws.amazon.com/vpc/latest/userguide/VPC_SecurityGroups.html
+# custom:
+#   id: AVD-AWS-0107
+#   avd_id: AVD-AWS-0107
+#   provider: aws
+#   service: ec2
+#   severity: CRITICAL
+#   short_code: no-public-ingress-sgr
+#   recommended_action: Set a more restrictive cidr range
+#   input:
+#     selector:
+#       - type: cloud
+#         subtypes:
+#           - service: ec2
+#             provider: aws
+package builtin.aws.ec2.aws0107
+
+import data.lib.cidr
+
+deny[res] {
+	group := input.aws.ec2.securitygroups[_]
+	rule := group.ingressrules[_]
+	block := rule.cidrs[_]
+	cidr.is_public(block.value)
+	res := result.new(sprintf("Security group rule allows ingress from public internet: %q.", [block.value]), block)
+}

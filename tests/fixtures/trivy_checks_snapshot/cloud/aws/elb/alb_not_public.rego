@@ -1,0 +1,25 @@
+# METADATA
+# title: Load balancer is exposed to the internet.
+# description: There are many scenarios in which you would want to expose a load balancer to the wider internet, but this check exists as a warning to prevent accidental exposure of internal assets. You should ensure that this resource should be exposed publicly.
+# custom:
+#   id: AVD-AWS-0053
+#   avd_id: AVD-AWS-0053
+#   provider: aws
+#   service: elb
+#   severity: HIGH
+#   short_code: alb-not-public
+#   recommended_action: Switch to an internal load balancer or add a tfsec ignore
+#   input:
+#     selector:
+#       - type: cloud
+#         subtypes:
+#           - service: elb
+#             provider: aws
+package builtin.aws.elb.aws0053
+
+deny[res] {
+	lb := input.aws.elb.loadbalancers[_]
+	lb.type.value != "gateway"
+	not lb.internal.value
+	res := result.new("Load balancer is exposed publicly.", lb.internal)
+}

@@ -1,0 +1,33 @@
+# METADATA
+# title: Use of plain HTTP.
+# description: Plain HTTP is unencrypted and human-readable. This means that if a malicious actor was to eavesdrop on your connection, they would be able to see all of your data flowing back and forth. You should use HTTPS, which is HTTP over an encrypted (TLS) connection, meaning eavesdroppers cannot read your traffic.
+# related_resources:
+#   - https://www.cloudflare.com/en-gb/learning/ssl/why-is-http-not-secure/
+# custom:
+#   id: AVD-AWS-0054
+#   avd_id: AVD-AWS-0054
+#   provider: aws
+#   service: elb
+#   severity: CRITICAL
+#   short_code: http-not-used
+#   recommended_action: Switch to HTTPS to benefit from TLS security features
+#   input:
+#     selector:
+#       - type: cloud
+#         subtypes:
+#           - service: elb
+#             provider: aws
+package builtin.aws.elb.aws0054
+
+redirects(listener) {
+	listener.defaultactions[_].type.value == "redirect"
+}
+
+deny[res] {
+	lb := input.aws.elb.loadbalancers[_]
+	lb.type.value == "application"
+	listener := lb.listeners[_]
+	listener.protocol.value == "HTTP"
+	not redirects(listener)
+	res := result.new("Listener for application load balancer does not use HTTPS.", listener.protocol)
+}

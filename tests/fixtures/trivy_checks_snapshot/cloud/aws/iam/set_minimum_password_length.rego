@@ -1,0 +1,26 @@
+# METADATA
+# title: IAM Password policy should have minimum password length of 14 or more
+# description: IAM account password policies should ensure that passwords have a minimum length. The account password policy should be set to enforce minimum password length of at least 14 characters.
+# related_resources:
+#   - https://docs.aws.amazon.com/IAM/latest/UserGuide/id_credentials_passwords_account-policy.html
+# custom:
+#   id: AVD-AWS-0063
+#   avd_id: AVD-AWS-0063
+#   provider: aws
+#   service: iam
+#   severity: MEDIUM
+#   short_code: set-minimum-password-length
+#   recommended_action: Enforce longer, more complex passwords in the policy
+#   input:
+#     selector:
+#       - type: cloud
+#         subtypes:
+#           - service: iam
+#             provider: aws
+package builtin.aws.iam.aws0063
+
+deny[res] {
+	policy := input.aws.iam.passwordpolicy
+	policy.minimumlength.value < 14
+	res := result.new(sprintf("Password policy allows a minimum password length of %d characters.", [policy.minimumlength.value]), policy.minimumlength)
+}

@@ -1,0 +1,27 @@
+# METADATA
+# title: A KMS key is not configured to auto-rotate.
+# description: You should configure your KMS keys to auto rotate to maintain security and defend against compromise.
+# related_resources:
+#   - https://docs.aws.amazon.com/kms/latest/developerguide/rotate-keys.html
+# custom:
+#   id: AVD-AWS-0065
+#   avd_id: AVD-AWS-0065
+#   provider: aws
+#   service: kms
+#   severity: MEDIUM
+#   short_code: auto-rotate-keys
+#   recommended_action: Configure KMS key to auto rotate
+#   input:
+#     selector:
+#       - type: cloud
+#         subtypes:
+#           - service: kms
+#             provider: aws
+package builtin.aws.kms.aws0065
+
+deny[res] {
+	key := input.aws.kms.keys[_]
+	key.usage.value != "SIGN_VERIFY"
+	not key.rotationenabled.value
+	res := result.new("Key does not have rotation enabled.", key.rotationenabled)
+}

@@ -1,0 +1,26 @@
+# METADATA
+# title: There is no encryption specified or encryption is disabled on the RDS Cluster.
+# description: Encryption should be enabled for an RDS Aurora cluster. When enabling encryption by setting the kms_key_id, the storage_encrypted must also be set to true.
+# related_resources:
+#   - https://docs.aws.amazon.com/AmazonRDS/latest/UserGuide/Overview.Encryption.html
+# custom:
+#   id: AVD-AWS-0079
+#   avd_id: AVD-AWS-0079
+#   provider: aws
+#   service: rds
+#   severity: HIGH
+#   short_code: encrypt-cluster-storage-data
+#   recommended_action: Enable encryption for RDS clusters
+#   input:
+#     selector:
+#       - type: cloud
+#         subtypes:
+#           - service: rds
+#             provider: aws
+package builtin.aws.rds.aws0079
+
+deny[res] {
+	cluster := input.aws.rds.clusters[_]
+	not cluster.encryption.encryptstorage.value
+	res := result.new("Cluster does not have storage encryption enabled.", cluster.encryption.encryptstorage)
+}

@@ -1,0 +1,27 @@
+# METADATA
+# title: RDS encryption has not been enabled at a DB Instance level.
+# description: Encryption should be enabled for an RDS Database instances. When enabling encryption by setting the kms_key_id.
+# related_resources:
+#   - https://docs.aws.amazon.com/AmazonRDS/latest/UserGuide/Overview.Encryption.html
+# custom:
+#   id: AVD-AWS-0080
+#   avd_id: AVD-AWS-0080
+#   provider: aws
+#   service: rds
+#   severity: HIGH
+#   short_code: encrypt-instance-storage-data
+#   recommended_action: Enable encryption for RDS instances
+#   input:
+#     selector:
+#       - type: cloud
+#         subtypes:
+#           - service: rds
+#             provider: aws
+package builtin.aws.rds.aws0080
+
+deny[res] {
+	instance := input.aws.rds.instances[_]
+	instance.replicationsourcearn.value == ""
+	not instance.encryption.encryptstorage.value
+	res := result.new("Instance does not have storage encryption enabled.", instance.encryption.encryptstorage)
+}

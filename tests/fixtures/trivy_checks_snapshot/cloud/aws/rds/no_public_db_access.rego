@@ -1,0 +1,26 @@
+# METADATA
+# title: A database resource is marked as publicly accessible.
+# description: Database resources should not publicly available. You should limit all access to the minimum that is required for your application to function.
+# related_resources:
+#   - https://docs.aws.amazon.com/AmazonRDS/latest/UserGuide/USER_VPC.html
+# custom:
+#   id: AVD-AWS-0180
+#   avd_id: AVD-AWS-0180
+#   provider: aws
+#   service: rds
+#   severity: CRITICAL
+#   short_code: no-public-db-access
+#   recommended_action: Set the database to not be publicly accessible
+#   input:
+#     selector:
+#       - type: cloud
+#         subtypes:
+#           - service: rds
+#             provider: aws
+package builtin.aws.rds.aws0180
+
+deny[res] {
+	instance := input.aws.rds.instances[_]
+	instance.publicaccess.value
+	res := result.new("Instance is exposed publicly.", instance.publicaccess)
+}

@@ -1,0 +1,33 @@
+# METADATA
+# title: RDS Cluster and RDS instance should have backup retention longer than default 1 day
+# description: RDS backup retention for clusters defaults to 1 day, this may not be enough to identify and respond to an issue. Backup retention periods should be set to a period that is a balance on cost and limiting risk.
+# related_resources:
+#   - https://docs.aws.amazon.com/AmazonRDS/latest/AuroraUserGuide/Aurora.Managing.Backups.html
+# custom:
+#   id: AVD-AWS-0077
+#   avd_id: AVD-AWS-0077
+#   provider: aws
+#   service: rds
+#   severity: MEDIUM
+#   short_code: specify-backup-retention
+#   recommended_action: Explicitly set the retention period to greater than the default
+#   input:
+#     selector:
+#       - type: cloud
+#         subtypes:
+#           - service: rds
+#             provider: aws
+package builtin.aws.rds.aws0077
+
+deny[res] {
+	instance := input.aws.rds.instances[_]
+	instance.replicationsourcearn.value == ""
+	instance.backupretentionperioddays.value < 2
+	res := result.new("Instance has very low backup retention period.", instance.backupretentionperioddays)
+}
+
+deny[res] {
+	cluster := input.aws.rds.clusters[_]
+	cluster.backupretentionperioddays.value < 2
+	res := result.new("Cluster has very low backup retention period.", cluster.backupretentionperioddays)
+}

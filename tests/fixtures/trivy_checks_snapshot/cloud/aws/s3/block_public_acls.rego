@@ -1,0 +1,32 @@
+# METADATA
+# title: S3 Access block should block public ACL
+# description: S3 buckets should block public ACLs on buckets and any objects they contain. By blocking, PUTs with fail if the object has any public ACL.
+# related_resources:
+#   - https://docs.aws.amazon.com/AmazonS3/latest/userguide/access-control-block-public-access.html
+# custom:
+#   id: AVD-AWS-0086
+#   avd_id: AVD-AWS-0086
+#   provider: aws
+#   service: s3
+#   severity: HIGH
+#   short_code: block-public-acls
+#   recommended_action: Enable blocking any PUT calls with a public ACL specified
+#   input:
+#     selector:
+#       - type: cloud
+#         subtypes:
+#           - service: s3
+#             provider: aws
+package builtin.aws.s3.aws0086
+
+deny[res] {
+	bucket := input.aws.s3.buckets[_]
+	not bucket.publicaccessblock
+	res := result.new(sprintf("No public access block so not blocking public acls for bucket %q", [bucket.name.value]), bucket)
+}
+
+deny[res] {
+	bucket := input.aws.s3.buckets[_]
+	not bucket.publicaccessblock.blockpublicacls.value
+	res := result.new(sprintf("Public access block for bucket %q does not block public ACLs", [bucket.name.value]), bucket.publicaccessblock.blockpublicacls)
+}

@@ -1,0 +1,32 @@
+# METADATA
+# title: S3 Access block should block public policy
+# description: S3 bucket policy should have block public policy to prevent users from putting a policy that enable public access.
+# related_resources:
+#   - https://docs.aws.amazon.com/AmazonS3/latest/userguide/access-control-block-public-access.html
+# custom:
+#   id: AVD-AWS-0087
+#   avd_id: AVD-AWS-0087
+#   provider: aws
+#   service: s3
+#   severity: HIGH
+#   short_code: block-public-policy
+#   recommended_action: Prevent policies that allow public access being PUT
+#   input:
+#     selector:
+#       - type: cloud
+#         subtypes:
+#           - service: s3
+#             provider: aws
+package builtin.aws.s3.aws0087
+
+deny[res] {
+	bucket := input.aws.s3.buckets[_]
+	not bucket.publicaccessblock
+	res := result.new(sprintf("No public access block so not blocking public policies for bucket %q", [bucket.name.value]), bucket)
+}
+
+deny[res] {
+	bucket := input.aws.s3.buckets[_]
+	not bucket.publicaccessblock.blockpublicpolicy.value
+	res := result.new(sprintf("Public access block for bucket %q does not block public policies", [bucket.name.value]), bucket.publicaccessblock.blockpublicpolicy)
+}

@@ -1,0 +1,26 @@
+# METADATA
+# title: Unencrypted S3 bucket.
+# description: S3 Buckets should be encrypted to protect the data that is stored within them if access is compromised.
+# related_resources:
+#   - https://docs.aws.amazon.com/AmazonS3/latest/userguide/bucket-encryption.html
+# custom:
+#   id: AVD-AWS-0088
+#   avd_id: AVD-AWS-0088
+#   provider: aws
+#   service: s3
+#   severity: HIGH
+#   short_code: enable-bucket-encryption
+#   recommended_action: Configure bucket encryption
+#   input:
+#     selector:
+#       - type: cloud
+#         subtypes:
+#           - service: s3
+#             provider: aws
+package builtin.aws.s3.aws0088
+
+deny[res] {
+	bucket := input.aws.s3.buckets[_]
+	not bucket.encryption.enabled.value
+	res := result.new(sprintf("Bucket %q does not have encryption enabled", [bucket.name.value]), bucket.encryption.enabled)
+}

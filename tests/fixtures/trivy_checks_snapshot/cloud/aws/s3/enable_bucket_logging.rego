@@ -1,0 +1,26 @@
+# METADATA
+# title: S3 Bucket does not have logging enabled.
+# description: Buckets should have logging enabled so that access can be audited.
+# related_resources:
+#   - https://docs.aws.amazon.com/AmazonS3/latest/userguide/ServerLogs.html
+# custom:
+#   id: AVD-AWS-0089
+#   avd_id: AVD-AWS-0089
+#   provider: aws
+#   service: s3
+#   severity: MEDIUM
+#   short_code: enable-bucket-logging
+#   recommended_action: Add a logging block to the resource to enable access logging
+#   input:
+#     selector:
+#       - type: cloud
+#         subtypes:
+#           - service: s3
+#             provider: aws
+package builtin.aws.s3.aws0089
+
+deny[res] {
+	bucket := input.aws.s3.buckets[_]
+	not bucket.logging.enabled.value
+	res := result.new(sprintf("Bucket %q does not have logging enabled", [bucket.name.value]), bucket.logging.enabled)
+}

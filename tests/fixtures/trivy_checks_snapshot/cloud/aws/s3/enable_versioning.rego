@@ -1,0 +1,26 @@
+# METADATA
+# title: S3 Data should be versioned
+# description: Versioning in Amazon S3 is a means of keeping multiple variants of an object in the same bucket. Versioning protects you from the consequences of unintended overwrites and deletions.
+# related_resources:
+#   - https://docs.aws.amazon.com/AmazonS3/latest/userguide/Versioning.html
+# custom:
+#   id: AVD-AWS-0090
+#   avd_id: AVD-AWS-0090
+#   provider: aws
+#   service: s3
+#   severity: MEDIUM
+#   short_code: enable-versioning
+#   recommended_action: Enable versioning to protect against accidental/malicious removal or modification
+#   input:
+#     selector:
+#       - type: cloud
+#         subtypes:
+#           - service: s3
+#             provider: aws
+package builtin.aws.s3.aws0090
+
+deny[res] {
+	bucket := input.aws.s3.buckets[_]
+	not bucket.versioning.enabled.value
+	res := result.new(sprintf("Bucket %q does not have versioning enabled", [bucket.name.value]), bucket.versioning.enabled)
+}

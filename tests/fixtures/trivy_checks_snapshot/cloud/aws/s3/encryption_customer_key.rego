@@ -1,0 +1,26 @@
+# METADATA
+# title: S3 encryption should use Customer Managed Keys
+# description: Encryption using AWS keys provides protection for your S3 buckets. To increase control of the encryption and manage factors like rotation use customer managed keys.
+# related_resources:
+#   - https://docs.aws.amazon.com/AmazonS3/latest/userguide/UsingKMSEncryption.html
+# custom:
+#   id: AVD-AWS-0132
+#   avd_id: AVD-AWS-0132
+#   provider: aws
+#   service: s3
+#   severity: HIGH
+#   short_code: encryption-customer-key
+#   recommended_action: Enable encryption using customer managed keys
+#   input:
+#     selector:
+#       - type: cloud
+#         subtypes:
+#           - service: s3
+#             provider: aws
+package builtin.aws.s3.aws0132
+
+deny[res] {
+	bucket := input.aws.s3.buckets[_]
+	bucket.encryption.kmskeyid.value == ""
+	res := result.new(sprintf("Bucket %q does not encrypt data with a customer managed key.", [bucket.name.value]), bucket.encryption)
+}

@@ -1,0 +1,32 @@
+# METADATA
+# title: S3 Access Block should Ignore Public Acl
+# description: S3 buckets should ignore public ACLs on buckets and any objects they contain. By ignoring rather than blocking, PUT calls with public ACLs will still be applied but the ACL will be ignored.
+# related_resources:
+#   - https://docs.aws.amazon.com/AmazonS3/latest/userguide/access-control-block-public-access.html
+# custom:
+#   id: AVD-AWS-0091
+#   avd_id: AVD-AWS-0091
+#   provider: aws
+#   service: s3
+#   severity: HIGH
+#   short_code: ignore-public-acls
+#   recommended_action: Enable ignoring the application of public ACLs in PUT calls
+#   input:
+#     selector:
+#       - type: cloud
+#         subtypes:
+#           - service: s3
+#             provider: aws
+package builtin.aws.s3.aws0091
+
+deny[res] {
+	bucket := input.aws.s3.buckets[_]
+	not bucket.publicaccessblock
+	res := result.new(sprintf("No public access block so not ignoring public acls for bucket %q", [bucket.name.value]), bucket)
+}
+
+deny[res] {
+	bucket := input.aws.s3.buckets[_]
+	not bucket.publicaccessblock.ignorepublicacls.value
+	res := result.new(sprintf("Public access block for bucket %q does not ignore public ACLs", [bucket.name.value]), bucket.publicaccessblock.ignorepublicacls)
+}

@@ -1,0 +1,42 @@
+# METADATA
+# title: S3 Bucket has an ACL defined which allows public access.
+# description: Buckets should not have ACLs that allow public access
+# related_resources:
+#   - https://docs.aws.amazon.com/AmazonS3/latest/userguide/acl-overview.html
+# custom:
+#   id: AVD-AWS-0092
+#   avd_id: AVD-AWS-0092
+#   provider: aws
+#   service: s3
+#   severity: HIGH
+#   short_code: no-public-access-with-acl
+#   recommended_action: Apply a more restrictive bucket ACL
+#   input:
+#     selector:
+#       - type: cloud
+#         subtypes:
+#           - service: s3
+#             provider: aws
+package builtin.aws.s3.aws0092
+
+is_public_acl(acl) {
+	acl == "public-read"
+}
+
+is_public_acl(acl) {
+	acl == "public-read-write"
+}
+
+is_public_acl(acl) {
+	acl == "website"
+}
+
+is_public_acl(acl) {
+	acl == "authenticated-read"
+}
+
+deny[res] {
+	bucket := input.aws.s3.buckets[_]
+	is_public_acl(bucket.acl.value)
+	res := result.new(sprintf("Bucket %q has a public ACL: %q.", [bucket.name.value, bucket.acl.value]), bucket.acl)
+}

@@ -1,0 +1,32 @@
+# METADATA
+# title: S3 Access block should restrict public bucket to limit access
+# description: S3 buckets should restrict public policies for the bucket. By enabling, the restrict_public_buckets, only the bucket owner and AWS Services can access if it has a public policy.
+# related_resources:
+#   - https://docs.aws.amazon.com/AmazonS3/latest/dev/access-control-block-public-access.html
+# custom:
+#   id: AVD-AWS-0093
+#   avd_id: AVD-AWS-0093
+#   provider: aws
+#   service: s3
+#   severity: HIGH
+#   short_code: no-public-buckets
+#   recommended_action: Limit the access to public buckets to only the owner or AWS Services (eg; CloudFront)
+#   input:
+#     selector:
+#       - type: cloud
+#         subtypes:
+#           - service: s3
+#             provider: aws
+package builtin.aws.s3.aws0093
+
+deny[res] {
+	bucket := input.aws.s3.buckets[_]
+	not bucket.publicaccessblock
+	res := result.new(sprintf("No public access block so not restricting public buckets for bucket %q", [bucket.name.value]), bucket)
+}
+
+deny[res] {
+	bucket := input.aws.s3.buckets[_]
+	not bucket.publicaccessblock.restrictpublicbuckets.value
+	res := result.new(sprintf("Public access block for bucket %q does not restrict public buckets", [bucket.name.value]), bucket.publicaccessblock.restrictpublicbuckets)
+}

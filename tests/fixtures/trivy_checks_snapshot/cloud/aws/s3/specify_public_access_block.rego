@@ -1,0 +1,26 @@
+# METADATA
+# title: S3 buckets should each define an aws_s3_bucket_public_access_block
+# description: The "block public access" settings in S3 override individual policies that apply to a given bucket, meaning that all public access can be controlled in one central definition for that bucket. It is therefore good practice to define these settings for each bucket in order to clearly define the public access that can be allowed for it.
+# related_resources:
+#   - https://registry.terraform.io/providers/hashicorp/aws/latest/docs/resources/s3_bucket_public_access_block
+# custom:
+#   id: AVD-AWS-0094
+#   avd_id: AVD-AWS-0094
+#   provider: aws
+#   service: s3
+#   severity: LOW
+#   short_code: specify-public-access-block
+#   recommended_action: Define a aws_s3_bucket_public_access_block for the given bucket to control public access policies
+#   input:
+#     selector:
+#       - type: cloud
+#         subtypes:
+#           - service: s3
+#             provider: aws
+package builtin.aws.s3.aws0094
+
+deny[res] {
+	bucket := input.aws.s3.buckets[_]
+	not bucket.publicaccessblock
+	res := result.new(sprintf("Bucket %q does not have a corresponding public access block.", [bucket.name.value]), bucket)
+}

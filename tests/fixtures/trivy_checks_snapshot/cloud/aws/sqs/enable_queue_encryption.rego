@@ -1,0 +1,27 @@
+# METADATA
+# title: Unencrypted SQS queue.
+# description: Queues should be encrypted to protect queue contents.
+# related_resources:
+#   - https://docs.aws.amazon.com/AWSSimpleQueueService/latest/SQSDeveloperGuide/sqs-server-side-encryption.html
+# custom:
+#   id: AVD-AWS-0096
+#   avd_id: AVD-AWS-0096
+#   provider: aws
+#   service: sqs
+#   severity: HIGH
+#   short_code: enable-queue-encryption
+#   recommended_action: Turn on SQS Queue encryption
+#   input:
+#     selector:
+#       - type: cloud
+#         subtypes:
+#           - service: sqs
+#             provider: aws
+package builtin.aws.sqs.aws0096
+
+deny[res] {
+	queue := input.aws.sqs.queues[_]
+	queue.encryption.kmskeyid.value == ""
+	not queue.encryption.managedencryption.value
+	res := result.new("Queue is not encrypted", queue.encryption)
+}

@@ -1,0 +1,13 @@
+# Faithful reconstruction of the trivy-checks lib/cloud CIDR helper
+# shapes (zero-egress build: the STRUCTURE -- a shared helper library
+# imported as data.lib.cidr by cloud checks -- matches the upstream
+# bundle so the cloud-path lib-import idiom is exercised for real).
+package lib.cidr
+
+is_public(c) {
+	c == "::/0"
+}
+
+is_public(c) {
+	net.cidr_contains(c, "8.8.8.8/32")
+}

@@ -321,6 +321,39 @@ def test_aws_scan_runs_terraform_checks(aws_endpoint):
     assert all("public-logs" in m for m in acl_msgs)
 
 
+def test_aws_scan_drives_typed_cloud_checks(aws_endpoint):
+    """The live aws scan feeds the SAME typed provider state as terraform
+    file scanning: with the trivy-checks snapshot loaded into the shared
+    scanner, its cloud-selector checks evaluate against the account."""
+    import os
+
+    from trivy_tpu.iac.engine import configure_shared_scanner
+
+    snapshot = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "fixtures", "trivy_checks_snapshot",
+    )
+    configure_shared_scanner([snapshot])
+    try:
+        scanner = AwsScanner(services=["s3"], endpoint=aws_endpoint)
+        [mc] = scanner.scan()
+        failed = {(f.check_id, f.message) for f in mc.failures}
+        ids = {c for c, _ in failed}
+        # typed checks fire on the adapted account state
+        assert "AVD-AWS-0094" in ids  # no public access block on either
+        assert "AVD-AWS-0090" in ids  # versioning off on public-logs
+        assert "AVD-AWS-0092" in ids  # public ACL on public-logs
+        # the locked-down bucket is versioned + encrypted: only
+        # public-logs may be named by the versioning/ACL findings
+        for cid in ("AVD-AWS-0090", "AVD-AWS-0092"):
+            msgs = [m for c, m in failed if c == cid]
+            assert msgs and all("locked-down" not in m for m in msgs), (
+                cid, msgs,
+            )
+    finally:
+        configure_shared_scanner([])
+
+
 def test_unsupported_service_is_loud(aws_endpoint):
     with pytest.raises(AwsError):
         AwsScanner(services=["glacier"], endpoint=aws_endpoint).scan()

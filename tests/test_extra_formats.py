@@ -360,3 +360,75 @@ def test_spdx_tag_value_roundtrip(tmp_path):
     ]
     assert ("requests", "2.31.0") in pkgs, pkgs
     assert blob.os is not None and blob.os.family == "alpine"
+
+
+def test_spdx_tag_value_golden():
+    """The full tag-value rendering, byte for byte: DocumentNamespace is
+    a deterministic name+uuid5 (reproducible SBOMs), and every element is
+    tied into the graph with DESCRIBES/CONTAINS Relationship stanzas —
+    OS packages under the OS element, app packages under the document."""
+    import io
+
+    from trivy_tpu import __version__
+    from trivy_tpu.ftypes import Metadata, Report, Result, ResultClass
+    from trivy_tpu.atypes import Package
+    from trivy_tpu.report.writer import write_report
+
+    report = Report(
+        artifact_name="demo",
+        artifact_type="filesystem",
+        created_at="2024-01-02T03:04:05Z",
+        metadata=Metadata(os_family="alpine", os_name="3.19"),
+        results=[
+            Result(
+                target="alpine",
+                result_class=ResultClass.OS_PKGS,
+                result_type="alpine",
+                packages=[Package(id="musl@1.2.4", name="musl",
+                                  version="1.2.4")],
+            ),
+            Result(
+                target="lib/requirements.txt",
+                result_class=ResultClass.LANG_PKGS,
+                result_type="pip",
+                packages=[Package(id="requests@2.31.0", name="requests",
+                                  version="2.31.0")],
+            ),
+        ],
+    )
+    buf = io.StringIO()
+    write_report(report, fmt="spdx", out=buf)
+    golden = f"""\
+SPDXVersion: SPDX-2.3
+DataLicense: CC0-1.0
+SPDXID: SPDXRef-DOCUMENT
+DocumentName: demo
+DocumentNamespace: https://trivy-tpu.dev/spdxdocs/demo-61a7910b-1495-5557-a99f-df9437edfd40
+Creator: Tool: trivy-tpu-{__version__}
+Created: 2024-01-02T03:04:05Z
+
+PackageName: alpine
+SPDXID: SPDXRef-OperatingSystem
+PackageVersion: 3.19
+PackageDownloadLocation: NONE
+PrimaryPackagePurpose: OPERATING-SYSTEM
+
+PackageName: musl
+SPDXID: SPDXRef-Package-1
+PackageVersion: 1.2.4
+PackageDownloadLocation: NONE
+PackageLicenseConcluded: NOASSERTION
+ExternalRef: PACKAGE-MANAGER purl pkg:alpine/musl@1.2.4
+
+PackageName: requests
+SPDXID: SPDXRef-Package-2
+PackageVersion: 2.31.0
+PackageDownloadLocation: NONE
+PackageLicenseConcluded: NOASSERTION
+ExternalRef: PACKAGE-MANAGER purl pkg:pypi/requests@2.31.0
+
+Relationship: SPDXRef-DOCUMENT DESCRIBES SPDXRef-OperatingSystem
+Relationship: SPDXRef-OperatingSystem CONTAINS SPDXRef-Package-1
+Relationship: SPDXRef-DOCUMENT DESCRIBES SPDXRef-Package-2
+"""
+    assert buf.getvalue() == golden

@@ -1,0 +1,147 @@
+"""Tests: --file-patterns type:regex (analyzer.go filePatternMatch) —
+the claim-pass override that hands arbitrarily-named files to a chosen
+analyzer, wired CLI -> Options -> AnalyzerOptions -> AnalyzerGroup."""
+
+import contextlib
+import io
+import json
+import os
+import re
+
+import pytest
+
+from trivy_tpu.analyzer.core import AnalyzerGroup, AnalyzerOptions
+from trivy_tpu.cli import main
+from trivy_tpu.commands.run import OptionsError, _parse_file_patterns
+from trivy_tpu.walker.fs import FileEntry
+
+REQS = b"requests==2.31.0\nflask==3.0.0\n"
+
+
+def _entry(path: str, content: bytes) -> FileEntry:
+    return FileEntry(
+        path=path, size=len(content), mode=0o644, opener=lambda c=content: c
+    )
+
+
+def _pip_apps(result):
+    return [a for a in result.applications if a.app_type == "pip"]
+
+
+def test_file_pattern_overrides_analyzer_claim():
+    """A path the pip analyzer would never claim (wrong filename) is
+    analyzed anyway when a pip:regex pattern matches it."""
+    group = AnalyzerGroup(
+        AnalyzerOptions(
+            file_patterns={"pip": [re.compile(r"requirements-.*\.lst")]}
+        )
+    )
+    result = group.analyze_entries(
+        "", [_entry("srv/requirements-prod.lst", REQS)]
+    )
+    apps = _pip_apps(result)
+    assert len(apps) == 1
+    assert {p.name for p in apps[0].packages} == {"requests", "flask"}
+
+
+def test_file_pattern_scoped_to_named_analyzer():
+    # the same file without a pattern (or with one for another analyzer)
+    # stays unclaimed
+    for opts in (
+        AnalyzerOptions(),
+        AnalyzerOptions(file_patterns={"npm": [re.compile(r".*\.lst")]}),
+    ):
+        group = AnalyzerGroup(opts)
+        result = group.analyze_entries(
+            "", [_entry("srv/requirements-prod.lst", REQS)]
+        )
+        assert not _pip_apps(result)
+    # and normal filename claims keep working alongside patterns
+    group = AnalyzerGroup(
+        AnalyzerOptions(file_patterns={"pip": [re.compile(r"\.lst$")]})
+    )
+    result = group.analyze_entries("", [_entry("requirements.txt", REQS)])
+    assert _pip_apps(result)
+
+
+def test_parse_file_patterns_rejects_malformed():
+    assert _parse_file_patterns([]) == {}
+    parsed = _parse_file_patterns(["pip:req-.*", "pip:other", "npm:x"])
+    assert sorted(parsed) == ["npm", "pip"] and len(parsed["pip"]) == 2
+    with pytest.raises(OptionsError):
+        _parse_file_patterns(["no-colon-here"])
+    with pytest.raises(OptionsError):
+        _parse_file_patterns([":missing-type"])
+    with pytest.raises(OptionsError):
+        _parse_file_patterns(["pip:(unclosed"])
+
+
+def _scan(tmp_path, argv_extra=(), env=None):
+    from trivy_tpu.db.vulndb import build_db
+
+    root = tmp_path / "src"
+    root.mkdir(exist_ok=True)
+    (root / "requirements-prod.lst").write_bytes(REQS)
+    build_db(str(tmp_path / "db"), {})
+    buf = io.StringIO()
+    old_env = {}
+    for k, v in (env or {}).items():
+        old_env[k] = os.environ.get(k)
+        os.environ[k] = v
+    try:
+        with contextlib.redirect_stdout(buf):
+            rc = main([
+                "fs", "--scanners", "vuln", "--format", "json",
+                "--list-all-pkgs", "--db-dir", str(tmp_path / "db"),
+                *argv_extra, str(root),
+            ])
+    finally:
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return rc, buf.getvalue()
+
+
+def _pip_results(out: str):
+    return [
+        r for r in (json.loads(out).get("Results") or [])
+        if r.get("Type") == "pip"
+    ]
+
+
+def test_file_patterns_cli_round_trip(tmp_path):
+    rc, out = _scan(tmp_path)
+    assert rc == 0 and not _pip_results(out)  # dead without the flag
+    rc, out = _scan(
+        tmp_path, argv_extra=("--file-patterns", r"pip:requirements-.*\.lst")
+    )
+    assert rc == 0
+    [res] = _pip_results(out)
+    assert {p["Name"] for p in res["Packages"]} == {"requests", "flask"}
+
+
+def test_file_patterns_env_round_trip(tmp_path):
+    rc, out = _scan(
+        tmp_path,
+        env={"TRIVY_TPU_FILE_PATTERNS": r"pip:requirements-.*\.lst"},
+    )
+    assert rc == 0 and _pip_results(out)
+
+
+def test_file_patterns_config_round_trip(tmp_path):
+    cfg = tmp_path / "trivy.yaml"
+    cfg.write_text('file-patterns:\n  - "pip:requirements-.*\\\\.lst"\n')
+    rc, out = _scan(tmp_path, argv_extra=("--config", str(cfg)))
+    assert rc == 0 and _pip_results(out)
+
+
+def test_bad_file_pattern_is_clean_cli_error(tmp_path, capsys):
+    (tmp_path / "x.py").write_text("pass\n")
+    rc = main([
+        "fs", "--scanners", "secret",
+        "--file-patterns", "malformed", str(tmp_path),
+    ])
+    assert rc == 2
+    assert "invalid file pattern" in capsys.readouterr().err

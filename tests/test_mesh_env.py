@@ -28,7 +28,10 @@ def test_meshed_pallas_parity_vs_oracle():
     engine = TpuSecretEngine(
         mesh=mesh, tile_len=512, kernel="pallas", max_batch_tiles=4096
     )
-    assert engine._tile_align % (8 * 128) == 0  # whole Pallas blocks per shard
+    # Whole Pallas blocks per shard: alignment is devices x the kernel's
+    # actual bitplane block geometry (block_rows=64 since the bitplane
+    # rewrite), not a hardcoded 128-row guess.
+    assert engine._tile_align == 8 * engine._pallas_obj.block_rows
 
     rng = np.random.RandomState(3)
     corpus = []

@@ -213,3 +213,44 @@ deny[msg] {
 }
 """
     assert _deny(src, {}) == ["aggregates ok"]
+
+
+def test_object_get_path_list_form():
+    """object.get's second form takes a PATH (array of keys / indices)
+    and walks nested objects and arrays — trivy-checks cloud checks lean
+    on it for optional deep lookups."""
+    src = """
+package t
+doc := {"a": {"b": [{"c": 7}]}, "top": 1}
+deny[msg] {
+    object.get(doc, ["a", "b", 0, "c"], 0) == 7
+    object.get(doc, ["a", "missing"], "dflt") == "dflt"
+    object.get(doc, ["a", "b", 5, "c"], "oob") == "oob"
+    object.get(doc, "top", 0) == 1
+    object.get(doc, "absent", 42) == 42
+    msg := "object.get ok"
+}
+"""
+    assert _deny(src, {}) == ["object.get ok"]
+
+
+def test_cloud_check_builtin_kit():
+    """The builtins the typed cloud corpus exercises, in one clause:
+    sprintf verbs, regex.match, net.cidr_contains in both verdict
+    directions, object.union merge precedence."""
+    src = """
+package t
+deny[msg] {
+    sprintf("%s:%d", ["db", 5432]) == "db:5432"
+    sprintf("%v", [["a"]]) != ""
+    regex.match("^AVD-AWS-\\\\d{4}$", "AVD-AWS-0086")
+    not regex.match("^AVD", "avd-aws")
+    net.cidr_contains("0.0.0.0/0", "203.0.113.9/32")
+    not net.cidr_contains("10.0.0.0/8", "192.168.1.1/32")
+    u := object.union({"a": 1, "keep": true}, {"a": 2})
+    u.a == 2
+    u.keep == true
+    msg := "cloud kit ok"
+}
+"""
+    assert _deny(src, {}) == ["cloud kit ok"]

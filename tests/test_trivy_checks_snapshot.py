@@ -96,10 +96,10 @@ def scanner():
 def test_snapshot_load_success_rate():
     """Every snapshot module loads (libraries into the registry, checks
     into the check list) — the load-success rate the VERDICT asked to
-    report is 18/18 checks + 2/2 libs."""
+    report is 46/46 checks (18 docker/k8s + 28 cloud) + 3/3 libs."""
     snap = load_checks(extra_dirs=[SNAPSHOT])
     loaded = [c for c in snap if c.module.source_path.startswith(SNAPSHOT)]
-    rate = len(loaded) / 18
+    rate = len(loaded) / 46
     assert rate == 1.0, (
         f"load-success rate {rate:.0%}: "
         f"{sorted(c.check_id for c in loaded)}"
@@ -107,6 +107,13 @@ def test_snapshot_load_success_rate():
     # helper libraries loaded into the registry but are not checks
     registry = snap[0].registry
     assert "lib.kubernetes" in registry and "lib.docker" in registry
+    assert "lib.cidr" in registry
+    # cloud checks route by their METADATA input selector, not package
+    cloud = [c for c in loaded if c.input_type == "cloud"]
+    assert len(cloud) == 28, sorted(c.check_id for c in cloud)
+    assert all(
+        {"provider": "aws"}.items() <= c.subtypes[0].items() for c in cloud
+    )
 
 
 def test_snapshot_k8s_checks_fail_direction(scanner):

@@ -268,6 +268,13 @@ class AnalyzerGroup:
             self.post_analyzers.append(p)
         self._post_fs: list = [None] * len(self.post_analyzers)
 
+    def _file_pattern_match(self, analyzer_type: str, file_path: str) -> bool:
+        """--file-patterns type:regex claim override (analyzer.go
+        filePatternMatch): a matching path is handed to that analyzer even
+        when its own required() declines the name."""
+        patterns = self.options.file_patterns.get(analyzer_type)
+        return bool(patterns) and any(p.search(file_path) for p in patterns)
+
     def analyzer_versions(self) -> dict[str, int]:
         """AnalyzerVersions (analyzer.go:372-381) — cache-key component."""
         versions = {a.type(): a.version() for a in self.analyzers}
@@ -329,7 +336,7 @@ class AnalyzerGroup:
                 if disabled and a.type() in disabled:
                     continue
                 br = batch_req.get(i)
-                if (
+                if self._file_pattern_match(a.type(), entry.path) or (
                     br[k]
                     if br is not None
                     else a.required(entry.path, entry.size, entry.mode)
@@ -338,7 +345,10 @@ class AnalyzerGroup:
             for j, p in enumerate(self.post_analyzers):
                 if disabled and p.type() in disabled:
                     continue
-                if not p.required(entry.path, entry.size, entry.mode):
+                if not (
+                    self._file_pattern_match(p.type(), entry.path)
+                    or p.required(entry.path, entry.size, entry.mode)
+                ):
                     continue
                 # Copy into the post-analyzer's composite FS
                 # (analyzer.go:506 + composite_fs.go): the file is read now

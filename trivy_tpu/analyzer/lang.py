@@ -203,7 +203,7 @@ class PoetryLockAnalyzer(_LockfileAnalyzer):
     filenames = ("poetry.lock",)
 
     def parse(self, content: bytes) -> list[Package]:
-        import tomllib
+        from trivy_tpu.compat import tomllib
 
         data = tomllib.loads(content.decode("utf-8", errors="replace"))
         return [
@@ -243,7 +243,7 @@ class CargoLockAnalyzer(_LockfileAnalyzer):
     filenames = ("Cargo.lock",)
 
     def parse(self, content: bytes) -> list[Package]:
-        import tomllib
+        from trivy_tpu.compat import tomllib
 
         data = tomllib.loads(content.decode("utf-8", errors="replace"))
         return [

@@ -422,7 +422,7 @@ class JuliaManifestAnalyzer(_FileNameAnalyzer):
     TYPE = "julia"
 
     def parse(self, content: bytes) -> list[Package]:
-        import tomllib
+        from trivy_tpu.compat import tomllib
 
         doc = tomllib.loads(content.decode("utf-8", "replace"))
         deps = doc.get("deps") or {

@@ -147,6 +147,14 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
         default=[s for s in str(_env_default("skip-dirs", "")).split(",") if s],
     )
     p.add_argument(
+        "--file-patterns", action="append",
+        default=[
+            s for s in str(_env_default("file-patterns", "")).split(",") if s
+        ],
+        help="analyzer file-name override, repeatable: type:regex "
+        "(e.g. pip:requirements-.*\\.txt)",
+    )
+    p.add_argument(
         "--secret-config", default=_env_default("secret-config", "trivy-secret.yaml")
     )
     p.add_argument(
@@ -298,6 +306,7 @@ def _options_from_args(args: argparse.Namespace) -> Options:
         cache_backend=args.cache_backend,
         skip_files=args.skip_files,
         skip_dirs=args.skip_dirs,
+        file_patterns=list(getattr(args, "file_patterns", []) or []),
         secret_config=args.secret_config,
         secret_backend=args.secret_backend,
         ignore_file=args.ignorefile if os.path.exists(args.ignorefile) else "",
@@ -707,7 +716,11 @@ def main(argv: list[str] | None = None) -> int:
     except Exception as e:
         from trivy_tpu.cache.redis import RedisError
         from trivy_tpu.cache.s3 import S3Error
-        from trivy_tpu.commands.run import CacheConfigError, ScanTimeoutError
+        from trivy_tpu.commands.run import (
+            CacheConfigError,
+            OptionsError,
+            ScanTimeoutError,
+        )
         from trivy_tpu.compliance.spec import ComplianceError
         from trivy_tpu.db.client import DBError
         from trivy_tpu.image.registry import RegistryError
@@ -717,7 +730,7 @@ def main(argv: list[str] | None = None) -> int:
         if isinstance(
             e,
             (DBError, RegistryError, ScanTimeoutError, ComplianceError,
-             RegoError, CacheConfigError, RedisError, S3Error),
+             RegoError, CacheConfigError, OptionsError, RedisError, S3Error),
         ):
             print(f"trivy-tpu: {e}", file=sys.stderr)
             return 2

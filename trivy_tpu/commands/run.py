@@ -35,6 +35,10 @@ class CacheConfigError(ValueError):
     pass
 
 
+class OptionsError(ValueError):
+    """Malformed flag value (the reference's xerrors out of flag parse)."""
+
+
 @dataclass
 class Options:
     """The flag.Options megastruct analogue (pkg/flag/options.go:323) — only
@@ -50,6 +54,7 @@ class Options:
     cache_backend: str = "memory"
     skip_files: list[str] = field(default_factory=list)
     skip_dirs: list[str] = field(default_factory=list)
+    file_patterns: list[str] = field(default_factory=list)  # type:regex
     secret_config: str = "trivy-secret.yaml"
     secret_backend: str = "auto"  # hybrid; never boots a device runtime by itself
     ignore_file: str = ""
@@ -109,6 +114,29 @@ def init_cache(options: Options) -> ArtifactCache:
             "(memory | fs | redis://... | s3://...)"
         )
     return MemoryCache()
+
+
+def _parse_file_patterns(raw: list[str]) -> dict:
+    """--file-patterns type:regex -> {type: [compiled]}  (analyzer.go
+    CreateAnalyzerGroup's filePatterns parse; bad entries are hard errors,
+    matching the reference's xerrors on an invalid pattern)."""
+    import re
+
+    out: dict[str, list] = {}
+    for spec in raw or []:
+        atype, sep, pattern = spec.partition(":")
+        if not sep or not atype or not pattern:
+            raise OptionsError(
+                f"invalid file pattern {spec!r} (want type:regex)"
+            )
+        try:
+            compiled = re.compile(pattern)
+        except re.error as e:
+            raise OptionsError(
+                f"invalid file pattern regex {pattern!r}: {e}"
+            ) from e
+        out.setdefault(atype, []).append(compiled)
+    return out
 
 
 def _analyzer_options(options: Options, target_kind: str) -> AnalyzerOptions:
@@ -173,6 +201,7 @@ def _analyzer_options(options: Options, target_kind: str) -> AnalyzerOptions:
         secret_scanner_option=SecretScannerOption(
             config_path=options.secret_config, backend=options.secret_backend
         ),
+        file_patterns=_parse_file_patterns(options.file_patterns),
         extra_analyzers=extra,
         sbom_sources=list(getattr(options, "sbom_sources", []) or []),
         cache_key_extra=cache_key_extra,

@@ -1,0 +1,5 @@
+"""Adapters lowering raw IaC parses into typed provider state
+(reference: pkg/iac/adapters)."""
+
+from trivy_tpu.iac.adapters.cloudformation import adapt_cloudformation  # noqa: F401
+from trivy_tpu.iac.adapters.terraform import adapt_terraform  # noqa: F401

@@ -39,10 +39,15 @@ class Check:
     description: str
     severity: str
     resolution: str
-    input_type: str  # dockerfile | kubernetes | terraform
+    input_type: str  # dockerfile | kubernetes | terraform | cloud | ...
     # package -> module for every module loaded alongside this check —
     # `import data.lib.kubernetes` helper libraries resolve through it.
     registry: dict = None  # type: ignore[assignment]
+    # selector subtypes for cloud checks: [{"provider": "aws",
+    # "service": "s3"}, ...] — the applicability gate.
+    subtypes: list = None  # type: ignore[assignment]
+    # METADATA related_resources URLs -> finding references.
+    references: list = None  # type: ignore[assignment]
 
 
 def _input_type_of(package: str) -> str | None:
@@ -87,11 +92,40 @@ def load_checks(extra_dirs: list[str] | None = None) -> list[Check]:
                 registry[mod.package] = mod
                 modules.append(mod)
     for mod in modules:
-        itype = _input_type_of(mod.package)
-        if itype is None or "deny" not in mod.rules:
-            continue
         md = mod.metadata or {}
         custom = md.get("custom") or {}
+        # The METADATA input selector is authoritative (the real bundle's
+        # cloud checks live under packages like builtin.aws.s3.* that the
+        # path heuristic can't route); the package path is the fallback
+        # for selector-less checks.
+        selectors = (custom.get("input") or {}).get("selector") or []
+        sel_types = [
+            s.get("type") for s in selectors if isinstance(s, dict)
+        ]
+        subtypes = [
+            st
+            for s in selectors
+            if isinstance(s, dict)
+            for st in s.get("subtypes") or []
+            if isinstance(st, dict)
+        ]
+        itype = None
+        if "cloud" in sel_types:
+            itype = "cloud"
+        elif sel_types and sel_types[0] in (
+            "dockerfile",
+            "kubernetes",
+            "terraform",
+            "cloudformation",
+            "json",
+            "yaml",
+            "toml",
+        ):
+            itype = sel_types[0]
+        if itype is None:
+            itype = _input_type_of(mod.package)
+        if itype is None or "deny" not in mod.rules:
+            continue
         checks.append(
             Check(
                 module=mod,
@@ -102,6 +136,10 @@ def load_checks(extra_dirs: list[str] | None = None) -> list[Check]:
                 resolution=custom.get("recommended_action", ""),
                 input_type=itype,
                 registry=registry,
+                subtypes=subtypes,
+                references=[
+                    str(u) for u in md.get("related_resources") or []
+                ],
             )
         )
     return checks
@@ -192,14 +230,13 @@ class IacScanner:
             except _yaml.YAMLError:
                 return None
         elif ftype == "toml":
-            try:
-                import tomllib
-            except ImportError:  # Python 3.10: tomllib landed in 3.11
+            from trivy_tpu.compat import tomllib
+
+            if tomllib is None:  # no TOML parser in this interpreter
                 import logging
 
                 logging.getLogger(__name__).warning(
-                    "toml checks need Python >= 3.11 (tomllib); %s skipped",
-                    file_path,
+                    "toml checks need tomllib or tomli; %s skipped", file_path
                 )
                 return None
             try:
@@ -246,72 +283,139 @@ class IacScanner:
         for check in self.checks:
             if check.input_type != ftype:
                 continue
-            failures = []
-            traces: list[str] = []
-            broken = False
-            for di, doc in enumerate(inputs):
-                ev = _Evaluator(
-                    doc, check.module.rules,
-                    registry=check.registry,
-                    imports=check.module.imports,
-                )
-                try:
-                    denies = ev.eval_set_rule("deny")
-                except Exception as e:  # noqa: BLE001 — any check crash
-                    # A policy that cannot evaluate — RegoError or a builtin
-                    # crashing on unexpected input shapes — must not read as
-                    # green (PASS) nor abort the file's other checks; log
-                    # and record nothing for this check.
-                    import logging
+            self._run_check(check, inputs, file_path, mc)
+        if ftype in ("terraform", "cloudformation"):
+            self._evaluate_cloud(file_path, ftype, inputs, mc)
+        return mc
 
-                    logging.getLogger(__name__).warning(
-                        "check %s failed to evaluate on %s: %s",
-                        check.check_id, file_path, e,
-                    )
-                    broken = True
-                    continue
-                if self.trace:
-                    traces.append(
-                        f"input[{di}] package {check.module.package}: "
-                        f"deny produced {len(denies)} result(s)"
-                    )
-                for d in denies:
-                    if isinstance(d, dict):
-                        msg = str(d.get("msg", ""))
-                        start = int(d.get("startline", 0) or 0)
-                        end = int(d.get("endline", 0) or start)
-                    else:
-                        msg, start, end = str(d), 0, 0
-                    failures.append(
-                        MisconfFinding(
-                            check_id=check.check_id,
-                            title=check.title,
-                            description=check.description,
-                            message=msg,
-                            resolution=check.resolution,
-                            severity=check.severity,
-                            status="FAIL",
-                            start_line=start,
-                            end_line=end or start,
-                        )
-                    )
-            if self.trace:
-                for f in failures:
-                    f.traces = list(traces)
-            if failures:
-                mc.failures.extend(failures)
-            elif broken:
-                pass  # neither PASS nor FAIL: the check did not evaluate
+    def _evaluate_cloud(
+        self,
+        file_path: str,
+        ftype: str,
+        inputs: list[Any],
+        mc: Misconfiguration,
+    ) -> None:
+        """Adapt the raw parse into typed provider state and run the
+        cloud-selector checks over it (pkg/iac/rego isPolicyApplicable +
+        the adapters/terraform lowering).  `cloud.tf.json` documents the
+        aws live scan synthesizes flow through here identically, so both
+        scan paths share one typed check corpus."""
+        cloud_checks = [c for c in self.checks if c.input_type == "cloud"]
+        if not cloud_checks:
+            return
+        try:
+            if ftype == "terraform":
+                from trivy_tpu.iac.adapters.terraform import adapt_terraform
+
+                state = adapt_terraform(
+                    [d for d in inputs if isinstance(d, dict)],
+                    filename=file_path,
+                )
             else:
-                mc.successes.append(
+                from trivy_tpu.iac.adapters.cloudformation import (
+                    adapt_cloudformation,
+                )
+
+                state = adapt_cloudformation(
+                    inputs[0] if inputs and isinstance(inputs[0], dict)
+                    else {},
+                    filename=file_path,
+                )
+        except Exception as e:  # noqa: BLE001 — adaptation must not
+            # take down the raw-schema findings already collected
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "typed-state adaptation failed for %s: %s", file_path, e
+            )
+            return
+        doc = state.to_rego()
+        for check in cloud_checks:
+            subtypes = check.subtypes or []
+            applicable = not subtypes or any(
+                state.service_has_resources(
+                    str(st.get("provider", "")), str(st.get("service", ""))
+                )
+                for st in subtypes
+            )
+            if not applicable:
+                continue
+            self._run_check(check, [doc], file_path, mc)
+
+    def _run_check(
+        self,
+        check: Check,
+        inputs: list[Any],
+        file_path: str,
+        mc: Misconfiguration,
+    ) -> None:
+        failures = []
+        traces: list[str] = []
+        broken = False
+        for di, doc in enumerate(inputs):
+            ev = _Evaluator(
+                doc, check.module.rules,
+                registry=check.registry,
+                imports=check.module.imports,
+            )
+            try:
+                denies = ev.eval_set_rule("deny")
+            except Exception as e:  # noqa: BLE001 — any check crash
+                # A policy that cannot evaluate — RegoError or a builtin
+                # crashing on unexpected input shapes — must not read as
+                # green (PASS) nor abort the file's other checks; log
+                # and record nothing for this check.
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "check %s failed to evaluate on %s: %s",
+                    check.check_id, file_path, e,
+                )
+                broken = True
+                continue
+            if self.trace:
+                traces.append(
+                    f"input[{di}] package {check.module.package}: "
+                    f"deny produced {len(denies)} result(s)"
+                )
+            for d in denies:
+                if isinstance(d, dict):
+                    msg = str(d.get("msg", ""))
+                    start = int(d.get("startline", 0) or 0)
+                    end = int(d.get("endline", 0) or start)
+                else:
+                    msg, start, end = str(d), 0, 0
+                failures.append(
                     MisconfFinding(
                         check_id=check.check_id,
                         title=check.title,
                         description=check.description,
+                        message=msg,
                         resolution=check.resolution,
                         severity=check.severity,
-                        status="PASS",
-                        traces=list(traces),
+                        status="FAIL",
+                        start_line=start,
+                        end_line=end or start,
+                        references=list(check.references or []),
                     )
                 )
-        return mc
+        if self.trace:
+            for f in failures:
+                f.traces = list(traces)
+        if failures:
+            mc.failures.extend(failures)
+        elif broken:
+            pass  # neither PASS nor FAIL: the check did not evaluate
+        else:
+            mc.successes.append(
+                MisconfFinding(
+                    check_id=check.check_id,
+                    title=check.title,
+                    description=check.description,
+                    resolution=check.resolution,
+                    severity=check.severity,
+                    status="PASS",
+                    traces=list(traces),
+                    references=list(check.references or []),
+                )
+            )

@@ -1,0 +1,27 @@
+"""AWS CloudTrail typed state (reference: pkg/iac/providers/aws/cloudtrail)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from trivy_tpu.iac.providers.types import (
+    BoolValue,
+    Metadata,
+    StringValue,
+)
+
+
+@dataclass
+class Trail:
+    metadata: Metadata
+    name: StringValue
+    is_multi_region: BoolValue
+    enable_log_file_validation: BoolValue
+    kms_key_id: StringValue
+    bucket_name: StringValue
+    is_logging: BoolValue
+
+
+@dataclass
+class CloudTrail:
+    trails: list[Trail] = field(default_factory=list)

@@ -1,0 +1,25 @@
+"""AWS KMS typed state (reference: pkg/iac/providers/aws/kms)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from trivy_tpu.iac.providers.types import (
+    BoolValue,
+    Metadata,
+    StringValue,
+)
+
+KEY_USAGE_SIGN = "SIGN_VERIFY"
+
+
+@dataclass
+class Key:
+    metadata: Metadata
+    usage: StringValue
+    rotation_enabled: BoolValue
+
+
+@dataclass
+class KMS:
+    keys: list[Key] = field(default_factory=list)

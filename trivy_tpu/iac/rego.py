@@ -1135,7 +1135,9 @@ class _Evaluator:
         if isinstance(expr, ArrayLit):
             return [self.eval_expr(i, env) for i in expr.items]
         if isinstance(expr, SetLit):
-            return [self.eval_expr(i, env) for i in expr.items]
+            # A set literal must carry set semantics: `{"a","b"}[x]` is a
+            # membership test on a bound x, not an index lookup.
+            return _SetVal([self.eval_expr(i, env) for i in expr.items])
         if isinstance(expr, ObjectLit):
             return {
                 self.eval_expr(k, env): self.eval_expr(v, env)
@@ -1282,12 +1284,50 @@ class _Evaluator:
         return fn(args)
 
 
+def _bi_object_get(args):
+    obj, key, default = args[:3]
+    # OPA accepts a path array key: object.get(o, ["a","b"], d) walks
+    # nested objects/arrays (the trivy-checks lib/ helpers lean on this).
+    if isinstance(key, (list, tuple)) and not isinstance(key, str):
+        cur = obj
+        for seg in key:
+            if isinstance(cur, dict) and seg in cur:
+                cur = cur[seg]
+            elif (
+                isinstance(cur, (list, tuple))
+                and isinstance(seg, (int, float))
+                and not isinstance(seg, bool)
+                and 0 <= int(seg) < len(cur)
+            ):
+                cur = cur[int(seg)]
+            else:
+                return default
+        return cur
+    if isinstance(obj, dict):
+        return obj.get(key, default)
+    return default
+
+
 def _bi_result_new(args):
     msg, cause = (args + [None, None])[:2]
     out = {"msg": msg, "startline": 0, "endline": 0}
     if isinstance(cause, dict):
-        out["startline"] = cause.get("StartLine", cause.get("__startline__", 0))
-        out["endline"] = cause.get("EndLine", cause.get("__endline__", 0))
+        # Typed provider state (iac/providers): a value object carries its
+        # own lowercase range keys; a struct nests them under
+        # __defsec_metadata__ (pkg/iac/rego/convert naming).
+        meta = cause
+        if isinstance(cause.get("__defsec_metadata__"), dict):
+            meta = cause["__defsec_metadata__"]
+        for ok, keys in (
+            ("startline", ("StartLine", "startline", "__startline__")),
+            ("endline", ("EndLine", "endline", "__endline__")),
+        ):
+            for k in keys:
+                if meta.get(k):
+                    out[ok] = meta[k]
+                    break
+        if isinstance(meta.get("filepath"), str):
+            out["filepath"] = meta["filepath"]
     return out
 
 
@@ -1482,7 +1522,7 @@ _BUILTINS = {
     "is_null": lambda a: a[0] is None,
     "is_array": lambda a: isinstance(a[0], list),
     "is_object": lambda a: isinstance(a[0], dict),
-    "object.get": lambda a: a[0].get(a[1], a[2]) if isinstance(a[0], dict) else a[2],
+    "object.get": lambda a: _bi_object_get(a),
     "array.concat": lambda a: list(a[0]) + list(a[1]),
     "regex.match": lambda a: bool(_re.search(a[0], a[1])),
     "re_match": lambda a: bool(_re.search(a[0], a[1])),

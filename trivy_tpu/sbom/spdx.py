@@ -2,34 +2,61 @@
 
 from __future__ import annotations
 
+import re
+import uuid
 from typing import Any
 
 from trivy_tpu import __version__
 from trivy_tpu.atypes import Application, ArtifactDetail, OS, Package
-from trivy_tpu.ftypes import Report
+from trivy_tpu.ftypes import Report, ResultClass
 from trivy_tpu.purl import PURL_TO_APP, package_url, parse_purl
+
+# Deterministic namespace derivation (instead of the reference's random
+# uuid): same artifact + creation time -> same DocumentNamespace, so
+# SBOM output is reproducible and golden-testable.
+_NAMESPACE_BASE = "https://trivy-tpu.dev/spdxdocs"
+
+
+def _document_namespace(report: Report) -> str:
+    name = report.artifact_name or "unknown"
+    seed = f"{name}-{report.created_at or ''}"
+    # path-like artifact names must still yield a valid URI segment
+    safe = re.sub(r"[^A-Za-z0-9.+-]", "-", name).strip("-") or "unknown"
+    return f"{_NAMESPACE_BASE}/{safe}-{uuid.uuid5(uuid.NAMESPACE_URL, seed)}"
 
 
 def encode_report(report: Report) -> dict[str, Any]:
     packages = []
+    relationships: list[dict[str, str]] = []
     idx = 0
+    os_id = None
     if report.metadata.os_family:
+        os_id = "SPDXRef-OperatingSystem"
         packages.append(
             {
-                "SPDXID": "SPDXRef-OperatingSystem",
+                "SPDXID": os_id,
                 "name": report.metadata.os_family,
                 "versionInfo": report.metadata.os_name,
                 "downloadLocation": "NONE",
                 "primaryPackagePurpose": "OPERATING-SYSTEM",
             }
         )
+        relationships.append(
+            {
+                "spdxElementId": "SPDXRef-DOCUMENT",
+                "relatedSpdxElement": os_id,
+                "relationshipType": "DESCRIBES",
+            }
+        )
     for result in report.results:
+        os_pkgs = result.result_class == ResultClass.OS_PKGS
         for pkg in result.packages:
             idx += 1
+            spdx_id = f"SPDXRef-Package-{idx}"
             purl = package_url(result.result_type, pkg.name, pkg.version)
             packages.append(
                 {
-                    "SPDXID": f"SPDXRef-Package-{idx}",
+                    "SPDXID": spdx_id,
                     "name": pkg.name,
                     "versionInfo": pkg.version,
                     "downloadLocation": "NONE",
@@ -43,16 +70,35 @@ def encode_report(report: Report) -> dict[str, Any]:
                     ],
                 }
             )
+            if os_pkgs and os_id:
+                # OS packages hang off the operating system element
+                relationships.append(
+                    {
+                        "spdxElementId": os_id,
+                        "relatedSpdxElement": spdx_id,
+                        "relationshipType": "CONTAINS",
+                    }
+                )
+            else:
+                relationships.append(
+                    {
+                        "spdxElementId": "SPDXRef-DOCUMENT",
+                        "relatedSpdxElement": spdx_id,
+                        "relationshipType": "DESCRIBES",
+                    }
+                )
     return {
         "spdxVersion": "SPDX-2.3",
         "dataLicense": "CC0-1.0",
         "SPDXID": "SPDXRef-DOCUMENT",
         "name": report.artifact_name,
+        "documentNamespace": _document_namespace(report),
         "creationInfo": {
             "creators": [f"Tool: trivy-tpu-{__version__}"],
             "created": report.created_at or "1970-01-01T00:00:00Z",
         },
         "packages": packages,
+        "relationships": relationships,
     }
 
 
@@ -66,6 +112,7 @@ def encode_tag_value(report: Report) -> str:
         f"DataLicense: {doc['dataLicense']}",
         f"SPDXID: {doc['SPDXID']}",
         f"DocumentName: {doc['name']}",
+        f"DocumentNamespace: {doc['documentNamespace']}",
         f"Creator: {doc['creationInfo']['creators'][0]}",
         f"Created: {doc['creationInfo']['created']}",
     ]
@@ -87,6 +134,14 @@ def encode_tag_value(report: Report) -> str:
                 "ExternalRef: "
                 f"{ref['referenceCategory']} {ref['referenceType']} "
                 f"{ref['referenceLocator']}"
+            )
+    if doc.get("relationships"):
+        lines.append("")
+        for rel in doc["relationships"]:
+            lines.append(
+                "Relationship: "
+                f"{rel['spdxElementId']} {rel['relationshipType']} "
+                f"{rel['relatedSpdxElement']}"
             )
     return "\n".join(lines) + "\n"
 
