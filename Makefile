@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test smoke bench checks-corpus
+.PHONY: test smoke serve-smoke bench checks-corpus
 
 # Tier-1: the suite the driver holds the repo to (fast, CPU, no slow marks).
 test:
@@ -17,6 +17,14 @@ smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest \
 		tests/test_bench_smoke.py::test_bench_smoke_subprocess \
 		-q -p no:cacheprovider
+
+# Server-mode smoke: boot the batching server on a random port, fire
+# concurrent ScanSecrets, assert every request succeeds, the /metrics
+# fill/coalescing counters are nonzero (>= one batch carried items from
+# two or more requests), and shutdown drains cleanly.
+serve-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serve_smoke.py \
+		-m serve_smoke -q -p no:cacheprovider
 
 # Full benchmark (honest corpora; on CPU this takes a while).
 bench:

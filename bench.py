@@ -291,6 +291,70 @@ def bench_kernel_exec() -> dict:
     return out
 
 
+def bench_serve(engine, n_clients: int = 16, files_per_req: int = 8) -> dict:
+    """Server-mode continuous batching (trivy_tpu/serve/): N synthetic
+    clients fire concurrent requests at one BatchScheduler over the
+    already-warm engine.  Reports throughput plus the coalescing shape —
+    requests per batch, mean fill ratio, multi-request batches (the
+    acceptance bar: batches must mix items from >= 2 distinct requests) —
+    against the same requests run sequentially through scan_batch."""
+    import threading
+
+    from trivy_tpu.serve import BatchScheduler, ServeConfig
+
+    corpus = bench_corpus.make_monorepo_corpus(n_clients * files_per_req)
+    reqs = [
+        corpus[i * files_per_req : (i + 1) * files_per_req]
+        for i in range(n_clients)
+    ]
+    nbytes = sum(len(c) for _, c in corpus)
+
+    t0 = time.perf_counter()
+    for items in reqs:
+        engine.scan_batch(items)
+    sequential_s = time.perf_counter() - t0
+
+    sched = BatchScheduler(lambda: engine, ServeConfig(batch_window_ms=8.0))
+    barrier = threading.Barrier(n_clients)
+    futs = [None] * n_clients
+
+    def fire(i):
+        barrier.wait()
+        futs[i] = sched.submit(reqs[i], client_id=f"bench{i}")
+
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(target=fire, args=(i,)) for i in range(n_clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for f in futs:
+        f.result()
+    batched_s = time.perf_counter() - t0
+    sched.drain(timeout=30)
+
+    s = sched.stats
+    out = {
+        "clients": n_clients,
+        "files_per_request": files_per_req,
+        "sequential_wall_s": round(sequential_s, 3),
+        "batched_wall_s": round(batched_s, 3),
+        "mb_per_sec": round(nbytes / max(batched_s, 1e-9) / 1e6, 1),
+        "batches": s.batches,
+        "multi_request_batches": s.multi_request_batches,
+        "requests_per_batch": round(s.coalesced_requests / max(s.batches, 1), 2),
+        "mean_fill_ratio": round(s.fill_ratio_sum / max(s.batches, 1), 4),
+        "mean_ticket_wait_ms": round(
+            1e3 * s.wait_s_sum / max(s.admitted, 1), 2
+        ),
+    }
+    if batched_s > 0:
+        out["batching_speedup"] = round(sequential_s / batched_s, 3)
+    return out
+
+
 def bench_license(n_files: int = 2000, n_license: int = 300) -> dict:
     """BASELINE config #5's second scanner: the license classifier
     (--scanners secret,license).  A corpus of source-shaped files with
@@ -807,6 +871,19 @@ def main() -> None:
             detail["kernel_exec"] = bench_kernel_exec()
         except Exception as e:
             detail["kernel_exec"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if os.environ.get("BENCH_SERVE", "1") == "1":
+        # Server mode: concurrent clients coalescing in the continuous
+        # batcher vs the same requests run sequentially.
+        try:
+            if SMOKE:
+                detail["serve"] = bench_serve(
+                    engine, n_clients=6, files_per_req=4
+                )
+            else:
+                detail["serve"] = bench_serve(engine)
+        except Exception as e:
+            detail["serve"] = {"error": f"{type(e).__name__}: {e}"}
 
     if os.environ.get("BENCH_LICENSE", "1") == "1":
         # BASELINE config #5's second scanner (--scanners secret,license).

@@ -127,3 +127,53 @@ def test_metrics_unknown_path_fixed_label():
         assert "injected" not in text
     finally:
         srv.shutdown()
+
+
+def test_metrics_exposition_format():
+    """Prometheus text-format regression: every sample line parses as
+    `name{labels} value`, every metric family carries HELP+TYPE, and the
+    serve/in-flight gauges added with the batching server are present."""
+    import re
+
+    srv = make_http_server("localhost:0", MemoryCache(), token="")
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        base = f"http://localhost:{srv.server_address[1]}"
+        req = urllib.request.Request(
+            base + "/twirp/trivy.cache.v1.Cache/MissingBlobs",
+            data=json.dumps({"ArtifactID": "a", "BlobIDs": []}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        urllib.request.urlopen(req, timeout=10).read()
+        text = urllib.request.urlopen(base + "/metrics", timeout=10).read().decode()
+
+        sample = re.compile(
+            r'^[a-zA-Z_:][a-zA-Z0-9_:]*'            # metric name
+            r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"\\]*"'   # first label
+            r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"\\]*")*\})?'  # more labels
+            r' -?[0-9.]+(e[+-][0-9]+)?$'             # value
+        )
+        helps, types, names = set(), set(), set()
+        for line in text.splitlines():
+            if not line:
+                continue
+            if line.startswith("# HELP "):
+                helps.add(line.split()[2])
+            elif line.startswith("# TYPE "):
+                parts = line.split()
+                types.add(parts[2])
+                assert parts[3] in ("counter", "gauge", "histogram", "summary")
+            else:
+                assert sample.match(line), f"bad exposition line: {line!r}"
+                names.add(line.split("{")[0].split()[0])
+        # Every sample belongs to a family announced with HELP + TYPE.
+        for n in names:
+            base_name = n[:-4] if n.endswith("_sum") and n not in types else n
+            assert n in types or base_name in types, f"no TYPE for {n}"
+            assert n in helps or base_name in helps, f"no HELP for {n}"
+        assert "trivy_tpu_inflight_requests" in names
+        assert "trivy_tpu_serve_queue_depth" in names
+        assert "trivy_tpu_serve_batches_total" in names
+        assert "trivy_tpu_serve_rejected_total" in names
+    finally:
+        srv.shutdown()

@@ -1,0 +1,224 @@
+"""Continuous cross-request batching scheduler (trivy_tpu/serve/).
+
+Covers the tentpole contracts: byte-identical parity between
+batched-across-requests and sequential engine output, fill-or-timeout
+coalescing, admission backpressure (queue depth, per-client caps),
+pre-dispatch deadline cancellation, and graceful drain.
+"""
+
+import threading
+import time
+
+import pytest
+
+from trivy_tpu.deadline import ScanTimeoutError
+from trivy_tpu.ftypes import Secret
+from trivy_tpu.serve import (
+    BatchScheduler,
+    ClientOverloadedError,
+    QueueFullError,
+    SchedulerClosedError,
+    ServeConfig,
+)
+
+SECRET_LINE = b"AWS_ACCESS_KEY_ID=AKIAQ6FAKEKEY1234567\n"
+
+
+class GatedEngine:
+    """Fake engine: records batches; optionally blocks until released."""
+
+    def __init__(self, gate: threading.Event | None = None):
+        self.gate = gate
+        self.batches: list[list[tuple[str, bytes]]] = []
+
+    def scan_batch(self, items):
+        self.batches.append(list(items))
+        if self.gate is not None:
+            assert self.gate.wait(timeout=10)
+        return [Secret(file_path=p) for p, _ in items]
+
+
+@pytest.fixture(scope="module")
+def engine():
+    from trivy_tpu.engine.hybrid import make_secret_engine
+
+    return make_secret_engine()
+
+
+def _flatten(secrets):
+    return [
+        (
+            s.file_path,
+            [
+                (f.rule_id, f.start_line, f.end_line, f.match, f.severity)
+                for f in s.findings
+            ],
+        )
+        for s in secrets
+    ]
+
+
+def test_concurrent_requests_parity_and_coalescing(engine, monkeypatch):
+    """N threads submitting concurrently produce byte-identical findings to
+    the same requests scanned sequentially, and at least one dispatched
+    batch coalesces items from >= 2 distinct requests."""
+    monkeypatch.setenv("TRIVY_TPU_LINK", "relay")
+    requests = []
+    for r in range(6):
+        items = []
+        for i in range(3):
+            filler = f"token_{r}_{i} = value\n".encode() * (i + 1)
+            body = SECRET_LINE + filler if (r + i) % 2 == 0 else filler
+            items.append((f"req{r}/file{i}.env", body))
+        requests.append(items)
+
+    sequential = [engine.scan_batch(items) for items in requests]
+
+    sched = BatchScheduler(
+        lambda: engine, ServeConfig(batch_window_ms=80.0)
+    )
+    futures = [None] * len(requests)
+    barrier = threading.Barrier(len(requests))
+
+    def fire(r):
+        barrier.wait()
+        futures[r] = sched.submit(requests[r], client_id=f"client{r}")
+
+    threads = [
+        threading.Thread(target=fire, args=(r,))
+        for r in range(len(requests))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    batched = [futures[r].result(timeout=30) for r in range(len(requests))]
+    sched.drain(timeout=10)
+
+    for seq, bat in zip(sequential, batched):
+        assert _flatten(seq) == _flatten(bat)
+    assert any(len(s.findings) for res in batched for s in res)
+    # Coalescing actually happened: fewer batches than requests, and at
+    # least one batch carried two or more requests' tickets.
+    assert sched.stats.multi_request_batches >= 1
+    assert sched.stats.batches < len(requests)
+    assert sched.stats.coalesced_requests == len(requests)
+
+
+def test_max_batch_bytes_dispatches_early():
+    eng = GatedEngine()
+    sched = BatchScheduler(
+        lambda: eng,
+        ServeConfig(batch_window_ms=5000.0, max_batch_bytes=64),
+    )
+    fut = sched.submit([("big.txt", b"x" * 100)])
+    # A long window must not delay an already-full batch.
+    fut.result(timeout=5)
+    assert len(eng.batches) == 1
+    sched.drain(timeout=5)
+
+
+def test_queue_full_rejects():
+    gate = threading.Event()
+    eng = GatedEngine(gate)
+    sched = BatchScheduler(
+        lambda: eng,
+        ServeConfig(
+            batch_window_ms=0.0, max_queue_depth=2,
+            max_inflight_per_client=100,
+        ),
+    )
+    first = sched.submit([("a", b"1")])  # dispatches, blocks on the gate
+    while sched.queue_depth() or not eng.batches:
+        time.sleep(0.005)  # wait until the owner thread holds it
+    queued = [sched.submit([("b", b"2")]), sched.submit([("c", b"3")])]
+    with pytest.raises(QueueFullError):
+        sched.submit([("d", b"4")])
+    assert sched.stats.rejected_full == 1
+    gate.set()
+    assert first.result(timeout=5) is not None
+    for f in queued:
+        f.result(timeout=5)
+    sched.drain(timeout=5)
+
+
+def test_per_client_inflight_cap():
+    gate = threading.Event()
+    eng = GatedEngine(gate)
+    sched = BatchScheduler(
+        lambda: eng,
+        ServeConfig(
+            batch_window_ms=0.0, max_queue_depth=100,
+            max_inflight_per_client=1,
+        ),
+    )
+    f1 = sched.submit([("a", b"1")], client_id="hog")
+    while not eng.batches:
+        time.sleep(0.005)
+    with pytest.raises(ClientOverloadedError):
+        sched.submit([("b", b"2")], client_id="hog")
+    # Another client is unaffected by the hog's cap.
+    f2 = sched.submit([("c", b"3")], client_id="polite")
+    assert sched.stats.rejected_client == 1
+    gate.set()
+    f1.result(timeout=5)
+    f2.result(timeout=5)
+    # Cap releases with the ticket: the hog can submit again.
+    f3 = sched.submit([("d", b"4")], client_id="hog")
+    f3.result(timeout=5)
+    sched.drain(timeout=5)
+
+
+def test_deadline_cancels_before_dispatch():
+    gate = threading.Event()
+    eng = GatedEngine(gate)
+    sched = BatchScheduler(
+        lambda: eng, ServeConfig(batch_window_ms=0.0)
+    )
+    blocker = sched.submit([("a", b"1")])
+    while not eng.batches:
+        time.sleep(0.005)
+    doomed = sched.submit([("b", b"2")], timeout_s=0.02)
+    time.sleep(0.05)  # expire while the first batch holds the engine
+    gate.set()
+    blocker.result(timeout=5)
+    with pytest.raises(ScanTimeoutError):
+        doomed.result(timeout=5)
+    sched.drain(timeout=5)
+    # The expired ticket's items never reached the engine.
+    assert all(p != "b" for batch in eng.batches for p, _ in batch)
+    assert sched.stats.expired == 1
+
+
+def test_drain_finishes_queue_then_rejects():
+    eng = GatedEngine()
+    sched = BatchScheduler(lambda: eng, ServeConfig(batch_window_ms=0.0))
+    futs = [sched.submit([(f"f{i}", b"x")]) for i in range(5)]
+    sched.drain(timeout=10)
+    for f in futs:
+        assert f.result(timeout=1) is not None  # queued work completed
+    with pytest.raises(SchedulerClosedError):
+        sched.submit([("late", b"x")])
+    assert sched.stats.rejected_closed == 1
+
+
+def test_engine_error_fails_batch_not_scheduler():
+    class BoomEngine:
+        def __init__(self):
+            self.calls = 0
+
+        def scan_batch(self, items):
+            self.calls += 1
+            if self.calls == 1:
+                raise RuntimeError("boom")
+            return [Secret(file_path=p) for p, _ in items]
+
+    eng = BoomEngine()
+    sched = BatchScheduler(lambda: eng, ServeConfig(batch_window_ms=0.0))
+    bad = sched.submit([("a", b"1")])
+    with pytest.raises(RuntimeError, match="boom"):
+        bad.result(timeout=5)
+    ok = sched.submit([("b", b"2")])  # scheduler survives the batch error
+    assert ok.result(timeout=5)[0].file_path == "b"
+    assert sched.stats.errors == 1
+    sched.drain(timeout=5)

@@ -1,0 +1,277 @@
+"""HTTP seat of the continuous batcher: Scanner/ScanSecrets end-to-end.
+
+Real in-process server on a free port (the integration_test.go:77-103
+pattern).  Covers: concurrent-request parity vs a local engine, 429 +
+Retry-After under backpressure, 408 on server-armed deadlines, draining ->
+503, client retry/backoff honoring Retry-After, and the /metrics
+exposition of the serve counters.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from trivy_tpu.cache.store import MemoryCache
+from trivy_tpu.engine.hybrid import make_secret_engine
+from trivy_tpu.ftypes import Secret
+from trivy_tpu.rpc.client import RemoteSecretEngine, RpcClient, RpcError
+from trivy_tpu.rpc.server import start_background
+from trivy_tpu.serve import ServeConfig
+
+SECRET_FILE = b"AWS_ACCESS_KEY_ID=AKIAQ6FAKEKEY1234567\n"
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return make_secret_engine()
+
+
+@pytest.fixture
+def serve_server(engine, monkeypatch):
+    """Server whose scheduler reuses the module engine (no rebuild cost)
+    and a window wide enough for tests to coalesce deliberately."""
+    monkeypatch.setenv("TRIVY_TPU_LINK", "relay")
+    httpd, _ = start_background(
+        "localhost:0",
+        MemoryCache(),
+        serve_config=ServeConfig(batch_window_ms=60.0),
+        secret_engine_factory=lambda: engine,
+    )
+    addr = f"{httpd.server_address[0]}:{httpd.server_address[1]}"
+    yield addr, httpd.scan_server
+    httpd.scan_server.scheduler.close()
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def _requests():
+    reqs = []
+    for r in range(5):
+        items = [
+            (f"req{r}/creds{i}.env", SECRET_FILE + f"# {r}.{i}\n".encode())
+            for i in range(2)
+        ]
+        items.append((f"req{r}/plain.txt", b"no secrets here at all\n"))
+        reqs.append(items)
+    return reqs
+
+
+def test_concurrent_scan_secrets_parity(serve_server, engine):
+    """N threads firing concurrent ScanSecrets produce byte-identical
+    wire JSON to sequential local scans, and the server's batches coalesce
+    items from >= 2 distinct requests."""
+    addr, scan_server = serve_server
+    reqs = _requests()
+    expected = [
+        [json.loads(json.dumps(_sec_json(s))) for s in engine.scan_batch(items)]
+        for items in reqs
+    ]
+
+    client = RpcClient(addr)
+    out = [None] * len(reqs)
+    barrier = threading.Barrier(len(reqs))
+
+    def fire(r):
+        barrier.wait()
+        out[r] = client.scan_secrets(reqs[r], client_id=f"c{r}")
+
+    threads = [
+        threading.Thread(target=fire, args=(r,)) for r in range(len(reqs))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    for r, resp in enumerate(out):
+        assert resp["Secrets"] == expected[r]
+        # Results view: one entry per finding-bearing file, Secrets class.
+        paths = [res["Target"] for res in resp["Results"]]
+        assert paths == [p for p, _ in reqs[r][:2]]
+        for res in resp["Results"]:
+            assert res["Class"] == "secret"
+            assert res["Secrets"]
+    stats = scan_server.scheduler.stats
+    assert stats.multi_request_batches >= 1
+    assert stats.coalesced_requests >= len(reqs)
+
+
+def _sec_json(s: Secret) -> dict:
+    from trivy_tpu.atypes import _secret_to_json
+
+    return _secret_to_json(s)
+
+
+def test_remote_secret_engine_parity(serve_server, engine):
+    addr, _ = serve_server
+    items = [
+        ("a/creds.env", SECRET_FILE),
+        ("b/nothing.txt", b"plain contents, no match\n"),
+    ]
+    remote = RemoteSecretEngine(addr).scan_batch(items)
+    local = engine.scan_batch(items)
+    assert [_sec_json(s) for s in remote] == [_sec_json(s) for s in local]
+    one = RemoteSecretEngine(addr).scan("a/creds.env", SECRET_FILE)
+    assert _sec_json(one) == _sec_json(local[0])
+
+
+def test_queue_full_returns_429_with_retry_after():
+    """Blocked engine + depth-1 queue: the third request is rejected at
+    admission with 429 and a Retry-After hint."""
+    gate = threading.Event()
+
+    class Blocking:
+        def scan_batch(self, items):
+            assert gate.wait(timeout=10)
+            return [Secret(file_path=p) for p, _ in items]
+
+    httpd, _ = start_background(
+        "localhost:0",
+        MemoryCache(),
+        serve_config=ServeConfig(
+            batch_window_ms=0.0, max_queue_depth=1, retry_after_s=7.0
+        ),
+        secret_engine_factory=Blocking,
+    )
+    addr = f"{httpd.server_address[0]}:{httpd.server_address[1]}"
+    sched = httpd.scan_server.scheduler
+    try:
+        client = RpcClient(addr, max_retries=1)
+        done = []
+        bg = []
+
+        def fire(i):
+            done.append(
+                client.scan_secrets([(f"f{i}", b"x")], client_id=f"c{i}")
+            )
+
+        # First request dispatches and blocks the owner thread...
+        bg.append(threading.Thread(target=fire, args=(0,)))
+        bg[0].start()
+        for _ in range(500):
+            if sched.inflight_tickets() == 1 and sched.queue_depth() == 0:
+                break
+            threading.Event().wait(0.01)
+        assert sched.queue_depth() == 0
+        # ...and the second occupies the queue's single slot.
+        bg.append(threading.Thread(target=fire, args=(1,)))
+        bg[1].start()
+        for _ in range(500):
+            if sched.queue_depth() == 1:
+                break
+            threading.Event().wait(0.01)
+        assert sched.queue_depth() == 1
+        with pytest.raises(RpcError) as ei:
+            client.scan_secrets([("f2", b"x")], client_id="c2")
+        assert "HTTP 429" in str(ei.value)
+        assert sched.stats.rejected_full == 1
+        # Retry-After surfaced on the wire.
+        req = urllib.request.Request(
+            f"http://{addr}/twirp/trivy.scanner.v1.Scanner/ScanSecrets",
+            data=json.dumps(
+                {"Files": [{"Path": "f3", "ContentB64": "eA=="}]}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as he:
+            urllib.request.urlopen(req)
+        assert he.value.code == 429
+        assert he.value.headers.get("Retry-After") == "7"
+    finally:
+        gate.set()
+        for t in bg:
+            t.join(timeout=10)
+        assert len(done) == 2
+        sched.close()
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_timeout_ms_expires_to_408(serve_server):
+    """A ticket whose deadline passes while an earlier batch holds the
+    engine comes back as 408 JSON, not a hung connection."""
+    addr, scan_server = serve_server
+    release = threading.Event()
+
+    class Slow:
+        def scan_batch(self, items):
+            release.wait(timeout=10)
+            return [Secret(file_path=p) for p, _ in items]
+
+    # Swap in a slow engine on a fresh scheduler for this test.
+    from trivy_tpu.serve import BatchScheduler
+
+    scan_server.scheduler.close()
+    scan_server.scheduler = BatchScheduler(
+        Slow, ServeConfig(batch_window_ms=0.0)
+    )
+    client = RpcClient(addr, max_retries=1)
+    blocker = threading.Thread(
+        target=lambda: client.scan_secrets([("a", b"x")], client_id="b1")
+    )
+    blocker.start()
+    while not scan_server.scheduler.inflight_tickets():
+        threading.Event().wait(0.01)
+    # Release the engine shortly after the doomed ticket's 30ms deadline
+    # has passed; the owner thread then cancels it before dispatch.
+    threading.Timer(0.3, release.set).start()
+    with pytest.raises(RpcError) as ei:
+        client.scan_secrets([("b", b"x")], timeout_ms=30, client_id="b2")
+    assert "HTTP 408" in str(ei.value)
+    assert "deadline" in str(ei.value)
+    blocker.join(timeout=10)
+
+
+def test_draining_returns_503_and_client_retries_honor_retry_after(
+    serve_server,
+):
+    """Draining server: every request gets 503 + Retry-After: 5; the
+    client retries with backoff floored at the server's hint and finally
+    surfaces the last error."""
+    addr, scan_server = serve_server
+    scan_server.draining = True
+    try:
+        naps = []
+        client = RpcClient(addr, max_retries=3)
+        client.sleep = naps.append
+        with pytest.raises(RpcError) as ei:
+            client.scan_secrets([("a", b"x")])
+        msg = str(ei.value)
+        assert "retries exhausted after 3 attempts" in msg
+        assert "HTTP 503" in msg
+        assert len(naps) == 2  # sleeps between attempts, none after last
+        assert all(n >= 5.0 for n in naps)  # Retry-After floors the jitter
+    finally:
+        scan_server.draining = False
+
+
+def test_bad_base64_is_400_not_retried(serve_server):
+    addr, _ = serve_server
+    calls = []
+    client = RpcClient(addr, max_retries=4)
+    client.sleep = calls.append
+    with pytest.raises(RpcError) as ei:
+        client.call(
+            "/twirp/trivy.scanner.v1.Scanner/ScanSecrets",
+            {"Files": [{"Path": "a", "ContentB64": "%%%not-base64%%%"}]},
+        )
+    assert "HTTP 400" in str(ei.value)
+    assert calls == []  # deterministic 4xx: no retry, no sleep
+
+
+def test_metrics_expose_serve_and_inflight(serve_server):
+    addr, _ = serve_server
+    RpcClient(addr).scan_secrets([("m/creds.env", SECRET_FILE)])
+    body = urllib.request.urlopen(f"http://{addr}/metrics").read().decode()
+    assert "trivy_tpu_inflight_requests 0" in body
+    assert "trivy_tpu_serve_queue_depth 0" in body
+    for counter in (
+        "trivy_tpu_serve_batches_total",
+        "trivy_tpu_serve_coalesced_requests_total",
+        "trivy_tpu_serve_batch_fill_ratio_sum",
+        "trivy_tpu_serve_ticket_wait_seconds_total",
+    ):
+        assert counter in body

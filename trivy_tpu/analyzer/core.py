@@ -64,8 +64,15 @@ class SecretScannerOption:
     config_path: str = ""
     # "auto" (hybrid: host sieve + cost-gated device verify — the product
     # default; never boots a device runtime by itself), "tpu" (all-device
-    # sieve), "cpu" (oracle).
+    # sieve), "cpu" (oracle), "server" (raw items ship to the scan server's
+    # continuous cross-request batcher — trivy_tpu/serve/).
     backend: str = "auto"
+    # backend == "server": where the engine lives and how to authenticate.
+    server_addr: str = ""
+    server_token: str = ""
+    # Forwarded as the request TimeoutMs so server-side tickets inherit the
+    # client's --timeout.  0 = unbounded.
+    timeout_s: float = 0.0
 
 
 @dataclass

@@ -69,10 +69,17 @@ class SecretAnalyzer(BatchAnalyzer):
         self._config_path = ""
         self._config_skip_paths: frozenset[str] = frozenset()
         self._backend = "auto"
+        self._server_addr = ""
+        self._server_token = ""
+        self._timeout_s = 0.0
 
     def init(self, options: AnalyzerOptions) -> None:
-        self._config_path = options.secret_scanner_option.config_path
-        self._backend = options.secret_scanner_option.backend
+        opt = options.secret_scanner_option
+        self._config_path = opt.config_path
+        self._backend = opt.backend
+        self._server_addr = getattr(opt, "server_addr", "")
+        self._server_token = getattr(opt, "server_token", "")
+        self._timeout_s = getattr(opt, "timeout_s", 0.0)
         self._config_skip_paths = self._build_config_skip_paths(self._config_path)
 
     @staticmethod
@@ -92,7 +99,22 @@ class SecretAnalyzer(BatchAnalyzer):
     def engine(self):
         if self._engine is None:
             config = load_config(self._config_path)
-            if self._backend == "cpu":
+            if self._backend == "server":
+                # The sidecar split: raw (path, blob) items board the scan
+                # server's continuous batcher instead of a local engine, so
+                # concurrent client processes share one device batch.
+                from trivy_tpu.rpc.client import RemoteSecretEngine
+
+                if not self._server_addr:
+                    raise ValueError(
+                        "--secret-backend server requires --server"
+                    )
+                self._engine = RemoteSecretEngine(
+                    self._server_addr,
+                    token=self._server_token,
+                    timeout_s=self._timeout_s,
+                )
+            elif self._backend == "cpu":
                 from trivy_tpu.engine.oracle import OracleScanner
 
                 self._engine = OracleScanner(config=config)

@@ -94,6 +94,16 @@ def _int_default(name: str, default: int) -> int:
         ) from e
 
 
+def _float_default(name: str, default: float) -> float:
+    val = _env_default(name, default)
+    try:
+        return float(val)
+    except (TypeError, ValueError) as e:
+        raise ConfigFileError(
+            f"{name} must be a number, got {val!r} (env/config)"
+        ) from e
+
+
 def _bool_default(name: str, default: bool = False) -> bool:
     val = _env_default(name, default)
     if isinstance(val, bool):
@@ -159,12 +169,14 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
     )
     p.add_argument(
         "--secret-backend",
-        choices=["auto", "hybrid", "tpu", "cpu", "native"],
+        choices=["auto", "hybrid", "tpu", "cpu", "native", "server"],
         default=_env_default("secret-backend", "auto"),
         help="auto = hybrid when the native sieve builds else device engine, "
         "hybrid = C++ host pre-sieve + confirm, tpu = device sieve engine, "
         "native = C++ host sieve via the device engine flow, "
-        "cpu = oracle engine",
+        "cpu = oracle engine, "
+        "server = ship raw items to the scan server's continuous "
+        "cross-request batcher (requires --server)",
     )
     p.add_argument("--ignorefile", default=_env_default("ignorefile", ".trivyignore"))
     p.add_argument(
@@ -552,6 +564,28 @@ def build_parser() -> argparse.ArgumentParser:
     p_server.add_argument("--cache-dir", default="")
     p_server.add_argument("--token", default="")
     p_server.add_argument("--db-dir", default="")
+    # Continuous cross-request batcher knobs (trivy_tpu/serve/); each binds
+    # TRIVY_TPU_<FLAG> like every other flag.
+    p_server.add_argument(
+        "--batch-window-ms", type=float,
+        default=_float_default("batch-window-ms", 4.0),
+        help="fill-or-timeout coalescing window for the secret batcher",
+    )
+    p_server.add_argument(
+        "--max-batch-bytes", type=int,
+        default=_int_default("max-batch-bytes", 8 << 20),
+        help="dispatch a batch early once its payload reaches this size",
+    )
+    p_server.add_argument(
+        "--max-queue-depth", type=int,
+        default=_int_default("max-queue-depth", 256),
+        help="admission queue bound; beyond it requests get 429 + Retry-After",
+    )
+    p_server.add_argument(
+        "--max-inflight-per-client", type=int,
+        default=_int_default("max-inflight-per-client", 8),
+        help="per-client in-flight ticket cap (fairness under load)",
+    )
 
     sub.add_parser("version", help="print version")
 
@@ -682,12 +716,19 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.command == "server":
         from trivy_tpu.rpc.server import serve
+        from trivy_tpu.serve import ServeConfig
 
         serve(
             args.listen,
             cache_dir=args.cache_dir,
             token=args.token,
             db_dir=args.db_dir,
+            serve_config=ServeConfig(
+                batch_window_ms=args.batch_window_ms,
+                max_batch_bytes=args.max_batch_bytes,
+                max_queue_depth=args.max_queue_depth,
+                max_inflight_per_client=args.max_inflight_per_client,
+            ),
         )
         return 0
 

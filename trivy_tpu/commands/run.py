@@ -199,7 +199,11 @@ def _analyzer_options(options: Options, target_kind: str) -> AnalyzerOptions:
     return AnalyzerOptions(
         disabled_analyzers=disabled,
         secret_scanner_option=SecretScannerOption(
-            config_path=options.secret_config, backend=options.secret_backend
+            config_path=options.secret_config,
+            backend=options.secret_backend,
+            server_addr=options.server_addr,
+            server_token=options.token,
+            timeout_s=options.timeout,
         ),
         file_patterns=_parse_file_patterns(options.file_patterns),
         extra_analyzers=extra,
@@ -275,7 +279,10 @@ def _build_scanner(options: Options, target_kind: str, cache: ArtifactCache) -> 
     if options.server_addr:
         from trivy_tpu.rpc.client import RemoteDriver
 
-        driver = RemoteDriver(options.server_addr, options.token, wire=options.server_wire)
+        driver = RemoteDriver(
+            options.server_addr, options.token, wire=options.server_wire,
+            timeout_s=options.timeout,
+        )
     else:
         driver = LocalDriver(cache, vuln_detector=_init_vuln_scanner(options))
     return Scanner(artifact=artifact, driver=driver)

@@ -27,6 +27,19 @@ def set_deadline(seconds: float | None) -> None:
     _local.at = (time.monotonic() + seconds) if seconds and seconds > 0 else None
 
 
+def set_deadline_at(at: float | None) -> None:
+    """Arm an absolute time.monotonic() deadline.  The serve scheduler uses
+    this to re-arm the engine-owner thread from ticket deadlines computed on
+    request threads."""
+    _local.at = at
+
+
+def remaining() -> float | None:
+    """Seconds until the armed deadline (negative if past), None if unarmed."""
+    at = getattr(_local, "at", None)
+    return None if at is None else at - time.monotonic()
+
+
 def clear() -> None:
     _local.at = None
 

@@ -1,31 +1,59 @@
-"""RPC client: remote scan driver + remote cache.
+"""RPC client: remote scan driver + remote cache + remote secret engine.
 
 Mirrors pkg/rpc/client/client.go (Scanner with custom headers) and
 pkg/cache/remote.go (RemoteCache), with retry/exponential backoff like
-pkg/rpc/retry.go.
+pkg/rpc/retry.go.  The retry loop speaks the server's backpressure
+protocol: 429/503 responses (the serve scheduler's admission rejections)
+are retried with jittered exponential backoff floored by the server's
+Retry-After hint; other 4xx are deterministic and never retried.
 """
 
 from __future__ import annotations
 
+import base64
 import json
+import random
 import time
 import urllib.error
 import urllib.request
 from dataclasses import dataclass, field
 from typing import Iterable
 
-from trivy_tpu.atypes import ArtifactInfo, BlobInfo
+from trivy_tpu.atypes import ArtifactInfo, BlobInfo, _secret_from_json
 from trivy_tpu.cache.store import ArtifactCache
+from trivy_tpu.ftypes import Secret
 from trivy_tpu.rpc.convert import blob_to_json, os_from_json, result_from_json
 from trivy_tpu.rpc.server import TOKEN_HEADER
 from trivy_tpu.scanner.service import Driver, ScanOptions
 
-MAX_RETRIES = 3
+MAX_RETRIES = 4
 BACKOFF_BASE_S = 0.2
+BACKOFF_CAP_S = 8.0
 
 
 class RpcError(RuntimeError):
     pass
+
+
+def _parse_retry_after(value: str | None) -> float | None:
+    """Seconds form of Retry-After (the only form the server emits)."""
+    if not value:
+        return None
+    try:
+        return max(0.0, float(value))
+    except ValueError:
+        return None
+
+
+def _backoff_s(attempt: int, retry_after: float | None) -> float:
+    """Jittered exponential backoff (retry.go semantics): full jitter in
+    [0.5x, 1.5x) of the capped exponential step, floored by the server's
+    Retry-After hint so a 429's advice is never undercut."""
+    delay = min(BACKOFF_CAP_S, BACKOFF_BASE_S * (2**attempt))
+    delay *= 0.5 + random.random()
+    if retry_after is not None:
+        delay = max(delay, retry_after)
+    return delay
 
 
 @dataclass
@@ -37,6 +65,9 @@ class RpcClient:
     # protobuf wire is byte-compatible with the reference's Go client
     # (rpc/{scanner,cache}/service.proto field numbers).
     wire: str = "json"
+    max_retries: int = MAX_RETRIES
+    timeout_s: float = 300.0  # per-attempt socket timeout
+    sleep = staticmethod(time.sleep)  # test seam
 
     def call(self, path: str, payload: dict) -> dict:
         # Accept both bare "host:port" and full "http(s)://host:port" forms
@@ -56,7 +87,8 @@ class RpcClient:
             body = json.dumps(payload).encode()
             ctype = "application/json"
         last: Exception | None = None
-        for attempt in range(MAX_RETRIES):
+        attempts = max(1, self.max_retries)
+        for attempt in range(attempts):
             req = urllib.request.Request(
                 url, data=body, headers={"Content-Type": ctype}
             )
@@ -64,8 +96,9 @@ class RpcClient:
                 req.add_header(TOKEN_HEADER, self.token)
             for k, v in self.headers.items():
                 req.add_header(k, v)
+            retry_after: float | None = None
             try:
-                with urllib.request.urlopen(req, timeout=300) as resp:
+                with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
                     raw = resp.read()
                     if self.wire == "protobuf":
                         from trivy_tpu.rpc import protowire
@@ -73,13 +106,47 @@ class RpcClient:
                         return protowire.decode_response(path, raw)
                     return json.loads(raw)
             except urllib.error.HTTPError as e:
-                if 400 <= e.code < 500:  # deterministic; non-retryable
+                if e.code in (429, 503):
+                    # Backpressure (queue full / client cap / draining):
+                    # retryable, honoring the server's Retry-After floor.
+                    retry_after = _parse_retry_after(
+                        e.headers.get("Retry-After")
+                    )
+                    last = RpcError(f"{path}: HTTP {e.code}: {e.read()!r}")
+                elif 400 <= e.code < 500:  # deterministic; non-retryable
                     raise RpcError(f"{path}: HTTP {e.code}: {e.read()!r}") from e
-                last = e
+                else:
+                    last = e
             except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
+                # Connection reset / refused / truncated body: retryable.
                 last = e
-            time.sleep(BACKOFF_BASE_S * (2**attempt))
-        raise RpcError(f"{path}: retries exhausted: {last}")
+            if attempt + 1 < attempts:
+                self.sleep(_backoff_s(attempt, retry_after))
+        raise RpcError(
+            f"{path}: retries exhausted after {attempts} attempts: {last}"
+        )
+
+    def scan_secrets(
+        self,
+        items: list[tuple[str, bytes]],
+        target: str = "",
+        timeout_ms: int | None = None,
+        client_id: str = "",
+    ) -> dict:
+        """POST raw (path, blob) items to the server's continuous batcher
+        (Scanner/ScanSecrets).  JSON-only: contents travel base64."""
+        payload: dict = {
+            "Target": target,
+            "Files": [
+                {"Path": p, "ContentB64": base64.b64encode(c).decode()}
+                for p, c in items
+            ],
+        }
+        if timeout_ms:
+            payload["TimeoutMs"] = int(timeout_ms)
+        if client_id:
+            payload["ClientID"] = client_id
+        return self.call("/twirp/trivy.scanner.v1.Scanner/ScanSecrets", payload)
 
 
 @dataclass
@@ -89,24 +156,76 @@ class RemoteDriver(Driver):
     addr: str
     token: str = ""
     wire: str = "json"  # or "protobuf" (reference Go client wire)
+    # Client --timeout forwarded so the SERVER arms the same deadline
+    # (rpc/server.py _arm_deadline): a server-side scan is bounded even
+    # when the client dies mid-request.  0 = unbounded (legacy).
+    timeout_s: float = 0.0
 
     def scan(self, target, artifact_id, blob_ids, options: ScanOptions):
         client = RpcClient(self.addr, self.token, wire=self.wire)
-        resp = client.call(
-            "/twirp/trivy.scanner.v1.Scanner/Scan",
-            {
-                "Target": target,
-                "ArtifactID": artifact_id,
-                "BlobIDs": list(blob_ids),
-                "Options": {
-                    "Scanners": list(options.scanners),
-                    "PkgTypes": list(options.pkg_types),
-                    "ListAllPackages": options.list_all_packages,
-                },
+        payload = {
+            "Target": target,
+            "ArtifactID": artifact_id,
+            "BlobIDs": list(blob_ids),
+            "Options": {
+                "Scanners": list(options.scanners),
+                "PkgTypes": list(options.pkg_types),
+                "ListAllPackages": options.list_all_packages,
             },
-        )
+        }
+        if self.timeout_s and self.timeout_s > 0:
+            payload["TimeoutMs"] = int(self.timeout_s * 1000)
+        resp = client.call("/twirp/trivy.scanner.v1.Scanner/Scan", payload)
         results = [result_from_json(r) for r in (resp.get("Results") or [])]
         return results, os_from_json(resp.get("OS"))
+
+
+class RemoteSecretEngine:
+    """The secret-engine seat over the wire (--secret-backend server).
+
+    Drop-in for the analyzer's engine protocol (scan_batch/scan): raw
+    (path, blob) items ship to the server's continuous batcher, where they
+    coalesce with items from OTHER client processes into one device batch.
+    This is the sidecar deployment the server docstring promises — many
+    thin scanning clients, one TPU-owning engine process.
+
+    No local ruleset is loaded, so the analyzer's client-side allow-path
+    pre-skip is a no-op; the server engine applies the same gate inside
+    scan_batch, and empty results are filtered identically — findings stay
+    byte-identical to a local engine.
+    """
+
+    def __init__(
+        self,
+        addr: str,
+        token: str = "",
+        timeout_s: float = 0.0,
+        client_id: str = "",
+    ):
+        self.client = RpcClient(addr, token)
+        self.timeout_s = timeout_s
+        self.client_id = client_id
+
+    def scan_batch(self, items: list[tuple[str, bytes]]) -> list[Secret]:
+        if not items:
+            return []
+        resp = self.client.scan_secrets(
+            items,
+            timeout_ms=int(self.timeout_s * 1000) if self.timeout_s else None,
+            client_id=self.client_id,
+        )
+        secrets = [
+            _secret_from_json(d) for d in (resp.get("Secrets") or [])
+        ]
+        if len(secrets) != len(items):
+            raise RpcError(
+                f"ScanSecrets returned {len(secrets)} results for "
+                f"{len(items)} items"
+            )
+        return secrets
+
+    def scan(self, path: str, content: bytes) -> Secret:
+        return self.scan_batch([(path, content)])[0]
 
 
 class RemoteCache(ArtifactCache):

@@ -8,31 +8,51 @@ and detectors (and owns the TPU mesh in sidecar deployments).
 
 Endpoints (POST, JSON bodies):
   /twirp/trivy.scanner.v1.Scanner/Scan
-      {Target, ArtifactID, BlobIDs, Options{Scanners}} -> {OS, Results}
+      {Target, ArtifactID, BlobIDs, Options{Scanners}, TimeoutMs?}
+      -> {OS, Results}
+  /twirp/trivy.scanner.v1.Scanner/ScanSecrets
+      {Target?, Files:[{Path, ContentB64}], TimeoutMs?, ClientID?}
+      -> {Results, Secrets}
   /twirp/trivy.cache.v1.Cache/PutArtifact   {ArtifactID, ArtifactInfo}
   /twirp/trivy.cache.v1.Cache/PutBlob       {BlobID, BlobInfo}
   /twirp/trivy.cache.v1.Cache/MissingBlobs  {ArtifactID, BlobIDs}
                                             -> {MissingArtifact, MissingBlobIDs}
   /twirp/trivy.cache.v1.Cache/DeleteBlobs   {BlobIDs}
+
+ScanSecrets is the TPU-sidecar seat: requests carry raw (path, blob) items,
+and the server's continuous cross-request batcher (trivy_tpu/serve/)
+coalesces items from CONCURRENT requests into one device batch under a
+fill-or-timeout window before they board the engine.  Backpressure is
+admission-level: a full queue or an over-cap client gets HTTP 429 with
+Retry-After, a draining server gets 503, and an expired request deadline
+gets a clean 408 JSON error.
 """
 
 from __future__ import annotations
 
+import base64
+import binascii
 import hmac
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from trivy_tpu import __version__
-from trivy_tpu.atypes import ArtifactInfo
+from trivy_tpu import __version__, deadline
+from trivy_tpu.atypes import ArtifactInfo, _secret_to_json
 from trivy_tpu.cache.store import (
     ArtifactCache,
     BlobNotFoundError,
     FSCache,
     MemoryCache,
 )
+from trivy_tpu.deadline import ScanTimeoutError
 from trivy_tpu.rpc.convert import blob_from_json, os_to_json, result_to_json
-from trivy_tpu.scanner.service import LocalDriver, ScanOptions
+from trivy_tpu.scanner.service import (
+    LocalDriver,
+    ScanOptions,
+    secrets_to_results,
+)
+from trivy_tpu.serve import AdmissionError, BatchScheduler, ServeConfig
 
 TOKEN_HEADER = "Trivy-Tpu-Token"
 
@@ -43,17 +63,24 @@ class _Metrics:
     same pull-based way)."""
 
     def __init__(self) -> None:
-        import threading
-
         self._lock = threading.Lock()
         self.requests: dict[tuple[str, str], int] = {}  # (method, code) -> n
         self.seconds: dict[str, float] = {}  # method -> total latency
+        self.inflight = 0  # RPC requests currently in a handler
 
     def observe(self, method: str, code: int, elapsed: float) -> None:
         with self._lock:
             key = (method, str(code))
             self.requests[key] = self.requests.get(key, 0) + 1
             self.seconds[method] = self.seconds.get(method, 0.0) + elapsed
+
+    def enter(self) -> None:
+        with self._lock:
+            self.inflight += 1
+
+    def exit(self) -> None:
+        with self._lock:
+            self.inflight -= 1
 
     def render(self) -> str:
         with self._lock:
@@ -73,15 +100,31 @@ class _Metrics:
                 lines.append(
                     f'trivy_tpu_request_seconds_total{{method="{method}"}} {secs:.6f}'
                 )
+            lines += [
+                "# HELP trivy_tpu_inflight_requests RPC requests currently being handled",
+                "# TYPE trivy_tpu_inflight_requests gauge",
+                f"trivy_tpu_inflight_requests {self.inflight}",
+            ]
             return "\n".join(lines) + "\n"
 
 
+def _default_engine_factory():
+    """Engine for the serve scheduler, built lazily ON the engine-owner
+    thread at first dispatch (a HybridSecretEngine probes the device link at
+    construction — server startup and cache-only traffic must not pay it)."""
+    from trivy_tpu.engine.hybrid import make_secret_engine
+
+    return make_secret_engine(backend="auto")
+
+
 class ScanServer:
-    """pkg/rpc/server Server: scanner + cache services over one cache."""
+    """pkg/rpc/server Server: scanner + cache services over one cache, plus
+    the continuous cross-request batcher for raw secret payloads."""
 
     def __init__(
         self, cache: ArtifactCache, token: str = "", db_dir: str = "",
-        cache_dir: str = "",
+        cache_dir: str = "", serve_config: ServeConfig | None = None,
+        secret_engine_factory=None,
     ):
         from trivy_tpu.scanner.vuln import init_vuln_scanner
 
@@ -91,8 +134,26 @@ class ScanServer:
         self.driver = LocalDriver(
             cache, vuln_detector=init_vuln_scanner(db_dir, cache_dir)
         )
+        self.serve_config = serve_config or ServeConfig()
+        self.scheduler = BatchScheduler(
+            secret_engine_factory or _default_engine_factory,
+            self.serve_config,
+        )
+        self.draining = False  # SIGTERM: reject new work with 503
 
     # -- service methods ------------------------------------------------
+
+    @staticmethod
+    def _arm_deadline(req: dict) -> bool:
+        """Server-side --timeout seat: the request's TimeoutMs arms the
+        handler thread's deadline, so a server-side scan can no longer run
+        unbounded (expiry surfaces as a 408 JSON error, not a hung
+        connection)."""
+        timeout_ms = req.get("TimeoutMs")
+        if not timeout_ms:
+            return False
+        deadline.set_deadline(float(timeout_ms) / 1000.0)
+        return True
 
     def scan(self, req: dict) -> dict:
         opts = req.get("Options") or {}
@@ -101,15 +162,63 @@ class ScanServer:
             pkg_types=list(opts.get("PkgTypes") or ["os", "library"]),
             list_all_packages=bool(opts.get("ListAllPackages")),
         )
-        results, detected_os = self.driver.scan(
-            req.get("Target", ""),
-            req.get("ArtifactID", ""),
-            list(req.get("BlobIDs") or []),
-            options,
-        )
+        armed = self._arm_deadline(req)
+        try:
+            results, detected_os = self.driver.scan(
+                req.get("Target", ""),
+                req.get("ArtifactID", ""),
+                list(req.get("BlobIDs") or []),
+                options,
+            )
+        finally:
+            if armed:
+                deadline.clear()
         return {
             "OS": os_to_json(detected_os),
             "Results": [result_to_json(r) for r in results],
+        }
+
+    def scan_secrets(self, req: dict) -> dict:
+        """The batched raw-bytes path: decode items, submit one ticket to
+        the scheduler, block on the demuxed future.  The handler thread
+        only waits; the engine runs on the scheduler's owner thread where
+        items from concurrent requests share one device batch."""
+        items: list[tuple[str, bytes]] = []
+        for f in req.get("Files") or []:
+            try:
+                content = base64.b64decode(f.get("ContentB64", "") or "")
+            except (binascii.Error, ValueError) as e:
+                raise ValueError(f"bad ContentB64: {e}") from e
+            items.append((f.get("Path", ""), content))
+        timeout_ms = req.get("TimeoutMs")
+        timeout_s = float(timeout_ms) / 1000.0 if timeout_ms else None
+        fut = self.scheduler.submit(
+            items,
+            client_id=str(req.get("ClientID") or req.get("_client") or ""),
+            timeout_s=timeout_s,
+        )
+        # Deadline-armed requests never hang the connection: even a wedged
+        # engine bounds the wait (the slack covers a dispatched batch that
+        # finishes just past the ticket deadline).
+        if timeout_s is not None:
+            from concurrent.futures import TimeoutError as _FutTimeout
+
+            try:
+                secrets = fut.result(timeout=timeout_s + 30.0)
+            except _FutTimeout:
+                raise ScanTimeoutError(
+                    "scan deadline exceeded waiting for batch"
+                ) from None
+        else:
+            secrets = fut.result()
+        return {
+            "Results": [
+                result_to_json(r)
+                for r in secrets_to_results(
+                    [s for s in secrets if s.findings]
+                )
+            ],
+            "Secrets": [_secret_to_json(s) for s in secrets],
         }
 
     def put_artifact(self, req: dict) -> dict:
@@ -135,6 +244,7 @@ class ScanServer:
 
 _ROUTES = {
     "/twirp/trivy.scanner.v1.Scanner/Scan": "scan",
+    "/twirp/trivy.scanner.v1.Scanner/ScanSecrets": "scan_secrets",
     "/twirp/trivy.cache.v1.Cache/PutArtifact": "put_artifact",
     "/twirp/trivy.cache.v1.Cache/PutBlob": "put_blob",
     "/twirp/trivy.cache.v1.Cache/MissingBlobs": "missing_blobs",
@@ -149,11 +259,16 @@ def _make_handler(server: ScanServer):
         def log_message(self, *args):  # quiet
             pass
 
-        def _send(self, code: int, payload: dict) -> None:
+        def _send(
+            self, code: int, payload: dict,
+            headers: dict[str, str] | None = None,
+        ) -> None:
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -168,7 +283,10 @@ def _make_handler(server: ScanServer):
             elif self.path == "/version":
                 self._send(200, {"Version": __version__})
             elif self.path == "/metrics":
-                body = server.metrics.render().encode()
+                body = (
+                    server.metrics.render()
+                    + server.scheduler.metrics_text()
+                ).encode()
                 self.send_response(200)
                 self.send_header(
                     "Content-Type", "text/plain; version=0.0.4"
@@ -180,6 +298,13 @@ def _make_handler(server: ScanServer):
                 self._send(404, {"error": "not found"})
 
         def do_POST(self):
+            server.metrics.enter()
+            try:
+                self._do_POST()
+            finally:
+                server.metrics.exit()
+
+        def _do_POST(self):
             import time as _time
 
             # Always drain the body first: HTTP/1.1 keep-alive connections
@@ -189,14 +314,17 @@ def _make_handler(server: ScanServer):
             method = _ROUTES.get(self.path)
             start = _time.monotonic()
 
-            def send(code: int, payload: dict) -> None:
+            def send(
+                code: int, payload: dict,
+                headers: dict[str, str] | None = None,
+            ) -> None:
                 # Known method names only: raw request paths would let an
                 # unauthenticated client inject label characters and grow
                 # the counter map without bound.
                 server.metrics.observe(
                     method or "unknown", code, _time.monotonic() - start
                 )
-                self._send(code, payload)
+                self._send(code, payload, headers)
 
             if server.token and not hmac.compare_digest(
                 self.headers.get(TOKEN_HEADER, "").encode("utf-8", "replace"),
@@ -206,6 +334,14 @@ def _make_handler(server: ScanServer):
                 return
             if method is None:
                 send(404, {"error": f"no such rpc: {self.path}"})
+                return
+            if server.draining:
+                # SIGTERM drain: stop admitting new work; in-flight batches
+                # finish before the process exits.
+                send(
+                    503, {"error": "server draining"},
+                    {"Retry-After": "5"},
+                )
                 return
             # Twirp wire negotiation: protobuf requests get protobuf
             # responses (the reference Go client's default); everything
@@ -218,6 +354,9 @@ def _make_handler(server: ScanServer):
                 if proto_mode:
                     from trivy_tpu.rpc import protowire
 
+                    if method == "scan_secrets":
+                        send(415, {"error": "ScanSecrets is JSON-only"})
+                        return
                     if not protowire.available():
                         send(415, {"error": "protobuf wire unavailable"})
                         return
@@ -234,7 +373,24 @@ def _make_handler(server: ScanServer):
                     self.wfile.write(data)
                     return
                 req = json.loads(raw or b"{}")
+                if method == "scan_secrets" and "_client" not in req:
+                    # Per-client in-flight caps key on the explicit ClientID
+                    # when sent, else the peer address.
+                    req["_client"] = self.client_address[0]
                 send(200, getattr(server, method)(req))
+            except AdmissionError as e:
+                # Backpressure: full queue / over-cap client -> 429, a
+                # draining scheduler -> 503; both carry Retry-After so the
+                # client backoff has a server-informed floor.
+                from trivy_tpu.serve import SchedulerClosedError
+
+                code = 503 if isinstance(e, SchedulerClosedError) else 429
+                send(
+                    code, {"error": str(e)},
+                    {"Retry-After": str(max(1, int(e.retry_after_s)))},
+                )
+            except ScanTimeoutError as e:
+                send(408, {"error": str(e)})  # clean JSON, not a hang
             except BlobNotFoundError as e:
                 send(422, {"error": str(e)})  # deterministic; don't retry
             except (KeyError, json.JSONDecodeError) as e:
@@ -256,34 +412,75 @@ def make_http_server(
     token: str = "",
     db_dir: str = "",
     cache_dir: str = "",
+    serve_config: ServeConfig | None = None,
+    secret_engine_factory=None,
 ) -> ThreadingHTTPServer:
     host, _, port = addr.rpartition(":")
-    httpd = ThreadingHTTPServer(
-        (host or "localhost", int(port)),
-        _make_handler(ScanServer(cache, token, db_dir, cache_dir)),
+    scan_server = ScanServer(
+        cache, token, db_dir, cache_dir,
+        serve_config=serve_config,
+        secret_engine_factory=secret_engine_factory,
     )
+    httpd = ThreadingHTTPServer(
+        (host or "localhost", int(port)), _make_handler(scan_server)
+    )
+    httpd.scan_server = scan_server  # tests/serve() reach the scheduler
     return httpd
 
 
-def serve(addr: str, cache_dir: str = "", token: str = "", db_dir: str = "") -> None:
-    """pkg/rpc/server/listen.go ListenAndServe."""
+def serve(
+    addr: str,
+    cache_dir: str = "",
+    token: str = "",
+    db_dir: str = "",
+    serve_config: ServeConfig | None = None,
+) -> None:
+    """pkg/rpc/server/listen.go ListenAndServe, with graceful SIGTERM
+    drain: stop admitting (503 + Retry-After), finish the batches already
+    queued in the scheduler, then exit."""
+    import signal
+
     cache = FSCache(cache_dir) if cache_dir else MemoryCache()
-    httpd = make_http_server(addr, cache, token, db_dir, cache_dir)
+    httpd = make_http_server(
+        addr, cache, token, db_dir, cache_dir, serve_config=serve_config
+    )
+    scan_server: ScanServer = httpd.scan_server
+
+    def _drain_and_stop() -> None:
+        scan_server.draining = True
+        scan_server.scheduler.drain(timeout=60.0)
+        httpd.shutdown()
+
+    def _on_sigterm(signum, frame) -> None:
+        # serve_forever runs on this thread; shutdown() must come from
+        # another one or it deadlocks.
+        threading.Thread(target=_drain_and_stop, daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        pass  # not the main thread (embedded); drain is the caller's job
     print(f"trivy-tpu server listening on {httpd.server_address[0]}:{httpd.server_address[1]}")
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
+        scan_server.scheduler.close()
         httpd.server_close()
 
 
 def start_background(
-    addr: str, cache: ArtifactCache, token: str = "", db_dir: str = ""
+    addr: str, cache: ArtifactCache, token: str = "", db_dir: str = "",
+    serve_config: ServeConfig | None = None, secret_engine_factory=None,
 ) -> tuple[ThreadingHTTPServer, threading.Thread]:
     """In-process server for tests (the §4 'multi-node without a cluster'
     pattern: integration_test.go:77-103 binds a real server on a free port)."""
-    httpd = make_http_server(addr, cache, token, db_dir)
+    httpd = make_http_server(
+        addr, cache, token, db_dir,
+        serve_config=serve_config,
+        secret_engine_factory=secret_engine_factory,
+    )
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()
     return httpd, t

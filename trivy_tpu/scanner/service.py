@@ -38,6 +38,23 @@ class ScanOptions:
     list_all_packages: bool = False
 
 
+def secrets_to_results(secrets) -> list[Result]:
+    """local/scan.go:263-281 secretsToResults — one Result per file.
+
+    Module-level so the serve path (rpc/server.py ScanSecrets, fed by the
+    cross-request batcher) shapes its response through the SAME function the
+    local driver uses: parity between batched-across-requests and sequential
+    output is then a property of the engine, not of two converters."""
+    return [
+        Result(
+            target=secret.file_path,
+            result_class=ResultClass.SECRET,
+            secrets=list(secret.findings),
+        )
+        for secret in secrets
+    ]
+
+
 class Driver:
     """scanner.Driver (scan.go:131-134) — the local-vs-remote seam."""
 
@@ -59,9 +76,13 @@ class LocalDriver(Driver):
     vuln_detector: object | None = None  # wired in when detectors land
 
     def scan(self, target, artifact_id, blob_ids, options):
+        from trivy_tpu import deadline
+
+        deadline.check()
         detail = Applier(self.cache).apply_layers(artifact_id, blob_ids)
         results: list[Result] = []
 
+        deadline.check()
         if SCANNER_VULN in options.scanners and self.vuln_detector is not None:
             results.extend(
                 self.vuln_detector.detect(target, detail, options)  # type: ignore[attr-defined]
@@ -114,16 +135,7 @@ class LocalDriver(Driver):
     @staticmethod
     def _secrets_to_results(detail) -> list[Result]:
         """local/scan.go:263-281 secretsToResults — one Result per file."""
-        out = []
-        for secret in detail.secrets:
-            out.append(
-                Result(
-                    target=secret.file_path,
-                    result_class=ResultClass.SECRET,
-                    secrets=list(secret.findings),
-                )
-            )
-        return out
+        return secrets_to_results(detail.secrets)
 
     @staticmethod
     def _licenses_to_results(detail) -> list[Result]:

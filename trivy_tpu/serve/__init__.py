@@ -1,0 +1,30 @@
+"""trivy_tpu.serve — continuous cross-request batching for the secret engine.
+
+The serving shape (request queue -> continuous batcher -> device engine ->
+demux) that turns the chunk pipeline's per-scan overlap into a traffic-scale
+optimization: concurrent Scan requests coalesce into one device batch under a
+fill-or-timeout window, exactly the Orca/vLLM-style micro-batching used by
+inference servers.  See scheduler.py for the engine-owner model.
+"""
+
+from trivy_tpu.serve.scheduler import (
+    AdmissionError,
+    BatchScheduler,
+    ClientOverloadedError,
+    QueueFullError,
+    SchedulerClosedError,
+    SchedulerStats,
+    ServeConfig,
+    Ticket,
+)
+
+__all__ = [
+    "AdmissionError",
+    "BatchScheduler",
+    "ClientOverloadedError",
+    "QueueFullError",
+    "SchedulerClosedError",
+    "SchedulerStats",
+    "ServeConfig",
+    "Ticket",
+]

@@ -1,0 +1,381 @@
+"""Continuous cross-request batching scheduler (the Orca/vLLM serving shape).
+
+The thread-per-request server sizes device batches by whatever one client
+sent: a request with three files runs a three-file `scan_batch` while the
+engine idles between requests.  This module inverts the ownership — ONE
+engine-owner thread owns the secret engine, and concurrent requests enqueue
+their (path, blob) items as tickets into a bounded admission queue.  The
+owner thread coalesces tickets into device batches under a fill-or-timeout
+window (the first ticket opens the window; the batch dispatches when either
+`max_batch_bytes` fills or `batch_window_ms` elapses), feeds the combined
+item list through the existing `HybridSecretEngine.scan_batch` /
+`ChunkPipeline` path, and demultiplexes per-item results back onto
+per-ticket futures.  Findings are byte-identical to the unbatched path:
+`scan_batch` results are per-item and batch-composition-independent (the
+chunk/dedupe parity the engine tests pin down).
+
+Admission control is where backpressure lives, not in the engine:
+
+  - bounded queue depth        -> QueueFullError        (HTTP 429)
+  - per-client in-flight caps  -> ClientOverloadedError (HTTP 429)
+  - draining/closed            -> SchedulerClosedError  (HTTP 503)
+
+Ordering is fair FIFO by arrival; the per-client cap keeps one aggressive
+client from occupying the whole window.  Tickets carry their request's
+absolute deadline: tickets that expire while queued are cancelled before
+dispatch (their future raises ScanTimeoutError), and a dispatching batch
+arms the engine-owner thread's deadline (trivy_tpu/deadline.py) to the
+LATEST ticket deadline — if that fires mid-batch, every ticket's deadline
+has already passed, so failing the whole batch is sound.
+
+Graceful drain: `drain()` stops admission (new submits raise
+SchedulerClosedError) and lets the owner thread finish everything already
+queued; `close()` additionally aborts anything still stuck so no waiter
+hangs on a wedged engine.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+
+from trivy_tpu import deadline as _deadline
+from trivy_tpu.deadline import ScanTimeoutError
+
+
+class AdmissionError(RuntimeError):
+    """Base for admission rejections; carries the Retry-After hint."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class QueueFullError(AdmissionError):
+    """Admission queue at max_queue_depth (HTTP 429)."""
+
+
+class ClientOverloadedError(AdmissionError):
+    """Client at its in-flight ticket cap (HTTP 429)."""
+
+
+class SchedulerClosedError(AdmissionError):
+    """Scheduler draining or shut down (HTTP 503)."""
+
+
+@dataclass
+class ServeConfig:
+    """Knobs, CLI-exposed as `server --batch-window-ms` etc. (env vars
+    TRIVY_TPU_BATCH_WINDOW_MS and friends via the cli env binding)."""
+
+    batch_window_ms: float = 4.0  # fill-or-timeout window
+    max_batch_bytes: int = 8 << 20  # dispatch early once this fills
+    max_queue_depth: int = 256  # tickets; beyond -> 429
+    max_inflight_per_client: int = 8  # queued+dispatching per client
+    retry_after_s: float = 1.0  # backpressure hint on 429/503
+
+
+@dataclass
+class Ticket:
+    """One request's admission into the batcher."""
+
+    items: list  # [(path, bytes)]
+    client_id: str
+    deadline_at: float | None  # absolute time.monotonic(), None = unbounded
+    future: Future
+    nbytes: int
+    enqueued_at: float
+
+
+@dataclass
+class SchedulerStats:
+    """Counters the /metrics endpoint exposes (all monotonic except the
+    live gauges read off the scheduler itself)."""
+
+    admitted: int = 0
+    rejected_full: int = 0
+    rejected_client: int = 0
+    rejected_closed: int = 0
+    expired: int = 0  # cancelled before dispatch
+    batches: int = 0
+    multi_request_batches: int = 0  # batches coalescing >= 2 tickets
+    coalesced_requests: int = 0  # sum of tickets per batch
+    items: int = 0
+    bytes: int = 0
+    fill_ratio_sum: float = 0.0  # sum over batches of bytes/max_batch_bytes
+    wait_s_sum: float = 0.0  # enqueue -> dispatch, summed over tickets
+    errors: int = 0  # batches failed by an engine exception
+
+
+class BatchScheduler:
+    """Single engine-owner thread + bounded admission queue.
+
+    `engine_factory` is called lazily on the owner thread at first dispatch
+    (building a HybridSecretEngine measures the device link — server startup
+    and non-secret traffic must not pay that).  The engine only ever runs on
+    the owner thread, so engines need no internal locking.
+    """
+
+    def __init__(self, engine_factory, config: ServeConfig | None = None):
+        self.config = config or ServeConfig()
+        self._engine_factory = engine_factory
+        self._engine = None
+        self._q: deque[Ticket] = deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._inflight: dict[str, int] = {}
+        self._admitting = True
+        self._thread: threading.Thread | None = None
+        self.stats = SchedulerStats()
+
+    # -- admission (request threads) ------------------------------------
+
+    def submit(
+        self,
+        items: list[tuple[str, bytes]],
+        client_id: str = "",
+        timeout_s: float | None = None,
+    ) -> Future:
+        """Enqueue one request's items; returns a Future resolving to the
+        per-item list[Secret].  Raises AdmissionError subclasses instead of
+        queuing when backpressure applies."""
+        cfg = self.config
+        now = time.monotonic()
+        ticket = Ticket(
+            items=list(items),
+            client_id=client_id or "-",
+            deadline_at=(now + timeout_s)
+            if timeout_s is not None and timeout_s > 0
+            else None,
+            future=Future(),
+            nbytes=sum(len(c) for _, c in items),
+            enqueued_at=now,
+        )
+        with self._not_empty:
+            if not self._admitting:
+                self.stats.rejected_closed += 1
+                raise SchedulerClosedError(
+                    "scheduler draining", cfg.retry_after_s
+                )
+            if len(self._q) >= cfg.max_queue_depth:
+                self.stats.rejected_full += 1
+                raise QueueFullError(
+                    f"admission queue full ({cfg.max_queue_depth} tickets)",
+                    cfg.retry_after_s,
+                )
+            if (
+                self._inflight.get(ticket.client_id, 0)
+                >= cfg.max_inflight_per_client
+            ):
+                self.stats.rejected_client += 1
+                raise ClientOverloadedError(
+                    f"client {ticket.client_id!r} at in-flight cap "
+                    f"({cfg.max_inflight_per_client})",
+                    cfg.retry_after_s,
+                )
+            self._inflight[ticket.client_id] = (
+                self._inflight.get(ticket.client_id, 0) + 1
+            )
+            self._q.append(ticket)
+            self.stats.admitted += 1
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name="serve-batcher", daemon=True
+                )
+                self._thread.start()
+            self._not_empty.notify()
+        return ticket.future
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def inflight_tickets(self) -> int:
+        with self._lock:
+            return sum(self._inflight.values())
+
+    # -- lifecycle -------------------------------------------------------
+
+    def drain(self, timeout: float | None = None) -> None:
+        """Stop admitting (submits raise SchedulerClosedError), let the
+        owner thread finish everything queued, then join it."""
+        with self._not_empty:
+            self._admitting = False
+            self._not_empty.notify_all()
+            t = self._thread
+        if t is not None:
+            t.join(timeout)
+
+    def close(self, timeout: float = 5.0) -> None:
+        """drain(), then abort anything still queued (a wedged engine must
+        not leave request threads hung on their futures)."""
+        self.drain(timeout)
+        with self._not_empty:
+            stuck = list(self._q)
+            self._q.clear()
+        for t in stuck:
+            t.future.set_exception(
+                SchedulerClosedError("scheduler shut down")
+            )
+            self._release(t)
+
+    # -- engine-owner thread ---------------------------------------------
+
+    def _release(self, ticket: Ticket) -> None:
+        with self._lock:
+            n = self._inflight.get(ticket.client_id, 0) - 1
+            if n <= 0:
+                self._inflight.pop(ticket.client_id, None)
+            else:
+                self._inflight[ticket.client_id] = n
+
+    def _expire(self, ticket: Ticket) -> None:
+        self.stats.expired += 1
+        ticket.future.set_exception(
+            ScanTimeoutError("request deadline expired before dispatch")
+        )
+        self._release(ticket)
+
+    def _pop(self, wait_s: float | None) -> Ticket | None:
+        with self._not_empty:
+            if not self._q and wait_s is not None and wait_s > 0:
+                self._not_empty.wait(timeout=wait_s)
+            return self._q.popleft() if self._q else None
+
+    def _run(self) -> None:
+        cfg = self.config
+        window_s = max(cfg.batch_window_ms, 0.0) / 1000.0
+        while True:
+            first = self._pop(wait_s=0.1)
+            if first is None:
+                with self._lock:
+                    if not self._admitting and not self._q:
+                        return
+                continue
+            if (
+                first.deadline_at is not None
+                and time.monotonic() > first.deadline_at
+            ):
+                self._expire(first)
+                continue
+            batch = [first]
+            nbytes = first.nbytes
+            window_end = time.monotonic() + window_s
+            while nbytes < cfg.max_batch_bytes:
+                rem = window_end - time.monotonic()
+                if rem <= 0:
+                    break
+                nxt = self._pop(wait_s=rem)
+                if nxt is None:
+                    continue  # timed out or spurious wake; rem re-checks
+                if (
+                    nxt.deadline_at is not None
+                    and time.monotonic() > nxt.deadline_at
+                ):
+                    self._expire(nxt)
+                    continue
+                batch.append(nxt)
+                nbytes += nxt.nbytes
+            self._dispatch(batch, nbytes)
+
+    def _dispatch(self, batch: list[Ticket], nbytes: int) -> None:
+        t0 = time.monotonic()
+        combined: list[tuple[str, bytes]] = []
+        spans: list[tuple[int, int]] = []
+        for t in batch:
+            spans.append((len(combined), len(combined) + len(t.items)))
+            combined.extend(t.items)
+            self.stats.wait_s_sum += max(0.0, t0 - t.enqueued_at)
+        self.stats.batches += 1
+        self.stats.coalesced_requests += len(batch)
+        if len(batch) >= 2:
+            self.stats.multi_request_batches += 1
+        self.stats.items += len(combined)
+        self.stats.bytes += nbytes
+        self.stats.fill_ratio_sum += min(
+            1.0, nbytes / max(self.config.max_batch_bytes, 1)
+        )
+        # Engine deadline: the latest ticket deadline, and only when every
+        # ticket has one — if it fires, every deadline in the batch has
+        # passed, so failing the whole batch with ScanTimeoutError is sound.
+        deadlines = [t.deadline_at for t in batch]
+        if all(d is not None for d in deadlines):
+            _deadline.set_deadline_at(max(deadlines))
+        else:
+            _deadline.clear()
+        try:
+            if self._engine is None:
+                self._engine = self._engine_factory()
+            results = self._engine.scan_batch(combined)
+        except ScanTimeoutError:
+            for t in batch:
+                t.future.set_exception(
+                    ScanTimeoutError("scan deadline exceeded in batch")
+                )
+                self._release(t)
+            return
+        except BaseException as e:
+            self.stats.errors += 1
+            for t in batch:
+                t.future.set_exception(e)
+                self._release(t)
+            return
+        finally:
+            _deadline.clear()
+        for t, (lo, hi) in zip(batch, spans):
+            t.future.set_result(results[lo:hi])
+            self._release(t)
+
+    # -- observability ---------------------------------------------------
+
+    def metrics_text(self) -> str:
+        """Prometheus exposition lines for the serve subsystem (appended to
+        the server's /metrics body)."""
+        s = self.stats
+        lines = [
+            "# HELP trivy_tpu_serve_queue_depth tickets waiting for dispatch",
+            "# TYPE trivy_tpu_serve_queue_depth gauge",
+            f"trivy_tpu_serve_queue_depth {self.queue_depth()}",
+            "# HELP trivy_tpu_serve_inflight_tickets tickets admitted and unresolved",
+            "# TYPE trivy_tpu_serve_inflight_tickets gauge",
+            f"trivy_tpu_serve_inflight_tickets {self.inflight_tickets()}",
+            "# HELP trivy_tpu_serve_tickets_total admitted tickets",
+            "# TYPE trivy_tpu_serve_tickets_total counter",
+            f"trivy_tpu_serve_tickets_total {s.admitted}",
+            "# HELP trivy_tpu_serve_rejected_total admission rejections by reason",
+            "# TYPE trivy_tpu_serve_rejected_total counter",
+            f'trivy_tpu_serve_rejected_total{{reason="queue_full"}} {s.rejected_full}',
+            f'trivy_tpu_serve_rejected_total{{reason="client_cap"}} {s.rejected_client}',
+            f'trivy_tpu_serve_rejected_total{{reason="closed"}} {s.rejected_closed}',
+            "# HELP trivy_tpu_serve_expired_total tickets cancelled at their deadline before dispatch",
+            "# TYPE trivy_tpu_serve_expired_total counter",
+            f"trivy_tpu_serve_expired_total {s.expired}",
+            "# HELP trivy_tpu_serve_batches_total dispatched device batches",
+            "# TYPE trivy_tpu_serve_batches_total counter",
+            f"trivy_tpu_serve_batches_total {s.batches}",
+            "# HELP trivy_tpu_serve_multi_request_batches_total batches coalescing two or more requests",
+            "# TYPE trivy_tpu_serve_multi_request_batches_total counter",
+            f"trivy_tpu_serve_multi_request_batches_total {s.multi_request_batches}",
+            "# HELP trivy_tpu_serve_coalesced_requests_total requests summed over dispatched batches",
+            "# TYPE trivy_tpu_serve_coalesced_requests_total counter",
+            f"trivy_tpu_serve_coalesced_requests_total {s.coalesced_requests}",
+            "# HELP trivy_tpu_serve_batch_items_total items summed over dispatched batches",
+            "# TYPE trivy_tpu_serve_batch_items_total counter",
+            f"trivy_tpu_serve_batch_items_total {s.items}",
+            "# HELP trivy_tpu_serve_batch_bytes_total payload bytes summed over dispatched batches",
+            "# TYPE trivy_tpu_serve_batch_bytes_total counter",
+            f"trivy_tpu_serve_batch_bytes_total {s.bytes}",
+            "# HELP trivy_tpu_serve_batch_fill_ratio_sum per-batch bytes/max_batch_bytes, summed (divide by batches_total for the mean fill)",
+            "# TYPE trivy_tpu_serve_batch_fill_ratio_sum counter",
+            f"trivy_tpu_serve_batch_fill_ratio_sum {s.fill_ratio_sum:.6f}",
+            "# HELP trivy_tpu_serve_ticket_wait_seconds_total enqueue-to-dispatch wait, summed over tickets",
+            "# TYPE trivy_tpu_serve_ticket_wait_seconds_total counter",
+            f"trivy_tpu_serve_ticket_wait_seconds_total {s.wait_s_sum:.6f}",
+            "# HELP trivy_tpu_serve_batch_errors_total batches failed by an engine exception",
+            "# TYPE trivy_tpu_serve_batch_errors_total counter",
+            f"trivy_tpu_serve_batch_errors_total {s.errors}",
+        ]
+        return "\n".join(lines) + "\n"
