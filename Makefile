@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test smoke serve-smoke bench checks-corpus
+.PHONY: test smoke serve-smoke bench checks-corpus rules-cache
 
 # Tier-1: the suite the driver holds the repo to (fast, CPU, no slow marks).
 test:
@@ -29,6 +29,13 @@ serve-smoke:
 # Full benchmark (honest corpora; on CPU this takes a while).
 bench:
 	$(PY) bench.py
+
+# Precompile the builtin ruleset into the registry cache (trivy_tpu/registry/)
+# so every later scan/server process warm-starts without compiling rules.
+# Honors TRIVY_TPU_RULES_CACHE_DIR / TRIVY_TPU_SECRET_CONFIG.
+rules-cache:
+	JAX_PLATFORMS=cpu $(PY) -m trivy_tpu.cli rules compile && \
+		JAX_PLATFORMS=cpu $(PY) -m trivy_tpu.cli rules verify
 
 # The check corpora: every builtin IaC check and every snapshot cloud
 # check must keep a fail + pass fixture pair (the cloud corpus includes

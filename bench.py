@@ -686,6 +686,53 @@ def bench_verify_backends(n_files: int) -> dict:
     return out
 
 
+def bench_coldstart() -> dict:
+    """Registry economics (trivy_tpu/registry/): fresh ruleset compilation
+    vs loading the persisted artifact, and the end-to-end engine
+    construction walls with the registry off (cold) vs warm.  Uses a
+    throwaway cache dir so the numbers are always a true cold save + warm
+    load, never polluted by the user's cache."""
+    import shutil
+    import tempfile
+
+    from trivy_tpu.engine.hybrid import make_secret_engine
+    from trivy_tpu.registry import store as rstore
+    from trivy_tpu.rules.model import build_ruleset
+
+    ruleset = build_ruleset()
+    cache = tempfile.mkdtemp(prefix="bench-rcache-")
+    try:
+        t0 = time.perf_counter()
+        art, _ = rstore.get_or_compile(ruleset, cache_dir=cache)
+        compile_save_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        warm_art, source = rstore.get_or_compile(ruleset, cache_dir=cache)
+        load_s = time.perf_counter() - t0
+        assert source == "warm", source
+
+        t0 = time.perf_counter()
+        make_secret_engine(backend=BACKEND)
+        engine_cold_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        engine = make_secret_engine(backend=BACKEND, rules_cache_dir=cache)
+        engine_warm_s = time.perf_counter() - t0
+        out = {
+            "digest": art.digest,
+            "compile_and_save_s": round(compile_save_s, 3),
+            "artifact_load_s": round(load_s, 3),
+            "engine_construct_cold_s": round(engine_cold_s, 3),
+            "engine_construct_warm_s": round(engine_warm_s, 3),
+        }
+        if engine_warm_s > 0:
+            out["warm_speedup"] = round(engine_cold_s / engine_warm_s, 2)
+        from trivy_tpu.registry.digest import engine_digest
+
+        assert engine_digest(engine) == art.digest
+        return out
+    finally:
+        shutil.rmtree(cache, ignore_errors=True)
+
+
 def _device_platform() -> str:
     try:
         import jax
@@ -749,6 +796,8 @@ def _emit(detail: dict, error: str | None = None) -> None:
         "value": detail.get("files_per_sec"),
         "unit": "files/s",
     }
+    if detail.get("ruleset_digest"):
+        payload["ruleset_digest"] = detail["ruleset_digest"]
     if detail.get("oracle_files_per_sec") and detail.get("files_per_sec"):
         payload["vs_baseline"] = round(
             detail["files_per_sec"] / detail["oracle_files_per_sec"], 2
@@ -783,6 +832,15 @@ def main() -> None:
         mono, engine, trials=4
     )
     detail["verify"] = getattr(engine, "verify", None)
+    # Which rule version produced every number in this report — the same
+    # content digest the registry keys artifacts by and the server stamps
+    # on responses (X-Trivy-Ruleset).
+    try:
+        from trivy_tpu.registry.digest import engine_digest
+
+        detail["ruleset_digest"] = engine_digest(engine)
+    except Exception:
+        pass
     # Host-speed dispersion (the 1-core bench CPU drifts +-40% between
     # runs): three oracle samples bound the noise the vs_baseline
     # multiple inherits, so round-over-round comparisons are judgeable.
@@ -884,6 +942,13 @@ def main() -> None:
                 detail["serve"] = bench_serve(engine)
         except Exception as e:
             detail["serve"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if os.environ.get("BENCH_COLDSTART", "1") == "1":
+        # Registry cold-compile vs warm-load economics (trivy_tpu/registry/).
+        try:
+            detail["coldstart"] = bench_coldstart()
+        except Exception as e:
+            detail["coldstart"] = {"error": f"{type(e).__name__}: {e}"}
 
     if os.environ.get("BENCH_LICENSE", "1") == "1":
         # BASELINE config #5's second scanner (--scanners secret,license).

@@ -65,9 +65,13 @@ def test_npm_caret_pins_leftmost_nonzero():
     assert not version_in_range("0.1.0", "^0.0")
 
 
-def test_secret_config_skip_is_basename_parity(tmp_path):
-    """r2 advisor: skip exactly filepath.Base(configPath) == filePath
-    (secret.go:138) — a scan-tree file at the configured path is scanned."""
+def test_secret_config_skip_forms(tmp_path):
+    """Skip filepath.Base(configPath) (secret.go:138) AND the normalized
+    relative config path — the walker reports a config living inside the
+    scan tree by relative path, never bare basename, and the config's own
+    example rules must not become findings.  Exact match only: look-alike
+    paths deeper in the tree are still scanned.  (Supersedes the r2
+    basename-only pin; see tests/test_secret_config_skip.py.)"""
     a = SecretAnalyzer.__new__(SecretAnalyzer)
     a._config_path = "conf/trivy-secret.yaml"
     a._config_skip_paths = SecretAnalyzer._build_config_skip_paths(a._config_path)
@@ -75,8 +79,10 @@ def test_secret_config_skip_is_basename_parity(tmp_path):
 
     # reference-parity basename form is skipped
     assert not a.required("trivy-secret.yaml", 100, 0o644)
-    # the configured path inside the scan tree is scanned (reference scans it)
-    assert a.required("conf/trivy-secret.yaml", 100, 0o644)
+    # the configured path inside the scan tree is skipped too
+    assert not a.required("conf/trivy-secret.yaml", 100, 0o644)
+    # but only by exact normalized match — no suffix matching
     assert a.required("/conf/trivy-secret.yaml", 100, 0o644)
+    assert a.required("other/conf/trivy-secret.yaml", 100, 0o644)
     # unrelated file still scanned
     assert a.required("src/app.py", 100, 0o644)

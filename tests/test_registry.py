@@ -1,0 +1,220 @@
+"""Compiled-ruleset registry (trivy_tpu/registry/): content digest,
+artifact round-trip, warm-start compile skipping with byte-identical
+findings, corruption/version-mismatch fallback, and the `rules` CLI.
+"""
+
+import json
+import logging
+import os
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from trivy_tpu.registry import store as rstore
+from trivy_tpu.registry.digest import (
+    canonical_ruleset_bytes,
+    engine_digest,
+    ruleset_digest,
+)
+from trivy_tpu.rules.model import RuleSet, build_ruleset, load_config
+
+PARITY_DIR = Path(__file__).parent / "parity" / "fixtures"
+
+
+def _parity_corpus() -> list[tuple[str, bytes]]:
+    return sorted(
+        (p.name, p.read_bytes())
+        for p in PARITY_DIR.iterdir()
+        if p.suffix in (".txt", ".json", ".md")
+    )
+
+
+# -- digest ---------------------------------------------------------------
+
+
+def test_digest_stable_and_content_addressed():
+    a, b = build_ruleset(), build_ruleset()
+    da, db = ruleset_digest(a), ruleset_digest(b)
+    assert da == db
+    assert a.content_digest() == da  # the RuleSet-side convenience agrees
+    assert len(da) == 64 and set(da) <= set("0123456789abcdef")
+    # Canonical form is pure JSON — no repr()/id() leakage between builds.
+    assert canonical_ruleset_bytes(a) == canonical_ruleset_bytes(b)
+    # Any rule change changes the digest.
+    smaller = RuleSet(rules=a.rules[1:], allow_rules=a.allow_rules)
+    assert ruleset_digest(smaller) != da
+
+
+def test_digest_sensitive_to_config():
+    builtin = ruleset_digest(build_ruleset())
+    cfg = load_config(
+        str(Path(__file__).parent / "parity" / "configs" / "allow-path.yaml")
+    )
+    assert ruleset_digest(build_ruleset(cfg)) != builtin
+
+
+def test_engine_digest_prefers_attribute():
+    class Fake:
+        ruleset_digest = "abc123"
+
+    assert engine_digest(Fake()) == "abc123"
+
+
+# -- artifact store -------------------------------------------------------
+
+
+def test_round_trip_exact(tmp_path):
+    ruleset = build_ruleset()
+    art, source = rstore.get_or_compile(ruleset, cache_dir=str(tmp_path))
+    assert source == "cold"
+    loaded = rstore.load_artifact(str(tmp_path), art.digest)
+    assert loaded is not None
+    fresh = rstore.compile_ruleset(ruleset)
+    for name in ("byte_class", "accept", "follow", "first", "rule_last",
+                 "pos_rule"):
+        got, want = getattr(loaded.nfa, name), getattr(fresh.nfa, name)
+        assert got.dtype == want.dtype and np.array_equal(got, want), name
+    assert loaded.nfa.rule_ids == fresh.nfa.rule_ids
+    assert [p.classes for p in loaded.pset.probes] == [
+        p.classes for p in fresh.pset.probes
+    ]
+    assert [
+        (p.rule_id, p.gate_probe_ids, p.anchor_conjuncts)
+        for p in loaded.pset.plans
+    ] == [
+        (p.rule_id, p.gate_probe_ids, p.anchor_conjuncts)
+        for p in fresh.pset.plans
+    ]
+    for name in ("masks", "vals", "gram_probe", "gram_window",
+                 "window_probe", "window_start", "probe_has_gram"):
+        assert np.array_equal(
+            getattr(loaded.gset, name), getattr(fresh.gset, name)
+        ), name
+
+
+def test_warm_start_skips_compilation_byte_identical(tmp_path, monkeypatch):
+    """The acceptance contract: a second engine construction against a
+    populated cache performs ZERO rule compilation (NFA, probe set, gram
+    set) yet produces byte-identical findings on the parity corpus."""
+    import trivy_tpu.engine.device as device_mod
+    import trivy_tpu.engine.nfa as nfa_mod
+    import trivy_tpu.engine.probes as probes_mod
+    from trivy_tpu.engine.hybrid import make_secret_engine
+
+    calls = {"compile_rules": 0, "build_probe_set": 0, "dev_probe_set": 0}
+    real_cr, real_bps = nfa_mod.compile_rules, probes_mod.build_probe_set
+    real_dev_bps = device_mod.build_probe_set
+
+    def count(key, real):
+        def wrapped(*a, **kw):
+            calls[key] += 1
+            return real(*a, **kw)
+
+        return wrapped
+
+    monkeypatch.setattr(nfa_mod, "compile_rules", count("compile_rules", real_cr))
+    monkeypatch.setattr(
+        probes_mod, "build_probe_set", count("build_probe_set", real_bps)
+    )
+    monkeypatch.setattr(
+        device_mod, "build_probe_set", count("dev_probe_set", real_dev_bps)
+    )
+
+    cache = str(tmp_path / "rcache")
+    cold = make_secret_engine(backend="auto", rules_cache_dir=cache)
+    after_cold = dict(calls)
+    assert after_cold["compile_rules"] == 1  # the registry's one compile
+    assert engine_digest(cold) == ruleset_digest(build_ruleset())
+
+    warm = make_secret_engine(backend="auto", rules_cache_dir=cache)
+    assert calls == after_cold, "warm start recompiled something"
+    assert engine_digest(warm) == engine_digest(cold)
+
+    corpus = _parity_corpus()
+    plain = make_secret_engine(backend="auto")  # registry off: ground truth
+    assert rstore.findings_fingerprint(
+        warm, corpus
+    ) == rstore.findings_fingerprint(plain, corpus)
+
+
+def test_corrupted_npz_falls_back(tmp_path, caplog):
+    ruleset = build_ruleset()
+    art, _ = rstore.get_or_compile(ruleset, cache_dir=str(tmp_path))
+    npz = tmp_path / art.digest / rstore.ARTIFACT_NPZ
+    npz.write_bytes(npz.read_bytes()[: npz.stat().st_size // 2])
+    with caplog.at_level(logging.WARNING, logger="trivy_tpu.registry"):
+        assert rstore.load_artifact(str(tmp_path), art.digest) is None
+    assert any("falling back" in r.getMessage() for r in caplog.records)
+    # get_or_compile recovers by recompiling (and re-persisting).
+    art2, source = rstore.get_or_compile(ruleset, cache_dir=str(tmp_path))
+    assert source == "cold" and art2.digest == art.digest
+    assert rstore.load_artifact(str(tmp_path), art.digest) is not None
+
+
+def test_manifest_mismatch_falls_back(tmp_path, caplog):
+    ruleset = build_ruleset()
+    art, _ = rstore.get_or_compile(ruleset, cache_dir=str(tmp_path))
+    mpath = tmp_path / art.digest / rstore.MANIFEST_JSON
+
+    def mutate(**kw):
+        m = json.loads(mpath.read_text())
+        m.update(kw)
+        mpath.write_text(json.dumps(m))
+
+    with caplog.at_level(logging.WARNING, logger="trivy_tpu.registry"):
+        mutate(schema_version=999)
+        assert rstore.load_artifact(str(tmp_path), art.digest) is None
+        mutate(schema_version=rstore.SCHEMA_VERSION, ruleset_digest="f" * 64)
+        assert rstore.load_artifact(str(tmp_path), art.digest) is None
+        mutate(ruleset_digest=art.digest, jax_version="0.0.0-other")
+        assert rstore.load_artifact(str(tmp_path), art.digest) is None
+        # Version pins are advisory under strict_versions=False (rules ls).
+        assert (
+            rstore.load_artifact(
+                str(tmp_path), art.digest, strict_versions=False
+            )
+            is not None
+        )
+    assert len(caplog.records) >= 3
+
+
+def test_resolve_rules_cache_dir(tmp_path, monkeypatch):
+    for v in ("off", "none", "0", "-", "OFF"):
+        assert rstore.resolve_rules_cache_dir(v) is None
+    assert rstore.resolve_rules_cache_dir(str(tmp_path)) == str(tmp_path)
+    monkeypatch.setenv("TRIVY_TPU_RULES_CACHE_DIR", str(tmp_path / "env"))
+    assert rstore.resolve_rules_cache_dir("") == str(tmp_path / "env")
+
+
+# -- the rules CLI --------------------------------------------------------
+
+
+def test_rules_cli_compile_ls_verify(tmp_path, capsys):
+    from trivy_tpu.cli import main
+
+    cache = str(tmp_path / "cache")
+    assert main(["rules", "compile", "--rules-cache-dir", cache]) == 0
+    out = capsys.readouterr().out
+    digest = out.split()[0]
+    assert len(digest) == 64 and "cold" in out
+
+    assert main(["rules", "compile", "--rules-cache-dir", cache]) == 0
+    assert "warm" in capsys.readouterr().out
+
+    assert main(["rules", "ls", "--rules-cache-dir", cache]) == 0
+    out = capsys.readouterr().out
+    assert digest[:16] in out
+
+    assert main(["rules", "verify", "--rules-cache-dir", cache]) == 0
+    assert "verify OK" in capsys.readouterr().out
+
+
+def test_rules_cli_verify_missing_artifact(tmp_path, capsys):
+    from trivy_tpu.cli import main
+
+    assert (
+        main(["rules", "verify", "--rules-cache-dir", str(tmp_path / "x")])
+        == 1
+    )
+    assert "verify FAILED" in capsys.readouterr().err

@@ -1,0 +1,53 @@
+"""The secret-config file must not be scanned against its own example
+rules — including when it lives in a subdirectory of the scan tree and the
+walker reports it by relative path, not bare basename.
+"""
+
+import pytest
+
+from trivy_tpu.analyzer.core import AnalyzerOptions, SecretScannerOption
+from trivy_tpu.analyzer.secret import SecretAnalyzer
+
+
+def _analyzer(config_path: str) -> SecretAnalyzer:
+    a = SecretAnalyzer()
+    a.init(
+        AnalyzerOptions(
+            secret_scanner_option=SecretScannerOption(config_path=config_path)
+        )
+    )
+    a._engine = type("E", (), {"ruleset": None})()  # no allow-path gate
+    return a
+
+
+@pytest.mark.parametrize(
+    "config_path",
+    ["configs/trivy-secret.yaml", "./configs/trivy-secret.yaml"],
+)
+def test_skips_relative_path_and_basename(config_path):
+    a = _analyzer(config_path)
+    assert not a.required("trivy-secret.yaml", 100, 0o644)
+    assert not a.required("configs/trivy-secret.yaml", 100, 0o644)
+    # Exact-path semantics: a LOOK-ALIKE deeper in the tree is still
+    # scanned (no suffix matching).
+    assert a.required("other/configs/trivy-secret.yaml", 100, 0o644)
+    assert a.required("configs/trivy-secret.yaml.bak", 100, 0o644)
+
+
+def test_bare_basename_config_unchanged():
+    a = _analyzer("trivy-secret.yaml")
+    assert not a.required("trivy-secret.yaml", 100, 0o644)
+    assert a.required("sub/trivy-secret.yaml", 100, 0o644)
+
+
+def test_required_batch_agrees_with_required():
+    a = _analyzer("configs/trivy-secret.yaml")
+    files = [
+        ("trivy-secret.yaml", 100),
+        ("configs/trivy-secret.yaml", 100),
+        ("other/configs/trivy-secret.yaml", 100),
+        ("src/main.py", 100),
+    ]
+    assert a.required_batch(files) == [
+        a.required(p, s, 0o644) for p, s in files
+    ]

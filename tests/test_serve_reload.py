@@ -1,0 +1,143 @@
+"""Zero-downtime ruleset hot reload (registry RulesetManager + serve +
+admin plane): in-flight requests finish on the engine that started them,
+the next batch runs the staged engine, nothing is dropped, and every
+response/metric carries the active ruleset digest.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+from trivy_tpu.cache.store import MemoryCache
+from trivy_tpu.ftypes import Secret
+from trivy_tpu.registry.manager import RulesetManager
+from trivy_tpu.rpc.client import RemoteSecretEngine, RpcClient
+from trivy_tpu.rpc.server import start_background
+from trivy_tpu.serve import BatchScheduler, ServeConfig
+
+
+class FakeEngine:
+    """Engine double with a pinned digest; optionally blocks mid-batch so a
+    reload can be staged while a batch is in flight."""
+
+    def __init__(self, digest: str, gate: threading.Event | None = None):
+        self.ruleset_digest = digest
+        self.gate = gate
+        self.started = threading.Event()
+        self.batches = 0
+
+    def scan_batch(self, items):
+        self.batches += 1
+        self.started.set()
+        if self.gate is not None:
+            assert self.gate.wait(timeout=10)
+        return [Secret(file_path=p) for p, _ in items]
+
+
+def test_manager_swaps_only_at_engine_call():
+    mgr = RulesetManager(lambda: FakeEngine("digest-A"))
+    eng_a, dig_a = mgr.engine()
+    assert dig_a == "digest-A" and mgr.epoch == 1 and mgr.reloads == 0
+    # Staging from another thread does NOT change the active engine...
+    staged = mgr.build_staged(lambda: FakeEngine("digest-B"))
+    assert staged == "digest-B"
+    assert mgr.active_digest == "digest-A"
+    # ...until the owner's next engine() call (the batch boundary).
+    eng_b, dig_b = mgr.engine()
+    assert dig_b == "digest-B" and eng_b is not eng_a
+    assert mgr.epoch == 2 and mgr.reloads == 1
+    # Two stages before one boundary: last writer wins, one install.
+    mgr.build_staged(lambda: FakeEngine("digest-C"))
+    mgr.build_staged(lambda: FakeEngine("digest-D"))
+    _, dig = mgr.engine()
+    assert dig == "digest-D" and mgr.epoch == 3 and mgr.reloads == 2
+
+
+def test_scheduler_inflight_finishes_on_old_next_batch_on_new():
+    """The acceptance contract: a request in flight when the reload lands
+    completes on the OLD ruleset; the next batch runs the NEW one; zero
+    requests are dropped."""
+    gate = threading.Event()
+    old = FakeEngine("digest-A", gate=gate)
+    new = FakeEngine("digest-B")
+    sched = BatchScheduler(lambda: old, ServeConfig(batch_window_ms=5.0))
+    try:
+        f1 = sched.submit([("a.env", b"x" * 32)], client_id="c1")
+        assert old.started.wait(timeout=10)  # batch 1 is mid-scan
+
+        # Reload arrives while batch 1 is blocked inside the old engine.
+        assert sched.reload(lambda: new) == "digest-B"
+        f2 = sched.submit([("b.env", b"y" * 32)], client_id="c2")
+
+        time.sleep(0.05)  # the staged swap must NOT preempt the running batch
+        assert not f1.done()
+        gate.set()
+
+        r1 = f1.result(timeout=10)
+        r2 = f2.result(timeout=10)
+        assert [s.file_path for s in r1] == ["a.env"]
+        assert [s.file_path for s in r2] == ["b.env"]
+        assert r1.ruleset_digest == "digest-A"
+        assert r2.ruleset_digest == "digest-B"
+        assert r2.ruleset_epoch > r1.ruleset_epoch
+        assert new.batches == 1 and old.batches == 1
+        assert sched.active_ruleset_digest() == "digest-B"
+        assert sched.manager.reloads == 1
+        assert sched.stats.errors == 0
+    finally:
+        sched.close()
+
+
+def test_server_admin_reload_and_digest_surfaces():
+    """End to end over HTTP: ScanSecrets responses and the X-Trivy-Ruleset
+    header carry the pre-reload digest, POST /admin/ruleset/reload stages a
+    replacement, and the next scan + /metrics build_info show the new one."""
+    serial = iter(["digest-A", "digest-B", "digest-C"])
+    httpd, _ = start_background(
+        "localhost:0",
+        MemoryCache(),
+        token="hunter2",
+        secret_engine_factory=lambda: FakeEngine(next(serial)),
+        serve_config=ServeConfig(batch_window_ms=5.0),
+    )
+    addr = f"{httpd.server_address[0]}:{httpd.server_address[1]}"
+    try:
+        remote = RemoteSecretEngine(addr, token="hunter2")
+        assert len(remote.scan_batch([("f.txt", b"hello world five")])) == 1
+        assert remote.ruleset_digest == "digest-A"
+
+        # Admin reload is token-authed like every POST.
+        resp = RpcClient(addr, token="hunter2").call("/admin/ruleset/reload", {})
+        assert resp == {
+            "RulesetDigest": "digest-B",
+            "Epoch": 1,
+            "Staged": True,
+        }
+        # In-flight attribution: the swap happens at the NEXT batch.
+        remote.scan_batch([("g.txt", b"hello world again")])
+        assert remote.ruleset_digest == "digest-B"
+
+        # The response header agrees with the body attribution.
+        req = urllib.request.Request(
+            f"http://{addr}/twirp/trivy.scanner.v1.Scanner/ScanSecrets",
+            data=json.dumps(
+                {"Files": [{"Path": "h.txt", "ContentB64": "aGVsbG8gd29ybGQh"}]}
+            ).encode(),
+            headers={
+                "Content-Type": "application/json",
+                "Trivy-Tpu-Token": "hunter2",
+            },
+        )
+        with urllib.request.urlopen(req) as r:
+            assert r.headers["X-Trivy-Ruleset"] == "digest-B"
+            assert json.loads(r.read())["RulesetDigest"] == "digest-B"
+
+        body = urllib.request.urlopen(f"http://{addr}/metrics").read().decode()
+        assert 'trivy_tpu_build_info{' in body
+        assert 'ruleset_digest="digest-B"' in body
+        assert "trivy_tpu_serve_ruleset_reloads_total 1" in body
+    finally:
+        httpd.scan_server.scheduler.close()
+        httpd.shutdown()
+        httpd.server_close()

@@ -73,6 +73,10 @@ class SecretScannerOption:
     # Forwarded as the request TimeoutMs so server-side tickets inherit the
     # client's --timeout.  0 = unbounded.
     timeout_s: float = 0.0
+    # Compiled-ruleset registry directory ("" = the default cache dir,
+    # "off"/"none" = disabled).  Warm-started local engines skip regex
+    # compilation entirely — see trivy_tpu/registry/.
+    rules_cache_dir: str = ""
 
 
 @dataclass

@@ -72,6 +72,7 @@ class SecretAnalyzer(BatchAnalyzer):
         self._server_addr = ""
         self._server_token = ""
         self._timeout_s = 0.0
+        self._rules_cache_dir = ""
 
     def init(self, options: AnalyzerOptions) -> None:
         opt = options.secret_scanner_option
@@ -80,20 +81,30 @@ class SecretAnalyzer(BatchAnalyzer):
         self._server_addr = getattr(opt, "server_addr", "")
         self._server_token = getattr(opt, "server_token", "")
         self._timeout_s = getattr(opt, "timeout_s", 0.0)
+        self._rules_cache_dir = getattr(opt, "rules_cache_dir", "")
         self._config_skip_paths = self._build_config_skip_paths(self._config_path)
 
     @staticmethod
     def _build_config_skip_paths(config_path: str) -> frozenset[str]:
         """Forms of the secret-config path to exclude from scanning.
 
-        Reference parity: the reference skips exactly the scanned file whose
-        path equals filepath.Base(configPath) (secret.go:138) — nothing
-        else.  A scan-tree file that merely sits at the configured path is
-        still scanned, matching the reference.
+        The reference skips the scanned file whose path equals
+        filepath.Base(configPath) (secret.go:138).  Basename alone misses
+        the common case where the config lives in a subdirectory of the
+        scan tree and the walker reports it by relative path — a config
+        given as ``configs/trivy-secret.yaml`` arrives at required() as
+        exactly that string, never as the bare basename, so the file's own
+        example rules would be scanned and reported as findings.  Skip the
+        normalized relative path too; path normalization keeps the match
+        exact (no suffix matching), so ``other/configs/trivy-secret.yaml``
+        is still scanned.
         """
         if not config_path:
             return frozenset()
-        return frozenset({os.path.basename(config_path)})
+        norm = os.path.normpath(config_path).replace(os.sep, "/")
+        if norm.startswith("./"):
+            norm = norm[2:]
+        return frozenset({os.path.basename(config_path), norm})
 
     @property
     def engine(self):
@@ -114,24 +125,21 @@ class SecretAnalyzer(BatchAnalyzer):
                     token=self._server_token,
                     timeout_s=self._timeout_s,
                 )
-            elif self._backend == "cpu":
-                from trivy_tpu.engine.oracle import OracleScanner
-
-                self._engine = OracleScanner(config=config)
-            elif self._backend == "native":
-                from trivy_tpu.engine.device import TpuSecretEngine
-
-                self._engine = TpuSecretEngine(config=config, sieve="native")
-            elif self._backend in ("auto", "hybrid"):
+            else:
+                # All local backends go through the factory, which maps the
+                # CLI aliases (cpu/tpu/native) and — when the registry is on
+                # — warm-starts from a cached compiled artifact instead of
+                # recompiling the ruleset in-process.
                 from trivy_tpu.engine.hybrid import make_secret_engine
+                from trivy_tpu.registry.store import resolve_rules_cache_dir
 
                 self._engine = make_secret_engine(
-                    config=config, backend=self._backend
+                    config=config,
+                    backend=self._backend,
+                    rules_cache_dir=resolve_rules_cache_dir(
+                        self._rules_cache_dir
+                    ),
                 )
-            else:
-                from trivy_tpu.engine.device import TpuSecretEngine
-
-                self._engine = TpuSecretEngine(config=config)
         return self._engine
 
     def type(self) -> str:

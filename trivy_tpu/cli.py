@@ -178,6 +178,12 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
         "server = ship raw items to the scan server's continuous "
         "cross-request batcher (requires --server)",
     )
+    p.add_argument(
+        "--rules-cache-dir",
+        default=_env_default("rules-cache-dir", ""),
+        help="compiled-ruleset registry directory (default "
+        "~/.cache/trivy-tpu/rulesets; 'off' disables warm starts)",
+    )
     p.add_argument("--ignorefile", default=_env_default("ignorefile", ".trivyignore"))
     p.add_argument(
         "--debug", action="store_true", default=_bool_default("debug")
@@ -321,6 +327,7 @@ def _options_from_args(args: argparse.Namespace) -> Options:
         file_patterns=list(getattr(args, "file_patterns", []) or []),
         secret_config=args.secret_config,
         secret_backend=args.secret_backend,
+        rules_cache_dir=getattr(args, "rules_cache_dir", ""),
         ignore_file=args.ignorefile if os.path.exists(args.ignorefile) else "",
         server_addr=args.server,
         username=getattr(args, "username", ""),
@@ -586,6 +593,55 @@ def build_parser() -> argparse.ArgumentParser:
         default=_int_default("max-inflight-per-client", 8),
         help="per-client in-flight ticket cap (fairness under load)",
     )
+    p_server.add_argument(
+        "--secret-config",
+        default=_env_default("secret-config", ""),
+        help="secret-config the server engine loads; SIGHUP or "
+        "POST /admin/ruleset/reload re-reads it and hot-swaps at a "
+        "batch boundary",
+    )
+    p_server.add_argument(
+        "--rules-cache-dir",
+        default=_env_default("rules-cache-dir", ""),
+        help="compiled-ruleset registry directory (default "
+        "~/.cache/trivy-tpu/rulesets; 'off' disables warm starts)",
+    )
+
+    # Ruleset registry maintenance: precompile, list, verify artifacts.
+    p_rules = sub.add_parser(
+        "rules", help="manage the compiled-ruleset registry"
+    )
+    rules_sub = p_rules.add_subparsers(dest="rules_command")
+    pr_compile = rules_sub.add_parser(
+        "compile",
+        help="compile a secret-config into the cache (cold-start killer)",
+    )
+    pr_compile.add_argument(
+        "--secret-config", default=_env_default("secret-config", "")
+    )
+    pr_compile.add_argument(
+        "--rules-cache-dir", default=_env_default("rules-cache-dir", "")
+    )
+    pr_compile.add_argument(
+        "--warmup", action="store_true", default=_bool_default("warmup"),
+        help="also AOT pre-lower/compile the sieve step kernels for the "
+        "configured shape buckets",
+    )
+    pr_ls = rules_sub.add_parser("ls", help="list cached compiled artifacts")
+    pr_ls.add_argument(
+        "--rules-cache-dir", default=_env_default("rules-cache-dir", "")
+    )
+    pr_verify = rules_sub.add_parser(
+        "verify",
+        help="prove a cached artifact round-trips to byte-identical "
+        "findings on the builtin corpus",
+    )
+    pr_verify.add_argument(
+        "--secret-config", default=_env_default("secret-config", "")
+    )
+    pr_verify.add_argument(
+        "--rules-cache-dir", default=_env_default("rules-cache-dir", "")
+    )
 
     sub.add_parser("version", help="print version")
 
@@ -714,7 +770,13 @@ def main(argv: list[str] | None = None) -> int:
             args.report, args.format, args.output, args.severity, args.template
         )
 
+    if args.command == "rules":
+        from trivy_tpu.commands.rules import run_rules
+
+        return run_rules(args)
+
     if args.command == "server":
+        from trivy_tpu.registry.store import resolve_rules_cache_dir
         from trivy_tpu.rpc.server import serve
         from trivy_tpu.serve import ServeConfig
 
@@ -729,6 +791,8 @@ def main(argv: list[str] | None = None) -> int:
                 max_queue_depth=args.max_queue_depth,
                 max_inflight_per_client=args.max_inflight_per_client,
             ),
+            secret_config=args.secret_config,
+            rules_cache_dir=resolve_rules_cache_dir(args.rules_cache_dir),
         )
         return 0
 

@@ -1,0 +1,160 @@
+"""`trivy-tpu rules` — compiled-ruleset registry maintenance.
+
+compile  precompile a secret-config into the content-addressed cache so
+         every later scan/server process warm-starts (optionally AOT
+         pre-lowering the sieve step kernels for the shape buckets)
+ls       list cached artifacts: digest, size, created, framework versions
+verify   prove a cached artifact is faithful: tensors must equal a fresh
+         compile exactly, and a warm-constructed engine must produce
+         byte-identical findings to a cold one on the builtin corpus
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from trivy_tpu.registry import store as rstore
+from trivy_tpu.registry.digest import ruleset_digest
+from trivy_tpu.rules.model import build_ruleset, load_config
+
+
+def _ruleset(args):
+    cfg_path = getattr(args, "secret_config", "") or ""
+    return build_ruleset(load_config(cfg_path) if cfg_path else None)
+
+
+def _cache_dir(args) -> str:
+    d = rstore.resolve_rules_cache_dir(getattr(args, "rules_cache_dir", ""))
+    return d if d is not None else rstore.default_cache_dir()
+
+
+def _compile(args) -> int:
+    ruleset = _ruleset(args)
+    cache_dir = _cache_dir(args)
+    t0 = time.perf_counter()
+    art, source = rstore.get_or_compile(ruleset, cache_dir=cache_dir)
+    elapsed = time.perf_counter() - t0
+    print(
+        f"{art.digest}  {source}  {len(ruleset.rules)} rules  "
+        f"{elapsed:.3f}s  -> {cache_dir}/{art.digest}"
+    )
+    if getattr(args, "warmup", False):
+        from trivy_tpu.engine.hybrid import make_secret_engine
+
+        engine = make_secret_engine(
+            ruleset=ruleset, backend="device", compiled=art
+        )
+        info = rstore.aot_warmup(engine)
+        if info["compiled"]:
+            print(f"aot: compiled buckets {info['buckets']}")
+        else:
+            print(f"aot: skipped ({info['skipped']})")
+    return 0
+
+
+def _ls(args) -> int:
+    cache_dir = _cache_dir(args)
+    entries = rstore.list_artifacts(cache_dir)
+    if not entries:
+        print(f"no cached rulesets under {cache_dir}")
+        return 0
+    print(f"{'DIGEST':16}  {'RULES':>5}  {'SIZE':>9}  {'CREATED':19}  VERSIONS")
+    for e in entries:
+        if not e["valid"]:
+            print(f"{e['digest'][:16]:16}  (unreadable: {e.get('error', '?')})")
+            continue
+        created = time.strftime(
+            "%Y-%m-%d %H:%M:%S", time.localtime(e["created_at"])
+        )
+        vers = f"trivy-tpu {e['trivy_tpu_version']}"
+        if e["jax_version"]:
+            vers += f", jax {e['jax_version']}"
+        print(
+            f"{e['digest'][:16]:16}  {e['num_rules']:>5}  "
+            f"{e['size_bytes']:>9}  {created:19}  {vers}"
+        )
+    return 0
+
+
+def _verify(args) -> int:
+    import numpy as np
+
+    from trivy_tpu.engine.hybrid import make_secret_engine
+
+    ruleset = _ruleset(args)
+    cache_dir = _cache_dir(args)
+    digest = ruleset_digest(ruleset)
+    art = rstore.load_artifact(cache_dir, digest)
+    if art is None:
+        print(
+            f"verify FAILED: no loadable artifact for {digest[:16]} under "
+            f"{cache_dir} (run `rules compile` first)",
+            file=sys.stderr,
+        )
+        return 1
+    fresh = rstore.compile_ruleset(ruleset, digest=digest)
+    checks: list[tuple[str, bool]] = []
+    for name in ("byte_class", "accept", "follow", "first", "rule_last", "pos_rule"):
+        checks.append(
+            (f"nfa.{name}", np.array_equal(getattr(art.nfa, name), getattr(fresh.nfa, name)))
+        )
+    checks.append(("nfa.rule_ids", art.nfa.rule_ids == fresh.nfa.rule_ids))
+    checks.append(
+        (
+            "pset.probes",
+            [p.classes for p in art.pset.probes]
+            == [p.classes for p in fresh.pset.probes],
+        )
+    )
+    checks.append(
+        (
+            "pset.plans",
+            [
+                (p.rule_id, p.gate_probe_ids, p.anchor_conjuncts)
+                for p in art.pset.plans
+            ]
+            == [
+                (p.rule_id, p.gate_probe_ids, p.anchor_conjuncts)
+                for p in fresh.pset.plans
+            ],
+        )
+    )
+    for name in ("masks", "vals", "gram_probe", "gram_window", "window_probe",
+                 "window_start", "probe_has_gram"):
+        checks.append(
+            (f"gset.{name}", np.array_equal(getattr(art.gset, name), getattr(fresh.gset, name)))
+        )
+    warm = make_secret_engine(ruleset=ruleset, backend="auto", compiled=art)
+    cold = make_secret_engine(ruleset=ruleset, backend="auto")
+    checks.append(
+        (
+            "findings (builtin corpus, byte-identical)",
+            rstore.findings_fingerprint(warm)
+            == rstore.findings_fingerprint(cold),
+        )
+    )
+    bad = [name for name, ok in checks if not ok]
+    for name, ok in checks:
+        print(f"  {'ok ' if ok else 'FAIL'} {name}")
+    if bad:
+        print(f"verify FAILED for {digest[:16]}: {', '.join(bad)}", file=sys.stderr)
+        return 1
+    print(f"verify OK: {digest} round-trips exactly")
+    return 0
+
+
+def run_rules(args) -> int:
+    cmd = getattr(args, "rules_command", None)
+    if cmd == "compile":
+        return _compile(args)
+    if cmd == "ls":
+        return _ls(args)
+    if cmd == "verify":
+        return _verify(args)
+    print(
+        "usage: trivy-tpu rules {compile,ls,verify} [--secret-config ...] "
+        "[--rules-cache-dir ...]",
+        file=sys.stderr,
+    )
+    return 2

@@ -57,6 +57,9 @@ class Options:
     file_patterns: list[str] = field(default_factory=list)  # type:regex
     secret_config: str = "trivy-secret.yaml"
     secret_backend: str = "auto"  # hybrid; never boots a device runtime by itself
+    # Compiled-ruleset registry dir ("" = default ~/.cache/trivy-tpu/rulesets,
+    # "off" disables warm starts) — trivy_tpu/registry/.
+    rules_cache_dir: str = ""
     ignore_file: str = ""
     disabled_analyzers: list[str] = field(default_factory=list)
     server_addr: str = ""  # non-empty => client mode (remote driver)
@@ -204,6 +207,7 @@ def _analyzer_options(options: Options, target_kind: str) -> AnalyzerOptions:
             server_addr=options.server_addr,
             server_token=options.token,
             timeout_s=options.timeout,
+            rules_cache_dir=getattr(options, "rules_cache_dir", ""),
         ),
         file_patterns=_parse_file_patterns(options.file_patterns),
         extra_analyzers=extra,

@@ -127,6 +127,7 @@ class TpuSecretEngine:
         pipeline_depth: int | None = None,
         dedupe: bool = True,
         resident_chunks: int | None = None,
+        compiled=None,
     ):
         from trivy_tpu.engine.pipeline import (
             ResidentChunkCache,
@@ -138,7 +139,15 @@ class TpuSecretEngine:
             max_batch_tiles = self.DEFAULT_MAX_BATCH_TILES
         self.ruleset = ruleset if ruleset is not None else build_ruleset(config)
         self.oracle = OracleScanner(self.ruleset)
-        self.pset: ProbeSet = build_probe_set(self.ruleset.rules)
+        # Warm start: a registry CompiledArtifact (already digest-matched to
+        # this ruleset by the loader) supplies the probe/gram tensors, so
+        # construction skips the whole compile pipeline.
+        self._compiled = compiled
+        self._ruleset_digest = compiled.digest if compiled is not None else None
+        self.pset: ProbeSet = (
+            compiled.pset if compiled is not None
+            else build_probe_set(self.ruleset.rules)
+        )
         self.tile_len = tile_len
         self.max_batch_tiles = max_batch_tiles
         self.sieve = sieve
@@ -161,7 +170,10 @@ class TpuSecretEngine:
         if sieve == "native":
             # C++ host sieve (native/gram_sieve.cpp): no JAX, for CPU-only
             # hosts; NumPy reference as last resort.
-            self.gset = build_gram_set(self.pset)
+            self.gset = (
+                compiled.gset if compiled is not None
+                else build_gram_set(self.pset)
+            )
             self._masks_np, self._vals_np = self.gset.masks, self.gset.vals
             self.overlap = GRAM_OVERLAP
             self._sieve_fn = None
@@ -178,7 +190,10 @@ class TpuSecretEngine:
 
             from trivy_tpu.ops import gram_sieve as gs_mod
 
-            self.gset: GramSet = build_gram_set(self.pset)
+            self.gset: GramSet = (
+                compiled.gset if compiled is not None
+                else build_gram_set(self.pset)
+            )
             self.overlap = GRAM_OVERLAP
             on_tpu = jax.devices()[0].platform == "tpu"
             use_pallas = kernel == "pallas" or (kernel == "auto" and on_tpu)
@@ -240,6 +255,17 @@ class TpuSecretEngine:
             raise ValueError(f"unknown sieve: {sieve}")
 
     # ------------------------------------------------------------------
+
+    @property
+    def ruleset_digest(self) -> str:
+        """Content digest of the active rule material (registry/digest.py);
+        seeded by a warm-start artifact, else computed lazily on first use
+        (response headers, /metrics, bench)."""
+        if self._ruleset_digest is None:
+            from trivy_tpu.registry.digest import ruleset_digest
+
+            self._ruleset_digest = ruleset_digest(self.ruleset)
+        return self._ruleset_digest
 
     def _buckets(self) -> list[int]:
         """Row batch shapes: TILE_BUCKETS capped by max_batch_tiles, rounded

@@ -198,6 +198,7 @@ class HybridSecretEngine(TpuSecretEngine):
         probe_confirm: bool = True,
         pipeline_depth: int | None = None,
         dedupe: bool = True,
+        compiled=None,
     ):
         super().__init__(
             ruleset=ruleset,
@@ -205,6 +206,7 @@ class HybridSecretEngine(TpuSecretEngine):
             sieve="native",
             pipeline_depth=pipeline_depth,
             dedupe=dedupe,
+            compiled=compiled,
         )
         self.chunk_bytes = chunk_bytes
         if verify not in ("auto", "dfa", "none", "device"):
@@ -712,6 +714,7 @@ def make_secret_engine(
     config=None,
     backend: str = "auto",
     mesh=None,
+    rules_cache_dir: str | None = None,
     **kw,
 ):
     """Engine factory.
@@ -723,12 +726,25 @@ def make_secret_engine(
       oracle  pure-Python reference engine
     CLI aliases (cli.py --secret-backend): tpu = device, cpu = oracle,
     native = device engine over the C++ host sieve.
+
+    `rules_cache_dir` routes construction through the compiled-artifact
+    registry: the ruleset digests to a cache key, a valid cached artifact
+    supplies the probe/gram/NFA tensors (warm start, no compile), and a
+    miss compiles once and persists for the next process.  None (the
+    default) leaves the registry out entirely.
     """
     backend = {"tpu": "device", "cpu": "oracle"}.get(backend, backend)
     if backend == "oracle":
         from trivy_tpu.engine.oracle import OracleScanner
 
         return OracleScanner(ruleset=ruleset, config=config)
+    if rules_cache_dir is not None and "compiled" not in kw:
+        from trivy_tpu.registry.store import get_or_compile
+        from trivy_tpu.rules.model import build_ruleset
+
+        if ruleset is None:
+            ruleset = build_ruleset(config)
+        kw["compiled"], _ = get_or_compile(ruleset, cache_dir=rules_cache_dir)
     if backend == "device":
         return TpuSecretEngine(ruleset=ruleset, config=config, mesh=mesh, **kw)
     if backend == "native":

@@ -1,0 +1,47 @@
+"""trivy_tpu.registry — content-addressed ruleset registry.
+
+The compile-once seat (Hyperscan's hs_serialize_database, JAX's AOT
+persistent cache): a RuleSet canonicalizes to a sha256 ruleset_digest
+(digest.py), the full compiled sieve state — UnionNFA transition tensors,
+probe set, gram constants — serializes to one .npz + manifest JSON under
+~/.cache/trivy-tpu/rulesets/<digest>/ (store.py), and the serve layer swaps
+epoch-versioned engines at batch boundaries without dropping in-flight work
+(manager.py).  Artifacts are detected, never trusted: any schema/version/
+checksum mismatch falls back to a fresh compile.
+"""
+
+from trivy_tpu.registry.digest import (
+    canonical_ruleset_bytes,
+    default_ruleset_digest,
+    engine_digest,
+    ruleset_digest,
+)
+from trivy_tpu.registry.manager import RulesetManager
+from trivy_tpu.registry.store import (
+    CompiledArtifact,
+    aot_warmup,
+    compile_ruleset,
+    default_cache_dir,
+    get_or_compile,
+    list_artifacts,
+    load_artifact,
+    resolve_rules_cache_dir,
+    save_artifact,
+)
+
+__all__ = [
+    "CompiledArtifact",
+    "RulesetManager",
+    "aot_warmup",
+    "canonical_ruleset_bytes",
+    "compile_ruleset",
+    "default_cache_dir",
+    "default_ruleset_digest",
+    "engine_digest",
+    "get_or_compile",
+    "list_artifacts",
+    "load_artifact",
+    "resolve_rules_cache_dir",
+    "ruleset_digest",
+    "save_artifact",
+]

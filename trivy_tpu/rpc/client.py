@@ -205,6 +205,11 @@ class RemoteSecretEngine:
         self.client = RpcClient(addr, token)
         self.timeout_s = timeout_s
         self.client_id = client_id
+        # Digest of the server-side ruleset that scanned the LAST batch
+        # (response RulesetDigest field); "" until a scan completes.  Lets
+        # thin clients log/compare which rule version produced findings
+        # even though no ruleset is loaded locally.
+        self.ruleset_digest = ""
 
     def scan_batch(self, items: list[tuple[str, bytes]]) -> list[Secret]:
         if not items:
@@ -214,6 +219,7 @@ class RemoteSecretEngine:
             timeout_ms=int(self.timeout_s * 1000) if self.timeout_s else None,
             client_id=self.client_id,
         )
+        self.ruleset_digest = str(resp.get("RulesetDigest") or "")
         secrets = [
             _secret_from_json(d) for d in (resp.get("Secrets") or [])
         ]

@@ -108,15 +108,6 @@ class _Metrics:
             return "\n".join(lines) + "\n"
 
 
-def _default_engine_factory():
-    """Engine for the serve scheduler, built lazily ON the engine-owner
-    thread at first dispatch (a HybridSecretEngine probes the device link at
-    construction — server startup and cache-only traffic must not pay it)."""
-    from trivy_tpu.engine.hybrid import make_secret_engine
-
-    return make_secret_engine(backend="auto")
-
-
 class ScanServer:
     """pkg/rpc/server Server: scanner + cache services over one cache, plus
     the continuous cross-request batcher for raw secret payloads."""
@@ -124,7 +115,8 @@ class ScanServer:
     def __init__(
         self, cache: ArtifactCache, token: str = "", db_dir: str = "",
         cache_dir: str = "", serve_config: ServeConfig | None = None,
-        secret_engine_factory=None,
+        secret_engine_factory=None, secret_config: str = "",
+        rules_cache_dir: str | None = None,
     ):
         from trivy_tpu.scanner.vuln import init_vuln_scanner
 
@@ -135,11 +127,32 @@ class ScanServer:
             cache, vuln_detector=init_vuln_scanner(db_dir, cache_dir)
         )
         self.serve_config = serve_config or ServeConfig()
+        # Ruleset provenance: the secret-config path the default engine
+        # factory (and SIGHUP restage) reads, and the registry cache dir a
+        # warm start loads compiled artifacts from (None = registry off).
+        self.secret_config = secret_config
+        self.rules_cache_dir = rules_cache_dir
+        self._config_digest: str | None = None
         self.scheduler = BatchScheduler(
-            secret_engine_factory or _default_engine_factory,
+            secret_engine_factory or self._build_engine,
             self.serve_config,
         )
         self.draining = False  # SIGTERM: reject new work with 503
+
+    def _build_engine(self):
+        """Default engine factory: built lazily ON the engine-owner thread
+        at first dispatch (a HybridSecretEngine probes the device link at
+        construction — server startup and cache-only traffic must not pay
+        it), and again on a staging thread at each hot reload.  Reads
+        self.secret_config dynamically so an admin reload that moved the
+        config path sticks for later SIGHUPs."""
+        from trivy_tpu.engine.hybrid import make_secret_engine
+        from trivy_tpu.rules.model import load_config
+
+        cfg = load_config(self.secret_config) if self.secret_config else None
+        return make_secret_engine(
+            config=cfg, backend="auto", rules_cache_dir=self.rules_cache_dir
+        )
 
     # -- service methods ------------------------------------------------
 
@@ -219,7 +232,63 @@ class ScanServer:
                 )
             ],
             "Secrets": [_secret_to_json(s) for s in secrets],
+            # The digest of the ruleset that actually scanned THIS batch
+            # (a reload mid-flight attributes each response to the engine
+            # that produced it, not whatever is active now).
+            "RulesetDigest": getattr(secrets, "ruleset_digest", ""),
+            "RulesetEpoch": getattr(secrets, "ruleset_epoch", 0),
         }
+
+    # -- ruleset registry -------------------------------------------------
+
+    def reload_ruleset(self, req: dict) -> dict:
+        """POST /admin/ruleset/reload: build a replacement engine on this
+        handler thread (optionally from a new SecretConfigPath), stage it,
+        and return the staged digest.  The swap itself happens at the next
+        batch boundary on the scheduler's owner thread; in-flight requests
+        finish on the old ruleset."""
+        path = (req or {}).get("SecretConfigPath", "")
+        if path:
+            self.secret_config = path
+            self._config_digest = None
+        digest = self.scheduler.reload()
+        return {
+            "RulesetDigest": digest,
+            "Epoch": self.scheduler.ruleset_epoch(),
+            "Staged": True,
+        }
+
+    def ruleset_digest(self) -> str:
+        """The digest scan surfaces advertise: the scheduler's active
+        engine when one exists, else the digest the configured rules WILL
+        have (pre-first-batch /metrics scrapes and Scan responses)."""
+        d = self.scheduler.active_ruleset_digest()
+        if d:
+            return d
+        if self._config_digest is None:
+            from trivy_tpu.registry.digest import (
+                default_ruleset_digest,
+                ruleset_digest,
+            )
+
+            if self.secret_config:
+                from trivy_tpu.rules.model import build_ruleset, load_config
+
+                self._config_digest = ruleset_digest(
+                    build_ruleset(load_config(self.secret_config))
+                )
+            else:
+                self._config_digest = default_ruleset_digest()
+        return self._config_digest
+
+    def build_info_text(self) -> str:
+        return (
+            "# HELP trivy_tpu_build_info build and active-ruleset identity"
+            " (value is always 1)\n"
+            "# TYPE trivy_tpu_build_info gauge\n"
+            f'trivy_tpu_build_info{{version="{__version__}",'
+            f'ruleset_digest="{self.ruleset_digest()}"}} 1\n'
+        )
 
     def put_artifact(self, req: dict) -> dict:
         self.cache.put_artifact(
@@ -249,6 +318,8 @@ _ROUTES = {
     "/twirp/trivy.cache.v1.Cache/PutBlob": "put_blob",
     "/twirp/trivy.cache.v1.Cache/MissingBlobs": "missing_blobs",
     "/twirp/trivy.cache.v1.Cache/DeleteBlobs": "delete_blobs",
+    # Admin plane (token-authed like every POST): stage a ruleset swap.
+    "/admin/ruleset/reload": "reload_ruleset",
 }
 
 
@@ -286,6 +357,7 @@ def _make_handler(server: ScanServer):
                 body = (
                     server.metrics.render()
                     + server.scheduler.metrics_text()
+                    + server.build_info_text()
                 ).encode()
                 self.send_response(200)
                 self.send_header(
@@ -368,6 +440,10 @@ def _make_handler(server: ScanServer):
                     )
                     self.send_response(200)
                     self.send_header("Content-Type", "application/protobuf")
+                    if method == "scan":
+                        self.send_header(
+                            "X-Trivy-Ruleset", server.ruleset_digest()
+                        )
                     self.send_header("Content-Length", str(len(data)))
                     self.end_headers()
                     self.wfile.write(data)
@@ -377,7 +453,13 @@ def _make_handler(server: ScanServer):
                     # Per-client in-flight caps key on the explicit ClientID
                     # when sent, else the peer address.
                     req["_client"] = self.client_address[0]
-                send(200, getattr(server, method)(req))
+                out = getattr(server, method)(req)
+                if method in ("scan", "scan_secrets"):
+                    # Every scan response states which ruleset produced it.
+                    dig = out.get("RulesetDigest") or server.ruleset_digest()
+                    send(200, out, {"X-Trivy-Ruleset": dig})
+                else:
+                    send(200, out)
             except AdmissionError as e:
                 # Backpressure: full queue / over-cap client -> 429, a
                 # draining scheduler -> 503; both carry Retry-After so the
@@ -414,12 +496,16 @@ def make_http_server(
     cache_dir: str = "",
     serve_config: ServeConfig | None = None,
     secret_engine_factory=None,
+    secret_config: str = "",
+    rules_cache_dir: str | None = None,
 ) -> ThreadingHTTPServer:
     host, _, port = addr.rpartition(":")
     scan_server = ScanServer(
         cache, token, db_dir, cache_dir,
         serve_config=serve_config,
         secret_engine_factory=secret_engine_factory,
+        secret_config=secret_config,
+        rules_cache_dir=rules_cache_dir,
     )
     httpd = ThreadingHTTPServer(
         (host or "localhost", int(port)), _make_handler(scan_server)
@@ -434,15 +520,20 @@ def serve(
     token: str = "",
     db_dir: str = "",
     serve_config: ServeConfig | None = None,
+    secret_config: str = "",
+    rules_cache_dir: str | None = None,
 ) -> None:
     """pkg/rpc/server/listen.go ListenAndServe, with graceful SIGTERM
     drain: stop admitting (503 + Retry-After), finish the batches already
-    queued in the scheduler, then exit."""
+    queued in the scheduler, then exit.  SIGHUP hot-reloads the secret
+    ruleset: the config re-reads and compiles on a side thread, then swaps
+    in at the next batch boundary (zero dropped requests)."""
     import signal
 
     cache = FSCache(cache_dir) if cache_dir else MemoryCache()
     httpd = make_http_server(
-        addr, cache, token, db_dir, cache_dir, serve_config=serve_config
+        addr, cache, token, db_dir, cache_dir, serve_config=serve_config,
+        secret_config=secret_config, rules_cache_dir=rules_cache_dir,
     )
     scan_server: ScanServer = httpd.scan_server
 
@@ -456,9 +547,16 @@ def serve(
         # another one or it deadlocks.
         threading.Thread(target=_drain_and_stop, daemon=True).start()
 
+    def _on_sighup(signum, frame) -> None:
+        # Engine build is seconds of work: stage off the signal frame.
+        threading.Thread(
+            target=scan_server.reload_ruleset, args=({},), daemon=True
+        ).start()
+
     try:
         signal.signal(signal.SIGTERM, _on_sigterm)
-    except ValueError:
+        signal.signal(signal.SIGHUP, _on_sighup)
+    except (ValueError, AttributeError):
         pass  # not the main thread (embedded); drain is the caller's job
     print(f"trivy-tpu server listening on {httpd.server_address[0]}:{httpd.server_address[1]}")
     try:
@@ -473,6 +571,7 @@ def serve(
 def start_background(
     addr: str, cache: ArtifactCache, token: str = "", db_dir: str = "",
     serve_config: ServeConfig | None = None, secret_engine_factory=None,
+    secret_config: str = "", rules_cache_dir: str | None = None,
 ) -> tuple[ThreadingHTTPServer, threading.Thread]:
     """In-process server for tests (the §4 'multi-node without a cluster'
     pattern: integration_test.go:77-103 binds a real server on a free port)."""
@@ -480,6 +579,8 @@ def start_background(
         addr, cache, token, db_dir,
         serve_config=serve_config,
         secret_engine_factory=secret_engine_factory,
+        secret_config=secret_config,
+        rules_cache_dir=rules_cache_dir,
     )
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()

@@ -382,6 +382,14 @@ class RuleSet:
         default=None, init=False, repr=False, compare=False
     )
 
+    def content_digest(self) -> str:
+        """sha256 over the canonical rule material — the key the compiled-
+        artifact registry stores under and every scan surface reports
+        (trivy_tpu/registry/digest.py owns the canonical form)."""
+        from trivy_tpu.registry.digest import ruleset_digest
+
+        return ruleset_digest(self)
+
     def allow(self, match: bytes) -> bool:
         return allow_rules_allow(self.allow_rules, match)
 

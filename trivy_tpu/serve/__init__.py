@@ -14,6 +14,7 @@ from trivy_tpu.serve.scheduler import (
     QueueFullError,
     SchedulerClosedError,
     SchedulerStats,
+    SecretBatch,
     ServeConfig,
     Ticket,
 )
@@ -25,6 +26,7 @@ __all__ = [
     "QueueFullError",
     "SchedulerClosedError",
     "SchedulerStats",
+    "SecretBatch",
     "ServeConfig",
     "Ticket",
 ]

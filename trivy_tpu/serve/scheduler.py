@@ -44,6 +44,17 @@ from dataclasses import dataclass, field
 
 from trivy_tpu import deadline as _deadline
 from trivy_tpu.deadline import ScanTimeoutError
+from trivy_tpu.registry.manager import RulesetManager
+
+
+class SecretBatch(list):
+    """A ticket's result list, tagged with the (digest, epoch) of the
+    engine that scanned it.  A list subclass so every existing consumer —
+    slicing, equality, `[s for s in secrets]` — is untouched; the serve
+    layer reads the attribution off the side."""
+
+    ruleset_digest: str = ""
+    ruleset_epoch: int = 0
 
 
 class AdmissionError(RuntimeError):
@@ -122,7 +133,11 @@ class BatchScheduler:
     def __init__(self, engine_factory, config: ServeConfig | None = None):
         self.config = config or ServeConfig()
         self._engine_factory = engine_factory
-        self._engine = None
+        # The manager owns the active/staged engine pair; only _dispatch
+        # (owner thread) installs, so swaps land exactly at batch
+        # boundaries and in-flight batches finish on the engine they
+        # started with.
+        self.manager = RulesetManager(engine_factory)
         self._q: deque[Ticket] = deque()
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
@@ -307,9 +322,10 @@ class BatchScheduler:
         else:
             _deadline.clear()
         try:
-            if self._engine is None:
-                self._engine = self._engine_factory()
-            results = self._engine.scan_batch(combined)
+            # Batch boundary: any staged ruleset swaps in HERE, before any
+            # of this batch's bytes touch an engine.
+            engine, digest = self.manager.engine()
+            results = engine.scan_batch(combined)
         except ScanTimeoutError:
             for t in batch:
                 t.future.set_exception(
@@ -325,9 +341,29 @@ class BatchScheduler:
             return
         finally:
             _deadline.clear()
+        epoch = self.manager.epoch
         for t, (lo, hi) in zip(batch, spans):
-            t.future.set_result(results[lo:hi])
+            out = SecretBatch(results[lo:hi])
+            out.ruleset_digest = digest
+            out.ruleset_epoch = epoch
+            t.future.set_result(out)
             self._release(t)
+
+    # -- hot reload ------------------------------------------------------
+
+    def reload(self, engine_factory=None) -> str:
+        """Stage a replacement engine (built on THIS thread — an admin
+        handler or SIGHUP thread, never the owner thread) to swap in at
+        the next batch boundary; returns the staged ruleset digest.
+        Default factory = the scheduler's own, i.e. re-read the current
+        config from disk."""
+        return self.manager.build_staged(engine_factory)
+
+    def active_ruleset_digest(self) -> str:
+        return self.manager.active_digest
+
+    def ruleset_epoch(self) -> int:
+        return self.manager.epoch
 
     # -- observability ---------------------------------------------------
 
@@ -377,5 +413,11 @@ class BatchScheduler:
             "# HELP trivy_tpu_serve_batch_errors_total batches failed by an engine exception",
             "# TYPE trivy_tpu_serve_batch_errors_total counter",
             f"trivy_tpu_serve_batch_errors_total {s.errors}",
+            "# HELP trivy_tpu_serve_ruleset_epoch engine installs since start (0 = no engine yet)",
+            "# TYPE trivy_tpu_serve_ruleset_epoch gauge",
+            f"trivy_tpu_serve_ruleset_epoch {self.manager.epoch}",
+            "# HELP trivy_tpu_serve_ruleset_reloads_total live engine replacements (hot reloads)",
+            "# TYPE trivy_tpu_serve_ruleset_reloads_total counter",
+            f"trivy_tpu_serve_ruleset_reloads_total {self.manager.reloads}",
         ]
         return "\n".join(lines) + "\n"
