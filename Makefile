@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test smoke serve-smoke bench checks-corpus rules-cache
+.PHONY: test smoke serve-smoke bench bench-link checks-corpus rules-cache
 
 # Tier-1: the suite the driver holds the repo to (fast, CPU, no slow marks).
 test:
@@ -12,10 +12,13 @@ test:
 
 # CI smoke: tiny-corpus bench.py --smoke on CPU (pipeline depth 2) via the
 # slow-marked subprocess test, which asserts the single-JSON-line contract
-# and nonzero h2d overlap accounting.
+# and nonzero h2d overlap accounting — plus the codec parity smoke: the
+# same corpus with TRIVY_TPU_LINK_CODEC=off and =auto must produce
+# byte-identical findings.
 smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest \
 		tests/test_bench_smoke.py::test_bench_smoke_subprocess \
+		tests/test_bench_smoke.py::test_smoke_codec_off_vs_auto \
 		-q -p no:cacheprovider
 
 # Server-mode smoke: boot the batching server on a random port, fire
@@ -29,6 +32,14 @@ serve-smoke:
 # Full benchmark (honest corpora; on CPU this takes a while).
 bench:
 	$(PY) bench.py
+
+# Link-codec economics only: raw vs coded H2D bytes, effective link rate,
+# D2H compaction ratios, full-corpus coded-vs-raw findings identity
+# (bench.py BENCH_LINK section with every other section off).
+bench-link:
+	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
+		BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 BENCH_IMAGE=0 \
+		BENCH_FILES=2000 BENCH_PARITY=sample $(PY) bench.py
 
 # Precompile the builtin ruleset into the registry cache (trivy_tpu/registry/)
 # so every later scan/server process warm-starts without compiling rules.

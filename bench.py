@@ -50,12 +50,15 @@ KERNEL = os.environ.get("BENCH_KERNEL", "1") == "1"
 DEVICE = os.environ.get("BENCH_DEVICE", "1") == "1"
 HITDENSE = os.environ.get("BENCH_HITDENSE", "1") == "1"
 HITDENSE_FILES = int(os.environ.get("BENCH_HITDENSE_FILES", "20000"))
+LINK = os.environ.get("BENCH_LINK", "1") == "1"
+LINK_FILES = int(os.environ.get("BENCH_LINK_FILES", "2000"))
 BACKEND = os.environ.get("BENCH_BACKEND", "auto")
 if SMOKE:
     N_FILES = 400
     RULE_SCALING = False
     KERNEL = False
     HITDENSE_FILES = 200
+    LINK_FILES = 300
     os.environ.setdefault("BENCH_LICENSE", "0")
     os.environ.setdefault("BENCH_IMAGE", "0")
 
@@ -533,9 +536,15 @@ def bench_device_engine(
     engine = TpuSecretEngine(resident_chunks=0, **kw)
     engine.warmup()
     detail, _results, _items, _ = bench_corpus_config(corpus, engine, trials=2)
-    tile_bytes = engine.stats.tiles * engine.tile_len
     mb_s, rtt = probe_link()
     ph = detail.get("phases") or {}
+    # Bytes that actually crossed the link, from the staging-time counters
+    # (resident hits and dedupe-skipped chunks excluded; coded = post-codec).
+    # The old tiles * tile_len product over-counted exactly those cases.
+    raw_link = ph.get("bytes_on_link_raw", 0) or (
+        engine.stats.tiles * engine.tile_len
+    )
+    coded_link = ph.get("bytes_on_link_coded", 0) or raw_link
     out = {
         "files": detail["files"],
         "files_per_sec": detail["files_per_sec"],
@@ -546,15 +555,18 @@ def bench_device_engine(
         "pipeline_depth": ph.get("pipeline_depth", 0),
         "h2d_overlap_s": ph.get("h2d_overlap_s", 0.0),
         "dedupe_saved_bytes": ph.get("dedupe_saved_bytes", 0),
-        "bytes_on_link": tile_bytes,
+        "bytes_on_link_raw": raw_link,
+        "bytes_on_link": coded_link,
         "link_mb_per_sec": round(mb_s, 1),
         "link_rtt_s": round(rtt, 4),
     }
+    if raw_link:
+        out["codec_ratio"] = round(coded_link / raw_link, 4)
     if mb_s > 0:
         # The link floor counts transfer time AND the fixed per-dispatch
         # round-trip (dispatches do not overlap on the relay).
         dispatches = detail.get("device_dispatches", 0)
-        floor_s = tile_bytes / (mb_s * 1e6) + dispatches * rtt
+        floor_s = coded_link / (mb_s * 1e6) + dispatches * rtt
         out["device_dispatches"] = dispatches
         out["link_floor_s"] = round(floor_s, 3)
 
@@ -686,6 +698,101 @@ def bench_verify_backends(n_files: int) -> dict:
     return out
 
 
+def bench_link(n_files: int) -> dict:
+    """BENCH_LINK: the transfer-codec economics (engine/link.py).
+
+    Runs the all-device engine over the same corpus with the link codec
+    off and in auto, and reports raw vs coded H2D bytes, the effective
+    post-codec link rate, the D2H compaction ratio on the sieve hit
+    matrix, the verify-stream fetch compaction, and findings parity
+    (coded findings must be byte-identical to raw over the WHOLE corpus
+    — asserted, and recorded so the acceptance criterion is auditable)."""
+    from trivy_tpu.engine import link as link_mod
+    from trivy_tpu.engine.device import TpuSecretEngine
+    from trivy_tpu.engine.hybrid import HybridSecretEngine, probe_link
+    from trivy_tpu.registry.store import findings_fingerprint
+
+    corpus = bench_corpus.make_monorepo_corpus(n_files)
+    out: dict = {"files": n_files, "platform": _device_platform()}
+    prev = os.environ.get("TRIVY_TPU_LINK_CODEC")
+    fps: dict[str, bytes] = {}
+    try:
+        for mode in ("off", "auto"):
+            os.environ["TRIVY_TPU_LINK_CODEC"] = mode
+            engine = TpuSecretEngine(resident_chunks=0)
+            engine.warmup()
+            t0 = time.perf_counter()
+            fps[mode] = findings_fingerprint(engine, corpus)
+            wall = time.perf_counter() - t0
+            ph = engine.stats.phases()
+            row = {
+                "wall_s": round(wall, 3),
+                "bytes_on_link_raw": ph.get("bytes_on_link_raw", 0),
+                "bytes_on_link_coded": ph.get("bytes_on_link_coded", 0),
+                "codec_ratio": ph.get("codec_ratio", 1.0),
+                "encode_s": ph.get("encode_s", 0.0),
+                "d2h_bytes_raw": ph.get("d2h_bytes_raw", 0),
+                "d2h_bytes": ph.get("d2h_bytes", 0),
+                "d2h_ratio": ph.get("d2h_ratio", 1.0),
+            }
+            codec = getattr(engine, "_link", None)
+            if codec is not None:
+                row["codec"] = {
+                    "sym_bits": codec.sym_bits,
+                    "classes": codec.num_classes,
+                    "exact": codec.exact,
+                    "id": codec.codec_id,
+                }
+            out[mode] = row
+
+        # Byte-identity over the full corpus IS the acceptance criterion.
+        out["parity_identical"] = fps["off"] == fps["auto"]
+        assert out["parity_identical"], "codec changed findings"
+
+        mb_s, rtt = probe_link()
+        out["link_mb_per_sec"] = round(mb_s, 1)
+        auto = out["auto"]
+        if mb_s > 0 and auto["bytes_on_link_raw"]:
+            out["effective_link_mb_per_sec"] = round(
+                link_mod.effective_link_rate(
+                    mb_s,
+                    h2d_ratio=auto["codec_ratio"],
+                    d2h_ratio=auto["d2h_ratio"],
+                ),
+                1,
+            )
+
+        # Verify-stream fetch compaction (nfa_device._verify_stream): the
+        # match-map D2H is bitmap + compacted nonzero rows when the codec
+        # layer is on.  Sparse-hit subset, so most rows compact away.
+        try:
+            sub = corpus[: max(100, n_files // 4)]
+            stream = {}
+            for mode in ("off", "auto"):
+                os.environ["TRIVY_TPU_LINK_CODEC"] = mode
+                eng = HybridSecretEngine(verify="device")
+                res = eng.scan_batch(list(sub))
+                ss = getattr(eng._nfa_verifier, "stream_stats", None) or {}
+                stream[mode] = {
+                    "fetch_bytes_raw": ss.get("fetch_bytes_raw", 0),
+                    "fetch_bytes": ss.get("fetch_bytes", 0),
+                    "findings": sum(len(r.findings) for r in res),
+                }
+            got = stream["auto"]["fetch_bytes"]
+            raw = stream["auto"]["fetch_bytes_raw"]
+            if raw and got:
+                stream["fetch_compaction_x"] = round(raw / got, 1)
+            out["verify_stream"] = stream
+        except NotImplementedError as e:
+            out["verify_stream"] = {"skipped": str(e)}
+    finally:
+        if prev is None:
+            os.environ.pop("TRIVY_TPU_LINK_CODEC", None)
+        else:
+            os.environ["TRIVY_TPU_LINK_CODEC"] = prev
+    return out
+
+
 def bench_coldstart() -> dict:
     """Registry economics (trivy_tpu/registry/): fresh ruleset compilation
     vs loading the persisted artifact, and the end-to-end engine
@@ -766,6 +873,24 @@ def _compact_detail(detail: dict) -> dict:
             )
             if k in de
         }
+    lk = detail.get("link")
+    if isinstance(lk, dict):
+        lc = {
+            k: lk[k]
+            for k in (
+                "parity_identical", "effective_link_mb_per_sec", "error",
+            )
+            if k in lk
+        }
+        auto = lk.get("auto")
+        if isinstance(auto, dict):
+            lc["codec_ratio"] = auto.get("codec_ratio")
+            lc["d2h_ratio"] = auto.get("d2h_ratio")
+        vs = lk.get("verify_stream")
+        if isinstance(vs, dict) and "fetch_compaction_x" in vs:
+            lc["fetch_compaction_x"] = vs["fetch_compaction_x"]
+        if lc:
+            c["link"] = lc
     vb = detail.get("verify_backend")
     if isinstance(vb, dict):
         vc = {
@@ -929,6 +1054,14 @@ def main() -> None:
             detail["kernel_exec"] = bench_kernel_exec()
         except Exception as e:
             detail["kernel_exec"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if LINK:
+        # Link codec economics: H2D transcode ratio, effective link rate,
+        # D2H compaction, full-corpus coded-vs-raw findings identity.
+        try:
+            detail["link"] = bench_link(LINK_FILES)
+        except Exception as e:
+            detail["link"] = {"error": f"{type(e).__name__}: {e}"}
 
     if os.environ.get("BENCH_SERVE", "1") == "1":
         # Server mode: concurrent clients coalescing in the continuous

@@ -112,3 +112,41 @@ def test_bench_smoke_subprocess(tmp_path):
     assert de["pipelined_wall_s"] > 0 and de["serial_wall_s"] > 0
     side = json.loads((tmp_path / "detail.json").read_text())
     assert side["device_engine"]["resident_rescan"]["resident_hits"] > 0
+    # Link codec section: engaged, ahead of the 0.55x acceptance bar, and
+    # byte-identical findings coded vs raw over the section's full corpus.
+    link = side["link"]
+    assert link["parity_identical"] is True
+    assert link["auto"]["codec_ratio"] <= 0.55
+    # Sieve-side d2h: the code-like smoke corpus is gram-hit dense, so the
+    # compactor's dense fallback must stay within bitmap overhead of raw.
+    assert link["auto"]["d2h_bytes"] <= link["auto"]["d2h_bytes_raw"] * 1.05
+    # The >=5x d2h acceptance bar lands on the sparse verify stream.
+    assert link["verify_stream"]["fetch_compaction_x"] >= 5
+
+
+@pytest.mark.slow
+def test_smoke_codec_off_vs_auto():
+    """The smoke corpus scanned with TRIVY_TPU_LINK_CODEC=off and =auto
+    must produce byte-identical findings, with the codec actually engaged
+    in auto (not trivially passing because it fell back to raw)."""
+    import bench_corpus
+    from trivy_tpu.engine.device import TpuSecretEngine
+    from trivy_tpu.registry.store import findings_fingerprint
+
+    corpus = bench_corpus.make_monorepo_corpus(200)
+    fps = {}
+    ratios = {}
+    prev = os.environ.get("TRIVY_TPU_LINK_CODEC")
+    try:
+        for mode in ("off", "auto"):
+            os.environ["TRIVY_TPU_LINK_CODEC"] = mode
+            engine = TpuSecretEngine()
+            fps[mode] = findings_fingerprint(engine, corpus)
+            ratios[mode] = engine.stats.phases().get("codec_ratio", 1.0)
+    finally:
+        if prev is None:
+            os.environ.pop("TRIVY_TPU_LINK_CODEC", None)
+        else:
+            os.environ["TRIVY_TPU_LINK_CODEC"] = prev
+    assert fps["off"] == fps["auto"]
+    assert ratios["auto"] < 1.0  # codec engaged on the builtin ruleset

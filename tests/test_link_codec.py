@@ -1,0 +1,461 @@
+"""Link codec (trivy_tpu/engine/link.py): bit-pack roundtrips, alphabet
+derivation, width-selection policy, coded-sieve parity against the numpy
+reference, d2h compacted fetches, the registry class-map pin, and
+randomized engine-level fuzz parity (coded vs raw vs oracle must be
+byte-identical findings — merged maps may only ADD sieve hits, never
+drop one).
+"""
+
+import json
+import logging
+import os
+import random
+
+import numpy as np
+import pytest
+
+from trivy_tpu.engine import link as link_mod
+from trivy_tpu.engine.link import (
+    LinkAlphabet,
+    LinkCodec,
+    canonical_class_map,
+    derive_alphabet,
+    effective_link_rate,
+    fetch_rows_compact,
+    fetch_stream_packed,
+    select_codec,
+)
+from trivy_tpu.ops.gram_sieve import gram_sieve_numpy
+
+
+def _alphabet_of(values: list[int]) -> LinkAlphabet:
+    vals = np.array(sorted(values), dtype=np.uint8)
+    return LinkAlphabet(values=vals, class_map=canonical_class_map(vals))
+
+
+class _FakeGramSet:
+    def __init__(self, masks, vals):
+        self.masks = np.asarray(masks, dtype=np.uint32)
+        self.vals = np.asarray(vals, dtype=np.uint32)
+
+
+# -- pack/unpack roundtrip ------------------------------------------------
+
+
+@pytest.mark.parametrize("sym_bits", [4, 6])
+@pytest.mark.parametrize("length", [1, 2, 3, 4, 7, 512, 513])
+def test_pack_unpack_roundtrip(sym_bits, length):
+    rng = np.random.default_rng(sym_bits * 1000 + length)
+    alpha = _alphabet_of(list(b"abcdef0123_-x"))
+    codec = LinkCodec(
+        sym_bits=sym_bits,
+        class_map=alpha.class_map,
+        num_classes=alpha.size,
+        exact=True,
+    )
+    rows = rng.integers(0, 256, size=(5, length), dtype=np.uint8)
+    coded = codec.encode_rows(rows)
+    assert coded.shape == (5, codec.coded_len(length))
+    import jax.numpy as jnp
+
+    unpacked = np.asarray(codec.make_unpack(length)(jnp.asarray(coded)))
+    assert np.array_equal(unpacked, alpha.class_map[rows])
+    # Every symbol fits the width, id 0 reserved for out-of-alphabet.
+    assert unpacked.max(initial=0) < (1 << sym_bits)
+    assert alpha.class_map[0] == 0  # NUL padding can never become a class
+
+
+def test_coded_len_and_ratio():
+    c4 = LinkCodec(4, np.zeros(256, np.uint8), 1, True)
+    c6 = LinkCodec(6, np.zeros(256, np.uint8), 1, True)
+    assert c4.coded_len(512) == 256 and c4.ratio == 0.5
+    assert c6.coded_len(512) == 384 and c6.ratio == 0.75
+    assert c4.coded_len(5) == 3 and c6.coded_len(5) == 6
+
+
+# -- alphabet derivation --------------------------------------------------
+
+
+def test_derive_alphabet_kept_bytes_only():
+    # gram 0 keeps bytes 'a','b' (positions 0,1), masks out the rest;
+    # gram 1 keeps '0' at position 3.  Masked positions must not leak.
+    gset = _FakeGramSet(
+        masks=[0x0000FFFF, 0xFF000000],
+        vals=[0x7A7A6261, 0x30515252],
+    )
+    alpha = derive_alphabet(gset)
+    assert alpha.values.tolist() == sorted(b"ab0")
+    # Canonical map: kept values -> ids 1..n by sorted rank, else 0.
+    for i, v in enumerate(alpha.values.tolist()):
+        assert alpha.class_map[v] == i + 1
+    assert alpha.class_map[0x7A] == 0  # masked-out byte stays "other"
+    assert alpha.class_map[0] == 0
+
+
+def test_derive_alphabet_folds_case():
+    gset = _FakeGramSet(masks=[0x000000FF], vals=[ord("k")])
+    alpha = derive_alphabet(gset)
+    # 'K' folds to 'k' at compile time, so both raw bytes share a class.
+    assert alpha.class_map[ord("K")] == alpha.class_map[ord("k")] != 0
+
+
+def test_derive_alphabet_builtin_fits_six_bits():
+    from trivy_tpu.engine.grams import build_gram_set
+    from trivy_tpu.engine.probes import build_probe_set
+    from trivy_tpu.rules.model import build_ruleset
+
+    gset = build_gram_set(build_probe_set(build_ruleset().rules))
+    alpha = derive_alphabet(gset)
+    assert 0 < alpha.size <= 63  # the 6-bit codec always applies
+
+
+# -- width selection ------------------------------------------------------
+
+
+def test_select_codec_policy():
+    small = _alphabet_of(list(range(1, 16)))  # 15 values
+    wide = _alphabet_of(list(range(1, 40)))  # 39 values
+    huge = _alphabet_of(list(range(1, 120)))  # 119 > 63
+
+    assert select_codec(small, "off") is None
+    assert select_codec(_alphabet_of([]), "auto") is None
+
+    c = select_codec(small, "auto")
+    assert c.sym_bits == 4 and c.exact
+
+    # No gset to price a merge against: auto falls through to exact 6.
+    c = select_codec(wide, "auto")
+    assert c.sym_bits == 6 and c.exact
+
+    c = select_codec(wide, "4")  # forced narrow -> merged
+    assert c.sym_bits == 4 and not c.exact and c.num_classes == 15
+    c = select_codec(wide, "6")
+    assert c.sym_bits == 6 and c.exact
+
+    c = select_codec(huge, "6")  # cannot fit even 63 -> merged 6
+    assert c.sym_bits == 6 and not c.exact
+    assert select_codec(huge, "auto") is None
+
+
+def test_codec_id_distinguishes_width_and_map():
+    wide = _alphabet_of(list(range(1, 40)))
+    ids = {
+        select_codec(wide, "4").codec_id,
+        select_codec(wide, "6").codec_id,
+        select_codec(_alphabet_of(list(range(1, 16))), "4").codec_id,
+    }
+    assert len(ids) == 3
+
+
+def test_merged_map_respects_class_cap():
+    wide = _alphabet_of(list(range(1, 40)))
+    c = select_codec(wide, "4")
+    used = np.unique(c.class_map[wide.values])
+    assert used.min() >= 1 and used.max() <= 15
+    # Every alphabet byte still lands in SOME class (never dropped to 0).
+    assert (c.class_map[wide.values] > 0).all()
+
+
+# -- coded sieve parity vs the numpy reference ----------------------------
+
+
+def _hits_coded(codec, rows, masks, vals):
+    import jax.numpy as jnp
+
+    cmasks, cvals = codec.encode_grams(masks, vals)
+    coded = codec.encode_rows(rows)
+    unpacked = np.asarray(
+        codec.make_unpack(rows.shape[1])(jnp.asarray(coded))
+    )
+    return gram_sieve_numpy(unpacked, cmasks, cvals)
+
+
+def test_exact_codec_reproduces_hits_bit_for_bit():
+    rng = np.random.default_rng(7)
+    alphabet = list(b"ghp_abcdef0123456789")
+    masks = np.array([0xFFFFFFFF, 0x00FFFFFF], dtype=np.uint32)
+    vals = np.array(
+        [
+            int.from_bytes(b"ghp_", "little"),
+            int.from_bytes(b"abc\x00", "little"),
+        ],
+        dtype=np.uint32,
+    )
+    gset = _FakeGramSet(masks, vals)
+    alpha = derive_alphabet(gset)
+    codec = select_codec(alpha, "auto")
+    assert codec is not None and codec.exact
+    rows = rng.integers(0, 256, size=(16, 128), dtype=np.uint8)
+    rows[3, 10:14] = np.frombuffer(b"ghp_", dtype=np.uint8)  # planted hit
+    rows[5, :] = 0  # all-NUL row must stay silent
+    raw = gram_sieve_numpy(rows, masks, vals)
+    assert np.array_equal(_hits_coded(codec, rows, masks, vals), raw)
+    assert raw[3].any() and not raw[5].any()
+
+
+def test_merged_codec_hits_are_a_superset():
+    rng = np.random.default_rng(11)
+    values = list(range(ord("a"), ord("a") + 26)) + list(
+        range(ord("0"), ord("0") + 10)
+    )
+    alpha = _alphabet_of(values)
+    masks = np.full(8, 0xFFFFFFFF, dtype=np.uint32)
+    picks = rng.choice(np.array(values, np.uint8), size=(8, 4))
+    vals = np.array(
+        [int.from_bytes(bytes(p.tolist()), "little") for p in picks],
+        dtype=np.uint32,
+    )
+    codec = select_codec(alpha, "4")
+    assert not codec.exact
+    rows = rng.choice(
+        np.array(values + [0, 0x20, 0xFF], np.uint8), size=(64, 96)
+    )
+    raw = gram_sieve_numpy(rows, masks, vals)
+    coded = _hits_coded(codec, rows, masks, vals)
+    assert (coded | raw == coded).all()  # raw => coded, never the reverse
+    assert raw.sum() <= coded.sum()
+
+
+# -- d2h compacted fetches ------------------------------------------------
+
+
+def test_fetch_rows_compact_sparse_dense_empty():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    t, w = 256, 16
+
+    # Sparse: a handful of nonzero rows -> compacted fetch moves far less.
+    sparse = np.zeros((t, w), dtype=np.uint32)
+    hot = rng.choice(t, size=5, replace=False)
+    sparse[hot] = rng.integers(1, 1 << 30, size=(5, w), dtype=np.uint32)
+    got, raw, fetched = fetch_rows_compact(jnp.asarray(sparse))
+    assert np.array_equal(got, sparse)
+    assert raw == t * w * 4 and fetched < raw // 5
+
+    # All-zero: only the bitmap crosses the link.
+    got, raw, fetched = fetch_rows_compact(jnp.zeros((t, w), jnp.uint32))
+    assert not got.any() and fetched == t // 8
+
+    # Dense: falls back to the full fetch (plus the bitmap it already paid).
+    dense = rng.integers(1, 100, size=(t, w), dtype=np.uint32)
+    got, raw, fetched = fetch_rows_compact(jnp.asarray(dense))
+    assert np.array_equal(got, dense) and fetched == raw + t // 8
+
+    # Tiny batches skip compaction entirely.
+    small = rng.integers(0, 2, size=(8, w), dtype=np.uint32)
+    got, raw, fetched = fetch_rows_compact(jnp.asarray(small))
+    assert np.array_equal(got, small) and fetched == raw
+
+
+def test_fetch_stream_packed_parity():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    rp, lo, g, bg = 4, 8, 16, 8  # 128 lanes
+    packed = np.zeros((rp, lo, g, bg), dtype=np.uint8)
+    for _ in range(3):  # three hot lanes
+        packed[
+            rng.integers(rp), rng.integers(lo), rng.integers(g),
+            rng.integers(bg),
+        ] = rng.integers(1, 255)
+    got, raw, fetched = fetch_stream_packed(jnp.asarray(packed))
+    assert np.array_equal(got, packed)
+    assert raw == packed.size and fetched < raw
+
+
+def test_effective_link_rate_model():
+    assert effective_link_rate(70.0) == pytest.approx(70.0)
+    # Halving h2d with compacted d2h beats either alone.
+    both = effective_link_rate(70.0, h2d_ratio=0.5, d2h_ratio=0.15)
+    h2d_only = effective_link_rate(70.0, h2d_ratio=0.5)
+    assert both > h2d_only > 70.0
+    # Compaction alone can lift a 750 MB/s relay over the 1 GB/s bar.
+    assert effective_link_rate(
+        750.0, d2h_ratio=link_mod.STREAM_D2H_RATIO
+    ) > 1000.0
+
+
+# -- engine-level fuzz parity ---------------------------------------------
+
+
+def _fuzz_corpus(seed: int, tile_len: int) -> list[tuple[str, bytes]]:
+    rng = random.Random(seed)
+    up = "ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+    alnum = up + up.lower() + "0123456789"
+
+    def pick(chars, n):
+        return "".join(rng.choice(chars) for _ in range(n)).encode()
+
+    secrets = [
+        lambda: b"ghp_" + pick(alnum, 36),
+        lambda: b'"AKIA' + pick(up + "0123456789", 16) + b'" ',
+        lambda: b"sk_live_" + pick("0123456789abcdefghij", 20),
+        lambda: b"glpat-" + pick(alnum, 20),
+        lambda: b"hf_" + pick(alnum, 39),
+    ]
+    out = []
+    for i in range(40):
+        kind = i % 4
+        if kind == 0:  # plain text with an embedded secret
+            body = pick(alnum + " \n", rng.randint(50, 800))
+            body += b"\nkey = " + rng.choice(secrets)() + b"\n"
+        elif kind == 1:  # out-of-alphabet binary noise around a secret
+            body = bytes(rng.randrange(128, 256) for _ in range(300))
+            if rng.random() < 0.7:
+                body += rng.choice(secrets)()
+            body += bytes(rng.randrange(128, 256) for _ in range(100))
+        elif kind == 2:  # NUL-heavy (class 0 must never match)
+            body = b"\x00" * rng.randint(100, 600)
+            if rng.random() < 0.5:
+                body += rng.choice(secrets)() + b"\x00" * 50
+        else:  # exactly one tile: the padding boundary case
+            sec = rng.choice(secrets)()
+            body = pick(alnum, tile_len - len(sec)) + sec
+            assert len(body) == tile_len
+        out.append((f"f{i:03d}.bin", body))
+    return out
+
+
+def _engine(mode: str, tile_len: int):
+    from trivy_tpu.engine.device import TpuSecretEngine
+
+    prev = os.environ.get("TRIVY_TPU_LINK_CODEC")
+    os.environ["TRIVY_TPU_LINK_CODEC"] = mode
+    try:
+        return TpuSecretEngine(tile_len=tile_len)
+    finally:
+        if prev is None:
+            os.environ.pop("TRIVY_TPU_LINK_CODEC", None)
+        else:
+            os.environ["TRIVY_TPU_LINK_CODEC"] = prev
+
+
+def test_engine_fuzz_parity_all_modes():
+    """off / auto / forced-4 (merged) / forced-6 all produce byte-identical
+    findings to each other and to the oracle, over blobs with
+    out-of-alphabet bytes, NUL runs, and exact-tile-length boundaries."""
+    from trivy_tpu.engine.oracle import OracleScanner
+    from trivy_tpu.registry.store import findings_fingerprint
+
+    tile_len = 512
+    corpus = _fuzz_corpus(seed=42, tile_len=tile_len)
+    engines = {m: _engine(m, tile_len) for m in ("off", "auto", "4", "6")}
+
+    assert engines["off"]._link is None
+    assert engines["off"]._codec_tag == ":raw"
+    for m in ("auto", "4", "6"):
+        codec = engines[m]._link
+        assert codec is not None, m
+        # Resident-cache keys must not collide across codecs.
+        assert engines[m]._codec_tag == ":" + codec.codec_id
+        assert engines[m]._staged_cols == codec.coded_len(tile_len)
+    assert engines["4"]._link.sym_bits == 4
+    assert engines["6"]._link.sym_bits == 6
+    # Distinct codecs get distinct tags (auto may legitimately equal one
+    # of the forced widths — it picks from the same family).
+    assert engines["4"]._codec_tag != engines["6"]._codec_tag
+    assert ":raw" not in (engines["4"]._codec_tag, engines["6"]._codec_tag)
+
+    fps = {m: findings_fingerprint(e, corpus) for m, e in engines.items()}
+    assert len(set(fps.values())) == 1, {
+        m: len(v) for m, v in fps.items()
+    }
+    oracle = OracleScanner()
+    for (path, content), dev in zip(
+        corpus, engines["off"].scan_batch(corpus)
+    ):
+        ref = oracle.scan(path, content)
+        assert [
+            (f.rule_id, f.start_line, f.match) for f in dev.findings
+        ] == [(f.rule_id, f.start_line, f.match) for f in ref.findings], path
+
+    # The codec actually moved fewer bytes where it engaged.
+    for m in ("auto", "4", "6"):
+        ph = engines[m].stats.phases()
+        assert ph["bytes_on_link_coded"] < ph["bytes_on_link_raw"], m
+        assert ph["codec_ratio"] <= engines[m]._link.ratio + 0.01
+        assert ph["d2h_bytes"] <= ph["d2h_bytes_raw"]
+    off = engines["off"].stats.phases()
+    assert off["bytes_on_link_coded"] == off["bytes_on_link_raw"]
+
+
+def test_engine_parity_many_seeds():
+    """Cheap multi-seed fuzz sweep: raw vs auto only."""
+    from trivy_tpu.registry.store import findings_fingerprint
+
+    tile_len = 512
+    raw = _engine("off", tile_len)
+    coded = _engine("auto", tile_len)
+    for seed in (1, 2, 3):
+        corpus = _fuzz_corpus(seed=seed, tile_len=tile_len)
+        assert findings_fingerprint(raw, corpus) == findings_fingerprint(
+            coded, corpus
+        ), seed
+
+
+# -- registry class-map pin ----------------------------------------------
+
+
+def test_tampered_class_map_falls_back_to_fresh_compile(tmp_path, caplog):
+    """An attacker who rewrites the stored class map AND recomputes the
+    manifest npz digest still fails the load: the map is re-derived from
+    the gram tensors and must match byte-for-byte."""
+    import hashlib
+    import io
+
+    from trivy_tpu.registry import store as rstore
+    from trivy_tpu.rules.model import build_ruleset
+
+    ruleset = build_ruleset()
+    art, source = rstore.get_or_compile(ruleset, cache_dir=str(tmp_path))
+    assert source == "cold"
+
+    npz_path = tmp_path / art.digest / rstore.ARTIFACT_NPZ
+    with np.load(npz_path) as z:
+        arrays = {k: z[k] for k in z.files}
+    assert "link_values" in arrays and "link_class_map" in arrays
+    # Swap two classes: still a plausible-looking [256] uint8 map.
+    cm = arrays["link_class_map"].copy()
+    a, b = arrays["link_values"][:2]
+    cm[a], cm[b] = cm[b], cm[a]
+    arrays["link_class_map"] = cm
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    blob = buf.getvalue()
+    npz_path.write_bytes(blob)
+    # Keep the manifest self-consistent, as a tamperer with file access
+    # trivially can: size and sha both match the rewritten npz.
+    mpath = tmp_path / art.digest / rstore.MANIFEST_JSON
+    m = json.loads(mpath.read_text())
+    m["npz_sha256"] = hashlib.sha256(blob).hexdigest()
+    m["npz_bytes"] = len(blob)
+    mpath.write_text(json.dumps(m))
+
+    with caplog.at_level(logging.WARNING, logger="trivy_tpu.registry"):
+        assert rstore.load_artifact(str(tmp_path), art.digest) is None
+    assert any("falling back" in r.getMessage() for r in caplog.records)
+    # get_or_compile recovers with a fresh compile and re-persists.
+    art2, source = rstore.get_or_compile(ruleset, cache_dir=str(tmp_path))
+    assert source == "cold" and art2.digest == art.digest
+    loaded = rstore.load_artifact(str(tmp_path), art.digest)
+    assert loaded is not None
+    fresh = derive_alphabet(loaded.gset)
+    assert np.array_equal(loaded.alphabet.values, fresh.values)
+    assert np.array_equal(loaded.alphabet.class_map, fresh.class_map)
+
+
+def test_artifact_round_trips_alphabet(tmp_path):
+    from trivy_tpu.registry import store as rstore
+    from trivy_tpu.rules.model import build_ruleset
+
+    art, _ = rstore.get_or_compile(build_ruleset(), cache_dir=str(tmp_path))
+    loaded = rstore.load_artifact(str(tmp_path), art.digest)
+    assert loaded is not None and loaded.alphabet is not None
+    fresh = derive_alphabet(loaded.gset)
+    assert np.array_equal(loaded.alphabet.values, fresh.values)
+    m = json.loads(
+        (tmp_path / art.digest / rstore.MANIFEST_JSON).read_text()
+    )
+    assert m["schema_version"] == rstore.SCHEMA_VERSION
+    assert m["link"]["alphabet_size"] == int(fresh.values.size)

@@ -77,6 +77,10 @@ class SecretScannerOption:
     # "off"/"none" = disabled).  Warm-started local engines skip regex
     # compilation entirely — see trivy_tpu/registry/.
     rules_cache_dir: str = ""
+    # Link tuning forwarded to local engines (None = engine defaults /
+    # TRIVY_TPU_PIPELINE_DEPTH / TRIVY_TPU_RESIDENT_CHUNKS).
+    pipeline_depth: int | None = None
+    resident_chunks: int | None = None
 
 
 @dataclass

@@ -73,6 +73,8 @@ class SecretAnalyzer(BatchAnalyzer):
         self._server_token = ""
         self._timeout_s = 0.0
         self._rules_cache_dir = ""
+        self._pipeline_depth: int | None = None
+        self._resident_chunks: int | None = None
 
     def init(self, options: AnalyzerOptions) -> None:
         opt = options.secret_scanner_option
@@ -82,6 +84,8 @@ class SecretAnalyzer(BatchAnalyzer):
         self._server_token = getattr(opt, "server_token", "")
         self._timeout_s = getattr(opt, "timeout_s", 0.0)
         self._rules_cache_dir = getattr(opt, "rules_cache_dir", "")
+        self._pipeline_depth = getattr(opt, "pipeline_depth", None)
+        self._resident_chunks = getattr(opt, "resident_chunks", None)
         self._config_skip_paths = self._build_config_skip_paths(self._config_path)
 
     @staticmethod
@@ -133,12 +137,18 @@ class SecretAnalyzer(BatchAnalyzer):
                 from trivy_tpu.engine.hybrid import make_secret_engine
                 from trivy_tpu.registry.store import resolve_rules_cache_dir
 
+                kw = {}
+                if self._pipeline_depth is not None:
+                    kw["pipeline_depth"] = self._pipeline_depth
+                if self._resident_chunks is not None:
+                    kw["resident_chunks"] = self._resident_chunks
                 self._engine = make_secret_engine(
                     config=config,
                     backend=self._backend,
                     rules_cache_dir=resolve_rules_cache_dir(
                         self._rules_cache_dir
                     ),
+                    **kw,
                 )
         return self._engine
 

@@ -94,6 +94,22 @@ def _int_default(name: str, default: int) -> int:
         ) from e
 
 
+def _opt_int_default(name: str) -> int | None:
+    """Like _int_default but with no built-in fallback: None means "flag
+    absent, let the engine pick its own default" (the engine layers read
+    the same TRIVY_TPU_* env vars, so the binding here only matters for
+    config-file values and explicit flags)."""
+    val = _env_default(name, None)
+    if val is None or val == "":
+        return None
+    try:
+        return int(val)
+    except (TypeError, ValueError) as e:
+        raise ConfigFileError(
+            f"{name} must be an integer, got {val!r} (env/config)"
+        ) from e
+
+
 def _float_default(name: str, default: float) -> float:
     val = _env_default(name, default)
     try:
@@ -183,6 +199,19 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
         default=_env_default("rules-cache-dir", ""),
         help="compiled-ruleset registry directory (default "
         "~/.cache/trivy-tpu/rulesets; 'off' disables warm starts)",
+    )
+    p.add_argument(
+        "--pipeline-depth", type=int,
+        default=_opt_int_default("pipeline-depth"),
+        help="chunks staged ahead in the device upload pipeline "
+        "(default: engine-chosen; TRIVY_TPU_PIPELINE_DEPTH)",
+    )
+    p.add_argument(
+        "--resident-chunks", type=int,
+        default=_opt_int_default("resident-chunks"),
+        help="device-resident chunk LRU capacity — repeated chunks skip "
+        "the host-device link entirely (default 32; "
+        "TRIVY_TPU_RESIDENT_CHUNKS)",
     )
     p.add_argument("--ignorefile", default=_env_default("ignorefile", ".trivyignore"))
     p.add_argument(
@@ -328,6 +357,8 @@ def _options_from_args(args: argparse.Namespace) -> Options:
         secret_config=args.secret_config,
         secret_backend=args.secret_backend,
         rules_cache_dir=getattr(args, "rules_cache_dir", ""),
+        pipeline_depth=getattr(args, "pipeline_depth", None),
+        resident_chunks=getattr(args, "resident_chunks", None),
         ignore_file=args.ignorefile if os.path.exists(args.ignorefile) else "",
         server_addr=args.server,
         username=getattr(args, "username", ""),
@@ -606,6 +637,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="compiled-ruleset registry directory (default "
         "~/.cache/trivy-tpu/rulesets; 'off' disables warm starts)",
     )
+    p_server.add_argument(
+        "--pipeline-depth", type=int,
+        default=_opt_int_default("pipeline-depth"),
+        help="chunks staged ahead in the server engine's device pipeline "
+        "(default: engine-chosen; TRIVY_TPU_PIPELINE_DEPTH)",
+    )
+    p_server.add_argument(
+        "--resident-chunks", type=int,
+        default=_opt_int_default("resident-chunks"),
+        help="device-resident chunk LRU capacity for the server engine "
+        "(default 32; TRIVY_TPU_RESIDENT_CHUNKS)",
+    )
 
     # Ruleset registry maintenance: precompile, list, verify artifacts.
     p_rules = sub.add_parser(
@@ -793,6 +836,8 @@ def main(argv: list[str] | None = None) -> int:
             ),
             secret_config=args.secret_config,
             rules_cache_dir=resolve_rules_cache_dir(args.rules_cache_dir),
+            pipeline_depth=args.pipeline_depth,
+            resident_chunks=args.resident_chunks,
         )
         return 0
 

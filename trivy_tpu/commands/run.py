@@ -60,6 +60,11 @@ class Options:
     # Compiled-ruleset registry dir ("" = default ~/.cache/trivy-tpu/rulesets,
     # "off" disables warm starts) — trivy_tpu/registry/.
     rules_cache_dir: str = ""
+    # Device-link tuning (None = engine defaults / TRIVY_TPU_PIPELINE_DEPTH /
+    # TRIVY_TPU_RESIDENT_CHUNKS): stage-ahead chunk count and the
+    # device-resident chunk LRU capacity — trivy_tpu/engine/pipeline.py.
+    pipeline_depth: int | None = None
+    resident_chunks: int | None = None
     ignore_file: str = ""
     disabled_analyzers: list[str] = field(default_factory=list)
     server_addr: str = ""  # non-empty => client mode (remote driver)
@@ -208,6 +213,8 @@ def _analyzer_options(options: Options, target_kind: str) -> AnalyzerOptions:
             server_token=options.token,
             timeout_s=options.timeout,
             rules_cache_dir=getattr(options, "rules_cache_dir", ""),
+            pipeline_depth=getattr(options, "pipeline_depth", None),
+            resident_chunks=getattr(options, "resident_chunks", None),
         ),
         file_patterns=_parse_file_patterns(options.file_patterns),
         extra_analyzers=extra,

@@ -90,6 +90,19 @@ class SieveStats:
     dedupe_saved_bytes: int = 0
     resident_hits: int = 0
     pipeline_depth: int = 0
+    # Link-codec accounting (engine/link.py).  bytes_on_link_raw counts
+    # padded chunk bytes at ACTUAL staging time — resident-LRU hits and
+    # dedupe-skipped blobs never ship, so they never count (the pre-codec
+    # bench derived this from tiles * tile_len, which overstated
+    # steady-state traffic).  bytes_on_link_coded is what device_put
+    # really moved (== raw when no codec applies); encode_s is the host
+    # transcode+pack cost.  d2h_bytes_raw/d2h_bytes are the fetch-side
+    # pair (full result size vs bitmap+compacted rows actually moved).
+    bytes_on_link_raw: int = 0
+    bytes_on_link_coded: int = 0
+    encode_s: float = 0.0
+    d2h_bytes_raw: int = 0
+    d2h_bytes: int = 0
 
     def phases(self) -> dict:
         out = {
@@ -107,6 +120,20 @@ class SieveStats:
             out["dedupe_saved_bytes"] = self.dedupe_saved_bytes
         if self.resident_hits:
             out["resident_hits"] = self.resident_hits
+        if self.bytes_on_link_raw:
+            out["bytes_on_link_raw"] = self.bytes_on_link_raw
+            out["bytes_on_link_coded"] = self.bytes_on_link_coded
+            out["codec_ratio"] = round(
+                self.bytes_on_link_coded / self.bytes_on_link_raw, 4
+            )
+        if self.encode_s:
+            out["encode_s"] = round(self.encode_s, 4)
+        if self.d2h_bytes_raw:
+            out["d2h_bytes_raw"] = self.d2h_bytes_raw
+            out["d2h_bytes"] = self.d2h_bytes
+            out["d2h_ratio"] = round(
+                self.d2h_bytes / self.d2h_bytes_raw, 4
+            )
         return out
 
 
@@ -167,6 +194,13 @@ class TpuSecretEngine:
         self._gate, self._gate_any, self._conj, self._conj_any = self.pset.gate_masks()
         self._build_member_matrices()
 
+        # Link-codec state (engine/link.py): filled in on the device gram
+        # path below; native/lut paths stay raw.
+        self._link = None
+        self._d2h_compact = False
+        self._staged_cols = tile_len
+        self._codec_tag = ":raw"
+
         if sieve == "native":
             # C++ host sieve (native/gram_sieve.cpp): no JAX, for CPU-only
             # hosts; NumPy reference as last resort.
@@ -195,6 +229,37 @@ class TpuSecretEngine:
                 else build_gram_set(self.pset)
             )
             self.overlap = GRAM_OVERLAP
+
+            # Link codec (engine/link.py): when the ruleset's kept-value
+            # alphabet fits a 4/6-bit width, rows transcode to packed
+            # class ids on the host and unpack on-device ahead of the
+            # match kernel; gram constants are rewritten into class space
+            # so the same kernels run unchanged.  Wide alphabets fall
+            # back to raw uint8 transparently (self._link stays None).
+            from trivy_tpu.engine import link as link_mod
+
+            _mode = link_mod.codec_mode()
+            self._d2h_compact = _mode != "off"
+            if _mode != "off":
+                _alpha = (
+                    getattr(compiled, "alphabet", None)
+                    if compiled is not None
+                    else None
+                )
+                if _alpha is None:
+                    _alpha = link_mod.derive_alphabet(self.gset)
+                self._link = link_mod.select_codec(_alpha, _mode, self.gset)
+            if self._link is not None:
+                self._staged_cols = self._link.coded_len(tile_len)
+                self._codec_tag = ":" + self._link.codec_id
+                cmasks, cvals = self._link.encode_grams(
+                    self.gset.masks, self.gset.vals
+                )
+                unpack = self._link.make_unpack(tile_len)
+            else:
+                cmasks, cvals = self.gset.masks, self.gset.vals
+                unpack = None
+
             on_tpu = jax.devices()[0].platform == "tpu"
             use_pallas = kernel == "pallas" or (kernel == "auto" and on_tpu)
             if use_pallas:
@@ -207,14 +272,20 @@ class TpuSecretEngine:
                     make_sharded_pallas_sieve,
                 )
 
-                sieve_obj = PallasGramSieve(self.gset.masks, self.gset.vals)
+                sieve_obj = PallasGramSieve(cmasks, cvals)
                 # Kernel output bits are over distinct (mask, val) pairs;
-                # _candidates expands them back to gset gram order.
+                # _candidates expands them back to gset gram order.  (In
+                # class space a merged codec can collapse more pairs than
+                # the raw constants would — the expansion handles both.)
                 self._pallas_obj = sieve_obj
                 if mesh is not None:
-                    self._sieve_fn = make_sharded_pallas_sieve(mesh, sieve_obj)
+                    self._sieve_fn = make_sharded_pallas_sieve(
+                        mesh, sieve_obj, pre=unpack
+                    )
                     # Every shard must tile into whole Pallas blocks.
                     self._tile_align = self._tile_align * sieve_obj.block_rows
+                elif unpack is not None:
+                    self._sieve_fn = lambda rows: sieve_obj(unpack(rows))
                 else:
                     self._sieve_fn = sieve_obj
                 self._tile_buckets = TILE_BUCKETS_PALLAS
@@ -228,16 +299,27 @@ class TpuSecretEngine:
                     # buckets are min-capped in _buckets().
                     self.max_batch_tiles = self._tile_buckets[-1]
             else:
-                masks, vals = gs_mod.pad_grams(self.gset.masks, self.gset.vals)
+                masks, vals = gs_mod.pad_grams(cmasks, cvals)
                 self._masks = jnp.asarray(masks)
                 self._vals = jnp.asarray(vals)
                 if mesh is not None:
-                    fn = gs_mod.make_sharded_gram_sieve(mesh)
+                    fn = gs_mod.make_sharded_gram_sieve(mesh, unpack=unpack)
+                elif unpack is not None:
+                    fn = jax.jit(
+                        lambda rows, m, v: gs_mod.gram_sieve_rows(
+                            unpack(rows), m, v
+                        )
+                    )
                 else:
                     fn = gs_mod._gram_sieve_jit
                 self._sieve_fn = lambda rows: fn(rows, self._masks, self._vals)
                 self._tile_buckets = TILE_BUCKETS
         elif sieve == "lut":
+            from trivy_tpu.engine import link as link_mod
+
+            # No transcoder here (the LUT sieve's byte semantics are the
+            # contract), but the d2h compaction is lossless and applies.
+            self._d2h_compact = link_mod.d2h_compaction_enabled()
             self._lut = jnp.asarray(self.pset.build_lut())
             self.overlap = max(DEFAULT_OVERLAP, self.pset.jmax)
             if mesh is not None:
@@ -290,7 +372,9 @@ class TpuSecretEngine:
         import jax.numpy as jnp
 
         for rows in self._buckets():
-            batch = jnp.zeros((rows, self.tile_len), dtype=jnp.uint8)
+            # Staged width: the codec ships packed class ids, so every
+            # bucket's compiled shape is the CODED row width.
+            batch = jnp.zeros((rows, self._staged_cols), dtype=jnp.uint8)
             jax.block_until_ready(self._sieve_fn(batch))
 
     def _build_member_matrices(self) -> None:
@@ -353,24 +437,63 @@ class TpuSecretEngine:
             self._sieve_donated = fn
         return self._sieve_donated
 
+    def _encode_chunk(self, part: np.ndarray) -> tuple[np.ndarray, int]:
+        """(staged buffer, raw padded bytes): the link codec transcodes
+        the padded chunk to packed class ids; without one the chunk
+        ships as-is.  Callers account bytes_on_link_* at actual staging
+        time, so resident hits and dedupe skips never count."""
+        if self._link is None:
+            return part, part.nbytes
+        import time as _time
+
+        t0 = _time.perf_counter()
+        coded = self._link.encode_rows(part)
+        self.stats.encode_s += _time.perf_counter() - t0
+        return coded, part.nbytes
+
+    def _count_link(self, raw_nbytes: int, coded_nbytes: int) -> None:
+        self.stats.bytes_on_link_raw += raw_nbytes
+        self.stats.bytes_on_link_coded += coded_nbytes
+
+    def _fetch_hits(self, out) -> np.ndarray:
+        """D2H of one chunk's hit words.  With compaction on, the device
+        reduces to a nonzero-row bitmap and ships only the hit rows
+        (engine/link.py); either way the raw/actual byte pair lands in
+        stats."""
+        if self._d2h_compact:
+            from trivy_tpu.engine import link as link_mod
+
+            arr, raw_b, got_b = link_mod.fetch_rows_compact(out)
+        else:
+            arr = np.asarray(out)
+            raw_b = got_b = arr.nbytes
+        self.stats.d2h_bytes_raw += raw_b
+        self.stats.d2h_bytes += got_b
+        return arr
+
     def _resident_dispatch(self, part: np.ndarray) -> np.ndarray:
         """One synchronous dispatch through the resident-chunk LRU: a
-        digest-identical chunk never re-crosses the link."""
+        digest-identical chunk never re-crosses the link.  The digest is
+        taken over the CODED buffer and suffixed with the codec id, so a
+        codec change (env flip, ruleset reload) can never alias a raw
+        chunk's cached hit words."""
         from trivy_tpu.engine.pipeline import chunk_digest
 
+        buf, raw_n = self._encode_chunk(part)
         digest = None
         # Sync-timing passes measure the raw link; a resident hit would
         # skip the transfer being measured.
         if self._resident.capacity and not os.environ.get(
             "TRIVY_TPU_SYNC_TIMING"
         ):
-            digest = chunk_digest(part)
+            digest = chunk_digest(buf) + self._codec_tag
             hit = self._resident.get(digest)
             if hit is not None:
                 self.stats.resident_hits += 1
                 return hit
         self.stats.device_dispatches += 1
-        out = self._dispatch_rows(part)
+        self._count_link(raw_n, buf.nbytes)
+        out = self._dispatch_rows(buf)
         if digest is not None:
             self._resident.put(digest, out)
         return out
@@ -395,10 +518,12 @@ class TpuSecretEngine:
             # design so the phase boundary stays measurable.
             chunks = []
             for off in range(0, total, max_rows):
-                self.stats.device_dispatches += 1
-                chunks.append(
-                    self._dispatch_rows(self._pad_chunk(rows, off, max_rows))
+                buf, raw_n = self._encode_chunk(
+                    self._pad_chunk(rows, off, max_rows)
                 )
+                self.stats.device_dispatches += 1
+                self._count_link(raw_n, buf.nbytes)
+                chunks.append(self._dispatch_rows(buf))
             return np.concatenate(chunks)[:total]
 
         # Chunked pipeline (engine/pipeline.py): h2d staging of chunk N+1
@@ -412,13 +537,15 @@ class TpuSecretEngine:
 
         def stage(ci):
             part = self._pad_chunk(rows, ci * max_rows, max_rows)
+            buf, raw_n = self._encode_chunk(part)
+            digest = None
             if self._resident.capacity:
-                digest = chunk_digest(part)
+                digest = chunk_digest(buf) + self._codec_tag
                 hit = self._resident.get(digest)
                 if hit is not None:
                     return (digest, hit, True)
-                return (digest, jax.device_put(part), False)
-            return (None, jax.device_put(part), False)
+            self._count_link(raw_n, buf.nbytes)
+            return (digest, jax.device_put(buf), False)
 
         def execute(ci, staged):
             digest, dev, hit = staged
@@ -430,7 +557,7 @@ class TpuSecretEngine:
 
         def finish(ci, handle):
             digest, out, hit = handle
-            out = np.asarray(out)
+            out = out if hit else self._fetch_hits(out)
             if not hit and digest is not None:
                 self._resident.put(digest, out)
             outs[ci] = out
@@ -442,26 +569,27 @@ class TpuSecretEngine:
         self.stats.h2d_overlap_s += pipe.stats.h2d_overlap_s
         return np.concatenate(outs)[:total]
 
-    def _dispatch_rows(self, rows: np.ndarray) -> np.ndarray:
-        """One sieve dispatch.  Under TRIVY_TPU_SYNC_TIMING=1 the h2d
-        transfer is forced to complete (a 1-element fetch round-trip —
-        block_until_ready returns early on relay links) before the kernel
-        runs, splitting stats.h2d_s from stats.exec_s; bench uses this to
-        measure how link-bound the all-device engine really is without
-        trusting a probe's rate estimate."""
+    def _dispatch_rows(self, buf: np.ndarray) -> np.ndarray:
+        """One sieve dispatch over an already-staged (possibly coded)
+        buffer.  Under TRIVY_TPU_SYNC_TIMING=1 the h2d transfer is forced
+        to complete (a 1-element fetch round-trip — block_until_ready
+        returns early on relay links) before the kernel runs, splitting
+        stats.h2d_s from stats.exec_s; bench uses this to measure how
+        link-bound the all-device engine really is without trusting a
+        probe's rate estimate."""
         import time as _time
 
         import jax
         import jax.numpy as jnp
 
         if not os.environ.get("TRIVY_TPU_SYNC_TIMING"):
-            return np.asarray(self._sieve_fn(jnp.asarray(rows)))
+            return self._fetch_hits(self._sieve_fn(jnp.asarray(buf)))
         t0 = _time.perf_counter()
-        dev = jax.device_put(rows)
+        dev = jax.device_put(buf)
         np.asarray(dev[:1, :1])  # forced round-trip: transfer is done
         self.stats.h2d_s += _time.perf_counter() - t0
         t0 = _time.perf_counter()
-        out = np.asarray(self._sieve_fn(dev))
+        out = self._fetch_hits(self._sieve_fn(dev))
         self.stats.exec_s += _time.perf_counter() - t0
         return out
 

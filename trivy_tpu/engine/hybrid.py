@@ -140,13 +140,23 @@ def probe_link(size: int = 8 << 20, attempts: int = 3):
         return result
 
 
-def _link_is_wide() -> bool:
+def _link_is_wide(h2d_ratio: float = 1.0, d2h_ratio: float = 1.0) -> bool:
     """Device verify by default only when the link can beat the host C
     verifier's NFA-mode walk (~300-900 MB/s measured): candidate bytes
     stream at the link rate, so the bar is link >= ~1 GB/s with sub-10ms
-    dispatch."""
+    dispatch.
+
+    The bar is priced against the EFFECTIVE post-codec rate
+    (engine/link.py): h2d transcoding and d2h compaction shrink the bytes
+    a raw payload costs, so a physical link below 1 GB/s can still clear
+    the bar when the codec is available — codec availability flips
+    backend selection, which is the point of pricing it here instead of
+    at the probe."""
+    from trivy_tpu.engine import link as link_mod
+
     mb_s, rtt = probe_link()
-    return mb_s >= 1000.0 and rtt < 0.01
+    eff = link_mod.effective_link_rate(mb_s, h2d_ratio, d2h_ratio)
+    return eff >= 1000.0 and rtt < 0.01
 
 
 def normalize_grams(
@@ -198,6 +208,7 @@ class HybridSecretEngine(TpuSecretEngine):
         probe_confirm: bool = True,
         pipeline_depth: int | None = None,
         dedupe: bool = True,
+        resident_chunks: int | None = None,
         compiled=None,
     ):
         super().__init__(
@@ -206,6 +217,7 @@ class HybridSecretEngine(TpuSecretEngine):
             sieve="native",
             pipeline_depth=pipeline_depth,
             dedupe=dedupe,
+            resident_chunks=resident_chunks,
             compiled=compiled,
         )
         self.chunk_bytes = chunk_bytes
@@ -220,9 +232,21 @@ class HybridSecretEngine(TpuSecretEngine):
             # Relay-attached chips (candidate bytes would cross a ~50 MB/s
             # tunnel the host verifier outruns 6-700x) and CPU-only hosts
             # keep the C walk; see probe_link for the measured economics.
+            # The verify stream ships RAW span bytes h2d (class semantics
+            # live in the per-byte accept tensors), so only the d2h side
+            # is discounted: with compaction on, the match-map fetch
+            # shrinks to ~STREAM_D2H_RATIO of its raw size.
+            from trivy_tpu.engine import link as link_mod
+
+            d2h_ratio = (
+                link_mod.STREAM_D2H_RATIO
+                if link_mod.d2h_compaction_enabled()
+                else 1.0
+            )
             verify = (
                 "device"
-                if _tpu_default_backend() and _link_is_wide()
+                if _tpu_default_backend()
+                and _link_is_wide(d2h_ratio=d2h_ratio)
                 else "dfa"
             )
         self.verify = verify

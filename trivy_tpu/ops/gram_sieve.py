@@ -58,8 +58,12 @@ def _gram_sieve_jit(rows, masks, vals):
     return gram_sieve_rows(rows, masks, vals)
 
 
-def make_sharded_gram_sieve(mesh: Mesh):
-    """Row axis sharded over the mesh 'data' axis; constants replicated."""
+def make_sharded_gram_sieve(mesh: Mesh, unpack=None):
+    """Row axis sharded over the mesh 'data' axis; constants replicated.
+
+    `unpack` (engine/link.py LinkCodec.make_unpack) decodes bit-packed
+    class-id rows ahead of the match — elementwise shifts/masks that keep
+    the row-axis sharding, so only the packed bytes cross the link."""
 
     @functools.partial(
         jax.jit,
@@ -71,6 +75,8 @@ def make_sharded_gram_sieve(mesh: Mesh):
         out_shardings=NamedSharding(mesh, P("data", None)),
     )
     def sharded(rows, masks, vals):
+        if unpack is not None:
+            rows = unpack(rows)
         return gram_sieve_rows(rows, masks, vals)
 
     return sharded

@@ -403,13 +403,17 @@ class PallasGramSieve:
         return out[:t] if pad else out
 
 
-def make_sharded_pallas_sieve(mesh, sieve: PallasGramSieve):
+def make_sharded_pallas_sieve(mesh, sieve: PallasGramSieve, pre=None):
     """The production kernel over a device mesh: the row axis shards across
     the mesh's 'data' axis with shard_map, each device running the Pallas
     program on its local rows (embarrassingly data-parallel — no collectives
     in the sieve itself; per-file OR/candidate resolution happens after
     gather).  Callers must size row batches to a multiple of
     (mesh devices x block_rows) so every shard tiles cleanly.
+
+    `pre` (the link codec's unpack) runs SHARD-LOCAL ahead of the kernel:
+    each device decodes only its own packed rows, so the decode never
+    induces a reshard or cross-device traffic.
     """
     import inspect
 
@@ -438,8 +442,13 @@ def make_sharded_pallas_sieve(mesh, sieve: PallasGramSieve):
         **extra,
     )
 
+    if pre is None:
+        local = sieve
+    else:
+        local = lambda rows: sieve(pre(rows))
+
     @jax.jit
     def sharded(rows: jax.Array) -> jax.Array:
-        return smap(sieve)(rows)
+        return smap(local)(rows)
 
     return sharded

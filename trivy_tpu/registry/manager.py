@@ -77,6 +77,13 @@ class RulesetManager:
     # -- observability (any thread) -------------------------------------
 
     @property
+    def active(self):
+        """The currently installed engine, or None before the first batch.
+        Never builds (unlike `engine()`): metrics scrapes must not trigger
+        a lazy compile on the HTTP thread."""
+        return self._active
+
+    @property
     def active_digest(self) -> str:
         with self._lock:
             return self._active_digest

@@ -33,7 +33,7 @@ from trivy_tpu.rules.model import RuleSet
 
 logger = logging.getLogger("trivy_tpu.registry")
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 ARTIFACT_NPZ = "artifact.npz"
 MANIFEST_JSON = "manifest.json"
 
@@ -80,11 +80,13 @@ class CompiledArtifact:
     pset: object  # engine.probes.ProbeSet
     gset: object  # engine.grams.GramSet
     manifest: dict
+    alphabet: object = None  # engine.link.LinkAlphabet (schema >= 2)
 
 
 def compile_ruleset(ruleset: RuleSet, digest: str | None = None) -> CompiledArtifact:
     """The cold path: Glushkov union NFA + probe set + gram constants."""
     from trivy_tpu.engine.grams import build_gram_set
+    from trivy_tpu.engine.link import derive_alphabet
     from trivy_tpu.engine.nfa import compile_rules
     from trivy_tpu.engine.probes import build_probe_set
 
@@ -94,7 +96,12 @@ def compile_ruleset(ruleset: RuleSet, digest: str | None = None) -> CompiledArti
     pset = build_probe_set(ruleset.rules)
     gset = build_gram_set(pset)
     return CompiledArtifact(
-        digest=digest, nfa=nfa, pset=pset, gset=gset, manifest={}
+        digest=digest,
+        nfa=nfa,
+        pset=pset,
+        gset=gset,
+        manifest={},
+        alphabet=derive_alphabet(gset),
     )
 
 
@@ -110,7 +117,16 @@ def _pack_arrays(art: CompiledArtifact) -> dict[str, np.ndarray]:
     row; ragged probe lengths and the per-rule plan lists serialize as CSR
     (ptr, ids) pairs so reload is exact and order-preserving.
     """
+    from trivy_tpu.engine.link import derive_alphabet
+
     nfa, pset, gset = art.nfa, art.pset, art.gset
+    # Canonical (exact, unmerged) link alphabet: stored so warm starts can
+    # build the H2D codec without touching the gram planner, and stored in
+    # canonical form so the artifact stays independent of the env-selected
+    # codec mode at save time.
+    alpha = art.alphabet
+    if alpha is None:
+        alpha = derive_alphabet(gset)
     probe_lens = np.array(
         [len(p.classes) for p in pset.probes], dtype=np.int32
     )
@@ -155,6 +171,8 @@ def _pack_arrays(art: CompiledArtifact) -> dict[str, np.ndarray]:
         "gset_window_probe": gset.window_probe,
         "gset_window_start": gset.window_start,
         "gset_probe_has_gram": gset.probe_has_gram,
+        "link_values": np.asarray(alpha.values, dtype=np.uint8),
+        "link_class_map": np.asarray(alpha.class_map, dtype=np.uint8),
     }
 
 
@@ -183,6 +201,7 @@ def _build_manifest(art: CompiledArtifact, arrays: dict) -> dict:
             "num_windows": int(gset.num_windows),
             "num_probes": int(gset.num_probes),
         },
+        "link": {"alphabet_size": int(len(arrays["link_values"]))},
         "shapes": {k: list(v.shape) for k, v in arrays.items()},
         "dtypes": {k: str(v.dtype) for k, v in arrays.items()},
         # Row-batch shape buckets the step kernels specialize on; the AOT
@@ -255,12 +274,32 @@ def _unpack_artifact(manifest: dict, z) -> CompiledArtifact:
         probe_has_gram=z["gset_probe_has_gram"],
         num_probes=int(manifest["gset"]["num_probes"]),
     )
+    # Never-trust the stored link alphabet: re-derive it from the (already
+    # shape/dtype-validated) gram tensors and require byte equality.  A
+    # tamperer who rewrote the class map AND recomputed npz_sha256 to match
+    # still fails here, because the map must agree with what the gram
+    # constants themselves imply — the sieve would silently mis-bucket
+    # bytes otherwise.
+    from trivy_tpu.engine.link import LinkAlphabet, derive_alphabet
+
+    fresh = derive_alphabet(gset)
+    stored_vals = np.asarray(z["link_values"], dtype=np.uint8)
+    stored_map = np.asarray(z["link_class_map"], dtype=np.uint8)
+    if not (
+        np.array_equal(stored_vals, fresh.values)
+        and np.array_equal(stored_map, fresh.class_map)
+    ):
+        raise ValueError(
+            "stored link class map does not match the gram tensors "
+            "(corrupt or tampered)"
+        )
     return CompiledArtifact(
         digest=manifest["ruleset_digest"],
         nfa=nfa,
         pset=pset,
         gset=gset,
         manifest=manifest,
+        alphabet=LinkAlphabet(values=stored_vals, class_map=stored_map),
     )
 
 
@@ -443,9 +482,11 @@ def aot_warmup(engine) -> dict:
         from trivy_tpu.ops import enable_compilation_cache
 
         enable_compilation_cache()
-        tile_len = engine.tile_len
+        # The sieve fn consumes STAGED rows: bit-packed class ids when the
+        # link codec engaged, raw bytes otherwise (engine/link.py).
+        cols = getattr(engine, "_staged_cols", engine.tile_len)
         for rows in engine._buckets():
-            spec = jax.ShapeDtypeStruct((rows, tile_len), jnp.uint8)
+            spec = jax.ShapeDtypeStruct((rows, cols), jnp.uint8)
             jax.jit(lambda t: fn(t)).lower(spec).compile()
             out["buckets"].append(rows)
             out["compiled"] += 1

@@ -117,6 +117,8 @@ class ScanServer:
         cache_dir: str = "", serve_config: ServeConfig | None = None,
         secret_engine_factory=None, secret_config: str = "",
         rules_cache_dir: str | None = None,
+        pipeline_depth: int | None = None,
+        resident_chunks: int | None = None,
     ):
         from trivy_tpu.scanner.vuln import init_vuln_scanner
 
@@ -132,6 +134,10 @@ class ScanServer:
         # warm start loads compiled artifacts from (None = registry off).
         self.secret_config = secret_config
         self.rules_cache_dir = rules_cache_dir
+        # Link tuning the default factory forwards to every engine it
+        # builds, including hot-reload replacements (None = engine default).
+        self.pipeline_depth = pipeline_depth
+        self.resident_chunks = resident_chunks
         self._config_digest: str | None = None
         self.scheduler = BatchScheduler(
             secret_engine_factory or self._build_engine,
@@ -150,8 +156,14 @@ class ScanServer:
         from trivy_tpu.rules.model import load_config
 
         cfg = load_config(self.secret_config) if self.secret_config else None
+        kw = {}
+        if self.pipeline_depth is not None:
+            kw["pipeline_depth"] = self.pipeline_depth
+        if self.resident_chunks is not None:
+            kw["resident_chunks"] = self.resident_chunks
         return make_secret_engine(
-            config=cfg, backend="auto", rules_cache_dir=self.rules_cache_dir
+            config=cfg, backend="auto",
+            rules_cache_dir=self.rules_cache_dir, **kw,
         )
 
     # -- service methods ------------------------------------------------
@@ -498,6 +510,8 @@ def make_http_server(
     secret_engine_factory=None,
     secret_config: str = "",
     rules_cache_dir: str | None = None,
+    pipeline_depth: int | None = None,
+    resident_chunks: int | None = None,
 ) -> ThreadingHTTPServer:
     host, _, port = addr.rpartition(":")
     scan_server = ScanServer(
@@ -506,6 +520,8 @@ def make_http_server(
         secret_engine_factory=secret_engine_factory,
         secret_config=secret_config,
         rules_cache_dir=rules_cache_dir,
+        pipeline_depth=pipeline_depth,
+        resident_chunks=resident_chunks,
     )
     httpd = ThreadingHTTPServer(
         (host or "localhost", int(port)), _make_handler(scan_server)
@@ -522,6 +538,8 @@ def serve(
     serve_config: ServeConfig | None = None,
     secret_config: str = "",
     rules_cache_dir: str | None = None,
+    pipeline_depth: int | None = None,
+    resident_chunks: int | None = None,
 ) -> None:
     """pkg/rpc/server/listen.go ListenAndServe, with graceful SIGTERM
     drain: stop admitting (503 + Retry-After), finish the batches already
@@ -534,6 +552,7 @@ def serve(
     httpd = make_http_server(
         addr, cache, token, db_dir, cache_dir, serve_config=serve_config,
         secret_config=secret_config, rules_cache_dir=rules_cache_dir,
+        pipeline_depth=pipeline_depth, resident_chunks=resident_chunks,
     )
     scan_server: ScanServer = httpd.scan_server
 

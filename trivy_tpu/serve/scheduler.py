@@ -420,4 +420,66 @@ class BatchScheduler:
             "# TYPE trivy_tpu_serve_ruleset_reloads_total counter",
             f"trivy_tpu_serve_ruleset_reloads_total {self.manager.reloads}",
         ]
+        lines.extend(self._engine_metric_lines())
         return "\n".join(lines) + "\n"
+
+    def _engine_metric_lines(self) -> list[str]:
+        """Link-economics gauges read off the active engine's SieveStats
+        (engine/device.py): resident-cache hits, pipeline h2d overlap, and
+        the raw-vs-coded byte accounting the link codec introduces.  Reads
+        the manager's non-building `active` accessor — a metrics scrape
+        must never trigger the lazy first-engine build — and tolerates
+        engines without stats (the oracle backend)."""
+        engine = self.manager.active
+        stats = getattr(engine, "stats", None)
+        if stats is None:
+            return []
+        lines = []
+
+        def gauge(name: str, help_text: str, value) -> None:
+            lines.append(f"# HELP trivy_tpu_engine_{name} {help_text}")
+            lines.append(f"# TYPE trivy_tpu_engine_{name} gauge")
+            if isinstance(value, float):
+                lines.append(f"trivy_tpu_engine_{name} {value:.6f}")
+            else:
+                lines.append(f"trivy_tpu_engine_{name} {value}")
+
+        gauge(
+            "resident_hits",
+            "device-resident chunk cache hits (H2D transfers skipped)",
+            int(getattr(stats, "resident_hits", 0)),
+        )
+        gauge(
+            "h2d_overlap_seconds",
+            "stage/execute overlap won by the chunk pipeline",
+            float(getattr(stats, "h2d_overlap_s", 0.0)),
+        )
+        raw = int(getattr(stats, "bytes_on_link_raw", 0))
+        coded = int(getattr(stats, "bytes_on_link_coded", 0))
+        gauge(
+            "link_bytes_raw",
+            "pre-codec payload bytes that needed device staging",
+            raw,
+        )
+        gauge(
+            "link_bytes_coded",
+            "post-codec bytes actually sent over the host-device link",
+            coded,
+        )
+        if raw:
+            gauge(
+                "link_codec_ratio",
+                "coded/raw H2D byte ratio (1.0 = codec disengaged)",
+                coded / raw,
+            )
+        gauge(
+            "d2h_bytes_raw",
+            "pre-compaction result bytes the device produced",
+            int(getattr(stats, "d2h_bytes_raw", 0)),
+        )
+        gauge(
+            "d2h_bytes",
+            "post-compaction bytes actually fetched from the device",
+            int(getattr(stats, "d2h_bytes", 0)),
+        )
+        return lines
