@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test smoke serve-smoke bench bench-link checks-corpus rules-cache
+.PHONY: test smoke serve-smoke obs-smoke bench bench-link checks-corpus rules-cache
 
 # Tier-1: the suite the driver holds the repo to (fast, CPU, no slow marks).
 test:
@@ -28,6 +28,17 @@ smoke:
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serve_smoke.py \
 		-m serve_smoke -q -p no:cacheprovider
+
+# Observability smoke: span-tree / off-by-default / exposition-lint tests
+# plus a BENCH_OBS-only bench run (disabled-path no-op span overhead < 2%
+# of scan wall asserted; findings off-vs-on byte-identical).
+obs-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_obs_trace.py \
+		tests/test_obs_metrics.py tests/test_observability.py \
+		-q -p no:cacheprovider && \
+	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
+		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
+		BENCH_IMAGE=0 $(PY) bench.py --smoke
 
 # Full benchmark (honest corpora; on CPU this takes a while).
 bench:

@@ -840,6 +840,74 @@ def bench_coldstart() -> dict:
         shutil.rmtree(cache, ignore_errors=True)
 
 
+def bench_obs(engine, n_files: int = 1500) -> dict:
+    """BENCH_OBS: observability cost (trivy_tpu/obs/).
+
+    Two claims back the always-compiled-in instrumentation: (1) disabled
+    — the default — a span call is one predicate returning a shared no-op
+    object; its per-call cost is microbenched and scaled by the span
+    count an enabled run of the same corpus actually emits, and that
+    bound must stay under 2% of the scan wall (asserted here rather than
+    via wall-clock A/B, which on a 1-core CI box is ±40% noise); (2)
+    enabled, findings stay byte-identical and the added wall is reported,
+    not asserted.
+    """
+    from trivy_tpu.obs import trace as obs_trace
+
+    corpus = bench_corpus.make_monorepo_corpus(n_files)
+    analyzer = _make_analyzer(engine)
+    items, _ = gate_corpus(corpus, analyzer)
+
+    obs_trace.disable()
+    obs_trace.clear()
+    t0 = time.perf_counter()
+    plain = engine.scan_batch(items)
+    off_wall = time.perf_counter() - t0
+
+    obs_trace.enable()
+    obs_trace.clear()
+    try:
+        t0 = time.perf_counter()
+        traced = engine.scan_batch(items)
+        on_wall = time.perf_counter() - t0
+        spans = obs_trace.snapshot()
+    finally:
+        obs_trace.disable()
+        obs_trace.clear()
+
+    identical = [repr(f) for f in plain] == [repr(f) for f in traced]
+    assert identical, "tracing changed findings"
+
+    # Disabled-path cost = (spans an enabled run would open) x (cost of
+    # the no-op span call), as a fraction of the untraced scan wall.
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with obs_trace.span("bench", items=1):
+            pass
+    noop_call_s = (time.perf_counter() - t0) / n
+    disabled_overhead = (
+        len(spans) * noop_call_s / off_wall if off_wall > 0 else 0.0
+    )
+    assert disabled_overhead < 0.02, (
+        f"disabled-path span overhead {disabled_overhead:.2%} >= 2%"
+    )
+    out = {
+        "files": len(items),
+        "findings_identical": identical,
+        "spans_per_scan": len(spans),
+        "noop_span_call_us": round(noop_call_s * 1e6, 4),
+        "disabled_overhead_pct": round(disabled_overhead * 100, 4),
+        "scan_wall_s": round(off_wall, 3),
+        "traced_wall_s": round(on_wall, 3),
+    }
+    if off_wall > 0:
+        out["enabled_overhead_pct"] = round(
+            (on_wall - off_wall) / off_wall * 100, 2
+        )
+    return out
+
+
 def _device_platform() -> str:
     try:
         import jax
@@ -891,6 +959,16 @@ def _compact_detail(detail: dict) -> dict:
             lc["fetch_compaction_x"] = vs["fetch_compaction_x"]
         if lc:
             c["link"] = lc
+    ob = detail.get("obs")
+    if isinstance(ob, dict):
+        c["obs"] = {
+            k: ob[k]
+            for k in (
+                "disabled_overhead_pct", "enabled_overhead_pct",
+                "findings_identical", "spans_per_scan", "error",
+            )
+            if k in ob
+        }
     vb = detail.get("verify_backend")
     if isinstance(vb, dict):
         vc = {
@@ -1075,6 +1153,15 @@ def main() -> None:
                 detail["serve"] = bench_serve(engine)
         except Exception as e:
             detail["serve"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if os.environ.get("BENCH_OBS", "1") == "1":
+        # Observability economics (trivy_tpu/obs/): disabled-path no-op
+        # span cost (<2% of scan wall, asserted), enabled-path wall and
+        # span count, findings identity off vs on.
+        try:
+            detail["obs"] = bench_obs(engine, n_files=300 if SMOKE else 1500)
+        except Exception as e:
+            detail["obs"] = {"error": f"{type(e).__name__}: {e}"}
 
     if os.environ.get("BENCH_COLDSTART", "1") == "1":
         # Registry cold-compile vs warm-load economics (trivy_tpu/registry/).

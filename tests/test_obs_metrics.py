@@ -1,0 +1,123 @@
+"""Unit tests for trivy_tpu/obs/metrics.py: registry, families, renderer."""
+
+import pytest
+
+from trivy_tpu.obs.metrics import (
+    BYTES_BUCKETS,
+    LATENCY_BUCKETS,
+    RATIO_BUCKETS,
+    Registry,
+)
+
+
+def test_counter_int_rendering_and_labels():
+    r = Registry()
+    c = r.counter("trivy_tpu_things_total", "things", labelnames=("kind",))
+    c.labels(kind="a").inc()
+    c.labels(kind="a").inc(2)
+    c.labels(kind="b").inc()
+    text = r.render()
+    assert "# HELP trivy_tpu_things_total things" in text
+    assert "# TYPE trivy_tpu_things_total counter" in text
+    # whole-valued counters render as ints, never 3.0
+    assert 'trivy_tpu_things_total{kind="a"} 3' in text
+    assert 'trivy_tpu_things_total{kind="b"} 1' in text
+
+
+def test_labelless_family_scrapes_zero_before_any_event():
+    r = Registry()
+    r.counter("trivy_tpu_nothing_total", "never incremented")
+    r.gauge("trivy_tpu_idle", "never set")
+    text = r.render()
+    assert "trivy_tpu_nothing_total 0" in text
+    assert "trivy_tpu_idle 0" in text
+
+
+def test_gauge_dec_floor():
+    r = Registry()
+    g = r.gauge("trivy_tpu_inflight", "inflight")
+    g.inc()
+    g.dec(floor=0.0)
+    g.dec(floor=0.0)  # double-exit must not go negative
+    assert "trivy_tpu_inflight 0\n" in r.render()
+
+
+def test_histogram_cumulative_buckets_and_inf():
+    r = Registry()
+    h = r.histogram(
+        "trivy_tpu_lat_seconds", "latency", buckets=(0.1, 1.0, 10.0)
+    )
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    lines = r.render().splitlines()
+    samples = [l for l in lines if l.startswith("trivy_tpu_lat_seconds")]
+    assert samples == [
+        'trivy_tpu_lat_seconds_bucket{le="0.1"} 1',
+        'trivy_tpu_lat_seconds_bucket{le="1"} 3',
+        'trivy_tpu_lat_seconds_bucket{le="10"} 4',
+        'trivy_tpu_lat_seconds_bucket{le="+Inf"} 5',
+        "trivy_tpu_lat_seconds_sum 56.05",
+        "trivy_tpu_lat_seconds_count 5",
+    ]
+
+
+def test_histogram_boundary_value_counts_into_its_bucket():
+    # le is <=: an observation exactly on a bound lands in that bucket.
+    r = Registry()
+    h = r.histogram("trivy_tpu_x", "x", buckets=(1.0, 2.0))
+    h.observe(1.0)
+    text = r.render()
+    assert 'trivy_tpu_x_bucket{le="1"} 1' in text
+
+
+def test_histogram_labels():
+    r = Registry()
+    h = r.histogram(
+        "trivy_tpu_phase_seconds", "phase", labelnames=("phase",),
+        buckets=(1.0,),
+    )
+    h.labels(phase="sieve").observe(0.5)
+    text = r.render()
+    assert 'trivy_tpu_phase_seconds_bucket{phase="sieve",le="1"} 1' in text
+    assert 'trivy_tpu_phase_seconds_count{phase="sieve"} 1' in text
+
+
+def test_reregistration_idempotent_and_conflict():
+    r = Registry()
+    a = r.counter("trivy_tpu_c_total", "c")
+    b = r.counter("trivy_tpu_c_total", "c")
+    assert a is b
+    with pytest.raises(ValueError):
+        r.gauge("trivy_tpu_c_total", "now a gauge?")
+    with pytest.raises(ValueError):
+        r.counter("trivy_tpu_c_total", "c", labelnames=("extra",))
+
+
+def test_bad_label_set_rejected():
+    r = Registry()
+    c = r.counter("trivy_tpu_l_total", "l", labelnames=("kind",))
+    with pytest.raises(ValueError):
+        c.labels(wrong="x")
+
+
+def test_label_value_escaping():
+    r = Registry()
+    c = r.counter("trivy_tpu_esc_total", "esc", labelnames=("v",))
+    c.labels(v='a"b\\c\nd').inc()
+    assert 'v="a\\"b\\\\c\\nd"' in r.render()
+
+
+def test_collect_hook_runs_and_failure_does_not_break_scrape():
+    r = Registry()
+    g = r.gauge("trivy_tpu_depth", "queue depth")
+    r.add_collect_hook(lambda: g.set(7))
+    r.add_collect_hook(lambda: 1 / 0)  # mid-teardown source object
+    text = r.render()
+    assert "trivy_tpu_depth 7" in text
+
+
+def test_default_bucket_sets_are_sane():
+    for bs in (LATENCY_BUCKETS, RATIO_BUCKETS, BYTES_BUCKETS):
+        assert list(bs) == sorted(bs)
+        assert len(set(bs)) == len(bs)
+    assert RATIO_BUCKETS[-1] == 1.0  # fill ratio is bounded [0, 1]

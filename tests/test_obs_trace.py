@@ -1,0 +1,211 @@
+"""Tracing tests: span trees, off-by-default no-op, export, JSON logs.
+
+The span-tree test runs a real in-process server with a device engine
+(JAX_PLATFORMS=cpu) so the full queue -> batch -> chunk.h2d -> chunk.exec
+-> chunk.fetch -> confirm chain exists, and asserts every stage carries
+the trace_id the client got back in X-Trivy-Trace-Id.
+"""
+
+import json
+import logging
+
+import pytest
+
+from trivy_tpu.cache.store import MemoryCache
+from trivy_tpu.engine.device import TpuSecretEngine
+from trivy_tpu.obs import trace as obs_trace
+from trivy_tpu.rpc.client import RemoteSecretEngine
+from trivy_tpu.rpc.server import start_background
+
+SECRET_FILE = b"AWS_ACCESS_KEY_ID=AKIAQ6FAKEKEY1234567\n"
+
+
+@pytest.fixture
+def tracing():
+    """Enable span collection for one test, restoring the default after."""
+    was = obs_trace.enabled()
+    obs_trace.enable()
+    obs_trace.clear()
+    yield
+    obs_trace.clear()
+    if not was:
+        obs_trace.disable()
+
+
+@pytest.fixture
+def no_tracing():
+    was = obs_trace.enabled()
+    obs_trace.disable()
+    obs_trace.clear()
+    yield
+    if was:
+        obs_trace.enable()
+
+
+def test_disabled_span_is_shared_noop(no_tracing):
+    s1 = obs_trace.span("x", items=3)
+    s2 = obs_trace.span("y")
+    assert s1 is s2  # one shared object: the disabled path allocates nothing
+    with s1 as sp:
+        sp.set(anything=1)
+    assert obs_trace.snapshot() == []
+    assert obs_trace.current_trace_id() == ""
+
+
+def test_span_nesting_links_parent_and_trace(tracing):
+    with obs_trace.span("outer") as outer:
+        tid = obs_trace.current_trace_id()
+        assert tid
+        with obs_trace.span("inner"):
+            pass
+    spans = {s.name: s for s in obs_trace.snapshot()}
+    assert spans["inner"].trace_id == spans["outer"].trace_id == tid
+    assert spans["inner"].parent_id == spans["outer"].span_id
+    assert spans["outer"].parent_id == 0
+    assert obs_trace.current_trace_id() == ""  # context restored
+
+
+def test_span_error_attr_and_context_reset(tracing):
+    with pytest.raises(RuntimeError):
+        with obs_trace.span("boom"):
+            raise RuntimeError("x")
+    (rec,) = obs_trace.snapshot()
+    assert rec.attrs["error"] == "RuntimeError"
+    assert obs_trace.current_trace_id() == ""
+
+
+def test_add_span_and_adopt(tracing):
+    with obs_trace.adopt("feedface00000000"):
+        assert obs_trace.current_trace_id() == "feedface00000000"
+        with obs_trace.span("work"):
+            pass
+    obs_trace.add_span("queue.wait", start=1.0, dur=-0.5, trace_id="t1")
+    by_name = {s.name: s for s in obs_trace.snapshot()}
+    assert by_name["work"].trace_id == "feedface00000000"
+    assert by_name["queue.wait"].dur == 0.0  # clamped, never negative
+
+
+def test_chrome_export_shape_and_dump(tracing, tmp_path):
+    with obs_trace.span("stage", bytes=42):
+        pass
+    doc = obs_trace.to_chrome()
+    assert doc["displayTimeUnit"] == "ms"
+    meta, ev = doc["traceEvents"][0], doc["traceEvents"][1]
+    assert meta["ph"] == "M" and meta["name"] == "process_name"
+    assert ev["ph"] == "X" and ev["name"] == "stage"
+    assert ev["dur"] >= 0 and ev["args"]["bytes"] == 42
+    assert ev["args"]["trace_id"]
+    out = obs_trace.dump(str(tmp_path / "sub" / "trace.json"))
+    with open(out, encoding="utf-8") as f:
+        assert json.load(f)["traceEvents"]
+
+
+def test_span_tree_one_trace_id_end_to_end(tracing):
+    """A --secret-backend server scan produces queue/batch/chunk/confirm
+    spans all carrying the trace_id echoed in X-Trivy-Trace-Id."""
+    srv, _ = start_background(
+        "localhost:0", MemoryCache(),
+        secret_engine_factory=lambda: TpuSecretEngine(tile_len=512),
+    )
+    try:
+        eng = RemoteSecretEngine(f"localhost:{srv.server_address[1]}")
+        findings = eng.scan_batch([("m/creds.env", SECRET_FILE)])
+        assert findings
+        tid = eng.last_trace_id
+        assert tid  # server echoed the header
+        hdr = next(
+            v for k, v in eng.client.last_response_headers.items()
+            if k.lower() == "x-trivy-trace-id"
+        )
+        assert hdr == tid
+        spans = obs_trace.snapshot()
+        names = {s.name for s in spans if s.trace_id == tid}
+        for stage in (
+            "rpc.scan_secrets", "queue.wait", "batch",
+            "chunk.h2d", "chunk.exec", "chunk.fetch", "confirm",
+        ):
+            assert stage in names, f"missing {stage} under trace {tid}"
+        # nothing leaked into a different trace
+        assert all(s.trace_id == tid for s in spans), (
+            {s.name: s.trace_id for s in spans}
+        )
+    finally:
+        srv.shutdown()
+
+
+def test_cli_trace_out_writes_chrome_json(tmp_path, no_tracing):
+    """`trivy-tpu scan --trace-out` enables collection for the run and
+    dumps one loadable Chrome-trace JSON rooted at a `scan` span."""
+    import contextlib
+    import io
+
+    from trivy_tpu.cli import main
+
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "creds.env").write_text(SECRET_FILE.decode())
+    out = tmp_path / "trace.json"
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main([
+            "fs", "--scanners", "secret", "--format", "json",
+            "--trace-out", str(out), str(proj),
+        ])
+    assert rc == 0
+    json.loads(buf.getvalue())  # report still well-formed
+    with open(out, encoding="utf-8") as f:
+        doc = json.load(f)
+    events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    roots = [e for e in events if e["name"] == "scan"]
+    assert roots, "no root scan span in --trace-out output"
+    tid = roots[0]["args"]["trace_id"]
+    assert sum(1 for e in events if e["args"]["trace_id"] == tid) >= 2
+
+
+def test_off_by_default_zero_spans_findings_identical(no_tracing):
+    """Tracing off (the default): no spans collect, and findings are
+    byte-identical to a traced run of the same engine."""
+    corpus = [
+        ("m/creds.env", SECRET_FILE),
+        ("m/app.py", b"x = 1\n" * 50),
+    ]
+    eng = TpuSecretEngine(tile_len=512)
+    plain = eng.scan_batch(corpus)
+    assert obs_trace.snapshot() == []
+    obs_trace.enable()
+    try:
+        traced = eng.scan_batch(corpus)
+        assert obs_trace.snapshot() != []
+    finally:
+        obs_trace.disable()
+        obs_trace.clear()
+    assert json.dumps([repr(s) for s in plain]) == json.dumps(
+        [repr(s) for s in traced]
+    )
+
+
+def test_json_log_format_with_trace_correlation(tracing, capsys):
+    from trivy_tpu.log import JsonFormatter, setup
+
+    setup(log_format="json")
+    try:
+        handler = next(
+            h for h in logging.getLogger("trivy_tpu").handlers
+            if getattr(h, "_trivy_console", False)
+        )
+        assert isinstance(handler.formatter, JsonFormatter)
+        rec = logging.LogRecord(
+            "trivy_tpu.serve.scheduler", logging.INFO, "f", 1,
+            "batch dispatched", None, None,
+        )
+        plain = json.loads(handler.formatter.format(rec))
+        assert plain["level"] == "INFO"
+        assert plain["logger"] == "serve.scheduler"
+        assert plain["msg"] == "batch dispatched"
+        assert "trace_id" not in plain  # no span open
+        with obs_trace.span("rpc.scan_secrets"):
+            tid = obs_trace.current_trace_id()
+            correlated = json.loads(handler.formatter.format(rec))
+        assert correlated["trace_id"] == tid
+    finally:
+        setup()  # restore console formatter for other tests

@@ -56,7 +56,39 @@ def test_server_metrics_endpoint():
         text = body.decode()
         assert 'trivy_tpu_requests_total{method="missing_blobs",code="200"} 1' in text
         assert 'code="404"' in text
-        assert "trivy_tpu_request_seconds_total" in text
+        # request latency is a histogram now: buckets + _sum + _count
+        assert 'trivy_tpu_request_seconds_bucket{method="missing_blobs",le="+Inf"} 1' in text
+        assert 'trivy_tpu_request_seconds_sum{method="missing_blobs"}' in text
+        assert 'trivy_tpu_request_seconds_count{method="missing_blobs"} 1' in text
+    finally:
+        srv.shutdown()
+
+
+def test_inflight_gauge_recovers_from_handler_error():
+    """A handler that raises must not leak the in-flight gauge (the old
+    counter pair could go permanently positive — or negative on a double
+    exit)."""
+    srv = make_http_server("localhost:0", MemoryCache(), token="")
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        base = f"http://localhost:{srv.server_address[1]}"
+        # scan_secrets with a malformed payload raises inside the handler.
+        bad = urllib.request.Request(
+            base + "/twirp/trivy.scanner.v1.Scanner/ScanSecrets",
+            data=b'{"Files": "not-a-list"}',
+            headers={"Content-Type": "application/json"},
+        )
+        for _ in range(3):
+            try:
+                urllib.request.urlopen(bad, timeout=10)
+            except urllib.error.HTTPError:
+                pass
+        text = urllib.request.urlopen(base + "/metrics", timeout=10).read().decode()
+        line = next(
+            l for l in text.splitlines()
+            if l.startswith("trivy_tpu_inflight_requests ")
+        )
+        assert line == "trivy_tpu_inflight_requests 0"
     finally:
         srv.shutdown()
 
@@ -130,9 +162,10 @@ def test_metrics_unknown_path_fixed_label():
 
 
 def test_metrics_exposition_format():
-    """Prometheus text-format regression: every sample line parses as
-    `name{labels} value`, every metric family carries HELP+TYPE, and the
-    serve/in-flight gauges added with the batching server are present."""
+    """Promtool-style lint of the /metrics exposition: every sample line
+    parses as `name{labels} value`, every family carries HELP+TYPE, names
+    match the trivy_tpu_[a-z_]+ convention, and each histogram's buckets
+    are cumulative and terminated by le="+Inf" matching _count."""
     import re
 
     srv = make_http_server("localhost:0", MemoryCache(), token="")
@@ -148,32 +181,80 @@ def test_metrics_exposition_format():
         text = urllib.request.urlopen(base + "/metrics", timeout=10).read().decode()
 
         sample = re.compile(
-            r'^[a-zA-Z_:][a-zA-Z0-9_:]*'            # metric name
+            r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'          # metric name
             r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"\\]*"'   # first label
             r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"\\]*")*\})?'  # more labels
-            r' -?[0-9.]+(e[+-][0-9]+)?$'             # value
+            r' (-?[0-9.]+(e[+-]?[0-9]+)?|\+Inf|NaN)$'    # value
         )
-        helps, types, names = set(), set(), set()
+        helps, names = set(), set()
+        types: dict[str, str] = {}
+        # histogram family -> {labels-without-le -> [(le, cumulative count)]}
+        buckets: dict[str, dict[str, list]] = {}
+        counts: dict[str, dict[str, float]] = {}
         for line in text.splitlines():
             if not line:
                 continue
             if line.startswith("# HELP "):
                 helps.add(line.split()[2])
-            elif line.startswith("# TYPE "):
+                continue
+            if line.startswith("# TYPE "):
                 parts = line.split()
-                types.add(parts[2])
                 assert parts[3] in ("counter", "gauge", "histogram", "summary")
-            else:
-                assert sample.match(line), f"bad exposition line: {line!r}"
-                names.add(line.split("{")[0].split()[0])
+                types[parts[2]] = parts[3]
+                continue
+            m = sample.match(line)
+            assert m, f"bad exposition line: {line!r}"
+            name = m.group(1)
+            names.add(name)
+            assert re.fullmatch(r"trivy_tpu_[a-z0-9_]+", name), (
+                f"name breaks the trivy_tpu_[a-z_]+ convention: {name}"
+            )
+            labels = m.group(2) or ""
+            value = float(line.rsplit(" ", 1)[1].replace("+Inf", "inf"))
+            for suffix, store in (("_bucket", buckets), ("_count", counts)):
+                fam = name[: -len(suffix)]
+                if name.endswith(suffix) and fam in types:
+                    le = ""
+                    keep = []
+                    for pair in labels.strip("{}").split(","):
+                        if pair.startswith("le="):
+                            le = pair[4:-1]
+                        elif pair:
+                            keep.append(pair)
+                    key = ",".join(keep)
+                    if suffix == "_bucket":
+                        store.setdefault(fam, {}).setdefault(key, []).append(
+                            (le, value)
+                        )
+                    else:
+                        store.setdefault(fam, {})[key] = value
         # Every sample belongs to a family announced with HELP + TYPE.
         for n in names:
-            base_name = n[:-4] if n.endswith("_sum") and n not in types else n
-            assert n in types or base_name in types, f"no TYPE for {n}"
-            assert n in helps or base_name in helps, f"no HELP for {n}"
+            fam = n
+            for suffix in ("_bucket", "_sum", "_count"):
+                if n.endswith(suffix) and n[: -len(suffix)] in types:
+                    fam = n[: -len(suffix)]
+            assert fam in types, f"no TYPE for {n}"
+            assert fam in helps, f"no HELP for {n}"
+        # Histogram contract: buckets cumulative, +Inf last, +Inf == _count.
+        assert buckets, "no histograms in the exposition"
+        for fam, series in buckets.items():
+            assert types[fam] == "histogram"
+            for key, bs in series.items():
+                les = [le for le, _ in bs]
+                assert les[-1] == "+Inf", f"{fam}: buckets not +Inf-terminated"
+                bounds = [float(le.replace("+Inf", "inf")) for le in les]
+                assert bounds == sorted(bounds), f"{fam}: le out of order"
+                vals = [v for _, v in bs]
+                assert vals == sorted(vals), f"{fam}: buckets not cumulative"
+                assert vals[-1] == counts[fam][key], (
+                    f"{fam}: le=+Inf bucket != _count"
+                )
         assert "trivy_tpu_inflight_requests" in names
         assert "trivy_tpu_serve_queue_depth" in names
         assert "trivy_tpu_serve_batches_total" in names
         assert "trivy_tpu_serve_rejected_total" in names
+        assert types.get("trivy_tpu_request_seconds") == "histogram"
+        assert types.get("trivy_tpu_serve_batch_fill_ratio") == "histogram"
     finally:
         srv.shutdown()

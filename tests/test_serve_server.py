@@ -272,6 +272,6 @@ def test_metrics_expose_serve_and_inflight(serve_server):
         "trivy_tpu_serve_batches_total",
         "trivy_tpu_serve_coalesced_requests_total",
         "trivy_tpu_serve_batch_fill_ratio_sum",
-        "trivy_tpu_serve_ticket_wait_seconds_total",
+        "trivy_tpu_serve_ticket_wait_seconds_sum",
     ):
         assert counter in body

@@ -231,6 +231,15 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
         "--trace", action="store_true", default=_bool_default("trace"),
         help="attach rego evaluation traces to misconfiguration findings",
     )
+    p.add_argument(
+        "--trace-out", default=_env_default("trace-out", ""),
+        help="write host span timeline (Chrome-trace JSON) to this path",
+    )
+    p.add_argument(
+        "--log-format", choices=("console", "json"),
+        default=_env_default("log-format", "console"),
+        help="log line format: console (default) or one JSON object per line",
+    )
     p.add_argument("--cache-dir", default=_env_default("cache-dir", ""))
     p.add_argument(
         "--cache-backend",
@@ -384,6 +393,8 @@ def _options_from_args(args: argparse.Namespace) -> Options:
         rekor_url=args.rekor_url,
         profile_dir=getattr(args, "profile_dir", ""),
         trace=getattr(args, "trace", False),
+        trace_out=getattr(args, "trace_out", ""),
+        log_format=getattr(args, "log_format", "console"),
     )
 
 
@@ -649,6 +660,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="device-resident chunk LRU capacity for the server engine "
         "(default 32; TRIVY_TPU_RESIDENT_CHUNKS)",
     )
+    p_server.add_argument(
+        "--profile-dir",
+        default=_env_default("profile-dir", ""),
+        help="default output directory for POST /admin/profile/start "
+        "windows (JAX device trace + host spans)",
+    )
+    p_server.add_argument(
+        "--log-format", choices=("console", "json"),
+        default=_env_default("log-format", "console"),
+        help="log line format: console (default) or one JSON object per "
+        "line with trace_id correlation",
+    )
 
     # Ruleset registry maintenance: precompile, list, verify artifacts.
     p_rules = sub.add_parser(
@@ -791,6 +814,7 @@ def main(argv: list[str] | None = None) -> int:
         debug=getattr(args, "debug", False),
         quiet=getattr(args, "quiet", False),
         no_color=getattr(args, "no_color", False),
+        log_format=getattr(args, "log_format", "console"),
     )
 
     if args.command in (None, "version"):
@@ -838,6 +862,7 @@ def main(argv: list[str] | None = None) -> int:
             rules_cache_dir=resolve_rules_cache_dir(args.rules_cache_dir),
             pipeline_depth=args.pipeline_depth,
             resident_chunks=args.resident_chunks,
+            profile_dir=args.profile_dir,
         )
         return 0
 

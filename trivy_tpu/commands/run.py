@@ -85,6 +85,8 @@ class Options:
     rekor_url: str = ""  # --rekor-url (unpackaged SBOM lookups)
     profile_dir: str = ""  # --profile-dir (JAX profiler trace of the scan)
     trace: bool = False  # --trace (rego traces on misconfig findings)
+    trace_out: str = ""  # --trace-out (host span Chrome-trace JSON path)
+    log_format: str = "console"  # --log-format console|json
     config_check: list[str] = field(default_factory=list)  # --config-check dirs
     insecure_registry: bool = False  # plain-http registry pulls
     username: str = ""  # private-registry basic/bearer credentials
@@ -356,6 +358,25 @@ def run(options: Options, target_kind: str) -> int:
     The worker also arms a cooperative deadline (trivy_tpu/deadline.py) that
     the analyzer dispatch checks, so the scan aborts shortly after the
     timeout instead of running on (and writing reports) in the background."""
+    from trivy_tpu.obs import trace as obs_trace
+
+    trace_out = getattr(options, "trace_out", "")
+    if trace_out:
+        obs_trace.enable()
+    if obs_trace.enabled():
+        with obs_trace.span("scan", target_kind=target_kind):
+            rc = _run_profiled(options, target_kind)
+        if trace_out:
+            obs_trace.dump(trace_out)
+        if getattr(options, "profile_dir", ""):
+            # Host spans land beside the device profile so Perfetto can
+            # load both into one timeline (profiles/README).
+            obs_trace.dump_into_profile_dir(options.profile_dir)
+        return rc
+    return _run_profiled(options, target_kind)
+
+
+def _run_profiled(options: Options, target_kind: str) -> int:
     if getattr(options, "profile_dir", ""):
         # Profiling must never break the scan — and a scan error must
         # never read as a profiler error.  Enter/exit are guarded
@@ -387,6 +408,7 @@ def run(options: Options, target_kind: str) -> int:
 
 def _run_with_timeout(options: Options, target_kind: str) -> int:
     if options.timeout and options.timeout > 0:
+        import contextvars
         import threading
 
         from trivy_tpu import deadline as _deadline
@@ -402,7 +424,10 @@ def _run_with_timeout(options: Options, target_kind: str) -> int:
             finally:
                 _deadline.clear()
 
-        t = threading.Thread(target=_worker, daemon=True)
+        # copy_context: the worker inherits the ambient trace context, so
+        # engine spans nest under run()'s root `scan` span.
+        ctx = contextvars.copy_context()
+        t = threading.Thread(target=lambda: ctx.run(_worker), daemon=True)
         t.start()
         t.join(options.timeout)
         if t.is_alive():

@@ -32,6 +32,7 @@ import numpy as np
 from trivy_tpu.ftypes import Secret
 from trivy_tpu.engine.grams import GramSet, build_gram_set
 from trivy_tpu.engine.oracle import OracleScanner
+from trivy_tpu.obs import trace as obs_trace
 from trivy_tpu.engine.probes import ProbeSet, build_probe_set
 from trivy_tpu.rules.model import RuleSet, SecretConfig, build_ruleset
 from trivy_tpu.scanner.packing import (
@@ -447,7 +448,8 @@ class TpuSecretEngine:
         import time as _time
 
         t0 = _time.perf_counter()
-        coded = self._link.encode_rows(part)
+        with obs_trace.span("chunk.encode", bytes=part.nbytes):
+            coded = self._link.encode_rows(part)
         self.stats.encode_s += _time.perf_counter() - t0
         return coded, part.nbytes
 
@@ -545,7 +547,9 @@ class TpuSecretEngine:
                 if hit is not None:
                     return (digest, hit, True)
             self._count_link(raw_n, buf.nbytes)
-            return (digest, jax.device_put(buf), False)
+            with obs_trace.span("chunk.h2d", chunk=ci, bytes=buf.nbytes):
+                dev = jax.device_put(buf)
+            return (digest, dev, False)
 
         def execute(ci, staged):
             digest, dev, hit = staged
@@ -553,13 +557,17 @@ class TpuSecretEngine:
                 self.stats.resident_hits += 1
                 return (digest, dev, True)
             self.stats.device_dispatches += 1
-            return (digest, exec_fn(dev), False)
+            with obs_trace.span("chunk.exec", chunk=ci):
+                out = exec_fn(dev)
+            return (digest, out, False)
 
         def finish(ci, handle):
             digest, out, hit = handle
-            out = out if hit else self._fetch_hits(out)
-            if not hit and digest is not None:
-                self._resident.put(digest, out)
+            if not hit:
+                with obs_trace.span("chunk.fetch", chunk=ci):
+                    out = self._fetch_hits(out)
+                if digest is not None:
+                    self._resident.put(digest, out)
             outs[ci] = out
 
         pipe = ChunkPipeline(
@@ -583,7 +591,14 @@ class TpuSecretEngine:
         import jax.numpy as jnp
 
         if not os.environ.get("TRIVY_TPU_SYNC_TIMING"):
-            return self._fetch_hits(self._sieve_fn(jnp.asarray(buf)))
+            # Split so the trace shows where a synchronous dispatch's time
+            # lands (dispatch is async; the fetch span absorbs the wait).
+            with obs_trace.span("chunk.h2d", bytes=buf.nbytes):
+                dev = jnp.asarray(buf)
+            with obs_trace.span("chunk.exec"):
+                out = self._sieve_fn(dev)
+            with obs_trace.span("chunk.fetch"):
+                return self._fetch_hits(out)
         t0 = _time.perf_counter()
         dev = jax.device_put(buf)
         np.asarray(dev[:1, :1])  # forced round-trip: transfer is done
@@ -673,19 +688,20 @@ class TpuSecretEngine:
         import time as _time
 
         t0 = _time.perf_counter()
-        fis, ris = np.nonzero(cand)
-        if len(fis):
-            contents = [c for _, c in items]
-            lens = np.fromiter(
-                (len(c) for c in contents), dtype=np.int64, count=len(items)
-            )
-            ptr_arr = (ctypes.c_char_p * len(items))(*contents)
-            ok = verifier.verify_pairs_files(
-                ptr_arr, lens,
-                fis.astype(np.int32), ris.astype(np.int32),
-            )
-            cand = cand.copy()
-            cand[fis[~ok.astype(bool)], ris[~ok.astype(bool)]] = False
+        with obs_trace.span("verify", files=len(items)):
+            fis, ris = np.nonzero(cand)
+            if len(fis):
+                contents = [c for _, c in items]
+                lens = np.fromiter(
+                    (len(c) for c in contents), dtype=np.int64, count=len(items)
+                )
+                ptr_arr = (ctypes.c_char_p * len(items))(*contents)
+                ok = verifier.verify_pairs_files(
+                    ptr_arr, lens,
+                    fis.astype(np.int32), ris.astype(np.int32),
+                )
+                cand = cand.copy()
+                cand[fis[~ok.astype(bool)], ris[~ok.astype(bool)]] = False
         self.stats.verify_s += _time.perf_counter() - t0
         return cand
 
@@ -724,22 +740,25 @@ class TpuSecretEngine:
 
         t0 = _time.perf_counter()
         results: list[Secret] = []
-        for fi, (path, content) in enumerate(items):
-            idxs = np.flatnonzero(cand[fi])
-            if len(idxs) == 0:
-                # Preserve the reference's allow-path result shape
-                # (scanner.go:375-380 returns Secret{FilePath} for allowed
-                # paths, empty Secret otherwise) even when the sieve lets us
-                # skip the oracle entirely.
-                if self.oracle.allow_path(path):
-                    results.append(Secret(file_path=path))
-                else:
-                    results.append(Secret())
-                continue
-            self.stats.candidate_pairs += len(idxs)
-            res = self.oracle.scan(path, content, rule_indices=idxs.tolist())
-            self.stats.confirmed_findings += len(res.findings)
-            results.append(res)
+        with obs_trace.span("confirm", files=len(items)):
+            for fi, (path, content) in enumerate(items):
+                idxs = np.flatnonzero(cand[fi])
+                if len(idxs) == 0:
+                    # Preserve the reference's allow-path result shape
+                    # (scanner.go:375-380 returns Secret{FilePath} for allowed
+                    # paths, empty Secret otherwise) even when the sieve lets
+                    # us skip the oracle entirely.
+                    if self.oracle.allow_path(path):
+                        results.append(Secret(file_path=path))
+                    else:
+                        results.append(Secret())
+                    continue
+                self.stats.candidate_pairs += len(idxs)
+                res = self.oracle.scan(
+                    path, content, rule_indices=idxs.tolist()
+                )
+                self.stats.confirmed_findings += len(res.findings)
+                results.append(res)
         self.stats.confirm_s += _time.perf_counter() - t0
         return results
 

@@ -33,6 +33,7 @@ scans; `make_secret_engine` picks per availability.
 
 from __future__ import annotations
 
+import contextvars
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -41,6 +42,7 @@ import numpy as np
 
 from trivy_tpu.engine.device import SieveStats, TpuSecretEngine
 from trivy_tpu.ftypes import Secret
+from trivy_tpu.obs import trace as obs_trace
 
 # Shared empty result for non-candidate files (see the confirm loop): reads
 # only — consumers filter on findings and empties never reach mutation sites.
@@ -554,9 +556,20 @@ class HybridSecretEngine(TpuSecretEngine):
                 dev_lanes,
             )
 
+        def _sieve_traced(contents):
+            with obs_trace.span(
+                "sieve", files=len(contents),
+                bytes=sum(len(c) for c in contents),
+            ):
+                return self._sieve_chunk(contents)
+
         pipe = ChunkPipeline(
+            # copy_context: the pool worker inherits the ambient
+            # (trace_id, span_id), so worker-side sieve spans land in the
+            # batch's tree instead of starting orphan traces.
             stage=lambda span: pool.submit(
-                self._sieve_chunk, [c for _p, c in items[span[0] : span[1]]]
+                contextvars.copy_context().run, _sieve_traced,
+                [c for _p, c in items[span[0] : span[1]]],
             ),
             execute=lambda span, fut: fut,
             finish=_finish,
@@ -594,14 +607,15 @@ class HybridSecretEngine(TpuSecretEngine):
             # a device verifier present, only its pass-through lanes walk
             # here; the rest verify on device at end of scan.
             t0 = time.perf_counter()
-            sub = scan_pairs[host]
-            ok = self._dfa_verifier.verify_pairs_files(
-                ptr_arr, lens,
-                sub[:, 0], sub[:, 1], sub[:, 2], sub[:, 3],
-            )
-            keep = np.ones(len(scan_pairs), dtype=bool)
-            keep[host] = ok.astype(bool)
-            scan_pairs, dev_mask = scan_pairs[keep], dev_mask[keep]
+            with obs_trace.span("verify", pairs=int(host.sum())):
+                sub = scan_pairs[host]
+                ok = self._dfa_verifier.verify_pairs_files(
+                    ptr_arr, lens,
+                    sub[:, 0], sub[:, 1], sub[:, 2], sub[:, 3],
+                )
+                keep = np.ones(len(scan_pairs), dtype=bool)
+                keep[host] = ok.astype(bool)
+                scan_pairs, dev_mask = scan_pairs[keep], dev_mask[keep]
             self.stats.verify_s += time.perf_counter() - t0
         dev_files: set[int] = set()
         if dev_mask.any():
@@ -661,12 +675,13 @@ class HybridSecretEngine(TpuSecretEngine):
         # candidates the oracle's own allow_path gate reproduces the same
         # result when the loop below overwrites the slot.
         empty = _EMPTY_SECRET
-        results[lo:hi] = [empty] * (hi - lo)
-        a0, a1 = np.searchsorted(allowed_pos, (lo, hi))
-        for i in allowed_pos[a0:a1].tolist():
-            results[i] = Secret(file_path=items[i][0])
-        for fi, idxs in pairs:
-            self._confirm_file(items, lo + int(fi), idxs, results)
+        with obs_trace.span("confirm", files=hi - lo):
+            results[lo:hi] = [empty] * (hi - lo)
+            a0, a1 = np.searchsorted(allowed_pos, (lo, hi))
+            for i in allowed_pos[a0:a1].tolist():
+                results[i] = Secret(file_path=items[i][0])
+            for fi, idxs in pairs:
+                self._confirm_file(items, lo + int(fi), idxs, results)
         self.stats.confirm_s += time.perf_counter() - t0
 
     def _confirm_file(self, items, gi: int, idxs, results) -> None:
@@ -711,7 +726,8 @@ class HybridSecretEngine(TpuSecretEngine):
         )
         sub = unver[:, :4].copy()
         sub[:, 0] = inv
-        ok = self._nfa_verifier.verify_lanes(contents, sub, lens)
+        with obs_trace.span("verify", pairs=len(unver), device=True):
+            ok = self._nfa_verifier.verify_lanes(contents, sub, lens)
         self.stats.device_pairs += len(unver)
         surviving = np.concatenate(
             [lanes[lanes[:, 4] == 1][:, :2], unver[ok][:, :2]]
@@ -719,17 +735,18 @@ class HybridSecretEngine(TpuSecretEngine):
         self.stats.verify_s += time.perf_counter() - t0
 
         t0 = time.perf_counter()
-        order = np.lexsort((surviving[:, 1], surviving[:, 0]))
-        surviving = surviving[order]
-        if len(surviving):
-            fis = surviving[:, 0]
-            splits = np.flatnonzero(fis[1:] != fis[:-1]) + 1
-            for gi, idxs in zip(
-                fis[np.r_[0, splits]], np.split(surviving[:, 1], splits)
-            ):
-                self._confirm_file(
-                    items, int(gi), np.unique(idxs).tolist(), results
-                )
+        with obs_trace.span("confirm", lanes=len(surviving)):
+            order = np.lexsort((surviving[:, 1], surviving[:, 0]))
+            surviving = surviving[order]
+            if len(surviving):
+                fis = surviving[:, 0]
+                splits = np.flatnonzero(fis[1:] != fis[:-1]) + 1
+                for gi, idxs in zip(
+                    fis[np.r_[0, splits]], np.split(surviving[:, 1], splits)
+                ):
+                    self._confirm_file(
+                        items, int(gi), np.unique(idxs).tolist(), results
+                    )
         self.stats.confirm_s += time.perf_counter() - t0
 
 

@@ -53,6 +53,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from trivy_tpu.engine.redfa import compile_search_nfa64, compute_prefix_bounds
+from trivy_tpu.obs import trace as obs_trace
 
 MAX_LEN = 1 << 15  # lanes whose walk window exceeds this verify on host
 LEN_BUCKETS = (512, 1024, 2048, 4096, 8192, 16384, MAX_LEN)
@@ -539,11 +540,12 @@ class NfaVerifier:
         def _fetch_one():
             tier_, lo_, hi_, out = in_flight.popleft()
             tf = _time.perf_counter()
-            if compact_fetch:
-                packed, raw_b, got_b = link_mod.fetch_stream_packed(out)
-            else:
-                packed = np.asarray(out)
-                raw_b = got_b = packed.nbytes
+            with obs_trace.span("verify.fetch", rows=hi_ - lo_):
+                if compact_fetch:
+                    packed, raw_b, got_b = link_mod.fetch_stream_packed(out)
+                else:
+                    packed = np.asarray(out)
+                    raw_b = got_b = packed.nbytes
             st["fetch_bytes_raw"] += raw_b
             st["fetch_bytes"] += got_b
             dtf = _time.perf_counter() - tf
@@ -556,35 +558,38 @@ class NfaVerifier:
             """Dispatch rows [row_lo, row_hi) of `tier` in group-bucket
             chunks, fetching oldest results once `depth` are in flight."""
             td = _time.perf_counter()
-            if tens is None:
-                _build_tensors()
-            length = tiers[tier]
-            gi = row_lo
-            while gi < row_hi:
-                remaining = -(-(row_hi - gi) // LANES_PER_GROUP)
-                gcap = next(
-                    (g for g in gbuckets if remaining <= g), gbuckets[-1]
-                )
-                lo = gi
-                hi = min(lo + gcap * LANES_PER_GROUP, row_hi)
-                gi = hi
-                rows_arr = np.zeros(
-                    (gcap * LANES_PER_GROUP, length), dtype=np.uint8
-                )
-                for k, row in enumerate(range(lo, hi)):
-                    rows_arr[k] = rows_buf[tier][row]
-                # [G*Bg, L] -> [Lo, 32, G, Bg]
-                bytes_t = np.ascontiguousarray(
-                    rows_arr.reshape(
-                        gcap, LANES_PER_GROUP, length // STREAM_BLOCK,
-                        STREAM_BLOCK,
-                    ).transpose(2, 3, 0, 1)
-                )
-                bd = self._put_stream(bytes_t)
-                in_flight.append((tier, lo, hi, run(bd, *tens)))
-                st["dispatches"] += 1
-                while len(in_flight) > depth:
-                    _fetch_one()
+            with obs_trace.span(
+                "verify.dispatch", tier=tier, rows=row_hi - row_lo
+            ):
+                if tens is None:
+                    _build_tensors()
+                length = tiers[tier]
+                gi = row_lo
+                while gi < row_hi:
+                    remaining = -(-(row_hi - gi) // LANES_PER_GROUP)
+                    gcap = next(
+                        (g for g in gbuckets if remaining <= g), gbuckets[-1]
+                    )
+                    lo = gi
+                    hi = min(lo + gcap * LANES_PER_GROUP, row_hi)
+                    gi = hi
+                    rows_arr = np.zeros(
+                        (gcap * LANES_PER_GROUP, length), dtype=np.uint8
+                    )
+                    for k, row in enumerate(range(lo, hi)):
+                        rows_arr[k] = rows_buf[tier][row]
+                    # [G*Bg, L] -> [Lo, 32, G, Bg]
+                    bytes_t = np.ascontiguousarray(
+                        rows_arr.reshape(
+                            gcap, LANES_PER_GROUP, length // STREAM_BLOCK,
+                            STREAM_BLOCK,
+                        ).transpose(2, 3, 0, 1)
+                    )
+                    bd = self._put_stream(bytes_t)
+                    in_flight.append((tier, lo, hi, run(bd, *tens)))
+                    st["dispatches"] += 1
+                    while len(in_flight) > depth:
+                        _fetch_one()
             st["dispatch_s"] += _time.perf_counter() - td
 
         # flat per-lane placement (vectorized verdict resolution):

@@ -37,6 +37,8 @@ from collections import OrderedDict, deque
 from dataclasses import dataclass
 from typing import Callable, Iterable
 
+from trivy_tpu.obs import trace as obs_trace
+
 DEFAULT_DEPTH = 2
 DEFAULT_RESIDENT_CHUNKS = 32
 
@@ -95,6 +97,14 @@ class ChunkPipeline:
         self.stats = PipelineStats(depth=depth or default_depth())
 
     def run(self, chunks: Iterable) -> None:
+        with obs_trace.span("pipeline", depth=self.stats.depth) as sp:
+            self._run(chunks)
+            sp.set(
+                chunks=self.stats.chunks,
+                h2d_overlap_s=round(self.stats.h2d_overlap_s, 4),
+            )
+
+    def _run(self, chunks: Iterable) -> None:
         depth = self.stats.depth
         inflight: deque = deque()
         try:

@@ -1,12 +1,18 @@
 """Logging setup: the reference's colored console handler discipline
 (pkg/log): level-colored prefixes on a tty, plain text otherwise,
 --debug/--quiet verbosity control, per-module loggers unchanged.
+
+`--log-format json` swaps the console formatter for one JSON object per
+line (ts/level/logger/msg), stamped with the ambient span's trace_id when
+one is open — the key that joins a log line to its request's span tree.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import sys
+import time
 
 _COLORS = {
     logging.DEBUG: "\x1b[35m",  # magenta
@@ -37,8 +43,33 @@ class ConsoleFormatter(logging.Formatter):
         return out
 
 
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line.  trace_id appears only when a span is
+    open on the emitting thread (obs/trace.py contextvar) — server logs
+    correlate to /debug/traces without any per-call plumbing."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        from trivy_tpu.obs import trace as obs_trace
+
+        out = {
+            "ts": time.strftime(
+                "%Y-%m-%dT%H:%M:%S", time.localtime(record.created)
+            ),
+            "level": record.levelname,
+            "logger": record.name.removeprefix("trivy_tpu."),
+            "msg": record.getMessage(),
+        }
+        trace_id = obs_trace.current_trace_id()
+        if trace_id:
+            out["trace_id"] = trace_id
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out)
+
+
 def setup(
-    debug: bool = False, quiet: bool = False, no_color: bool = False
+    debug: bool = False, quiet: bool = False, no_color: bool = False,
+    log_format: str = "console",
 ) -> None:
     """Install the console handler on the package root logger.
 
@@ -50,8 +81,11 @@ def setup(
             logger.removeHandler(h)
     handler = logging.StreamHandler(sys.stderr)
     handler._trivy_console = True  # type: ignore[attr-defined]
-    color = not no_color and sys.stderr.isatty()
-    handler.setFormatter(ConsoleFormatter(color))
+    if log_format == "json":
+        handler.setFormatter(JsonFormatter())
+    else:
+        color = not no_color and sys.stderr.isatty()
+        handler.setFormatter(ConsoleFormatter(color))
     logger.addHandler(handler)
     # Propagation stays on: the root logger has no handlers in CLI use
     # (no double printing) and log-capture tooling relies on it.

@@ -1,0 +1,20 @@
+"""Observability substrate: span tracing (obs/trace.py) and the
+counter/gauge/histogram metrics registry (obs/metrics.py).
+
+One trace from RPC ticket to TPU kernel: `RemoteSecretEngine` mints a
+trace_id, ships it as `X-Trivy-Trace-Id`, the server stamps it onto the
+scheduler ticket, and every pipeline stage (queue wait, batch fill,
+per-chunk encode/h2d/exec/fetch, host confirm) opens a span carrying it.
+Spans land in a bounded ring buffer and export as Chrome-trace JSON
+(`trivy-tpu scan --trace-out`, server `GET /debug/traces`), which Perfetto
+merges with the JAX profiler's device timeline when both write into one
+`--profile-dir`.
+
+Everything is off by default: `span()` returns a no-op singleton unless
+tracing was enabled (`TRIVY_TPU_TRACE=1` or `trace.enable()`), so the
+scan path pays one predicate per call site.
+"""
+
+from trivy_tpu.obs import metrics, trace
+
+__all__ = ["metrics", "trace"]

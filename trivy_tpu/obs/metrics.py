@@ -1,0 +1,314 @@
+"""Counter/gauge/histogram families with one registry + exposition renderer.
+
+The server's and scheduler's hand-rolled Prometheus text lines grew the same
+code three times (rpc/server.py _Metrics, serve/scheduler.py metrics_text and
+_engine_metric_lines); this module is the single exposition path they all
+render through.  Families register on a `Registry` (per-server, never
+process-global — tests boot many servers in one process), samples update at
+event time, and `render()` emits text-format 0.0.4: HELP + TYPE per family,
+histogram `_bucket{le=...}` series cumulative and `le="+Inf"`-terminated,
+`_sum`/`_count` alongside.
+
+Latency lives in fixed-bucket histograms, not totals: a `*_seconds_total`
+counter answers "how much", a histogram answers "how bad is the tail", and
+the tail is what an admission queue tunes against.
+
+Collect hooks run at scrape time for values owned elsewhere (queue depth,
+ruleset epoch, engine link gauges) — a hook must never do work a scrape
+shouldn't trigger (the scheduler's hook reads the non-building
+`RulesetManager.active`, exactly like the render path it replaces).
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from typing import Callable, Iterable
+
+# Request/wait latency buckets: 1ms..60s, roughly log-spaced.  The scan
+# server's floor is a batch window of a few ms and its ceiling a deadline
+# of minutes; these cover both tails.
+LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+# Batch fill ratio is bounded [0, 1]; resolution matters near empty
+# (window expired) and near full (bytes-capped dispatch).
+RATIO_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
+# Per-batch byte volumes: 4 KiB .. 256 MiB, x4 steps.
+BYTES_BUCKETS = tuple(float(4096 * 4**i) for i in range(9))
+
+
+def _fmt(v: float | int) -> str:
+    """Exposition value: ints stay ints, floats trim trailing zeros."""
+    if isinstance(v, bool):
+        return str(int(v))
+    if isinstance(v, int):
+        return str(v)
+    if v != v or v in (float("inf"), float("-inf")):
+        return {float("inf"): "+Inf", float("-inf"): "-Inf"}.get(v, "NaN")
+    out = f"{v:.9f}".rstrip("0").rstrip(".")
+    return out or "0"
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _label_str(names: tuple[str, ...], values: tuple[str, ...]) -> str:
+    if not names:
+        return ""
+    inner = ",".join(
+        f'{n}="{_escape(str(v))}"' for n, v in zip(names, values)
+    )
+    return "{" + inner + "}"
+
+
+class _Family:
+    kind = ""
+
+    def __init__(
+        self, name: str, help_text: str, labelnames: tuple[str, ...],
+        lock: threading.Lock,
+    ):
+        self.name = name
+        self.help = help_text
+        self.labelnames = tuple(labelnames)
+        self._lock = lock
+        self._children: dict[tuple[str, ...], object] = {}
+        if not self.labelnames:
+            # Label-less families expose their zero sample immediately
+            # (a gauge that has never been set must still scrape as 0).
+            self._child(())
+
+    def _new_child(self):
+        raise NotImplementedError
+
+    def _child(self, key: tuple[str, ...]):
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(key, self._new_child())
+        return child
+
+    def labels(self, **kw):
+        if set(kw) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: labels {sorted(kw)} != {sorted(self.labelnames)}"
+            )
+        return self._child(tuple(str(kw[n]) for n in self.labelnames))
+
+    def render(self) -> list[str]:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
+        with self._lock:
+            items = sorted(self._children.items())
+        for key, child in items:
+            lines.extend(self._render_child(key, child))
+        return lines
+
+    def _render_child(self, key, child) -> list[str]:
+        raise NotImplementedError
+
+
+class _Value:
+    __slots__ = ("v", "_lock")
+
+    def __init__(self, lock: threading.Lock):
+        self.v = 0.0
+        self._lock = lock
+
+
+class _CounterChild(_Value):
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.v += amount
+
+    def set_total(self, value: float) -> None:
+        """Collect-hook seat: adopt a monotonic total owned elsewhere
+        (e.g. RulesetManager.reloads) instead of double-counting events."""
+        with self._lock:
+            self.v = value
+
+
+class Counter(_Family):
+    kind = "counter"
+
+    def _new_child(self):
+        return _CounterChild(self._lock)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._child(()).inc(amount)
+
+    def set_total(self, value: float) -> None:
+        self._child(()).set_total(value)
+
+    def _render_child(self, key, child) -> list[str]:
+        return [
+            f"{self.name}{_label_str(self.labelnames, key)} {_fmt(_as_num(child.v))}"
+        ]
+
+
+class _GaugeChild(_Value):
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.v = value
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.v += amount
+
+    def dec(self, amount: float = 1.0, floor: float | None = None) -> None:
+        with self._lock:
+            self.v -= amount
+            if floor is not None and self.v < floor:
+                self.v = floor
+
+
+class Gauge(_Family):
+    kind = "gauge"
+
+    def _new_child(self):
+        return _GaugeChild(self._lock)
+
+    def set(self, value: float) -> None:
+        self._child(()).set(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._child(()).inc(amount)
+
+    def dec(self, amount: float = 1.0, floor: float | None = None) -> None:
+        self._child(()).dec(amount, floor)
+
+    def _render_child(self, key, child) -> list[str]:
+        return [
+            f"{self.name}{_label_str(self.labelnames, key)} {_fmt(_as_num(child.v))}"
+        ]
+
+
+class _HistogramChild:
+    __slots__ = ("counts", "sum", "count", "_buckets", "_lock")
+
+    def __init__(self, buckets: tuple[float, ...], lock: threading.Lock):
+        self._buckets = buckets
+        self.counts = [0] * len(buckets)  # per-bucket (non-cumulative)
+        self.sum = 0.0
+        self.count = 0
+        self._lock = lock
+
+    def observe(self, value: float) -> None:
+        i = bisect_left(self._buckets, value)
+        with self._lock:
+            if i < len(self.counts):
+                self.counts[i] += 1
+            self.sum += value
+            self.count += 1
+
+
+class Histogram(_Family):
+    kind = "histogram"
+
+    def __init__(self, name, help_text, labelnames, lock, buckets):
+        b = tuple(sorted(float(x) for x in buckets))
+        if not b:
+            raise ValueError(f"{name}: histogram needs >= 1 bucket bound")
+        self.buckets = b
+        super().__init__(name, help_text, labelnames, lock)
+
+    def _new_child(self):
+        return _HistogramChild(self.buckets, self._lock)
+
+    def observe(self, value: float) -> None:
+        self._child(()).observe(value)
+
+    def _render_child(self, key, child) -> list[str]:
+        lines = []
+        cum = 0
+        for bound, n in zip(self.buckets, child.counts):
+            cum += n
+            labels = _label_str(
+                self.labelnames + ("le",), key + (_fmt(bound),)
+            )
+            lines.append(f"{self.name}_bucket{labels} {cum}")
+        inf_labels = _label_str(self.labelnames + ("le",), key + ("+Inf",))
+        lines.append(f"{self.name}_bucket{inf_labels} {child.count}")
+        plain = _label_str(self.labelnames, key)
+        lines.append(f"{self.name}_sum{plain} {_fmt(child.sum)}")
+        lines.append(f"{self.name}_count{plain} {child.count}")
+        return lines
+
+
+def _as_num(v: float):
+    """Render-friendly: whole floats print as ints (counters that only
+    ever inc(1) must expose `3`, not `3.0`)."""
+    return int(v) if isinstance(v, float) and v.is_integer() else v
+
+
+class Registry:
+    """One scrape surface: ordered families + collect hooks."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: dict[str, _Family] = {}
+        self._hooks: list[Callable[[], None]] = []
+
+    def _register(self, cls, name: str, help_text: str, labelnames, **kw):
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if not isinstance(fam, cls) or fam.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        f"metric {name!r} re-registered with a different "
+                        f"type or label set"
+                    )
+                return fam
+            fam = cls(name, help_text, tuple(labelnames),
+                      threading.Lock(), **kw)
+            self._families[name] = fam
+            return fam
+
+    def counter(
+        self, name: str, help_text: str, labelnames: Iterable[str] = ()
+    ) -> Counter:
+        return self._register(Counter, name, help_text, labelnames)
+
+    def gauge(
+        self, name: str, help_text: str, labelnames: Iterable[str] = ()
+    ) -> Gauge:
+        return self._register(Gauge, name, help_text, labelnames)
+
+    def histogram(
+        self,
+        name: str,
+        help_text: str,
+        labelnames: Iterable[str] = (),
+        buckets: Iterable[float] = LATENCY_BUCKETS,
+    ) -> Histogram:
+        return self._register(
+            Histogram, name, help_text, labelnames, buckets=buckets
+        )
+
+    def add_collect_hook(self, fn: Callable[[], None]) -> None:
+        """`fn()` runs at every render(), before lines are built — the seat
+        for gauges mirroring live state (queue depth, engine stats).  Hooks
+        must be cheap and must never build what is not already built."""
+        with self._lock:
+            self._hooks.append(fn)
+
+    def render(self) -> str:
+        with self._lock:
+            hooks = list(self._hooks)
+            fams = list(self._families.values())
+        for fn in hooks:
+            try:
+                fn()
+            except Exception:
+                # A scrape must never 500 because one hook's source object
+                # is mid-teardown; the stale sample is the lesser evil.
+                pass
+        lines: list[str] = []
+        for fam in fams:
+            lines.extend(fam.render())
+        return "\n".join(lines) + "\n"

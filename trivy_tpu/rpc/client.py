@@ -22,6 +22,7 @@ from typing import Iterable
 from trivy_tpu.atypes import ArtifactInfo, BlobInfo, _secret_from_json
 from trivy_tpu.cache.store import ArtifactCache
 from trivy_tpu.ftypes import Secret
+from trivy_tpu.obs import trace as obs_trace
 from trivy_tpu.rpc.convert import blob_to_json, os_from_json, result_from_json
 from trivy_tpu.rpc.server import TOKEN_HEADER
 from trivy_tpu.scanner.service import Driver, ScanOptions
@@ -67,6 +68,9 @@ class RpcClient:
     wire: str = "json"
     max_retries: int = MAX_RETRIES
     timeout_s: float = 300.0  # per-attempt socket timeout
+    # Response headers of the last successful call (trace correlation:
+    # the server echoes X-Trivy-Trace-Id here).
+    last_response_headers: dict[str, str] = field(default_factory=dict)
     sleep = staticmethod(time.sleep)  # test seam
 
     def call(self, path: str, payload: dict) -> dict:
@@ -100,6 +104,7 @@ class RpcClient:
             try:
                 with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
                     raw = resp.read()
+                    self.last_response_headers = dict(resp.headers.items())
                     if self.wire == "protobuf":
                         from trivy_tpu.rpc import protowire
 
@@ -210,15 +215,41 @@ class RemoteSecretEngine:
         # thin clients log/compare which rule version produced findings
         # even though no ruleset is loaded locally.
         self.ruleset_digest = ""
+        # Trace id of the last batch, as echoed in the server's
+        # X-Trivy-Trace-Id response header: the key that joins this
+        # client's spans with the server's batch/chunk spans.
+        self.last_trace_id = ""
 
     def scan_batch(self, items: list[tuple[str, bytes]]) -> list[Secret]:
         if not items:
             return []
-        resp = self.client.scan_secrets(
-            items,
-            timeout_ms=int(self.timeout_s * 1000) if self.timeout_s else None,
-            client_id=self.client_id,
+        # This is where a trace is born: mint an id (or inherit the
+        # enclosing span's), ship it in the request header so server-side
+        # queue/batch/chunk spans join this client's tree.
+        trace_id = ""
+        if obs_trace.enabled():
+            trace_id = obs_trace.current_trace_id() or obs_trace.new_trace_id()
+            self.client.headers["X-Trivy-Trace-Id"] = trace_id
+        with obs_trace.span(
+            "rpc.scan_secrets",
+            trace_id=trace_id or None,
+            items=len(items),
+            bytes=sum(len(c) for _, c in items),
+        ):
+            resp = self.client.scan_secrets(
+                items,
+                timeout_ms=int(self.timeout_s * 1000) if self.timeout_s else None,
+                client_id=self.client_id,
+            )
+        echoed = next(
+            (
+                v
+                for k, v in self.client.last_response_headers.items()
+                if k.lower() == "x-trivy-trace-id"
+            ),
+            "",
         )
+        self.last_trace_id = echoed or trace_id
         self.ruleset_digest = str(resp.get("RulesetDigest") or "")
         secrets = [
             _secret_from_json(d) for d in (resp.get("Secrets") or [])

@@ -46,6 +46,8 @@ from trivy_tpu.cache.store import (
     MemoryCache,
 )
 from trivy_tpu.deadline import ScanTimeoutError
+from trivy_tpu.obs import metrics as obs_metrics
+from trivy_tpu.obs import trace as obs_trace
 from trivy_tpu.rpc.convert import blob_from_json, os_to_json, result_to_json
 from trivy_tpu.scanner.service import (
     LocalDriver,
@@ -58,54 +60,37 @@ TOKEN_HEADER = "Trivy-Tpu-Token"
 
 
 class _Metrics:
-    """Process counters in Prometheus text exposition format (the aux
-    metrics subsystem seat — the reference exposes its server metrics the
-    same pull-based way)."""
+    """RPC request families on the server's shared registry.  Latency is a
+    per-method HISTOGRAM (the totals-only rendering this replaces could
+    not show tail latency, the number an admission queue tunes against),
+    and inflight is floor-clamped on exit so a raising handler can never
+    drive the gauge negative."""
 
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self.requests: dict[tuple[str, str], int] = {}  # (method, code) -> n
-        self.seconds: dict[str, float] = {}  # method -> total latency
-        self.inflight = 0  # RPC requests currently in a handler
+    def __init__(self, registry: obs_metrics.Registry) -> None:
+        self._requests = registry.counter(
+            "trivy_tpu_requests_total",
+            "RPC requests by method and code",
+            labelnames=("method", "code"),
+        )
+        self._seconds = registry.histogram(
+            "trivy_tpu_request_seconds",
+            "handler latency by method",
+            labelnames=("method",),
+        )
+        self._inflight = registry.gauge(
+            "trivy_tpu_inflight_requests",
+            "RPC requests currently being handled",
+        )
 
     def observe(self, method: str, code: int, elapsed: float) -> None:
-        with self._lock:
-            key = (method, str(code))
-            self.requests[key] = self.requests.get(key, 0) + 1
-            self.seconds[method] = self.seconds.get(method, 0.0) + elapsed
+        self._requests.labels(method=method, code=str(code)).inc()
+        self._seconds.labels(method=method).observe(elapsed)
 
     def enter(self) -> None:
-        with self._lock:
-            self.inflight += 1
+        self._inflight.inc()
 
     def exit(self) -> None:
-        with self._lock:
-            self.inflight -= 1
-
-    def render(self) -> str:
-        with self._lock:
-            lines = [
-                "# HELP trivy_tpu_requests_total RPC requests by method and code",
-                "# TYPE trivy_tpu_requests_total counter",
-            ]
-            for (method, code), n in sorted(self.requests.items()):
-                lines.append(
-                    f'trivy_tpu_requests_total{{method="{method}",code="{code}"}} {n}'
-                )
-            lines += [
-                "# HELP trivy_tpu_request_seconds_total cumulative handler latency",
-                "# TYPE trivy_tpu_request_seconds_total counter",
-            ]
-            for method, secs in sorted(self.seconds.items()):
-                lines.append(
-                    f'trivy_tpu_request_seconds_total{{method="{method}"}} {secs:.6f}'
-                )
-            lines += [
-                "# HELP trivy_tpu_inflight_requests RPC requests currently being handled",
-                "# TYPE trivy_tpu_inflight_requests gauge",
-                f"trivy_tpu_inflight_requests {self.inflight}",
-            ]
-            return "\n".join(lines) + "\n"
+        self._inflight.dec(floor=0.0)
 
 
 class ScanServer:
@@ -119,12 +104,16 @@ class ScanServer:
         rules_cache_dir: str | None = None,
         pipeline_depth: int | None = None,
         resident_chunks: int | None = None,
+        profile_dir: str = "",
     ):
         from trivy_tpu.scanner.vuln import init_vuln_scanner
 
         self.cache = cache
         self.token = token
-        self.metrics = _Metrics()
+        # One registry per server: _Metrics' request families and the
+        # scheduler's serve/engine families render as one /metrics body.
+        self.registry = obs_metrics.Registry()
+        self.metrics = _Metrics(self.registry)
         self.driver = LocalDriver(
             cache, vuln_detector=init_vuln_scanner(db_dir, cache_dir)
         )
@@ -142,8 +131,15 @@ class ScanServer:
         self.scheduler = BatchScheduler(
             secret_engine_factory or self._build_engine,
             self.serve_config,
+            registry=self.registry,
         )
         self.draining = False  # SIGTERM: reject new work with 503
+        # Live-profiling window (POST /admin/profile/start|stop): default
+        # output dir from --profile-dir, overridable per start request.
+        self.profile_dir = profile_dir
+        self._profile_lock = threading.Lock()
+        self._profiling = False
+        self._profile_path = ""
 
     def _build_engine(self):
         """Default engine factory: built lazily ON the engine-owner thread
@@ -221,6 +217,7 @@ class ScanServer:
             items,
             client_id=str(req.get("ClientID") or req.get("_client") or ""),
             timeout_s=timeout_s,
+            trace_id=str(req.get("_trace_id") or ""),
         )
         # Deadline-armed requests never hang the connection: even a wedged
         # engine bounds the wait (the slack covers a dispatched batch that
@@ -268,6 +265,50 @@ class ScanServer:
             "RulesetDigest": digest,
             "Epoch": self.scheduler.ruleset_epoch(),
             "Staged": True,
+        }
+
+    # -- live profiling ---------------------------------------------------
+
+    def profile_start(self, req: dict) -> dict:
+        """POST /admin/profile/start: open a JAX profiler trace of the live
+        serving window (scan-only had this via --profile-dir; a server
+        needs it switchable without restarting).  One window at a time."""
+        path = (req or {}).get("ProfileDir", "") or self.profile_dir
+        if not path:
+            raise ValueError(
+                "no profile dir: pass ProfileDir or start the server "
+                "with --profile-dir"
+            )
+        with self._profile_lock:
+            if self._profiling:
+                raise ValueError(
+                    f"profiler already active ({self._profile_path})"
+                )
+            import jax
+
+            jax.profiler.start_trace(path)
+            self._profiling = True
+            self._profile_path = path
+        return {"Profiling": True, "ProfileDir": path}
+
+    def profile_stop(self, req: dict) -> dict:
+        """POST /admin/profile/stop: close the profiler window and drop the
+        host span ring into the same directory, so Perfetto shows host
+        stages against the device timeline."""
+        with self._profile_lock:
+            if not self._profiling:
+                raise ValueError("profiler not active")
+            import jax
+
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                self._profiling = False
+            host = obs_trace.dump_into_profile_dir(self._profile_path)
+        return {
+            "Profiling": False,
+            "ProfileDir": self._profile_path,
+            "HostTrace": host or "",
         }
 
     def ruleset_digest(self) -> str:
@@ -330,8 +371,11 @@ _ROUTES = {
     "/twirp/trivy.cache.v1.Cache/PutBlob": "put_blob",
     "/twirp/trivy.cache.v1.Cache/MissingBlobs": "missing_blobs",
     "/twirp/trivy.cache.v1.Cache/DeleteBlobs": "delete_blobs",
-    # Admin plane (token-authed like every POST): stage a ruleset swap.
+    # Admin plane (token-authed like every POST): stage a ruleset swap,
+    # open/close a live JAX profiler window.
     "/admin/ruleset/reload": "reload_ruleset",
+    "/admin/profile/start": "profile_start",
+    "/admin/profile/stop": "profile_stop",
 }
 
 
@@ -367,14 +411,21 @@ def _make_handler(server: ScanServer):
                 self._send(200, {"Version": __version__})
             elif self.path == "/metrics":
                 body = (
-                    server.metrics.render()
-                    + server.scheduler.metrics_text()
-                    + server.build_info_text()
+                    server.registry.render() + server.build_info_text()
                 ).encode()
                 self.send_response(200)
                 self.send_header(
                     "Content-Type", "text/plain; version=0.0.4"
                 )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path == "/debug/traces":
+                # Span ring as Chrome-trace JSON — load in Perfetto or
+                # chrome://tracing.  Empty traceEvents when tracing is off.
+                body = json.dumps(obs_trace.to_chrome()).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -397,6 +448,16 @@ def _make_handler(server: ScanServer):
             raw = self.rfile.read(length)
             method = _ROUTES.get(self.path)
             start = _time.monotonic()
+            # Cross-boundary trace propagation: adopt the client's id (a
+            # sanitized copy — header bytes must not flow into traces or
+            # logs verbatim) or mint one so the response header always
+            # names the trace this request's spans carry.
+            hdr = self.headers.get("X-Trivy-Trace-Id", "")
+            trace_id = "".join(
+                c for c in hdr if c.isalnum() or c in "-_"
+            )[:64]
+            if not trace_id and obs_trace.enabled():
+                trace_id = obs_trace.new_trace_id()
 
             def send(
                 code: int, payload: dict,
@@ -408,6 +469,9 @@ def _make_handler(server: ScanServer):
                 server.metrics.observe(
                     method or "unknown", code, _time.monotonic() - start
                 )
+                if trace_id:
+                    headers = dict(headers or {})
+                    headers.setdefault("X-Trivy-Trace-Id", trace_id)
                 self._send(code, payload, headers)
 
             if server.token and not hmac.compare_digest(
@@ -445,7 +509,10 @@ def _make_handler(server: ScanServer):
                         send(415, {"error": "protobuf wire unavailable"})
                         return
                     req = protowire.decode_request(method, raw)
-                    out = getattr(server, method)(req)
+                    with obs_trace.span(
+                        f"rpc.{method}", trace_id=trace_id or None
+                    ):
+                        out = getattr(server, method)(req)
                     data = protowire.encode_response(method, out)
                     server.metrics.observe(
                         method, 200, _time.monotonic() - start
@@ -461,11 +528,16 @@ def _make_handler(server: ScanServer):
                     self.wfile.write(data)
                     return
                 req = json.loads(raw or b"{}")
-                if method == "scan_secrets" and "_client" not in req:
-                    # Per-client in-flight caps key on the explicit ClientID
-                    # when sent, else the peer address.
-                    req["_client"] = self.client_address[0]
-                out = getattr(server, method)(req)
+                if method == "scan_secrets":
+                    if "_client" not in req:
+                        # Per-client in-flight caps key on the explicit
+                        # ClientID when sent, else the peer address.
+                        req["_client"] = self.client_address[0]
+                    req["_trace_id"] = trace_id
+                with obs_trace.span(
+                    f"rpc.{method}", trace_id=trace_id or None
+                ):
+                    out = getattr(server, method)(req)
                 if method in ("scan", "scan_secrets"):
                     # Every scan response states which ruleset produced it.
                     dig = out.get("RulesetDigest") or server.ruleset_digest()
@@ -512,6 +584,7 @@ def make_http_server(
     rules_cache_dir: str | None = None,
     pipeline_depth: int | None = None,
     resident_chunks: int | None = None,
+    profile_dir: str = "",
 ) -> ThreadingHTTPServer:
     host, _, port = addr.rpartition(":")
     scan_server = ScanServer(
@@ -522,6 +595,7 @@ def make_http_server(
         rules_cache_dir=rules_cache_dir,
         pipeline_depth=pipeline_depth,
         resident_chunks=resident_chunks,
+        profile_dir=profile_dir,
     )
     httpd = ThreadingHTTPServer(
         (host or "localhost", int(port)), _make_handler(scan_server)
@@ -540,6 +614,7 @@ def serve(
     rules_cache_dir: str | None = None,
     pipeline_depth: int | None = None,
     resident_chunks: int | None = None,
+    profile_dir: str = "",
 ) -> None:
     """pkg/rpc/server/listen.go ListenAndServe, with graceful SIGTERM
     drain: stop admitting (503 + Retry-After), finish the batches already
@@ -553,6 +628,7 @@ def serve(
         addr, cache, token, db_dir, cache_dir, serve_config=serve_config,
         secret_config=secret_config, rules_cache_dir=rules_cache_dir,
         pipeline_depth=pipeline_depth, resident_chunks=resident_chunks,
+        profile_dir=profile_dir,
     )
     scan_server: ScanServer = httpd.scan_server
 
@@ -591,6 +667,7 @@ def start_background(
     addr: str, cache: ArtifactCache, token: str = "", db_dir: str = "",
     serve_config: ServeConfig | None = None, secret_engine_factory=None,
     secret_config: str = "", rules_cache_dir: str | None = None,
+    profile_dir: str = "",
 ) -> tuple[ThreadingHTTPServer, threading.Thread]:
     """In-process server for tests (the §4 'multi-node without a cluster'
     pattern: integration_test.go:77-103 binds a real server on a free port)."""
@@ -600,6 +677,7 @@ def start_background(
         secret_engine_factory=secret_engine_factory,
         secret_config=secret_config,
         rules_cache_dir=rules_cache_dir,
+        profile_dir=profile_dir,
     )
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()

@@ -44,6 +44,8 @@ from dataclasses import dataclass, field
 
 from trivy_tpu import deadline as _deadline
 from trivy_tpu.deadline import ScanTimeoutError
+from trivy_tpu.obs import metrics as obs_metrics
+from trivy_tpu.obs import trace as obs_trace
 from trivy_tpu.registry.manager import RulesetManager
 
 
@@ -89,6 +91,13 @@ class ServeConfig:
     retry_after_s: float = 1.0  # backpressure hint on 429/503
 
 
+# SieveStats seconds accumulators diffed per batch into the
+# serve_batch_phase_seconds histogram (label = attr minus the "_s").
+_PHASE_ATTRS = (
+    "pack_s", "encode_s", "sieve_s", "candidate_s", "verify_s", "confirm_s",
+)
+
+
 @dataclass
 class Ticket:
     """One request's admission into the batcher."""
@@ -99,6 +108,7 @@ class Ticket:
     future: Future
     nbytes: int
     enqueued_at: float
+    trace_id: str = ""  # X-Trivy-Trace-Id from the request, "" = untraced
 
 
 @dataclass
@@ -130,7 +140,12 @@ class BatchScheduler:
     the owner thread, so engines need no internal locking.
     """
 
-    def __init__(self, engine_factory, config: ServeConfig | None = None):
+    def __init__(
+        self,
+        engine_factory,
+        config: ServeConfig | None = None,
+        registry: obs_metrics.Registry | None = None,
+    ):
         self.config = config or ServeConfig()
         self._engine_factory = engine_factory
         # The manager owns the active/staged engine pair; only _dispatch
@@ -144,7 +159,92 @@ class BatchScheduler:
         self._inflight: dict[str, int] = {}
         self._admitting = True
         self._thread: threading.Thread | None = None
+        # SchedulerStats stays the programmatic surface (bench.py and the
+        # serve tests read it); the registry is the exposition surface.
+        # Both are written at event time — dual-write, one source of truth
+        # per consumer.
         self.stats = SchedulerStats()
+        self.registry = registry if registry is not None else obs_metrics.Registry()
+        self._register_metrics()
+
+    def _register_metrics(self) -> None:
+        r = self.registry
+        self._m_queue_depth = r.gauge(
+            "trivy_tpu_serve_queue_depth", "tickets waiting for dispatch"
+        )
+        self._m_inflight = r.gauge(
+            "trivy_tpu_serve_inflight_tickets",
+            "tickets admitted and unresolved",
+        )
+        self._m_tickets = r.counter(
+            "trivy_tpu_serve_tickets_total", "admitted tickets"
+        )
+        self._m_rejected = r.counter(
+            "trivy_tpu_serve_rejected_total",
+            "admission rejections by reason",
+            labelnames=("reason",),
+        )
+        # Pre-create the reason children so every rejection lane scrapes
+        # as 0 before its first event (dashboards alert on rate(), which
+        # needs the series to exist).
+        for reason in ("queue_full", "client_cap", "closed"):
+            self._m_rejected.labels(reason=reason)
+        self._m_expired = r.counter(
+            "trivy_tpu_serve_expired_total",
+            "tickets cancelled at their deadline before dispatch",
+        )
+        self._m_batches = r.counter(
+            "trivy_tpu_serve_batches_total", "dispatched device batches"
+        )
+        self._m_multi = r.counter(
+            "trivy_tpu_serve_multi_request_batches_total",
+            "batches coalescing two or more requests",
+        )
+        self._m_coalesced = r.counter(
+            "trivy_tpu_serve_coalesced_requests_total",
+            "requests summed over dispatched batches",
+        )
+        self._m_items = r.counter(
+            "trivy_tpu_serve_batch_items_total",
+            "items summed over dispatched batches",
+        )
+        self._m_bytes_total = r.counter(
+            "trivy_tpu_serve_batch_bytes_total",
+            "payload bytes summed over dispatched batches",
+        )
+        self._m_fill = r.histogram(
+            "trivy_tpu_serve_batch_fill_ratio",
+            "per-batch bytes/max_batch_bytes at dispatch",
+            buckets=obs_metrics.RATIO_BUCKETS,
+        )
+        self._m_wait = r.histogram(
+            "trivy_tpu_serve_ticket_wait_seconds",
+            "enqueue-to-dispatch wait per ticket",
+        )
+        self._m_batch_bytes = r.histogram(
+            "trivy_tpu_serve_batch_bytes",
+            "payload bytes per dispatched batch",
+            buckets=obs_metrics.BYTES_BUCKETS,
+        )
+        self._m_phase = r.histogram(
+            "trivy_tpu_serve_batch_phase_seconds",
+            "engine seconds per batch by pipeline phase",
+            labelnames=("phase",),
+        )
+        self._m_errors = r.counter(
+            "trivy_tpu_serve_batch_errors_total",
+            "batches failed by an engine exception",
+        )
+        self._m_epoch = r.gauge(
+            "trivy_tpu_serve_ruleset_epoch",
+            "engine installs since start (0 = no engine yet)",
+        )
+        self._m_reloads = r.counter(
+            "trivy_tpu_serve_ruleset_reloads_total",
+            "live engine replacements (hot reloads)",
+        )
+        self._engine_gauges: dict[str, obs_metrics.Gauge] = {}
+        r.add_collect_hook(self._collect)
 
     # -- admission (request threads) ------------------------------------
 
@@ -153,6 +253,7 @@ class BatchScheduler:
         items: list[tuple[str, bytes]],
         client_id: str = "",
         timeout_s: float | None = None,
+        trace_id: str = "",
     ) -> Future:
         """Enqueue one request's items; returns a Future resolving to the
         per-item list[Secret].  Raises AdmissionError subclasses instead of
@@ -168,15 +269,18 @@ class BatchScheduler:
             future=Future(),
             nbytes=sum(len(c) for _, c in items),
             enqueued_at=now,
+            trace_id=trace_id,
         )
         with self._not_empty:
             if not self._admitting:
                 self.stats.rejected_closed += 1
+                self._m_rejected.labels(reason="closed").inc()
                 raise SchedulerClosedError(
                     "scheduler draining", cfg.retry_after_s
                 )
             if len(self._q) >= cfg.max_queue_depth:
                 self.stats.rejected_full += 1
+                self._m_rejected.labels(reason="queue_full").inc()
                 raise QueueFullError(
                     f"admission queue full ({cfg.max_queue_depth} tickets)",
                     cfg.retry_after_s,
@@ -186,6 +290,7 @@ class BatchScheduler:
                 >= cfg.max_inflight_per_client
             ):
                 self.stats.rejected_client += 1
+                self._m_rejected.labels(reason="client_cap").inc()
                 raise ClientOverloadedError(
                     f"client {ticket.client_id!r} at in-flight cap "
                     f"({cfg.max_inflight_per_client})",
@@ -196,6 +301,7 @@ class BatchScheduler:
             )
             self._q.append(ticket)
             self.stats.admitted += 1
+            self._m_tickets.inc()
             if self._thread is None:
                 self._thread = threading.Thread(
                     target=self._run, name="serve-batcher", daemon=True
@@ -249,6 +355,7 @@ class BatchScheduler:
 
     def _expire(self, ticket: Ticket) -> None:
         self.stats.expired += 1
+        self._m_expired.inc()
         ticket.future.set_exception(
             ScanTimeoutError("request deadline expired before dispatch")
         )
@@ -303,16 +410,34 @@ class BatchScheduler:
         for t in batch:
             spans.append((len(combined), len(combined) + len(t.items)))
             combined.extend(t.items)
-            self.stats.wait_s_sum += max(0.0, t0 - t.enqueued_at)
+            wait = max(0.0, t0 - t.enqueued_at)
+            self.stats.wait_s_sum += wait
+            self._m_wait.observe(wait)
+            # The wait interval is only known now, at dispatch — record it
+            # retroactively so the trace tree shows queue time per ticket.
+            obs_trace.add_span(
+                "queue.wait",
+                start=time.perf_counter() - wait,
+                dur=wait,
+                trace_id=t.trace_id,
+                client=t.client_id,
+                items=len(t.items),
+            )
+        fill = min(1.0, nbytes / max(self.config.max_batch_bytes, 1))
         self.stats.batches += 1
+        self._m_batches.inc()
         self.stats.coalesced_requests += len(batch)
+        self._m_coalesced.inc(len(batch))
         if len(batch) >= 2:
             self.stats.multi_request_batches += 1
+            self._m_multi.inc()
         self.stats.items += len(combined)
+        self._m_items.inc(len(combined))
         self.stats.bytes += nbytes
-        self.stats.fill_ratio_sum += min(
-            1.0, nbytes / max(self.config.max_batch_bytes, 1)
-        )
+        self._m_bytes_total.inc(nbytes)
+        self.stats.fill_ratio_sum += fill
+        self._m_fill.observe(fill)
+        self._m_batch_bytes.observe(float(nbytes))
         # Engine deadline: the latest ticket deadline, and only when every
         # ticket has one — if it fires, every deadline in the batch has
         # passed, so failing the whole batch with ScanTimeoutError is sound.
@@ -321,11 +446,36 @@ class BatchScheduler:
             _deadline.set_deadline_at(max(deadlines))
         else:
             _deadline.clear()
+        # The batch span adopts the first traced ticket's id so a remote
+        # client's tree contains the batch it rode in; the other tickets'
+        # ids land in attrs for cross-referencing.
+        lead = next((t.trace_id for t in batch if t.trace_id), "")
         try:
             # Batch boundary: any staged ruleset swaps in HERE, before any
             # of this batch's bytes touch an engine.
             engine, digest = self.manager.engine()
-            results = engine.scan_batch(combined)
+            estats = getattr(engine, "stats", None)
+            phases_before = (
+                {a: float(getattr(estats, a, 0.0)) for a in _PHASE_ATTRS}
+                if estats is not None
+                else None
+            )
+            with obs_trace.span(
+                "batch",
+                trace_id=lead or None,
+                tickets=len(batch),
+                items=len(combined),
+                bytes=nbytes,
+                trace_ids=[t.trace_id for t in batch if t.trace_id],
+            ):
+                results = engine.scan_batch(combined)
+            if phases_before is not None:
+                # SieveStats accumulates across scan_batch calls; the
+                # per-batch contribution is the before/after delta.
+                for attr, before in phases_before.items():
+                    delta = float(getattr(estats, attr, 0.0)) - before
+                    if delta > 0:
+                        self._m_phase.labels(phase=attr[:-2]).observe(delta)
         except ScanTimeoutError:
             for t in batch:
                 t.future.set_exception(
@@ -335,6 +485,7 @@ class BatchScheduler:
             return
         except BaseException as e:
             self.stats.errors += 1
+            self._m_errors.inc()
             for t in batch:
                 t.future.set_exception(e)
                 self._release(t)
@@ -368,81 +519,31 @@ class BatchScheduler:
     # -- observability ---------------------------------------------------
 
     def metrics_text(self) -> str:
-        """Prometheus exposition lines for the serve subsystem (appended to
-        the server's /metrics body)."""
-        s = self.stats
-        lines = [
-            "# HELP trivy_tpu_serve_queue_depth tickets waiting for dispatch",
-            "# TYPE trivy_tpu_serve_queue_depth gauge",
-            f"trivy_tpu_serve_queue_depth {self.queue_depth()}",
-            "# HELP trivy_tpu_serve_inflight_tickets tickets admitted and unresolved",
-            "# TYPE trivy_tpu_serve_inflight_tickets gauge",
-            f"trivy_tpu_serve_inflight_tickets {self.inflight_tickets()}",
-            "# HELP trivy_tpu_serve_tickets_total admitted tickets",
-            "# TYPE trivy_tpu_serve_tickets_total counter",
-            f"trivy_tpu_serve_tickets_total {s.admitted}",
-            "# HELP trivy_tpu_serve_rejected_total admission rejections by reason",
-            "# TYPE trivy_tpu_serve_rejected_total counter",
-            f'trivy_tpu_serve_rejected_total{{reason="queue_full"}} {s.rejected_full}',
-            f'trivy_tpu_serve_rejected_total{{reason="client_cap"}} {s.rejected_client}',
-            f'trivy_tpu_serve_rejected_total{{reason="closed"}} {s.rejected_closed}',
-            "# HELP trivy_tpu_serve_expired_total tickets cancelled at their deadline before dispatch",
-            "# TYPE trivy_tpu_serve_expired_total counter",
-            f"trivy_tpu_serve_expired_total {s.expired}",
-            "# HELP trivy_tpu_serve_batches_total dispatched device batches",
-            "# TYPE trivy_tpu_serve_batches_total counter",
-            f"trivy_tpu_serve_batches_total {s.batches}",
-            "# HELP trivy_tpu_serve_multi_request_batches_total batches coalescing two or more requests",
-            "# TYPE trivy_tpu_serve_multi_request_batches_total counter",
-            f"trivy_tpu_serve_multi_request_batches_total {s.multi_request_batches}",
-            "# HELP trivy_tpu_serve_coalesced_requests_total requests summed over dispatched batches",
-            "# TYPE trivy_tpu_serve_coalesced_requests_total counter",
-            f"trivy_tpu_serve_coalesced_requests_total {s.coalesced_requests}",
-            "# HELP trivy_tpu_serve_batch_items_total items summed over dispatched batches",
-            "# TYPE trivy_tpu_serve_batch_items_total counter",
-            f"trivy_tpu_serve_batch_items_total {s.items}",
-            "# HELP trivy_tpu_serve_batch_bytes_total payload bytes summed over dispatched batches",
-            "# TYPE trivy_tpu_serve_batch_bytes_total counter",
-            f"trivy_tpu_serve_batch_bytes_total {s.bytes}",
-            "# HELP trivy_tpu_serve_batch_fill_ratio_sum per-batch bytes/max_batch_bytes, summed (divide by batches_total for the mean fill)",
-            "# TYPE trivy_tpu_serve_batch_fill_ratio_sum counter",
-            f"trivy_tpu_serve_batch_fill_ratio_sum {s.fill_ratio_sum:.6f}",
-            "# HELP trivy_tpu_serve_ticket_wait_seconds_total enqueue-to-dispatch wait, summed over tickets",
-            "# TYPE trivy_tpu_serve_ticket_wait_seconds_total counter",
-            f"trivy_tpu_serve_ticket_wait_seconds_total {s.wait_s_sum:.6f}",
-            "# HELP trivy_tpu_serve_batch_errors_total batches failed by an engine exception",
-            "# TYPE trivy_tpu_serve_batch_errors_total counter",
-            f"trivy_tpu_serve_batch_errors_total {s.errors}",
-            "# HELP trivy_tpu_serve_ruleset_epoch engine installs since start (0 = no engine yet)",
-            "# TYPE trivy_tpu_serve_ruleset_epoch gauge",
-            f"trivy_tpu_serve_ruleset_epoch {self.manager.epoch}",
-            "# HELP trivy_tpu_serve_ruleset_reloads_total live engine replacements (hot reloads)",
-            "# TYPE trivy_tpu_serve_ruleset_reloads_total counter",
-            f"trivy_tpu_serve_ruleset_reloads_total {self.manager.reloads}",
-        ]
-        lines.extend(self._engine_metric_lines())
-        return "\n".join(lines) + "\n"
+        """Prometheus exposition for the serve subsystem.  When the server
+        shares its registry with the scheduler this is the whole scrape
+        body; standalone schedulers (tests, embedding) render their own."""
+        return self.registry.render()
 
-    def _engine_metric_lines(self) -> list[str]:
-        """Link-economics gauges read off the active engine's SieveStats
-        (engine/device.py): resident-cache hits, pipeline h2d overlap, and
-        the raw-vs-coded byte accounting the link codec introduces.  Reads
-        the manager's non-building `active` accessor — a metrics scrape
-        must never trigger the lazy first-engine build — and tolerates
-        engines without stats (the oracle backend)."""
+    def _collect(self) -> None:
+        """Registry collect hook: mirror live state into gauges at scrape
+        time.  Reads the manager's non-building `active` accessor — a
+        metrics scrape must never trigger the lazy first-engine build —
+        and tolerates engines without stats (the oracle backend)."""
+        self._m_queue_depth.set(self.queue_depth())
+        self._m_inflight.set(self.inflight_tickets())
+        self._m_epoch.set(self.manager.epoch)
+        self._m_reloads.set_total(self.manager.reloads)
         engine = self.manager.active
         stats = getattr(engine, "stats", None)
         if stats is None:
-            return []
-        lines = []
+            return
 
         def gauge(name: str, help_text: str, value) -> None:
-            lines.append(f"# HELP trivy_tpu_engine_{name} {help_text}")
-            lines.append(f"# TYPE trivy_tpu_engine_{name} gauge")
-            if isinstance(value, float):
-                lines.append(f"trivy_tpu_engine_{name} {value:.6f}")
-            else:
-                lines.append(f"trivy_tpu_engine_{name} {value}")
+            g = self._engine_gauges.get(name)
+            if g is None:
+                g = self.registry.gauge(f"trivy_tpu_engine_{name}", help_text)
+                self._engine_gauges[name] = g
+            g.set(value)
 
         gauge(
             "resident_hits",
@@ -482,4 +583,3 @@ class BatchScheduler:
             "post-compaction bytes actually fetched from the device",
             int(getattr(stats, "d2h_bytes", 0)),
         )
-        return lines
