@@ -454,7 +454,7 @@ class HybridSecretEngine(TpuSecretEngine):
         # `contents` stays referenced for the duration of both calls).
         ptr_arr = (ctypes.c_char_p * nfiles)(*contents)
         starts = np.zeros(nfiles, dtype=np.int64)  # filled by the C scan
-        self.stats.pack_s += time.perf_counter() - t0
+        pack_dt = time.perf_counter() - t0
 
         t0 = time.perf_counter()
         lib = load_native()
@@ -482,7 +482,7 @@ class HybridSecretEngine(TpuSecretEngine):
             if found <= cap:
                 break
             cap = int(found) + 64
-        self.stats.sieve_s += time.perf_counter() - t0
+        sieve_dt = time.perf_counter() - t0
 
         pairs = out[: int(found)]
         dev = (
@@ -496,7 +496,10 @@ class HybridSecretEngine(TpuSecretEngine):
         # wall-clock from sieve+verify into max(sieve, verify+confirm)).
         # ptr_arr/lens travel along: the verify walks the ORIGINAL file
         # buffers (case-sensitive rules must not see folded bytes).
-        return pairs, dev, ptr_arr, lens
+        # Timings return as data: this runs on pool workers, and a
+        # concurrent ``self.stats.X += dt`` from two workers is a lost
+        # update — the finish stage merges them single-threaded.
+        return pairs, dev, ptr_arr, lens, (pack_dt, sieve_dt)
 
     def _chunks(self, items: list[tuple[str, bytes]]):
         """Split items into contiguous chunks of ~chunk_bytes."""
@@ -551,9 +554,12 @@ class HybridSecretEngine(TpuSecretEngine):
 
         def _finish(span, fut):
             deadline.check()
+            pairs, dev, ptr_arr, lens, (pack_dt, sieve_dt) = fut.result()
+            self.stats.pack_s += pack_dt
+            self.stats.sieve_s += sieve_dt
             self._finish_chunk(
-                items, span[0], span[1], fut.result(), results, allowed_pos,
-                dev_lanes,
+                items, span[0], span[1], (pairs, dev, ptr_arr, lens),
+                results, allowed_pos, dev_lanes,
             )
 
         def _sieve_traced(contents):
