@@ -3,12 +3,46 @@
 
 PY ?= python
 
-.PHONY: test smoke serve-smoke obs-smoke bench bench-link checks-corpus rules-cache
+.PHONY: test lint lint-changed lockcheck smoke serve-smoke obs-smoke bench bench-link checks-corpus rules-cache
 
 # Tier-1: the suite the driver holds the repo to (fast, CPU, no slow marks).
-test:
+# Lint runs first — a graftlint finding fails the build before pytest
+# collection starts, and costs ~2s when clean.
+test: lint
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
+
+# Static analysis: graftlint (project rules GL001-GL006, always available)
+# plus ruff + mypy when the environment has them (the pinned CI container
+# may not; config lives in pyproject.toml either way).
+lint:
+	$(PY) -m tools.graftlint
+	@if $(PY) -c "import ruff" 2>/dev/null || command -v ruff >/dev/null; then \
+		ruff check trivy_tpu tools bench.py; \
+	else \
+		echo "lint: ruff not installed, skipping (config in pyproject.toml)"; \
+	fi
+	@if command -v mypy >/dev/null; then \
+		mypy --config-file pyproject.toml; \
+	else \
+		echo "lint: mypy not installed, skipping (config in pyproject.toml)"; \
+	fi
+
+# Fast pre-commit loop: only .py files changed vs HEAD.
+lint-changed:
+	$(PY) -m tools.graftlint --changed
+
+# The runtime sanitizer over the threaded suites: lock-order cycles and
+# owner-role violations anywhere in the run fail the session (see
+# tests/conftest.py pytest_sessionfinish).
+# (test_lockcheck.py is deliberately absent: its unit tests create
+# violations on purpose and reset the graph, which would blind the
+# session-end gate for everything before them; they run in tier-1.)
+lockcheck:
+	TRIVY_TPU_LOCKCHECK=1 JAX_PLATFORMS=cpu $(PY) -m pytest \
+		tests/test_serve_scheduler.py tests/test_serve_reload.py \
+		tests/test_chunk_pipeline.py \
+		-q -m 'not slow' -p no:cacheprovider
 
 # CI smoke: tiny-corpus bench.py --smoke on CPU (pipeline depth 2) via the
 # slow-marked subprocess test, which asserts the single-JSON-line contract

@@ -27,3 +27,15 @@ if "jax" in sys.modules:
     jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Under TRIVY_TPU_LOCKCHECK=1 the whole run is a lock-order probe:
+    any acquisition-order cycle or ownership violation recorded anywhere
+    in the session fails it, even if every individual test passed."""
+    if os.environ.get("TRIVY_TPU_LOCKCHECK", "") in ("", "0", "false", "off"):
+        return
+    from trivy_tpu import lockcheck
+
+    lockcheck.assert_clean()  # raises -> nonzero exit
+    print("\nlockcheck: clean")

@@ -1,0 +1,301 @@
+"""Lock-order / ownership sanitizer tests (trivy_tpu/lockcheck.py).
+
+The contract under test: disabled, make_lock is a plain threading.Lock
+(zero overhead); enabled, the checked wrapper (1) records the process-wide
+acquisition-order graph and reports ABBA cycles even when the interleaving
+never deadlocked, (2) raises eagerly on same-thread re-acquisition instead
+of hanging, and (3) enforces first-asserter-binds owner roles.  Real
+workloads (scheduler coalescing, hot reload) then run under the sanitizer
+and must be cycle- and violation-free; the slow-marked subprocess test
+re-runs the serve/reload/pipeline suites with TRIVY_TPU_LOCKCHECK=1, where
+tests/conftest.py fails the session on any recorded cycle or violation.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from trivy_tpu import lockcheck
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def checked(monkeypatch):
+    """Sanitizer on + clean graph, cleaned up so the session-end gate
+    (active only under an external TRIVY_TPU_LOCKCHECK=1) never sees the
+    deliberate violations these tests create."""
+    monkeypatch.setenv("TRIVY_TPU_LOCKCHECK", "1")
+    lockcheck.reset()
+    yield
+    lockcheck.reset()
+
+
+# -- construction gating ----------------------------------------------------
+
+
+def test_disabled_returns_plain_lock(monkeypatch):
+    monkeypatch.delenv("TRIVY_TPU_LOCKCHECK", raising=False)
+    lock = lockcheck.make_lock("x")
+    assert type(lock) is type(threading.Lock())
+    role = lockcheck.owner_role("r")
+    role.assert_here()  # no-op from any thread
+    t = threading.Thread(target=role.assert_here)
+    t.start()
+    t.join()
+
+
+def test_enabled_returns_checked_lock(checked):
+    lock = lockcheck.make_lock("x")
+    assert lock.__class__.__name__ == "_CheckedLock"
+    with lock:
+        assert lock.locked()
+    assert not lock.locked()
+
+
+# -- order graph ------------------------------------------------------------
+
+
+def test_edge_recorded(checked):
+    a = lockcheck.make_lock("fixture.a")
+    b = lockcheck.make_lock("fixture.b")
+    with a:
+        with b:
+            pass
+    assert ("fixture.a", "fixture.b") in lockcheck.edges()
+    assert lockcheck.check_cycles() == []
+    lockcheck.assert_clean()
+
+
+def test_abba_cycle_detected(checked):
+    """The deliberate ABBA deadlock fixture: two threads take the pair in
+    opposite orders SEQUENTIALLY (no real deadlock ever happens) and the
+    order graph still convicts them — that is the point of order checking
+    over deadlock waiting."""
+    a = lockcheck.make_lock("abba.a")
+    b = lockcheck.make_lock("abba.b")
+
+    def a_then_b():
+        with a:
+            with b:
+                pass
+
+    def b_then_a():
+        with b:
+            with a:
+                pass
+
+    for fn in (a_then_b, b_then_a):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+
+    cycles = lockcheck.check_cycles()
+    assert len(cycles) == 1
+    assert set(cycles[0]) == {"abba.a", "abba.b"}
+    with pytest.raises(lockcheck.LockCheckError, match="cycle"):
+        lockcheck.assert_clean()
+
+
+def test_same_name_instances_share_a_node(checked):
+    """Per-instance family locks constructed from one site share a graph
+    node, so the graph stays O(sites); a self-edge through two INSTANCES
+    of the same name is not recorded (same-name nesting is the one shape
+    name-keying cannot adjudicate)."""
+    a1 = lockcheck.make_lock("shared.site")
+    a2 = lockcheck.make_lock("shared.site")
+    with a1:
+        with a2:
+            pass
+    assert lockcheck.edges() == {}
+    assert lockcheck.check_cycles() == []
+
+
+def test_reacquisition_raises_instead_of_hanging(checked):
+    lock = lockcheck.make_lock("reent")
+    with lock:
+        with pytest.raises(lockcheck.LockCheckError, match="re-acquisition"):
+            lock.acquire()
+    assert lockcheck.violations()
+    lockcheck.reset()
+
+
+def test_release_unheld_recorded(checked):
+    lock = lockcheck.make_lock("stray")
+    lock._lock.acquire()  # put the raw lock in a releasable state
+    lock.release()
+    assert any("not held" in v for v in lockcheck.violations())
+
+
+def test_condition_wait_keeps_held_set_exact(checked):
+    """Condition.wait() releases and re-acquires the checked lock through
+    the public acquire/release protocol, so the held-set stays exact and
+    later acquisitions record correct edges."""
+    lock = lockcheck.make_lock("cond.lock")
+    cond = lockcheck.make_condition(lock)
+    other = lockcheck.make_lock("cond.other")
+    woke = threading.Event()
+
+    def waiter():
+        with cond:
+            cond.wait(timeout=5)
+            with other:  # edge cond.lock -> cond.other from a held-set
+                pass     # that survived the wait round-trip
+        woke.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    # let the waiter block, then wake it
+    import time
+
+    time.sleep(0.05)
+    with cond:
+        cond.notify_all()
+    t.join(timeout=10)
+    assert woke.is_set()
+    assert ("cond.lock", "cond.other") in lockcheck.edges()
+    assert lockcheck.check_cycles() == []
+    assert lockcheck.violations() == []
+
+
+# -- owner roles ------------------------------------------------------------
+
+
+def test_owner_role_binds_first_then_rejects(checked):
+    role = lockcheck.owner_role("fixture.owner")
+    role.assert_here()  # binds to this thread
+    role.assert_here()  # same thread: fine
+    errs = []
+
+    def intruder():
+        try:
+            role.assert_here()
+        except lockcheck.LockCheckError as e:
+            errs.append(e)
+
+    t = threading.Thread(target=intruder)
+    t.start()
+    t.join()
+    assert len(errs) == 1
+    assert lockcheck.violations()
+    lockcheck.reset()
+
+
+def test_owner_role_reset_rebinds(checked):
+    role = lockcheck.owner_role("rebind")
+    role.assert_here()
+    role.reset()
+    ok = []
+    t = threading.Thread(target=lambda: (role.assert_here(), ok.append(1)))
+    t.start()
+    t.join()
+    assert ok == [1]
+
+
+# -- real workloads under the sanitizer -------------------------------------
+
+
+class _FakeEngine:
+    ruleset_digest = "lockcheck-fake"
+
+    def scan_batch(self, items):
+        return [[] for _ in items]
+
+
+def test_scheduler_workload_order_clean(checked):
+    """Submit/dispatch/drain through the REAL BatchScheduler with checked
+    locks: the serve.scheduler + registry.manager + metrics lock stack must
+    record an acyclic order and bind the batcher role to one thread."""
+    from trivy_tpu.serve.scheduler import BatchScheduler, ServeConfig
+
+    sched = BatchScheduler(
+        lambda: _FakeEngine(), ServeConfig(batch_window_ms=1.0)
+    )
+    futs = [
+        sched.submit([(f"f{i}.txt", b"payload-%d" % i)], client_id=f"c{i % 2}")
+        for i in range(8)
+    ]
+    for f in futs:
+        assert f.result(timeout=10) == [[]]
+    sched.metrics_text()  # scrape path: registry hooks + family locks
+    sched.close()
+    assert lockcheck.check_cycles() == []
+    assert lockcheck.violations() == []
+
+
+def test_reload_workload_order_clean(checked):
+    """Hot reload: stage from a foreign thread while the owner swaps at
+    batch boundaries — engine() stays single-threaded (role-bound) and the
+    manager/scheduler lock order stays acyclic."""
+    from trivy_tpu.registry.manager import RulesetManager
+
+    mgr = RulesetManager(lambda: _FakeEngine())
+    mgr.engine()  # binds the engine-owner role to this thread
+    t = threading.Thread(target=lambda: mgr.build_staged(lambda: _FakeEngine()))
+    t.start()
+    t.join()
+    eng, digest = mgr.engine()  # owner thread swaps the staged engine in
+    assert digest == "lockcheck-fake" and mgr.reloads == 1
+    assert lockcheck.check_cycles() == []
+    assert lockcheck.violations() == []
+
+
+def test_manager_owner_role_enforced(checked):
+    from trivy_tpu.registry.manager import RulesetManager
+
+    mgr = RulesetManager(lambda: _FakeEngine())
+    mgr.engine()
+    errs = []
+
+    def intruder():
+        try:
+            mgr.engine()
+        except lockcheck.LockCheckError as e:
+            errs.append(e)
+
+    t = threading.Thread(target=intruder)
+    t.start()
+    t.join()
+    assert len(errs) == 1
+    lockcheck.reset()
+
+
+# -- the sanitized tier-1 subset (subprocess, slow) -------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.lockcheck
+def test_concurrency_suites_clean_under_lockcheck():
+    """Run the scheduler, hot-reload, and chunk-pipeline suites with the
+    sanitizer on.  TRIVY_TPU_LOCKCHECK=1 is set before the interpreter
+    starts, so module-level locks (trace ring, link-probe cache, native
+    loader, protogen) instrument too; tests/conftest.py fails the session
+    on any recorded cycle or ownership violation."""
+    env = dict(os.environ)
+    env["TRIVY_TPU_LOCKCHECK"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            "tests/test_serve_scheduler.py",
+            "tests/test_serve_reload.py",
+            "tests/test_chunk_pipeline.py",
+            "-q",
+            "-m",
+            "not slow",
+            "-p",
+            "no:cacheprovider",
+        ],
+        cwd=ROOT,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-4000:]
+    assert "lockcheck: clean" in proc.stdout

@@ -1,0 +1,34 @@
+"""graftlint: project-native static analysis for trivy-tpu.
+
+Generic linters know Python; none of them know that a `jax.jit` inside a
+loop recompiles per iteration, that `np.asarray` on a device array is a
+host sync, or that `RulesetManager._active` may only be touched by the
+engine-owner thread.  graftlint encodes exactly those project rules as AST
+checks over trivy_tpu/, with the contracts declared in source as trailing
+comments (`# owner: _lock`, `# graftlint: fetch-boundary`, ...).
+
+Rule catalogue (each with allow/deny fixtures under fixtures/):
+
+  GL001  recompile hazard: jit constructed per-call / per-iteration
+  GL002  traced-signature instability: f-strings, set/dict-order shapes,
+         unhashable static args reaching jitted callables
+  GL003  donated-buffer reuse after a donate_argnums call site
+  GL004  host-sync leak in engine hot paths outside fetch boundaries
+  GL005  thread-ownership: `# owner:` state mutated without its lock/role
+  GL006  hook safety: unbalanced gauge inc/dec, span misuse, raising
+         collect hooks
+
+The runtime complement is trivy_tpu/lockcheck.py (TRIVY_TPU_LOCKCHECK=1
+lock-order + owner-role sanitizer); graftlint checks what must hold by
+construction, lockcheck checks what only shows up live.
+"""
+
+from __future__ import annotations
+
+from tools.graftlint.core import Finding, lint_paths, load_waivers
+
+# importing the rule modules registers them; anything importing the
+# package (CLI, tests) sees the full registry
+from tools.graftlint import rules_jax, rules_threads  # noqa: E402,F401
+
+__all__ = ["Finding", "lint_paths", "load_waivers"]

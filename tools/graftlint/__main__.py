@@ -1,0 +1,3 @@
+from tools.graftlint.cli import main
+
+raise SystemExit(main())

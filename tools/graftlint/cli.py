@@ -1,0 +1,147 @@
+"""graftlint command line.
+
+    python -m tools.graftlint [PATH ...] [--changed] [--format text|json]
+
+Default targets (no PATH, no --changed) are the `make lint` surface:
+trivy_tpu/, tools/, bench.py.  --changed lints only .py files touched in
+the working tree vs HEAD (staged, unstaged, and untracked) — the fast
+pre-commit loop.  Exit code 0 = clean, 1 = findings, 2 = parse/usage
+errors, so CI can distinguish "you have findings" from "lint is broken".
+
+Waivers load from tools/graftlint/waivers.toml next to this file; stale
+entries (waiving nothing) are an error so the ledger can only shrink.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+from tools.graftlint.core import RULES, Finding, lint_paths, load_waivers
+
+DEFAULT_TARGETS = ("trivy_tpu", "tools", "bench.py")
+
+
+def _repo_root() -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(here))
+
+
+def _changed_files(root: str) -> list[str]:
+    try:
+        proc = subprocess.run(
+            ["git", "status", "--porcelain"],
+            cwd=root,
+            capture_output=True,
+            text=True,
+            timeout=30,
+            check=True,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return []
+    out = []
+    for line in proc.stdout.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:].strip()
+        if " -> " in path:  # rename: lint the new side
+            path = path.split(" -> ", 1)[1]
+        path = path.strip('"')
+        if path.endswith(".py") and os.path.exists(os.path.join(root, path)):
+            out.append(os.path.join(root, path))
+    return sorted(set(out))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="graftlint", description=__doc__)
+    ap.add_argument("paths", nargs="*", help="files or directories to lint")
+    ap.add_argument(
+        "--changed",
+        action="store_true",
+        help="lint only .py files changed vs HEAD (fast pre-commit mode)",
+    )
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument(
+        "--rules",
+        default="",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    ap.add_argument(
+        "--no-waivers",
+        action="store_true",
+        help="ignore the waiver ledger (report raw findings)",
+    )
+    args = ap.parse_args(argv)
+
+    root = _repo_root()
+    if args.changed:
+        paths = _changed_files(root)
+        if not paths:
+            print("graftlint: no changed .py files")
+            return 0
+    elif args.paths:
+        paths = args.paths
+    else:
+        paths = [
+            os.path.join(root, t)
+            for t in DEFAULT_TARGETS
+            if os.path.exists(os.path.join(root, t))
+        ]
+
+    rules = None
+    if args.rules:
+        wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = wanted - set(RULES)
+        if unknown:
+            print(f"graftlint: unknown rules {sorted(unknown)}", file=sys.stderr)
+            return 2
+        rules = {k: v for k, v in RULES.items() if k in wanted}
+
+    waivers = []
+    if not args.no_waivers:
+        try:
+            waivers = load_waivers(
+                os.path.join(os.path.dirname(os.path.abspath(__file__)), "waivers.toml")
+            )
+        except ValueError as e:
+            print(f"graftlint: {e}", file=sys.stderr)
+            return 2
+
+    findings, errors = lint_paths(paths, root, rules=rules, waivers=waivers)
+
+    stale = [w for w in waivers if not w.used]
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [f.__dict__ for f in findings],
+                    "errors": errors,
+                    "stale_waivers": [w.__dict__ for w in stale],
+                },
+                indent=2,
+                default=str,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        for e in errors:
+            print(f"graftlint: parse error: {e}", file=sys.stderr)
+        for w in stale:
+            print(
+                f"graftlint: stale waiver {w.rule} {w.file}:{w.line} "
+                "matches nothing — remove it",
+                file=sys.stderr,
+            )
+        if not findings and not errors and not stale:
+            print(f"graftlint: clean ({len(RULES)} rules)")
+    if errors or stale:
+        return 2
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

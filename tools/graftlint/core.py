@@ -1,0 +1,304 @@
+"""graftlint core: parsed-module model, annotations, registry, waivers.
+
+One `Module` per file carries the AST (with parent links), the raw source
+lines, and every comment keyed by line — rules read contracts out of
+trailing comments instead of a sidecar config, so the annotation lives next
+to the code it governs and moves with it in diffs.
+
+Annotation grammar (all trailing comments):
+
+  # owner: <name>                 declares the assigned attribute/global as
+                                  owned by lock attr <name>, or by a ROLE
+                                  when <name> is not a plain identifier
+                                  (e.g. ``engine-owner``)
+  # graftlint: owner(<role>)      on a ``def`` line: the function body runs
+                                  as <role> (may mutate role-owned state)
+  # graftlint: holds(<lock>)      on a ``def`` line: every caller holds
+                                  <lock> (mutations inside count as locked)
+  # graftlint: fetch-boundary     on a ``def`` line: deliberate host-sync
+                                  point; GL004 sinks inside are allowed
+  # graftlint: jit-cached         this jit construction is cached by other
+                                  means (persistent compilation cache, ...)
+  # graftlint: ignore[GL00x]      suppress one rule on this line
+  # graftlint: ignore             suppress every rule on this line
+
+Waivers are the heavier escape hatch: a checked-in ledger entry with a
+reason, reviewed like code.  The shipped ledger is empty and the tests pin
+it empty-parseable; policy is to fix findings, not waive them.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+_DIRECTIVE_RE = re.compile(r"graftlint:\s*(.+)$")
+_OWNER_RE = re.compile(r"#\s*owner:\s*(\S+)")
+
+
+def _parse_directives(comment: str) -> list[str]:
+    """``# graftlint: owner(engine-owner) ignore[GL005]`` -> both tokens."""
+    m = _DIRECTIVE_RE.search(comment)
+    if not m:
+        return []
+    return [t for t in re.split(r"[,\s]+", m.group(1).strip()) if t]
+
+
+class Module:
+    """One parsed source file plus its comment/annotation index."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # parent links: rules climb from a node to its loop/with/def context
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._gl_parent = node  # type: ignore[attr-defined]
+        self.comments: dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+                if tok.type == tokenize.COMMENT:
+                    # last comment on the line wins (there is only ever one)
+                    self.comments[tok.start[0]] = tok.string
+        except tokenize.TokenError:
+            pass
+
+    # -- annotation queries -------------------------------------------------
+
+    def directives(self, line: int) -> list[str]:
+        return _parse_directives(self.comments.get(line, ""))
+
+    def has_directive(self, line: int, name: str) -> bool:
+        return any(d == name or d.startswith(name + "(") for d in self.directives(line))
+
+    def directive_arg(self, line: int, name: str) -> str | None:
+        for d in self.directives(line):
+            if d.startswith(name + "(") and d.endswith(")"):
+                return d[len(name) + 1 : -1]
+        return None
+
+    def owner_decl(self, line: int) -> str | None:
+        m = _OWNER_RE.search(self.comments.get(line, ""))
+        return m.group(1) if m else None
+
+    def ignored(self, line: int, rule: str) -> bool:
+        for d in self.directives(line):
+            if d == "ignore":
+                return True
+            if d.startswith("ignore[") and d.endswith("]"):
+                if rule in re.split(r"[,\s]+", d[7:-1]):
+                    return True
+        return False
+
+    # -- AST context helpers ------------------------------------------------
+
+    def parent(self, node: ast.AST) -> ast.AST | None:
+        return getattr(node, "_gl_parent", None)
+
+    def ancestors(self, node: ast.AST):
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+    def enclosing_function(self, node: ast.AST):
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def function_chain(self, node: ast.AST) -> list[ast.FunctionDef]:
+        """All enclosing defs, innermost first (nested fetch helpers inherit
+        an outer function's fetch-boundary annotation)."""
+        return [
+            a
+            for a in self.ancestors(node)
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+
+    def in_loop(self, node: ast.AST) -> bool:
+        """Inside a for/while body, stopping at the enclosing def."""
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                return False
+            if isinstance(anc, (ast.For, ast.While, ast.AsyncFor)):
+                return True
+        return False
+
+
+def dotted_name(node: ast.AST) -> str:
+    """``jax.experimental.pjit.pjit`` -> that string; "" when not a plain
+    dotted name."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+# -- rule registry ---------------------------------------------------------
+
+RULES: dict[str, object] = {}
+
+
+def rule(rule_id: str):
+    def deco(fn):
+        fn.rule_id = rule_id
+        RULES[rule_id] = fn
+        return fn
+
+    return deco
+
+
+def lint_module(mod: Module, rules: dict | None = None) -> list[Finding]:
+    # import for side effect: rule registration
+    from tools.graftlint import rules_jax, rules_threads  # noqa: F401
+
+    out: list[Finding] = []
+    for rid, fn in sorted((rules or RULES).items()):
+        for f in fn(mod):
+            if not mod.ignored(f.line, f.rule):
+                out.append(f)
+    return sorted(out, key=lambda f: (f.path, f.line, f.rule, f.message))
+
+
+def lint_paths(
+    paths: list[str],
+    repo_root: str,
+    rules: dict | None = None,
+    waivers: list[dict] | None = None,
+) -> tuple[list[Finding], list[str]]:
+    """Lint every .py under `paths`; returns (findings, parse_errors).
+    Fixture files are skipped unless a fixtures path is given explicitly."""
+    import os
+
+    files: list[str] = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            files.append(p)
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d not in ("__pycache__", ".git")
+                )
+                if os.path.basename(dirpath) == "fixtures" and dirpath.endswith(
+                    os.path.join("graftlint", "fixtures")
+                ):
+                    continue
+                files.extend(
+                    os.path.join(dirpath, f)
+                    for f in sorted(filenames)
+                    if f.endswith(".py")
+                )
+    findings: list[Finding] = []
+    errors: list[str] = []
+    for fpath in sorted(set(files)):
+        rel = os.path.relpath(fpath, repo_root).replace(os.sep, "/")
+        try:
+            with open(fpath, encoding="utf-8") as fh:
+                src = fh.read()
+            mod = Module(fpath, rel, src)
+        except (OSError, SyntaxError, ValueError) as e:
+            errors.append(f"{rel}: {e}")
+            continue
+        findings.extend(lint_module(mod, rules))
+    if waivers:
+        findings = apply_waivers(findings, waivers)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.message)), errors
+
+
+# -- waiver ledger ---------------------------------------------------------
+
+
+@dataclass
+class Waiver:
+    rule: str
+    file: str
+    line: int
+    reason: str = ""
+    used: bool = field(default=False, compare=False)
+
+
+def load_waivers(path: str) -> list[Waiver]:
+    """Parse the ``[[waiver]]`` ledger.  Python 3.10 has no tomllib, so
+    this reads exactly the subset the ledger uses: table-array headers and
+    ``key = value`` lines with string/int values."""
+    import os
+
+    if not os.path.exists(path):
+        return []
+    waivers: list[Waiver] = []
+    cur: dict | None = None
+    with open(path, encoding="utf-8") as fh:
+        for raw in fh:
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if line == "[[waiver]]":
+                cur = {}
+                waivers.append(cur)  # type: ignore[arg-type]
+                continue
+            if "=" in line and cur is not None:
+                key, _, val = line.partition("=")
+                key, val = key.strip(), val.strip()
+                if val.startswith('"') and val.endswith('"'):
+                    cur[key] = val[1:-1]
+                else:
+                    try:
+                        cur[key] = int(val)
+                    except ValueError:
+                        cur[key] = val
+                continue
+            raise ValueError(f"{path}: unparseable waiver line {line!r}")
+    out = []
+    for w in waivers:
+        out.append(
+            Waiver(
+                rule=str(w.get("rule", "")),
+                file=str(w.get("file", "")),
+                line=int(w.get("line", 0)),
+                reason=str(w.get("reason", "")),
+            )
+        )
+    return out
+
+
+def apply_waivers(findings: list[Finding], waivers: list) -> list[Finding]:
+    kept = []
+    for f in findings:
+        waived = False
+        for w in waivers:
+            rule_ok = w.rule in ("", "*", f.rule) if hasattr(w, "rule") else False
+            if (
+                rule_ok
+                and f.path.endswith(w.file)
+                and (w.line in (0, f.line))
+            ):
+                w.used = True
+                waived = True
+                break
+        if not waived:
+            kept.append(f)
+    return kept

@@ -1,0 +1,39 @@
+"""GL001 allow fixture: every construction here is cached somewhere."""
+
+import functools
+
+import jax
+
+_TOP = jax.jit(lambda v: v + 1)  # module level: one construction per import
+
+
+class Engine:
+    def __init__(self):
+        fn = jax.jit(lambda v: v * 2)  # built locally, cached on self below
+        self._fn = fn
+        self._donated = None
+
+    def exec_fn(self):
+        if self._donated is None:
+            self._donated = jax.jit(lambda v: v, donate_argnums=0)
+        return self._donated
+
+
+@functools.lru_cache(maxsize=1)
+def factory():
+    return jax.jit(lambda v: v + 3)
+
+
+def annotated(x):
+    h = jax.jit(lambda v: v)  # graftlint: jit-cached
+    return h(x)
+
+
+_MEMO = None
+
+
+def global_memo():
+    global _MEMO
+    if _MEMO is None:
+        _MEMO = jax.jit(lambda v: v * 4)
+    return _MEMO

@@ -1,0 +1,20 @@
+"""GL001 deny fixture: every jit construction here re-traces per use."""
+
+import jax
+
+
+def per_call(x):
+    f = jax.jit(lambda v: v + 1)  # GL001: constructed per call, never cached
+    return f(x)
+
+
+def immediate(x):
+    return jax.jit(lambda v: v * 2)(x)  # GL001: construct-and-invoke
+
+
+def in_loop(xs):
+    out = []
+    for x in xs:
+        g = jax.jit(lambda v: v - 1)  # GL001: constructed per iteration
+        out.append(g(x))
+    return out

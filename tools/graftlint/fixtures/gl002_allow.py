@@ -1,0 +1,20 @@
+"""GL002 allow fixture: traced signatures stay hashable and ordered."""
+
+import jax
+import jax.numpy as jnp
+
+run = jax.jit(lambda x: x)
+
+good_statics = jax.jit(lambda a, b: a, static_argnums=(1,))
+
+
+def good_call(x):
+    return run(x)
+
+
+def good_stack(d):
+    return jnp.stack([d[k] for k in sorted(d.keys())])
+
+
+def good_list(vals):
+    return jnp.array([v * 2 for v in vals])

@@ -1,0 +1,20 @@
+"""GL002 deny fixture: unstable values reaching traced signatures."""
+
+import jax
+import jax.numpy as jnp
+
+run = jax.jit(lambda x: x)
+
+bad_statics = jax.jit(lambda a, b: a, static_argnums=[1])  # GL002: unhashable
+
+
+def bad_fstring(name, x):
+    return run(f"kernel-{name}")  # GL002: f-string into a jitted callable
+
+
+def bad_set(vals):
+    return run(set(vals))  # GL002: hash-ordered iterable traced
+
+
+def bad_stack(d):
+    return jnp.stack([d[k] for k in d.keys()])  # GL002: dict-order shape

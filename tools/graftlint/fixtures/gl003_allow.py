@@ -1,0 +1,21 @@
+"""GL003 allow fixture: donated names die (or are rebound) after the call."""
+
+import jax
+
+
+def ok(x):
+    f = jax.jit(lambda v: v, donate_argnums=0)  # graftlint: ignore[GL001]
+    y = f(x)
+    return y
+
+
+def rebound(x):
+    f = jax.jit(lambda v: v, donate_argnums=0)  # graftlint: ignore[GL001]
+    x = f(x)
+    return x + 1
+
+
+def non_donating(x):
+    g = jax.jit(lambda v: v + 1)  # graftlint: ignore[GL001]
+    y = g(x)
+    return x + y

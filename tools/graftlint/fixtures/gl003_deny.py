@@ -1,0 +1,14 @@
+"""GL003 deny fixture: donated buffers read after the donating call."""
+
+import jax
+
+
+def reuse(x):
+    f = jax.jit(lambda v: v, donate_argnums=0)  # graftlint: ignore[GL001]
+    y = f(x)
+    return x + y  # GL003: x's buffer was donated to f
+
+
+def immediate_reuse(x):
+    y = jax.jit(lambda v: v * 2, donate_argnums=0)(x)  # graftlint: ignore[GL001]
+    return x.sum() + y  # GL003: x read after donation

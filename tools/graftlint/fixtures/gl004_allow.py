@@ -1,0 +1,27 @@
+"""GL004 allow fixture: syncs only at declared fetch boundaries."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def fetch(rows):  # graftlint: fetch-boundary
+    dev = jnp.sum(rows, axis=1)
+    return np.asarray(dev)
+
+
+def outer(rows):
+    def _fetch_one(d):  # graftlint: fetch-boundary
+        return np.asarray(d)
+
+    dev = jnp.sum(rows)
+    return _fetch_one(dev)
+
+
+def host_only(xs):
+    arr = np.asarray(xs)  # host data in, host data out: no device sync
+    return arr.sum() + float(len(xs))
+
+
+def pinned(rows):
+    dev = jnp.sum(rows)
+    return np.asarray(dev)  # graftlint: ignore[GL004]

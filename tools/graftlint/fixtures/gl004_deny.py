@@ -1,0 +1,34 @@
+"""GL004 deny fixture: device values materialized outside a boundary."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def leaky(rows):
+    dev = jnp.sum(rows, axis=1)
+    host = np.asarray(dev)  # GL004: mid-pipeline sync
+    return host
+
+
+def cast_leak(rows):
+    total = jnp.sum(rows)
+    return float(total)  # GL004
+
+
+def item_leak(rows):
+    s = jnp.max(rows)
+    return s.item()  # GL004
+
+
+def iter_leak(rows):
+    dev = jnp.abs(rows)
+    out = []
+    for v in dev:  # GL004: element-by-element host pull
+        out.append(v)
+    return out
+
+
+def derived_leak(rows):
+    dev = jnp.sum(rows, axis=1)
+    top = dev[:4]
+    return np.asarray(top)  # GL004: taint flows through the slice

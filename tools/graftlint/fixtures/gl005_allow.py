@@ -1,0 +1,37 @@
+"""GL005 allow fixture: every mutation holds the declared lock or role."""
+
+import threading
+
+
+class Safe:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._q = []  # owner: _lock
+        self._count = 0  # owner: _lock
+        self._active = None  # owner: engine-owner
+
+    def put(self, item):
+        with self._lock:
+            self._q.append(item)
+
+    def put_notify(self, item):
+        with self._cond:  # the Condition aliases _lock
+            self._q.append(item)
+            self._count += 1
+
+    def _drain_locked(self):  # graftlint: holds(_lock)
+        items, self._q = self._q, []
+        return items
+
+    def install(self, engine):  # graftlint: owner(engine-owner)
+        self._active = engine
+
+
+_GLOBAL_LOCK = threading.Lock()
+_STATE = {}  # owner: _GLOBAL_LOCK
+
+
+def poke(k, v):
+    with _GLOBAL_LOCK:
+        _STATE[k] = v

@@ -1,0 +1,28 @@
+"""GL005 deny fixture: owned state mutated without its lock or role."""
+
+import threading
+
+
+class Unsafe:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = []  # owner: _lock
+        self._count = 0  # owner: _lock
+        self._active = None  # owner: engine-owner
+
+    def put(self, item):
+        self._q.append(item)  # GL005: no lock held
+
+    def bump(self):
+        self._count += 1  # GL005: unlocked augmented assignment
+
+    def set_active(self, engine):
+        self._active = engine  # GL005: not an owner(engine-owner) function
+
+
+_GLOBAL_LOCK = threading.Lock()
+_STATE = {}  # owner: _GLOBAL_LOCK
+
+
+def poke(k, v):
+    _STATE[k] = v  # GL005: module-owned global stored without the lock

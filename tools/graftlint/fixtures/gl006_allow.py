@@ -1,0 +1,30 @@
+"""GL006 allow fixture: hooks and spans used safely."""
+
+
+def balanced(gauge, work):
+    gauge.inc()
+    try:
+        work()
+    finally:
+        gauge.dec()
+
+
+def spanned(obs_trace, name, work):
+    with obs_trace.span(name):
+        work()
+
+
+def register(reg):
+    reg.add_collect_hook(_hook)
+
+
+def _hook():
+    try:
+        if _risky():
+            raise ValueError("handled in-hook")
+    except ValueError:
+        pass
+
+
+def _risky():
+    return False

@@ -1,0 +1,21 @@
+"""GL006 deny fixture: hook-safety hazards."""
+
+
+def leak(gauge, work):
+    gauge.inc()  # GL006: work() may raise and skip the dec
+    work()
+    gauge.dec()
+
+
+def stray_span(obs_trace, name):
+    sp = obs_trace.span(name)  # GL006: span outside a with statement
+    sp.__enter__()
+    return sp
+
+
+def register(reg):
+    reg.add_collect_hook(_hook)
+
+
+def _hook():  # GL006: raising hook aborts the scrape
+    raise RuntimeError("scrape killer")

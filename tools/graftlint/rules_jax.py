@@ -1,0 +1,508 @@
+"""JAX hot-path rules: recompile hazards, trace instability, donation,
+host-sync leaks.
+
+These encode the performance contracts the engine lives by (see
+trivy_tpu/engine/device.py): jit once and cache the callable, keep traced
+signatures hash-stable and order-deterministic, never touch a donated
+buffer again, and fetch device results only at declared boundaries.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import Finding, Module, dotted_name, rule
+
+_JIT_NAMES = {"jax.jit", "jax.pjit", "pjit", "jax.experimental.pjit.pjit"}
+_CACHE_DECORATORS = {
+    "functools.lru_cache",
+    "functools.cache",
+    "lru_cache",
+    "cache",
+}
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and dotted_name(node.func) in _JIT_NAMES
+
+
+def _decorator_names(fn: ast.FunctionDef) -> set[str]:
+    out = set()
+    for dec in fn.decorator_list:
+        if isinstance(dec, ast.Call):
+            out.add(dotted_name(dec.func))
+        else:
+            out.add(dotted_name(dec))
+    return out
+
+
+def _self_attr_assigned(fn: ast.FunctionDef) -> bool:
+    """Any ``self.<attr> = ...`` in the function: the construct-then-cache
+    pattern (build locally, store on self) keeps the jit for the object's
+    lifetime."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    return True
+        elif isinstance(node, ast.AugAssign):
+            tgt = node.target
+            if (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                return True
+    return False
+
+
+@rule("GL001")
+def check_recompile(mod: Module) -> list[Finding]:
+    """jit construction that re-traces per call or per iteration."""
+    out = []
+    for node in ast.walk(mod.tree):
+        if not _is_jit_call(node):
+            continue
+        line = node.lineno
+        if mod.has_directive(line, "jit-cached"):
+            continue
+        parent = mod.parent(node)
+        # jax.jit(f)(x): the Call node is the .func of an outer Call
+        if isinstance(parent, ast.Call) and parent.func is node:
+            out.append(
+                Finding(
+                    "GL001",
+                    mod.relpath,
+                    line,
+                    "jit constructed and immediately invoked; each call "
+                    "re-traces — bind the jitted callable once and reuse it",
+                )
+            )
+            continue
+        if mod.in_loop(node):
+            out.append(
+                Finding(
+                    "GL001",
+                    mod.relpath,
+                    line,
+                    "jit constructed inside a loop re-traces every "
+                    "iteration; hoist it out or cache by static key",
+                )
+            )
+            continue
+        fn = mod.enclosing_function(node)
+        if fn is None:
+            continue  # module-level construction compiles once per import
+        if mod.has_directive(fn.lineno, "jit-cached"):
+            continue
+        chain = mod.function_chain(node)
+        if any(_decorator_names(f) & _CACHE_DECORATORS for f in chain):
+            continue  # lru_cache'd factory: one construction per key
+        if any(_self_attr_assigned(f) for f in chain):
+            continue  # built locally, cached on self for the object's life
+        if _assigned_to_global(mod, node, fn):
+            continue  # module-global memo (``global X; X = jax.jit(...)``)
+        out.append(
+            Finding(
+                "GL001",
+                mod.relpath,
+                line,
+                f"jit constructed inside {fn.name}() with no caching "
+                "(no self-attribute store, no lru_cache, no jit-cached "
+                "annotation); every call pays a fresh trace+compile",
+            )
+        )
+    return out
+
+
+def _assigned_to_global(mod: Module, jit_call: ast.AST, fn: ast.FunctionDef) -> bool:
+    """``global _MEMO; if _MEMO is None: _MEMO = jax.jit(...)`` caches for
+    the process lifetime, same as a module-level construction."""
+    global_names = {
+        name
+        for node in ast.walk(fn)
+        if isinstance(node, ast.Global)
+        for name in node.names
+    }
+    if not global_names:
+        return False
+    for anc in [jit_call] + list(mod.ancestors(jit_call)):
+        parent = mod.parent(anc)
+        if isinstance(parent, ast.Assign) and parent.value is anc:
+            return any(
+                isinstance(t, ast.Name) and t.id in global_names
+                for t in parent.targets
+            )
+    return False
+
+
+# -- GL002: traced-signature instability -----------------------------------
+
+_ORDER_UNSTABLE_METHODS = {"keys", "values", "items"}
+_STACKERS = {
+    "jnp.stack",
+    "jnp.concatenate",
+    "jnp.array",
+    "jnp.asarray",
+    "np.stack",
+    "np.concatenate",
+    "np.array",
+    "np.asarray",
+}
+
+
+def _jitted_names(mod: Module) -> set[str]:
+    """Names (and self-attrs, as ``self.<attr>``) bound to jit results, plus
+    @jit-decorated function names."""
+    names: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and _is_jit_call(node.value):
+            for tgt in node.targets:
+                d = dotted_name(tgt)
+                if d:
+                    names.add(d)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                d = dotted_name(dec)
+                if d in _JIT_NAMES:
+                    names.add(node.name)
+                elif isinstance(dec, ast.Call):
+                    dn = dotted_name(dec.func)
+                    if dn in _JIT_NAMES:
+                        names.add(node.name)
+                    elif dn in ("functools.partial", "partial") and any(
+                        dotted_name(a) in _JIT_NAMES for a in dec.args
+                    ):
+                        names.add(node.name)
+    return names
+
+
+def _is_order_unstable(node: ast.AST) -> str | None:
+    """set()/dict-view expressions whose iteration order is run-dependent."""
+    if isinstance(node, ast.Call):
+        if dotted_name(node.func) == "set":
+            return "set(...)"
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _ORDER_UNSTABLE_METHODS
+            and not node.args
+        ):
+            # dict .keys()/.values()/.items() are insertion-ordered, but a
+            # traced shape built from them silently depends on build order;
+            # only flag when they feed a traced signature via comprehension
+            # (handled by the caller), not plain iteration.
+            return f".{node.func.attr}()"
+    if isinstance(node, ast.Set) or isinstance(node, ast.SetComp):
+        return "set literal"
+    return None
+
+
+@rule("GL002")
+def check_trace_stability(mod: Module) -> list[Finding]:
+    out = []
+    jitted = _jitted_names(mod)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        line = node.lineno
+        # (b) unhashable static args on the jit call itself
+        if _is_jit_call(node):
+            for kw in node.keywords:
+                if kw.arg in ("static_argnums", "static_argnames") and isinstance(
+                    kw.value, (ast.List, ast.Dict)
+                ):
+                    out.append(
+                        Finding(
+                            "GL002",
+                            mod.relpath,
+                            line,
+                            f"{kw.arg} given as an unhashable "
+                            f"{'list' if isinstance(kw.value, ast.List) else 'dict'}"
+                            " literal; jit requires hashable statics "
+                            "(use a tuple)",
+                        )
+                    )
+            continue
+        fname = dotted_name(node.func)
+        # (a) unstable values passed straight into a jitted callable
+        if fname in jitted:
+            for arg in node.args:
+                if isinstance(arg, ast.JoinedStr):
+                    out.append(
+                        Finding(
+                            "GL002",
+                            mod.relpath,
+                            line,
+                            f"f-string passed to jitted {fname}(); every "
+                            "distinct string is a new static value and a "
+                            "fresh compile",
+                        )
+                    )
+                else:
+                    why = _is_order_unstable(arg)
+                    if why:
+                        out.append(
+                            Finding(
+                                "GL002",
+                                mod.relpath,
+                                line,
+                                f"{why} passed to jitted {fname}(); "
+                                "iteration order is not deterministic — "
+                                "sort before tracing",
+                            )
+                        )
+        # (c) stacking an order-unstable comprehension into a traced array
+        if fname in _STACKERS and node.args:
+            arg = node.args[0]
+            if isinstance(arg, (ast.ListComp, ast.GeneratorExp)):
+                gen = arg.generators[0]
+                why = _is_order_unstable(gen.iter)
+                if why:
+                    out.append(
+                        Finding(
+                            "GL002",
+                            mod.relpath,
+                            line,
+                            f"{fname}() over {why}; element order (and so "
+                            "the traced shape contents) depends on hash "
+                            "order — wrap the iterable in sorted()",
+                        )
+                    )
+    return out
+
+
+# -- GL003: donated-buffer reuse -------------------------------------------
+
+
+def _donated_positions(call: ast.Call) -> list[int]:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return [v.value]
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return [
+                    e.value
+                    for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int)
+                ]
+    return []
+
+
+@rule("GL003")
+def check_donation(mod: Module) -> list[Finding]:
+    """A name passed at a donated position is dead after the call: XLA may
+    alias its buffer into the output, and later reads see garbage (or
+    raise) on real devices while passing on CPU."""
+    out = []
+    funcs = [
+        n
+        for n in ast.walk(mod.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    # module level counts as one scope too; each scope only walks its OWN
+    # statements (nested defs are their own scope) so nothing reports twice
+    for scope in funcs + [mod.tree]:
+        nodes = list(_own_nodes(scope))
+        donating: dict[str, list[int]] = {}  # local name -> donated positions
+        # pass 1: donating callables bound in this scope
+        for node in nodes:
+            if isinstance(node, ast.Assign) and _is_jit_call(node.value):
+                pos = _donated_positions(node.value)
+                if pos:
+                    for tgt in node.targets:
+                        d = dotted_name(tgt)
+                        if d:
+                            donating[d] = pos
+        # pass 2: call sites -> (donated var, call line)
+        donated_vars: list[tuple[str, int]] = []
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            pos: list[int] = []
+            fname = dotted_name(node.func)
+            if fname in donating:
+                pos = donating[fname]
+            elif _is_jit_call(node.func):
+                pos = _donated_positions(node.func)
+            for p in pos:
+                if p < len(node.args) and isinstance(node.args[p], ast.Name):
+                    donated_vars.append((node.args[p].id, node.lineno))
+        if not donated_vars:
+            continue
+        # pass 3: later loads of a donated name (a re-binding in between
+        # clears it — the name no longer refers to the donated buffer)
+        loads: dict[str, list[int]] = {}
+        stores: dict[str, list[int]] = {}
+        for node in nodes:
+            if isinstance(node, ast.Name):
+                bucket = loads if isinstance(node.ctx, ast.Load) else stores
+                bucket.setdefault(node.id, []).append(node.lineno)
+        for var, call_line in donated_vars:
+            for load_line in sorted(loads.get(var, [])):
+                if load_line <= call_line:
+                    continue
+                # a same-line store is ``x = f(x)``: the rebinding kills
+                # the donated reference (args are Loads, never Stores)
+                if any(
+                    call_line <= s <= load_line for s in stores.get(var, [])
+                ):
+                    break  # rebound before this load
+                out.append(
+                    Finding(
+                        "GL003",
+                        mod.relpath,
+                        load_line,
+                        f"{var!r} used after being donated at line "
+                        f"{call_line}; its buffer may already be aliased "
+                        "into the output",
+                    )
+                )
+                break  # one finding per donation site is enough
+    return out
+
+
+# -- GL004: host-sync leaks in engine hot paths ----------------------------
+
+_SYNC_SCOPE_PREFIX = "trivy_tpu/engine/"
+_DEVICE_PREFIXES = ("jax.", "jnp.")
+_CAST_SINKS = {"float", "int", "bool", "list", "tuple"}
+_NP_SINKS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_METHOD_SINKS = {"item", "tolist", "block_until_ready"}
+
+
+@rule("GL004")
+def check_host_sync(mod: Module) -> list[Finding]:
+    """Device->host materialization outside a declared fetch boundary.
+
+    Scope: trivy_tpu/engine/ (and graftlint's own fixtures).  Taint is
+    intra-function: values produced by jax./jnp. calls (or derived from
+    them) reaching np.asarray / float() / .item() / iteration force a
+    device sync mid-pipeline, serializing work the engine overlaps.
+    """
+    rel = mod.relpath
+    if not (
+        rel.startswith(_SYNC_SCOPE_PREFIX)
+        or _SYNC_SCOPE_PREFIX in rel
+        or "graftlint/fixtures/" in rel
+    ):
+        return []
+    out = []
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        boundary = any(
+            mod.has_directive(f.lineno, "fetch-boundary")
+            for f in [fn] + mod.function_chain(fn)
+        )
+        if boundary:
+            continue
+        # only lint the function's own statements, not nested defs (they
+        # get their own pass with their own boundary annotation)
+        own_nodes = _own_nodes(fn)
+        tainted: set[str] = set()
+        for node in own_nodes:
+            if isinstance(node, ast.Assign):
+                if _expr_tainted(node.value, tainted):
+                    for tgt in node.targets:
+                        d = dotted_name(tgt)
+                        if d:
+                            tainted.add(d)
+                else:
+                    for tgt in node.targets:
+                        d = dotted_name(tgt)
+                        tainted.discard(d)
+            elif isinstance(node, ast.Call):
+                snk = _sink_kind(node, tainted)
+                if snk:
+                    out.append(
+                        Finding(
+                            "GL004",
+                            mod.relpath,
+                            node.lineno,
+                            f"{snk} forces a device->host sync in an "
+                            "engine hot path; move it behind a "
+                            "`# graftlint: fetch-boundary` function",
+                        )
+                    )
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                if _expr_tainted(node.iter, tainted) and not isinstance(
+                    node.iter, ast.Call
+                ):
+                    out.append(
+                        Finding(
+                            "GL004",
+                            mod.relpath,
+                            node.lineno,
+                            "iterating a device array pulls it to host "
+                            "element by element; fetch once at a declared "
+                            "boundary instead",
+                        )
+                    )
+    return out
+
+
+def _own_nodes(fn: ast.AST):
+    """Walk fn in document order without descending into nested defs
+    (taint must be assigned before later lines consume it)."""
+    for node in ast.iter_child_nodes(fn):
+        yield node
+        if not isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+        ):
+            yield from _own_nodes(node)
+
+
+def _expr_tainted(node: ast.AST, tainted: set[str]) -> bool:
+    if isinstance(node, ast.Call):
+        d = dotted_name(node.func)
+        if d.startswith(_DEVICE_PREFIXES):
+            return True
+        if d in tainted:
+            return True
+        # method call on a tainted object (dev.reshape(...), etc.)
+        if isinstance(node.func, ast.Attribute) and _expr_tainted(
+            node.func.value, tainted
+        ):
+            return True
+        return False
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.Attribute):
+        return dotted_name(node) in tainted or _expr_tainted(node.value, tainted)
+    if isinstance(node, ast.Subscript):
+        return _expr_tainted(node.value, tainted)
+    if isinstance(node, ast.BinOp):
+        return _expr_tainted(node.left, tainted) or _expr_tainted(
+            node.right, tainted
+        )
+    if isinstance(node, ast.Compare):
+        return _expr_tainted(node.left, tainted) or any(
+            _expr_tainted(c, tainted) for c in node.comparators
+        )
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return any(_expr_tainted(e, tainted) for e in node.elts)
+    return False
+
+
+def _sink_kind(call: ast.Call, tainted: set[str]) -> str | None:
+    fname = dotted_name(call.func)
+    if fname in _NP_SINKS and call.args and _expr_tainted(call.args[0], tainted):
+        return f"{fname}() on a device value"
+    if (
+        fname in _CAST_SINKS
+        and len(call.args) == 1
+        and _expr_tainted(call.args[0], tainted)
+    ):
+        return f"{fname}() on a device value"
+    if (
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr in _METHOD_SINKS
+        and _expr_tainted(call.func.value, tainted)
+    ):
+        return f".{call.func.attr}() on a device value"
+    return None

@@ -1,0 +1,399 @@
+"""Thread-ownership and hook-safety rules.
+
+GL005 is the static half of the lockcheck contract: state declared
+``# owner: <lock>`` may only be MUTATED under ``with <lock>:`` (or inside a
+function annotated ``# graftlint: holds(<lock>)``), and state owned by a
+ROLE (``# owner: engine-owner``) only inside functions annotated
+``# graftlint: owner(<role>)``.  Reads are deliberately unchecked — the
+codebase uses benign racy fast-path reads (double-checked init) whose
+mutations are all locked.
+
+Constructor bodies (``__init__``) and module-level statements are exempt:
+objects are published only after construction, modules after import.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import Finding, Module, dotted_name, rule
+
+_MUTATING_METHODS = {
+    "append",
+    "appendleft",
+    "extend",
+    "insert",
+    "pop",
+    "popleft",
+    "remove",
+    "discard",
+    "clear",
+    "add",
+    "update",
+    "setdefault",
+}
+
+_CONDITION_MAKERS = {
+    "threading.Condition",
+    "lockcheck.make_condition",
+}
+
+
+def _role_owner(owner: str) -> bool:
+    """Owners that are not attribute identifiers are thread roles."""
+    return not owner.isidentifier()
+
+
+class _ClassOwnership:
+    def __init__(self):
+        self.owned: dict[str, str] = {}  # attr -> lock attr or role
+        self.aliases: dict[str, str] = {}  # condition attr -> lock attr
+
+
+def _collect_class(mod: Module, cls: ast.ClassDef) -> _ClassOwnership:
+    own = _ClassOwnership()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        elif isinstance(node, ast.Assign):
+            targets = node.targets
+        else:
+            continue
+        decl = mod.owner_decl(node.lineno)
+        for tgt in targets:
+            if not (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                continue
+            if decl:
+                own.owned[tgt.attr] = decl
+            if (
+                isinstance(node.value, ast.Call)
+                and dotted_name(node.value.func) in _CONDITION_MAKERS
+                and node.value.args
+            ):
+                src = dotted_name(node.value.args[0])
+                if src.startswith("self."):
+                    own.aliases[tgt.attr] = src[len("self.") :]
+    return own
+
+
+def _collect_module_owned(mod: Module) -> tuple[dict[str, str], dict[str, str]]:
+    owned: dict[str, str] = {}
+    aliases: dict[str, str] = {}
+    for node in mod.tree.body:
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        decl = mod.owner_decl(node.lineno)
+        value = node.value
+        for tgt in targets:
+            if not isinstance(tgt, ast.Name):
+                continue
+            if decl:
+                owned[tgt.id] = decl
+            if (
+                isinstance(value, ast.Call)
+                and dotted_name(value.func) in _CONDITION_MAKERS
+                and value.args
+            ):
+                src = dotted_name(value.args[0])
+                if src and "." not in src:
+                    aliases[tgt.id] = src
+    return owned, aliases
+
+
+def _holds_lock(
+    mod: Module,
+    node: ast.AST,
+    lock: str,
+    aliases: dict[str, str],
+    self_scoped: bool,
+) -> bool:
+    """Is `node` under ``with <lock>:`` (or an alias), or inside a function
+    whose callers are declared to hold it?"""
+
+    def matches(expr: ast.AST) -> bool:
+        d = dotted_name(expr)
+        if self_scoped:
+            if not d.startswith("self."):
+                return False
+            attr = d[len("self.") :]
+        else:
+            attr = d
+        return attr == lock or aliases.get(attr) == lock
+
+    for anc in mod.ancestors(node):
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                if matches(item.context_expr):
+                    return True
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            held = mod.directive_arg(anc.lineno, "holds")
+            if held is not None and (held == lock or aliases.get(held) == lock):
+                return True
+    return False
+
+
+def _runs_as_role(mod: Module, node: ast.AST, role: str) -> bool:
+    for anc in mod.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if mod.directive_arg(anc.lineno, "owner") == role:
+                return True
+    return False
+
+
+def _in_init_or_module_level(mod: Module, node: ast.AST, self_scoped: bool) -> bool:
+    fn = mod.enclosing_function(node)
+    if fn is None:
+        return True  # import-time / class-body statement
+    return self_scoped and fn.name == "__init__"
+
+
+def _attr_mutations(scope: ast.AST):
+    """Yield (node, base_expr, kind) for every mutation site in `scope`:
+    plain/aug/tuple assigns, subscript stores/deletes, mutating calls."""
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                for leaf in _flatten_target(tgt):
+                    yield node, leaf, "assignment"
+        elif isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                for leaf in _flatten_target(node.target):
+                    yield node, leaf, "assignment"
+        elif isinstance(node, ast.AugAssign):
+            for leaf in _flatten_target(node.target):
+                yield node, leaf, "augmented assignment"
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript):
+                    yield node, tgt.value, "del"
+        elif isinstance(node, ast.Call):
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATING_METHODS
+            ):
+                yield node, node.func.value, f".{node.func.attr}()"
+
+
+def _flatten_target(tgt: ast.AST):
+    if isinstance(tgt, (ast.Tuple, ast.List)):
+        for e in tgt.elts:
+            yield from _flatten_target(e)
+    elif isinstance(tgt, ast.Subscript):
+        yield tgt.value
+    else:
+        yield tgt
+
+
+@rule("GL005")
+def check_ownership(mod: Module) -> list[Finding]:
+    out = []
+
+    def check_site(node, base, kind, name, owner, aliases, self_scoped):
+        if _in_init_or_module_level(mod, node, self_scoped):
+            return
+        if _role_owner(owner):
+            if not _runs_as_role(mod, node, owner):
+                out.append(
+                    Finding(
+                        "GL005",
+                        mod.relpath,
+                        node.lineno,
+                        f"{kind} of {name!r} (owner role {owner!r}) outside "
+                        f"a `# graftlint: owner({owner})` function",
+                    )
+                )
+        elif not _holds_lock(mod, node, owner, aliases, self_scoped):
+            out.append(
+                Finding(
+                    "GL005",
+                    mod.relpath,
+                    node.lineno,
+                    f"{kind} of {name!r} without holding its declared "
+                    f"lock {owner!r}",
+                )
+            )
+
+    for cls in (n for n in ast.walk(mod.tree) if isinstance(n, ast.ClassDef)):
+        own = _collect_class(mod, cls)
+        if not own.owned:
+            continue
+        for node, base, kind in _attr_mutations(cls):
+            if not (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"
+            ):
+                continue
+            owner = own.owned.get(base.attr)
+            if owner is None:
+                continue
+            check_site(
+                node, base, kind, f"self.{base.attr}", owner, own.aliases, True
+            )
+
+    mod_owned, mod_aliases = _collect_module_owned(mod)
+    if mod_owned:
+        for node, base, kind in _attr_mutations(mod.tree):
+            if not isinstance(base, ast.Name):
+                continue
+            owner = mod_owned.get(base.id)
+            if owner is None:
+                continue
+            check_site(node, base, kind, base.id, owner, mod_aliases, False)
+    return out
+
+
+# -- GL006: hook safety ----------------------------------------------------
+
+
+@rule("GL006")
+def check_hooks(mod: Module) -> list[Finding]:
+    out = []
+    out.extend(_check_gauge_pairs(mod))
+    out.extend(_check_span_use(mod))
+    out.extend(_check_collect_hooks(mod))
+    return out
+
+
+def _check_gauge_pairs(mod: Module) -> list[Finding]:
+    """An inc whose matching dec can be skipped by an exception leaks the
+    gauge forever (the inflight counter bug class): the dec must sit in a
+    ``finally``."""
+    out = []
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        incs: dict[str, ast.Call] = {}
+        decs: dict[str, ast.Call] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                key = ast.dump(node.func.value)
+                if node.func.attr == "inc":
+                    incs.setdefault(key, node)
+                elif node.func.attr == "dec":
+                    decs.setdefault(key, node)
+        for key, inc in incs.items():
+            dec = decs.get(key)
+            if dec is None or dec.lineno <= inc.lineno:
+                continue
+            if not _in_finally(mod, dec):
+                out.append(
+                    Finding(
+                        "GL006",
+                        mod.relpath,
+                        inc.lineno,
+                        "gauge inc()/dec() pair where the dec is not in a "
+                        "finally: an exception in between leaks the gauge",
+                    )
+                )
+    return out
+
+
+def _in_finally(mod: Module, node: ast.AST) -> bool:
+    prev: ast.AST = node
+    for anc in mod.ancestors(node):
+        if isinstance(anc, ast.Try) and any(
+            prev is stmt or _contains(stmt, prev) for stmt in anc.finalbody
+        ):
+            return True
+        prev = anc
+    return False
+
+
+def _contains(root: ast.AST, target: ast.AST) -> bool:
+    return any(n is target for n in ast.walk(root))
+
+
+def _check_span_use(mod: Module) -> list[Finding]:
+    """span() is only safe as a ``with`` context manager: assigned to a
+    variable its __exit__ (ring append, ctx reset) can be skipped."""
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted_name(node.func)
+        if not (d == "span" or d.endswith(".span")):
+            continue
+        if d.endswith(".span") and not any(
+            hint in d for hint in ("trace", "obs")
+        ):
+            continue  # unrelated .span() methods
+        parent = mod.parent(node)
+        if isinstance(parent, ast.withitem):
+            continue
+        if isinstance(parent, ast.Return) and _inside_def_named(
+            mod, node, ("span",)
+        ):
+            continue  # the trace module's own factory
+        out.append(
+            Finding(
+                "GL006",
+                mod.relpath,
+                node.lineno,
+                f"{d}(...) used outside a `with` statement; a span whose "
+                "__exit__ can be skipped corrupts the ambient trace context",
+            )
+        )
+    return out
+
+
+def _inside_def_named(mod: Module, node: ast.AST, names: tuple[str, ...]) -> bool:
+    fn = mod.enclosing_function(node)
+    return fn is not None and fn.name in names
+
+
+def _check_collect_hooks(mod: Module) -> list[Finding]:
+    """A collect hook that raises kills the whole scrape for every family
+    behind it; hooks must catch their own risk (a registry may shield them,
+    but hooks are also rendered by code that does not)."""
+    out = []
+    func_defs = {
+        n.name: n
+        for n in ast.walk(mod.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    for node in ast.walk(mod.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and dotted_name(node.func).split(".")[-1] == "add_collect_hook"
+            and node.args
+            and isinstance(node.args[0], ast.Name)
+        ):
+            continue
+        hook = func_defs.get(node.args[0].id)
+        if hook is None:
+            continue
+        for raise_node in ast.walk(hook):
+            if isinstance(raise_node, ast.Raise) and not _under_handler(
+                mod, raise_node, hook
+            ):
+                out.append(
+                    Finding(
+                        "GL006",
+                        mod.relpath,
+                        hook.lineno,
+                        f"collect hook {hook.name}() can raise "
+                        f"(line {raise_node.lineno}); a raising hook "
+                        "aborts the metrics scrape",
+                    )
+                )
+                break
+    return out
+
+
+def _under_handler(mod: Module, node: ast.AST, stop: ast.AST) -> bool:
+    """Raise guarded by an enclosing try-with-handlers inside the hook."""
+    prev: ast.AST = node
+    for anc in mod.ancestors(node):
+        if anc is stop:
+            return False
+        if isinstance(anc, ast.Try) and anc.handlers:
+            if any(prev is s or _contains(s, prev) for s in anc.body):
+                return True
+        prev = anc
+    return False

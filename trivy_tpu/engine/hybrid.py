@@ -34,12 +34,12 @@ scans; `make_secret_engine` picks per availability.
 from __future__ import annotations
 
 import contextvars
-import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from trivy_tpu import lockcheck
 from trivy_tpu.engine.device import SieveStats, TpuSecretEngine
 from trivy_tpu.ftypes import Secret
 from trivy_tpu.obs import trace as obs_trace
@@ -86,11 +86,11 @@ def _tpu_default_backend() -> bool:
 # ships 3x8MB through the relay — ~0.4s per HybridSecretEngine before the
 # cache) while tests that flip the override still see their value.  Guarded
 # by a lock: engines are built from thread pools in the server path.
-_LINK_PROBE: dict[str, tuple[float, float]] = {}
-_LINK_PROBE_LOCK = threading.Lock()
+_LINK_PROBE_LOCK = lockcheck.make_lock("engine.hybrid.link_probe")
+_LINK_PROBE: dict[str, tuple[float, float]] = {}  # owner: _LINK_PROBE_LOCK
 
 
-def probe_link(size: int = 8 << 20, attempts: int = 3):
+def probe_link(size: int = 8 << 20, attempts: int = 3):  # graftlint: fetch-boundary
     """(mb_per_sec, round_trip_s) of the host<->device link, measured once
     per process as the best of `attempts` `size`-byte transfers (relay
     tunnels jitter by 10x+ on small probes, so one sample misclassifies).

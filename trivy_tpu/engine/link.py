@@ -347,7 +347,7 @@ def _compact_jits():
     return row_flags, gather_rows
 
 
-def fetch_rows_compact(out) -> tuple[np.ndarray, int, int]:
+def fetch_rows_compact(out) -> tuple[np.ndarray, int, int]:  # graftlint: fetch-boundary
     """Fetch a device array whose leading axis is rows, compacting to the
     nonzero rows: (host array, raw_bytes, fetched_bytes).
 
@@ -399,7 +399,7 @@ def _stream_lane_jit():
     return to_lanes
 
 
-def fetch_stream_packed(out) -> tuple[np.ndarray, int, int]:
+def fetch_stream_packed(out) -> tuple[np.ndarray, int, int]:  # graftlint: fetch-boundary
     """Compacted fetch of the verify stream's packed flag tensor
     ([ceil(R/8), Lo, G, Bg] uint8): device-side transpose to lane-major
     2D, nonzero-lane gather, host-side reshape back.  Returns

@@ -184,7 +184,7 @@ class NfaVerifier:
             return jnp.asarray(classes_t), jnp.asarray(gids)
         return jax.device_put(classes_t, cls_sh), jax.device_put(gids, gid_sh)
 
-    def warmup(self, compile_buckets: bool = False) -> None:
+    def warmup(self, compile_buckets: bool = False) -> None:  # graftlint: fetch-boundary
         """Ship rule tensors; with ``compile_buckets`` also pre-compile the
         jit specializations bulk work actually hits: every length bucket at
         the largest group count (big batches ride max-G dispatches) plus
@@ -537,7 +537,7 @@ class NfaVerifier:
                 for t in (fol, acc, fst, lst)
             )
 
-        def _fetch_one():
+        def _fetch_one():  # graftlint: fetch-boundary
             tier_, lo_, hi_, out = in_flight.popleft()
             tf = _time.perf_counter()
             with obs_trace.span("verify.fetch", rows=hi_ - lo_):

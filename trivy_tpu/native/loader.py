@@ -12,9 +12,10 @@ import ctypes
 import hashlib
 import os
 import subprocess
-import threading
 
 import numpy as np
+
+from trivy_tpu import lockcheck
 
 _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
@@ -22,9 +23,9 @@ _NATIVE_DIR = os.path.join(
 )
 _SOURCES = ["gram_sieve.cpp"]
 
-_lock = threading.Lock()
-_lib: ctypes.CDLL | None = None
-_lib_failed = False
+_lock = lockcheck.make_lock("native.loader")
+_lib: ctypes.CDLL | None = None  # owner: _lock
+_lib_failed = False  # owner: _lock
 
 
 def _cache_dir() -> str:

@@ -25,6 +25,8 @@ import threading
 from bisect import bisect_left
 from typing import Callable, Iterable
 
+from trivy_tpu import lockcheck
+
 # Request/wait latency buckets: 1ms..60s, roughly log-spaced.  The scan
 # server's floor is a batch window of a few ms and its ceiling a deadline
 # of minutes; these cover both tails.
@@ -250,9 +252,9 @@ class Registry:
     """One scrape surface: ordered families + collect hooks."""
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._families: dict[str, _Family] = {}
-        self._hooks: list[Callable[[], None]] = []
+        self._lock = lockcheck.make_lock("obs.metrics.registry")
+        self._families: dict[str, _Family] = {}  # owner: _lock
+        self._hooks: list[Callable[[], None]] = []  # owner: _lock
 
     def _register(self, cls, name: str, help_text: str, labelnames, **kw):
         with self._lock:
@@ -265,7 +267,7 @@ class Registry:
                     )
                 return fam
             fam = cls(name, help_text, tuple(labelnames),
-                      threading.Lock(), **kw)
+                      lockcheck.make_lock("obs.metrics.family"), **kw)
             self._families[name] = fam
             return fam
 

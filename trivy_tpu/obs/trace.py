@@ -32,16 +32,18 @@ import uuid
 from collections import deque
 from dataclasses import dataclass, field
 
+from trivy_tpu import lockcheck
+
 # perf_counter -> wall-clock anchor, fixed at import so every span in the
 # process (and its chrome export) shares one timebase.
 _EPOCH_S = time.time() - time.perf_counter()
 
 DEFAULT_RING = 8192
 
-_lock = threading.Lock()
-_ring: deque = deque(maxlen=DEFAULT_RING)
+_lock = lockcheck.make_lock("obs.trace.ring")
+_ring: deque = deque(maxlen=DEFAULT_RING)  # owner: _lock
 _enabled = os.environ.get("TRIVY_TPU_TRACE", "") not in ("", "0", "false", "off")
-_next_id = 0
+_next_id = 0  # owner: _lock
 
 # (trace_id, span_id) of the innermost open span on this thread/context.
 _ctx: contextvars.ContextVar[tuple[str, int] | None] = contextvars.ContextVar(
@@ -97,7 +99,7 @@ def current_trace_id() -> str:
     return cur[0] if cur else ""
 
 
-def _alloc_id() -> int:
+def _alloc_id() -> int:  # graftlint: holds(_lock)
     global _next_id
     _next_id += 1
     return _next_id

@@ -15,20 +15,26 @@ the old engine while the replacement builds.
 
 from __future__ import annotations
 
-import threading
-
+from trivy_tpu import lockcheck
 from trivy_tpu.registry.digest import engine_digest
 
 
 class RulesetManager:
     def __init__(self, engine_factory):
         self._factory = engine_factory
-        self._lock = threading.Lock()
-        self._active = None
-        self._active_digest = ""
-        self._staged: tuple[object, str] | None = None
-        self._epoch = 0  # bumps on every install, including the first
-        self._reloads = 0  # installs that REPLACED a live engine
+        self._lock = lockcheck.make_lock("registry.manager")
+        # engine() binds this role to its first calling thread; under
+        # TRIVY_TPU_LOCKCHECK=1 a second thread calling engine() on the
+        # same manager raises (the "only the owner thread swaps epochs"
+        # contract, enforced instead of commented).
+        self._owner = lockcheck.owner_role("ruleset.manager.owner")
+        self._active = None  # owner: engine-owner
+        self._active_digest = ""  # owner: _lock
+        self._staged: tuple[object, str] | None = None  # owner: _lock
+        # bumps on every install, including the first
+        self._epoch = 0  # owner: _lock
+        # installs that REPLACED a live engine
+        self._reloads = 0  # owner: _lock
 
     # -- staging (any thread) -------------------------------------------
 
@@ -51,11 +57,12 @@ class RulesetManager:
 
     # -- the owner thread -----------------------------------------------
 
-    def engine(self) -> tuple[object, str]:
+    def engine(self) -> tuple[object, str]:  # graftlint: owner(engine-owner)
         """Called by the engine-owner thread at each batch boundary: swap
         in anything staged, lazily build the first engine, and return
         (engine, digest) for this batch.  Only this method ever installs,
         so the active engine never changes mid-batch."""
+        self._owner.assert_here()
         with self._lock:
             staged, self._staged = self._staged, None
         if staged is not None:
@@ -68,7 +75,7 @@ class RulesetManager:
             self._install(engine, engine_digest(engine))
         return self._active, self._active_digest
 
-    def _install(self, engine, digest: str) -> None:
+    def _install(self, engine, digest: str) -> None:  # graftlint: owner(engine-owner)
         self._active = engine
         with self._lock:
             self._active_digest = digest

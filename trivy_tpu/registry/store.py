@@ -487,7 +487,8 @@ def aot_warmup(engine) -> dict:
         cols = getattr(engine, "_staged_cols", engine.tile_len)
         for rows in engine._buckets():
             spec = jax.ShapeDtypeStruct((rows, cols), jnp.uint8)
-            jax.jit(lambda t: fn(t)).lower(spec).compile()
+            # per-bucket traces land in the persistent compilation cache
+            jax.jit(lambda t: fn(t)).lower(spec).compile()  # graftlint: jit-cached
             out["buckets"].append(rows)
             out["compiled"] += 1
     except Exception as e:  # AOT is best-effort by contract

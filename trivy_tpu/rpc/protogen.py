@@ -13,14 +13,15 @@ import hashlib
 import os
 import subprocess
 import sys
-import threading
+
+from trivy_tpu import lockcheck
 
 _PROTO_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "proto")
 _SOURCES = ["common.proto", "scanner.proto", "cache.proto"]
 
-_lock = threading.Lock()
-_mods: dict | None = None
-_failed = False
+_lock = lockcheck.make_lock("rpc.protogen")
+_mods: dict | None = None  # owner: _lock
+_failed = False  # owner: _lock
 
 
 def _cache_dir(h: str) -> str:

@@ -37,7 +37,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from trivy_tpu import __version__, deadline
+from trivy_tpu import __version__, deadline, lockcheck
 from trivy_tpu.atypes import ArtifactInfo, _secret_to_json
 from trivy_tpu.cache.store import (
     ArtifactCache,
@@ -137,9 +137,9 @@ class ScanServer:
         # Live-profiling window (POST /admin/profile/start|stop): default
         # output dir from --profile-dir, overridable per start request.
         self.profile_dir = profile_dir
-        self._profile_lock = threading.Lock()
-        self._profiling = False
-        self._profile_path = ""
+        self._profile_lock = lockcheck.make_lock("rpc.server.profile")
+        self._profiling = False  # owner: _profile_lock
+        self._profile_path = ""  # owner: _profile_lock
 
     def _build_engine(self):
         """Default engine factory: built lazily ON the engine-owner thread

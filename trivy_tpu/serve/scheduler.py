@@ -43,6 +43,7 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 
 from trivy_tpu import deadline as _deadline
+from trivy_tpu import lockcheck
 from trivy_tpu.deadline import ScanTimeoutError
 from trivy_tpu.obs import metrics as obs_metrics
 from trivy_tpu.obs import trace as obs_trace
@@ -153,12 +154,15 @@ class BatchScheduler:
         # boundaries and in-flight batches finish on the engine they
         # started with.
         self.manager = RulesetManager(engine_factory)
-        self._q: deque[Ticket] = deque()
-        self._lock = threading.Lock()
-        self._not_empty = threading.Condition(self._lock)
-        self._inflight: dict[str, int] = {}
-        self._admitting = True
-        self._thread: threading.Thread | None = None
+        self._lock = lockcheck.make_lock("serve.scheduler")
+        self._not_empty = lockcheck.make_condition(self._lock)
+        # The engine-owner role: only _dispatch (the serve-batcher thread)
+        # runs engines; under TRIVY_TPU_LOCKCHECK=1 this is asserted live.
+        self._owner = lockcheck.owner_role("serve.batcher")
+        self._q: deque[Ticket] = deque()  # owner: _lock
+        self._inflight: dict[str, int] = {}  # owner: _lock
+        self._admitting = True  # owner: _lock
+        self._thread: threading.Thread | None = None  # owner: _lock
         # SchedulerStats stays the programmatic surface (bench.py and the
         # serve tests read it); the registry is the exposition surface.
         # Both are written at event time — dual-write, one source of truth
@@ -403,7 +407,8 @@ class BatchScheduler:
                 nbytes += nxt.nbytes
             self._dispatch(batch, nbytes)
 
-    def _dispatch(self, batch: list[Ticket], nbytes: int) -> None:
+    def _dispatch(self, batch: list[Ticket], nbytes: int) -> None:  # graftlint: owner(serve-batcher)
+        self._owner.assert_here()
         t0 = time.monotonic()
         combined: list[tuple[str, bytes]] = []
         spans: list[tuple[int, int]] = []
