@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test lint lint-changed lockcheck smoke serve-smoke obs-smoke bench bench-link checks-corpus rules-cache
+.PHONY: test lint lint-changed lockcheck smoke serve-smoke obs-smoke tenancy-smoke bench bench-link checks-corpus rules-cache
 
 # Tier-1: the suite the driver holds the repo to (fast, CPU, no slow marks).
 # Lint runs first — a graftlint finding fails the build before pytest
@@ -41,7 +41,7 @@ lint-changed:
 lockcheck:
 	TRIVY_TPU_LOCKCHECK=1 JAX_PLATFORMS=cpu $(PY) -m pytest \
 		tests/test_serve_scheduler.py tests/test_serve_reload.py \
-		tests/test_chunk_pipeline.py \
+		tests/test_chunk_pipeline.py tests/test_tenancy.py \
 		-q -m 'not slow' -p no:cacheprovider
 
 # CI smoke: tiny-corpus bench.py --smoke on CPU (pipeline depth 2) via the
@@ -72,7 +72,20 @@ obs-smoke:
 		-q -p no:cacheprovider && \
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
-		BENCH_IMAGE=0 $(PY) bench.py --smoke
+		BENCH_IMAGE=0 BENCH_TENANT=0 $(PY) bench.py --smoke
+
+# Multi-tenant serving smoke (trivy_tpu/tenancy/): lane routing, WRR
+# fairness, pool LRU/warm re-admit, quota 429s, rules push e2e — with the
+# lock-order sanitizer armed — then a BENCH_TENANT-only bench run (lane
+# fill ratio, cross-tenant shared batches, pool hit rate, zero-recompile
+# evict/re-admit cycle on the single-JSON-line contract).
+tenancy-smoke:
+	TRIVY_TPU_LOCKCHECK=1 JAX_PLATFORMS=cpu $(PY) -m pytest \
+		tests/test_tenancy.py tests/test_rules_push.py \
+		-q -m 'not slow' -p no:cacheprovider && \
+	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
+		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
+		BENCH_IMAGE=0 BENCH_OBS=0 $(PY) bench.py --smoke
 
 # Full benchmark (honest corpora; on CPU this takes a while).
 bench:
@@ -84,7 +97,7 @@ bench:
 bench-link:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 BENCH_IMAGE=0 \
-		BENCH_FILES=2000 BENCH_PARITY=sample $(PY) bench.py
+		BENCH_TENANT=0 BENCH_FILES=2000 BENCH_PARITY=sample $(PY) bench.py
 
 # Precompile the builtin ruleset into the registry cache (trivy_tpu/registry/)
 # so every later scan/server process warm-starts without compiling rules.

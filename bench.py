@@ -358,6 +358,169 @@ def bench_serve(engine, n_clients: int = 16, files_per_req: int = 8) -> dict:
     return out
 
 
+def bench_tenant(engine, n_tenants: int = 8, files_per_req: int = 6) -> dict:
+    """BENCH_TENANT: multi-tenant ruleset serving (trivy_tpu/tenancy/).
+
+    Two ruleset digests (the server default + a pushed custom ruleset)
+    served from one scheduler: tenants split across them, same-digest
+    tenants coalescing into shared device batches.  Reports lane fill
+    ratio, cross-tenant shared-batch count, the shared-batch speedup vs
+    running each tenant serially on its own engine, the resident pool's
+    hit rate, and an evict/warm-re-admit cycle (recompiles must be 0 —
+    the registry warm path is the acceptance bar)."""
+    import tempfile
+    import textwrap
+    import threading
+
+    from trivy_tpu.engine.hybrid import make_secret_engine
+    from trivy_tpu.registry import store as rstore
+    from trivy_tpu.registry.digest import ruleset_digest
+    from trivy_tpu.rules.model import build_ruleset, load_config
+    from trivy_tpu.serve import BatchScheduler, ServeConfig
+    from trivy_tpu.tenancy.pool import ResidentRulesetPool, UnknownRulesetError
+
+    custom_yaml = textwrap.dedent(
+        """
+        rules:
+          - id: bench-tenant-token
+            category: custom
+            title: Bench tenant token
+            severity: critical
+            regex: BENCHTOK-[a-f0-9]{8}
+            keywords: [BENCHTOK-]
+        """
+    )
+    tmp = tempfile.mkdtemp(prefix="bench-tenant-")
+    cfg_path = os.path.join(tmp, "custom.yaml")
+    with open(cfg_path, "w", encoding="utf-8") as f:
+        f.write(custom_yaml)
+    cache_dir = os.path.join(tmp, "rulesets")
+    custom_rs = build_ruleset(load_config(cfg_path))
+    custom_digest = ruleset_digest(custom_rs)
+    builtin_rs = build_ruleset(None)
+    builtin_digest = ruleset_digest(builtin_rs)
+    rstore.get_or_compile(custom_rs, cache_dir=cache_dir)
+    rstore.get_or_compile(builtin_rs, cache_dir=cache_dir)
+    rstore.save_ruleset_source(cache_dir, custom_digest, custom_yaml)
+    rstore.save_ruleset_source(cache_dir, builtin_digest, "")
+
+    recompiles = [0]
+    real_compile = rstore.compile_ruleset
+
+    def counting_compile(*a, **kw):
+        recompiles[0] += 1
+        return real_compile(*a, **kw)
+
+    def loader(digest):
+        ruleset = rstore.load_ruleset_source(cache_dir, digest)
+        if ruleset is None:
+            raise UnknownRulesetError(digest)
+        art = rstore.load_artifact(cache_dir, digest)
+        source = "warm"
+        if art is None:
+            art, source = rstore.get_or_compile(ruleset, cache_dir=cache_dir)
+        eng = make_secret_engine(ruleset=ruleset, backend="auto", compiled=art)
+        return eng, rstore.artifact_device_bytes(art), source
+
+    corpus = bench_corpus.make_monorepo_corpus(n_tenants * files_per_req)
+    reqs = [
+        corpus[i * files_per_req : (i + 1) * files_per_req]
+        for i in range(n_tenants)
+    ]
+    # Tenants alternate digests: even -> default lane, odd -> custom.
+    digests = ["" if i % 2 == 0 else custom_digest for i in range(n_tenants)]
+
+    # Per-tenant serial baseline: each tenant's engine scans its own
+    # requests, one tenant at a time (what per-tenant processes would do).
+    custom_engine, _, _ = loader(custom_digest)
+    t0 = time.perf_counter()
+    for items, dig in zip(reqs, digests):
+        (custom_engine if dig else engine).scan_batch(items)
+    serial_s = time.perf_counter() - t0
+
+    sched = BatchScheduler(
+        lambda: engine,
+        ServeConfig(batch_window_ms=8.0),
+        ruleset_loader=loader,
+    )
+    # Warm both lanes (admits the custom digest + traces its engine) so
+    # the timed section measures steady-state batching, not compile.
+    warm = corpus[:1]
+    sched.submit(warm, client_id="warmup", ruleset_digest="").result()
+    sched.submit(
+        warm, client_id="warmup", ruleset_digest=custom_digest
+    ).result()
+    s0 = sched.stats
+    base_batches = s0.batches
+    base_cross = s0.cross_tenant_batches
+    base_multi = s0.multi_request_batches
+    base_fill = s0.fill_ratio_sum
+    base_hits, base_misses = sched.pool.stats.hits, sched.pool.stats.misses
+    barrier = threading.Barrier(n_tenants)
+    futs = [None] * n_tenants
+
+    def fire(i):
+        barrier.wait()
+        futs[i] = sched.submit(
+            reqs[i], client_id=f"tenant{i}", ruleset_digest=digests[i]
+        )
+
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(target=fire, args=(i,)) for i in range(n_tenants)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for f in futs:
+        f.result()
+    shared_s = time.perf_counter() - t0
+    s, pstats = sched.stats, sched.pool.stats
+    n_batches = s.batches - base_batches
+    hits = pstats.hits - base_hits
+    misses = pstats.misses - base_misses
+    sched.drain(timeout=30)
+
+    # Evict/warm-re-admit cycle on a pool-of-one: re-admitting after
+    # eviction must ride the registry warm path, never recompile.
+    rstore.compile_ruleset = counting_compile
+    try:
+        small = ResidentRulesetPool(loader, max_resident=1)
+        small.ensure(custom_digest)
+        small.ensure(builtin_digest)  # evicts custom
+        small.ensure(custom_digest)  # warm re-admit
+        cycle = {
+            "evictions": small.stats.evictions,
+            "warm_admits": small.stats.warm_admits,
+            "recompiles": recompiles[0],
+        }
+    finally:
+        rstore.compile_ruleset = real_compile
+
+    out = {
+        "tenants": n_tenants,
+        "rulesets": 2,
+        "files_per_request": files_per_req,
+        "per_tenant_serial_s": round(serial_s, 4),
+        "shared_batch_s": round(shared_s, 4),
+        "batches": n_batches,
+        "cross_tenant_batches": s.cross_tenant_batches - base_cross,
+        "multi_request_batches": s.multi_request_batches - base_multi,
+        "lane_fill_ratio": round(
+            (s.fill_ratio_sum - base_fill) / max(n_batches, 1), 4
+        ),
+        "pool_hits": hits,
+        "pool_misses": misses,
+        "pool_hit_rate": round(hits / max(hits + misses, 1), 4),
+        "pool_warm_admits": pstats.warm_admits,
+        "evict_readmit": cycle,
+    }
+    if shared_s > 0:
+        out["shared_batch_speedup"] = round(serial_s / shared_s, 3)
+    return out
+
+
 def bench_license(n_files: int = 2000, n_license: int = 300) -> dict:
     """BASELINE config #5's second scanner: the license classifier
     (--scanners secret,license).  A corpus of source-shaped files with
@@ -1153,6 +1316,21 @@ def main() -> None:
                 detail["serve"] = bench_serve(engine)
         except Exception as e:
             detail["serve"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if os.environ.get("BENCH_TENANT", "1") == "1":
+        # Multi-tenant ruleset serving (trivy_tpu/tenancy/): two digests
+        # on one scheduler — lane fill ratio, cross-tenant shared-batch
+        # speedup vs per-tenant serial, pool hit rate, and an
+        # evict/warm-re-admit cycle with zero recompiles.
+        try:
+            if SMOKE:
+                detail["tenant"] = bench_tenant(
+                    engine, n_tenants=4, files_per_req=3
+                )
+            else:
+                detail["tenant"] = bench_tenant(engine)
+        except Exception as e:
+            detail["tenant"] = {"error": f"{type(e).__name__}: {e}"}
 
     if os.environ.get("BENCH_OBS", "1") == "1":
         # Observability economics (trivy_tpu/obs/): disabled-path no-op

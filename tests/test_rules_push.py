@@ -1,0 +1,260 @@
+"""`rules push` end-to-end: install a ruleset into a live server's
+registry by digest, then scan under it.
+
+Real in-process server (the integration_test.go pattern) with a
+registry-backed resident pool.  Covers: YAML push with server-side
+compile, client-compiled artifact adoption ("pushed" source), digest
+routing via request field and response header, 404 for unknown digests
+(non-retryable), per-tenant quota 429 with Retry-After over HTTP, the
+CLI `rules push` path, and build_info exposing one series per resident
+ruleset.
+"""
+
+import base64
+import json
+import textwrap
+import urllib.error
+import urllib.request
+from argparse import Namespace
+
+import pytest
+
+from trivy_tpu.cache.store import MemoryCache
+from trivy_tpu.engine.hybrid import make_secret_engine
+from trivy_tpu.registry import store as rstore
+from trivy_tpu.rpc.client import RpcClient, RpcError
+from trivy_tpu.rpc.server import start_background
+from trivy_tpu.serve import ServeConfig
+
+CUSTOM_YAML = textwrap.dedent(
+    """
+    rules:
+      - id: push-test-token
+        category: custom
+        title: Push test token
+        severity: critical
+        regex: PUSHTOK-[a-f0-9]{8}
+        keywords: [PUSHTOK-]
+    """
+)
+
+CUSTOM_FILE = b"token = PUSHTOK-deadbeef\n"
+PLAIN_FILE = b"nothing to see here\n"
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return make_secret_engine()
+
+
+@pytest.fixture
+def push_server(engine, tmp_path, monkeypatch):
+    """Server with a registry dir (=> resident pool enabled) reusing the
+    module engine for the default lane."""
+    monkeypatch.setenv("TRIVY_TPU_LINK", "relay")
+    cache_dir = str(tmp_path / "rulesets")
+    httpd, _ = start_background(
+        "localhost:0",
+        MemoryCache(),
+        serve_config=ServeConfig(batch_window_ms=20.0),
+        secret_engine_factory=lambda: engine,
+        rules_cache_dir=cache_dir,
+    )
+    addr = f"{httpd.server_address[0]}:{httpd.server_address[1]}"
+    yield addr, httpd.scan_server, cache_dir
+    httpd.scan_server.scheduler.close()
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def _finding_ids(resp):
+    return [
+        f.get("RuleID")
+        for s in (resp.get("Secrets") or [])
+        for f in (s.get("Findings") or [])
+    ]
+
+
+def test_push_yaml_then_scan_under_pushed_digest(push_server):
+    addr, scan_server, _ = push_server
+    client = RpcClient(addr)
+    resp = client.push_ruleset(rules_yaml=CUSTOM_YAML)
+    digest = resp["RulesetDigest"]
+    assert digest and resp["Resident"] is True
+    assert resp["Source"] in ("cold", "warm")  # server-side compile
+
+    # Scanning under the pushed digest finds the custom token...
+    out = client.scan_secrets(
+        [("a/tok.txt", CUSTOM_FILE)], client_id="t1", ruleset_digest=digest
+    )
+    assert "push-test-token" in _finding_ids(out)
+    assert out["RulesetDigest"] == digest
+    hdr = {k.lower(): v for k, v in client.last_response_headers.items()}
+    assert hdr.get("x-trivy-ruleset") == digest
+    # ...and the default ruleset (no digest) does not.
+    out_default = client.scan_secrets(
+        [("a/tok.txt", CUSTOM_FILE)], client_id="t1"
+    )
+    assert "push-test-token" not in _finding_ids(out_default)
+    assert out_default["RulesetDigest"] != digest
+    # The pool hit path served the second pushed-digest request warm.
+    pool = scan_server.scheduler.pool
+    assert pool is not None and pool.resident_count() >= 1
+
+
+def test_push_client_compiled_artifact_is_adopted(push_server, tmp_path):
+    addr, _, _ = push_server
+    from trivy_tpu.rules.model import build_ruleset, load_config
+
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text(CUSTOM_YAML)
+    local_cache = str(tmp_path / "local-cache")
+    art, _ = rstore.get_or_compile(
+        build_ruleset(load_config(str(cfg))), cache_dir=local_cache
+    )
+    art_dir = f"{local_cache}/{art.digest}"
+    with open(f"{art_dir}/{rstore.MANIFEST_JSON}", encoding="utf-8") as f:
+        manifest = json.load(f)
+    with open(f"{art_dir}/{rstore.ARTIFACT_NPZ}", "rb") as f:
+        npz = f.read()
+
+    client = RpcClient(addr)
+    resp = client.push_ruleset(
+        rules_yaml=CUSTOM_YAML, manifest_json=manifest, npz=npz
+    )
+    assert resp["RulesetDigest"] == art.digest
+    assert resp["Source"] == "pushed"  # no server-side compile
+
+
+def test_scan_unknown_digest_is_404_and_not_retried(push_server):
+    addr, _, _ = push_server
+    client = RpcClient(addr)
+    slept = []
+    client.sleep = slept.append
+    with pytest.raises(RpcError) as ei:
+        client.scan_secrets(
+            [("a.txt", PLAIN_FILE)], ruleset_digest="f" * 64
+        )
+    assert "404" in str(ei.value)
+    assert slept == []  # deterministic: the retry loop never engaged
+
+
+def test_ruleset_select_header_routes_like_the_field(push_server):
+    addr, _, _ = push_server
+    client = RpcClient(addr)
+    digest = client.push_ruleset(rules_yaml=CUSTOM_YAML)["RulesetDigest"]
+    body = json.dumps(
+        {
+            "Files": [
+                {
+                    "Path": "h/tok.txt",
+                    "ContentB64": base64.b64encode(CUSTOM_FILE).decode(),
+                }
+            ]
+        }
+    ).encode()
+    req = urllib.request.Request(
+        f"http://{addr}/twirp/trivy.scanner.v1.Scanner/ScanSecrets",
+        data=body,
+        headers={
+            "Content-Type": "application/json",
+            "X-Trivy-Ruleset-Select": digest,
+        },
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        out = json.loads(resp.read())
+        assert resp.headers.get("X-Trivy-Ruleset") == digest
+    assert "push-test-token" in _finding_ids(out)
+
+
+def test_build_info_lists_resident_rulesets(push_server):
+    addr, _, _ = push_server
+    client = RpcClient(addr)
+    digest = client.push_ruleset(rules_yaml=CUSTOM_YAML)["RulesetDigest"]
+    with urllib.request.urlopen(
+        f"http://{addr}/metrics", timeout=10
+    ) as resp:
+        text = resp.read().decode()
+    assert "trivy_tpu_build_info{" in text
+    # One series for the default ruleset AND one for the pushed resident.
+    assert f'ruleset_digest="{digest}"' in text
+    assert text.count("trivy_tpu_build_info{") >= 2
+    assert "trivy_tpu_tenancy_resident_rulesets" in text
+
+
+def test_quota_429_with_retry_after_over_http(engine, tmp_path, monkeypatch):
+    monkeypatch.setenv("TRIVY_TPU_LINK", "relay")
+    httpd, _ = start_background(
+        "localhost:0",
+        MemoryCache(),
+        serve_config=ServeConfig(
+            batch_window_ms=0.0, tenant_rps=1.0, tenant_burst=1.0
+        ),
+        secret_engine_factory=lambda: engine,
+    )
+    addr = f"{httpd.server_address[0]}:{httpd.server_address[1]}"
+    try:
+        client = RpcClient(addr, max_retries=1)  # surface the 429 raw
+        client.scan_secrets([("a.txt", PLAIN_FILE)], client_id="t1")
+        body = json.dumps(
+            {
+                "ClientID": "t1",
+                "Files": [
+                    {
+                        "Path": "b.txt",
+                        "ContentB64": base64.b64encode(PLAIN_FILE).decode(),
+                    }
+                ],
+            }
+        ).encode()
+        req = urllib.request.Request(
+            f"http://{addr}/twirp/trivy.scanner.v1.Scanner/ScanSecrets",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 429
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        # An over-quota tenant does not poison others.
+        client.scan_secrets([("c.txt", PLAIN_FILE)], client_id="t2")
+    finally:
+        httpd.scan_server.scheduler.close()
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_rules_push_cli_end_to_end(push_server, tmp_path, capsys):
+    addr, scan_server, _ = push_server
+    from trivy_tpu.commands.rules import run_rules
+
+    cfg = tmp_path / "cli.yaml"
+    cfg.write_text(CUSTOM_YAML)
+    rc = run_rules(
+        Namespace(
+            rules_command="push",
+            server=addr,
+            token="",
+            secret_config=str(cfg),
+            rules_cache_dir=str(tmp_path / "cli-cache"),
+            compile_on_server=False,
+            no_admit=False,
+        )
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "pushed" in out and "source=pushed" in out
+    # Usage errors exit 2, wire errors exit 1 (verify-style codes).
+    assert run_rules(Namespace(rules_command="push", server="")) == 2
+    rc_bad = run_rules(
+        Namespace(
+            rules_command="push",
+            server="localhost:1",  # nothing listening
+            token="",
+            secret_config="",
+            rules_cache_dir=str(tmp_path / "cli-cache"),
+            compile_on_server=True,
+            no_admit=False,
+        )
+    )
+    assert rc_bad == 1

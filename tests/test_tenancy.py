@@ -1,0 +1,547 @@
+"""Multi-tenant ruleset serving: QoS buckets, the resident-ruleset LRU,
+digest-lane scheduling, and cross-tenant parity.
+
+Three layers, cheapest first: pure-unit token-bucket/pool tests with fake
+engines and an injected clock; scheduler lane tests over fake per-digest
+engines (routing, coalescing, fairness, quotas — no device work); and a
+real-engine parity + evict/warm-readmit test proving per-tenant findings
+are byte-identical to solo runs and that re-admitting an evicted digest
+never recompiles (the registry warm path).
+"""
+
+import textwrap
+import threading
+import time
+
+import pytest
+
+from trivy_tpu.ftypes import Secret
+from trivy_tpu.serve import (
+    BatchScheduler,
+    QuotaExceededError,
+    ServeConfig,
+)
+from trivy_tpu.tenancy.pool import ResidentRulesetPool, UnknownRulesetError
+from trivy_tpu.tenancy.qos import TenantAdmission, TenantQuota, TokenBucket
+
+# ---------------------------------------------------------------------------
+# Token buckets / admission QoS (pure units, injected clock)
+# ---------------------------------------------------------------------------
+
+
+def test_token_bucket_refill_deterministic():
+    b = TokenBucket(rate=2.0, burst=2.0, now=0.0)
+    assert b.wait_for(2.0, now=0.0) == 0.0
+    b.take(2.0, now=0.0)
+    # Empty: one token is half a second away at 2/s.
+    assert b.wait_for(1.0, now=0.0) == pytest.approx(0.5)
+    assert b.wait_for(1.0, now=0.5) == 0.0
+    # Refill caps at burst, no matter how long the idle gap.
+    assert b.wait_for(2.0, now=100.0) == 0.0
+
+
+def test_token_bucket_oversized_request_clamps_to_burst():
+    b = TokenBucket(rate=10.0, burst=10.0, now=0.0)
+    # 100 tokens can never exist at once; the request pays the full
+    # bucket instead of waiting forever.
+    assert b.wait_for(100.0, now=0.0) == 0.0
+    b.take(100.0, now=0.0)
+    assert b.tokens == 0.0
+    wait = b.wait_for(100.0, now=0.0)
+    assert 0.0 < wait <= 1.0  # one full refill, not 10 seconds
+
+
+def test_qos_zero_rates_admit_everything():
+    qos = TenantAdmission()  # default quota: everything unlimited
+    for i in range(1000):
+        wait, reason = qos.try_admit("tenant", 1 << 20, now=float(i) * 1e-6)
+        assert (wait, reason) == (0.0, "")
+    assert qos.stats.admitted == 1000
+
+
+def test_qos_request_rate_and_exact_retry_after():
+    qos = TenantAdmission(default=TenantQuota(rps=1.0, burst=2.0))
+    assert qos.try_admit("a", 0, now=0.0) == (0.0, "")
+    assert qos.try_admit("a", 0, now=0.0) == (0.0, "")
+    wait, reason = qos.try_admit("a", 0, now=0.0)
+    assert reason == "requests"
+    assert wait == pytest.approx(1.0)  # 1 token at 1/s: exactly 1s away
+    # The bucket keeps its promise: at now + wait the request admits.
+    assert qos.try_admit("a", 0, now=wait) == (0.0, "")
+
+
+def test_qos_rejection_debits_nothing():
+    """The all-or-nothing contract: a byte-bucket rejection must not have
+    consumed a request token (the classic partial-debit leak)."""
+    qos = TenantAdmission(
+        default=TenantQuota(rps=2.0, burst=2.0, bytes_per_s=100.0)
+    )
+    assert qos.try_admit("a", 60, now=0.0) == (0.0, "")
+    wait, reason = qos.try_admit("a", 60, now=0.0)  # bytes: 40 left of 100
+    assert reason == "bytes"
+    assert wait == pytest.approx(0.2)  # (60-40)/100
+    # Both request tokens were minted at t=0 and only ONE was spent; if the
+    # rejection had leaked a request token this would bounce on "requests".
+    assert qos.try_admit("a", 20, now=0.0) == (0.0, "")
+    assert qos.stats.rejected_bytes == 1
+
+
+def test_qos_tenant_isolation_and_overrides():
+    qos = TenantAdmission(default=TenantQuota(rps=1.0, burst=1.0))
+    assert qos.try_admit("hog", 0, now=0.0) == (0.0, "")
+    wait, reason = qos.try_admit("hog", 0, now=0.0)
+    assert reason == "requests" and wait > 0
+    # Another tenant's bucket is untouched by the hog's exhaustion.
+    assert qos.try_admit("polite", 0, now=0.0) == (0.0, "")
+    # Per-tenant override replaces the default immediately (bucket reset).
+    qos.set_quota("hog", TenantQuota(rps=100.0, burst=100.0, max_inflight=2))
+    assert qos.try_admit("hog", 0, now=0.0) == (0.0, "")
+    assert qos.max_inflight("hog") == 2
+    assert qos.max_inflight("polite") is None
+    qos.set_quota("hog", None)  # back to the default
+    assert qos.max_inflight("hog") is None
+
+
+# ---------------------------------------------------------------------------
+# Resident pool (fake loader)
+# ---------------------------------------------------------------------------
+
+
+class FakeEngine:
+    """Minimal engine: records batches, returns one Secret per item, and
+    optionally blocks on a gate so tests can hold the owner thread."""
+
+    def __init__(self, tag: str, gate: threading.Event | None = None,
+                 order: list | None = None):
+        self.tag = tag
+        self.gate = gate
+        self.order = order
+        self.batches: list[list[str]] = []
+
+    def scan_batch(self, items):
+        self.batches.append([p for p, _ in items])
+        if self.order is not None:
+            self.order.append(self.tag)
+        if self.gate is not None:
+            assert self.gate.wait(5.0)
+        return [Secret(file_path=p) for p, _ in items]
+
+
+class CountingLoader:
+    def __init__(self, known: dict[str, FakeEngine], delay_s: float = 0.0):
+        self.known = known
+        self.delay_s = delay_s
+        self.calls: list[str] = []
+        self._lock = threading.Lock()
+
+    def __call__(self, digest: str):
+        with self._lock:
+            self.calls.append(digest)
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        eng = self.known.get(digest)
+        if eng is None:
+            raise UnknownRulesetError(f"no such ruleset {digest!r}")
+        return eng, 100, "cold"
+
+
+def test_pool_hit_miss_lru_eviction_and_readmit():
+    loader = CountingLoader(
+        {d: FakeEngine(d) for d in ("A", "B", "C")}
+    )
+    pool = ResidentRulesetPool(loader, max_resident=2)
+    pool.ensure("A")
+    pool.ensure("A")  # hit: no second load
+    pool.ensure("B")
+    assert loader.calls == ["A", "B"]
+    assert pool.stats.hits == 1 and pool.stats.misses == 2
+    pool.ensure("C")  # A is LRU -> evicted
+    assert pool.stats.evictions == 1
+    assert [d for d, _, _ in pool.residents()] == ["B", "C"]
+    pool.ensure("A")  # re-admit: loads again, evicting B
+    assert loader.calls == ["A", "B", "C", "A"]
+    assert [d for d, _, _ in pool.residents()] == ["C", "A"]
+
+
+def test_pool_byte_budget_eviction_keeps_newest():
+    loader = CountingLoader({d: FakeEngine(d) for d in ("A", "B")})
+    pool = ResidentRulesetPool(loader, max_resident=8, max_resident_bytes=150)
+    pool.ensure("A")  # 100 bytes
+    pool.ensure("B")  # 200 total > 150 -> A evicted, B (newest) survives
+    assert [d for d, _, _ in pool.residents()] == ["B"]
+    assert pool.stats.evictions == 1
+    assert pool.resident_bytes() == 100
+
+
+def test_pool_concurrent_ensure_builds_once():
+    loader = CountingLoader({"A": FakeEngine("A")}, delay_s=0.05)
+    pool = ResidentRulesetPool(loader, max_resident=2)
+    errs: list[Exception] = []
+
+    def go():
+        try:
+            pool.ensure("A")
+        except Exception as e:  # pragma: no cover - failure detail
+            errs.append(e)
+
+    threads = [threading.Thread(target=go) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert loader.calls == ["A"]  # one build, five waiters
+    assert pool.stats.misses == 6 or pool.stats.misses + pool.stats.hits == 6
+
+
+def test_pool_unknown_digest_raises_for_all_waiters():
+    loader = CountingLoader({})
+    pool = ResidentRulesetPool(loader, max_resident=2)
+    with pytest.raises(UnknownRulesetError):
+        pool.ensure("nope")
+    # The failed build is not cached: a later push could register it.
+    with pytest.raises(UnknownRulesetError):
+        pool.ensure("nope")
+    assert loader.calls == ["nope", "nope"]
+
+
+def test_pool_dispatch_readmits_evicted_digest():
+    loader = CountingLoader({d: FakeEngine(d) for d in ("A", "B", "C")})
+    pool = ResidentRulesetPool(loader, max_resident=2)
+    pool.ensure("A")
+    pool.ensure("B")
+    pool.ensure("C")  # evicts A
+    engine, digest, epoch = pool.engine_for_dispatch("A")
+    assert engine.tag == "A" and digest == "A" and epoch >= 1
+    assert pool.stats.owner_loads == 1
+    assert "A" in [d for d, _, _ in pool.residents()]
+
+
+# ---------------------------------------------------------------------------
+# Scheduler lanes (fake engines; no device work)
+# ---------------------------------------------------------------------------
+
+
+def _lane_scheduler(engines: dict[str, FakeEngine], default: FakeEngine,
+                    **cfg_kw) -> BatchScheduler:
+    cfg = ServeConfig(**cfg_kw)
+    loader = CountingLoader(engines)
+    sched = BatchScheduler(lambda: default, cfg, ruleset_loader=loader)
+    sched._loader = loader  # test back-channel
+    return sched
+
+
+def _flatten(secrets):
+    return [(s.file_path, tuple(s.findings)) for s in secrets]
+
+
+def test_lanes_route_by_digest_and_never_mix():
+    engines = {d: FakeEngine(d) for d in ("A", "B")}
+    default = FakeEngine("default")
+    sched = _lane_scheduler(engines, default, batch_window_ms=40.0)
+    try:
+        futs = {}
+        barrier = threading.Barrier(3)
+
+        def fire(key, digest):
+            def go():
+                barrier.wait()
+                futs[key] = sched.submit(
+                    [(f"{key}/f.txt", b"x" * 8)],
+                    client_id=key,
+                    ruleset_digest=digest,
+                )
+            t = threading.Thread(target=go)
+            t.start()
+            return t
+
+        threads = [
+            fire("ta", "A"), fire("tb", "B"), fire("td", ""),
+        ]
+        for t in threads:
+            t.join()
+        results = {k: f.result(timeout=10) for k, f in futs.items()}
+        # Each ticket was scanned by its digest's engine, nothing mixed.
+        assert engines["A"].batches == [["ta/f.txt"]]
+        assert engines["B"].batches == [["tb/f.txt"]]
+        assert default.batches == [["td/f.txt"]]
+        assert results["ta"].ruleset_digest == "A"
+        assert results["tb"].ruleset_digest == "B"
+        assert sched.lane_count() == 3  # default + A + B
+    finally:
+        sched.close()
+
+
+def test_same_digest_cross_client_coalesces_into_shared_batch():
+    engines = {"A": FakeEngine("A")}
+    sched = _lane_scheduler(engines, FakeEngine("default"),
+                            batch_window_ms=80.0)
+    try:
+        n = 4
+        futs = [None] * n
+        barrier = threading.Barrier(n)
+
+        def go(i):
+            barrier.wait()
+            futs[i] = sched.submit(
+                [(f"c{i}/f.txt", b"y" * 4)],
+                client_id=f"tenant-{i}",
+                ruleset_digest="A",
+            )
+
+        threads = [threading.Thread(target=go, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, f in enumerate(futs):
+            out = f.result(timeout=10)
+            assert [s.file_path for s in out] == [f"c{i}/f.txt"]
+        # All four tenants shared device batches: fewer batches than
+        # requests, and at least one batch held >= 2 distinct clients.
+        assert sched.stats.batches < n
+        assert sched.stats.multi_request_batches >= 1
+        assert sched.stats.cross_tenant_batches >= 1
+        assert sched.stats.coalesced_requests == n
+    finally:
+        sched.close()
+
+
+def test_quota_rejection_is_429_shaped_with_exact_retry_after():
+    sched = _lane_scheduler({}, FakeEngine("default"),
+                            batch_window_ms=0.0,
+                            tenant_rps=1.0, tenant_burst=1.0)
+    try:
+        fut = sched.submit([("a.txt", b"z")], client_id="t1")
+        fut.result(timeout=10)
+        with pytest.raises(QuotaExceededError) as ei:
+            sched.submit([("b.txt", b"z")], client_id="t1")
+        assert ei.value.retry_after_s > 0
+        assert sched.stats.rejected_quota == 1
+        # Another tenant is unaffected by t1's exhaustion.
+        sched.submit([("c.txt", b"z")], client_id="t2").result(timeout=10)
+    finally:
+        sched.close()
+
+
+def test_per_tenant_inflight_override_beats_flat_cap():
+    gate = threading.Event()
+    engines = {"A": FakeEngine("A", gate=gate)}
+    sched = _lane_scheduler(engines, FakeEngine("default"),
+                            batch_window_ms=0.0,
+                            max_inflight_per_client=8)
+    try:
+        sched.qos.set_quota("t1", TenantQuota(max_inflight=1))
+        f1 = sched.submit([("a.txt", b"z")], client_id="t1",
+                          ruleset_digest="A")
+        # Wait until the owner thread is blocked inside the gated engine.
+        deadline = time.monotonic() + 5
+        while not engines["A"].batches and time.monotonic() < deadline:
+            time.sleep(0.002)
+        from trivy_tpu.serve import ClientOverloadedError
+
+        with pytest.raises(ClientOverloadedError):
+            sched.submit([("b.txt", b"z")], client_id="t1",
+                         ruleset_digest="A")
+        gate.set()
+        f1.result(timeout=10)
+    finally:
+        gate.set()
+        sched.close()
+
+
+def test_weighted_round_robin_bounds_hog_starvation():
+    """A hog with 4 queued tickets and a polite tenant with 1: once both
+    lanes are ready, WRR dispatches the polite lane within two batches —
+    starvation is bounded by lane count, not traffic share."""
+    gate = threading.Event()
+    order: list[str] = []
+    engines = {
+        "HOG": FakeEngine("HOG", gate=gate, order=order),
+        "POLITE": FakeEngine("POLITE", order=order),
+    }
+    # max_batch_bytes=1: every ticket dispatches as its own batch, so the
+    # interleaving is observable per ticket.
+    sched = _lane_scheduler(engines, FakeEngine("default"),
+                            batch_window_ms=0.0, max_batch_bytes=1)
+    try:
+        futs = [sched.submit([("hog/0.txt", b"z")], client_id="hog",
+                             ruleset_digest="HOG")]
+        # Owner thread is now blocked in the gated HOG engine; queue the
+        # rest behind it.
+        deadline = time.monotonic() + 5
+        while not engines["HOG"].batches and time.monotonic() < deadline:
+            time.sleep(0.002)
+        for i in range(1, 4):
+            futs.append(sched.submit([(f"hog/{i}.txt", b"z")],
+                                     client_id="hog",
+                                     ruleset_digest="HOG"))
+        futs.append(sched.submit([("polite/0.txt", b"z")],
+                                 client_id="polite",
+                                 ruleset_digest="POLITE"))
+        engines["HOG"].gate = None  # only the first batch blocks
+        gate.set()
+        for f in futs:
+            f.result(timeout=10)
+        # order[0] is the gated batch; the polite lane lands within the
+        # next two dispatches despite the hog's 3 remaining tickets.
+        assert "POLITE" in order[1:3], order
+    finally:
+        gate.set()
+        sched.close()
+
+
+# ---------------------------------------------------------------------------
+# Real engines: parity + evict/warm-readmit with zero recompiles
+# ---------------------------------------------------------------------------
+
+CUSTOM_YAML = textwrap.dedent(
+    """
+    rules:
+      - id: tenancy-test-token
+        category: custom
+        title: Tenancy test token
+        severity: critical
+        regex: TENANTTOK-[a-f0-9]{8}
+        keywords: [TENANTTOK-]
+    """
+)
+
+SECRET_FILE = b"AWS_ACCESS_KEY_ID=AKIAQ6FAKEKEY1234567\n"
+CUSTOM_FILE = b"token = TENANTTOK-deadbeef\n"
+
+
+@pytest.fixture(scope="module")
+def tenant_setup(tmp_path_factory):
+    """A registry cache holding two pushed rulesets (builtin + custom),
+    plus a real default engine — the server-side loader shape, in-process.
+    """
+    from trivy_tpu.engine.hybrid import make_secret_engine
+    from trivy_tpu.registry import store as rstore
+    from trivy_tpu.registry.digest import ruleset_digest
+    from trivy_tpu.rules.model import build_ruleset, load_config
+
+    cache_dir = str(tmp_path_factory.mktemp("ruleset-cache"))
+    cfg_path = tmp_path_factory.mktemp("cfg") / "custom.yaml"
+    cfg_path.write_text(CUSTOM_YAML)
+
+    builtin_rs = build_ruleset(None)
+    custom_rs = build_ruleset(load_config(str(cfg_path)))
+    digests = {}
+    for rs, yaml_text in ((builtin_rs, ""), (custom_rs, CUSTOM_YAML)):
+        d = ruleset_digest(rs)
+        rstore.get_or_compile(rs, cache_dir=cache_dir)
+        rstore.save_ruleset_source(cache_dir, d, yaml_text)
+        digests[id(rs)] = d
+    return {
+        "cache_dir": cache_dir,
+        "builtin_digest": digests[id(builtin_rs)],
+        "custom_digest": digests[id(custom_rs)],
+        "default_engine": make_secret_engine(),
+    }
+
+
+def _make_loader(cache_dir, compile_counter=None):
+    from trivy_tpu.engine.hybrid import make_secret_engine
+    from trivy_tpu.registry import store as rstore
+
+    def loader(digest):
+        ruleset = rstore.load_ruleset_source(cache_dir, digest)
+        if ruleset is None:
+            raise UnknownRulesetError(digest)
+        art = rstore.load_artifact(cache_dir, digest)
+        if art is not None:
+            source = "warm"
+        else:
+            if compile_counter is not None:
+                compile_counter.append(digest)
+            art, source = rstore.get_or_compile(ruleset, cache_dir=cache_dir)
+        engine = make_secret_engine(
+            ruleset=ruleset, backend="auto", compiled=art
+        )
+        return engine, rstore.artifact_device_bytes(art), source
+
+    return loader
+
+
+def test_multi_tenant_findings_byte_identical_to_solo(
+    tenant_setup, monkeypatch
+):
+    """Two tenants on two digests served concurrently produce exactly the
+    findings their solo (single-tenant, unbatched) runs produce."""
+    monkeypatch.setenv("TRIVY_TPU_LINK", "relay")
+    cache_dir = tenant_setup["cache_dir"]
+    custom = tenant_setup["custom_digest"]
+    items_a = [("a/creds.env", SECRET_FILE), ("a/tok.txt", CUSTOM_FILE)]
+    items_b = [("b/tok.txt", CUSTOM_FILE), ("b/creds.env", SECRET_FILE)]
+
+    # Solo baselines, one engine per tenant's digest.
+    solo_default = _flatten(
+        tenant_setup["default_engine"].scan_batch(items_a)
+    )
+    custom_engine, _, _ = _make_loader(cache_dir)(custom)
+    solo_custom = _flatten(custom_engine.scan_batch(items_b))
+    # The custom digest actually changes findings: TENANTTOK only fires
+    # there, so cross-lane contamination would be visible.
+    assert any("tenancy-test-token" == f.rule_id
+               for _, fs in solo_custom for f in fs)
+    assert not any("tenancy-test-token" == f.rule_id
+                   for _, fs in solo_default for f in fs)
+
+    sched = BatchScheduler(
+        lambda: tenant_setup["default_engine"],
+        ServeConfig(batch_window_ms=40.0),
+        ruleset_loader=_make_loader(cache_dir),
+    )
+    try:
+        barrier = threading.Barrier(2)
+        futs = {}
+
+        def go(key, items, digest):
+            barrier.wait()
+            futs[key] = sched.submit(items, client_id=key,
+                                     ruleset_digest=digest)
+
+        ta = threading.Thread(target=go, args=("a", items_a, ""))
+        tb = threading.Thread(target=go, args=("b", items_b, custom))
+        ta.start(); tb.start(); ta.join(); tb.join()
+        got_a = _flatten(futs["a"].result(timeout=120))
+        got_b = _flatten(futs["b"].result(timeout=120))
+        assert got_a == solo_default
+        assert got_b == solo_custom
+        assert futs["b"].result().ruleset_digest == custom
+    finally:
+        sched.close()
+
+
+def test_evict_then_warm_readmit_zero_recompiles(tenant_setup, monkeypatch):
+    """A full pool evicts the LRU digest; requesting it again re-admits
+    through the registry warm path — asserted by a compile counter that
+    must stay empty AND by forbidding compile_ruleset outright."""
+    monkeypatch.setenv("TRIVY_TPU_LINK", "relay")
+    from trivy_tpu.registry import store as rstore
+
+    cache_dir = tenant_setup["cache_dir"]
+    builtin, custom = (
+        tenant_setup["builtin_digest"], tenant_setup["custom_digest"],
+    )
+    compiles: list[str] = []
+    loader = _make_loader(cache_dir, compile_counter=compiles)
+
+    def _no_compile(*a, **kw):  # the artifacts are primed; any compile
+        raise AssertionError("re-admit must ride the warm path")
+
+    monkeypatch.setattr(rstore, "compile_ruleset", _no_compile)
+    pool = ResidentRulesetPool(loader, max_resident=1)
+    pool.ensure(custom)
+    assert pool.stats.cold_admits == 0 and pool.stats.warm_admits == 1
+    pool.ensure(builtin)  # pool-of-one: custom evicted
+    assert pool.stats.evictions == 1
+    assert [d for d, _, _ in pool.residents()] == [builtin]
+    pool.ensure(custom)  # warm re-admit, zero recompiles
+    assert pool.stats.warm_admits == 3
+    assert compiles == []
+    engine, digest, _ = pool.engine_for_dispatch(custom)
+    assert digest == custom
+    flat = _flatten(engine.scan_batch([("t/tok.txt", CUSTOM_FILE)]))
+    assert any(f.rule_id == "tenancy-test-token" for _, fs in flat for f in fs)

@@ -81,6 +81,10 @@ class SecretScannerOption:
     # TRIVY_TPU_PIPELINE_DEPTH / TRIVY_TPU_RESIDENT_CHUNKS).
     pipeline_depth: int | None = None
     resident_chunks: int | None = None
+    # backend == "server": digest of a pushed ruleset every request should
+    # scan under ("" = the server's default) — per-tenant ruleset pinning
+    # against the server's resident pool (trivy_tpu/tenancy/).
+    ruleset_select: str = ""
 
 
 @dataclass

@@ -84,6 +84,7 @@ class SecretAnalyzer(BatchAnalyzer):
         self._server_token = getattr(opt, "server_token", "")
         self._timeout_s = getattr(opt, "timeout_s", 0.0)
         self._rules_cache_dir = getattr(opt, "rules_cache_dir", "")
+        self._ruleset_select = getattr(opt, "ruleset_select", "")
         self._pipeline_depth = getattr(opt, "pipeline_depth", None)
         self._resident_chunks = getattr(opt, "resident_chunks", None)
         self._config_skip_paths = self._build_config_skip_paths(self._config_path)
@@ -128,6 +129,7 @@ class SecretAnalyzer(BatchAnalyzer):
                     self._server_addr,
                     token=self._server_token,
                     timeout_s=self._timeout_s,
+                    ruleset_select=self._ruleset_select,
                 )
             else:
                 # All local backends go through the factory, which maps the

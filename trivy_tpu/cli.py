@@ -195,6 +195,12 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
         "cross-request batcher (requires --server)",
     )
     p.add_argument(
+        "--ruleset",
+        default=_env_default("ruleset", ""),
+        help="with --secret-backend server: digest of a pushed ruleset to "
+        "scan under (see `rules push`; default = the server's ruleset)",
+    )
+    p.add_argument(
         "--rules-cache-dir",
         default=_env_default("rules-cache-dir", ""),
         help="compiled-ruleset registry directory (default "
@@ -365,6 +371,7 @@ def _options_from_args(args: argparse.Namespace) -> Options:
         file_patterns=list(getattr(args, "file_patterns", []) or []),
         secret_config=args.secret_config,
         secret_backend=args.secret_backend,
+        ruleset_select=getattr(args, "ruleset", ""),
         rules_cache_dir=getattr(args, "rules_cache_dir", ""),
         pipeline_depth=getattr(args, "pipeline_depth", None),
         resident_chunks=getattr(args, "resident_chunks", None),
@@ -635,6 +642,41 @@ def build_parser() -> argparse.ArgumentParser:
         default=_int_default("max-inflight-per-client", 8),
         help="per-client in-flight ticket cap (fairness under load)",
     )
+    # Multi-tenant ruleset serving (trivy_tpu/tenancy/): compiled-engine
+    # residency pool + per-tenant admission quotas.
+    p_server.add_argument(
+        "--max-resident-rulesets", type=int,
+        default=_int_default("max-resident-rulesets", 4),
+        help="compiled-ruleset LRU slots the server keeps device-resident "
+        "for per-request ruleset selection",
+    )
+    p_server.add_argument(
+        "--max-resident-mb", type=int,
+        default=_int_default("max-resident-mb", 0),
+        help="estimated device MB cap across resident rulesets "
+        "(0 = count-bounded only)",
+    )
+    p_server.add_argument(
+        "--tenant-rps", type=float,
+        default=_float_default("tenant-rps", 0.0),
+        help="default per-tenant requests/s quota; over-rate requests get "
+        "429 with an exact Retry-After (0 = unlimited)",
+    )
+    p_server.add_argument(
+        "--tenant-burst", type=float,
+        default=_float_default("tenant-burst", 0.0),
+        help="per-tenant request token-bucket depth (0 = max(rps, 1))",
+    )
+    p_server.add_argument(
+        "--tenant-bytes-per-sec", type=float,
+        default=_float_default("tenant-bytes-per-sec", 0.0),
+        help="default per-tenant payload bytes/s quota (0 = unlimited)",
+    )
+    p_server.add_argument(
+        "--tenant-bytes-burst", type=float,
+        default=_float_default("tenant-bytes-burst", 0.0),
+        help="per-tenant byte token-bucket depth (0 = one second of rate)",
+    )
     p_server.add_argument(
         "--secret-config",
         default=_env_default("secret-config", ""),
@@ -707,6 +749,37 @@ def build_parser() -> argparse.ArgumentParser:
     )
     pr_verify.add_argument(
         "--rules-cache-dir", default=_env_default("rules-cache-dir", "")
+    )
+    pr_push = rules_sub.add_parser(
+        "push",
+        help="compile a secret-config and install it into a running "
+        "server's registry by digest (scans select it via RulesetDigest)",
+    )
+    pr_push.add_argument(
+        "--server", default=_env_default("server", ""),
+        help="server address (host:port or URL); required",
+    )
+    pr_push.add_argument(
+        "--token", default=_env_default("token", ""),
+        help="server auth token (Trivy-Tpu-Token header)",
+    )
+    pr_push.add_argument(
+        "--secret-config", default=_env_default("secret-config", ""),
+        help="secret-config YAML to push (empty = builtin rules only)",
+    )
+    pr_push.add_argument(
+        "--rules-cache-dir", default=_env_default("rules-cache-dir", ""),
+        help="local cache the client-side compile lands in",
+    )
+    pr_push.add_argument(
+        "--compile-on-server", action="store_true",
+        default=_bool_default("compile-on-server"),
+        help="ship only the YAML and let the server compile (default: "
+        "compile locally and upload the validated artifact)",
+    )
+    pr_push.add_argument(
+        "--no-admit", action="store_true", default=_bool_default("no-admit"),
+        help="register the ruleset without making it device-resident",
     )
 
     sub.add_parser("version", help="print version")
@@ -857,6 +930,12 @@ def main(argv: list[str] | None = None) -> int:
                 max_batch_bytes=args.max_batch_bytes,
                 max_queue_depth=args.max_queue_depth,
                 max_inflight_per_client=args.max_inflight_per_client,
+                max_resident_rulesets=args.max_resident_rulesets,
+                max_resident_bytes=args.max_resident_mb << 20,
+                tenant_rps=args.tenant_rps,
+                tenant_burst=args.tenant_burst,
+                tenant_bytes_per_s=args.tenant_bytes_per_sec,
+                tenant_bytes_burst=args.tenant_bytes_burst,
             ),
             secret_config=args.secret_config,
             rules_cache_dir=resolve_rules_cache_dir(args.rules_cache_dir),

@@ -7,6 +7,9 @@ ls       list cached artifacts: digest, size, created, framework versions
 verify   prove a cached artifact is faithful: tensors must equal a fresh
          compile exactly, and a warm-constructed engine must produce
          byte-identical findings to a cold one on the builtin corpus
+push     compile a secret-config (client-side by default) and install the
+         ruleset + artifact into a running server's registry by digest,
+         so scans can select it with --ruleset / RulesetDigest
 """
 
 from __future__ import annotations
@@ -144,6 +147,70 @@ def _verify(args) -> int:
     return 0
 
 
+def _push(args) -> int:
+    import json
+    import os
+
+    from trivy_tpu.rpc.client import RpcClient, RpcError
+
+    server = getattr(args, "server", "") or ""
+    if not server:
+        print("rules push: --server is required", file=sys.stderr)
+        return 2
+    cfg_path = getattr(args, "secret_config", "") or ""
+    rules_yaml = ""
+    if cfg_path:
+        try:
+            with open(cfg_path, encoding="utf-8") as f:
+                rules_yaml = f.read()
+        except OSError as e:
+            print(f"rules push: cannot read {cfg_path}: {e}", file=sys.stderr)
+            return 2
+    client = RpcClient(server, getattr(args, "token", "") or "")
+    manifest = None
+    npz = None
+    if not getattr(args, "compile_on_server", False):
+        # Client-side compile (default): build into the local cache, then
+        # ship the artifact files so the server validates and installs
+        # without compiling — the push path a CI job uses to keep compile
+        # cost off the serving box.
+        ruleset = _ruleset(args)
+        cache_dir = _cache_dir(args)
+        art, source = rstore.get_or_compile(ruleset, cache_dir=cache_dir)
+        art_dir = os.path.join(cache_dir, art.digest)
+        try:
+            with open(
+                os.path.join(art_dir, rstore.MANIFEST_JSON), encoding="utf-8"
+            ) as f:
+                manifest = json.load(f)
+            with open(os.path.join(art_dir, rstore.ARTIFACT_NPZ), "rb") as f:
+                npz = f.read()
+        except OSError as e:
+            print(
+                f"rules push: compiled {art.digest[:16]} ({source}) but "
+                f"cannot read its files: {e}",
+                file=sys.stderr,
+            )
+            return 1
+        print(f"compiled {art.digest[:16]} locally ({source}); uploading")
+    try:
+        resp = client.push_ruleset(
+            rules_yaml=rules_yaml,
+            manifest_json=manifest,
+            npz=npz,
+            admit=not getattr(args, "no_admit", False),
+        )
+    except RpcError as e:
+        print(f"rules push FAILED: {e}", file=sys.stderr)
+        return 1
+    print(
+        f"pushed {resp.get('RulesetDigest', '?')}  "
+        f"source={resp.get('Source', '?')}  "
+        f"resident={bool(resp.get('Resident'))}"
+    )
+    return 0
+
+
 def run_rules(args) -> int:
     cmd = getattr(args, "rules_command", None)
     if cmd == "compile":
@@ -152,9 +219,11 @@ def run_rules(args) -> int:
         return _ls(args)
     if cmd == "verify":
         return _verify(args)
+    if cmd == "push":
+        return _push(args)
     print(
-        "usage: trivy-tpu rules {compile,ls,verify} [--secret-config ...] "
-        "[--rules-cache-dir ...]",
+        "usage: trivy-tpu rules {compile,ls,verify,push} "
+        "[--secret-config ...] [--rules-cache-dir ...] [--server ...]",
         file=sys.stderr,
     )
     return 2

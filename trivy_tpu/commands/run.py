@@ -57,6 +57,9 @@ class Options:
     file_patterns: list[str] = field(default_factory=list)  # type:regex
     secret_config: str = "trivy-secret.yaml"
     secret_backend: str = "auto"  # hybrid; never boots a device runtime by itself
+    # --secret-backend server: pushed-ruleset digest every request scans
+    # under ("" = server default) — see trivy_tpu/tenancy/.
+    ruleset_select: str = ""
     # Compiled-ruleset registry dir ("" = default ~/.cache/trivy-tpu/rulesets,
     # "off" disables warm starts) — trivy_tpu/registry/.
     rules_cache_dir: str = ""
@@ -211,6 +214,7 @@ def _analyzer_options(options: Options, target_kind: str) -> AnalyzerOptions:
         secret_scanner_option=SecretScannerOption(
             config_path=options.secret_config,
             backend=options.secret_backend,
+            ruleset_select=getattr(options, "ruleset_select", ""),
             server_addr=options.server_addr,
             server_token=options.token,
             timeout_s=options.timeout,

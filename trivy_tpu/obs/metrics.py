@@ -93,6 +93,17 @@ class _Family:
                 child = self._children.setdefault(key, self._new_child())
         return child
 
+    def clear(self) -> None:
+        """Drop every child series.  The seat for collect hooks that
+        rebuild a family from live state each scrape (e.g. one
+        trivy_tpu_build_info series per *resident* ruleset): without the
+        reset, series for evicted residents would keep scraping stale 1s.
+        Label-less families re-expose their zero sample immediately."""
+        with self._lock:
+            self._children.clear()
+        if not self.labelnames:
+            self._child(())
+
     def labels(self, **kw):
         if set(kw) != set(self.labelnames):
             raise ValueError(

@@ -36,6 +36,12 @@ logger = logging.getLogger("trivy_tpu.registry")
 SCHEMA_VERSION = 2
 ARTIFACT_NPZ = "artifact.npz"
 MANIFEST_JSON = "manifest.json"
+# The ruleset SOURCE (secret-config YAML; empty file = builtin rules only).
+# Artifacts alone cannot reconstruct an engine — the confirm-side regex
+# patterns and allow rules live in the RuleSet, not the tensors — so
+# multi-tenant serving persists the source next to the artifact and
+# rebuilds the RuleSet from it on demand (tenancy/pool.py loader).
+RULESET_SRC = "ruleset.yaml"
 
 # Sentinel values of --rules-cache-dir that disable the store entirely.
 _DISABLED = ("off", "none", "0", "-")
@@ -426,6 +432,133 @@ def get_or_compile(
         except OSError as e:
             logger.warning("could not persist ruleset artifact: %s", e)
     return art, "cold"
+
+
+def artifact_device_bytes(art: CompiledArtifact) -> int:
+    """Estimated device residency of one compiled ruleset: the tensor
+    bytes the engines stage (NFA transitions + gram constants dominate;
+    host-side probe plans are noise).  Manifest shape/dtype pins are the
+    fast path; a just-compiled artifact (empty manifest) sums the arrays
+    directly."""
+    m = art.manifest or {}
+    shapes, dtypes = m.get("shapes"), m.get("dtypes")
+    if shapes and dtypes:
+        total = 0
+        for key, shape in shapes.items():
+            n = 1
+            for d in shape:
+                n *= int(d)
+            total += n * np.dtype(dtypes[key]).itemsize
+        return total
+    total = 0
+    for obj, names in (
+        (art.nfa, ("byte_class", "accept", "follow", "first", "rule_last",
+                   "pos_rule")),
+        (art.gset, ("masks", "vals", "gram_probe", "gram_window",
+                    "window_probe", "window_start", "probe_has_gram")),
+    ):
+        for name in names:
+            total += int(np.asarray(getattr(obj, name)).nbytes)
+    return total
+
+
+# ---------------------------------------------------------------------------
+# Ruleset sources (the `rules push` landing pad)
+# ---------------------------------------------------------------------------
+
+
+def save_ruleset_source(cache_dir: str, digest: str, yaml_text: str) -> str:
+    """Persist the secret-config YAML under <cache>/<digest>/ruleset.yaml
+    (atomic; empty text = builtin rules).  Returns the file path."""
+    dirp = os.path.join(cache_dir, digest)
+    os.makedirs(dirp, exist_ok=True)
+    path = os.path.join(dirp, RULESET_SRC)
+    _atomic_write(path, yaml_text.encode("utf-8"))
+    return path
+
+
+def load_ruleset_source(cache_dir: str, digest: str) -> RuleSet | None:
+    """Rebuild the RuleSet for a stored digest, or None when no source is
+    registered or it fails validation.  Never trusted: the rebuilt
+    ruleset's digest must equal the directory digest, or a tampered YAML
+    could serve different confirm regexes under a trusted digest."""
+    path = os.path.join(cache_dir, digest, RULESET_SRC)
+    if not os.path.exists(path):
+        return None
+    try:
+        from trivy_tpu.rules.model import build_ruleset, load_config
+
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        ruleset = build_ruleset(load_config(path) if text.strip() else None)
+        got = ruleset_digest(ruleset)
+        if got != digest:
+            raise ValueError(
+                f"source rebuilds to digest {got[:16]}, directory says "
+                f"{digest[:16]} (corrupt or tampered)"
+            )
+        return ruleset
+    except Exception as e:
+        logger.warning("ruleset source %s unusable (%s)", path, e)
+        return None
+
+
+def install_ruleset(
+    cache_dir: str,
+    rules_yaml: str = "",
+    manifest: dict | None = None,
+    npz: bytes | None = None,
+) -> tuple[str, str]:
+    """The `rules push` server seat: register a ruleset by source, adopt a
+    client-compiled artifact when it validates exactly like a local one
+    would, else compile server-side (or warm-load a prior compile).
+    Returns (digest, source) with source "pushed" | "warm" | "cold"."""
+    import tempfile
+
+    from trivy_tpu.rules.model import build_ruleset, load_config
+
+    cfg = None
+    if rules_yaml.strip():
+        fd, tmp = tempfile.mkstemp(suffix=".yaml", prefix="trivy-push-")
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                f.write(rules_yaml)
+            cfg = load_config(tmp)
+        finally:
+            os.unlink(tmp)
+    ruleset = build_ruleset(cfg)
+    digest = ruleset_digest(ruleset)
+    save_ruleset_source(cache_dir, digest, rules_yaml)
+    if manifest is not None and npz is not None:
+        # Never-trust adoption: write the pushed files, then run them
+        # through the exact load_artifact gauntlet (digest pin, sha256,
+        # schema/version pins, link class map re-derivation).  A rejected
+        # push falls through to a server-side compile — a bad client can
+        # cost the server a compile, never a wrong artifact.
+        try:
+            if manifest.get("ruleset_digest") != digest:
+                raise ValueError(
+                    f"pushed manifest digest "
+                    f"{str(manifest.get('ruleset_digest'))[:16]!r} does not "
+                    f"match the YAML's digest {digest[:16]!r}"
+                )
+            dirp = os.path.join(cache_dir, digest)
+            os.makedirs(dirp, exist_ok=True)
+            _atomic_write(os.path.join(dirp, ARTIFACT_NPZ), npz)
+            _atomic_write(
+                os.path.join(dirp, MANIFEST_JSON),
+                json.dumps(manifest, indent=1, sort_keys=True).encode("utf-8"),
+            )
+            if load_artifact(cache_dir, digest) is None:
+                raise ValueError("pushed artifact failed validation")
+            return digest, "pushed"
+        except Exception as e:
+            logger.warning(
+                "pushed artifact for %s rejected (%s); compiling server-side",
+                digest[:16], e,
+            )
+    _, source = get_or_compile(ruleset, cache_dir=cache_dir)
+    return digest, source
 
 
 def list_artifacts(cache_dir: str | None = None) -> list[dict]:

@@ -137,9 +137,12 @@ class RpcClient:
         target: str = "",
         timeout_ms: int | None = None,
         client_id: str = "",
+        ruleset_digest: str = "",
     ) -> dict:
         """POST raw (path, blob) items to the server's continuous batcher
-        (Scanner/ScanSecrets).  JSON-only: contents travel base64."""
+        (Scanner/ScanSecrets).  JSON-only: contents travel base64.
+        `ruleset_digest` routes the request onto that pushed ruleset's
+        batching lane ("" = the server's default ruleset)."""
         payload: dict = {
             "Target": target,
             "Files": [
@@ -151,7 +154,31 @@ class RpcClient:
             payload["TimeoutMs"] = int(timeout_ms)
         if client_id:
             payload["ClientID"] = client_id
+        if ruleset_digest:
+            payload["RulesetDigest"] = ruleset_digest
         return self.call("/twirp/trivy.scanner.v1.Scanner/ScanSecrets", payload)
+
+    def push_ruleset(
+        self,
+        rules_yaml: str = "",
+        manifest_json: dict | None = None,
+        npz: bytes | None = None,
+        admit: bool = True,
+    ) -> dict:
+        """POST /admin/ruleset/push: install a ruleset (and optionally its
+        client-side-compiled artifact) into the server's registry.  Rides
+        call(), so quota/drain rejections (429/503) get the same jittered
+        Retry-After-floored backoff as scans."""
+        payload: dict = {"Admit": bool(admit)}
+        if rules_yaml:
+            payload["RulesYamlB64"] = base64.b64encode(
+                rules_yaml.encode("utf-8")
+            ).decode()
+        if manifest_json is not None:
+            payload["ManifestJson"] = manifest_json
+        if npz is not None:
+            payload["NpzB64"] = base64.b64encode(npz).decode()
+        return self.call("/admin/ruleset/push", payload)
 
 
 @dataclass
@@ -206,10 +233,15 @@ class RemoteSecretEngine:
         token: str = "",
         timeout_s: float = 0.0,
         client_id: str = "",
+        ruleset_select: str = "",
     ):
         self.client = RpcClient(addr, token)
         self.timeout_s = timeout_s
         self.client_id = client_id
+        # Digest of a pushed ruleset every batch should scan under ("" =
+        # the server's default).  Per-tenant pinning: two clients with
+        # different selections share the server but never a batch.
+        self.ruleset_select = ruleset_select
         # Digest of the server-side ruleset that scanned the LAST batch
         # (response RulesetDigest field); "" until a scan completes.  Lets
         # thin clients log/compare which rule version produced findings
@@ -240,6 +272,7 @@ class RemoteSecretEngine:
                 items,
                 timeout_ms=int(self.timeout_s * 1000) if self.timeout_s else None,
                 client_id=self.client_id,
+                ruleset_digest=self.ruleset_select,
             )
         echoed = next(
             (

@@ -54,7 +54,12 @@ from trivy_tpu.scanner.service import (
     ScanOptions,
     secrets_to_results,
 )
-from trivy_tpu.serve import AdmissionError, BatchScheduler, ServeConfig
+from trivy_tpu.serve import (
+    AdmissionError,
+    BatchScheduler,
+    ServeConfig,
+    UnknownRulesetError,
+)
 
 TOKEN_HEADER = "Trivy-Tpu-Token"
 
@@ -132,7 +137,22 @@ class ScanServer:
             secret_engine_factory or self._build_engine,
             self.serve_config,
             registry=self.registry,
+            # Per-request ruleset selection needs somewhere to load pushed
+            # rulesets from; without a registry dir the pool stays off and
+            # digest-carrying requests get a deterministic 404.
+            ruleset_loader=(
+                self._load_ruleset_engine if rules_cache_dir else None
+            ),
         )
+        # Build/ruleset identity: one series per RESIDENT ruleset, rebuilt
+        # from live state at each scrape (clear + re-set), so evicted
+        # digests stop scraping instead of pinning stale 1s forever.
+        self._m_build_info = self.registry.gauge(
+            "trivy_tpu_build_info",
+            "build and active-ruleset identity (value is always 1)",
+            labelnames=("version", "ruleset_digest", "epoch"),
+        )
+        self.registry.add_collect_hook(self._collect_build_info)
         self.draining = False  # SIGTERM: reject new work with 503
         # Live-profiling window (POST /admin/profile/start|stop): default
         # output dir from --profile-dir, overridable per start request.
@@ -161,6 +181,41 @@ class ScanServer:
             config=cfg, backend="auto",
             rules_cache_dir=self.rules_cache_dir, **kw,
         )
+
+    def _load_ruleset_engine(self, digest: str):
+        """ResidentRulesetPool loader: rebuild the engine for a registered
+        digest.  The RuleSet source (confirm-side regexes, allow rules)
+        comes from the registry's persisted ruleset.yaml — compiled tensors
+        alone cannot reconstruct an engine — and the compiled artifact
+        rides the warm path when present.  Raises UnknownRulesetError for
+        digests nobody pushed.  Runs on request threads (admission) or the
+        engine-owner thread (post-eviction re-admit), never under any
+        scheduler/pool lock."""
+        from trivy_tpu.engine.hybrid import make_secret_engine
+        from trivy_tpu.registry import store as rstore
+
+        ruleset = rstore.load_ruleset_source(self.rules_cache_dir, digest)
+        if ruleset is None:
+            raise UnknownRulesetError(
+                f"ruleset {digest[:16]!r} not in this server's registry; "
+                "push it first (trivy-tpu rules push)"
+            )
+        art = rstore.load_artifact(self.rules_cache_dir, digest)
+        if art is not None:
+            source = "warm"
+        else:
+            art, source = rstore.get_or_compile(
+                ruleset, cache_dir=self.rules_cache_dir
+            )
+        kw = {}
+        if self.pipeline_depth is not None:
+            kw["pipeline_depth"] = self.pipeline_depth
+        if self.resident_chunks is not None:
+            kw["resident_chunks"] = self.resident_chunks
+        engine = make_secret_engine(
+            ruleset=ruleset, backend="auto", compiled=art, **kw
+        )
+        return engine, rstore.artifact_device_bytes(art), source
 
     # -- service methods ------------------------------------------------
 
@@ -213,11 +268,22 @@ class ScanServer:
             items.append((f.get("Path", ""), content))
         timeout_ms = req.get("TimeoutMs")
         timeout_s = float(timeout_ms) / 1000.0 if timeout_ms else None
+        # Per-request ruleset selection: the RulesetDigest field (or the
+        # X-Trivy-Ruleset-Select header the handler copied in) routes this
+        # ticket onto that digest's lane.  Selecting the server's own
+        # active ruleset collapses to the default lane, so "pin what the
+        # server already runs" costs no extra residency slot.
+        digest = str(
+            req.get("RulesetDigest") or req.get("_ruleset_select") or ""
+        )
+        if digest and digest == self.ruleset_digest():
+            digest = ""
         fut = self.scheduler.submit(
             items,
             client_id=str(req.get("ClientID") or req.get("_client") or ""),
             timeout_s=timeout_s,
             trace_id=str(req.get("_trace_id") or ""),
+            ruleset_digest=digest,
         )
         # Deadline-armed requests never hang the connection: even a wedged
         # engine bounds the wait (the slack covers a dispatched batch that
@@ -334,14 +400,71 @@ class ScanServer:
                 self._config_digest = default_ruleset_digest()
         return self._config_digest
 
-    def build_info_text(self) -> str:
-        return (
-            "# HELP trivy_tpu_build_info build and active-ruleset identity"
-            " (value is always 1)\n"
-            "# TYPE trivy_tpu_build_info gauge\n"
-            f'trivy_tpu_build_info{{version="{__version__}",'
-            f'ruleset_digest="{self.ruleset_digest()}"}} 1\n'
+    def _collect_build_info(self) -> None:
+        """Registry collect hook: rebuild trivy_tpu_build_info from live
+        state — the default ruleset plus one series per pool-resident
+        digest.  clear() first so evicted residents stop scraping; cheap
+        (ruleset_digest() is cached, residents() is a lock + list copy),
+        and it never builds an engine."""
+        fam = self._m_build_info
+        fam.clear()
+        fam.labels(
+            version=__version__,
+            ruleset_digest=self.ruleset_digest(),
+            epoch=str(self.scheduler.ruleset_epoch()),
+        ).set(1)
+        pool = self.scheduler.pool
+        if pool is not None:
+            for digest, epoch, _nbytes in pool.residents():
+                fam.labels(
+                    version=__version__,
+                    ruleset_digest=digest,
+                    epoch=str(epoch),
+                ).set(1)
+
+    def push_ruleset(self, req: dict) -> dict:
+        """POST /admin/ruleset/push: install a ruleset into the server's
+        registry by digest.  Client-side-compiled pushes carry the YAML
+        source plus the compiled artifact (ManifestJson + NpzB64) and skip
+        server compilation entirely after never-trust validation;
+        YAML-only pushes compile here.  Admit=true (default) also makes
+        the engine pool-resident so the tenant's first scan pays no build.
+        """
+        if not self.rules_cache_dir:
+            raise ValueError(
+                "rules push requires the server's ruleset registry "
+                "(start with --rules-cache-dir)"
+            )
+        from trivy_tpu.registry import store as rstore
+
+        req = req or {}
+        rules_yaml = ""
+        if req.get("RulesYamlB64"):
+            rules_yaml = base64.b64decode(req["RulesYamlB64"]).decode(
+                "utf-8"
+            )
+        manifest = req.get("ManifestJson")
+        if isinstance(manifest, str):
+            manifest = json.loads(manifest)
+        npz = (
+            base64.b64decode(req["NpzB64"]) if req.get("NpzB64") else None
         )
+        digest, source = rstore.install_ruleset(
+            self.rules_cache_dir,
+            rules_yaml=rules_yaml,
+            manifest=manifest,
+            npz=npz,
+        )
+        resident = False
+        pool = self.scheduler.pool
+        if req.get("Admit", True) and pool is not None:
+            pool.ensure(digest)
+            resident = True
+        return {
+            "RulesetDigest": digest,
+            "Source": source,
+            "Resident": resident,
+        }
 
     def put_artifact(self, req: dict) -> dict:
         self.cache.put_artifact(
@@ -372,8 +495,9 @@ _ROUTES = {
     "/twirp/trivy.cache.v1.Cache/MissingBlobs": "missing_blobs",
     "/twirp/trivy.cache.v1.Cache/DeleteBlobs": "delete_blobs",
     # Admin plane (token-authed like every POST): stage a ruleset swap,
-    # open/close a live JAX profiler window.
+    # install a pushed ruleset, open/close a live JAX profiler window.
     "/admin/ruleset/reload": "reload_ruleset",
+    "/admin/ruleset/push": "push_ruleset",
     "/admin/profile/start": "profile_start",
     "/admin/profile/stop": "profile_stop",
 }
@@ -410,9 +534,9 @@ def _make_handler(server: ScanServer):
             elif self.path == "/version":
                 self._send(200, {"Version": __version__})
             elif self.path == "/metrics":
-                body = (
-                    server.registry.render() + server.build_info_text()
-                ).encode()
+                # One render path: build_info rides the registry's
+                # collect hook like every other live-state family.
+                body = server.registry.render().encode()
                 self.send_response(200)
                 self.send_header(
                     "Content-Type", "text/plain; version=0.0.4"
@@ -534,6 +658,14 @@ def _make_handler(server: ScanServer):
                         # ClientID when sent, else the peer address.
                         req["_client"] = self.client_address[0]
                     req["_trace_id"] = trace_id
+                    # Header-based ruleset routing (proxies can set it
+                    # without touching bodies); sanitized like the trace
+                    # header — digests are hex, anything else can only
+                    # 404, never reach a log or label verbatim.
+                    sel = self.headers.get("X-Trivy-Ruleset-Select", "")
+                    req["_ruleset_select"] = "".join(
+                        c for c in sel if c.isalnum() or c in "-_"
+                    )[:80]
                 with obs_trace.span(
                     f"rpc.{method}", trace_id=trace_id or None
                 ):
@@ -555,6 +687,10 @@ def _make_handler(server: ScanServer):
                     code, {"error": str(e)},
                     {"Retry-After": str(max(1, int(e.retry_after_s)))},
                 )
+            except UnknownRulesetError as e:
+                # Deterministic: the digest is not in the registry and a
+                # retry cannot fix that — the client must push first.
+                send(404, {"error": str(e)})
             except ScanTimeoutError as e:
                 send(408, {"error": str(e)})  # clean JSON, not a hang
             except BlobNotFoundError as e:

@@ -5,6 +5,11 @@ demux) that turns the chunk pipeline's per-scan overlap into a traffic-scale
 optimization: concurrent Scan requests coalesce into one device batch under a
 fill-or-timeout window, exactly the Orca/vLLM-style micro-batching used by
 inference servers.  See scheduler.py for the engine-owner model.
+
+Multi-tenancy (PR 8) keys the queue by ruleset digest: per-digest lanes
+coalesce same-digest tickets from different clients, weighted round-robin
+picks among ready lanes, and per-tenant token buckets (trivy_tpu/tenancy/)
+gate admission before any ticket enters a lane.
 """
 
 from trivy_tpu.serve.scheduler import (
@@ -12,11 +17,18 @@ from trivy_tpu.serve.scheduler import (
     BatchScheduler,
     ClientOverloadedError,
     QueueFullError,
+    QuotaExceededError,
     SchedulerClosedError,
     SchedulerStats,
     SecretBatch,
     ServeConfig,
     Ticket,
+)
+from trivy_tpu.tenancy import (
+    ResidentRulesetPool,
+    TenantAdmission,
+    TenantQuota,
+    UnknownRulesetError,
 )
 
 __all__ = [
@@ -24,9 +36,14 @@ __all__ = [
     "BatchScheduler",
     "ClientOverloadedError",
     "QueueFullError",
+    "QuotaExceededError",
+    "ResidentRulesetPool",
     "SchedulerClosedError",
     "SchedulerStats",
     "SecretBatch",
     "ServeConfig",
+    "TenantAdmission",
+    "TenantQuota",
     "Ticket",
+    "UnknownRulesetError",
 ]

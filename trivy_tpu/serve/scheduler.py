@@ -3,7 +3,7 @@
 The thread-per-request server sizes device batches by whatever one client
 sent: a request with three files runs a three-file `scan_batch` while the
 engine idles between requests.  This module inverts the ownership — ONE
-engine-owner thread owns the secret engine, and concurrent requests enqueue
+engine-owner thread owns the secret engines, and concurrent requests enqueue
 their (path, blob) items as tickets into a bounded admission queue.  The
 owner thread coalesces tickets into device batches under a fill-or-timeout
 window (the first ticket opens the window; the batch dispatches when either
@@ -14,14 +14,27 @@ per-ticket futures.  Findings are byte-identical to the unbatched path:
 `scan_batch` results are per-item and batch-composition-independent (the
 chunk/dedupe parity the engine tests pin down).
 
+Multi-tenancy keys the queue by RULESET DIGEST: each digest gets its own
+lane (deque + fill window), so same-digest tickets from *different* clients
+coalesce into shared device batches while different-digest tickets never
+mix (a batch runs on exactly one engine).  The default lane ("") is the
+server's configured ruleset, backed by the scheduler's own RulesetManager;
+digest lanes resolve their engine through the ResidentRulesetPool
+(trivy_tpu/tenancy/), whose per-slot managers reuse the same epoch-swap
+machinery.  Dispatch picks among ready lanes by smooth weighted
+round-robin, so a hot tenant saturating its lane cannot starve the rest —
+starvation is bounded by the number of active lanes, not by traffic share.
+
 Admission control is where backpressure lives, not in the engine:
 
-  - bounded queue depth        -> QueueFullError        (HTTP 429)
-  - per-client in-flight caps  -> ClientOverloadedError (HTTP 429)
-  - draining/closed            -> SchedulerClosedError  (HTTP 503)
+  - per-tenant token buckets    -> QuotaExceededError    (HTTP 429)
+  - bounded queue depth         -> QueueFullError        (HTTP 429)
+  - per-client in-flight caps   -> ClientOverloadedError (HTTP 429)
+  - draining/closed             -> SchedulerClosedError  (HTTP 503)
 
-Ordering is fair FIFO by arrival; the per-client cap keeps one aggressive
-client from occupying the whole window.  Tickets carry their request's
+Quota rejections carry the bucket's exact refill time as Retry-After;
+tenant quotas (requests/s, bytes/s, inflight) come from the TenantAdmission
+controller and can be overridden per tenant.  Tickets carry their request's
 absolute deadline: tickets that expire while queued are cancelled before
 dispatch (their future raises ScanTimeoutError), and a dispatching batch
 arms the engine-owner thread's deadline (trivy_tpu/deadline.py) to the
@@ -48,6 +61,8 @@ from trivy_tpu.deadline import ScanTimeoutError
 from trivy_tpu.obs import metrics as obs_metrics
 from trivy_tpu.obs import trace as obs_trace
 from trivy_tpu.registry.manager import RulesetManager
+from trivy_tpu.tenancy.pool import ResidentRulesetPool, UnknownRulesetError
+from trivy_tpu.tenancy.qos import TenantAdmission, TenantQuota
 
 
 class SecretBatch(list):
@@ -76,6 +91,11 @@ class ClientOverloadedError(AdmissionError):
     """Client at its in-flight ticket cap (HTTP 429)."""
 
 
+class QuotaExceededError(AdmissionError):
+    """Tenant over its token-bucket quota (HTTP 429); Retry-After is the
+    bucket's exact refill time, not a fixed hint."""
+
+
 class SchedulerClosedError(AdmissionError):
     """Scheduler draining or shut down (HTTP 503)."""
 
@@ -85,11 +105,26 @@ class ServeConfig:
     """Knobs, CLI-exposed as `server --batch-window-ms` etc. (env vars
     TRIVY_TPU_BATCH_WINDOW_MS and friends via the cli env binding)."""
 
-    batch_window_ms: float = 4.0  # fill-or-timeout window
+    batch_window_ms: float = 4.0  # fill-or-timeout window (per lane)
     max_batch_bytes: int = 8 << 20  # dispatch early once this fills
-    max_queue_depth: int = 256  # tickets; beyond -> 429
+    max_queue_depth: int = 256  # tickets across all lanes; beyond -> 429
     max_inflight_per_client: int = 8  # queued+dispatching per client
     retry_after_s: float = 1.0  # backpressure hint on 429/503
+    # -- tenancy (trivy_tpu/tenancy/) ------------------------------------
+    max_resident_rulesets: int = 4  # compiled-engine LRU slots
+    max_resident_bytes: int = 0  # estimated device bytes cap (0 = off)
+    tenant_rps: float = 0.0  # default per-tenant requests/s (0 = off)
+    tenant_burst: float = 0.0  # request bucket depth (0 = max(rps, 1))
+    tenant_bytes_per_s: float = 0.0  # default per-tenant bytes/s (0 = off)
+    tenant_bytes_burst: float = 0.0  # byte bucket depth (0 = 1s of rate)
+
+    def default_quota(self) -> TenantQuota:
+        return TenantQuota(
+            rps=self.tenant_rps,
+            burst=self.tenant_burst,
+            bytes_per_s=self.tenant_bytes_per_s,
+            bytes_burst=self.tenant_bytes_burst,
+        )
 
 
 # SieveStats seconds accumulators diffed per batch into the
@@ -110,6 +145,24 @@ class Ticket:
     nbytes: int
     enqueued_at: float
     trace_id: str = ""  # X-Trivy-Trace-Id from the request, "" = untraced
+    ruleset_digest: str = ""  # lane key; "" = the default ruleset
+
+
+class _Lane:
+    """One ruleset digest's admission queue + fill window + WRR state.
+    All fields are owned by the scheduler lock (the lane is an interior
+    struct, never handed out)."""
+
+    __slots__ = ("digest", "q", "nbytes", "opened_at", "weight",
+                 "current_weight")
+
+    def __init__(self, digest: str, weight: float = 1.0):
+        self.digest = digest
+        self.q: deque[Ticket] = deque()
+        self.nbytes = 0  # queued payload bytes
+        self.opened_at = 0.0  # window start: first enqueue into empty lane
+        self.weight = weight
+        self.current_weight = 0.0  # smooth-WRR accumulator
 
 
 @dataclass
@@ -121,9 +174,11 @@ class SchedulerStats:
     rejected_full: int = 0
     rejected_client: int = 0
     rejected_closed: int = 0
+    rejected_quota: int = 0  # tenant token bucket said no
     expired: int = 0  # cancelled before dispatch
     batches: int = 0
     multi_request_batches: int = 0  # batches coalescing >= 2 tickets
+    cross_tenant_batches: int = 0  # batches coalescing >= 2 distinct clients
     coalesced_requests: int = 0  # sum of tickets per batch
     items: int = 0
     bytes: int = 0
@@ -133,12 +188,17 @@ class SchedulerStats:
 
 
 class BatchScheduler:
-    """Single engine-owner thread + bounded admission queue.
+    """Single engine-owner thread + per-digest admission lanes.
 
     `engine_factory` is called lazily on the owner thread at first dispatch
     (building a HybridSecretEngine measures the device link — server startup
-    and non-secret traffic must not pay that).  The engine only ever runs on
+    and non-secret traffic must not pay that).  Engines only ever run on
     the owner thread, so engines need no internal locking.
+
+    `ruleset_loader` (optional) enables per-request ruleset selection: a
+    `loader(digest) -> (engine, nbytes, source)` callback backing a
+    ResidentRulesetPool.  Without it, submits carrying a digest are
+    rejected with UnknownRulesetError.
     """
 
     def __init__(
@@ -146,20 +206,22 @@ class BatchScheduler:
         engine_factory,
         config: ServeConfig | None = None,
         registry: obs_metrics.Registry | None = None,
+        ruleset_loader=None,
     ):
         self.config = config or ServeConfig()
         self._engine_factory = engine_factory
-        # The manager owns the active/staged engine pair; only _dispatch
-        # (owner thread) installs, so swaps land exactly at batch
-        # boundaries and in-flight batches finish on the engine they
-        # started with.
+        # The manager owns the DEFAULT lane's active/staged engine pair;
+        # only _dispatch (owner thread) installs, so swaps land exactly at
+        # batch boundaries and in-flight batches finish on the engine they
+        # started with.  Digest lanes get the same machinery per pool slot.
         self.manager = RulesetManager(engine_factory)
         self._lock = lockcheck.make_lock("serve.scheduler")
         self._not_empty = lockcheck.make_condition(self._lock)
         # The engine-owner role: only _dispatch (the serve-batcher thread)
         # runs engines; under TRIVY_TPU_LOCKCHECK=1 this is asserted live.
         self._owner = lockcheck.owner_role("serve.batcher")
-        self._q: deque[Ticket] = deque()  # owner: _lock
+        # digest -> lane; "" (always present) is the default ruleset.
+        self._lanes: dict[str, _Lane] = {"": _Lane("")}  # owner: _lock
         self._inflight: dict[str, int] = {}  # owner: _lock
         self._admitting = True  # owner: _lock
         self._thread: threading.Thread | None = None  # owner: _lock
@@ -169,12 +231,31 @@ class BatchScheduler:
         # per consumer.
         self.stats = SchedulerStats()
         self.registry = registry if registry is not None else obs_metrics.Registry()
+        # Tenancy: QoS always on (zero rates = admit everything, so the
+        # controller costs one lock + two dict probes per submit); the
+        # resident pool only with a loader.
+        self.qos = TenantAdmission(default=self.config.default_quota())
+        self.pool: ResidentRulesetPool | None = (
+            ResidentRulesetPool(
+                ruleset_loader,
+                max_resident=self.config.max_resident_rulesets,
+                max_resident_bytes=self.config.max_resident_bytes,
+                registry=self.registry,
+            )
+            if ruleset_loader is not None
+            else None
+        )
         self._register_metrics()
 
     def _register_metrics(self) -> None:
         r = self.registry
         self._m_queue_depth = r.gauge(
-            "trivy_tpu_serve_queue_depth", "tickets waiting for dispatch"
+            "trivy_tpu_serve_queue_depth",
+            "tickets waiting for dispatch (all lanes)",
+        )
+        self._m_lanes = r.gauge(
+            "trivy_tpu_serve_lanes",
+            "digest lanes known to the scheduler (1 = default only)",
         )
         self._m_inflight = r.gauge(
             "trivy_tpu_serve_inflight_tickets",
@@ -191,7 +272,7 @@ class BatchScheduler:
         # Pre-create the reason children so every rejection lane scrapes
         # as 0 before its first event (dashboards alert on rate(), which
         # needs the series to exist).
-        for reason in ("queue_full", "client_cap", "closed"):
+        for reason in ("queue_full", "client_cap", "closed", "quota"):
             self._m_rejected.labels(reason=reason)
         self._m_expired = r.counter(
             "trivy_tpu_serve_expired_total",
@@ -203,6 +284,10 @@ class BatchScheduler:
         self._m_multi = r.counter(
             "trivy_tpu_serve_multi_request_batches_total",
             "batches coalescing two or more requests",
+        )
+        self._m_cross_tenant = r.counter(
+            "trivy_tpu_serve_cross_tenant_batches_total",
+            "batches coalescing two or more distinct clients",
         )
         self._m_coalesced = r.counter(
             "trivy_tpu_serve_coalesced_requests_total",
@@ -258,10 +343,13 @@ class BatchScheduler:
         client_id: str = "",
         timeout_s: float | None = None,
         trace_id: str = "",
+        ruleset_digest: str = "",
     ) -> Future:
         """Enqueue one request's items; returns a Future resolving to the
         per-item list[Secret].  Raises AdmissionError subclasses instead of
-        queuing when backpressure applies."""
+        queuing when backpressure applies.  `ruleset_digest` selects the
+        lane ("" = the server's default ruleset); unknown digests raise
+        UnknownRulesetError before anything is queued."""
         cfg = self.config
         now = time.monotonic()
         ticket = Ticket(
@@ -274,7 +362,37 @@ class BatchScheduler:
             nbytes=sum(len(c) for _, c in items),
             enqueued_at=now,
             trace_id=trace_id,
+            ruleset_digest=ruleset_digest,
         )
+        # QoS first (cheapest, and the only per-tenant *rate* control —
+        # everything below protects the server, this protects tenants
+        # from each other).  Sequential with the scheduler lock, never
+        # nested, so the lock-order graph gains no qos<->scheduler edge.
+        wait_s, reason = self.qos.try_admit(
+            ticket.client_id, ticket.nbytes, now
+        )
+        if wait_s > 0:
+            self.stats.rejected_quota += 1
+            self._m_rejected.labels(reason="quota").inc()
+            raise QuotaExceededError(
+                f"client {ticket.client_id!r} over its {reason} quota",
+                wait_s,
+            )
+        inflight_cap = cfg.max_inflight_per_client
+        override = self.qos.max_inflight(ticket.client_id)
+        if override is not None:
+            inflight_cap = override
+        # Residency next: make the requested ruleset's engine resident
+        # (LRU admit, warm path when the registry has the artifact) BEFORE
+        # the ticket can enter a lane — a lane must never hold tickets for
+        # an unknown digest.  Builds run outside every scheduler lock.
+        if ruleset_digest:
+            if self.pool is None:
+                raise UnknownRulesetError(
+                    "per-request ruleset selection requires the server's "
+                    "ruleset registry (start with --rules-cache-dir)"
+                )
+            self.pool.ensure(ruleset_digest)
         with self._not_empty:
             if not self._admitting:
                 self.stats.rejected_closed += 1
@@ -282,28 +400,34 @@ class BatchScheduler:
                 raise SchedulerClosedError(
                     "scheduler draining", cfg.retry_after_s
                 )
-            if len(self._q) >= cfg.max_queue_depth:
+            if (
+                sum(len(l.q) for l in self._lanes.values())
+                >= cfg.max_queue_depth
+            ):
                 self.stats.rejected_full += 1
                 self._m_rejected.labels(reason="queue_full").inc()
                 raise QueueFullError(
                     f"admission queue full ({cfg.max_queue_depth} tickets)",
                     cfg.retry_after_s,
                 )
-            if (
-                self._inflight.get(ticket.client_id, 0)
-                >= cfg.max_inflight_per_client
-            ):
+            if self._inflight.get(ticket.client_id, 0) >= inflight_cap:
                 self.stats.rejected_client += 1
                 self._m_rejected.labels(reason="client_cap").inc()
                 raise ClientOverloadedError(
                     f"client {ticket.client_id!r} at in-flight cap "
-                    f"({cfg.max_inflight_per_client})",
+                    f"({inflight_cap})",
                     cfg.retry_after_s,
                 )
             self._inflight[ticket.client_id] = (
                 self._inflight.get(ticket.client_id, 0) + 1
             )
-            self._q.append(ticket)
+            lane = self._lanes.get(ruleset_digest)
+            if lane is None:
+                lane = self._lanes[ruleset_digest] = _Lane(ruleset_digest)
+            if not lane.q:
+                lane.opened_at = now  # first ticket opens the fill window
+            lane.q.append(ticket)
+            lane.nbytes += ticket.nbytes
             self.stats.admitted += 1
             self._m_tickets.inc()
             if self._thread is None:
@@ -316,11 +440,15 @@ class BatchScheduler:
 
     def queue_depth(self) -> int:
         with self._lock:
-            return len(self._q)
+            return sum(len(l.q) for l in self._lanes.values())
 
     def inflight_tickets(self) -> int:
         with self._lock:
             return sum(self._inflight.values())
+
+    def lane_count(self) -> int:
+        with self._lock:
+            return len(self._lanes)
 
     # -- lifecycle -------------------------------------------------------
 
@@ -338,9 +466,12 @@ class BatchScheduler:
         """drain(), then abort anything still queued (a wedged engine must
         not leave request threads hung on their futures)."""
         self.drain(timeout)
+        stuck: list[Ticket] = []
         with self._not_empty:
-            stuck = list(self._q)
-            self._q.clear()
+            for lane in self._lanes.values():
+                stuck.extend(lane.q)
+                lane.q.clear()
+                lane.nbytes = 0
         for t in stuck:
             t.future.set_exception(
                 SchedulerClosedError("scheduler shut down")
@@ -365,49 +496,104 @@ class BatchScheduler:
         )
         self._release(ticket)
 
-    def _pop(self, wait_s: float | None) -> Ticket | None:
-        with self._not_empty:
-            if not self._q and wait_s is not None and wait_s > 0:
-                self._not_empty.wait(timeout=wait_s)
-            return self._q.popleft() if self._q else None
+    def _pick_lane(self, ready: list[_Lane]) -> _Lane:  # graftlint: holds(_lock)
+        """Smooth weighted round-robin (the nginx upstream algorithm) over
+        the dispatch-ready lanes: every lane's accumulator grows by its
+        weight each round, the max dispatches and pays back the total —
+        interleaving is proportional and starvation is impossible while a
+        lane stays ready."""
+        total = 0.0
+        best: _Lane | None = None
+        for lane in ready:
+            lane.current_weight += lane.weight
+            total += lane.weight
+            if best is None or lane.current_weight > best.current_weight:
+                best = lane
+        assert best is not None
+        best.current_weight -= total
+        return best
 
-    def _run(self) -> None:
+    def _next_batch(self) -> tuple[list[Ticket], int, str] | None:
+        """Block until a lane is dispatch-ready (bytes filled or window
+        elapsed), then take its tickets up to max_batch_bytes.  Returns
+        None when draining and every lane is empty."""
         cfg = self.config
         window_s = max(cfg.batch_window_ms, 0.0) / 1000.0
         while True:
-            first = self._pop(wait_s=0.1)
-            if first is None:
-                with self._lock:
-                    if not self._admitting and not self._q:
-                        return
-                continue
-            if (
-                first.deadline_at is not None
-                and time.monotonic() > first.deadline_at
-            ):
-                self._expire(first)
-                continue
-            batch = [first]
-            nbytes = first.nbytes
-            window_end = time.monotonic() + window_s
-            while nbytes < cfg.max_batch_bytes:
-                rem = window_end - time.monotonic()
-                if rem <= 0:
-                    break
-                nxt = self._pop(wait_s=rem)
-                if nxt is None:
-                    continue  # timed out or spurious wake; rem re-checks
-                if (
-                    nxt.deadline_at is not None
-                    and time.monotonic() > nxt.deadline_at
-                ):
-                    self._expire(nxt)
-                    continue
-                batch.append(nxt)
-                nbytes += nxt.nbytes
-            self._dispatch(batch, nbytes)
+            expired: list[Ticket] = []
+            batch: list[Ticket] | None = None
+            nbytes = 0
+            lane_digest = ""
+            done = False
+            with self._not_empty:
+                now = time.monotonic()
+                # Sweep expired tickets out of every lane first, so a
+                # doomed ticket never boards a batch and never holds a
+                # lane's window open.  Futures resolve after the lock
+                # drops (_expire re-takes it via _release).
+                for lane in self._lanes.values():
+                    if not lane.q:
+                        continue
+                    keep: deque[Ticket] = deque()
+                    for t in lane.q:
+                        if t.deadline_at is not None and now > t.deadline_at:
+                            expired.append(t)
+                            lane.nbytes -= t.nbytes
+                        else:
+                            keep.append(t)
+                    lane.q = keep
+                ready = [
+                    lane
+                    for lane in self._lanes.values()
+                    if lane.q
+                    and (
+                        lane.nbytes >= cfg.max_batch_bytes
+                        or now >= lane.opened_at + window_s
+                    )
+                ]
+                if ready:
+                    lane = self._pick_lane(ready)
+                    batch = []
+                    while lane.q and (
+                        not batch or nbytes < cfg.max_batch_bytes
+                    ):
+                        t = lane.q.popleft()
+                        batch.append(t)
+                        nbytes += t.nbytes
+                        lane.nbytes -= t.nbytes
+                    # Remainder (byte-capped take) gets a fresh window.
+                    lane.opened_at = now
+                    lane_digest = lane.digest
+                elif not expired:
+                    if not self._admitting and not any(
+                        lane.q for lane in self._lanes.values()
+                    ):
+                        done = True
+                    else:
+                        waits = [
+                            lane.opened_at + window_s - now
+                            for lane in self._lanes.values()
+                            if lane.q
+                        ]
+                        self._not_empty.wait(
+                            timeout=max(min(waits), 0.001) if waits else 0.1
+                        )
+            for t in expired:
+                self._expire(t)
+            if batch:
+                return batch, nbytes, lane_digest
+            if done:
+                return None
 
-    def _dispatch(self, batch: list[Ticket], nbytes: int) -> None:  # graftlint: owner(serve-batcher)
+    def _run(self) -> None:
+        while True:
+            nxt = self._next_batch()
+            if nxt is None:
+                return
+            batch, nbytes, lane_digest = nxt
+            self._dispatch(batch, nbytes, lane_digest)
+
+    def _dispatch(self, batch: list[Ticket], nbytes: int, lane_digest: str = "") -> None:  # graftlint: owner(serve-batcher)
         self._owner.assert_here()
         t0 = time.monotonic()
         combined: list[tuple[str, bytes]] = []
@@ -436,6 +622,11 @@ class BatchScheduler:
         if len(batch) >= 2:
             self.stats.multi_request_batches += 1
             self._m_multi.inc()
+        if len({t.client_id for t in batch}) >= 2:
+            # The multi-tenant headline: distinct clients sharing one
+            # device batch (BENCH_TENANT's shared-batch speedup source).
+            self.stats.cross_tenant_batches += 1
+            self._m_cross_tenant.inc()
         self.stats.items += len(combined)
         self._m_items.inc(len(combined))
         self.stats.bytes += nbytes
@@ -457,8 +648,17 @@ class BatchScheduler:
         lead = next((t.trace_id for t in batch if t.trace_id), "")
         try:
             # Batch boundary: any staged ruleset swaps in HERE, before any
-            # of this batch's bytes touch an engine.
-            engine, digest = self.manager.engine()
+            # of this batch's bytes touch an engine.  Digest lanes resolve
+            # through the pool (re-admitting via the registry warm path if
+            # evicted since admission); the default lane through the
+            # scheduler's own manager.
+            if lane_digest:
+                engine, digest, epoch = self.pool.engine_for_dispatch(
+                    lane_digest
+                )
+            else:
+                engine, digest = self.manager.engine()
+                epoch = self.manager.epoch
             estats = getattr(engine, "stats", None)
             phases_before = (
                 {a: float(getattr(estats, a, 0.0)) for a in _PHASE_ATTRS}
@@ -497,7 +697,6 @@ class BatchScheduler:
             return
         finally:
             _deadline.clear()
-        epoch = self.manager.epoch
         for t, (lo, hi) in zip(batch, spans):
             out = SecretBatch(results[lo:hi])
             out.ruleset_digest = digest
@@ -508,11 +707,12 @@ class BatchScheduler:
     # -- hot reload ------------------------------------------------------
 
     def reload(self, engine_factory=None) -> str:
-        """Stage a replacement engine (built on THIS thread — an admin
-        handler or SIGHUP thread, never the owner thread) to swap in at
-        the next batch boundary; returns the staged ruleset digest.
+        """Stage a replacement DEFAULT-lane engine (built on THIS thread —
+        an admin handler or SIGHUP thread, never the owner thread) to swap
+        in at the next batch boundary; returns the staged ruleset digest.
         Default factory = the scheduler's own, i.e. re-read the current
-        config from disk."""
+        config from disk.  Digest lanes don't reload — a changed custom
+        ruleset IS a new digest (content addressing)."""
         return self.manager.build_staged(engine_factory)
 
     def active_ruleset_digest(self) -> str:
@@ -535,6 +735,7 @@ class BatchScheduler:
         metrics scrape must never trigger the lazy first-engine build —
         and tolerates engines without stats (the oracle backend)."""
         self._m_queue_depth.set(self.queue_depth())
+        self._m_lanes.set(self.lane_count())
         self._m_inflight.set(self.inflight_tickets())
         self._m_epoch.set(self.manager.epoch)
         self._m_reloads.set_total(self.manager.reloads)

@@ -1,0 +1,235 @@
+"""ResidentRulesetPool: LRU of compiled-ruleset engines, one per digest.
+
+Multi-model serving, reshaped for rulesets: a compiled ruleset is device
+state (NFA transition tensors, gram constants, pre-lowered step kernels),
+so "which rulesets can we serve right now" is a *residency* question.  The
+pool keeps up to `max_resident` engines (optionally bounded by estimated
+device bytes) keyed by ruleset digest, evicting least-recently-used slots
+when a new digest is admitted.
+
+Each slot owns its own `RulesetManager` — the PR 4 epoch-swap machinery,
+per ruleset.  Request threads build engines (via the injected loader, which
+rides the registry's warm path) and *stage* them; only the scheduler's
+engine-owner thread installs, at a batch boundary, via
+`engine_for_dispatch`.  In-flight batches therefore always finish on the
+engine they started with, and eviction is safe mid-batch: dropping a slot
+only drops the pool's reference, while the dispatching batch keeps its own
+until demux completes.
+
+Lock discipline (the "pool eviction vs. scheduler dispatch" ABBA trap):
+`_lock` guards only the slot table and counters.  The loader — which takes
+engine-construction locks (link probe, registry manager) — always runs
+*outside* `_lock`, and manager methods are never called under it.  The
+scheduler never holds its own lock while calling into the pool, so the
+order graph gains no edge in either direction.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from concurrent.futures import Future
+from dataclasses import dataclass
+
+from trivy_tpu import lockcheck
+from trivy_tpu.registry.manager import RulesetManager
+
+
+class UnknownRulesetError(RuntimeError):
+    """The requested digest has no source in the server's registry (the
+    client must `rules push` it first).  Deterministic: HTTP 404-class,
+    never retried."""
+
+
+@dataclass
+class PoolStats:
+    """Monotonic counters (mutated under the pool lock; read freely)."""
+
+    hits: int = 0  # ensure() found the digest resident
+    misses: int = 0  # ensure() had to build/wait for a build
+    admits: int = 0  # slots installed (first admit + re-admits)
+    evictions: int = 0  # LRU slots dropped for budget
+    warm_admits: int = 0  # admits satisfied by the registry warm path
+    cold_admits: int = 0  # admits that compiled fresh
+    owner_loads: int = 0  # dispatch-time re-admits after eviction
+
+
+class _Slot:
+    __slots__ = ("digest", "manager", "nbytes")
+
+    def __init__(self, digest: str, manager: RulesetManager, nbytes: int):
+        self.digest = digest
+        self.manager = manager
+        self.nbytes = nbytes
+
+
+class ResidentRulesetPool:
+    """LRU of per-digest engines behind a loader callback.
+
+    `loader(digest) -> (engine, nbytes, source)` rebuilds an engine for a
+    registered digest ("warm"/"cold" says whether the registry's compiled
+    artifact was reused) or raises UnknownRulesetError.  It is called on
+    request threads (admission) and, rarely, on the engine-owner thread
+    when a digest was evicted between admission and dispatch.
+    """
+
+    def __init__(
+        self,
+        loader,
+        max_resident: int = 4,
+        max_resident_bytes: int = 0,
+        registry=None,
+    ):
+        self._loader = loader
+        self.max_resident = max(1, int(max_resident))
+        self.max_resident_bytes = max(0, int(max_resident_bytes))
+        self._lock = lockcheck.make_lock("tenancy.pool")
+        self._slots: OrderedDict[str, _Slot] = OrderedDict()  # owner: _lock
+        # One in-flight build per digest: concurrent requesters for a
+        # non-resident digest share a Future instead of racing the loader.
+        self._building: dict[str, Future] = {}  # owner: _lock
+        self.stats = PoolStats()  # counters; mutated under _lock
+        if registry is not None:
+            self._register_metrics(registry)
+
+    # -- admission (request threads) --------------------------------------
+
+    def ensure(self, digest: str, timeout_s: float = 300.0) -> None:
+        """Make `digest` resident (or raise UnknownRulesetError).  The
+        expensive build runs outside the pool lock; concurrent callers for
+        the same digest block on the builder's Future."""
+        with self._lock:
+            slot = self._slots.get(digest)
+            if slot is not None:
+                self._slots.move_to_end(digest)
+                self.stats.hits += 1
+                return
+            self.stats.misses += 1
+            fut = self._building.get(digest)
+            if fut is None:
+                fut = Future()
+                self._building[digest] = fut
+                builder = True
+            else:
+                builder = False
+        if not builder:
+            fut.result(timeout=timeout_s)  # re-raises the builder's error
+            return
+        try:
+            engine, nbytes, source = self._loader(digest)
+            self._admit(digest, engine, nbytes, source)
+        except BaseException as e:
+            with self._lock:
+                self._building.pop(digest, None)
+            fut.set_exception(e)
+            raise
+        with self._lock:
+            self._building.pop(digest, None)
+        fut.set_result(None)
+
+    def _admit(self, digest: str, engine, nbytes: int, source: str) -> None:
+        """Install a freshly-built engine as a slot, evicting LRU slots
+        over budget.  The slot's manager stages the engine; the owner
+        thread installs it (epoch bump) at its first dispatch."""
+        manager = RulesetManager(lambda: engine)
+        manager.stage(engine, digest)
+        with self._lock:
+            self._slots[digest] = _Slot(digest, manager, int(nbytes))
+            self._slots.move_to_end(digest)
+            self.stats.admits += 1
+            if source == "warm":
+                self.stats.warm_admits += 1
+            else:
+                self.stats.cold_admits += 1
+            self._evict_over_budget_locked()
+
+    def _evict_over_budget_locked(self) -> None:  # graftlint: holds(_lock)
+        # Never evict down past the newest slot: a single ruleset larger
+        # than max_resident_bytes still serves (degraded to pool-of-one).
+        while len(self._slots) > 1 and (
+            len(self._slots) > self.max_resident
+            or (
+                self.max_resident_bytes
+                and sum(s.nbytes for s in self._slots.values())
+                > self.max_resident_bytes
+            )
+        ):
+            self._slots.popitem(last=False)
+            self.stats.evictions += 1
+
+    # -- dispatch (engine-owner thread) -----------------------------------
+
+    def engine_for_dispatch(self, digest: str) -> tuple[object, str, int]:
+        """Resolve (engine, digest, epoch) for a batch.  Installs anything
+        the slot's manager has staged — this IS the batch boundary.  If the
+        digest was evicted after admission (budget pressure from other
+        tenants), re-admit it here via the loader's warm path."""
+        with self._lock:
+            slot = self._slots.get(digest)
+            if slot is not None:
+                self._slots.move_to_end(digest)
+        if slot is None:
+            engine, nbytes, source = self._loader(digest)
+            self._admit(digest, engine, nbytes, source)
+            with self._lock:
+                slot = self._slots[digest]
+                self.stats.owner_loads += 1
+        engine, dig = slot.manager.engine()
+        return engine, dig, slot.manager.epoch
+
+    # -- observability (any thread) ---------------------------------------
+
+    def residents(self) -> list[tuple[str, int, int]]:
+        """(digest, epoch, nbytes) per resident slot, LRU-first.  Manager
+        locks are taken after the pool lock is released (no nesting)."""
+        with self._lock:
+            slots = list(self._slots.values())
+        return [(s.digest, s.manager.epoch, s.nbytes) for s in slots]
+
+    def resident_count(self) -> int:
+        with self._lock:
+            return len(self._slots)
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return sum(s.nbytes for s in self._slots.values())
+
+    def _register_metrics(self, registry) -> None:
+        self._m_resident = registry.gauge(
+            "trivy_tpu_tenancy_resident_rulesets",
+            "compiled rulesets currently device-resident in the pool",
+        )
+        self._m_resident_bytes = registry.gauge(
+            "trivy_tpu_tenancy_resident_bytes",
+            "estimated device bytes held by resident ruleset slots",
+        )
+        self._m_hits = registry.counter(
+            "trivy_tpu_tenancy_pool_hits_total",
+            "admissions that found their ruleset already resident",
+        )
+        self._m_misses = registry.counter(
+            "trivy_tpu_tenancy_pool_misses_total",
+            "admissions that had to build or wait for a build",
+        )
+        self._m_admits = registry.counter(
+            "trivy_tpu_tenancy_pool_admits_total",
+            "ruleset slots installed, by registry source",
+            labelnames=("source",),
+        )
+        for source in ("warm", "cold"):
+            self._m_admits.labels(source=source)
+        self._m_evictions = registry.counter(
+            "trivy_tpu_tenancy_pool_evictions_total",
+            "LRU slots dropped to stay under the residency budget",
+        )
+        registry.add_collect_hook(self._collect)
+
+    def _collect(self) -> None:
+        """Scrape-time mirror of pool state; reads counters without the
+        lock (ints, monotonic — a torn read is a stale sample at worst)."""
+        self._m_resident.set(self.resident_count())
+        self._m_resident_bytes.set(self.resident_bytes())
+        self._m_hits.set_total(self.stats.hits)
+        self._m_misses.set_total(self.stats.misses)
+        self._m_admits.labels(source="warm").set_total(self.stats.warm_admits)
+        self._m_admits.labels(source="cold").set_total(self.stats.cold_admits)
+        self._m_evictions.set_total(self.stats.evictions)
