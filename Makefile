@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test lint lint-changed lockcheck smoke serve-smoke obs-smoke tenancy-smoke bench bench-link checks-corpus rules-cache
+.PHONY: test lint lint-changed lockcheck smoke serve-smoke obs-smoke slo-smoke tenancy-smoke bench bench-link checks-corpus rules-cache
 
 # Tier-1: the suite the driver holds the repo to (fast, CPU, no slow marks).
 # Lint runs first — a graftlint finding fails the build before pytest
@@ -73,6 +73,16 @@ obs-smoke:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
 		BENCH_IMAGE=0 BENCH_TENANT=0 $(PY) bench.py --smoke
+
+# SLO / flight-recorder smoke: boot the server with a deliberately tight
+# latency objective, drive mixed-tenant traffic with one induced breach,
+# then assert the /debug/slo budget math recomputes from its own window
+# sums, a flight record captured the breach (span tree + scheduler
+# snapshot), top-K tenant series + "_other" rollup hold on /metrics, and
+# the same request carried an X-Trivy-Explain phase breakdown.
+slo-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_slo_smoke.py \
+		-m slo_smoke -q -p no:cacheprovider
 
 # Multi-tenant serving smoke (trivy_tpu/tenancy/): lane routing, WRR
 # fairness, pool LRU/warm re-admit, quota 429s, rules push e2e — with the

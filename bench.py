@@ -1068,7 +1068,141 @@ def bench_obs(engine, n_files: int = 1500) -> dict:
         out["enabled_overhead_pct"] = round(
             (on_wall - off_wall) / off_wall * 100, 2
         )
+    out["tenant_labels"] = _bench_tenant_label_cost()
+    out["flight"] = _bench_flight_capture_cost()
+    if os.environ.get("BENCH_TENANT", "1") == "1":
+        out["mixed_tenant"] = _bench_obs_mixed_tenant(engine)
     return out
+
+
+def _bench_tenant_label_cost(n_events: int = 20_000) -> dict:
+    """Enabled-path cost of the per-tenant label seats: one admit (two
+    governor resolves + two labeled incs) plus one wait observation per
+    event, 8 tenants round-robin (all resident, so this is the steady
+    top-K path, not rebalance churn)."""
+    from trivy_tpu.obs import metrics as obs_metrics
+    from trivy_tpu.obs.tenantmetrics import TenantMetrics
+
+    tm = TenantMetrics(obs_metrics.Registry(), max_tenant_series=8)
+    tenants = [f"tenant{i}" for i in range(8)]
+    t0 = time.perf_counter()
+    for i in range(n_events):
+        t = tenants[i % 8]
+        tm.admit(t, "")
+        tm.wait(t, 0.001)
+    wall = time.perf_counter() - t0
+    return {
+        "events": n_events,
+        "event_us": round(wall / n_events * 1e6, 3),
+    }
+
+
+def _bench_flight_capture_cost(n_captures: int = 100) -> dict:
+    """Cost of promoting a breach into the incident ring: span-tree
+    assembly from the live trace ring + a scheduler-snapshot stub + the
+    ring append.  Tracing is enabled with a realistic span population so
+    the per-capture filter pass is honest."""
+    from trivy_tpu.obs import trace as obs_trace
+    from trivy_tpu.obs.flight import FlightRecorder
+
+    obs_trace.enable()
+    obs_trace.clear()
+    try:
+        with obs_trace.span("rpc", method="scan_secrets"):
+            for _ in range(16):
+                with obs_trace.span("batch", items=4):
+                    pass
+        spans = obs_trace.snapshot()
+        trace_id = spans[0].trace_id if spans else ""
+        rec = FlightRecorder(
+            snapshot_fn=lambda: {"lanes": {}, "queue_depth": 0}
+        )
+        t0 = time.perf_counter()
+        for _ in range(n_captures):
+            rec.capture(
+                trace_id=trace_id, method="scan_secrets", tenant="bench",
+                code=200, elapsed_s=0.1, reason="latency",
+            )
+        wall = time.perf_counter() - t0
+    finally:
+        obs_trace.disable()
+        obs_trace.clear()
+    return {
+        "captures": n_captures,
+        "spans_per_record": len(spans),
+        "capture_us": round(wall / n_captures * 1e6, 3),
+    }
+
+
+def _bench_obs_mixed_tenant(engine, n_tenants: int = 8) -> dict:
+    """Mixed-tenant load with the full enabled path armed: tracing on,
+    per-tenant labels live, flight recorder attached, one induced
+    deadline breach.  Reports the wall, how many incidents the ring
+    captured, and the tenant-series count the governor settled on."""
+    import threading
+
+    from trivy_tpu.obs import trace as obs_trace
+    from trivy_tpu.obs.flight import FlightRecorder
+    from trivy_tpu.serve import BatchScheduler, ServeConfig
+
+    corpus = bench_corpus.make_monorepo_corpus(n_tenants * 3)
+    reqs = [corpus[i * 3 : (i + 1) * 3] for i in range(n_tenants)]
+    sched = BatchScheduler(
+        lambda: engine,
+        ServeConfig(batch_window_ms=8.0, max_tenant_series=4),
+    )
+    sched.flight = FlightRecorder(snapshot_fn=sched.snapshot)
+    obs_trace.enable()
+    obs_trace.clear()
+    try:
+        sched.submit(corpus[:1], client_id="warmup").result()
+        barrier = threading.Barrier(n_tenants)
+        futs = [None] * n_tenants
+
+        def fire(i):
+            barrier.wait()
+            futs[i] = sched.submit(
+                reqs[i], client_id=f"tenant{i}", explain=(i == 0)
+            )
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=fire, args=(i,))
+            for i in range(n_tenants)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for f in futs:
+            f.result()
+        wall = time.perf_counter() - t0
+        # Induced breach: a ticket whose deadline has already passed is
+        # expired by the scheduler and promoted into the flight ring.
+        breach = sched.submit(
+            corpus[:1], client_id="tenant-slow", timeout_s=1e-4
+        )
+        try:
+            breach.result(timeout=10)
+        except Exception:
+            pass
+        deadline = time.monotonic() + 10
+        while not sched.flight.captured and time.monotonic() < deadline:
+            time.sleep(0.01)
+        explain = getattr(futs[0].result(), "explain", None) or {}
+        n_series = len(sched.tenant_metrics.tenants.resident())
+        sched.drain(timeout=30)
+    finally:
+        sched.close()
+        obs_trace.disable()
+        obs_trace.clear()
+    return {
+        "tenants": n_tenants,
+        "wall_s": round(wall, 3),
+        "flight_records": sched.flight.captured,
+        "tenant_series": n_series,
+        "explain_phases": sorted((explain.get("phases_ms") or {})),
+    }
 
 
 def _device_platform() -> str:

@@ -26,7 +26,7 @@ from tools.graftlint.core import (
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(ROOT, "tools", "graftlint", "fixtures")
-ALL_RULES = ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006")
+ALL_RULES = ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007")
 
 
 def _lint_fixture(name: str):
@@ -68,6 +68,7 @@ def test_deny_fixture_counts_stable():
         "GL004": 5,
         "GL005": 4,
         "GL006": 3,
+        "GL007": 4,
     }
 
 

@@ -1,0 +1,156 @@
+"""Flight recorder (trivy_tpu/obs/flight.py): bounded incident ring,
+newest-first reads, JSONL persistence, guarded snapshot capture, span-tree
+filtering, and the scheduler's deadline-expiry + explain integration."""
+
+import json
+import threading
+
+import pytest
+
+from trivy_tpu.deadline import ScanTimeoutError
+from trivy_tpu.ftypes import Secret
+from trivy_tpu.obs import trace as obs_trace
+from trivy_tpu.obs.flight import FlightRecorder
+
+
+@pytest.fixture
+def tracing():
+    obs_trace.enable()
+    obs_trace.clear()
+    yield
+    obs_trace.disable()
+    obs_trace.clear()
+
+
+def test_ring_is_bounded_and_newest_first():
+    rec = FlightRecorder(capacity=4)
+    for i in range(10):
+        rec.capture(method="m", code=500, reason=f"r{i}")
+    assert rec.captured == 10  # capture count survives ring eviction
+    records = rec.records()
+    assert [r["seq"] for r in records] == [10, 9, 8, 7]
+    assert [r["seq"] for r in rec.records(limit=2)] == [10, 9]
+
+
+def test_out_path_jsonl(tmp_path):
+    out = tmp_path / "flight.jsonl"
+    rec = FlightRecorder(capacity=2, out_path=str(out))
+    for i in range(5):
+        rec.capture(method="m", code=408, reason="deadline", elapsed_s=i)
+    lines = out.read_text().strip().splitlines()
+    # every capture persists, even ones the ring has since evicted
+    assert len(lines) == 5
+    assert [json.loads(l)["seq"] for l in lines] == [1, 2, 3, 4, 5]
+
+
+def test_snapshot_fn_failure_never_raises():
+    def boom():
+        raise RuntimeError("scheduler mid-teardown")
+
+    rec = FlightRecorder(snapshot_fn=boom)
+    r = rec.capture(method="m", code=500, reason="error")
+    assert r["scheduler"] == {"error": "RuntimeError: scheduler mid-teardown"}
+
+
+def test_span_tree_filters_by_trace_and_rebases_time(tracing):
+    with obs_trace.span("other-request"):
+        pass
+    tid = obs_trace.new_trace_id()
+    with obs_trace.span("rpc.scan_secrets", trace_id=tid):
+        with obs_trace.span("batch", items=3):
+            pass
+    rec = FlightRecorder()
+    r = rec.capture(trace_id=tid, method="scan_secrets", reason="latency")
+    names = [s["name"] for s in r["spans"]]
+    assert names == ["rpc.scan_secrets", "batch"]
+    assert r["spans"][0]["start_ms"] == 0.0  # rebased to the tree root
+    assert r["spans"][1]["parent_id"] == r["spans"][0]["span_id"]
+    assert r["spans"][1]["attrs"]["items"] == 3
+    # no trace id -> no span scan at all
+    assert rec.capture(method="m", reason="error")["spans"] == []
+
+
+def test_metrics_family_counts_reasons():
+    from trivy_tpu.obs import metrics as obs_metrics
+
+    reg = obs_metrics.Registry()
+    rec = FlightRecorder(registry=reg)
+    rec.capture(reason="latency")
+    rec.capture(reason="latency")
+    rec.capture(reason="reject")
+    text = reg.render()
+    assert 'trivy_tpu_flight_records_total{reason="latency"} 2' in text
+    assert 'trivy_tpu_flight_records_total{reason="reject"} 1' in text
+
+
+def _scheduler(gate=None, entered=None, **cfg_kw):
+    from trivy_tpu.serve import BatchScheduler, ServeConfig
+
+    class Engine:
+        def scan_batch(self, items):
+            if entered is not None:
+                entered.set()
+            if gate is not None:
+                assert gate.wait(timeout=10)
+            return [Secret(file_path=p) for p, _ in items]
+
+    return BatchScheduler(Engine, ServeConfig(batch_window_ms=1.0, **cfg_kw))
+
+
+def test_scheduler_deadline_expiry_captures_flight(tracing):
+    """A ticket expiring in-queue is the scheduler-internal breach: the
+    flight record must carry the deadline reason, the ticket's trace, and
+    a scheduler snapshot (lanes + qos) taken at expiry time."""
+    import time
+
+    gate = threading.Event()
+    entered = threading.Event()
+    sched = _scheduler(gate=gate, entered=entered)
+    sched.flight = FlightRecorder(snapshot_fn=sched.snapshot)
+    try:
+        tid = obs_trace.new_trace_id()
+        # occupy the owner thread: the blocker must be *inside* the engine
+        # before the doomed ticket enqueues, or the two would coalesce.
+        blocker = sched.submit([("a.txt", b"x")], client_id="t0")
+        assert entered.wait(timeout=10)
+        doomed = sched.submit(
+            [("b.txt", b"y")], client_id="t1", timeout_s=0.005, trace_id=tid
+        )
+        time.sleep(0.05)  # let the deadline pass before releasing the engine
+        gate.set()
+        with pytest.raises(ScanTimeoutError):
+            doomed.result(timeout=10)
+        blocker.result(timeout=10)
+        records = sched.flight.records()
+        assert len(records) == 1
+        r = records[0]
+        assert r["reason"] == "deadline"
+        assert r["code"] == 408
+        assert r["tenant"] == "t1"
+        assert r["trace_id"] == tid
+        assert "lanes" in r["scheduler"] and "qos" in r["scheduler"]
+    finally:
+        gate.set()
+        sched.close()
+
+
+def test_scheduler_explain_breakdown():
+    sched = _scheduler()
+    try:
+        out = sched.submit(
+            [("a.txt", b"x"), ("b.txt", b"y")], client_id="t0", explain=True
+        ).result(timeout=10)
+        exp = out.explain
+        assert exp is not None
+        assert exp["queue_wait_ms"] >= 0
+        assert exp["batch_wall_ms"] >= 0
+        assert exp["batch"]["items"] == 2
+        assert exp["batch"]["lane"] == "default"
+        assert isinstance(exp["phases_ms"], dict)
+        # non-asking tickets pay nothing
+        plain = sched.submit([("c.txt", b"z")], client_id="t0").result(
+            timeout=10
+        )
+        assert plain.explain is None
+    finally:
+        sched.close()

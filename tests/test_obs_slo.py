@@ -1,0 +1,160 @@
+"""SLO tracker (trivy_tpu/obs/slo.py): objective parsing, threshold
+snapping, multi-window burn-rate math on an injected clock, error
+classification (408/5xx burn, 429 does not), and the exported
+trivy_tpu_slo_* families."""
+
+import pytest
+
+from trivy_tpu.obs import metrics as obs_metrics
+from trivy_tpu.obs.slo import (
+    WINDOWS,
+    Objective,
+    SloTracker,
+    load_slo_config,
+    snap_threshold,
+)
+
+
+def test_objective_validation():
+    Objective().validate()  # defaults are valid
+    with pytest.raises(ValueError):
+        Objective(latency_threshold_s=0.0).validate()
+    with pytest.raises(ValueError):
+        Objective(latency_target=1.0).validate()
+    with pytest.raises(ValueError):
+        Objective(error_target=0.0).validate()
+
+
+def test_snap_threshold_down_to_bucket_bound():
+    assert snap_threshold(1.0) == 1.0  # exact bound stays
+    assert snap_threshold(0.3) == 0.25  # snaps DOWN, never up
+    assert snap_threshold(100.0) == 60.0  # above all -> largest
+    assert snap_threshold(0.0001) == 0.001  # below all -> smallest
+
+
+def test_load_slo_config_inheritance(tmp_path):
+    p = tmp_path / "slo.yaml"
+    p.write_text(
+        "default:\n"
+        "  latency_threshold_s: 0.5\n"
+        "  error_target: 0.99\n"
+        "methods:\n"
+        "  scan_secrets: {latency_threshold_s: 0.1}\n"
+        "  scan:\n"
+    )
+    default, methods = load_slo_config(str(p))
+    assert default.latency_threshold_s == 0.5
+    assert default.latency_target == 0.99  # built-in default survives
+    assert default.error_target == 0.99
+    # method overrides one field, inherits the rest from `default`
+    assert methods["scan_secrets"].latency_threshold_s == 0.1
+    assert methods["scan_secrets"].error_target == 0.99
+    # empty method entry == the default objective
+    assert methods["scan"] == default
+
+
+def test_load_slo_config_rejects_non_mapping(tmp_path):
+    p = tmp_path / "bad.yaml"
+    p.write_text("- just\n- a list\n")
+    with pytest.raises(ValueError):
+        load_slo_config(str(p))
+
+
+def _tracker(clock, **kw):
+    reg = obs_metrics.Registry()
+    return reg, SloTracker(reg, now=lambda: clock[0], **kw)
+
+
+def test_burn_rate_math_exact():
+    """100 requests, 2 over threshold, 1 server error: latency burn =
+    (2/100)/(1-0.99) = 2.0, error burn = (1/100)/(1-0.999) = 10.0, on
+    every window (all slots inside 5m)."""
+    clock = [10_000.0]
+    _, slo = _tracker(clock)
+    for i in range(100):
+        code = 500 if i == 0 else 200
+        elapsed = 5.0 if i < 2 else 0.01
+        slo.observe("scan_secrets", code, elapsed)
+        clock[0] += 1.0
+    rep = slo.report()
+    m = rep["methods"]["scan_secrets"]
+    for label, _ in WINDOWS:
+        w = m["windows"][label]
+        assert (w["total"], w["slow"], w["errors"]) == (100, 2, 1)
+        assert w["latency_burn"] == pytest.approx(2.0)
+        assert w["error_burn"] == pytest.approx(10.0)
+    assert m["latency_budget_remaining"] == pytest.approx(-1.0)
+    assert m["error_budget_remaining"] == pytest.approx(-9.0)
+
+
+def test_error_classification():
+    clock = [10_000.0]
+    _, slo = _tracker(clock)
+    assert slo.observe("m", 200, 0.01) == ()
+    assert slo.observe("m", 400, 0.01) == ()  # client error: no burn
+    assert slo.observe("m", 429, 0.01) == ()  # QoS reject: no burn
+    assert slo.observe("m", 408, 0.01) == ("error",)
+    assert slo.observe("m", 503, 0.01) == ("error",)
+    assert slo.observe("m", 200, 10.0) == ("latency",)
+    assert slo.observe("m", 500, 10.0) == ("latency", "error")
+    w = slo.report()["methods"]["m"]["windows"]["6h"]
+    assert (w["total"], w["slow"], w["errors"]) == (7, 2, 3)
+
+
+def test_windows_decay_independently():
+    """A burst of errors ages out of the 5m window while the 6h window
+    still remembers it — the blip-vs-leak distinction."""
+    clock = [10_000.0]
+    _, slo = _tracker(clock)
+    for _ in range(10):
+        slo.observe("m", 500, 0.01)
+    clock[0] += 600.0  # 10 minutes later
+    for _ in range(10):
+        slo.observe("m", 200, 0.01)
+    w = slo.report()["methods"]["m"]["windows"]
+    assert w["5m"]["errors"] == 0 and w["5m"]["total"] == 10
+    assert w["6h"]["errors"] == 10 and w["6h"]["total"] == 20
+
+
+def test_slots_pruned_past_longest_window():
+    clock = [10_000.0]
+    _, slo = _tracker(clock)
+    slo.observe("m", 200, 0.01)
+    clock[0] += 22_000.0  # > 6h
+    slo.observe("m", 200, 0.01)
+    w = slo.report()["methods"]["m"]["windows"]["6h"]
+    assert w["total"] == 1
+    assert len(slo._methods["m"]) == 1  # the stale slot was dropped
+
+
+def test_per_method_objectives_and_snap():
+    clock = [10_000.0]
+    _, slo = _tracker(
+        clock,
+        per_method={"fast": Objective(latency_threshold_s=0.3)},
+    )
+    # snapped down to the 0.25 histogram bound at construction
+    assert slo.objective("fast").latency_threshold_s == 0.25
+    assert slo.objective("other").latency_threshold_s == 1.0
+    assert slo.observe("fast", 200, 0.4) == ("latency",)
+    assert slo.observe("other", 200, 0.4) == ()
+
+
+def test_exported_families_render():
+    clock = [10_000.0]
+    reg, slo = _tracker(clock)
+    slo.observe("scan_secrets", 200, 5.0)
+    text = reg.render()
+    assert (
+        'trivy_tpu_slo_burn_rate{method="scan_secrets",slo="latency",'
+        'window="5m"}' in text
+    )
+    assert "trivy_tpu_slo_budget_remaining" in text
+    assert (
+        'trivy_tpu_slo_breaches_total{method="scan_secrets",slo="latency"} 1'
+        in text
+    )
+    assert (
+        'trivy_tpu_slo_latency_threshold_seconds{method="scan_secrets"} 1'
+        in text
+    )

@@ -1,0 +1,194 @@
+"""Cardinality governor + per-tenant families (trivy_tpu/obs/tenantmetrics.py):
+top-K promotion/demotion determinism, fold conservation (sum over tenants +
+`_other` equals the untenanted total), and the scrape-size bound under 1,000
+synthetic tenants."""
+
+import re
+
+import pytest
+
+from trivy_tpu.ftypes import Secret
+from trivy_tpu.obs import metrics as obs_metrics
+from trivy_tpu.obs.tenantmetrics import OTHER, CardinalityGovernor, TenantMetrics
+
+
+def test_governor_is_deterministic():
+    """Identical observation sequences produce identical residency —
+    promotion/demotion is a pure function of the sequence."""
+    seq = [f"t{i % 7}" for i in range(100)] + ["burst"] * 40 + ["t0"] * 10
+    a = CardinalityGovernor(max_series=3, cadence=8, name="gov.det.a")
+    b = CardinalityGovernor(max_series=3, cadence=8, name="gov.det.b")
+    ra = [a.resolve(k) for k in seq]
+    rb = [b.resolve(k) for k in seq]
+    assert ra == rb
+    assert a.resident() == b.resident()
+
+
+def test_first_k_promote_immediately_tail_rolls_up():
+    g = CardinalityGovernor(max_series=2, cadence=1000, name="gov.firstk")
+    assert g.resolve("a") == "a"
+    assert g.resolve("b") == "b"
+    assert g.resolve("c") == OTHER  # table full, no rebalance yet
+    assert g.resident() == ("a", "b")
+    assert g.lookup("a") == "a" and g.lookup("c") == OTHER
+
+
+def test_dominance_shift_promotes_and_demotes():
+    """A newcomer that out-ranks a resident takes its series at the next
+    rebalance; the loser's traffic maps to _other afterwards."""
+    demoted = []
+    g = CardinalityGovernor(
+        max_series=2, cadence=8, on_demote=demoted.append, name="gov.shift"
+    )
+    for _ in range(3):
+        g.resolve("a")
+    g.resolve("b")
+    for _ in range(12):  # crosses >= 1 rebalance boundary
+        g.resolve("hot")
+    assert "hot" in g.resident()
+    assert "b" not in g.resident()  # lowest-volume resident lost its seat
+    assert "b" in demoted
+    assert g.lookup("b") == OTHER
+
+
+def test_rebalance_halves_and_drops_zero_counts():
+    g = CardinalityGovernor(max_series=1, cadence=4, name="gov.decay")
+    for k in ("a", "b", "c", "a"):  # 4th resolve triggers the rebalance
+        g.resolve(k)
+    # a: 2 -> 1, b/c: 1 -> 0 dropped (not resident)
+    assert set(g._counts) == {"a"}
+    assert g.resident() == ("a",)
+
+
+def _series(text: str, family: str, label: str) -> dict[str, float]:
+    """label-value -> sample for every series of `family` in exposition
+    text (counter families only; no suffixes)."""
+    out = {}
+    for m in re.finditer(
+        rf"^{family}{{([^}}]*)}} ([0-9.e+-]+)$", text, re.MULTILINE
+    ):
+        labels = dict(
+            kv.split("=", 1) for kv in m.group(1).split(",") if "=" in kv
+        )
+        v = labels.get(label, "").strip('"')
+        out[v] = out.get(v, 0.0) + float(m.group(2))
+    return out
+
+
+def test_fold_conserves_totals_and_drops_demoted_series():
+    reg = obs_metrics.Registry()
+    tm = TenantMetrics(reg, max_tenant_series=2, cadence=8)
+    events = 0
+    for _ in range(3):
+        tm.admit("a", "")
+        events += 1
+    tm.admit("b", "")
+    events += 1
+    for _ in range(12):
+        tm.admit("hot", "")
+        events += 1
+    text = reg.render()
+    per_tenant = _series(text, "trivy_tpu_tenant_requests_total", "tenant")
+    # conservation: every admit counted exactly once, folds moved samples
+    assert sum(per_tenant.values()) == events
+    # the demoted tenant's series is gone (folded into _other), not stale
+    assert "b" not in per_tenant
+    assert OTHER in per_tenant
+    assert "hot" in per_tenant
+
+
+def test_wait_and_phase_follow_residency_without_counting():
+    reg = obs_metrics.Registry()
+    tm = TenantMetrics(reg, max_tenant_series=1, cadence=1000)
+    tm.admit("big", "")
+    tm.wait("big", 0.05)
+    tm.wait("stranger", 0.05)  # never admitted -> rolls up
+    tm.phase("", "sieve", 0.01)  # "" digest maps to the default lane
+    text = reg.render()
+    assert 'trivy_tpu_tenant_ticket_wait_seconds_count{tenant="big"} 1' in text
+    assert (
+        f'trivy_tpu_tenant_ticket_wait_seconds_count{{tenant="{OTHER}"}} 1'
+        in text
+    )
+    assert "stranger" not in text
+    assert (
+        'trivy_tpu_tenant_batch_phase_seconds_count'
+        '{digest="default",phase="sieve"} 1' in text
+    )
+
+
+def test_thousand_tenants_bounded_scrape():
+    """1,000 distinct tenants, K=8: the scrape carries at most K + 1
+    tenant label values and the governor's count table stays bounded."""
+    K = 8
+    reg = obs_metrics.Registry()
+    tm = TenantMetrics(reg, max_tenant_series=K)
+    for i in range(1000):
+        t = f"tenant{i:04d}"
+        for _ in range(1 + i % 3):
+            tm.admit(t, "")
+            tm.reject(t, "quota")
+    text = reg.render()
+    per_tenant = _series(text, "trivy_tpu_tenant_requests_total", "tenant")
+    assert len(per_tenant) <= K + 1
+    assert OTHER in per_tenant
+    rejected = _series(text, "trivy_tpu_tenant_rejected_total", "tenant")
+    assert len(rejected) <= K + 1
+    # conservation across the full run
+    total_events = sum(1 + i % 3 for i in range(1000))
+    assert sum(per_tenant.values()) == total_events
+    # the counts table is bounded by decay + zero-dropping, not O(tenants)
+    assert len(tm.tenants._counts) <= K + tm.tenants.cadence
+
+
+def test_scheduler_feeds_tenant_families():
+    """End-to-end through BatchScheduler: per-tenant admits equal the
+    untenanted serve_tickets_total, rejections carry the reason label."""
+    import threading
+
+    from trivy_tpu.serve import BatchScheduler, ClientOverloadedError, ServeConfig
+
+    gate = threading.Event()
+    gate.set()
+
+    class Engine:
+        def scan_batch(self, items):
+            assert gate.wait(timeout=10)
+            return [Secret(file_path=p) for p, _ in items]
+
+    sched = BatchScheduler(
+        Engine,
+        ServeConfig(
+            batch_window_ms=1.0, max_inflight_per_client=1,
+            max_tenant_series=2,
+        ),
+    )
+    try:
+        for i in range(6):  # sequential: cap-1 clients must not collide
+            sched.submit(
+                [(f"f{i}.txt", b"data")], client_id=f"c{i % 3}"
+            ).result(timeout=10)
+        # Hold the engine so c0's next ticket stays inflight, forcing the
+        # labeled client_cap rejection deterministically.
+        gate.clear()
+        held = sched.submit([("g.txt", b"x")], client_id="c0")
+        try:
+            with pytest.raises(ClientOverloadedError):
+                sched.submit([("h.txt", b"x")], client_id="c0")
+        finally:
+            gate.set()
+        held.result(timeout=10)
+        text = sched.metrics_text()
+        per_tenant = _series(text, "trivy_tpu_tenant_requests_total", "tenant")
+        m = re.search(
+            r"^trivy_tpu_serve_tickets_total (\d+)", text, re.MULTILINE
+        )
+        assert m is not None
+        assert sum(per_tenant.values()) == float(m.group(1))
+        # K=2: three tenants -> at most 2 named + _other
+        assert len(per_tenant) <= 3
+        rej = _series(text, "trivy_tpu_tenant_rejected_total", "reason")
+        assert rej.get("client_cap", 0) >= 1
+    finally:
+        gate.set()
+        sched.close()

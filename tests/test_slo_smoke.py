@@ -1,0 +1,149 @@
+"""`make slo-smoke`: the metrics-to-"why" loop end to end.
+
+Boot the real server with a deliberately tight scan_secrets latency
+objective (1ms — the batching window alone breaches it), drive
+mixed-tenant traffic, then walk the whole observability chain: /debug/slo
+burn-rate math recomputes from its own window sums, every breached
+request landed a flight record carrying a span tree + scheduler snapshot
+(and persisted to --flight-out), the tenant label space on /metrics is
+top-K + "_other", and the explain-asking request got its per-phase
+breakdown echoed back.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from trivy_tpu.cache.store import MemoryCache
+from trivy_tpu.engine.hybrid import make_secret_engine
+from trivy_tpu.obs import trace as obs_trace
+from trivy_tpu.rpc.client import RpcClient, format_explain
+from trivy_tpu.rpc.server import start_background
+from trivy_tpu.serve import ServeConfig
+
+pytestmark = pytest.mark.slo_smoke
+
+SECRET_FILE = b"AWS_ACCESS_KEY_ID=AKIAQ6FAKEKEY1234567\n"
+TARGET = 0.5  # burn = slow_fraction / (1 - 0.5) = 2 * slow_fraction
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return make_secret_engine()
+
+
+@pytest.fixture
+def slo_server(engine, monkeypatch, tmp_path):
+    monkeypatch.setenv("TRIVY_TPU_LINK", "relay")
+    slo_yaml = tmp_path / "slo.yaml"
+    slo_yaml.write_text(
+        "methods:\n"
+        "  scan_secrets:\n"
+        "    latency_threshold_s: 0.001\n"
+        f"    latency_target: {TARGET}\n"
+    )
+    flight_out = tmp_path / "flight.jsonl"
+    obs_trace.enable()
+    obs_trace.clear()
+    httpd, _ = start_background(
+        "localhost:0",
+        MemoryCache(),
+        serve_config=ServeConfig(batch_window_ms=5.0, max_tenant_series=2),
+        secret_engine_factory=lambda: engine,
+        slo_config=str(slo_yaml),
+        flight_out=str(flight_out),
+    )
+    addr = f"{httpd.server_address[0]}:{httpd.server_address[1]}"
+    yield addr, httpd.scan_server, flight_out
+    httpd.scan_server.scheduler.close()
+    httpd.shutdown()
+    httpd.server_close()
+    obs_trace.disable()
+    obs_trace.clear()
+
+
+def _get_json(addr, path):
+    with urllib.request.urlopen(f"http://{addr}{path}", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _get_text(addr, path):
+    with urllib.request.urlopen(f"http://{addr}{path}", timeout=10) as r:
+        return r.read().decode()
+
+
+def test_slo_smoke_end_to_end(slo_server):
+    addr, scan_server, flight_out = slo_server
+    client = RpcClient(addr)
+    items = [("creds.env", SECRET_FILE), ("plain.txt", b"nothing here\n")]
+
+    # Mixed-tenant traffic: A and B claim the two governed series, C's
+    # single request must roll up into "_other".  A's first request asks
+    # for the explain breakdown.
+    explained = client.scan_secrets(
+        items, client_id="A", explain=True
+    )
+    n_requests = 1
+    for tenant, n in (("A", 2), ("B", 3), ("C", 1)):
+        for _ in range(n):
+            resp = client.scan_secrets(items, client_id=tenant)
+            assert resp["Secrets"], "scan must keep finding the secret"
+            n_requests += 1
+
+    # -- explain: the asking request carries the phase breakdown ----------
+    exp = explained.get("Explain")
+    assert exp, "X-Trivy-Explain/Explain request must echo a breakdown"
+    assert exp["queue_wait_ms"] >= 0
+    assert exp["batch"]["items"] >= len(items)
+    assert isinstance(exp["phases_ms"], dict)
+    assert "queue wait" in format_explain(exp)
+
+    # -- /debug/slo: burn rates recompute from the reported sums ----------
+    rep = _get_json(addr, "/debug/slo")
+    m = rep["methods"]["scan_secrets"]
+    assert m["objective"]["latency_threshold_s"] == 0.001
+    for label in ("5m", "1h", "6h"):
+        w = m["windows"][label]
+        assert w["total"] >= n_requests
+        # 1ms objective vs a 5ms batch window: every request is slow.
+        assert w["slow"] == w["total"]
+        assert w["latency_burn"] == pytest.approx(
+            (w["slow"] / w["total"]) / (1.0 - TARGET), abs=1e-3
+        )
+    assert m["latency_budget_remaining"] == pytest.approx(
+        1.0 - m["windows"]["6h"]["latency_burn"], abs=2e-4
+    )
+
+    # -- /debug/flight: every breach promoted spans + scheduler state -----
+    fl = _get_json(addr, "/debug/flight")
+    assert fl["captured"] >= n_requests
+    assert fl["records"], "breaches must land in the incident ring"
+    rec = fl["records"][0]  # newest first
+    assert rec["reason"] == "latency"
+    assert rec["tenant"] in ("A", "B", "C")
+    assert rec["spans"], "tracing was on: the span tree must be attached"
+    assert any(s["name"] == "rpc.scan_secrets" for s in rec["spans"])
+    assert "lanes" in rec["scheduler"]
+    assert "qos" in rec["scheduler"]
+    # limit is honored newest-first
+    assert len(_get_json(addr, "/debug/flight?limit=2")["records"]) == 2
+
+    # -- --flight-out: incidents persisted as they were captured ----------
+    lines = flight_out.read_text().strip().splitlines()
+    assert len(lines) == fl["captured"]
+    assert all(json.loads(l)["reason"] == "latency" for l in lines)
+
+    # -- /metrics: top-K tenants + "_other", never the tail's own label ---
+    text = _get_text(addr, "/metrics")
+    assert 'tenant="A"' in text
+    assert 'tenant="B"' in text
+    assert 'tenant="_other"' in text
+    assert 'tenant="C"' not in text
+    assert "trivy_tpu_slo_burn_rate" in text
+    assert "trivy_tpu_flight_records_total" in text
+
+    # -- /debug/traces honors ?limit= (S1) --------------------------------
+    chrome = _get_json(addr, "/debug/traces?limit=2")
+    spans = [e for e in chrome["traceEvents"] if e.get("ph") == "X"]
+    assert len(spans) == 2

@@ -17,6 +17,8 @@ Rule catalogue (each with allow/deny fixtures under fixtures/):
   GL005  thread-ownership: `# owner:` state mutated without its lock/role
   GL006  hook safety: unbalanced gauge inc/dec, span misuse, raising
          collect hooks
+  GL007  label cardinality: identity-shaped metric label values not
+         routed through the cardinality governor
 
 The runtime complement is trivy_tpu/lockcheck.py (TRIVY_TPU_LOCKCHECK=1
 lock-order + owner-role sanitizer); graftlint checks what must hold by
@@ -29,6 +31,10 @@ from tools.graftlint.core import Finding, lint_paths, load_waivers
 
 # importing the rule modules registers them; anything importing the
 # package (CLI, tests) sees the full registry
-from tools.graftlint import rules_jax, rules_threads  # noqa: E402,F401
+from tools.graftlint import (  # noqa: E402,F401
+    rules_jax,
+    rules_labels,
+    rules_threads,
+)
 
 __all__ = ["Finding", "lint_paths", "load_waivers"]

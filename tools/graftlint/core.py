@@ -173,7 +173,11 @@ def rule(rule_id: str):
 
 def lint_module(mod: Module, rules: dict | None = None) -> list[Finding]:
     # import for side effect: rule registration
-    from tools.graftlint import rules_jax, rules_threads  # noqa: F401
+    from tools.graftlint import (  # noqa: F401
+        rules_jax,
+        rules_labels,
+        rules_threads,
+    )
 
     out: list[Finding] = []
     for rid, fn in sorted((rules or RULES).items()):

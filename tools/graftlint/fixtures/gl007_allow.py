@@ -1,0 +1,27 @@
+"""GL007 allow fixture: identity-shaped labels routed through a governor."""
+
+
+def literal(fam):
+    fam.labels(tenant="_other").inc()
+
+
+def laundered_inline(fam, governor, client_id):
+    fam.labels(tenant=governor.resolve(client_id)).inc()
+
+
+def laundered_name(fam, governor, client_id, digest):
+    tenant = governor.resolve(client_id)
+    lane = governor.lookup(digest)
+    fam.labels(tenant=tenant, digest=lane).observe(1.0)
+
+
+def bounded_compositions(fam, governor, client_id, fallback):
+    fam.labels(tenant=str(governor.resolve(client_id))).inc()
+    fam.labels(
+        tenant=governor.resolve(client_id) if client_id else "_other"
+    ).inc()
+
+
+def bounded_dimension(fam, code):
+    # non-identity labels (status codes, phases) are out of scope
+    fam.labels(code=str(code), phase="pack").observe(0.5)

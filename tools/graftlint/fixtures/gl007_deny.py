@@ -1,0 +1,21 @@
+"""GL007 deny fixture: unbounded identity values minting metric series."""
+
+
+def raw_parameter(fam, client_id):
+    fam.labels(tenant=client_id).inc()  # GL007: raw request field
+
+
+def hashed(fam, blob):
+    import hashlib
+
+    digest = hashlib.sha256(blob).hexdigest()
+    fam.labels(digest=digest).inc()  # GL007: one series per blob
+
+
+def per_file(fam, blob_path):
+    fam.labels(path=blob_path).observe(1.0)  # GL007: one series per file
+
+
+def dressed_up(fam, tenants):
+    for t in tenants:
+        fam.labels(tenant=t.upper()).inc()  # GL007: transform != bound

@@ -1,0 +1,127 @@
+"""Metric-label cardinality rule.
+
+GL007 is the static half of the cardinality-governor contract
+(trivy_tpu/obs/tenantmetrics.py): a Prometheus label value drawn from an
+unbounded source — tenant id, ruleset digest, file path, trace id — mints a
+new time series per distinct value, and a scrape that grows with traffic is
+an OOM with a dashboard in front of it.  Any ``.labels(...)`` call whose
+keyword names one of the identity-shaped label dimensions must route the
+value through a governor (``governor.resolve(key)`` / ``.lookup(key)``,
+which collapse the long tail into ``"_other"``) or use a literal.
+
+Bounded value shapes (recursively):
+
+  * a string literal (``tenant="_other"``)
+  * a call whose method is ``resolve``/``lookup`` (the governor seats)
+  * a name assigned from such a call earlier in the same function
+  * ``str(<bounded>)`` and ``<bounded> if c else <bounded>``
+
+Everything else — a raw parameter, an attribute like ``ticket.client_id``,
+an f-string, a slice of a digest — is a finding.  Deliberately-bounded
+sites (a loop over pool slots that clears the family each scrape) annotate
+with ``# graftlint: ignore[GL007]``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import Finding, Module, rule
+
+# Label names whose values are identity-shaped: one distinct value per
+# tenant / ruleset / file / request in the wild, i.e. unbounded.
+UNBOUNDED_LABELS = frozenset(
+    {
+        "tenant",
+        "client",
+        "client_id",
+        "digest",
+        "ruleset_digest",
+        "path",
+        "file",
+        "target",
+        "trace_id",
+        "user",
+    }
+)
+
+# Method names that launder an unbounded key into a bounded label value.
+_LAUNDERERS = frozenset({"resolve", "lookup"})
+
+
+def _is_launder_call(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in _LAUNDERERS
+    )
+
+
+def _laundered_names(fn: ast.AST) -> set[str]:
+    """Names assigned from a governor resolve/lookup anywhere in `fn`
+    (order-insensitive on purpose: a false pass here still leaves the
+    runtime governor as the enforcement backstop)."""
+    names: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and _is_launder_call(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+        elif isinstance(node, ast.AnnAssign):
+            if _is_launder_call(node.value) and isinstance(
+                node.target, ast.Name
+            ):
+                names.add(node.target.id)
+    return names
+
+
+def _is_bounded(node: ast.AST, laundered: set[str]) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return True
+    if _is_launder_call(node):
+        return True
+    if isinstance(node, ast.Name) and node.id in laundered:
+        return True
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "str"
+        and len(node.args) == 1
+    ):
+        return _is_bounded(node.args[0], laundered)
+    if isinstance(node, ast.IfExp):
+        return _is_bounded(node.body, laundered) and _is_bounded(
+            node.orelse, laundered
+        )
+    return False
+
+
+@rule("GL007")
+def check_label_cardinality(mod: Module) -> list[Finding]:
+    out = []
+    for node in ast.walk(mod.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "labels"
+            and node.keywords
+        ):
+            continue
+        fn = mod.enclosing_function(node)
+        laundered = _laundered_names(fn) if fn is not None else set()
+        for kw in node.keywords:
+            if kw.arg not in UNBOUNDED_LABELS:
+                continue
+            if _is_bounded(kw.value, laundered):
+                continue
+            out.append(
+                Finding(
+                    "GL007",
+                    mod.relpath,
+                    node.lineno,
+                    f"label {kw.arg!r} takes an unbounded value "
+                    "(identity-shaped label not routed through a "
+                    "cardinality governor resolve()/lookup())",
+                )
+            )
+    return out

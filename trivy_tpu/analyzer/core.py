@@ -85,6 +85,9 @@ class SecretScannerOption:
     # scan under ("" = the server's default) — per-tenant ruleset pinning
     # against the server's resident pool (trivy_tpu/tenancy/).
     ruleset_select: str = ""
+    # backend == "server": ask for the per-phase timing breakdown on every
+    # batch response (--explain) — trivy_tpu/obs/.
+    explain: bool = False
 
 
 @dataclass

@@ -75,6 +75,7 @@ class SecretAnalyzer(BatchAnalyzer):
         self._rules_cache_dir = ""
         self._pipeline_depth: int | None = None
         self._resident_chunks: int | None = None
+        self._explain = False
 
     def init(self, options: AnalyzerOptions) -> None:
         opt = options.secret_scanner_option
@@ -87,6 +88,7 @@ class SecretAnalyzer(BatchAnalyzer):
         self._ruleset_select = getattr(opt, "ruleset_select", "")
         self._pipeline_depth = getattr(opt, "pipeline_depth", None)
         self._resident_chunks = getattr(opt, "resident_chunks", None)
+        self._explain = getattr(opt, "explain", False)
         self._config_skip_paths = self._build_config_skip_paths(self._config_path)
 
     @staticmethod
@@ -130,6 +132,7 @@ class SecretAnalyzer(BatchAnalyzer):
                     token=self._server_token,
                     timeout_s=self._timeout_s,
                     ruleset_select=self._ruleset_select,
+                    explain=self._explain,
                 )
             else:
                 # All local backends go through the factory, which maps the

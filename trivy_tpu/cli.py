@@ -242,6 +242,12 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
         help="write host span timeline (Chrome-trace JSON) to this path",
     )
     p.add_argument(
+        "--explain", action="store_true", default=_bool_default("explain"),
+        help="with --secret-backend server: request the per-phase timing "
+        "breakdown (queue wait, batch fill, engine phases) for each "
+        "batch and print it after the scan",
+    )
+    p.add_argument(
         "--log-format", choices=("console", "json"),
         default=_env_default("log-format", "console"),
         help="log line format: console (default) or one JSON object per line",
@@ -401,6 +407,7 @@ def _options_from_args(args: argparse.Namespace) -> Options:
         profile_dir=getattr(args, "profile_dir", ""),
         trace=getattr(args, "trace", False),
         trace_out=getattr(args, "trace_out", ""),
+        explain=getattr(args, "explain", False),
         log_format=getattr(args, "log_format", "console"),
     )
 
@@ -678,6 +685,24 @@ def build_parser() -> argparse.ArgumentParser:
         help="per-tenant byte token-bucket depth (0 = one second of rate)",
     )
     p_server.add_argument(
+        "--max-tenant-series", type=int,
+        default=_int_default("max-tenant-series", 16),
+        help="tenants that get their own metric series (top-K by request "
+        'volume); the long tail rolls up into tenant="_other"',
+    )
+    p_server.add_argument(
+        "--slo-config",
+        default=_env_default("slo-config", ""),
+        help="YAML per-method latency/error objectives overriding the "
+        "defaults (burn rates served at GET /debug/slo)",
+    )
+    p_server.add_argument(
+        "--flight-out",
+        default=_env_default("flight-out", ""),
+        help="append flight-recorder breach records (span tree + "
+        "scheduler snapshot) to this JSONL file as they are captured",
+    )
+    p_server.add_argument(
         "--secret-config",
         default=_env_default("secret-config", ""),
         help="secret-config the server engine loads; SIGHUP or "
@@ -936,12 +961,15 @@ def main(argv: list[str] | None = None) -> int:
                 tenant_burst=args.tenant_burst,
                 tenant_bytes_per_s=args.tenant_bytes_per_sec,
                 tenant_bytes_burst=args.tenant_bytes_burst,
+                max_tenant_series=args.max_tenant_series,
             ),
             secret_config=args.secret_config,
             rules_cache_dir=resolve_rules_cache_dir(args.rules_cache_dir),
             pipeline_depth=args.pipeline_depth,
             resident_chunks=args.resident_chunks,
             profile_dir=args.profile_dir,
+            slo_config=args.slo_config,
+            flight_out=args.flight_out,
         )
         return 0
 

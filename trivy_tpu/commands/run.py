@@ -89,6 +89,7 @@ class Options:
     profile_dir: str = ""  # --profile-dir (JAX profiler trace of the scan)
     trace: bool = False  # --trace (rego traces on misconfig findings)
     trace_out: str = ""  # --trace-out (host span Chrome-trace JSON path)
+    explain: bool = False  # --explain (server-side per-phase batch timings)
     log_format: str = "console"  # --log-format console|json
     config_check: list[str] = field(default_factory=list)  # --config-check dirs
     insecure_registry: bool = False  # plain-http registry pulls
@@ -221,6 +222,7 @@ def _analyzer_options(options: Options, target_kind: str) -> AnalyzerOptions:
             rules_cache_dir=getattr(options, "rules_cache_dir", ""),
             pipeline_depth=getattr(options, "pipeline_depth", None),
             resident_chunks=getattr(options, "resident_chunks", None),
+            explain=getattr(options, "explain", False),
         ),
         file_patterns=_parse_file_patterns(options.file_patterns),
         extra_analyzers=extra,
@@ -376,8 +378,30 @@ def run(options: Options, target_kind: str) -> int:
             # Host spans land beside the device profile so Perfetto can
             # load both into one timeline (profiles/README).
             obs_trace.dump_into_profile_dir(options.profile_dir)
-        return rc
-    return _run_profiled(options, target_kind)
+    else:
+        rc = _run_profiled(options, target_kind)
+    _print_explains(options)
+    return rc
+
+
+def _print_explains(options: Options) -> None:
+    """--explain: pretty-print the per-batch phase breakdowns the server
+    echoed back.  The engine instance lives deep inside the analyzer, so
+    the client module accumulates them (rpc.client.LAST_EXPLAINS); stderr
+    keeps the report stream (stdout / --output) machine-parseable."""
+    if not getattr(options, "explain", False):
+        return
+    from trivy_tpu.rpc import client as rpc_client
+
+    explains = list(rpc_client.LAST_EXPLAINS)
+    if not explains:
+        print("trivy-tpu: --explain: no server batches recorded "
+              "(is --secret-backend server in effect?)", file=sys.stderr)
+        return
+    print(f"trivy-tpu: --explain: {len(explains)} server batch(es)",
+          file=sys.stderr)
+    for exp in explains:
+        print(rpc_client.format_explain(exp), file=sys.stderr)
 
 
 def _run_profiled(options: Options, target_kind: str) -> int:

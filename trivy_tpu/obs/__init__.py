@@ -1,5 +1,8 @@
-"""Observability substrate: span tracing (obs/trace.py) and the
-counter/gauge/histogram metrics registry (obs/metrics.py).
+"""Observability substrate: span tracing (obs/trace.py), the
+counter/gauge/histogram metrics registry (obs/metrics.py), per-method
+SLO burn-rate tracking (obs/slo.py), tenant-labelled families behind a
+cardinality governor (obs/tenantmetrics.py), and the breach-triggered
+flight recorder (obs/flight.py).
 
 One trace from RPC ticket to TPU kernel: `RemoteSecretEngine` mints a
 trace_id, ships it as `X-Trivy-Trace-Id`, the server stamps it onto the
@@ -8,13 +11,14 @@ per-chunk encode/h2d/exec/fetch, host confirm) opens a span carrying it.
 Spans land in a bounded ring buffer and export as Chrome-trace JSON
 (`trivy-tpu scan --trace-out`, server `GET /debug/traces`), which Perfetto
 merges with the JAX profiler's device timeline when both write into one
-`--profile-dir`.
+`--profile-dir`.  When a request breaches its SLO, its span tree plus a
+scheduler snapshot are promoted into the flight ring (`GET /debug/flight`).
 
 Everything is off by default: `span()` returns a no-op singleton unless
 tracing was enabled (`TRIVY_TPU_TRACE=1` or `trace.enable()`), so the
 scan path pays one predicate per call site.
 """
 
-from trivy_tpu.obs import metrics, trace
+from trivy_tpu.obs import flight, metrics, slo, tenantmetrics, trace
 
-__all__ = ["metrics", "trace"]
+__all__ = ["flight", "metrics", "slo", "tenantmetrics", "trace"]

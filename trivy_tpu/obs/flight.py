@@ -1,0 +1,150 @@
+"""Flight recorder: cheap always-on tracing, breach-promoted incidents.
+
+The trace ring (obs/trace.py) already records every span at deque-append
+cost, but it is a *global* ring: by the time an operator asks "why was
+that scan slow", the interesting spans have been pushed out by ten
+thousand boring ones.  The flight recorder closes that gap the Dapper
+way — keep tracing cheap and unconditional, and at the moment a request
+*breaches* (latency over its SLO threshold, 408/5xx, a QoS 429, or a
+deadline expiry inside the scheduler) promote everything we know about it
+into a small bounded incident ring:
+
+  * the request's full span tree, filtered out of the trace ring by
+    trace id (queue wait, batch execution, engine phases — whatever the
+    request touched);
+  * a scheduler snapshot taken at breach time: lane depths, resident
+    pool contents, QoS bucket levels — the context that explains *why*
+    the request waited.
+
+Incidents are served newest-first by `GET /debug/flight?limit=N` and,
+when `--flight-out` is set, appended to a JSONL file as they are captured
+so they survive the process.
+
+Capture runs on request/handler threads and must never raise: an
+observability feature that can turn a breach into an outage is worse
+than no feature.  The snapshot callback and the file append are each
+individually guarded.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from typing import Callable
+
+from trivy_tpu import lockcheck
+from trivy_tpu.obs import trace as obs_trace
+
+DEFAULT_CAPACITY = 64
+
+
+class FlightRecorder:
+    """Bounded incident ring.  `snapshot_fn` is injected (the server
+    passes BatchScheduler.snapshot) so this module needs no dependency on
+    trivy_tpu.serve."""
+
+    def __init__(
+        self,
+        capacity: int = DEFAULT_CAPACITY,
+        snapshot_fn: Callable[[], dict] | None = None,
+        out_path: str = "",
+        registry=None,
+    ):
+        self._lock = lockcheck.make_lock("obs.flight")
+        self._ring: deque[dict] = deque(maxlen=max(1, int(capacity)))  # owner: _lock
+        self._seq = 0  # owner: _lock
+        self._snapshot_fn = snapshot_fn
+        self.out_path = out_path
+        self._m_captured = None
+        if registry is not None:
+            self._m_captured = registry.counter(
+                "trivy_tpu_flight_records_total",
+                "breach incidents captured into the flight ring",
+                ("reason",),
+            )
+
+    @property
+    def captured(self) -> int:
+        with self._lock:
+            return self._seq
+
+    # -- capture (request / owner threads) ---------------------------------
+
+    def _span_tree(self, trace_id: str) -> list[dict]:
+        if not trace_id:
+            return []
+        spans = [s for s in obs_trace.snapshot() if s.trace_id == trace_id]
+        spans.sort(key=lambda s: (s.start, s.span_id))
+        t0 = spans[0].start if spans else 0.0
+        return [
+            {
+                "name": s.name,
+                "span_id": s.span_id,
+                "parent_id": s.parent_id,
+                "start_ms": round((s.start - t0) * 1e3, 3),
+                "dur_ms": round(s.dur * 1e3, 3),
+                "tid": s.tid,
+                "attrs": dict(s.attrs),
+            }
+            for s in spans
+        ]
+
+    def _scheduler_state(self) -> dict:
+        if self._snapshot_fn is None:
+            return {}
+        try:
+            return self._snapshot_fn()
+        except Exception as e:
+            # Breach context is best-effort; the record (with spans) still
+            # lands even when the scheduler is mid-teardown.
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def capture(
+        self,
+        *,
+        trace_id: str = "",
+        method: str = "",
+        tenant: str = "",
+        code: int = 0,
+        elapsed_s: float = 0.0,
+        reason: str = "",
+    ) -> dict:
+        """Promote one breached request into the incident ring and return
+        the record (callers may enrich their logs with it)."""
+        rec = {
+            "seq": 0,
+            "captured_at": time.time(),
+            "reason": reason,
+            "method": method,
+            "tenant": tenant,
+            "trace_id": trace_id,
+            "code": int(code),
+            "elapsed_s": round(float(elapsed_s), 6),
+            "spans": self._span_tree(trace_id),
+            "scheduler": self._scheduler_state(),
+        }
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            self._ring.append(rec)
+            if self.out_path:
+                try:
+                    with open(self.out_path, "a") as f:
+                        f.write(json.dumps(rec, default=str) + "\n")
+                except OSError:
+                    pass
+        if self._m_captured is not None:
+            self._m_captured.labels(reason=reason or "unknown").inc()
+        return rec
+
+    # -- read side (debug endpoint, tests) ---------------------------------
+
+    def records(self, limit: int | None = None) -> list[dict]:
+        """Newest-first incident list, optionally truncated to `limit`."""
+        with self._lock:
+            items = list(self._ring)
+        items.reverse()
+        if limit is not None:
+            items = items[: max(0, int(limit))]
+        return items

@@ -111,6 +111,36 @@ class _Family:
             )
         return self._child(tuple(str(kw[n]) for n in self.labelnames))
 
+    def fold_label(self, labelname: str, value: str, into: str) -> None:
+        """Fold every series whose `labelname` equals `value` into the
+        series with that label replaced by `into`, then drop the source.
+
+        The cardinality governor's demotion primitive: totals are conserved
+        (each event was counted exactly once, folding moves samples rather
+        than duplicating them), the destination stays monotonic (a fold
+        only adds), and the demoted series disappears from the next scrape
+        instead of pinning a stale sample forever."""
+        if labelname not in self.labelnames:
+            raise ValueError(
+                f"{self.name}: no label {labelname!r} in {self.labelnames}"
+            )
+        i = self.labelnames.index(labelname)
+        with self._lock:
+            keys = [k for k in self._children if k[i] == str(value)]
+            for key in keys:
+                src = self._children.pop(key)
+                dkey = key[:i] + (str(into),) + key[i + 1 :]
+                dst = self._children.get(dkey)
+                if dst is None:
+                    dst = self._children[dkey] = self._new_child()
+                self._fold_child(src, dst)
+
+    def _fold_child(self, src, dst) -> None:
+        """Merge src's samples into dst; runs under the family lock, so it
+        must touch child fields directly (inc()/observe() would deadlock
+        on the same non-reentrant lock)."""
+        raise NotImplementedError
+
     def render(self) -> list[str]:
         lines = [
             f"# HELP {self.name} {self.help}",
@@ -152,6 +182,9 @@ class Counter(_Family):
     def _new_child(self):
         return _CounterChild(self._lock)
 
+    def _fold_child(self, src, dst) -> None:
+        dst.v += src.v
+
     def inc(self, amount: float = 1.0) -> None:
         self._child(()).inc(amount)
 
@@ -185,6 +218,11 @@ class Gauge(_Family):
 
     def _new_child(self):
         return _GaugeChild(self._lock)
+
+    def _fold_child(self, src, dst) -> None:
+        # Gauges fold additively: the governor only demotes counting-style
+        # gauges, where "combined level" is the only meaningful rollup.
+        dst.v += src.v
 
     def set(self, value: float) -> None:
         self._child(()).set(value)
@@ -232,6 +270,12 @@ class Histogram(_Family):
 
     def _new_child(self):
         return _HistogramChild(self.buckets, self._lock)
+
+    def _fold_child(self, src, dst) -> None:
+        for i, n in enumerate(src.counts):
+            dst.counts[i] += n
+        dst.sum += src.sum
+        dst.count += src.count
 
     def observe(self, value: float) -> None:
         self._child(()).observe(value)

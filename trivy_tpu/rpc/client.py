@@ -138,11 +138,14 @@ class RpcClient:
         timeout_ms: int | None = None,
         client_id: str = "",
         ruleset_digest: str = "",
+        explain: bool = False,
     ) -> dict:
         """POST raw (path, blob) items to the server's continuous batcher
         (Scanner/ScanSecrets).  JSON-only: contents travel base64.
         `ruleset_digest` routes the request onto that pushed ruleset's
-        batching lane ("" = the server's default ruleset)."""
+        batching lane ("" = the server's default ruleset).  `explain` asks
+        the server to echo the per-phase timing breakdown (queue wait,
+        batch fill, engine phases) in the response's Explain field."""
         payload: dict = {
             "Target": target,
             "Files": [
@@ -156,6 +159,8 @@ class RpcClient:
             payload["ClientID"] = client_id
         if ruleset_digest:
             payload["RulesetDigest"] = ruleset_digest
+        if explain:
+            payload["Explain"] = True
         return self.call("/twirp/trivy.scanner.v1.Scanner/ScanSecrets", payload)
 
     def push_ruleset(
@@ -212,6 +217,42 @@ class RemoteDriver(Driver):
         return results, os_from_json(resp.get("OS"))
 
 
+# Per-request Explain breakdowns from the current process's --explain
+# scans, appended in completion order (newest last).  Module-level on
+# purpose: the CLI's engine instance is buried inside the analyzer stack,
+# and the command layer reads this after the artifact walk completes.
+# Reset whenever an explain-enabled engine is constructed (one scan's
+# breakdowns never bleed into the next).
+LAST_EXPLAINS: list[dict] = []
+
+
+def format_explain(exp: dict) -> str:
+    """Pretty-print one ScanSecrets Explain breakdown (CLI --explain):
+    where the request's wall time went, phase by phase."""
+    if not exp:
+        return "explain: server returned no breakdown"
+    b = exp.get("batch") or {}
+    head = (
+        f"explain: trace={exp.get('trace_id') or '-'} "
+        f"lane={b.get('lane', '-')} "
+        f"batch={b.get('tickets', '?')} req"
+        f" / {b.get('items', '?')} items"
+        f" / {b.get('bytes', 0)} B"
+    )
+    if b.get("coalesced"):
+        head += " (coalesced)"
+    lines = [head]
+    lines.append(
+        f"  {'queue wait':<12} {float(exp.get('queue_wait_ms', 0.0)):>10.3f} ms"
+    )
+    for name, ms in (exp.get("phases_ms") or {}).items():
+        lines.append(f"  {name:<12} {float(ms):>10.3f} ms")
+    lines.append(
+        f"  {'batch wall':<12} {float(exp.get('batch_wall_ms', 0.0)):>10.3f} ms"
+    )
+    return "\n".join(lines)
+
+
 class RemoteSecretEngine:
     """The secret-engine seat over the wire (--secret-backend server).
 
@@ -234,6 +275,7 @@ class RemoteSecretEngine:
         timeout_s: float = 0.0,
         client_id: str = "",
         ruleset_select: str = "",
+        explain: bool = False,
     ):
         self.client = RpcClient(addr, token)
         self.timeout_s = timeout_s
@@ -251,6 +293,13 @@ class RemoteSecretEngine:
         # X-Trivy-Trace-Id response header: the key that joins this
         # client's spans with the server's batch/chunk spans.
         self.last_trace_id = ""
+        # --explain: ship X-Trivy-Explain on every batch and collect the
+        # per-phase breakdowns for the CLI to print after the scan.
+        self.explain = explain
+        self.last_explain: dict = {}
+        if explain:
+            self.client.headers["X-Trivy-Explain"] = "1"
+            del LAST_EXPLAINS[:]
 
     def scan_batch(self, items: list[tuple[str, bytes]]) -> list[Secret]:
         if not items:
@@ -273,6 +322,7 @@ class RemoteSecretEngine:
                 timeout_ms=int(self.timeout_s * 1000) if self.timeout_s else None,
                 client_id=self.client_id,
                 ruleset_digest=self.ruleset_select,
+                explain=self.explain,
             )
         echoed = next(
             (
@@ -284,6 +334,9 @@ class RemoteSecretEngine:
         )
         self.last_trace_id = echoed or trace_id
         self.ruleset_digest = str(resp.get("RulesetDigest") or "")
+        if self.explain:
+            self.last_explain = dict(resp.get("Explain") or {})
+            LAST_EXPLAINS.append(self.last_explain)
         secrets = [
             _secret_from_json(d) for d in (resp.get("Secrets") or [])
         ]

@@ -36,6 +36,7 @@ import hmac
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
 
 from trivy_tpu import __version__, deadline, lockcheck
 from trivy_tpu.atypes import ArtifactInfo, _secret_to_json
@@ -46,7 +47,9 @@ from trivy_tpu.cache.store import (
     MemoryCache,
 )
 from trivy_tpu.deadline import ScanTimeoutError
+from trivy_tpu.obs import flight as obs_flight
 from trivy_tpu.obs import metrics as obs_metrics
+from trivy_tpu.obs import slo as obs_slo
 from trivy_tpu.obs import trace as obs_trace
 from trivy_tpu.rpc.convert import blob_from_json, os_to_json, result_to_json
 from trivy_tpu.scanner.service import (
@@ -110,6 +113,8 @@ class ScanServer:
         pipeline_depth: int | None = None,
         resident_chunks: int | None = None,
         profile_dir: str = "",
+        slo_config: str = "",
+        flight_out: str = "",
     ):
         from trivy_tpu.scanner.vuln import init_vuln_scanner
 
@@ -144,6 +149,26 @@ class ScanServer:
                 self._load_ruleset_engine if rules_cache_dir else None
             ),
         )
+        # SLO tracking + breach capture: the tracker classifies every RPC
+        # observation against its (default or --slo-config) objective;
+        # breaches promote the request's span tree plus a scheduler
+        # snapshot into the flight ring (GET /debug/flight, --flight-out).
+        default_obj, per_method = (
+            obs_slo.load_slo_config(slo_config)
+            if slo_config
+            else (obs_slo.Objective(), {})
+        )
+        self.slo = obs_slo.SloTracker(
+            self.registry, default=default_obj, per_method=per_method
+        )
+        self.flight = obs_flight.FlightRecorder(
+            snapshot_fn=self.scheduler.snapshot,
+            out_path=flight_out,
+            registry=self.registry,
+        )
+        # The scheduler captures deadline expiries itself (at expiry time,
+        # when the snapshot still shows the queue that starved the ticket).
+        self.scheduler.flight = self.flight
         # Build/ruleset identity: one series per RESIDENT ruleset, rebuilt
         # from live state at each scrape (clear + re-set), so evicted
         # digests stop scraping instead of pinning stale 1s forever.
@@ -278,12 +303,14 @@ class ScanServer:
         )
         if digest and digest == self.ruleset_digest():
             digest = ""
+        explain = bool(req.get("Explain") or req.get("_explain"))
         fut = self.scheduler.submit(
             items,
             client_id=str(req.get("ClientID") or req.get("_client") or ""),
             timeout_s=timeout_s,
             trace_id=str(req.get("_trace_id") or ""),
             ruleset_digest=digest,
+            explain=explain,
         )
         # Deadline-armed requests never hang the connection: even a wedged
         # engine bounds the wait (the slack covers a dispatched batch that
@@ -299,7 +326,7 @@ class ScanServer:
                 ) from None
         else:
             secrets = fut.result()
-        return {
+        out = {
             "Results": [
                 result_to_json(r)
                 for r in secrets_to_results(
@@ -313,6 +340,11 @@ class ScanServer:
             "RulesetDigest": getattr(secrets, "ruleset_digest", ""),
             "RulesetEpoch": getattr(secrets, "ruleset_epoch", 0),
         }
+        if explain:
+            # Per-phase breakdown the dispatch attached (same timing the
+            # span tree carries); only the asking request pays the bytes.
+            out["Explain"] = getattr(secrets, "explain", None) or {}
+        return out
 
     # -- ruleset registry -------------------------------------------------
 
@@ -408,7 +440,11 @@ class ScanServer:
         and it never builds an engine."""
         fam = self._m_build_info
         fam.clear()
-        fam.labels(
+        # Digest labels here are bounded by construction — one series for
+        # the active ruleset plus one per pool slot, and clear() above
+        # resets the family every scrape — so GL007's governor requirement
+        # does not apply.
+        fam.labels(  # graftlint: ignore[GL007]
             version=__version__,
             ruleset_digest=self.ruleset_digest(),
             epoch=str(self.scheduler.ruleset_epoch()),
@@ -416,7 +452,7 @@ class ScanServer:
         pool = self.scheduler.pool
         if pool is not None:
             for digest, epoch, _nbytes in pool.residents():
-                fam.labels(
+                fam.labels(  # graftlint: ignore[GL007]
                     version=__version__,
                     ruleset_digest=digest,
                     epoch=str(epoch),
@@ -503,6 +539,15 @@ _ROUTES = {
 }
 
 
+def _query_limit(query: str, default: int = 64) -> int:
+    """?limit=N for the debug endpoints; bad values fall back to the
+    default rather than 400 (these are operator conveniences)."""
+    try:
+        return max(1, int(parse_qs(query).get("limit", [default])[0]))
+    except (TypeError, ValueError):
+        return default
+
+
 def _make_handler(server: ScanServer):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -524,16 +569,18 @@ def _make_handler(server: ScanServer):
             self.wfile.write(body)
 
         def do_GET(self):
-            if self.path == "/healthz":
+            parsed = urlparse(self.path)
+            route = parsed.path
+            if route == "/healthz":
                 body = b"ok"
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
-            elif self.path == "/version":
+            elif route == "/version":
                 self._send(200, {"Version": __version__})
-            elif self.path == "/metrics":
+            elif route == "/metrics":
                 # One render path: build_info rides the registry's
                 # collect hook like every other live-state family.
                 body = server.registry.render().encode()
@@ -544,15 +591,31 @@ def _make_handler(server: ScanServer):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
-            elif self.path == "/debug/traces":
+            elif route == "/debug/traces":
                 # Span ring as Chrome-trace JSON — load in Perfetto or
                 # chrome://tracing.  Empty traceEvents when tracing is off.
-                body = json.dumps(obs_trace.to_chrome()).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                # Bounded: newest `limit` spans only (default 64) — a full
+                # 8192-span ring must not become a multi-MB response.
+                spans = obs_trace.snapshot()
+                spans = spans[-_query_limit(parsed.query):]
+                spans.reverse()  # newest first
+                self._send(200, obs_trace.to_chrome(spans))
+            elif route == "/debug/slo":
+                # Per-method burn rates and remaining error budget (see
+                # obs/slo.py for the window/budget math).
+                self._send(200, server.slo.report())
+            elif route == "/debug/flight":
+                # Captured breach incidents, newest first, same ?limit=N
+                # contract as /debug/traces.
+                self._send(
+                    200,
+                    {
+                        "captured": server.flight.captured,
+                        "records": server.flight.records(
+                            _query_limit(parsed.query)
+                        ),
+                    },
+                )
             else:
                 self._send(404, {"error": "not found"})
 
@@ -582,17 +645,38 @@ def _make_handler(server: ScanServer):
             )[:64]
             if not trace_id and obs_trace.enabled():
                 trace_id = obs_trace.new_trace_id()
+            # Tenant attribution for breach capture; the scan_secrets
+            # branch below fills it in once the body is parsed.
+            info = {"tenant": ""}
+
+            def observe(code: int) -> None:
+                # Known method names only: raw request paths would let an
+                # unauthenticated client inject label characters and grow
+                # the counter map without bound.
+                elapsed = _time.monotonic() - start
+                server.metrics.observe(method or "unknown", code, elapsed)
+                breaches = server.slo.observe(
+                    method or "unknown", code, elapsed
+                )
+                if breaches or code == 429:
+                    # Breach capture: latency over objective, error-budget
+                    # classes (408/5xx), and QoS rejections (429 — no
+                    # budget burn, but the tenant felt it) promote this
+                    # request's spans + a scheduler snapshot.
+                    server.flight.capture(
+                        trace_id=trace_id,
+                        method=method or "unknown",
+                        tenant=info["tenant"],
+                        code=code,
+                        elapsed_s=elapsed,
+                        reason="+".join(breaches) or "reject",
+                    )
 
             def send(
                 code: int, payload: dict,
                 headers: dict[str, str] | None = None,
             ) -> None:
-                # Known method names only: raw request paths would let an
-                # unauthenticated client inject label characters and grow
-                # the counter map without bound.
-                server.metrics.observe(
-                    method or "unknown", code, _time.monotonic() - start
-                )
+                observe(code)
                 if trace_id:
                     headers = dict(headers or {})
                     headers.setdefault("X-Trivy-Trace-Id", trace_id)
@@ -638,9 +722,7 @@ def _make_handler(server: ScanServer):
                     ):
                         out = getattr(server, method)(req)
                     data = protowire.encode_response(method, out)
-                    server.metrics.observe(
-                        method, 200, _time.monotonic() - start
-                    )
+                    observe(200)
                     self.send_response(200)
                     self.send_header("Content-Type", "application/protobuf")
                     if method == "scan":
@@ -658,6 +740,15 @@ def _make_handler(server: ScanServer):
                         # ClientID when sent, else the peer address.
                         req["_client"] = self.client_address[0]
                     req["_trace_id"] = trace_id
+                    info["tenant"] = str(
+                        req.get("ClientID") or req.get("_client") or ""
+                    )
+                    # X-Trivy-Explain: 1 (CLI --explain): echo the
+                    # per-phase timing breakdown in the response.
+                    if self.headers.get("X-Trivy-Explain", "") in (
+                        "1", "true", "yes",
+                    ):
+                        req["_explain"] = True
                     # Header-based ruleset routing (proxies can set it
                     # without touching bodies); sanitized like the trace
                     # header — digests are hex, anything else can only
@@ -721,6 +812,8 @@ def make_http_server(
     pipeline_depth: int | None = None,
     resident_chunks: int | None = None,
     profile_dir: str = "",
+    slo_config: str = "",
+    flight_out: str = "",
 ) -> ThreadingHTTPServer:
     host, _, port = addr.rpartition(":")
     scan_server = ScanServer(
@@ -732,6 +825,8 @@ def make_http_server(
         pipeline_depth=pipeline_depth,
         resident_chunks=resident_chunks,
         profile_dir=profile_dir,
+        slo_config=slo_config,
+        flight_out=flight_out,
     )
     httpd = ThreadingHTTPServer(
         (host or "localhost", int(port)), _make_handler(scan_server)
@@ -751,6 +846,8 @@ def serve(
     pipeline_depth: int | None = None,
     resident_chunks: int | None = None,
     profile_dir: str = "",
+    slo_config: str = "",
+    flight_out: str = "",
 ) -> None:
     """pkg/rpc/server/listen.go ListenAndServe, with graceful SIGTERM
     drain: stop admitting (503 + Retry-After), finish the batches already
@@ -759,12 +856,18 @@ def serve(
     in at the next batch boundary (zero dropped requests)."""
     import signal
 
+    # Flight-recorder contract: every request is traced at ring-buffer
+    # cost so a breach can promote its span tree.  Daemon-only — tests
+    # and embedders opt in explicitly via obs_trace.enable() so that
+    # in-process servers never flip tracing globally.
+    obs_trace.enable()
     cache = FSCache(cache_dir) if cache_dir else MemoryCache()
     httpd = make_http_server(
         addr, cache, token, db_dir, cache_dir, serve_config=serve_config,
         secret_config=secret_config, rules_cache_dir=rules_cache_dir,
         pipeline_depth=pipeline_depth, resident_chunks=resident_chunks,
-        profile_dir=profile_dir,
+        profile_dir=profile_dir, slo_config=slo_config,
+        flight_out=flight_out,
     )
     scan_server: ScanServer = httpd.scan_server
 
@@ -803,7 +906,7 @@ def start_background(
     addr: str, cache: ArtifactCache, token: str = "", db_dir: str = "",
     serve_config: ServeConfig | None = None, secret_engine_factory=None,
     secret_config: str = "", rules_cache_dir: str | None = None,
-    profile_dir: str = "",
+    profile_dir: str = "", slo_config: str = "", flight_out: str = "",
 ) -> tuple[ThreadingHTTPServer, threading.Thread]:
     """In-process server for tests (the §4 'multi-node without a cluster'
     pattern: integration_test.go:77-103 binds a real server on a free port)."""
@@ -814,6 +917,8 @@ def start_background(
         secret_config=secret_config,
         rules_cache_dir=rules_cache_dir,
         profile_dir=profile_dir,
+        slo_config=slo_config,
+        flight_out=flight_out,
     )
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()

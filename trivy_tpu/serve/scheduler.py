@@ -60,6 +60,7 @@ from trivy_tpu import lockcheck
 from trivy_tpu.deadline import ScanTimeoutError
 from trivy_tpu.obs import metrics as obs_metrics
 from trivy_tpu.obs import trace as obs_trace
+from trivy_tpu.obs.tenantmetrics import TenantMetrics
 from trivy_tpu.registry.manager import RulesetManager
 from trivy_tpu.tenancy.pool import ResidentRulesetPool, UnknownRulesetError
 from trivy_tpu.tenancy.qos import TenantAdmission, TenantQuota
@@ -73,6 +74,9 @@ class SecretBatch(list):
 
     ruleset_digest: str = ""
     ruleset_epoch: int = 0
+    # Per-phase timing breakdown, attached only when the ticket asked for
+    # it (X-Trivy-Explain); None costs nothing on the common path.
+    explain: dict | None = None
 
 
 class AdmissionError(RuntimeError):
@@ -117,6 +121,8 @@ class ServeConfig:
     tenant_burst: float = 0.0  # request bucket depth (0 = max(rps, 1))
     tenant_bytes_per_s: float = 0.0  # default per-tenant bytes/s (0 = off)
     tenant_bytes_burst: float = 0.0  # byte bucket depth (0 = 1s of rate)
+    # -- per-tenant observability (obs/tenantmetrics.py) -----------------
+    max_tenant_series: int = 16  # top-K tenants with own metric series
 
     def default_quota(self) -> TenantQuota:
         return TenantQuota(
@@ -146,6 +152,7 @@ class Ticket:
     enqueued_at: float
     trace_id: str = ""  # X-Trivy-Trace-Id from the request, "" = untraced
     ruleset_digest: str = ""  # lane key; "" = the default ruleset
+    explain: bool = False  # attach the per-phase breakdown to the result
 
 
 class _Lane:
@@ -245,6 +252,16 @@ class BatchScheduler:
             if ruleset_loader is not None
             else None
         )
+        # Tenant/digest-labelled families behind the cardinality governor
+        # (obs/tenantmetrics.py): always on — the governor is O(1) per
+        # event and K=0 degrades to the "_other" rollup only.
+        self.tenant_metrics = TenantMetrics(
+            self.registry, max_tenant_series=self.config.max_tenant_series
+        )
+        # Breach incident capture (obs/flight.py): the server attaches its
+        # recorder so deadline expiries captured here land in the same ring
+        # as RPC-side breaches.  None = recording off (standalone use).
+        self.flight = None
         self._register_metrics()
 
     def _register_metrics(self) -> None:
@@ -344,6 +361,7 @@ class BatchScheduler:
         timeout_s: float | None = None,
         trace_id: str = "",
         ruleset_digest: str = "",
+        explain: bool = False,
     ) -> Future:
         """Enqueue one request's items; returns a Future resolving to the
         per-item list[Secret].  Raises AdmissionError subclasses instead of
@@ -363,6 +381,7 @@ class BatchScheduler:
             enqueued_at=now,
             trace_id=trace_id,
             ruleset_digest=ruleset_digest,
+            explain=explain,
         )
         # QoS first (cheapest, and the only per-tenant *rate* control —
         # everything below protects the server, this protects tenants
@@ -374,6 +393,7 @@ class BatchScheduler:
         if wait_s > 0:
             self.stats.rejected_quota += 1
             self._m_rejected.labels(reason="quota").inc()
+            self.tenant_metrics.reject(ticket.client_id, "quota")
             raise QuotaExceededError(
                 f"client {ticket.client_id!r} over its {reason} quota",
                 wait_s,
@@ -397,6 +417,7 @@ class BatchScheduler:
             if not self._admitting:
                 self.stats.rejected_closed += 1
                 self._m_rejected.labels(reason="closed").inc()
+                self.tenant_metrics.reject(ticket.client_id, "closed")
                 raise SchedulerClosedError(
                     "scheduler draining", cfg.retry_after_s
                 )
@@ -406,6 +427,7 @@ class BatchScheduler:
             ):
                 self.stats.rejected_full += 1
                 self._m_rejected.labels(reason="queue_full").inc()
+                self.tenant_metrics.reject(ticket.client_id, "queue_full")
                 raise QueueFullError(
                     f"admission queue full ({cfg.max_queue_depth} tickets)",
                     cfg.retry_after_s,
@@ -413,6 +435,7 @@ class BatchScheduler:
             if self._inflight.get(ticket.client_id, 0) >= inflight_cap:
                 self.stats.rejected_client += 1
                 self._m_rejected.labels(reason="client_cap").inc()
+                self.tenant_metrics.reject(ticket.client_id, "client_cap")
                 raise ClientOverloadedError(
                     f"client {ticket.client_id!r} at in-flight cap "
                     f"({inflight_cap})",
@@ -430,6 +453,7 @@ class BatchScheduler:
             lane.nbytes += ticket.nbytes
             self.stats.admitted += 1
             self._m_tickets.inc()
+            self.tenant_metrics.admit(ticket.client_id, ruleset_digest)
             if self._thread is None:
                 self._thread = threading.Thread(
                     target=self._run, name="serve-batcher", daemon=True
@@ -495,6 +519,21 @@ class BatchScheduler:
             ScanTimeoutError("request deadline expired before dispatch")
         )
         self._release(ticket)
+        if self.flight is not None:
+            # A deadline expiry IS the breach the flight recorder exists
+            # for: capture here, at expiry time, so the scheduler snapshot
+            # shows the queue state that starved the ticket (the handler's
+            # 408 lands ~30s later, after the state has moved on).  Runs
+            # outside every scheduler lock (capture re-takes them via
+            # snapshot_fn).
+            self.flight.capture(
+                trace_id=ticket.trace_id,
+                method="scan_secrets",
+                tenant=ticket.client_id,
+                code=408,
+                elapsed_s=max(0.0, time.monotonic() - ticket.enqueued_at),
+                reason="deadline",
+            )
 
     def _pick_lane(self, ready: list[_Lane]) -> _Lane:  # graftlint: holds(_lock)
         """Smooth weighted round-robin (the nginx upstream algorithm) over
@@ -598,12 +637,15 @@ class BatchScheduler:
         t0 = time.monotonic()
         combined: list[tuple[str, bytes]] = []
         spans: list[tuple[int, int]] = []
+        waits: list[float] = []
         for t in batch:
             spans.append((len(combined), len(combined) + len(t.items)))
             combined.extend(t.items)
             wait = max(0.0, t0 - t.enqueued_at)
+            waits.append(wait)
             self.stats.wait_s_sum += wait
             self._m_wait.observe(wait)
+            self.tenant_metrics.wait(t.client_id, wait)
             # The wait interval is only known now, at dispatch — record it
             # retroactively so the trace tree shows queue time per ticket.
             obs_trace.add_span(
@@ -674,13 +716,17 @@ class BatchScheduler:
                 trace_ids=[t.trace_id for t in batch if t.trace_id],
             ):
                 results = engine.scan_batch(combined)
+            phase_deltas: dict[str, float] = {}
             if phases_before is not None:
                 # SieveStats accumulates across scan_batch calls; the
                 # per-batch contribution is the before/after delta.
                 for attr, before in phases_before.items():
                     delta = float(getattr(estats, attr, 0.0)) - before
                     if delta > 0:
-                        self._m_phase.labels(phase=attr[:-2]).observe(delta)
+                        phase = attr[:-2]
+                        phase_deltas[phase] = delta
+                        self._m_phase.labels(phase=phase).observe(delta)
+                        self.tenant_metrics.phase(lane_digest, phase, delta)
         except ScanTimeoutError:
             for t in batch:
                 t.future.set_exception(
@@ -697,10 +743,33 @@ class BatchScheduler:
             return
         finally:
             _deadline.clear()
-        for t, (lo, hi) in zip(batch, spans):
+        batch_wall = time.monotonic() - t0
+        for t, (lo, hi), wait in zip(batch, spans, waits):
             out = SecretBatch(results[lo:hi])
             out.ruleset_digest = digest
             out.ruleset_epoch = epoch
+            if t.explain:
+                # Built from the same timing the span tree carries (queue
+                # wait + SieveStats phase deltas), so explain costs the
+                # asking ticket a dict and everyone else nothing.
+                out.explain = {
+                    "trace_id": t.trace_id,
+                    "queue_wait_ms": round(wait * 1e3, 3),
+                    "batch_wall_ms": round(batch_wall * 1e3, 3),
+                    "phases_ms": {
+                        k: round(v * 1e3, 3) for k, v in phase_deltas.items()
+                    },
+                    "batch": {
+                        "tickets": len(batch),
+                        "items": len(combined),
+                        "bytes": nbytes,
+                        "coalesced": len(batch) >= 2,
+                        "fill_ratio": round(fill, 4),
+                        "lane": lane_digest or "default",
+                        "ruleset_digest": digest,
+                        "ruleset_epoch": epoch,
+                    },
+                }
             t.future.set_result(out)
             self._release(t)
 
@@ -722,6 +791,43 @@ class BatchScheduler:
         return self.manager.epoch
 
     # -- observability ---------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Point-in-time scheduler state for flight-recorder capture: lane
+        depths, per-client inflight, pool residency, QoS bucket levels —
+        the context that explains why a breached request waited.  Locks
+        are taken strictly sequentially (scheduler, then pool, then qos),
+        never nested, so capture adds no lock-order edges."""
+        now = time.monotonic()
+        with self._lock:
+            lanes = {
+                (lane.digest or "default"): {
+                    "depth": len(lane.q),
+                    "queued_bytes": lane.nbytes,
+                    "window_open_ms": (
+                        round((now - lane.opened_at) * 1e3, 1)
+                        if lane.q
+                        else None
+                    ),
+                    "weight": lane.weight,
+                }
+                for lane in self._lanes.values()
+            }
+            inflight = dict(self._inflight)
+            admitting = self._admitting
+        out = {
+            "lanes": lanes,
+            "queue_depth": sum(l["depth"] for l in lanes.values()),
+            "inflight_per_client": inflight,
+            "admitting": admitting,
+        }
+        if self.pool is not None:
+            out["pool"] = [
+                {"digest": d, "epoch": e, "nbytes": n}
+                for d, e, n in self.pool.residents()
+            ]
+        out["qos"] = self.qos.snapshot(now)
+        return out
 
     def metrics_text(self) -> str:
         """Prometheus exposition for the serve subsystem.  When the server

@@ -221,6 +221,17 @@ class ResidentRulesetPool:
             "trivy_tpu_tenancy_pool_evictions_total",
             "LRU slots dropped to stay under the residency budget",
         )
+        # Live occupancy under the pool_* prefix the capacity dashboards
+        # key on (the tenancy_* pair above predates the naming split and
+        # stays for compatibility).
+        self._m_slots_used = registry.gauge(
+            "trivy_tpu_pool_slots_used",
+            "resident-ruleset slots currently occupied",
+        )
+        self._m_pool_bytes = registry.gauge(
+            "trivy_tpu_pool_resident_bytes",
+            "estimated device bytes pinned by occupied pool slots",
+        )
         registry.add_collect_hook(self._collect)
 
     def _collect(self) -> None:
@@ -228,6 +239,10 @@ class ResidentRulesetPool:
         lock (ints, monotonic — a torn read is a stale sample at worst)."""
         self._m_resident.set(self.resident_count())
         self._m_resident_bytes.set(self.resident_bytes())
+        # Floor-clamped like the server's inflight gauge: a scrape racing
+        # teardown must never expose a negative occupancy sample.
+        self._m_slots_used.set(max(0, self.resident_count()))
+        self._m_pool_bytes.set(max(0, self.resident_bytes()))
         self._m_hits.set_total(self.stats.hits)
         self._m_misses.set_total(self.stats.misses)
         self._m_admits.labels(source="warm").set_total(self.stats.warm_admits)

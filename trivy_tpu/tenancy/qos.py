@@ -136,6 +136,32 @@ class TenantAdmission:
         ServeConfig cap."""
         return self.quota(tenant).max_inflight
 
+    def snapshot(self, now: float) -> dict:
+        """Bucket levels at `now`, for flight-recorder capture.  Read-only:
+        refill is *computed* against `now`, never applied, so a snapshot
+        cannot perturb admission.  Only rate-limited tenants appear —
+        unlimited quotas never create buckets."""
+        with self._lock:
+            tenants: dict[str, dict] = {}
+            for kind, table in (
+                ("request", self._req_buckets),
+                ("byte", self._byte_buckets),
+            ):
+                for tenant, b in table.items():
+                    level = min(
+                        b.burst,
+                        b.tokens + max(0.0, now - b.updated) * b.rate,
+                    )
+                    entry = tenants.setdefault(tenant, {})
+                    entry[f"{kind}_tokens"] = round(level, 3)
+                    entry[f"{kind}_burst"] = b.burst
+            return {
+                "tenants": tenants,
+                "admitted": self.stats.admitted,
+                "rejected_requests": self.stats.rejected_requests,
+                "rejected_bytes": self.stats.rejected_bytes,
+            }
+
     # -- admission (request threads) -------------------------------------
 
     def _bucket(  # graftlint: holds(_lock)
