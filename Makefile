@@ -3,16 +3,18 @@
 
 PY ?= python
 
-.PHONY: test lint lint-changed lockcheck smoke serve-smoke obs-smoke slo-smoke tenancy-smoke bench bench-link checks-corpus rules-cache
+.PHONY: test lint lint-changed lockcheck smoke serve-smoke obs-smoke slo-smoke tenancy-smoke bench bench-link checks-corpus rules-cache perf-gate
 
 # Tier-1: the suite the driver holds the repo to (fast, CPU, no slow marks).
 # Lint runs first — a graftlint finding fails the build before pytest
-# collection starts, and costs ~2s when clean.
+# collection starts, and costs ~2s when clean.  The perf gate rides the
+# fast path too: one smoke bench run vs the checked-in baseline.
 test: lint
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
+	$(MAKE) perf-gate
 
-# Static analysis: graftlint (project rules GL001-GL006, always available)
+# Static analysis: graftlint (project rules GL001-GL008, always available)
 # plus ruff + mypy when the environment has them (the pinned CI container
 # may not; config lives in pyproject.toml either way).
 lint:
@@ -96,6 +98,24 @@ tenancy-smoke:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
 		BENCH_IMAGE=0 BENCH_OBS=0 $(PY) bench.py --smoke
+
+# Performance regression gate: one smoke bench run (heavy sections off,
+# primary corpus only) appends to a throwaway ledger, then
+# `trivy-tpu perf gate` holds it against the checked-in baseline
+# (tools/perfgate/baseline.json) and exits non-zero on any metric
+# outside its per-metric tolerance.  After an INTENTIONAL perf change,
+# refresh the baseline per tools/perfgate/README.md.
+perf-gate:
+	rm -f /tmp/trivy-tpu-perf-ledger.jsonl && \
+	BENCH_LEDGER_FILE=/tmp/trivy-tpu-perf-ledger.jsonl \
+		BENCH_DETAIL_FILE=/tmp/trivy-tpu-perf-detail.json \
+		BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 \
+		BENCH_HITDENSE=0 BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 \
+		BENCH_LICENSE=0 BENCH_IMAGE=0 BENCH_TENANT=0 BENCH_OBS=0 \
+		JAX_PLATFORMS=cpu $(PY) bench.py --smoke >/dev/null && \
+	JAX_PLATFORMS=cpu $(PY) -m trivy_tpu.cli perf gate \
+		--ledger /tmp/trivy-tpu-perf-ledger.jsonl \
+		--baseline tools/perfgate/baseline.json
 
 # Full benchmark (honest corpora; on CPU this takes a while).
 bench:

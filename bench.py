@@ -1319,6 +1319,17 @@ def _emit(detail: dict, error: str | None = None) -> None:
     json.loads(line)  # the one line must parse — validate before printing
     sys.stdout.write(line + "\n")
     sys.stdout.flush()
+    # Ledger append AFTER the stdout contract is satisfied: the same
+    # compact payload, wrapped with git sha / platform / rc so runs are
+    # comparable over time (`trivy-tpu perf report|diff|gate`).  append()
+    # never raises and never prints; a broken ledger must not fail a
+    # bench that already emitted its line.
+    try:
+        from trivy_tpu.obs import perfledger
+
+        perfledger.append(payload, rc=1 if error is not None else 0)
+    except Exception:
+        pass
 
 
 def main() -> None:

@@ -1,0 +1,155 @@
+"""`GET /debug` index + the /debug/gate audit surface end to end.
+
+Boots the real server around a hybrid engine whose gate actually priced
+the link (narrow relay profile, so the decision is "link-narrow" and the
+scan safely stays on the host DFA), then asserts the acceptance loop:
+the same decision record — with the cost-model inputs it used — is
+readable from `GET /debug/gate`, lands inside the flight capture of a
+breached request, rides the `--explain` echo, and tallies into
+`trivy_tpu_hybrid_gate_decision_total` on /metrics.  The `/debug` index
+must list every registered debug route (source-scan regression test) so
+new surfaces cannot ship undiscoverable.
+"""
+
+import json
+import re
+import urllib.request
+
+import pytest
+
+from trivy_tpu.cache.store import MemoryCache
+from trivy_tpu.engine import hybrid
+from trivy_tpu.engine.hybrid import GATE_EFF_MB_S, HybridSecretEngine
+from trivy_tpu.obs import gatelog
+from trivy_tpu.obs import metrics as obs_metrics
+from trivy_tpu.obs import trace as obs_trace
+from trivy_tpu.rpc import server as rpc_server
+from trivy_tpu.rpc.client import RpcClient
+from trivy_tpu.rpc.server import DEBUG_SURFACES, start_background
+from trivy_tpu.serve import ServeConfig
+
+SECRET_FILE = b"AWS_ACCESS_KEY_ID=AKIAQ6FAKEKEY1234567\n"
+
+
+@pytest.fixture
+def gate_server(monkeypatch, tmp_path):
+    # Price the gate for real: pretend a device exists, pin the narrow
+    # relay link profile.  auto -> link-narrow -> host DFA, so the scan
+    # itself never needs device kernels.
+    monkeypatch.setattr(hybrid, "_tpu_default_backend", lambda: True)
+    monkeypatch.setenv("TRIVY_TPU_LINK", "relay")
+    gatelog.clear()
+    obs_metrics.drain_device_phases()
+    engine = HybridSecretEngine(verify="auto")
+    assert engine.verify == "dfa"
+
+    slo_yaml = tmp_path / "slo.yaml"
+    slo_yaml.write_text(
+        "methods:\n"
+        "  scan_secrets:\n"
+        "    latency_threshold_s: 0.001\n"  # batching window alone breaches
+        "    latency_target: 0.5\n"
+    )
+    obs_trace.enable()
+    obs_trace.clear()
+    httpd, _ = start_background(
+        "localhost:0",
+        MemoryCache(),
+        serve_config=ServeConfig(batch_window_ms=5.0),
+        secret_engine_factory=lambda: engine,
+        slo_config=str(slo_yaml),
+    )
+    addr = f"{httpd.server_address[0]}:{httpd.server_address[1]}"
+    yield addr, engine
+    httpd.scan_server.scheduler.close()
+    httpd.shutdown()
+    httpd.server_close()
+    obs_trace.disable()
+    obs_trace.clear()
+    gatelog.clear()
+    obs_metrics.drain_device_phases()
+
+
+def _get_json(addr, path):
+    with urllib.request.urlopen(f"http://{addr}{path}", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _get_text(addr, path):
+    with urllib.request.urlopen(f"http://{addr}{path}", timeout=10) as r:
+        return r.read().decode()
+
+
+def test_debug_index_lists_every_registered_route():
+    """Every `route == "/debug/..."` handler in server.py must appear in
+    DEBUG_SURFACES — the index is the discovery surface, and a route
+    missing from it is effectively unshipped."""
+    src = open(rpc_server.__file__).read()
+    handled = set(re.findall(r'route == "(/debug/[^"]+)"', src))
+    assert handled, "source scan must find the debug route handlers"
+    assert handled == set(DEBUG_SURFACES)
+    assert all(desc for desc in DEBUG_SURFACES.values())
+
+
+def test_debug_surfaces_end_to_end(gate_server):
+    addr, engine = gate_server
+    gd = engine.gate_decision
+    assert gd["reason"] == "link-narrow"
+
+    client = RpcClient(addr)
+    items = [("creds.env", SECRET_FILE), ("plain.txt", b"nothing here\n")]
+    explained = client.scan_secrets(items, client_id="A", explain=True)
+    for _ in range(2):
+        assert client.scan_secrets(items, client_id="A")["Secrets"]
+
+    # -- /debug index: lists all surfaces, each answers 200 JSON ----------
+    idx = _get_json(addr, "/debug")
+    assert idx["surfaces"] == DEBUG_SURFACES
+    assert _get_json(addr, "/debug/")["surfaces"] == DEBUG_SURFACES
+    for route in idx["surfaces"]:
+        assert isinstance(_get_json(addr, route), dict), route
+
+    # -- /debug/gate: decision records WITH cost-model inputs -------------
+    gate = _get_json(addr, "/debug/gate")
+    assert gate["decisions"], "engine construction must have audited"
+    rec = gate["decisions"][0]  # newest first
+    assert rec["seq"] == gd["seq"]
+    assert rec["requested"] == "auto"
+    assert rec["backend"] == "dfa"
+    assert rec["reason"] == "link-narrow"
+    assert rec["link"]["mb_per_sec"] == 50.0
+    assert rec["link"]["rtt_s"] == 0.1
+    assert rec["link"]["eff_mb_per_sec"] < GATE_EFF_MB_S
+    assert rec["thresholds"]["eff_mb_per_sec"] == GATE_EFF_MB_S
+    assert rec["margin"] < 0
+    assert gate["tallies"]["dfa/link-narrow"] >= 1
+    assert len(_get_json(addr, "/debug/gate?limit=1")["decisions"]) == 1
+
+    # -- the SAME record inside a breached request's flight capture -------
+    fl = _get_json(addr, "/debug/flight")
+    assert fl["records"], "1ms objective vs 5ms batch window must breach"
+    breach = fl["records"][0]
+    assert breach["reason"] == "latency"
+    assert any(g.get("seq") == gd["seq"] for g in breach["gate"]), (
+        "flight capture must carry the gate decision that routed this "
+        "process's verification"
+    )
+
+    # -- and on the --explain echo ----------------------------------------
+    exp = explained.get("Explain")
+    assert exp and exp["gate"]["reason"] == "link-narrow"
+    assert exp["gate"]["link"]["mb_per_sec"] == 50.0
+
+    # -- /metrics: decision tallies + margin gauge ------------------------
+    text = _get_text(addr, "/metrics")
+    assert "trivy_tpu_hybrid_gate_decision_total" in text
+    assert 'reason="link-narrow"' in text
+    assert "trivy_tpu_hybrid_gate_margin" in text
+
+    # -- device-phase histogram appears once sections report --------------
+    obs_metrics.record_device_phase("sieve-step", 0.0015)
+    obs_metrics.record_device_phase("encode", 0.0002)
+    text = _get_text(addr, "/metrics")
+    assert "trivy_tpu_device_phase_seconds" in text
+    assert 'kernel="sieve-step"' in text
+    assert 'kernel="encode"' in text

@@ -1,0 +1,128 @@
+"""Device-phase attribution (obs/metrics.py device_phase + engine hooks).
+
+The contract has two halves.  Disabled (the default): `device_phase()`
+returns a shared no-op handle — one predicate, no span, no fence, no
+sample — so the pipelined engines keep their async overlap and the
+BENCH_OBS <2% bound.  Enabled: each handle opens a `kernel.<name>` span
+nested under the ambient chunk span, fences on the section's output
+arrays at `.done()`, and queues a (kernel, seconds) sample for the
+server's collect hook to drain into
+`trivy_tpu_device_phase_seconds{kernel}`.
+"""
+
+import pytest
+
+from trivy_tpu.obs import metrics as obs_metrics
+from trivy_tpu.obs import trace as obs_trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    obs_trace.disable()
+    obs_trace.clear()
+    obs_metrics.drain_device_phases()
+    yield
+    obs_trace.disable()
+    obs_trace.clear()
+    obs_metrics.drain_device_phases()
+
+
+def test_disabled_path_is_shared_noop():
+    ph1 = obs_metrics.device_phase("encode")
+    ph2 = obs_metrics.device_phase("sieve-step")
+    assert ph1 is ph2  # one shared object: no per-call allocation
+    assert ph1.done() == 0.0
+    assert obs_metrics.drain_device_phases() == []
+    assert obs_trace.snapshot() == []
+
+
+def test_enabled_records_sample_and_span():
+    obs_trace.enable()
+    ph = obs_metrics.device_phase("compact")
+    dt = ph.done()
+    assert dt >= 0.0
+    samples = obs_metrics.drain_device_phases()
+    assert len(samples) == 1
+    kernel, seconds = samples[0]
+    assert kernel == "compact"
+    assert seconds == dt
+    names = [s.name for s in obs_trace.snapshot()]
+    assert "kernel.compact" in names
+
+
+def test_done_fences_output_arrays():
+    obs_trace.enable()
+
+    class FakeArray:
+        def __init__(self):
+            self.fenced = 0
+
+        def block_until_ready(self):
+            self.fenced += 1
+
+    a, b = FakeArray(), FakeArray()
+    ph = obs_metrics.device_phase("sieve-step")
+    ph.done((a, b))  # one level of tuple flattening
+    assert a.fenced == 1 and b.fenced == 1
+
+    class BrokenArray:
+        def block_until_ready(self):
+            raise RuntimeError("device gone")
+
+    ph = obs_metrics.device_phase("sieve-step")
+    ph.done(BrokenArray())  # a failed fence degrades timing, never raises
+    assert len(obs_metrics.drain_device_phases()) == 2
+
+
+def test_pending_queue_is_bounded():
+    cap = obs_metrics._DEVICE_PHASE_MAX_PENDING
+    for i in range(cap + 100):
+        obs_metrics.record_device_phase("encode", float(i))
+    samples = obs_metrics.drain_device_phases()
+    assert len(samples) == cap
+    # oldest dropped, newest kept
+    assert samples[-1][1] == float(cap + 99)
+    assert samples[0][1] == 100.0
+
+
+def test_device_engine_attributes_kernels_when_traced():
+    from trivy_tpu.engine.device import TpuSecretEngine
+
+    eng = TpuSecretEngine(resident_chunks=0)
+    items = [
+        (f"f{i}.txt", b"AWS_ACCESS_KEY_ID=AKIAQ6FAKEKEY1234567\n" + b"x" * 200)
+        for i in range(8)
+    ]
+
+    eng.scan_batch(list(items))  # untraced: no samples, no fences
+    assert obs_metrics.drain_device_phases() == []
+
+    obs_trace.enable()
+    results = eng.scan_batch(list(items))
+    samples = obs_metrics.drain_device_phases()
+    obs_trace.disable()
+
+    assert any(len(r.findings) for r in results)
+    kernels = {k for k, _ in samples}
+    assert kernels, "traced run must attribute at least one kernel section"
+    assert kernels <= set(obs_metrics.DEVICE_PHASE_KERNELS)
+    assert "sieve-step" in kernels
+    assert all(s >= 0.0 for _, s in samples)
+
+
+def test_hybrid_device_verify_stream_attributed(monkeypatch):
+    from trivy_tpu.engine.hybrid import HybridSecretEngine
+
+    try:
+        eng = HybridSecretEngine(verify="device")
+    except NotImplementedError:
+        pytest.skip("device NFA verify unavailable on this host")
+    items = [
+        ("creds.env", b"AWS_ACCESS_KEY_ID=AKIAQ6FAKEKEY1234567\n"),
+        ("plain.txt", b"nothing to see\n" * 20),
+    ]
+    obs_trace.enable()
+    eng.scan_batch(list(items))
+    samples = obs_metrics.drain_device_phases()
+    obs_trace.disable()
+    assert any(k == "verify-stream" for k, _ in samples)

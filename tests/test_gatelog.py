@@ -1,0 +1,184 @@
+"""Hybrid-gate decision audit (trivy_tpu/obs/gatelog.py + engine/hybrid.py).
+
+Every gate resolution — auto pricing the link, a forced backend, the
+no-device short-circuit, the device->dfa fallback — must land one
+structured record carrying the cost-model terms the decision actually
+used, so "why did this process verify on the DFA" is answerable from a
+running server (`GET /debug/gate`), a breach capture, or `--explain`
+without re-deriving the economics by hand.
+"""
+
+import pytest
+
+from trivy_tpu.engine import hybrid
+from trivy_tpu.engine.hybrid import (
+    GATE_EFF_MB_S,
+    GATE_RTT_S,
+    HybridSecretEngine,
+    gate_terms,
+)
+from trivy_tpu.obs import gatelog
+
+
+@pytest.fixture(autouse=True)
+def _clean_gatelog():
+    gatelog.clear()
+    yield
+    gatelog.clear()
+
+
+# -- the log itself ---------------------------------------------------------
+
+
+def test_record_minimal_and_full():
+    bare = gatelog.record(requested="dfa", backend="dfa", reason="forced")
+    assert bare["seq"] == 1
+    assert bare["requested"] == "dfa"
+    assert bare["backend"] == "dfa"
+    assert bare["reason"] == "forced"
+    assert bare["margin"] is None
+    assert "link" not in bare and "thresholds" not in bare
+
+    full = gatelog.record(
+        requested="auto", backend="device", reason="link-wide",
+        link_mb_per_sec=10_000.0, link_rtt_s=1e-4,
+        h2d_ratio=1.0, d2h_ratio=0.15,
+        eff_mb_per_sec=11_000.0,
+        eff_threshold_mb_per_sec=GATE_EFF_MB_S,
+        rtt_threshold_s=GATE_RTT_S,
+        codec="auto", margin=0.99,
+    )
+    assert full["seq"] == 2
+    assert full["link"]["mb_per_sec"] == 10_000.0
+    assert full["link"]["d2h_ratio"] == 0.15
+    assert full["thresholds"] == {
+        "eff_mb_per_sec": GATE_EFF_MB_S, "rtt_s": GATE_RTT_S,
+    }
+    assert full["margin"] == 0.99
+
+
+def test_records_newest_first_and_limit():
+    for i in range(5):
+        gatelog.record(requested="auto", backend="dfa", reason="no-device")
+    recs = gatelog.records()
+    assert [r["seq"] for r in recs] == [5, 4, 3, 2, 1]
+    assert [r["seq"] for r in gatelog.records(limit=2)] == [5, 4]
+    assert gatelog.last()["seq"] == 5
+
+
+def test_tallies_survive_ring_eviction():
+    n = gatelog.DEFAULT_CAPACITY + 50
+    for _ in range(n):
+        gatelog.record(requested="auto", backend="dfa", reason="link-narrow")
+    assert len(gatelog.records()) == gatelog.DEFAULT_CAPACITY
+    assert gatelog.tallies() == {("dfa", "link-narrow"): n}
+
+
+def test_last_margin_skips_unpriced_decisions():
+    assert gatelog.last_margin() is None
+    gatelog.record(
+        requested="auto", backend="dfa", reason="link-narrow", margin=-0.4
+    )
+    gatelog.record(requested="dfa", backend="dfa", reason="forced")
+    assert gatelog.last_margin() == -0.4
+
+
+def test_clear_resets_everything():
+    gatelog.record(requested="dfa", backend="dfa", reason="forced")
+    gatelog.clear()
+    assert gatelog.records() == []
+    assert gatelog.tallies() == {}
+    assert gatelog.record(
+        requested="dfa", backend="dfa", reason="forced"
+    )["seq"] == 1
+
+
+# -- gate_terms: the priced decision ----------------------------------------
+
+
+def test_gate_terms_wide_link(monkeypatch):
+    monkeypatch.setenv("TRIVY_TPU_LINK", "wide")
+    terms = gate_terms()
+    assert terms["link_mb_per_sec"] == 10_000.0
+    assert terms["wide"] is True
+    assert terms["margin"] > 0
+    assert terms["eff_threshold_mb_per_sec"] == GATE_EFF_MB_S
+
+
+def test_gate_terms_narrow_link(monkeypatch):
+    monkeypatch.setenv("TRIVY_TPU_LINK", "relay")
+    terms = gate_terms()
+    assert terms["link_mb_per_sec"] == 50.0
+    assert terms["wide"] is False
+    assert terms["margin"] < 0
+
+
+# -- engine integration -----------------------------------------------------
+
+
+def test_forced_backend_records_decision():
+    eng = HybridSecretEngine(verify="dfa")
+    gd = eng.gate_decision
+    assert gd["requested"] == "dfa"
+    assert gd["backend"] == "dfa"
+    assert gd["reason"] == "forced"
+    assert gatelog.last()["seq"] == gd["seq"]
+
+
+def test_auto_without_device_records_no_device(monkeypatch):
+    monkeypatch.setattr(hybrid, "_tpu_default_backend", lambda: False)
+    eng = HybridSecretEngine(verify="auto")
+    assert eng.verify == "dfa"
+    gd = eng.gate_decision
+    assert gd["reason"] == "no-device"
+    assert gd["requested"] == "auto"
+    assert "link" not in gd  # never priced the link
+
+
+def test_auto_narrow_link_records_cost_model_terms(monkeypatch):
+    monkeypatch.setattr(hybrid, "_tpu_default_backend", lambda: True)
+    monkeypatch.setenv("TRIVY_TPU_LINK", "relay")
+    eng = HybridSecretEngine(verify="auto")
+    assert eng.verify == "dfa"
+    gd = eng.gate_decision
+    assert gd["reason"] == "link-narrow"
+    assert gd["backend"] == "dfa"
+    assert gd["link"]["mb_per_sec"] == 50.0
+    assert gd["link"]["rtt_s"] == 0.1
+    assert gd["link"]["eff_mb_per_sec"] < GATE_EFF_MB_S
+    assert gd["thresholds"]["eff_mb_per_sec"] == GATE_EFF_MB_S
+    assert gd["margin"] < 0
+
+
+def test_auto_wide_link_records_device_decision(monkeypatch):
+    monkeypatch.setattr(hybrid, "_tpu_default_backend", lambda: True)
+    monkeypatch.setenv("TRIVY_TPU_LINK", "wide")
+    eng = HybridSecretEngine(verify="auto")
+    gd = eng.gate_decision
+    if eng.verify == "device":
+        assert gd["reason"] == "link-wide"
+        assert gd["margin"] > 0
+        assert gd["link"]["eff_mb_per_sec"] >= GATE_EFF_MB_S
+    else:
+        # device NFA unavailable in this environment: auto falls back and
+        # the fallback itself must be audited with its error.
+        assert gd["reason"] == "fallback"
+        assert gd["backend"] == "dfa"
+        assert gd["error"]
+
+
+def test_explain_carries_gate_decision():
+    from trivy_tpu.serve import BatchScheduler, ServeConfig
+
+    eng = HybridSecretEngine(verify="dfa")
+    sched = BatchScheduler(lambda: eng, ServeConfig(batch_window_ms=2.0))
+    try:
+        out = sched.submit(
+            [("a.txt", b"nothing here\n")], client_id="t", explain=True
+        ).result()
+        gate = out.explain["gate"]
+        assert gate["backend"] == "dfa"
+        assert gate["reason"] == "forced"
+        sched.drain(timeout=10)
+    finally:
+        sched.close()

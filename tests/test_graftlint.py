@@ -26,7 +26,9 @@ from tools.graftlint.core import (
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(ROOT, "tools", "graftlint", "fixtures")
-ALL_RULES = ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007")
+ALL_RULES = (
+    "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007", "GL008",
+)
 
 
 def _lint_fixture(name: str):
@@ -69,6 +71,7 @@ def test_deny_fixture_counts_stable():
         "GL005": 4,
         "GL006": 3,
         "GL007": 4,
+        "GL008": 4,
     }
 
 

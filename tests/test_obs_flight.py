@@ -70,6 +70,61 @@ def test_span_tree_filters_by_trace_and_rebases_time(tracing):
     assert rec.capture(method="m", reason="error")["spans"] == []
 
 
+def test_out_path_rotation_caps_disk(tmp_path):
+    """--flight-out must not grow without bound: when the active file
+    would exceed the cap, it rotates to a single `.1` backup (overwriting
+    the previous one, whose records are counted as dropped)."""
+    from trivy_tpu.obs import metrics as obs_metrics
+
+    out = tmp_path / "flight.jsonl"
+    reg = obs_metrics.Registry()
+    # ~1KB cap: each capture is a few hundred bytes, so 20 captures force
+    # several rotations.
+    rec = FlightRecorder(
+        capacity=64, out_path=str(out), out_max_mb=0.001, registry=reg
+    )
+    n = 20
+    for i in range(n):
+        rec.capture(method="m", code=408, reason="deadline", elapsed_s=i)
+
+    backup = tmp_path / "flight.jsonl.1"
+    assert backup.exists(), "cap must have forced at least one rotation"
+    assert out.stat().st_size <= rec.out_max_bytes
+    assert rec.dropped > 0
+
+    # conservation: every capture is live, in the backup, or counted dropped
+    live = len(out.read_text().strip().splitlines())
+    kept = len(backup.read_text().strip().splitlines())
+    assert live + kept + rec.dropped == n
+    assert (
+        f'trivy_tpu_flight_dropped_total {rec.dropped}' in reg.render()
+    )
+
+
+def test_out_path_rotation_disabled_by_zero_cap(tmp_path):
+    out = tmp_path / "flight.jsonl"
+    rec = FlightRecorder(out_path=str(out), out_max_mb=0.0)
+    for i in range(20):
+        rec.capture(method="m", reason="deadline", elapsed_s=i)
+    assert not (tmp_path / "flight.jsonl.1").exists()
+    assert len(out.read_text().strip().splitlines()) == 20
+    assert rec.dropped == 0
+
+
+def test_gate_fn_embeds_decisions_and_never_raises():
+    rec = FlightRecorder(gate_fn=lambda: [{"seq": 7, "backend": "dfa"}])
+    r = rec.capture(method="m", reason="latency")
+    assert r["gate"] == [{"seq": 7, "backend": "dfa"}]
+
+    def boom():
+        raise RuntimeError("gatelog mid-teardown")
+
+    r = FlightRecorder(gate_fn=boom).capture(method="m", reason="latency")
+    assert r["gate"] == [{"error": "RuntimeError: gatelog mid-teardown"}]
+    # no gate_fn at all -> plain empty list, key always present
+    assert FlightRecorder().capture(method="m", reason="e")["gate"] == []
+
+
 def test_metrics_family_counts_reasons():
     from trivy_tpu.obs import metrics as obs_metrics
 

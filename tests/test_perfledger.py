@@ -1,0 +1,276 @@
+"""Bench ledger + regression gate (obs/perfledger.py, `trivy-tpu perf`).
+
+The ledger is append-only JSONL: one entry per bench run wrapping the
+same compact payload the bench printed, plus provenance (git sha,
+platform, rc, timestamp).  `perf gate` holds the latest entry against a
+checked-in baseline and must fail on an artificially regressed baseline
+— that failure IS the CI tripwire `make perf-gate` relies on.  bench.py's
+single-line stdout contract is re-asserted here against the ledger hook:
+the hook runs after the line is flushed and must never widen it.
+"""
+
+import argparse
+import json
+
+import pytest
+
+from trivy_tpu.obs import perfledger
+
+PAYLOAD = {
+    "metric": "secret_scan_files_per_sec",
+    "value": 25000.0,
+    "unit": "files/s",
+    "ruleset_digest": "abc123",
+    "vs_baseline": 19.5,
+    "detail": {
+        "files": 400,
+        "files_per_sec": 25000.0,
+        "mb_per_sec": 107.0,
+        "findings": 1,
+        "smoke": True,
+    },
+}
+
+
+def _baseline(value, tolerance=0.5, direction="higher", metric="value"):
+    return {"schema": 1, "metrics": {
+        metric: {
+            "baseline": value, "tolerance": tolerance, "direction": direction,
+        },
+    }}
+
+
+# -- append / read ----------------------------------------------------------
+
+
+def test_append_read_round_trip(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    entry = perfledger.append(PAYLOAD, rc=0, path=path)
+    assert entry["schema"] == perfledger.SCHEMA
+    assert entry["rc"] == 0
+    assert entry["ruleset_digest"] == "abc123"
+    assert entry["bench"]["value"] == 25000.0
+
+    perfledger.append(PAYLOAD, rc=1, path=path)
+    entries = perfledger.read(path)
+    assert len(entries) == 2  # append-only: both runs survive
+    assert [e["rc"] for e in entries] == [0, 1]
+    assert entries[0]["ts"] <= entries[1]["ts"]
+
+
+def test_empty_env_disables_ledger(tmp_path, monkeypatch):
+    monkeypatch.setenv("BENCH_LEDGER_FILE", "")
+    assert perfledger.ledger_path() == ""
+    assert perfledger.append(PAYLOAD) is None
+
+
+def test_read_skips_malformed_lines(tmp_path):
+    path = tmp_path / "ledger.jsonl"
+    perfledger.append(PAYLOAD, path=str(path))
+    with open(path, "a") as f:
+        f.write('{"truncated by a kill -9\n')
+        f.write("not json at all\n")
+    perfledger.append(PAYLOAD, path=str(path))
+    assert len(perfledger.read(str(path))) == 2
+
+
+def test_append_never_raises(tmp_path):
+    # unwritable path: directory as file target
+    assert perfledger.append(PAYLOAD, path=str(tmp_path)) is None
+
+
+# -- flatten / diff ---------------------------------------------------------
+
+
+def test_flatten_dotted_numeric_leaves():
+    flat = perfledger.flatten({"bench": PAYLOAD})
+    assert flat["value"] == 25000.0
+    assert flat["detail.mb_per_sec"] == 107.0
+    assert "detail.smoke" not in flat  # bools excluded
+    assert "metric" not in flat  # strings excluded
+
+
+def test_diff_reports_biggest_movers_first():
+    base = {"bench": {"a": 100.0, "b": 10.0, "only_base": 1.0}}
+    head = {"bench": {"a": 110.0, "b": 30.0, "only_head": 2.0}}
+    rows = perfledger.diff(base, head)
+    by_metric = {r["metric"]: r for r in rows}
+    assert by_metric["a"]["pct"] == 10.0
+    assert by_metric["b"]["pct"] == 200.0
+    assert rows[0]["metric"] == "b"  # 200% beats 10%
+    assert by_metric["only_base"]["head"] is None
+    assert by_metric["only_head"]["base"] is None
+
+
+# -- gate -------------------------------------------------------------------
+
+
+def test_gate_passes_within_tolerance():
+    entry = {"rc": 0, "bench": PAYLOAD}
+    failures, checked = perfledger.gate(entry, _baseline(30000.0, 0.5))
+    assert failures == []
+    assert len(checked) == 1
+    assert checked[0]["metric"] == "value"
+
+
+def test_gate_fails_on_regressed_baseline():
+    # Artificially regressed: baseline says 10x the run's throughput with
+    # a tight tolerance — the gate MUST fire (acceptance criterion).
+    entry = {"rc": 0, "bench": PAYLOAD}
+    failures, _ = perfledger.gate(entry, _baseline(250000.0, 0.1))
+    assert len(failures) == 1
+    assert failures[0]["metric"] == "value"
+    assert failures[0]["reason"] == "outside tolerance"
+
+
+def test_gate_direction_lower():
+    entry = {"rc": 0, "bench": {"detail": {"wall_s": 2.0}}}
+    ok, _ = perfledger.gate(
+        entry, _baseline(2.5, 0.2, "lower", "detail.wall_s")
+    )
+    assert ok == []
+    bad, _ = perfledger.gate(
+        entry, _baseline(1.0, 0.2, "lower", "detail.wall_s")
+    )
+    assert len(bad) == 1
+
+
+def test_gate_skips_absent_metrics():
+    entry = {"rc": 0, "bench": {"value": 1.0}}
+    failures, checked = perfledger.gate(
+        entry, _baseline(100.0, 0.1, metric="detail.not_measured")
+    )
+    assert failures == [] and checked == []
+
+
+def test_gate_fails_nonzero_rc():
+    entry = {"rc": 1, "bench": {"error": "OracleError: boom"}}
+    failures, _ = perfledger.gate(entry, _baseline(1.0))
+    assert any(f["metric"] == "rc" for f in failures)
+
+
+# -- the perf CLI -----------------------------------------------------------
+
+
+def _ns(**kw):
+    return argparse.Namespace(**kw)
+
+
+def _seeded_ledger(tmp_path, n=2):
+    path = str(tmp_path / "ledger.jsonl")
+    for i in range(n):
+        p = json.loads(json.dumps(PAYLOAD))
+        p["value"] = 25000.0 + 1000.0 * i
+        perfledger.append(p, path=path)
+    return path
+
+
+def test_cli_report(tmp_path, capsys):
+    from trivy_tpu.commands.perf import run_perf
+
+    path = _seeded_ledger(tmp_path, n=3)
+    rc = run_perf(_ns(perf_command="report", ledger=path, limit=2))
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "FILES/S" in out
+    assert out.count("\n") == 3  # header + 2 rows (limit honored)
+
+
+def test_cli_diff(tmp_path, capsys):
+    from trivy_tpu.commands.perf import run_perf
+
+    path = _seeded_ledger(tmp_path)
+    rc = run_perf(_ns(perf_command="diff", ledger=path, base=-2, head=-1))
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "value" in out and "+4.00%" in out
+
+
+def test_cli_gate_pass_and_fail(tmp_path, capsys):
+    from trivy_tpu.commands.perf import run_perf
+
+    path = _seeded_ledger(tmp_path)
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_baseline(25000.0, 0.5)))
+    assert run_perf(_ns(
+        perf_command="gate", ledger=path, baseline=str(good)
+    )) == 0
+    regressed = tmp_path / "bad.json"
+    regressed.write_text(json.dumps(_baseline(500000.0, 0.05)))
+    assert run_perf(_ns(
+        perf_command="gate", ledger=path, baseline=str(regressed)
+    )) == 1
+    capsys.readouterr()
+
+
+def test_cli_usage_errors(tmp_path, capsys):
+    from trivy_tpu.commands.perf import run_perf
+
+    missing = str(tmp_path / "nope.jsonl")
+    assert run_perf(_ns(perf_command="report", ledger=missing, limit=5)) == 2
+    assert run_perf(_ns(perf_command="gate", ledger=missing, baseline="")) == 2
+    assert run_perf(_ns(perf_command=None)) == 2
+    capsys.readouterr()
+
+
+def test_cli_parser_wires_perf(monkeypatch, tmp_path, capsys):
+    """`trivy-tpu perf gate --ledger ... --baseline ...` end to end
+    through the real argparse tree."""
+    from trivy_tpu import cli
+
+    path = _seeded_ledger(tmp_path)
+    baseline = tmp_path / "b.json"
+    baseline.write_text(json.dumps(_baseline(25000.0, 0.5)))
+    rc = cli.main([
+        "perf", "gate", "--ledger", path, "--baseline", str(baseline),
+    ])
+    assert rc == 0
+    assert "perf gate: ok" in capsys.readouterr().out
+
+
+# -- bench.py contract ------------------------------------------------------
+
+
+def test_bench_emit_appends_ledger_and_keeps_line_contract(
+    tmp_path, monkeypatch, capsys
+):
+    import bench
+
+    ledger = str(tmp_path / "ledger.jsonl")
+    monkeypatch.setenv("BENCH_LEDGER_FILE", ledger)
+    monkeypatch.setenv("BENCH_DETAIL_FILE", str(tmp_path / "detail.json"))
+
+    detail = {"files_per_sec": 123.4, "oracle_files_per_sec": 10.0,
+              "ruleset_digest": "d" * 16}
+    bench._emit(detail)
+    line = capsys.readouterr().out
+    assert line.count("\n") == 1  # exactly one line
+    assert len(line.encode()) <= bench.MAX_LINE_BYTES + 1
+    payload = json.loads(line)
+    assert payload["value"] == 123.4
+
+    entries = perfledger.read(ledger)
+    assert len(entries) == 1
+    assert entries[0]["rc"] == 0
+    assert entries[0]["bench"] == payload  # schema round-trip: same object
+    assert entries[0]["ruleset_digest"] == "d" * 16
+
+
+def test_bench_emit_error_path_appends_with_nonzero_rc(
+    tmp_path, monkeypatch, capsys
+):
+    import bench
+
+    ledger = str(tmp_path / "ledger.jsonl")
+    monkeypatch.setenv("BENCH_LEDGER_FILE", ledger)
+    monkeypatch.setenv("BENCH_DETAIL_FILE", str(tmp_path / "detail.json"))
+
+    bench._emit({}, error="OracleError: parity mismatch on x.py")
+    line = capsys.readouterr().out
+    payload = json.loads(line)
+    assert "parity mismatch" in payload["error"]
+
+    entries = perfledger.read(ledger)
+    assert len(entries) == 1
+    assert entries[0]["rc"] != 0
+    assert entries[0]["bench"]["error"] == payload["error"]

@@ -19,6 +19,8 @@ Rule catalogue (each with allow/deny fixtures under fixtures/):
          collect hooks
   GL007  label cardinality: identity-shaped metric label values not
          routed through the cardinality governor
+  GL008  duration-clock hygiene: durations computed by subtracting
+         wall-clock time.time() readings instead of perf_counter()
 
 The runtime complement is trivy_tpu/lockcheck.py (TRIVY_TPU_LOCKCHECK=1
 lock-order + owner-role sanitizer); graftlint checks what must hold by
@@ -35,6 +37,7 @@ from tools.graftlint import (  # noqa: E402,F401
     rules_jax,
     rules_labels,
     rules_threads,
+    rules_time,
 )
 
 __all__ = ["Finding", "lint_paths", "load_waivers"]

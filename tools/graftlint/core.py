@@ -177,6 +177,7 @@ def lint_module(mod: Module, rules: dict | None = None) -> list[Finding]:
         rules_jax,
         rules_labels,
         rules_threads,
+        rules_time,
     )
 
     out: list[Finding] = []

@@ -1,0 +1,33 @@
+"""GL008 allow fixture: legitimate wall-clock uses and monotonic durations."""
+
+import time
+
+# Epoch anchor (the trace module's idiom): wall minus MONOTONIC converts
+# perf_counter readings to epoch seconds — not a duration on the wall clock.
+_EPOCH_S = time.time() - time.perf_counter()
+
+
+def work():
+    pass
+
+
+def timestamp():
+    # A wall-clock reading that is never subtracted is a timestamp.
+    return {"captured_at": time.time()}
+
+
+def monotonic_duration():
+    t0 = time.perf_counter()
+    work()
+    return time.perf_counter() - t0
+
+
+def uptime(started_at_epoch):
+    # Delta against a stored cross-process timestamp: the wall clock is
+    # the only clock both processes share.  Out of scope.
+    return time.time() - started_at_epoch
+
+
+def anchored_stamp(perf_start):
+    # perf reading re-anchored to epoch: right operand is untainted.
+    return _EPOCH_S + perf_start - 0.0

@@ -1,0 +1,34 @@
+"""GL008 deny fixture: durations measured on the wall clock."""
+
+import time
+import time as walltime
+from time import time as now
+
+
+def work():
+    pass
+
+
+def classic_delta():
+    t0 = time.time()
+    work()
+    return time.time() - t0  # GL008: wall-clock duration
+
+
+def two_readings():
+    start = time.time()
+    work()
+    end = time.time()
+    return end - start  # GL008: both operands wall-clock names
+
+
+def module_alias():
+    t0 = walltime.time()
+    work()
+    return walltime.time() - t0  # GL008: aliased import, same clock
+
+
+def bare_import():
+    t0 = now()
+    work()
+    return now() - t0  # GL008: from-import alias, same clock

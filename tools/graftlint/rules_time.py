@@ -1,0 +1,114 @@
+"""Duration-clock hygiene.
+
+GL008: a duration must never be computed by subtracting two wall-clock
+readings.  ``time.time()`` follows the system clock — NTP slews, DST
+shifts, and operator `date` calls all land in the delta, and the bench
+ledger's round-over-round comparisons (and every latency histogram) are
+only as honest as the clock behind them.  ``time.perf_counter()`` is the
+monotonic high-resolution clock made for intervals.
+
+The rule flags a subtraction only when BOTH operands are wall-clock: a
+direct ``time.time()`` call, or a name assigned from one in the same
+scope.  That shape IS the duration idiom (``t0 = time.time(); ...;
+dt = time.time() - t0``) and nothing else:
+
+  * plain ``time.time()`` timestamps (``captured_at``, ledger ``ts``)
+    never appear in a subtraction — allowed;
+  * the trace module's epoch anchor ``time.time() - time.perf_counter()``
+    has a monotonic right operand — allowed without annotation;
+  * ``time.time() - stored_epoch`` (uptime against a cross-process
+    timestamp) has an untainted right operand — out of scope; the wall
+    clock is the only clock both processes share.
+
+Aliases are tracked (``import time as t``, ``from time import time as
+now``); taint does not cross function boundaries, so the rule stays
+cheap and cannot false-positive on unrelated locals.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import Finding, Module, dotted_name, rule
+
+
+def _clock_names(mod: Module) -> tuple[set[str], set[str]]:
+    """(module aliases for `time`, bare names bound to `time.time`)."""
+    mod_aliases: set[str] = set()
+    bare_time: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    mod_aliases.add(a.asname or a.name)
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name == "time":
+                    bare_time.add(a.asname or a.name)
+    return mod_aliases, bare_time
+
+
+def _scope_walk(scope: ast.AST):
+    """Walk `scope` without descending into nested function/lambda bodies
+    (their locals are a different scope)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@rule("GL008")
+def check_wall_clock_durations(mod: Module) -> list[Finding]:
+    mod_aliases, bare_time = _clock_names(mod)
+    if not mod_aliases and not bare_time:
+        return []
+
+    def is_wall_call(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        d = dotted_name(node.func)
+        if "." in d:
+            head, _, tail = d.rpartition(".")
+            return head in mod_aliases and tail == "time"
+        return d in bare_time
+
+    out: list[Finding] = []
+    scopes: list[ast.AST] = [mod.tree] + [
+        n
+        for n in ast.walk(mod.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for scope in scopes:
+        tainted: set[str] = set()
+        for node in _scope_walk(scope):
+            if isinstance(node, ast.Assign) and is_wall_call(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        tainted.add(tgt.id)
+
+        def is_wall(node: ast.AST) -> bool:
+            return is_wall_call(node) or (
+                isinstance(node, ast.Name) and node.id in tainted
+            )
+
+        for node in _scope_walk(scope):
+            if (
+                isinstance(node, ast.BinOp)
+                and isinstance(node.op, ast.Sub)
+                and is_wall(node.left)
+                and is_wall(node.right)
+            ):
+                out.append(
+                    Finding(
+                        "GL008",
+                        mod.relpath,
+                        node.lineno,
+                        "duration computed by subtracting wall-clock "
+                        "time.time() readings; the wall clock jumps (NTP, "
+                        "DST) — use time.perf_counter() for intervals",
+                    )
+                )
+    return out

@@ -703,6 +703,13 @@ def build_parser() -> argparse.ArgumentParser:
         "scheduler snapshot) to this JSONL file as they are captured",
     )
     p_server.add_argument(
+        "--flight-out-max-mb", type=float,
+        default=_float_default("flight-out-max-mb", 64.0),
+        help="size cap on the --flight-out file; at the cap it rotates to "
+        "<path>.1 (one backup) and overwritten records count into "
+        "trivy_tpu_flight_dropped_total (0 = uncapped)",
+    )
+    p_server.add_argument(
         "--secret-config",
         default=_env_default("secret-config", ""),
         help="secret-config the server engine loads; SIGHUP or "
@@ -805,6 +812,53 @@ def build_parser() -> argparse.ArgumentParser:
     pr_push.add_argument(
         "--no-admit", action="store_true", default=_bool_default("no-admit"),
         help="register the ruleset without making it device-resident",
+    )
+
+    # Performance observatory: bench-ledger trajectory, run diffs, and the
+    # CI regression gate over a checked-in baseline.
+    p_perf = sub.add_parser(
+        "perf", help="bench-ledger reports and regression gating"
+    )
+    perf_sub = p_perf.add_subparsers(dest="perf_command")
+    pf_report = perf_sub.add_parser(
+        "report", help="render the recent bench-ledger trajectory"
+    )
+    pf_report.add_argument(
+        "--ledger", default=_env_default("ledger", ""),
+        help="bench ledger JSONL (default BENCH_LEDGER_FILE or "
+        "BENCH_LEDGER.jsonl)",
+    )
+    pf_report.add_argument(
+        "--limit", type=int, default=_int_default("limit", 10),
+        help="most-recent runs to include",
+    )
+    pf_diff = perf_sub.add_parser(
+        "diff", help="per-metric deltas between two ledger runs"
+    )
+    pf_diff.add_argument(
+        "--ledger", default=_env_default("ledger", "")
+    )
+    pf_diff.add_argument(
+        "--base", type=int, default=_int_default("base", -2),
+        help="base run index (negative = from the end; default -2)",
+    )
+    pf_diff.add_argument(
+        "--head", type=int, default=_int_default("head", -1),
+        help="head run index (negative = from the end; default -1, the "
+        "latest run)",
+    )
+    pf_gate = perf_sub.add_parser(
+        "gate",
+        help="exit non-zero when the latest run regresses past the "
+        "baseline's per-metric tolerance",
+    )
+    pf_gate.add_argument(
+        "--ledger", default=_env_default("ledger", "")
+    )
+    pf_gate.add_argument(
+        "--baseline", default=_env_default("baseline", ""),
+        help="baseline JSON with per-metric tolerances "
+        "(tools/perfgate/baseline.json in CI)",
     )
 
     sub.add_parser("version", help="print version")
@@ -940,6 +994,11 @@ def main(argv: list[str] | None = None) -> int:
 
         return run_rules(args)
 
+    if args.command == "perf":
+        from trivy_tpu.commands.perf import run_perf
+
+        return run_perf(args)
+
     if args.command == "server":
         from trivy_tpu.registry.store import resolve_rules_cache_dir
         from trivy_tpu.rpc.server import serve
@@ -970,6 +1029,7 @@ def main(argv: list[str] | None = None) -> int:
             profile_dir=args.profile_dir,
             slo_config=args.slo_config,
             flight_out=args.flight_out,
+            flight_out_max_mb=args.flight_out_max_mb,
         )
         return 0
 

@@ -32,6 +32,7 @@ import numpy as np
 from trivy_tpu.ftypes import Secret
 from trivy_tpu.engine.grams import GramSet, build_gram_set
 from trivy_tpu.engine.oracle import OracleScanner
+from trivy_tpu.obs import metrics as obs_metrics
 from trivy_tpu.obs import trace as obs_trace
 from trivy_tpu.engine.probes import ProbeSet, build_probe_set
 from trivy_tpu.rules.model import RuleSet, SecretConfig, build_ruleset
@@ -287,6 +288,10 @@ class TpuSecretEngine:
                     self._tile_align = self._tile_align * sieve_obj.block_rows
                 elif unpack is not None:
                     self._sieve_fn = lambda rows: sieve_obj(unpack(rows))
+                    # split handles for per-kernel attribution (the traced
+                    # path times unpack apart from the match kernel)
+                    self._unpack_fn = unpack
+                    self._sieve_core = sieve_obj
                 else:
                     self._sieve_fn = sieve_obj
                 self._tile_buckets = TILE_BUCKETS_PALLAS
@@ -310,6 +315,10 @@ class TpuSecretEngine:
                         lambda rows, m, v: gs_mod.gram_sieve_rows(
                             unpack(rows), m, v
                         )
+                    )
+                    self._unpack_fn = unpack
+                    self._sieve_core = lambda rows: gs_mod._gram_sieve_jit(
+                        rows, self._masks, self._vals
                     )
                 else:
                     fn = gs_mod._gram_sieve_jit
@@ -449,7 +458,9 @@ class TpuSecretEngine:
 
         t0 = _time.perf_counter()
         with obs_trace.span("chunk.encode", bytes=part.nbytes):
+            ph = obs_metrics.device_phase("encode")
             coded = self._link.encode_rows(part)
+            ph.done(coded)
         self.stats.encode_s += _time.perf_counter() - t0
         return coded, part.nbytes
 
@@ -558,14 +569,23 @@ class TpuSecretEngine:
                 return (digest, dev, True)
             self.stats.device_dispatches += 1
             with obs_trace.span("chunk.exec", chunk=ci):
-                out = exec_fn(dev)
+                # traced runs take the per-kernel attributed path (fenced
+                # unpack/sieve-step sections); untraced runs keep the
+                # donated fused dispatch and full pipeline overlap
+                out = (
+                    self._exec_attributed(dev)
+                    if obs_trace.enabled()
+                    else exec_fn(dev)
+                )
             return (digest, out, False)
 
         def finish(ci, handle):
             digest, out, hit = handle
             if not hit:
                 with obs_trace.span("chunk.fetch", chunk=ci):
+                    ph = obs_metrics.device_phase("compact")
                     out = self._fetch_hits(out)
+                    ph.done()
                 if digest is not None:
                     self._resident.put(digest, out)
             outs[ci] = out
@@ -576,6 +596,31 @@ class TpuSecretEngine:
         pipe.run(range(n_chunks))
         self.stats.h2d_overlap_s += pipe.stats.h2d_overlap_s
         return np.concatenate(outs)[:total]
+
+    def _exec_attributed(self, dev):
+        """One sieve execution with per-kernel attribution.  When tracing
+        is enabled the codec's device-side unpack stage and the match
+        kernel run as separate fenced `device_phase` sections (the fence —
+        block_until_ready before reading the clock — is what pins an
+        async dispatch's wall time to ITS kernel).  Tracing off runs the
+        fused jitted composition untouched: no fences, no split, the
+        disabled path costs one predicate."""
+        if not obs_trace.enabled():
+            return self._sieve_fn(dev)
+        unpack = getattr(self, "_unpack_fn", None)
+        core = getattr(self, "_sieve_core", None)
+        if unpack is None or core is None:
+            ph = obs_metrics.device_phase("sieve-step")
+            out = self._sieve_fn(dev)
+            ph.done(out)
+            return out
+        ph = obs_metrics.device_phase("unpack")
+        rows = unpack(dev)
+        ph.done(rows)
+        ph = obs_metrics.device_phase("sieve-step")
+        out = core(rows)
+        ph.done(out)
+        return out
 
     def _dispatch_rows(self, buf: np.ndarray) -> np.ndarray:
         """One sieve dispatch over an already-staged (possibly coded)
@@ -596,9 +641,12 @@ class TpuSecretEngine:
             with obs_trace.span("chunk.h2d", bytes=buf.nbytes):
                 dev = jnp.asarray(buf)
             with obs_trace.span("chunk.exec"):
-                out = self._sieve_fn(dev)
+                out = self._exec_attributed(dev)
             with obs_trace.span("chunk.fetch"):
-                return self._fetch_hits(out)
+                ph = obs_metrics.device_phase("compact")
+                arr = self._fetch_hits(out)
+                ph.done()
+                return arr
         t0 = _time.perf_counter()
         dev = jax.device_put(buf)
         np.asarray(dev[:1, :1])  # forced round-trip  # graftlint: ignore[GL004]

@@ -42,6 +42,7 @@ import numpy as np
 from trivy_tpu import lockcheck
 from trivy_tpu.engine.device import SieveStats, TpuSecretEngine
 from trivy_tpu.ftypes import Secret
+from trivy_tpu.obs import gatelog
 from trivy_tpu.obs import trace as obs_trace
 
 # Shared empty result for non-candidate files (see the confirm loop): reads
@@ -142,6 +143,39 @@ def probe_link(size: int = 8 << 20, attempts: int = 3):  # graftlint: fetch-boun
         return result
 
 
+# The device-verify bar: effective post-codec rate and dispatch RTT the
+# link must clear before the gate routes verify to the device NFA.
+GATE_EFF_MB_S = 1000.0
+GATE_RTT_S = 0.01
+
+
+def gate_terms(h2d_ratio: float = 1.0, d2h_ratio: float = 1.0) -> dict:
+    """Measure the link and price it against the device-verify bar;
+    returns every term the decision used (the gate-audit record body).
+
+    `margin` is the signed distance from the flip point: the worse of
+    (effective rate vs GATE_EFF_MB_S) and (RTT vs GATE_RTT_S), each as a
+    fraction of its threshold.  Positive = the link cleared the bar."""
+    from trivy_tpu.engine import link as link_mod
+
+    mb_s, rtt = probe_link()
+    eff = link_mod.effective_link_rate(mb_s, h2d_ratio, d2h_ratio)
+    wide = eff >= GATE_EFF_MB_S and rtt < GATE_RTT_S
+    margin = min(eff / GATE_EFF_MB_S - 1.0, 1.0 - rtt / GATE_RTT_S)
+    return {
+        "link_mb_per_sec": mb_s,
+        "link_rtt_s": rtt,
+        "h2d_ratio": h2d_ratio,
+        "d2h_ratio": d2h_ratio,
+        "eff_mb_per_sec": eff,
+        "eff_threshold_mb_per_sec": GATE_EFF_MB_S,
+        "rtt_threshold_s": GATE_RTT_S,
+        "codec": link_mod.codec_mode(),
+        "wide": wide,
+        "margin": margin,
+    }
+
+
 def _link_is_wide(h2d_ratio: float = 1.0, d2h_ratio: float = 1.0) -> bool:
     """Device verify by default only when the link can beat the host C
     verifier's NFA-mode walk (~300-900 MB/s measured): candidate bytes
@@ -154,11 +188,7 @@ def _link_is_wide(h2d_ratio: float = 1.0, d2h_ratio: float = 1.0) -> bool:
     the bar when the codec is available — codec availability flips
     backend selection, which is the point of pricing it here instead of
     at the probe."""
-    from trivy_tpu.engine import link as link_mod
-
-    mb_s, rtt = probe_link()
-    eff = link_mod.effective_link_rate(mb_s, h2d_ratio, d2h_ratio)
-    return eff >= 1000.0 and rtt < 0.01
+    return gate_terms(h2d_ratio, d2h_ratio)["wide"]
 
 
 def normalize_grams(
@@ -245,11 +275,33 @@ class HybridSecretEngine(TpuSecretEngine):
                 if link_mod.d2h_compaction_enabled()
                 else 1.0
             )
-            verify = (
-                "device"
-                if _tpu_default_backend()
-                and _link_is_wide(d2h_ratio=d2h_ratio)
-                else "dfa"
+            if not _tpu_default_backend():
+                verify = "dfa"
+                self.gate_decision = gatelog.record(
+                    requested="auto", backend="dfa", reason="no-device",
+                )
+            else:
+                terms = gate_terms(d2h_ratio=d2h_ratio)
+                verify = "device" if terms["wide"] else "dfa"
+                self.gate_decision = gatelog.record(
+                    requested="auto",
+                    backend=verify,
+                    reason="link-wide" if terms["wide"] else "link-narrow",
+                    link_mb_per_sec=terms["link_mb_per_sec"],
+                    link_rtt_s=terms["link_rtt_s"],
+                    h2d_ratio=terms["h2d_ratio"],
+                    d2h_ratio=terms["d2h_ratio"],
+                    eff_mb_per_sec=terms["eff_mb_per_sec"],
+                    eff_threshold_mb_per_sec=terms[
+                        "eff_threshold_mb_per_sec"
+                    ],
+                    rtt_threshold_s=terms["rtt_threshold_s"],
+                    codec=terms["codec"],
+                    margin=terms["margin"],
+                )
+        else:
+            self.gate_decision = gatelog.record(
+                requested=requested, backend=verify, reason="forced",
             )
         self.verify = verify
         self._nfa_verifier = None
@@ -276,6 +328,10 @@ class HybridSecretEngine(TpuSecretEngine):
                         "device NFA verify stage is not available"
                     ) from e
                 self.verify = verify = "dfa"  # auto falls back to host DFA
+                self.gate_decision = gatelog.record(
+                    requested=requested, backend="dfa", reason="fallback",
+                    error=f"{type(e).__name__}: {e}",
+                )
         if verify in ("dfa", "device"):
             # In device mode the DFA still verifies pass-through lanes
             # (rules with no 64-position automaton, oversized windows).
@@ -521,6 +577,18 @@ class HybridSecretEngine(TpuSecretEngine):
             return super().scan_batch(items)  # NumPy gram path
         self.stats.files += len(items)
         self.stats.bytes += sum(len(c) for _, c in items)
+        gd = getattr(self, "gate_decision", None)
+        if gd is not None and obs_trace.enabled():
+            # Pin the gate's routing verdict onto this batch's span tree:
+            # a flight capture or --explain then shows WHY verify ran on
+            # the DFA/device without consulting /debug/gate separately.
+            with obs_trace.span(
+                "hybrid.gate",
+                backend=gd["backend"],
+                reason=gd["reason"],
+                margin=gd.get("margin"),
+            ):
+                pass
 
         from trivy_tpu import deadline
 

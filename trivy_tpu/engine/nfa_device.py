@@ -53,6 +53,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from trivy_tpu.engine.redfa import compile_search_nfa64, compute_prefix_bounds
+from trivy_tpu.obs import metrics as obs_metrics
 from trivy_tpu.obs import trace as obs_trace
 
 MAX_LEN = 1 << 15  # lanes whose walk window exceeds this verify on host
@@ -586,7 +587,13 @@ class NfaVerifier:
                         ).transpose(2, 3, 0, 1)
                     )
                     bd = self._put_stream(bytes_t)
-                    in_flight.append((tier, lo, hi, run(bd, *tens)))
+                    # traced runs fence each dispatch (per-kernel
+                    # verify-stream attribution); untraced dispatch stays
+                    # async and overlaps with the bounded fetch queue
+                    ph = obs_metrics.device_phase("verify-stream")
+                    out = run(bd, *tens)
+                    ph.done(out)
+                    in_flight.append((tier, lo, hi, out))
                     st["dispatches"] += 1
                     while len(in_flight) > depth:
                         _fetch_one()

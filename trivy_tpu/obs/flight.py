@@ -18,17 +18,23 @@ into a small bounded incident ring:
 
 Incidents are served newest-first by `GET /debug/flight?limit=N` and,
 when `--flight-out` is set, appended to a JSONL file as they are captured
-so they survive the process.
+so they survive the process.  The file is size-capped
+(`--flight-out-max-mb`, default 64): when the active file would exceed
+the cap it rotates to `<path>.1` (one backup generation), and records
+lost with the overwritten backup count into
+`trivy_tpu_flight_dropped_total` — a long-running server cannot fill the
+disk with incidents.
 
 Capture runs on request/handler threads and must never raise: an
 observability feature that can turn a breach into an outage is worse
-than no feature.  The snapshot callback and the file append are each
-individually guarded.
+than no feature.  The snapshot callback, the gate callback, and the file
+append are each individually guarded.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 from collections import deque
 from typing import Callable
@@ -37,31 +43,53 @@ from trivy_tpu import lockcheck
 from trivy_tpu.obs import trace as obs_trace
 
 DEFAULT_CAPACITY = 64
+DEFAULT_OUT_MAX_MB = 64.0
 
 
 class FlightRecorder:
     """Bounded incident ring.  `snapshot_fn` is injected (the server
     passes BatchScheduler.snapshot) so this module needs no dependency on
-    trivy_tpu.serve."""
+    trivy_tpu.serve; `gate_fn` likewise (the server passes a
+    gatelog.records thunk) so a capture embeds the hybrid-gate decisions
+    that routed the breached request."""
 
     def __init__(
         self,
         capacity: int = DEFAULT_CAPACITY,
         snapshot_fn: Callable[[], dict] | None = None,
         out_path: str = "",
+        out_max_mb: float = DEFAULT_OUT_MAX_MB,
+        gate_fn: Callable[[], list] | None = None,
         registry=None,
     ):
         self._lock = lockcheck.make_lock("obs.flight")
         self._ring: deque[dict] = deque(maxlen=max(1, int(capacity)))  # owner: _lock
         self._seq = 0  # owner: _lock
         self._snapshot_fn = snapshot_fn
+        self._gate_fn = gate_fn
         self.out_path = out_path
+        # 0 disables the cap; the bookkeeping below is all owner: _lock.
+        self.out_max_bytes = int(max(0.0, out_max_mb) * (1 << 20))
+        self._out_bytes = 0
+        self._out_records = 0  # records this process wrote to the active file
+        self._backup_records = 0  # records this process rotated into .1
+        self.dropped = 0  # records lost to rotation (this process's writes)
+        if out_path:
+            try:
+                self._out_bytes = os.path.getsize(out_path)
+            except OSError:
+                self._out_bytes = 0
         self._m_captured = None
+        self._m_dropped = None
         if registry is not None:
             self._m_captured = registry.counter(
                 "trivy_tpu_flight_records_total",
                 "breach incidents captured into the flight ring",
                 ("reason",),
+            )
+            self._m_dropped = registry.counter(
+                "trivy_tpu_flight_dropped_total",
+                "flight-out JSONL records lost to size-capped rotation",
             )
 
     @property
@@ -100,6 +128,14 @@ class FlightRecorder:
             # lands even when the scheduler is mid-teardown.
             return {"error": f"{type(e).__name__}: {e}"}
 
+    def _gate_state(self) -> list:
+        if self._gate_fn is None:
+            return []
+        try:
+            return list(self._gate_fn())
+        except Exception as e:
+            return [{"error": f"{type(e).__name__}: {e}"}]
+
     def capture(
         self,
         *,
@@ -123,19 +159,40 @@ class FlightRecorder:
             "elapsed_s": round(float(elapsed_s), 6),
             "spans": self._span_tree(trace_id),
             "scheduler": self._scheduler_state(),
+            "gate": self._gate_state(),
         }
+        dropped = 0
         with self._lock:
             self._seq += 1
             rec["seq"] = self._seq
             self._ring.append(rec)
             if self.out_path:
                 try:
+                    line = json.dumps(rec, default=str) + "\n"
+                    if (
+                        self.out_max_bytes
+                        and self._out_bytes
+                        and self._out_bytes + len(line) > self.out_max_bytes
+                    ):
+                        # One backup generation: the active file demotes to
+                        # .1 (still on disk), whatever .1 held is gone —
+                        # that loss is what the dropped counter measures.
+                        os.replace(self.out_path, self.out_path + ".1")
+                        dropped = self._backup_records
+                        self.dropped += dropped
+                        self._backup_records = self._out_records
+                        self._out_records = 0
+                        self._out_bytes = 0
                     with open(self.out_path, "a") as f:
-                        f.write(json.dumps(rec, default=str) + "\n")
+                        f.write(line)
+                    self._out_bytes += len(line)
+                    self._out_records += 1
                 except OSError:
                     pass
         if self._m_captured is not None:
             self._m_captured.labels(reason=reason or "unknown").inc()
+        if dropped and self._m_dropped is not None:
+            self._m_dropped.inc(dropped)
         return rec
 
     # -- read side (debug endpoint, tests) ---------------------------------

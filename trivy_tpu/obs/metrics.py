@@ -22,6 +22,7 @@ shouldn't trigger (the scheduler's hook reads the non-building
 from __future__ import annotations
 
 import threading
+import time
 from bisect import bisect_left
 from typing import Callable, Iterable
 
@@ -369,3 +370,126 @@ class Registry:
         for fam in fams:
             lines.extend(fam.render())
         return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Device-phase attribution: per-kernel fenced timings from the engines
+# ---------------------------------------------------------------------------
+#
+# The engines' coarse wall timings (dispatch_s / fetch_map_s) lump every
+# kernel behind one async dispatch boundary; these helpers split them into
+# honest per-kernel sections.  `device_phase(kernel)` opens a span nested
+# under the ambient chunk span and, at `.done(*arrays)`, blocks on the
+# section's output arrays before reading the clock — the fence is what makes
+# an async dispatch's timing attributable to ITS kernel rather than to
+# whoever synchronizes next.  Fences run ONLY when tracing is enabled: the
+# disabled path returns a shared no-op handle and costs one predicate (the
+# BENCH_OBS <2% overhead contract), and the pipelined engine's overlap is
+# never serialized outside an observation window.
+#
+# Samples queue process-globally (engines don't own a registry); a server's
+# collect hook drains them into its per-server
+# `trivy_tpu_device_phase_seconds{kernel}` histogram at scrape time.
+
+# The per-kernel section names the engines report (bounded label set).
+DEVICE_PHASE_KERNELS = (
+    "encode", "unpack", "sieve-step", "compact", "verify-stream",
+)
+
+# Kernel sections are sub-millisecond to a few seconds (relay dispatch):
+# 50us .. 2.5s, roughly log-spaced.
+DEVICE_PHASE_BUCKETS = (
+    0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+    0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+)
+
+_DEVICE_PHASE_LOCK = lockcheck.make_lock("obs.metrics.device_phase")
+_DEVICE_PHASE_PENDING: list[tuple[str, float]] = []  # owner: _DEVICE_PHASE_LOCK
+# Tracing on with nothing scraping (CLI scans) must not grow unbounded:
+# beyond the cap the oldest samples drop — the scrape path is best-effort
+# by design, the span tree keeps the full record.
+_DEVICE_PHASE_MAX_PENDING = 4096
+
+
+def record_device_phase(kernel: str, seconds: float) -> None:
+    """Queue one per-kernel fenced timing for the next scrape drain."""
+    with _DEVICE_PHASE_LOCK:
+        _DEVICE_PHASE_PENDING.append((kernel, seconds))
+        overflow = len(_DEVICE_PHASE_PENDING) - _DEVICE_PHASE_MAX_PENDING
+        if overflow > 0:
+            del _DEVICE_PHASE_PENDING[:overflow]
+
+
+def drain_device_phases() -> list[tuple[str, float]]:
+    """Take every pending (kernel, seconds) sample (collect-hook seat)."""
+    with _DEVICE_PHASE_LOCK:
+        out = list(_DEVICE_PHASE_PENDING)
+        _DEVICE_PHASE_PENDING.clear()
+    return out
+
+
+class _NoopPhase:
+    __slots__ = ()
+
+    def done(self, *arrays) -> float:
+        return 0.0
+
+
+_NOOP_PHASE = _NoopPhase()
+
+
+class _DevicePhase:
+    __slots__ = ("kernel", "_t0", "_span")
+
+    def __init__(self, kernel: str):
+        from trivy_tpu.obs import trace as obs_trace
+
+        self.kernel = kernel
+        # Deliberate handle pattern: begin/done brackets an async dispatch
+        # across statements, which `with` cannot.  If done() is skipped by
+        # an unwinding exception the span misses its ring append but the
+        # ambient context heals: the enclosing chunk span's token reset
+        # restores the contextvar.
+        self._span = obs_trace.span(  # graftlint: ignore[GL006]
+            f"kernel.{kernel}", kernel=kernel
+        )
+        self._span.__enter__()
+        self._t0 = time.perf_counter()
+
+    def done(self, *arrays) -> float:
+        flat: list = []
+        for a in arrays:
+            if isinstance(a, (tuple, list)):
+                flat.extend(a)
+            else:
+                flat.append(a)
+        for a in flat:
+            bur = getattr(a, "block_until_ready", None)
+            if bur is not None:
+                try:
+                    bur()
+                except Exception:
+                    # a failed fence degrades the timing, never the scan
+                    pass
+        dt = time.perf_counter() - self._t0
+        record_device_phase(self.kernel, dt)
+        self._span.__exit__(None, None, None)
+        return dt
+
+
+def device_phase(kernel: str):
+    """Begin a per-kernel timed section; no-op unless tracing is enabled.
+
+    Usage in engine code::
+
+        ph = obs_metrics.device_phase("sieve-step")
+        out = step(dev_rows)          # async dispatch
+        ph.done(out)                  # fence + record + close span
+
+    `.done(*arrays)` blocks on each array that has `block_until_ready`
+    (host-side sections pass none and just read the clock)."""
+    from trivy_tpu.obs import trace as obs_trace
+
+    if not obs_trace.enabled():
+        return _NOOP_PHASE
+    return _DevicePhase(kernel)

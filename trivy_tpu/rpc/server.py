@@ -48,6 +48,7 @@ from trivy_tpu.cache.store import (
 )
 from trivy_tpu.deadline import ScanTimeoutError
 from trivy_tpu.obs import flight as obs_flight
+from trivy_tpu.obs import gatelog
 from trivy_tpu.obs import metrics as obs_metrics
 from trivy_tpu.obs import slo as obs_slo
 from trivy_tpu.obs import trace as obs_trace
@@ -115,6 +116,7 @@ class ScanServer:
         profile_dir: str = "",
         slo_config: str = "",
         flight_out: str = "",
+        flight_out_max_mb: float = obs_flight.DEFAULT_OUT_MAX_MB,
     ):
         from trivy_tpu.scanner.vuln import init_vuln_scanner
 
@@ -164,11 +166,38 @@ class ScanServer:
         self.flight = obs_flight.FlightRecorder(
             snapshot_fn=self.scheduler.snapshot,
             out_path=flight_out,
+            out_max_mb=flight_out_max_mb,
+            # A breach capture embeds the recent hybrid-gate decisions, so
+            # the incident record answers "why did verify run there".
+            gate_fn=lambda: gatelog.records(limit=8),
             registry=self.registry,
         )
         # The scheduler captures deadline expiries itself (at expiry time,
         # when the snapshot still shows the queue that starved the ticket).
         self.scheduler.flight = self.flight
+        # Hybrid-gate decision audit + per-kernel device-phase sections:
+        # both sources are process-level (engines are built on scheduler /
+        # reload threads and own no registry), so collect hooks fold them
+        # into this server's scrape at render time.
+        self._m_gate_total = self.registry.counter(
+            "trivy_tpu_hybrid_gate_decision_total",
+            "hybrid-gate backend resolutions by outcome",
+            ("backend", "reason"),
+        )
+        self._m_gate_margin = self.registry.gauge(
+            "trivy_tpu_hybrid_gate_margin",
+            "signed distance of the newest link-priced gate decision from "
+            "its flip point (positive = device bar cleared)",
+        )
+        self._gate_exported: dict[tuple[str, str], int] = {}
+        self.registry.add_collect_hook(self._collect_gate)
+        self._m_device_phase = self.registry.histogram(
+            "trivy_tpu_device_phase_seconds",
+            "fenced per-kernel device sections (tracing-enabled runs only)",
+            ("kernel",),
+            buckets=obs_metrics.DEVICE_PHASE_BUCKETS,
+        )
+        self.registry.add_collect_hook(self._collect_device_phases)
         # Build/ruleset identity: one series per RESIDENT ruleset, rebuilt
         # from live state at each scrape (clear + re-set), so evicted
         # digests stop scraping instead of pinning stale 1s forever.
@@ -432,6 +461,36 @@ class ScanServer:
                 self._config_digest = default_ruleset_digest()
         return self._config_digest
 
+    def _collect_gate(self) -> None:
+        """Registry collect hook: fold the process-level gate-audit
+        tallies into this server's counter family.  gatelog counts are
+        monotonic; the hook incs by delta against what it last exported,
+        so many servers in one process (tests) each converge on the same
+        totals without double counting within one registry."""
+        for (backend, reason), total in gatelog.tallies().items():
+            key = (backend, reason)
+            delta = total - self._gate_exported.get(key, 0)
+            if delta > 0:
+                # backend/reason are bounded enums (gatelog docstring),
+                # not request-controlled identities.
+                self._m_gate_total.labels(  # graftlint: ignore[GL007]
+                    backend=backend, reason=reason
+                ).inc(delta)
+                self._gate_exported[key] = total
+        margin = gatelog.last_margin()
+        if margin is not None:
+            self._m_gate_margin.set(margin)
+
+    def _collect_device_phases(self) -> None:
+        """Registry collect hook: drain pending fenced per-kernel samples
+        into trivy_tpu_device_phase_seconds{kernel}.  Samples only exist
+        while tracing is enabled; the drain is destructive, so exactly one
+        scraping server observes each sample."""
+        for kernel, seconds in obs_metrics.drain_device_phases():
+            self._m_device_phase.labels(  # graftlint: ignore[GL007]
+                kernel=kernel
+            ).observe(seconds)
+
     def _collect_build_info(self) -> None:
         """Registry collect hook: rebuild trivy_tpu_build_info from live
         state — the default ruleset plus one series per pool-resident
@@ -539,6 +598,21 @@ _ROUTES = {
 }
 
 
+# Debug surfaces the GET side serves, with the one-line description the
+# `/debug` index renders.  Every new surface registers here — the index
+# handler and the route chain both read this table, and a regression test
+# asserts each listed route actually answers.
+DEBUG_SURFACES = {
+    "/debug/traces": "span ring as Chrome-trace JSON "
+    "(?limit=N, newest first)",
+    "/debug/slo": "per-method SLO burn rates and remaining error budget",
+    "/debug/flight": "breach-promoted incident ring "
+    "(?limit=N, newest first)",
+    "/debug/gate": "hybrid-gate decision audit: backend resolutions with "
+    "cost-model inputs (?limit=N, newest first)",
+}
+
+
 def _query_limit(query: str, default: int = 64) -> int:
     """?limit=N for the debug endpoints; bad values fall back to the
     default rather than 400 (these are operator conveniences)."""
@@ -616,6 +690,27 @@ def _make_handler(server: ScanServer):
                         ),
                     },
                 )
+            elif route == "/debug/gate":
+                # Hybrid-gate decision audit: newest-first records with
+                # the measured link terms and thresholds each decision
+                # priced, plus the monotonic per-outcome tallies.
+                self._send(
+                    200,
+                    {
+                        "decisions": gatelog.records(
+                            _query_limit(parsed.query)
+                        ),
+                        "tallies": {
+                            f"{backend}/{reason}": n
+                            for (backend, reason), n in sorted(
+                                gatelog.tallies().items()
+                            )
+                        },
+                    },
+                )
+            elif route in ("/debug", "/debug/"):
+                # Index of every debug surface with its one-liner.
+                self._send(200, {"surfaces": DEBUG_SURFACES})
             else:
                 self._send(404, {"error": "not found"})
 
@@ -814,6 +909,7 @@ def make_http_server(
     profile_dir: str = "",
     slo_config: str = "",
     flight_out: str = "",
+    flight_out_max_mb: float = obs_flight.DEFAULT_OUT_MAX_MB,
 ) -> ThreadingHTTPServer:
     host, _, port = addr.rpartition(":")
     scan_server = ScanServer(
@@ -827,6 +923,7 @@ def make_http_server(
         profile_dir=profile_dir,
         slo_config=slo_config,
         flight_out=flight_out,
+        flight_out_max_mb=flight_out_max_mb,
     )
     httpd = ThreadingHTTPServer(
         (host or "localhost", int(port)), _make_handler(scan_server)
@@ -848,6 +945,7 @@ def serve(
     profile_dir: str = "",
     slo_config: str = "",
     flight_out: str = "",
+    flight_out_max_mb: float = obs_flight.DEFAULT_OUT_MAX_MB,
 ) -> None:
     """pkg/rpc/server/listen.go ListenAndServe, with graceful SIGTERM
     drain: stop admitting (503 + Retry-After), finish the batches already
@@ -867,7 +965,7 @@ def serve(
         secret_config=secret_config, rules_cache_dir=rules_cache_dir,
         pipeline_depth=pipeline_depth, resident_chunks=resident_chunks,
         profile_dir=profile_dir, slo_config=slo_config,
-        flight_out=flight_out,
+        flight_out=flight_out, flight_out_max_mb=flight_out_max_mb,
     )
     scan_server: ScanServer = httpd.scan_server
 

@@ -759,6 +759,9 @@ class BatchScheduler:
                     "phases_ms": {
                         k: round(v * 1e3, 3) for k, v in phase_deltas.items()
                     },
+                    # the hybrid gate's routing verdict for this engine
+                    # (why verify ran on dfa/device), when it has one
+                    "gate": getattr(engine, "gate_decision", None),
                     "batch": {
                         "tickets": len(batch),
                         "items": len(combined),
