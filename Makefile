@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test lint lint-changed lockcheck smoke serve-smoke obs-smoke slo-smoke tenancy-smoke bench bench-link checks-corpus rules-cache perf-gate
+.PHONY: test lint lint-changed lockcheck smoke serve-smoke obs-smoke slo-smoke tenancy-smoke mem-smoke bench bench-link checks-corpus rules-cache perf-gate
 
 # Tier-1: the suite the driver holds the repo to (fast, CPU, no slow marks).
 # Lint runs first — a graftlint finding fails the build before pytest
@@ -14,7 +14,7 @@ test: lint
 		--continue-on-collection-errors -p no:cacheprovider
 	$(MAKE) perf-gate
 
-# Static analysis: graftlint (project rules GL001-GL008, always available)
+# Static analysis: graftlint (project rules GL001-GL009, always available)
 # plus ruff + mypy when the environment has them (the pinned CI container
 # may not; config lives in pyproject.toml either way).
 lint:
@@ -74,7 +74,7 @@ obs-smoke:
 		-q -p no:cacheprovider && \
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
-		BENCH_IMAGE=0 BENCH_TENANT=0 $(PY) bench.py --smoke
+		BENCH_IMAGE=0 BENCH_TENANT=0 BENCH_MEM=0 $(PY) bench.py --smoke
 
 # SLO / flight-recorder smoke: boot the server with a deliberately tight
 # latency objective, drive mixed-tenant traffic with one induced breach,
@@ -97,7 +97,19 @@ tenancy-smoke:
 		-q -m 'not slow' -p no:cacheprovider && \
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
-		BENCH_IMAGE=0 BENCH_OBS=0 $(PY) bench.py --smoke
+		BENCH_IMAGE=0 BENCH_OBS=0 BENCH_MEM=0 $(PY) bench.py --smoke
+
+# Device-memory observatory smoke: memwatch ledger units, pool
+# estimate-vs-measured reconciliation, pressure watermark e2e
+# (soft -> LRU eviction, hard -> 429 + Retry-After, hbm-pressure flight
+# records) — then a BENCH_MEM-only bench run (ledger conservation, pool
+# reconciliation delta, soft-evict latency, per-device memory_stats).
+mem-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_memwatch.py \
+		-m mem_smoke -q -p no:cacheprovider && \
+	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
+		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
+		BENCH_IMAGE=0 BENCH_TENANT=0 BENCH_OBS=0 $(PY) bench.py --smoke
 
 # Performance regression gate: one smoke bench run (heavy sections off,
 # primary corpus only) appends to a throwaway ledger, then

@@ -1214,6 +1214,109 @@ def _device_platform() -> str:
         return "unavailable"
 
 
+def bench_mem() -> dict:
+    """BENCH_MEM: device-memory ledger economics (trivy_tpu/obs/memwatch).
+
+    Three deterministic claims plus a raw report: (1) the attributed
+    ledger conserves bytes exactly across track/resize/release (asserted
+    as detail.mem.ledger_conserved = 1); (2) the resident pool's
+    manifest-estimate vs memwatch-measured reconciliation produces the
+    constructed error ratio on synthetic slots; (3) the soft-watermark
+    actuator (`evict_to_bytes`) evicts the constructed slot count, and
+    its latency is reported (pressure_evict_ms, perf-gated with a
+    generous tolerance).  Finally, per-device `memory_stats` are emitted
+    verbatim — or an explicit "unavailable" marker on backends without
+    allocator stats — so multichip runs start with a populated per-device
+    baseline instead of a log tail.
+    """
+    from trivy_tpu.obs import memwatch
+    from trivy_tpu.tenancy.pool import ResidentRulesetPool
+
+    was_enabled = memwatch.enabled()
+    memwatch.reset()
+    memwatch.enable()
+    out: dict = {}
+    try:
+        # 1. Conservation: 32 tracked MiB-sized allocations; half resized,
+        # half released; then everything released -> ledger back to zero.
+        handles = [memwatch.track("bench-mem", 1 << 20) for _ in range(32)]
+        for h in handles[:16]:
+            h.resize(2 << 20)
+        for h in handles[16:]:
+            h.release()
+        conserved = (
+            memwatch.total_bytes() == 16 * (2 << 20)
+            and memwatch.allocation_count() == 16
+        )
+        for h in handles[:16]:
+            h.release()
+        conserved = conserved and memwatch.total_bytes() == 0
+        out["ledger_conserved"] = 1 if conserved else 0
+
+        # 2. Estimate reconciliation: the fake loader estimates 1 MiB per
+        # slot while its "engine" registers 1.25 MiB measured under the
+        # digest scope -> (meas - est)/est = 0.25 by construction.
+        est_b, meas_b = 1 << 20, (1 << 20) + (1 << 18)
+
+        def loader(digest):
+            memwatch.track("nfa-tensors", meas_b, digest=digest)
+            return object(), est_b, "warm"
+
+        pool = ResidentRulesetPool(loader, max_resident=8)
+        for i in range(6):
+            pool.ensure(f"sha256:benchmem{i}")
+        est, meas = pool.estimate_reconciliation()
+        out["pool_slots"] = pool.resident_count()
+        out["pool_estimate_bytes"] = est
+        out["pool_measured_bytes"] = meas
+        out["estimate_error_ratio"] = (
+            round((meas - est) / est, 4) if est else 0.0
+        )
+
+        # 3. Soft-watermark actuator: 6 measured slots down to a 2-slot
+        # byte target -> exactly 4 LRU evictions, never the newest.
+        t0 = time.perf_counter()
+        evicted, freed = pool.evict_to_bytes(2 * meas_b)
+        out["pressure_evict_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+        out["soft_evicted_slots"] = evicted
+        out["soft_freed_bytes"] = freed
+    finally:
+        memwatch.reset()
+        if not was_enabled:
+            memwatch.disable()
+
+    # Per-device raw allocator stats (MULTICHIP baseline): every device
+    # reports its memory_stats dict, or the explicit marker when the
+    # backend has no allocator stats (CPU) — never just a log tail.
+    devices: dict = {}
+    try:
+        import jax
+
+        jdevs = jax.devices()
+    except Exception:
+        jdevs = []
+    for d in jdevs:
+        key = f"{d.platform}:{getattr(d, 'id', 0)}"
+        fn = getattr(d, "memory_stats", None)
+        ms = None
+        if fn is not None:
+            try:
+                ms = fn()
+            except Exception:
+                ms = None
+        if ms:
+            devices[key] = {
+                k: int(v)
+                for k, v in ms.items()
+                if isinstance(v, (int, float))
+            }
+        else:
+            devices[key] = {"memory_stats": "unavailable"}
+    out["n_devices"] = len(jdevs)
+    out["devices"] = devices
+    return out
+
+
 def _compact_detail(detail: dict) -> dict:
     """Headline subset of `detail` small enough for the tail-captured
     stdout line; the full structure lives in the side file."""
@@ -1265,6 +1368,17 @@ def _compact_detail(detail: dict) -> dict:
                 "findings_identical", "spans_per_scan", "error",
             )
             if k in ob
+        }
+    mm = detail.get("mem")
+    if isinstance(mm, dict):
+        c["mem"] = {
+            k: mm[k]
+            for k in (
+                "ledger_conserved", "estimate_error_ratio",
+                "soft_evicted_slots", "pressure_evict_ms", "n_devices",
+                "error",
+            )
+            if k in mm
         }
     vb = detail.get("verify_backend")
     if isinstance(vb, dict):
@@ -1485,6 +1599,15 @@ def main() -> None:
             detail["obs"] = bench_obs(engine, n_files=300 if SMOKE else 1500)
         except Exception as e:
             detail["obs"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if os.environ.get("BENCH_MEM", "1") == "1":
+        # Device-memory ledger (trivy_tpu/obs/memwatch): conservation,
+        # pool estimate-vs-measured reconciliation, soft-watermark
+        # eviction latency, per-device allocator stats.
+        try:
+            detail["mem"] = bench_mem()
+        except Exception as e:
+            detail["mem"] = {"error": f"{type(e).__name__}: {e}"}
 
     if os.environ.get("BENCH_COLDSTART", "1") == "1":
         # Registry cold-compile vs warm-load economics (trivy_tpu/registry/).

@@ -153,3 +153,13 @@ def test_debug_surfaces_end_to_end(gate_server):
     assert "trivy_tpu_device_phase_seconds" in text
     assert 'kernel="sieve-step"' in text
     assert 'kernel="encode"' in text
+
+    # -- /debug/memory: the device-memory ledger, attribution exact -------
+    assert "/debug/memory" in DEBUG_SURFACES
+    mem = _get_json(addr, "/debug/memory")
+    assert mem["enabled"] is True
+    assert "pressure" in mem and "devices" in mem
+    for dev in mem["devices"].values():
+        # attributed per-component sums must equal the device total
+        # exactly (tolerance zero by construction)
+        assert sum(dev["attributed"].values()) == dev["attributed_bytes"]

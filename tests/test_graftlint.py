@@ -28,6 +28,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(ROOT, "tools", "graftlint", "fixtures")
 ALL_RULES = (
     "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007", "GL008",
+    "GL009",
 )
 
 
@@ -72,6 +73,7 @@ def test_deny_fixture_counts_stable():
         "GL006": 3,
         "GL007": 4,
         "GL008": 4,
+        "GL009": 3,
     }
 
 

@@ -1,0 +1,527 @@
+"""Device-memory ledger (trivy_tpu/obs/memwatch.py) + HBM watermarks.
+
+Four layers, cheapest first: pure ledger units (track/resize/release
+conservation, digest tagging, the shared no-op handle when off); the
+CPU-backend fallback (no ``memory_stats`` -> the ledger still answers,
+``pressure()`` reports its source honestly); collect-hook exposition
+through a fresh Registry (promtool-style lint); and the watermark loop
+end-to-end on a fake stats injector — soft pressure LRU-evicts the
+resident pool using MEASURED bytes, hard pressure sheds the submit with
+429 + Retry-After, and every transition lands in the flight ring with
+reason "hbm-pressure".
+"""
+
+import gc
+import re
+import threading
+
+import numpy as np
+import pytest
+
+from trivy_tpu.ftypes import Secret
+from trivy_tpu.obs import memwatch
+from trivy_tpu.obs.flight import FlightRecorder
+from trivy_tpu.obs.metrics import Registry
+from trivy_tpu.serve import BatchScheduler, HbmPressureError, ServeConfig
+from trivy_tpu.tenancy.pool import ResidentRulesetPool
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ledger():
+    """Every test starts with an empty, enabled process-global ledger and
+    leaves no provider/allocations behind for the next module."""
+    was_enabled = memwatch.enabled()
+    memwatch.reset()
+    memwatch.enable()
+    yield
+    memwatch.reset()
+    if not was_enabled:
+        memwatch.disable()
+
+
+# ---------------------------------------------------------------------------
+# Ledger units
+# ---------------------------------------------------------------------------
+
+
+def test_track_resize_release_conserves_bytes():
+    a = memwatch.track("pool", 100, device="fake:0")
+    b = memwatch.track("cache", 50, device="fake:0")
+    c = memwatch.track("pool", 25, device="fake:1")
+    assert memwatch.total_bytes() == 175
+    assert memwatch.allocation_count() == 3
+
+    a.resize(200)
+    assert memwatch.total_bytes() == 275
+
+    b.release()
+    b.release()  # idempotent
+    assert memwatch.total_bytes() == 225
+    b.resize(999)  # released handles ignore resizes
+    assert memwatch.total_bytes() == 225
+
+    snap = memwatch.snapshot()
+    assert snap["devices"]["fake:0"]["attributed"] == {"pool": 200}
+    assert snap["devices"]["fake:1"]["attributed"] == {"pool": 25}
+    # peak survives the release: high-water was 200 + 50 on fake:0
+    assert snap["devices"]["fake:0"]["attributed_peak_bytes"] == 250
+
+    a.release()
+    c.release()
+    assert memwatch.total_bytes() == 0
+    assert memwatch.allocation_count() == 0
+
+
+def test_disabled_tracking_returns_shared_noop_handle():
+    memwatch.disable()
+    h1 = memwatch.track("pool", 100)
+    h2 = memwatch.track("cache", 5000)
+    assert h1 is h2 is memwatch.NOOP_HANDLE
+    h1.resize(10)
+    h1.release()
+    assert memwatch.total_bytes() == 0
+    assert memwatch.allocation_count() == 0
+
+
+def test_digest_context_tags_and_exclude_filters():
+    with memwatch.ruleset_digest("sha256:aa"):
+        memwatch.track("nfa-tensors", 300)
+        memwatch.track("ruleset-pool", 100)
+    memwatch.track("nfa-tensors", 77, digest="sha256:bb")
+    memwatch.track("chunk-cache", 5)  # untagged
+
+    assert memwatch.bytes_for_digest("sha256:aa") == 400
+    assert (
+        memwatch.bytes_for_digest("sha256:aa", exclude=("ruleset-pool",))
+        == 300
+    )
+    assert memwatch.bytes_for_digest("sha256:bb") == 77
+    assert memwatch.bytes_for_digest("") == 0
+
+
+def test_owner_garbage_collection_releases():
+    class Owner:
+        pass
+
+    owner = Owner()
+    memwatch.track("cache", 123, owner=owner)
+    assert memwatch.total_bytes() == 123
+    del owner
+    gc.collect()
+    assert memwatch.total_bytes() == 0
+
+
+def test_nbytes_of_arrays_and_nests():
+    a = np.zeros(10, np.uint8)
+    b = np.zeros((4, 4), np.float32)
+    assert memwatch.nbytes_of(a) == 10
+    assert memwatch.nbytes_of((a, b)) == 10 + 64
+    assert memwatch.nbytes_of([a, (b, a)]) == 10 + 64 + 10
+    assert memwatch.nbytes_of("not an array") == 0
+
+
+# ---------------------------------------------------------------------------
+# CPU fallback: no memory_stats anywhere
+# ---------------------------------------------------------------------------
+
+
+def test_cpu_backend_has_no_raw_stats_but_ledger_answers():
+    """Tier-1 runs with JAX_PLATFORMS=cpu: the default sampler finds no
+    allocator stats, and the ledger keeps working from registrations."""
+    assert memwatch.raw_stats() == {}
+    memwatch.track("pool", 500)
+    p = memwatch.pressure()
+    assert p["source"] == "none" and p["fraction"] == 0.0
+    snap = memwatch.snapshot()
+    assert snap["attributed_total_bytes"] == 500
+    dev = snap["devices"][memwatch._device_name()]
+    assert dev["raw"] is None and dev["residual_bytes"] is None
+
+
+def test_attributed_pressure_needs_explicit_budget():
+    memwatch.track("pool", 400)
+    memwatch.set_attributed_limit(1000)
+    p = memwatch.pressure()
+    assert p["source"] == "attributed"
+    assert p["fraction"] == pytest.approx(0.4)
+    assert p["bytes_limit"] == 1000
+
+
+def test_injected_provider_measured_pressure_max_over_devices():
+    memwatch.set_stats_provider(
+        lambda: {
+            "fake:0": {
+                "bytes_in_use": 100, "peak_bytes_in_use": 150,
+                "bytes_limit": 1000,
+            },
+            "fake:1": {
+                "bytes_in_use": 600, "peak_bytes_in_use": 700,
+                "bytes_limit": 1000,
+            },
+            "fake:2": {
+                "bytes_in_use": 999, "peak_bytes_in_use": 999,
+                "bytes_limit": 0,  # no limit -> excluded from pressure
+            },
+        }
+    )
+    p = memwatch.pressure()
+    assert p["source"] == "measured" and p["device"] == "fake:1"
+    assert p["fraction"] == pytest.approx(0.6)
+
+
+def test_snapshot_residual_is_raw_minus_attributed():
+    memwatch.set_stats_provider(
+        lambda: {
+            "fake:0": {
+                "bytes_in_use": 1000, "peak_bytes_in_use": 1200,
+                "bytes_limit": 4000,
+            }
+        }
+    )
+    memwatch.track("pool", 300, device="fake:0")
+    memwatch.track("cache", 100, device="fake:0")
+    snap = memwatch.snapshot(top=1)
+    dev = snap["devices"]["fake:0"]
+    assert dev["attributed_bytes"] == 400
+    assert dev["residual_bytes"] == 600
+    # attributed sums equal the registered allocations exactly (tolerance
+    # zero by construction — the /debug/memory contract)
+    assert sum(dev["attributed"].values()) == dev["attributed_bytes"]
+    assert snap["top"] == [
+        {"component": "pool", "device": "fake:0", "digest": "", "nbytes": 300}
+    ]
+
+
+def test_stats_provider_may_read_the_ledger_back():
+    """The provider runs OUTSIDE the ledger lock — a fake that derives
+    bytes_in_use from the ledger itself must not deadlock."""
+    memwatch.set_stats_provider(
+        lambda: {
+            "fake:0": {
+                "bytes_in_use": memwatch.total_bytes(),
+                "peak_bytes_in_use": memwatch.total_bytes(),
+                "bytes_limit": 1000,
+            }
+        }
+    )
+    memwatch.track("pool", 250)
+    done = []
+
+    def probe():
+        done.append(memwatch.snapshot()["pressure"]["fraction"])
+
+    t = threading.Thread(target=probe)
+    t.start()
+    t.join(timeout=10)
+    assert done and done[0] == pytest.approx(0.25)
+
+
+# ---------------------------------------------------------------------------
+# Collect-hook exposition
+# ---------------------------------------------------------------------------
+
+_SAMPLE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"\\]*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"\\]*")*\})?'
+    r' (-?[0-9.]+(e[+-]?[0-9]+)?|\+Inf|NaN)$'
+)
+
+
+def test_register_collectors_exposition_lints_clean():
+    reg = Registry()
+    memwatch.register_collectors(reg)
+    memwatch.set_stats_provider(
+        lambda: {
+            "fake:0": {
+                "bytes_in_use": 900, "peak_bytes_in_use": 950,
+                "bytes_limit": 1000,
+            }
+        }
+    )
+    memwatch.track("ruleset-pool", 300, device="fake:0")
+    memwatch.track("chunk-cache", 100, device="fake:0")
+
+    text = reg.render()
+    helps, types, names = set(), set(), set()
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            helps.add(line.split()[2])
+            continue
+        if line.startswith("# TYPE "):
+            types.add(line.split()[2])
+            continue
+        m = _SAMPLE.match(line)
+        assert m, f"bad exposition line: {line!r}"
+        names.add(m.group(1))
+        assert re.fullmatch(r"trivy_tpu_[a-z0-9_]+", m.group(1))
+    for fam in (
+        "trivy_tpu_device_hbm_bytes",
+        "trivy_tpu_device_hbm_peak_bytes",
+        "trivy_tpu_hbm_pressure",
+    ):
+        assert fam in helps and fam in types and fam in names
+
+    assert (
+        'trivy_tpu_device_hbm_bytes{device="fake:0",'
+        'component="ruleset-pool"} 300' in text
+    )
+    # raw minus attributed (900 - 400) shows as the _unattributed series
+    assert (
+        'trivy_tpu_device_hbm_bytes{device="fake:0",'
+        'component="_unattributed"} 500' in text
+    )
+    assert 'trivy_tpu_device_hbm_peak_bytes{device="fake:0"} 950' in text
+    assert "trivy_tpu_hbm_pressure 0.9" in text
+
+
+def test_collect_hook_drops_released_series():
+    reg = Registry()
+    memwatch.register_collectors(reg)
+    h = memwatch.track("chunk-cache", 64, device="fake:0")
+    assert 'component="chunk-cache"} 64' in reg.render()
+    h.release()
+    assert 'component="chunk-cache"' not in reg.render()
+
+
+# ---------------------------------------------------------------------------
+# Pool reconciliation: estimates vs measured bytes (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+def _estimate_loader(nbytes_est: int, measured: int = 0):
+    """Loader whose 'engine build' optionally registers `measured` bytes
+    under the ambient digest scope — the way real compiled-ruleset tensors
+    land in the ledger during ResidentRulesetPool.ensure()."""
+
+    def load(digest: str):
+        if measured:
+            memwatch.track("nfa-tensors", measured)
+        return object(), nbytes_est, "warm"
+
+    return load
+
+
+def test_pool_budget_estimate_fallback_path():
+    """No engine-level registrations: --max-resident-mb enforcement falls
+    back to the loader's manifest estimates."""
+    pool = ResidentRulesetPool(
+        _estimate_loader(100), max_resident=8, max_resident_bytes=250
+    )
+    pool.ensure("A")
+    pool.ensure("B")
+    assert pool.stats.evictions == 0  # 200 <= 250 on estimates
+    pool.ensure("C")  # 300 > 250 -> LRU eviction
+    assert pool.stats.evictions == 1
+    assert [d for d, _, _ in pool.residents()] == ["B", "C"]
+    assert pool.accounted_bytes() == 200
+    assert pool.estimate_reconciliation() == (0, 0)  # nothing measured
+
+
+def test_pool_budget_prefers_measured_bytes():
+    """Same estimates, but engines measure 150 real bytes per digest: the
+    byte budget must act on measured truth (two slots now exceed 250)."""
+    pool = ResidentRulesetPool(
+        _estimate_loader(100, measured=150),
+        max_resident=8,
+        max_resident_bytes=250,
+    )
+    pool.ensure("A")
+    pool.ensure("B")  # measured 300 > 250 -> evict A (estimates said 200)
+    assert pool.stats.evictions == 1
+    assert [d for d, _, _ in pool.residents()] == ["B"]
+    assert pool.accounted_bytes() == 150
+    est, meas = pool.estimate_reconciliation()
+    assert (est, meas) == (100, 150)
+
+
+def test_pool_estimate_error_ratio_exported():
+    reg = Registry()
+    pool = ResidentRulesetPool(
+        _estimate_loader(100, measured=150), max_resident=8, registry=reg
+    )
+    pool.ensure("A")
+    pool.ensure("B")
+    assert "trivy_tpu_pool_bytes_estimate_error_ratio 0.5" in reg.render()
+
+
+def test_pool_measured_zeroes_its_own_estimate_entry():
+    """Attribution must not double-count: once a digest has measured
+    engine bytes, the slot's own 'ruleset-pool' estimate entry zeroes."""
+    pool = ResidentRulesetPool(_estimate_loader(100, measured=150))
+    pool.ensure("A")
+    assert pool.accounted_bytes() == 150
+    assert memwatch.bytes_for_digest("A") == 150  # not 250
+
+
+def test_evict_to_bytes_never_drops_newest():
+    pool = ResidentRulesetPool(_estimate_loader(100, measured=150))
+    for d in ("A", "B", "C"):
+        pool.ensure(d)
+    evicted, freed = pool.evict_to_bytes(0)
+    assert evicted == 2 and freed == 300
+    assert [d for d, _, _ in pool.residents()] == ["C"]
+
+
+# ---------------------------------------------------------------------------
+# Watermark loop end-to-end (fake stats injector)
+# ---------------------------------------------------------------------------
+
+
+class _FakeEngine:
+    def scan_batch(self, items):
+        return [Secret(file_path=p) for p, _ in items]
+
+
+def _pressure_harness(state: dict, **cfg_kw):
+    """Scheduler + pool + flight recorder against an injected allocator
+    whose usage/limit come from the mutable `state` dict."""
+    memwatch.set_stats_provider(
+        lambda: {
+            "fake:0": {
+                "bytes_in_use": state["in_use"],
+                "peak_bytes_in_use": state["in_use"],
+                "bytes_limit": state["limit"],
+            }
+        }
+    )
+    def loader(digest: str):
+        memwatch.track("nfa-tensors", 100)  # measured engine bytes
+        return _FakeEngine(), 100, "warm"
+
+    sched = BatchScheduler(
+        _FakeEngine,
+        ServeConfig(batch_window_ms=1.0, **cfg_kw),
+        ruleset_loader=loader,
+    )
+    sched.flight = FlightRecorder(
+        snapshot_fn=sched.snapshot,
+        memory_fn=lambda: memwatch.snapshot(top=3),
+        registry=sched.registry,
+    )
+    return sched
+
+
+def test_hbm_soft_evicts_measured_then_hard_sheds_429():
+    state = {"in_use": 100, "limit": 1000}
+    sched = _pressure_harness(
+        state, hbm_soft_pct=50.0, hbm_hard_pct=90.0, retry_after_s=7.0
+    )
+    try:
+        items = [("a.env", b"AWS_KEY=AKIAQ6FAKEKEY1234567\n")]
+        for digest in ("A", "B", "C"):
+            sched.submit(items, client_id="t1", ruleset_digest=digest).result(
+                timeout=30
+            )
+        assert sched.hbm_state() == "ok"
+        assert sched.pool.resident_count() == 3
+        assert sched.flight.captured == 0
+
+        # Soft band: 60% of limit; excess over the 50% line is 100 bytes,
+        # so the pool must shed exactly one measured 100-byte slot (LRU).
+        state["in_use"] = 600
+        sched.submit(items, client_id="t1", ruleset_digest="C").result(
+            timeout=30
+        )
+        assert sched.hbm_state() == "soft"
+        assert sched.stats.hbm_evicted_slots == 1
+        assert sched.pool.resident_count() == 2
+        assert [d for d, _, _ in sched.pool.residents()] == ["B", "C"]
+
+        # The ok->soft transition is a flight record with the memory
+        # snapshot embedded.
+        assert sched.flight.captured == 1
+        rec = sched.flight.records()[0]
+        assert rec["reason"] == "hbm-pressure"
+        assert rec["method"] == "hbm-watch" and rec["code"] == 200
+        assert rec["memory"]["pressure"]["source"] == "measured"
+
+        # Hard band: 95% -> the submit itself is shed with Retry-After,
+        # after one more eviction attempt toward the soft line.
+        state["in_use"] = 950
+        with pytest.raises(HbmPressureError) as ei:
+            sched.submit(items, client_id="t1", ruleset_digest="C")
+        assert ei.value.retry_after_s == 7.0
+        assert sched.hbm_state() == "hard"
+        assert sched.stats.rejected_hbm == 1
+        # evict_to_bytes(0) spares the newest slot by design
+        assert sched.pool.resident_count() == 1
+        assert sched.flight.captured == 2
+        hard_rec = sched.flight.records()[0]  # newest first
+        assert hard_rec["reason"] == "hbm-pressure" and hard_rec["code"] == 429
+
+        text = sched.registry.render()
+        assert (
+            'trivy_tpu_flight_records_total{reason="hbm-pressure"} 2' in text
+        )
+        assert 'trivy_tpu_serve_rejected_total{reason="hbm"} 1' in text
+
+        # Recovery: pressure recedes, admissions resume, third transition.
+        state["in_use"] = 100
+        sched.submit(items, client_id="t1", ruleset_digest="A").result(
+            timeout=30
+        )
+        assert sched.hbm_state() == "ok"
+        assert sched.stats.hbm_transitions == 3
+    finally:
+        sched.close()
+
+
+def test_hbm_watermarks_disabled_is_noop():
+    state = {"in_use": 999, "limit": 1000}
+    sched = _pressure_harness(state, hbm_soft_pct=0.0, hbm_hard_pct=0.0)
+    try:
+        items = [("a.txt", b"plain\n")]
+        sched.submit(items, client_id="t1", ruleset_digest="A").result(
+            timeout=30
+        )
+        assert sched.hbm_state() == "ok"
+        assert sched.stats.hbm_transitions == 0
+        assert sched.flight.captured == 0
+    finally:
+        sched.close()
+
+
+@pytest.mark.mem_smoke
+def test_mem_smoke_pressure_cycle_end_to_end():
+    """make mem-smoke: allocate -> soft pressure -> measured eviction ->
+    hard shed -> recovery, with the exposition reflecting each phase."""
+    state = {"in_use": 200, "limit": 1000}
+    sched = _pressure_harness(
+        state, hbm_soft_pct=50.0, hbm_hard_pct=90.0, retry_after_s=3.0
+    )
+    memwatch.register_collectors(sched.registry)
+    try:
+        items = [("cfg/a.env", b"AWS_KEY=AKIAQ6FAKEKEY1234567\n")]
+        for digest in ("A", "B", "C", "D"):
+            sched.submit(items, client_id="t1", ruleset_digest=digest).result(
+                timeout=30
+            )
+        assert "trivy_tpu_hbm_pressure 0.2" in sched.registry.render()
+
+        state["in_use"] = 700  # 70%: soft band, 200 excess bytes
+        sched.submit(items, client_id="t2", ruleset_digest="D").result(
+            timeout=30
+        )
+        assert sched.hbm_state() == "soft"
+        assert sched.stats.hbm_evicted_slots == 2  # 2 x 100 measured bytes
+
+        state["in_use"] = 940  # 94%: hard band
+        with pytest.raises(HbmPressureError):
+            sched.submit(items, client_id="t2", ruleset_digest="D")
+        assert sched.stats.rejected_hbm == 1
+
+        state["in_use"] = 300  # recovered
+        sched.submit(items, client_id="t1", ruleset_digest="A").result(
+            timeout=30
+        )
+        assert sched.hbm_state() == "ok"
+        text = sched.registry.render()
+        assert "trivy_tpu_hbm_pressure 0.3" in text
+        assert (
+            'trivy_tpu_flight_records_total{reason="hbm-pressure"} 3' in text
+        )
+    finally:
+        sched.close()

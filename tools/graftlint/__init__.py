@@ -21,6 +21,9 @@ Rule catalogue (each with allow/deny fixtures under fixtures/):
          routed through the cardinality governor
   GL008  duration-clock hygiene: durations computed by subtracting
          wall-clock time.time() readings instead of perf_counter()
+  GL009  unledgered residency: device_put results stored on self.*/module
+         globals without a memwatch registration (or `# graftlint:
+         transient` annotation)
 
 The runtime complement is trivy_tpu/lockcheck.py (TRIVY_TPU_LOCKCHECK=1
 lock-order + owner-role sanitizer); graftlint checks what must hold by

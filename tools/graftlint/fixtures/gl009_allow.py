@@ -1,0 +1,26 @@
+"""GL009 allow fixture: ledgered, annotated, or genuinely transient."""
+
+import jax
+import numpy as np
+
+from trivy_tpu.obs import memwatch
+
+_SCRATCH_HOST = np.zeros((8, 8), np.float32)
+
+WARM_SCRATCH = jax.device_put(_SCRATCH_HOST)  # graftlint: transient
+
+
+class Engine:
+    def warm(self, arrs):
+        self._tensors = tuple(jax.device_put(a) for a in arrs)
+        memwatch.track(
+            "fixture-tensors", memwatch.nbytes_of(self._tensors), owner=self
+        )
+
+    def rebind(self, table):
+        # rebound on every dispatch; never outlives the call that reads it
+        self._scratch = jax.device_put(table)  # graftlint: transient
+
+    def stage(self, buf):
+        staged = jax.device_put(buf)  # local staging: not long-lived
+        return staged

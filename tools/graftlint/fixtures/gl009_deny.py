@@ -1,0 +1,16 @@
+"""GL009 deny fixture: long-lived device placements with no ledger entry."""
+
+import jax
+import numpy as np
+
+_LUT_HOST = np.zeros((64, 64), np.float32)
+
+RESIDENT_LUT = jax.device_put(_LUT_HOST)  # GL009: module-global residency
+
+
+class Engine:
+    def warm(self, arrs):
+        self._tensors = tuple(jax.device_put(a) for a in arrs)  # GL009
+
+    def pin(self, table):
+        self._table = jax.device_put(table)  # GL009

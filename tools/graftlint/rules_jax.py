@@ -506,3 +506,85 @@ def _sink_kind(call: ast.Call, tainted: set[str]) -> str | None:
     ):
         return f".{call.func.attr}() on a device value"
     return None
+
+
+# -- GL009: unledgered long-lived device placements ------------------------
+
+
+def _find_device_put(node: ast.AST) -> ast.Call | None:
+    """First call whose dotted name ends in ``device_put`` anywhere inside
+    the expression (covers ``tuple(jax.device_put(a) for a in ...)``)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            d = dotted_name(sub.func)
+            if d == "device_put" or d.endswith(".device_put"):
+                return sub
+    return None
+
+
+def _has_memwatch_call(scope: ast.AST) -> bool:
+    """Any ``memwatch.<fn>(...)`` call in the scope: the allocation is
+    ledgered (or deliberately scoped) by the device-memory observatory."""
+    for sub in ast.walk(scope):
+        if isinstance(sub, ast.Call):
+            d = dotted_name(sub.func)
+            if d.startswith("memwatch.") or ".memwatch." in d:
+                return True
+    return False
+
+
+@rule("GL009")
+def check_resident_device_put(mod: Module) -> list[Finding]:
+    """Long-lived device_put results must be memwatch-ledgered.
+
+    Scope: trivy_tpu/ (and graftlint's own fixtures).  A ``jax.device_put``
+    whose result lands on ``self.<attr>`` or a module-level global outlives
+    the call — it is exactly the HBM the device-memory ledger
+    (trivy_tpu/obs/memwatch.py) exists to attribute.  Either register the
+    bytes (a ``memwatch.track``/``memwatch.*`` call in the same function)
+    or mark the site ``# graftlint: transient`` when the binding is
+    genuinely short-lived (rebound per dispatch).
+    """
+    rel = mod.relpath
+    if not (
+        rel.startswith("trivy_tpu/")
+        or "/trivy_tpu/" in rel
+        or "graftlint/fixtures/" in rel
+    ):
+        return []
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        target = None
+        for tgt in node.targets:
+            if (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                target = f"self.{tgt.attr}"
+            elif isinstance(tgt, ast.Name) and mod.enclosing_function(
+                node
+            ) is None:
+                target = tgt.id  # module global
+        if target is None:
+            continue
+        if _find_device_put(node.value) is None:
+            continue
+        if mod.has_directive(node.lineno, "transient"):
+            continue
+        chain = mod.function_chain(node)
+        if any(_has_memwatch_call(f) for f in chain):
+            continue
+        out.append(
+            Finding(
+                "GL009",
+                mod.relpath,
+                node.lineno,
+                f"device_put result stored on {target} outlives the call "
+                "with no memwatch registration; track the bytes "
+                "(memwatch.track) or annotate `# graftlint: transient`",
+            )
+        )
+    return out

@@ -735,6 +735,20 @@ def build_parser() -> argparse.ArgumentParser:
         "(default 32; TRIVY_TPU_RESIDENT_CHUNKS)",
     )
     p_server.add_argument(
+        "--hbm-soft-pct", type=float,
+        default=_float_default("hbm-soft-pct", 85.0),
+        help="device-memory soft watermark as %% of the HBM bytes_limit: "
+        "above it admission LRU-evicts resident rulesets (measured bytes) "
+        "back under the line (0 disables)",
+    )
+    p_server.add_argument(
+        "--hbm-hard-pct", type=float,
+        default=_float_default("hbm-hard-pct", 95.0),
+        help="device-memory hard watermark as %% of the HBM bytes_limit: "
+        "above it new submissions get 429 + Retry-After until pressure "
+        "drops (0 disables)",
+    )
+    p_server.add_argument(
         "--profile-dir",
         default=_env_default("profile-dir", ""),
         help="default output directory for POST /admin/profile/start "
@@ -1021,6 +1035,8 @@ def main(argv: list[str] | None = None) -> int:
                 tenant_bytes_per_s=args.tenant_bytes_per_sec,
                 tenant_bytes_burst=args.tenant_bytes_burst,
                 max_tenant_series=args.max_tenant_series,
+                hbm_soft_pct=args.hbm_soft_pct,
+                hbm_hard_pct=args.hbm_hard_pct,
             ),
             secret_config=args.secret_config,
             rules_cache_dir=resolve_rules_cache_dir(args.rules_cache_dir),

@@ -32,6 +32,7 @@ import numpy as np
 from trivy_tpu.ftypes import Secret
 from trivy_tpu.engine.grams import GramSet, build_gram_set
 from trivy_tpu.engine.oracle import OracleScanner
+from trivy_tpu.obs import memwatch
 from trivy_tpu.obs import metrics as obs_metrics
 from trivy_tpu.obs import trace as obs_trace
 from trivy_tpu.engine.probes import ProbeSet, build_probe_set
@@ -556,17 +557,21 @@ class TpuSecretEngine:
                 digest = chunk_digest(buf) + self._codec_tag
                 hit = self._resident.get(digest)
                 if hit is not None:
-                    return (digest, hit, True)
+                    return (digest, hit, True, memwatch.NOOP_HANDLE)
             self._count_link(raw_n, buf.nbytes)
             with obs_trace.span("chunk.h2d", chunk=ci, bytes=buf.nbytes):
                 dev = jax.device_put(buf)
-            return (digest, dev, False)
+            # Staging buffers live device-side for up to `depth` chunks;
+            # the ledger entry rides the pipeline handle and releases at
+            # finish (or cancel on a drained pipeline).
+            mw = memwatch.track("pipeline-staging", buf.nbytes)
+            return (digest, dev, False, mw)
 
         def execute(ci, staged):
-            digest, dev, hit = staged
+            digest, dev, hit, mw = staged
             if hit:
                 self.stats.resident_hits += 1
-                return (digest, dev, True)
+                return (digest, dev, True, mw)
             self.stats.device_dispatches += 1
             with obs_trace.span("chunk.exec", chunk=ci):
                 # traced runs take the per-kernel attributed path (fenced
@@ -577,10 +582,11 @@ class TpuSecretEngine:
                     if obs_trace.enabled()
                     else exec_fn(dev)
                 )
-            return (digest, out, False)
+            return (digest, out, False, mw)
 
         def finish(ci, handle):
-            digest, out, hit = handle
+            digest, out, hit, mw = handle
+            mw.release()
             if not hit:
                 with obs_trace.span("chunk.fetch", chunk=ci):
                     ph = obs_metrics.device_phase("compact")
@@ -590,8 +596,12 @@ class TpuSecretEngine:
                     self._resident.put(digest, out)
             outs[ci] = out
 
+        def cancel(ci, handle):
+            handle[3].release()
+
         pipe = ChunkPipeline(
-            stage, execute, finish, depth=self.pipeline_depth
+            stage, execute, finish, depth=self.pipeline_depth,
+            cancel=cancel,
         )
         pipe.run(range(n_chunks))
         self.stats.h2d_overlap_s += pipe.stats.h2d_overlap_s

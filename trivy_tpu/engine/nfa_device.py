@@ -53,6 +53,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from trivy_tpu.engine.redfa import compile_search_nfa64, compute_prefix_bounds
+from trivy_tpu.obs import memwatch
 from trivy_tpu.obs import metrics as obs_metrics
 from trivy_tpu.obs import trace as obs_trace
 
@@ -177,6 +178,15 @@ class NfaVerifier:
                 )
             else:
                 self._tensors_on_device = tuple(jnp.asarray(a) for a in arrs)
+            # Compiled-ruleset NFA tensors are the canonical long-lived
+            # device allocation: ledger them for the verifier's lifetime
+            # (the pool's measured-byte accounting reads this back via
+            # the ambient ruleset-digest tag).
+            memwatch.track(
+                "nfa-tensors",
+                memwatch.nbytes_of(self._tensors_on_device),
+                owner=self,
+            )
         return self._tensors_on_device
 
     def _put(self, classes_t: np.ndarray, gids: np.ndarray):
@@ -447,6 +457,21 @@ class NfaVerifier:
     def _verify_stream(
         self, contents, pairs, start, stop, s_idx, keep
     ) -> None:
+        """Exception-safe shell around the stream dispatch: the per-call
+        stacked rule tensors are ledgered ("verify-stream") for exactly
+        the duration of the call, even when a dispatch raises."""
+        mw: list = []
+        try:
+            self._verify_stream_impl(
+                contents, pairs, start, stop, s_idx, keep, mw
+            )
+        finally:
+            for h in mw:
+                h.release()
+
+    def _verify_stream_impl(
+        self, contents, pairs, start, stop, s_idx, keep, mw
+    ) -> None:
         """Multi-rule stream dispatch: pairs group by FILE, each file's
         single SPAN of raw bytes (covering every candidate pair's window)
         packs into fixed rows, and every distinct candidate rule scans
@@ -536,6 +561,9 @@ class NfaVerifier:
                 if rep is not None
                 else jnp.asarray(t, jdt)
                 for t in (fol, acc, fst, lst)
+            )
+            mw.append(
+                memwatch.track("verify-stream", memwatch.nbytes_of(tens))
             )
 
         def _fetch_one():  # graftlint: fetch-boundary

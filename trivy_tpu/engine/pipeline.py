@@ -37,6 +37,7 @@ from collections import OrderedDict, deque
 from dataclasses import dataclass
 from typing import Callable, Iterable
 
+from trivy_tpu.obs import memwatch
 from trivy_tpu.obs import trace as obs_trace
 
 DEFAULT_DEPTH = 2
@@ -158,7 +159,8 @@ class ResidentChunkCache:
     `ArtifactCache.missing_blobs` so callers can diff before staging.
     """
 
-    def __init__(self, capacity: int | None = None):
+    def __init__(self, capacity: int | None = None,
+                 component: str = "chunk-cache"):
         if capacity is None:
             try:
                 capacity = int(
@@ -170,6 +172,10 @@ class ResidentChunkCache:
         self._lru: OrderedDict[str, object] = OrderedDict()
         self.hits = 0
         self.misses = 0
+        # Memwatch attribution: every cached result's bytes are ledgered
+        # under `component` for as long as the entry is resident.
+        self._component = component
+        self._mw: dict[str, object] = {}
 
     def __len__(self) -> int:
         return len(self._lru)
@@ -189,10 +195,19 @@ class ResidentChunkCache:
     def put(self, digest: str, value) -> None:
         if self.capacity == 0:
             return
+        old = self._mw.pop(digest, None)
+        if old is not None:
+            old.release()
         self._lru[digest] = value
         self._lru.move_to_end(digest)
+        self._mw[digest] = memwatch.track(
+            self._component, memwatch.nbytes_of(value), owner=self
+        )
         while len(self._lru) > self.capacity:
-            self._lru.popitem(last=False)
+            evicted, _ = self._lru.popitem(last=False)
+            mw = self._mw.pop(evicted, None)
+            if mw is not None:
+                mw.release()
 
     def missing_chunks(self, digests: Iterable[str]) -> list[str]:
         """ArtifactCache.missing_blobs shape: digests NOT resident (these
@@ -201,3 +216,6 @@ class ResidentChunkCache:
 
     def clear(self) -> None:
         self._lru.clear()
+        for mw in self._mw.values():
+            mw.release()
+        self._mw.clear()

@@ -1,8 +1,10 @@
 """Observability substrate: span tracing (obs/trace.py), the
 counter/gauge/histogram metrics registry (obs/metrics.py), per-method
 SLO burn-rate tracking (obs/slo.py), tenant-labelled families behind a
-cardinality governor (obs/tenantmetrics.py), and the breach-triggered
-flight recorder (obs/flight.py).
+cardinality governor (obs/tenantmetrics.py), the breach-triggered
+flight recorder (obs/flight.py), and the device-memory ledger
+(obs/memwatch.py: raw HBM stats + per-component attributed bytes, the
+pressure signal the admission watermarks act on).
 
 One trace from RPC ticket to TPU kernel: `RemoteSecretEngine` mints a
 trace_id, ships it as `X-Trivy-Trace-Id`, the server stamps it onto the
@@ -19,6 +21,13 @@ tracing was enabled (`TRIVY_TPU_TRACE=1` or `trace.enable()`), so the
 scan path pays one predicate per call site.
 """
 
-from trivy_tpu.obs import flight, metrics, slo, tenantmetrics, trace
+from trivy_tpu.obs import (
+    flight,
+    memwatch,
+    metrics,
+    slo,
+    tenantmetrics,
+    trace,
+)
 
-__all__ = ["flight", "metrics", "slo", "tenantmetrics", "trace"]
+__all__ = ["flight", "memwatch", "metrics", "slo", "tenantmetrics", "trace"]

@@ -14,7 +14,9 @@ into a small bounded incident ring:
     request touched);
   * a scheduler snapshot taken at breach time: lane depths, resident
     pool contents, QoS bucket levels — the context that explains *why*
-    the request waited.
+    the request waited;
+  * a device-memory snapshot (obs/memwatch.py) so an `hbm-pressure`
+    incident names who held the bytes when the watermark tripped.
 
 Incidents are served newest-first by `GET /debug/flight?limit=N` and,
 when `--flight-out` is set, appended to a JSONL file as they are captured
@@ -27,8 +29,8 @@ disk with incidents.
 
 Capture runs on request/handler threads and must never raise: an
 observability feature that can turn a breach into an outage is worse
-than no feature.  The snapshot callback, the gate callback, and the file
-append are each individually guarded.
+than no feature.  The snapshot callback, the gate callback, the memory
+callback, and the file append are each individually guarded.
 """
 
 from __future__ import annotations
@@ -61,12 +63,14 @@ class FlightRecorder:
         out_max_mb: float = DEFAULT_OUT_MAX_MB,
         gate_fn: Callable[[], list] | None = None,
         registry=None,
+        memory_fn: Callable[[], dict] | None = None,
     ):
         self._lock = lockcheck.make_lock("obs.flight")
         self._ring: deque[dict] = deque(maxlen=max(1, int(capacity)))  # owner: _lock
         self._seq = 0  # owner: _lock
         self._snapshot_fn = snapshot_fn
         self._gate_fn = gate_fn
+        self._memory_fn = memory_fn
         self.out_path = out_path
         # 0 disables the cap; the bookkeeping below is all owner: _lock.
         self.out_max_bytes = int(max(0.0, out_max_mb) * (1 << 20))
@@ -136,6 +140,14 @@ class FlightRecorder:
         except Exception as e:
             return [{"error": f"{type(e).__name__}: {e}"}]
 
+    def _memory_state(self) -> dict:
+        if self._memory_fn is None:
+            return {}
+        try:
+            return dict(self._memory_fn())
+        except Exception as e:
+            return {"error": f"{type(e).__name__}: {e}"}
+
     def capture(
         self,
         *,
@@ -160,6 +172,7 @@ class FlightRecorder:
             "spans": self._span_tree(trace_id),
             "scheduler": self._scheduler_state(),
             "gate": self._gate_state(),
+            "memory": self._memory_state(),
         }
         dropped = 0
         with self._lock:

@@ -49,6 +49,7 @@ from trivy_tpu.cache.store import (
 from trivy_tpu.deadline import ScanTimeoutError
 from trivy_tpu.obs import flight as obs_flight
 from trivy_tpu.obs import gatelog
+from trivy_tpu.obs import memwatch as obs_memwatch
 from trivy_tpu.obs import metrics as obs_metrics
 from trivy_tpu.obs import slo as obs_slo
 from trivy_tpu.obs import trace as obs_trace
@@ -126,6 +127,12 @@ class ScanServer:
         # scheduler's serve/engine families render as one /metrics body.
         self.registry = obs_metrics.Registry()
         self.metrics = _Metrics(self.registry)
+        # Device-memory ledger on for the server's lifetime (idempotent,
+        # process-global): engine/pool/cache allocations register from
+        # here on, and the watermark admission checks can act.  Costs a
+        # shared no-op handle per track() call site when off, nothing
+        # extra per scanned byte.
+        obs_memwatch.enable()
         self.driver = LocalDriver(
             cache, vuln_detector=init_vuln_scanner(db_dir, cache_dir)
         )
@@ -171,6 +178,9 @@ class ScanServer:
             # the incident record answers "why did verify run there".
             gate_fn=lambda: gatelog.records(limit=8),
             registry=self.registry,
+            # ... and the device-memory snapshot, so hbm-pressure (and any
+            # other) incidents name who held HBM at breach time.
+            memory_fn=lambda: obs_memwatch.snapshot(top=5),
         )
         # The scheduler captures deadline expiries itself (at expiry time,
         # when the snapshot still shows the queue that starved the ticket).
@@ -207,6 +217,10 @@ class ScanServer:
             labelnames=("version", "ruleset_digest", "epoch"),
         )
         self.registry.add_collect_hook(self._collect_build_info)
+        # Device-memory families (per-device per-component attributed
+        # bytes, peak, pressure) rebuilt from the process-global ledger at
+        # each scrape — same seat as the gate/device-phase hooks above.
+        obs_memwatch.register_collectors(self.registry)
         self.draining = False  # SIGTERM: reject new work with 503
         # Live-profiling window (POST /admin/profile/start|stop): default
         # output dir from --profile-dir, overridable per start request.
@@ -517,6 +531,34 @@ class ScanServer:
                     epoch=str(epoch),
                 ).set(1)
 
+    def memory_report(self) -> dict:
+        """The /debug/memory body: memwatch's snapshot (per-device raw +
+        attributed breakdown, residual, top allocations, pressure) plus
+        this server's watermarks, the admission state machine's band, and
+        the resident pool's estimate-vs-measured reconciliation.  The
+        per-component attributed sums equal the registered allocations
+        exactly — tolerance 0 by construction; only the raw residual
+        (backend in-use minus the ledger) is an estimate."""
+        report = obs_memwatch.snapshot()
+        report["watermarks"] = {
+            "soft_pct": self.serve_config.hbm_soft_pct,
+            "hard_pct": self.serve_config.hbm_hard_pct,
+        }
+        report["state"] = self.scheduler.hbm_state()
+        pool = self.scheduler.pool
+        if pool is not None:
+            est, meas = pool.estimate_reconciliation()
+            report["pool"] = {
+                "resident_slots": pool.resident_count(),
+                "estimate_bytes": pool.resident_bytes(),
+                "accounted_bytes": pool.accounted_bytes(),
+                "measured_bytes": meas,
+                "estimate_error_ratio": (
+                    (meas - est) / est if est > 0 else 0.0
+                ),
+            }
+        return report
+
     def push_ruleset(self, req: dict) -> dict:
         """POST /admin/ruleset/push: install a ruleset into the server's
         registry by digest.  Client-side-compiled pushes carry the YAML
@@ -610,6 +652,8 @@ DEBUG_SURFACES = {
     "(?limit=N, newest first)",
     "/debug/gate": "hybrid-gate decision audit: backend resolutions with "
     "cost-model inputs (?limit=N, newest first)",
+    "/debug/memory": "device-memory ledger: per-device raw vs attributed "
+    "bytes, watermarks, pressure state, pool estimate reconciliation",
 }
 
 
@@ -708,6 +752,10 @@ def _make_handler(server: ScanServer):
                         },
                     },
                 )
+            elif route == "/debug/memory":
+                # Device-memory ledger: raw HBM truth vs attributed
+                # truth, watermarks, and the pool's estimate error.
+                self._send(200, server.memory_report())
             elif route in ("/debug", "/debug/"):
                 # Index of every debug surface with its one-liner.
                 self._send(200, {"surfaces": DEBUG_SURFACES})

@@ -16,6 +16,7 @@ from trivy_tpu.serve.scheduler import (
     AdmissionError,
     BatchScheduler,
     ClientOverloadedError,
+    HbmPressureError,
     QueueFullError,
     QuotaExceededError,
     SchedulerClosedError,
@@ -35,6 +36,7 @@ __all__ = [
     "AdmissionError",
     "BatchScheduler",
     "ClientOverloadedError",
+    "HbmPressureError",
     "QueueFullError",
     "QuotaExceededError",
     "ResidentRulesetPool",

@@ -58,6 +58,7 @@ from dataclasses import dataclass, field
 from trivy_tpu import deadline as _deadline
 from trivy_tpu import lockcheck
 from trivy_tpu.deadline import ScanTimeoutError
+from trivy_tpu.obs import memwatch
 from trivy_tpu.obs import metrics as obs_metrics
 from trivy_tpu.obs import trace as obs_trace
 from trivy_tpu.obs.tenantmetrics import TenantMetrics
@@ -104,6 +105,11 @@ class SchedulerClosedError(AdmissionError):
     """Scheduler draining or shut down (HTTP 503)."""
 
 
+class HbmPressureError(AdmissionError):
+    """Device memory above the hard watermark — new admissions shed with
+    429 + Retry-After until pressure recedes (obs/memwatch.py)."""
+
+
 @dataclass
 class ServeConfig:
     """Knobs, CLI-exposed as `server --batch-window-ms` etc. (env vars
@@ -123,6 +129,9 @@ class ServeConfig:
     tenant_bytes_burst: float = 0.0  # byte bucket depth (0 = 1s of rate)
     # -- per-tenant observability (obs/tenantmetrics.py) -----------------
     max_tenant_series: int = 16  # top-K tenants with own metric series
+    # -- device-memory watermarks (obs/memwatch.py), % of bytes_limit ----
+    hbm_soft_pct: float = 85.0  # soft: LRU-evict pool toward target (0=off)
+    hbm_hard_pct: float = 95.0  # hard: shed new admissions with 429 (0=off)
 
     def default_quota(self) -> TenantQuota:
         return TenantQuota(
@@ -182,6 +191,9 @@ class SchedulerStats:
     rejected_client: int = 0
     rejected_closed: int = 0
     rejected_quota: int = 0  # tenant token bucket said no
+    rejected_hbm: int = 0  # device memory above the hard watermark
+    hbm_evicted_slots: int = 0  # pool slots shed by soft-pressure eviction
+    hbm_transitions: int = 0  # ok/soft/hard state changes observed
     expired: int = 0  # cancelled before dispatch
     batches: int = 0
     multi_request_batches: int = 0  # batches coalescing >= 2 tickets
@@ -262,6 +274,9 @@ class BatchScheduler:
         # recorder so deadline expiries captured here land in the same ring
         # as RPC-side breaches.  None = recording off (standalone use).
         self.flight = None
+        # HBM pressure state machine (ok/soft/hard), advanced by submit-
+        # side watermark checks against memwatch.pressure().  owner: _lock
+        self._hbm_state = "ok"
         self._register_metrics()
 
     def _register_metrics(self) -> None:
@@ -289,7 +304,7 @@ class BatchScheduler:
         # Pre-create the reason children so every rejection lane scrapes
         # as 0 before its first event (dashboards alert on rate(), which
         # needs the series to exist).
-        for reason in ("queue_full", "client_cap", "closed", "quota"):
+        for reason in ("queue_full", "client_cap", "closed", "quota", "hbm"):
             self._m_rejected.labels(reason=reason)
         self._m_expired = r.counter(
             "trivy_tpu_serve_expired_total",
@@ -402,6 +417,11 @@ class BatchScheduler:
         override = self.qos.max_inflight(ticket.client_id)
         if override is not None:
             inflight_cap = override
+        # Device-memory watermarks next, BEFORE pool.ensure can load yet
+        # another ruleset into scarce HBM: soft pressure evicts LRU pool
+        # slots toward target, hard pressure sheds this admission with a
+        # 429 through the same AdmissionError path the quotas use.
+        self._check_hbm(ticket)
         # Residency next: make the requested ruleset's engine resident
         # (LRU admit, warm path when the registry has the artifact) BEFORE
         # the ticket can enter a lane — a lane must never hold tickets for
@@ -461,6 +481,74 @@ class BatchScheduler:
                 self._thread.start()
             self._not_empty.notify()
         return ticket.future
+
+    def _check_hbm(self, ticket: Ticket) -> None:
+        """Advance the HBM pressure state machine and act on it.
+
+        Runs on request threads before any scheduler lock is held for the
+        ticket.  Soft (>= hbm_soft_pct of the device limit): evict LRU
+        resident-pool slots down to the byte target that would bring the
+        fraction back under the soft line, using measured bytes.  Hard
+        (>= hbm_hard_pct): reject with 429 + Retry-After.  Every state
+        transition is promoted into the flight ring with reason
+        "hbm-pressure" — the capture embeds the memory snapshot, so the
+        incident names who held HBM when the watermark tripped.  No-op
+        when both watermarks are 0, memwatch is off, or no byte limit is
+        known (CPU without an injected budget)."""
+        cfg = self.config
+        if (cfg.hbm_soft_pct <= 0 and cfg.hbm_hard_pct <= 0) or (
+            not memwatch.enabled()
+        ):
+            return
+        p = memwatch.pressure()
+        if p["source"] == "none":
+            return
+        pct = p["fraction"] * 100.0
+        state = "ok"
+        if cfg.hbm_hard_pct > 0 and pct >= cfg.hbm_hard_pct:
+            state = "hard"
+        elif cfg.hbm_soft_pct > 0 and pct >= cfg.hbm_soft_pct:
+            state = "soft"
+        with self._lock:
+            prev = self._hbm_state
+            self._hbm_state = state
+            if state != prev:
+                self.stats.hbm_transitions += 1
+        if state != prev and self.flight is not None:
+            # Outside every scheduler lock: capture re-takes them via
+            # snapshot_fn (same rule as the _expire capture).
+            self.flight.capture(
+                trace_id=ticket.trace_id,
+                method="hbm-watch",
+                tenant=ticket.client_id,
+                code=429 if state == "hard" else 200,
+                elapsed_s=0.0,
+                reason="hbm-pressure",
+            )
+        if state in ("soft", "hard") and (
+            self.pool is not None and p["bytes_limit"] > 0
+        ):
+            # Evict toward the byte target that puts the device back at
+            # the soft line; freeing is bounded by what the pool holds.
+            soft = cfg.hbm_soft_pct or cfg.hbm_hard_pct
+            excess = int((pct - soft) / 100.0 * p["bytes_limit"])
+            target = max(0, self.pool.accounted_bytes() - excess)
+            evicted, _freed = self.pool.evict_to_bytes(target)
+            self.stats.hbm_evicted_slots += evicted
+        if state == "hard":
+            self.stats.rejected_hbm += 1
+            self._m_rejected.labels(reason="hbm").inc()
+            self.tenant_metrics.reject(ticket.client_id, "hbm")
+            raise HbmPressureError(
+                f"device memory at {pct:.1f}% of limit "
+                f"(hard watermark {cfg.hbm_hard_pct:.0f}%)",
+                cfg.retry_after_s,
+            )
+
+    def hbm_state(self) -> str:
+        """Current watermark band: "ok", "soft", or "hard"."""
+        with self._lock:
+            return self._hbm_state
 
     def queue_depth(self) -> int:
         with self._lock:
@@ -715,7 +803,12 @@ class BatchScheduler:
                 bytes=nbytes,
                 trace_ids=[t.trace_id for t in batch if t.trace_id],
             ):
-                results = engine.scan_batch(combined)
+                # Digest scope for memwatch: lazy first-dispatch device
+                # allocations (NFA tensor shipping, chunk-cache fills)
+                # register under this lane's ruleset, which is what the
+                # pool's measured-byte accounting reads back.
+                with memwatch.ruleset_digest(lane_digest or digest):
+                    results = engine.scan_batch(combined)
             phase_deltas: dict[str, float] = {}
             if phases_before is not None:
                 # SieveStats accumulates across scan_batch calls; the
@@ -762,6 +855,13 @@ class BatchScheduler:
                     # the hybrid gate's routing verdict for this engine
                     # (why verify ran on dfa/device), when it has one
                     "gate": getattr(engine, "gate_decision", None),
+                    # device-memory posture at dispatch: pressure fraction
+                    # + ledger totals (obs/memwatch.py) and the admission
+                    # state machine's current watermark band
+                    "memory": {
+                        **memwatch.explain_block(),
+                        "state": self._hbm_state,
+                    },
                     "batch": {
                         "tickets": len(batch),
                         "items": len(combined),
@@ -818,11 +918,13 @@ class BatchScheduler:
             }
             inflight = dict(self._inflight)
             admitting = self._admitting
+            hbm_state = self._hbm_state
         out = {
             "lanes": lanes,
             "queue_depth": sum(l["depth"] for l in lanes.values()),
             "inflight_per_client": inflight,
             "admitting": admitting,
+            "hbm_state": hbm_state,
         }
         if self.pool is not None:
             out["pool"] = [

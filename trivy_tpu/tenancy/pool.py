@@ -21,7 +21,16 @@ Lock discipline (the "pool eviction vs. scheduler dispatch" ABBA trap):
 engine-construction locks (link probe, registry manager) — always runs
 *outside* `_lock`, and manager methods are never called under it.  The
 scheduler never holds its own lock while calling into the pool, so the
-order graph gains no edge in either direction.
+order graph gains no edge in either direction.  The one lock taken under
+`_lock` is obs/memwatch's ledger lock (a leaf: memwatch never calls out
+while holding it), for measured-byte accounting.
+
+Byte accounting (PR 11): each slot's `nbytes` is the loader's manifest
+*estimate*; `_slot_cost` prefers memwatch-*measured* bytes for the digest
+when engine-level registrations exist, so both the `--max-resident-mb`
+budget and the HBM soft-watermark eviction act on real usage.  The
+estimate error is exported as
+`trivy_tpu_pool_bytes_estimate_error_ratio`.
 """
 
 from __future__ import annotations
@@ -31,6 +40,7 @@ from concurrent.futures import Future
 from dataclasses import dataclass
 
 from trivy_tpu import lockcheck
+from trivy_tpu.obs import memwatch
 from trivy_tpu.registry.manager import RulesetManager
 
 
@@ -54,12 +64,14 @@ class PoolStats:
 
 
 class _Slot:
-    __slots__ = ("digest", "manager", "nbytes")
+    __slots__ = ("digest", "manager", "nbytes", "mw")
 
-    def __init__(self, digest: str, manager: RulesetManager, nbytes: int):
+    def __init__(self, digest: str, manager: RulesetManager, nbytes: int,
+                 mw=memwatch.NOOP_HANDLE):
         self.digest = digest
         self.manager = manager
-        self.nbytes = nbytes
+        self.nbytes = nbytes  # manifest ESTIMATE from the loader
+        self.mw = mw  # memwatch registration carrying the slot's bytes
 
 
 class ResidentRulesetPool:
@@ -115,7 +127,11 @@ class ResidentRulesetPool:
             fut.result(timeout=timeout_s)  # re-raises the builder's error
             return
         try:
-            engine, nbytes, source = self._loader(digest)
+            # Digest scope: device allocations the build registers with
+            # memwatch (compiled NFA tensors, caches) carry this digest,
+            # which is where _slot_cost's measured bytes come from.
+            with memwatch.ruleset_digest(digest):
+                engine, nbytes, source = self._loader(digest)
             self._admit(digest, engine, nbytes, source)
         except BaseException as e:
             with self._lock:
@@ -132,8 +148,15 @@ class ResidentRulesetPool:
         thread installs it (epoch bump) at its first dispatch."""
         manager = RulesetManager(lambda: engine)
         manager.stage(engine, digest)
+        # The slot's own ledger entry carries the manifest estimate; once
+        # engine-level registrations measure this digest for real,
+        # _slot_cost zeroes it so attribution never double-counts.
+        mw = memwatch.track(
+            "ruleset-pool", int(nbytes), digest=digest, owner=manager
+        )
         with self._lock:
-            self._slots[digest] = _Slot(digest, manager, int(nbytes))
+            old = self._slots.pop(digest, None)
+            self._slots[digest] = _Slot(digest, manager, int(nbytes), mw)
             self._slots.move_to_end(digest)
             self.stats.admits += 1
             if source == "warm":
@@ -141,20 +164,61 @@ class ResidentRulesetPool:
             else:
                 self.stats.cold_admits += 1
             self._evict_over_budget_locked()
+        if old is not None:
+            old.mw.release()
+
+    def _slot_cost(self, s: _Slot) -> int:
+        """Bytes a slot is charged against budgets: memwatch-MEASURED
+        bytes for the digest when engine-level registrations exist (the
+        slot's own "ruleset-pool" estimate entry is zeroed so attribution
+        never double-counts), the manifest estimate otherwise."""
+        measured = memwatch.bytes_for_digest(
+            s.digest, exclude=("ruleset-pool",)
+        )
+        if measured > 0:
+            if s.mw.nbytes:
+                s.mw.resize(0)
+            return measured
+        if s.mw.nbytes != s.nbytes:
+            s.mw.resize(s.nbytes)
+        return s.nbytes
 
     def _evict_over_budget_locked(self) -> None:  # graftlint: holds(_lock)
         # Never evict down past the newest slot: a single ruleset larger
         # than max_resident_bytes still serves (degraded to pool-of-one).
+        # The byte budget holds against measured-preferring _slot_cost.
         while len(self._slots) > 1 and (
             len(self._slots) > self.max_resident
             or (
                 self.max_resident_bytes
-                and sum(s.nbytes for s in self._slots.values())
+                and sum(self._slot_cost(s) for s in self._slots.values())
                 > self.max_resident_bytes
             )
         ):
-            self._slots.popitem(last=False)
+            _, s = self._slots.popitem(last=False)
             self.stats.evictions += 1
+            s.mw.release()
+
+    def evict_to_bytes(self, target_bytes: int) -> tuple[int, int]:
+        """HBM soft-watermark actuator: drop LRU slots (never the newest)
+        until accounted bytes fit under `target_bytes`; returns
+        (evicted_slots, freed_bytes).  Costs are measured-preferring via
+        _slot_cost, so the pressure loop acts on real usage — the freed
+        engine's own ledger entries release when its last batch reference
+        drops (memwatch owner finalizers)."""
+        freed = 0
+        evicted = 0
+        with self._lock:
+            while len(self._slots) > 1 and (
+                sum(self._slot_cost(s) for s in self._slots.values())
+                > max(0, int(target_bytes))
+            ):
+                _, s = self._slots.popitem(last=False)
+                freed += self._slot_cost(s)
+                evicted += 1
+                self.stats.evictions += 1
+                s.mw.release()
+        return evicted, freed
 
     # -- dispatch (engine-owner thread) -----------------------------------
 
@@ -168,7 +232,8 @@ class ResidentRulesetPool:
             if slot is not None:
                 self._slots.move_to_end(digest)
         if slot is None:
-            engine, nbytes, source = self._loader(digest)
+            with memwatch.ruleset_digest(digest):
+                engine, nbytes, source = self._loader(digest)
             self._admit(digest, engine, nbytes, source)
             with self._lock:
                 slot = self._slots[digest]
@@ -190,8 +255,32 @@ class ResidentRulesetPool:
             return len(self._slots)
 
     def resident_bytes(self) -> int:
+        """Manifest-estimate bytes over resident slots (the historical
+        surface; budgets use accounted_bytes)."""
         with self._lock:
             return sum(s.nbytes for s in self._slots.values())
+
+    def accounted_bytes(self) -> int:
+        """Budget-relevant resident bytes: memwatch-measured per digest
+        when available, manifest estimate otherwise."""
+        with self._lock:
+            return sum(self._slot_cost(s) for s in self._slots.values())
+
+    def estimate_reconciliation(self) -> tuple[int, int]:
+        """(estimate_sum, measured_sum) over resident slots whose digest
+        has memwatch-measured bytes; (0, 0) when nothing is measured.
+        Feeds trivy_tpu_pool_bytes_estimate_error_ratio."""
+        with self._lock:
+            slots = list(self._slots.values())
+        est = meas = 0
+        for s in slots:
+            m = memwatch.bytes_for_digest(
+                s.digest, exclude=("ruleset-pool",)
+            )
+            if m > 0:
+                est += s.nbytes
+                meas += m
+        return est, meas
 
     def _register_metrics(self, registry) -> None:
         self._m_resident = registry.gauge(
@@ -232,6 +321,11 @@ class ResidentRulesetPool:
             "trivy_tpu_pool_resident_bytes",
             "estimated device bytes pinned by occupied pool slots",
         )
+        self._m_est_err = registry.gauge(
+            "trivy_tpu_pool_bytes_estimate_error_ratio",
+            "(measured - estimate) / estimate over resident slots with "
+            "memwatch-measured bytes (0 = estimates exact or unmeasured)",
+        )
         registry.add_collect_hook(self._collect)
 
     def _collect(self) -> None:
@@ -248,3 +342,5 @@ class ResidentRulesetPool:
         self._m_admits.labels(source="warm").set_total(self.stats.warm_admits)
         self._m_admits.labels(source="cold").set_total(self.stats.cold_admits)
         self._m_evictions.set_total(self.stats.evictions)
+        est, meas = self.estimate_reconciliation()
+        self._m_est_err.set((meas - est) / est if est > 0 else 0.0)
