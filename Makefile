@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test lint lint-changed lockcheck smoke serve-smoke obs-smoke slo-smoke tenancy-smoke mem-smoke bench bench-link checks-corpus rules-cache perf-gate
+.PHONY: test lint lint-changed lockcheck smoke serve-smoke obs-smoke slo-smoke tenancy-smoke mem-smoke chaos-smoke bench bench-link checks-corpus rules-cache perf-gate
 
 # Tier-1: the suite the driver holds the repo to (fast, CPU, no slow marks).
 # Lint runs first — a graftlint finding fails the build before pytest
@@ -12,9 +12,10 @@ PY ?= python
 test: lint
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
+	$(MAKE) chaos-smoke
 	$(MAKE) perf-gate
 
-# Static analysis: graftlint (project rules GL001-GL009, always available)
+# Static analysis: graftlint (project rules GL001-GL010, always available)
 # plus ruff + mypy when the environment has them (the pinned CI container
 # may not; config lives in pyproject.toml either way).
 lint:
@@ -74,7 +75,8 @@ obs-smoke:
 		-q -p no:cacheprovider && \
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
-		BENCH_IMAGE=0 BENCH_TENANT=0 BENCH_MEM=0 $(PY) bench.py --smoke
+		BENCH_IMAGE=0 BENCH_TENANT=0 BENCH_MEM=0 BENCH_FAULT=0 \
+		$(PY) bench.py --smoke
 
 # SLO / flight-recorder smoke: boot the server with a deliberately tight
 # latency objective, drive mixed-tenant traffic with one induced breach,
@@ -97,7 +99,8 @@ tenancy-smoke:
 		-q -m 'not slow' -p no:cacheprovider && \
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
-		BENCH_IMAGE=0 BENCH_OBS=0 BENCH_MEM=0 $(PY) bench.py --smoke
+		BENCH_IMAGE=0 BENCH_OBS=0 BENCH_MEM=0 BENCH_FAULT=0 \
+		$(PY) bench.py --smoke
 
 # Device-memory observatory smoke: memwatch ledger units, pool
 # estimate-vs-measured reconciliation, pressure watermark e2e
@@ -109,7 +112,18 @@ mem-smoke:
 		-m mem_smoke -q -p no:cacheprovider && \
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
-		BENCH_IMAGE=0 BENCH_TENANT=0 BENCH_OBS=0 $(PY) bench.py --smoke
+		BENCH_IMAGE=0 BENCH_TENANT=0 BENCH_OBS=0 BENCH_FAULT=0 \
+		$(PY) bench.py --smoke
+
+# Chaos smoke: the fault-injection serve suite (tests/test_chaos_serve.py,
+# -m chaos).  Arms the in-repo fault plane on the dispatch/device/rpc
+# seams and asserts the failure-domain contract: byte-identical findings
+# under per-batch degradation, zero lost tickets, breaker opens under
+# sustained failure and re-closes when the fault budget clears, and a
+# 20%-connection-reset RPC profile completes every request.
+chaos-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos \
+		-p no:cacheprovider
 
 # Performance regression gate: one smoke bench run (heavy sections off,
 # primary corpus only) appends to a throwaway ledger, then
@@ -139,7 +153,8 @@ bench:
 bench-link:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 BENCH_IMAGE=0 \
-		BENCH_TENANT=0 BENCH_FILES=2000 BENCH_PARITY=sample $(PY) bench.py
+		BENCH_TENANT=0 BENCH_FAULT=0 BENCH_FILES=2000 BENCH_PARITY=sample \
+		$(PY) bench.py
 
 # Precompile the builtin ruleset into the registry cache (trivy_tpu/registry/)
 # so every later scan/server process warm-starts without compiling rules.

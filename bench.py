@@ -1317,6 +1317,101 @@ def bench_mem() -> dict:
     return out
 
 
+def bench_fault(engine) -> dict:
+    """BENCH_FAULT: failure-domain economics (trivy_tpu/faults.py,
+    engine/breaker.py, the serve scheduler's degradation ladder).
+
+    Serves one small request stream through a BatchScheduler twice — once
+    healthy, once with a dispatch fault armed on EVERY batch (so every
+    batch pays fault detection + the byte-identical host re-run) — and
+    reports parity (findings identical across the two runs, asserted into
+    parity_identical), healthy vs degraded throughput, the single-batch
+    recovery latency, and the breaker's open/re-close counters under an
+    x-limited fault (the breaker must re-close once the fault clears).
+    """
+    from trivy_tpu import faults as faults_mod
+    from trivy_tpu.serve import BatchScheduler, ServeConfig
+
+    secret = b"AWS_ACCESS_KEY_ID=AKIAQ6FAKEKEY1234567\n"
+    requests = []
+    for r in range(12):
+        items = []
+        for i in range(4):
+            filler = f"token_{r}_{i} = value\n".encode() * (i + 1)
+            body = secret + filler if (r + i) % 2 == 0 else filler
+            items.append((f"req{r}/file{i}.env", body))
+        requests.append(items)
+    n_files = sum(len(items) for items in requests)
+
+    def flatten(secrets):
+        return [
+            (s.file_path, [(f.rule_id, f.start_line, f.match) for f in s.findings])
+            for s in secrets
+        ]
+
+    def serve_all():
+        sched = BatchScheduler(
+            lambda: engine, ServeConfig(batch_window_ms=5.0)
+        )
+        t0 = time.perf_counter()
+        futs = [
+            sched.submit(items, client_id=f"c{i}")
+            for i, items in enumerate(requests)
+        ]
+        outs = [flatten(f.result(timeout=120)) for f in futs]
+        wall = time.perf_counter() - t0
+        sched.drain(timeout=30)
+        return outs, wall, sched
+
+    out: dict = {"files": n_files}
+    try:
+        healthy, wall_h, _ = serve_all()
+        faults_mod.configure("sched.dispatch:error@1")
+        degraded, wall_d, sched_d = serve_all()
+    finally:
+        faults_mod.clear()
+    out["parity_identical"] = 1 if healthy == degraded else 0
+    out["healthy_files_per_sec"] = round(n_files / max(wall_h, 1e-9), 1)
+    out["degraded_files_per_sec"] = round(n_files / max(wall_d, 1e-9), 1)
+    out["degraded_ratio"] = round(max(wall_h, 1e-9) / max(wall_d, 1e-9), 3)
+    out["degraded_batches"] = sched_d.stats.degraded_batches
+
+    # Single-batch recovery latency: one dispatch fault, one host re-run.
+    faults_mod.configure("sched.dispatch:error@1x1")
+    try:
+        sched = BatchScheduler(lambda: engine, ServeConfig(batch_window_ms=0.0))
+        t0 = time.perf_counter()
+        sched.submit(requests[0]).result(timeout=120)
+        out["recovery_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+        sched.drain(timeout=30)
+    finally:
+        faults_mod.clear()
+
+    # Breaker cycle: an x-limited fault trips it open; once the budget is
+    # spent the half-open probe succeeds and it re-closes.
+    faults_mod.configure("sched.dispatch:error@1x3")
+    try:
+        sched = BatchScheduler(
+            lambda: engine,
+            ServeConfig(
+                batch_window_ms=0.0,
+                breaker_threshold=3,
+                breaker_cooldown_s=0.05,
+            ),
+        )
+        for i in range(3):
+            sched.submit([(f"trip{i}.txt", b"x = 1\n")]).result(timeout=120)
+        time.sleep(0.08)
+        sched.submit([("probe.txt", b"x = 1\n")]).result(timeout=120)
+        snap = sched.breaker.snapshot()
+        out["breaker_opened"] = snap["opened_total"]
+        out["breaker_reclosed"] = snap["reclosed_total"]
+        sched.drain(timeout=30)
+    finally:
+        faults_mod.clear()
+    return out
+
+
 def _compact_detail(detail: dict) -> dict:
     """Headline subset of `detail` small enough for the tail-captured
     stdout line; the full structure lives in the side file."""
@@ -1379,6 +1474,17 @@ def _compact_detail(detail: dict) -> dict:
                 "error",
             )
             if k in mm
+        }
+    ft = detail.get("fault")
+    if isinstance(ft, dict):
+        c["fault"] = {
+            k: ft[k]
+            for k in (
+                "parity_identical", "degraded_ratio", "recovery_ms",
+                "breaker_opened", "breaker_reclosed", "degraded_batches",
+                "error",
+            )
+            if k in ft
         }
     vb = detail.get("verify_backend")
     if isinstance(vb, dict):
@@ -1608,6 +1714,14 @@ def main() -> None:
             detail["mem"] = bench_mem()
         except Exception as e:
             detail["mem"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if os.environ.get("BENCH_FAULT", "1") == "1":
+        # Failure domains (faults + breaker + scheduler ladder): degraded
+        # parity/throughput, recovery latency, breaker open/re-close.
+        try:
+            detail["fault"] = bench_fault(engine)
+        except Exception as e:
+            detail["fault"] = {"error": f"{type(e).__name__}: {e}"}
 
     if os.environ.get("BENCH_COLDSTART", "1") == "1":
         # Registry cold-compile vs warm-load economics (trivy_tpu/registry/).

@@ -39,3 +39,19 @@ def pytest_sessionfinish(session, exitstatus):
 
     lockcheck.assert_clean()  # raises -> nonzero exit
     print("\nlockcheck: clean")
+
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _isolate_rpc_retry_budget():
+    """The client retry budget is deliberately process-wide (it guards a
+    whole process against retry storms), which in a test run means one
+    suite's retry traffic can drain another suite's budget inside the
+    60s window.  Reset it per test — budget POLICY has its own tests in
+    test_rpc_retry.py; everything else should see a fresh floor."""
+    yield
+    mod = sys.modules.get("trivy_tpu.rpc.client")
+    if mod is not None:
+        mod.reset_retry_budget()

@@ -1,0 +1,135 @@
+"""Device circuit breaker (trivy_tpu/engine/breaker.py): the full state
+machine on a fake clock — trip threshold, sliding failure window, cooldown
+to half-open, single-probe admission, re-close and re-open."""
+
+from trivy_tpu.engine.breaker import STATE_CODES, CircuitBreaker
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _breaker(clock, **kw):
+    kw.setdefault("failure_threshold", 3)
+    kw.setdefault("window_s", 30.0)
+    kw.setdefault("cooldown_s", 5.0)
+    return CircuitBreaker(clock=clock, **kw)
+
+
+def test_starts_closed_and_allows():
+    b = _breaker(FakeClock())
+    assert b.state == "closed"
+    assert b.allow()
+    assert b.state_code() == STATE_CODES["closed"]
+
+
+def test_opens_on_threshold_failures():
+    b = _breaker(FakeClock())
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed" and b.allow()
+    b.record_failure()
+    assert b.state == "open"
+    assert not b.allow()
+    assert b.snapshot()["opened_total"] == 1
+
+
+def test_window_expires_old_failures():
+    clk = FakeClock()
+    b = _breaker(clk, window_s=10.0)
+    b.record_failure()
+    b.record_failure()
+    clk.advance(11.0)  # both fall out of the window
+    b.record_failure()
+    assert b.state == "closed"  # only 1 failure in window
+
+
+def test_success_clears_failure_count():
+    b = _breaker(FakeClock())
+    b.record_failure()
+    b.record_failure()
+    b.record_success()
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed"
+
+
+def test_cooldown_half_open_single_probe_then_reclose():
+    clk = FakeClock()
+    b = _breaker(clk)
+    for _ in range(3):
+        b.record_failure()
+    assert b.state == "open" and not b.allow()
+    clk.advance(5.0)
+    assert b.allow()  # cooldown elapsed: the probe
+    assert b.state == "half-open"
+    assert not b.allow()  # one probe at a time
+    b.record_success()
+    assert b.state == "closed"
+    snap = b.snapshot()
+    assert snap["reclosed_total"] == 1
+    assert snap["probes_total"] == 1
+    assert b.allow()
+
+
+def test_probe_failure_reopens_and_restarts_cooldown():
+    clk = FakeClock()
+    b = _breaker(clk)
+    for _ in range(3):
+        b.record_failure()
+    clk.advance(5.0)
+    assert b.allow()
+    b.record_failure()  # probe failed
+    assert b.state == "open"
+    assert b.snapshot()["opened_total"] == 2
+    assert not b.allow()  # cooldown restarted at probe failure
+    clk.advance(5.0)
+    assert b.allow()
+    b.record_success()
+    assert b.state == "closed"
+
+
+def test_transition_listener_sees_every_edge():
+    clk = FakeClock()
+    seen = []
+    b = _breaker(
+        clk, on_transition=lambda old, new, why: seen.append((old, new))
+    )
+    for _ in range(3):
+        b.record_failure()
+    clk.advance(5.0)
+    b.allow()
+    b.record_success()
+    assert seen == [
+        ("closed", "open"),
+        ("open", "half-open"),
+        ("half-open", "closed"),
+    ]
+
+
+def test_listener_exception_does_not_poison_routing():
+    clk = FakeClock()
+
+    def boom(old, new, why):
+        raise RuntimeError("bad listener")
+
+    b = _breaker(clk, on_transition=boom)
+    for _ in range(3):
+        b.record_failure()
+    assert b.state == "open"  # transition happened despite the raise
+
+
+def test_snapshot_shape():
+    b = _breaker(FakeClock())
+    snap = b.snapshot()
+    assert snap["state"] == "closed" and snap["state_code"] == 0
+    assert snap["failure_threshold"] == 3
+    assert snap["window_s"] == 30.0 and snap["cooldown_s"] == 5.0
+    assert snap["failures_in_window"] == 0

@@ -1,0 +1,300 @@
+"""Chaos suite: serve-path parity and liveness under injected faults.
+
+The acceptance contract for the failure domains: with faults armed on the
+dispatch boundary EVERY batch, findings stay byte-identical to an
+unfaulted run (the host DFA re-run is the same automaton over the same
+prefix bounds), zero tickets are lost (every future resolves), the
+breaker opens under sustained failure and re-closes once the fault
+clears, and a 20%-connection-reset RPC profile completes every request
+through the client retry loop.
+
+`make chaos-smoke` runs exactly this module (-m chaos); the profiles are
+armed programmatically (faults.configure) so the schedule is pinned by
+the in-repo seed, not the invoking shell.
+"""
+
+import threading
+import time
+
+import pytest
+
+from trivy_tpu import faults
+from trivy_tpu.ftypes import Secret
+from trivy_tpu.serve import BatchScheduler, ServeConfig
+
+pytestmark = pytest.mark.chaos
+
+SECRET_LINE = b"AWS_ACCESS_KEY_ID=AKIAQ6FAKEKEY1234567\n"
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """No fault profile outlives its test."""
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def engine():
+    from trivy_tpu.engine.hybrid import make_secret_engine
+
+    return make_secret_engine()
+
+
+def _flatten(secrets):
+    return [
+        (
+            s.file_path,
+            [
+                (f.rule_id, f.start_line, f.end_line, f.match, f.severity)
+                for f in s.findings
+            ],
+        )
+        for s in secrets
+    ]
+
+
+def _requests(n=6, per=3):
+    requests = []
+    for r in range(n):
+        items = []
+        for i in range(per):
+            filler = f"token_{r}_{i} = value\n".encode() * (i + 1)
+            body = SECRET_LINE + filler if (r + i) % 2 == 0 else filler
+            items.append((f"req{r}/file{i}.env", body))
+        requests.append(items)
+    return requests
+
+
+class FlakyEngine:
+    """Fake engine with a host path: scan_batch raises `fail_with` for the
+    first `fail_n` calls, then succeeds; scan_batch_host always succeeds.
+    Secrets are tagged with the path that produced them so tests can tell
+    device results from host results apart (real engines are
+    byte-identical by construction; fakes prove the routing)."""
+
+    def __init__(self, fail_n=0, fail_with=None):
+        self.fail_n = fail_n
+        self.fail_with = fail_with or RuntimeError("injected device failure")
+        self.calls = 0
+        self.host_calls = 0
+        self._lock = threading.Lock()
+
+    def scan_batch(self, items):
+        with self._lock:
+            self.calls += 1
+            if self.calls <= self.fail_n:
+                raise self.fail_with
+        return [Secret(file_path=p) for p, _ in items]
+
+    def scan_batch_host(self, items):
+        with self._lock:
+            self.host_calls += 1
+        return [Secret(file_path=p) for p, _ in items]
+
+
+# -- serve parity under per-batch dispatch faults ---------------------------
+
+
+def test_parity_under_dispatch_fault_every_batch(engine):
+    """sched.dispatch error on EVERY batch: all tickets resolve via the
+    degraded host re-run with byte-identical findings."""
+    requests = _requests()
+    sequential = [engine.scan_batch(items) for items in requests]
+
+    faults.configure("sched.dispatch:error@1")
+    sched = BatchScheduler(lambda: engine, ServeConfig(batch_window_ms=40.0))
+    try:
+        futures = [
+            sched.submit(items, client_id=f"client{r}")
+            for r, items in enumerate(requests)
+        ]
+        batched = [f.result(timeout=60) for f in futures]
+    finally:
+        faults.clear()
+        sched.drain(timeout=10)
+
+    for seq, bat in zip(sequential, batched):
+        assert _flatten(seq) == _flatten(bat)
+    assert any(len(s.findings) for res in batched for s in res)
+    # Every dispatched batch crossed a failure domain, none was lost.
+    assert sched.stats.degraded_batches >= 1
+    assert sched.stats.degraded_batches == sched.stats.batches
+    assert sched.stats.errors == 0
+
+
+def test_breaker_opens_then_recloses_when_fault_clears():
+    """An x-limited fault trips the breaker; once the fault budget is
+    spent, the half-open probe succeeds and the breaker re-closes."""
+    eng = FlakyEngine()
+    faults.configure("sched.dispatch:error@1x3")
+    sched = BatchScheduler(
+        lambda: eng,
+        ServeConfig(
+            batch_window_ms=0.0,
+            breaker_threshold=3,
+            breaker_cooldown_s=0.05,
+        ),
+    )
+    try:
+        # Three sequential batches fault at dispatch -> breaker opens.
+        for i in range(3):
+            sched.submit([(f"a{i}.txt", b"x")]).result(timeout=10)
+        assert sched.breaker.snapshot()["state"] == "open"
+        assert sched.readiness()["ready"] is False
+
+        # While open: device skipped, host serves ("breaker" path).
+        host_before = eng.host_calls
+        sched.submit([("open.txt", b"x")]).result(timeout=10)
+        assert eng.host_calls > host_before
+
+        # Cooldown elapses; fault budget is exhausted; the probe batch
+        # reaches the (now healthy) engine and re-closes the breaker.
+        time.sleep(0.08)
+        sched.submit([("probe.txt", b"x")]).result(timeout=10)
+        snap = sched.breaker.snapshot()
+        assert snap["state"] == "closed"
+        assert snap["opened_total"] == 1
+        assert snap["reclosed_total"] == 1
+        assert sched.readiness()["ready"] is True
+        assert sched.stats.degraded_batches >= 4  # 3 trips + >=1 open-path
+        assert sched.stats.errors == 0
+    finally:
+        faults.clear()
+        sched.drain(timeout=10)
+
+
+def test_oom_sheds_and_retries_on_device():
+    """RESOURCE_EXHAUSTED once: the batch retries (split) and completes on
+    the DEVICE path — no degradation, breaker stays closed."""
+    eng = FlakyEngine(
+        fail_n=1, fail_with=faults.InjectedOom("RESOURCE_EXHAUSTED: injected")
+    )
+    sched = BatchScheduler(lambda: eng, ServeConfig(batch_window_ms=0.0))
+    try:
+        out = sched.submit([("a.txt", b"x"), ("b.txt", b"y")]).result(
+            timeout=10
+        )
+        assert [s.file_path for s in out] == ["a.txt", "b.txt"]
+        assert sched.stats.shed_retries == 1
+        assert sched.stats.degraded_batches == 0
+        assert eng.host_calls == 0
+        assert sched.breaker.snapshot()["state"] == "closed"
+    finally:
+        sched.drain(timeout=10)
+
+
+def test_oom_that_survives_shedding_degrades_to_host():
+    eng = FlakyEngine(
+        fail_n=99, fail_with=faults.InjectedOom("RESOURCE_EXHAUSTED: injected")
+    )
+    sched = BatchScheduler(lambda: eng, ServeConfig(batch_window_ms=0.0))
+    try:
+        out = sched.submit([("a.txt", b"x"), ("b.txt", b"y")]).result(
+            timeout=10
+        )
+        assert [s.file_path for s in out] == ["a.txt", "b.txt"]
+        assert sched.stats.shed_retries == 1
+        assert sched.stats.degraded_batches == 1
+        assert eng.host_calls == 1
+    finally:
+        sched.drain(timeout=10)
+
+
+def test_external_resolution_race_does_not_poison_scheduler():
+    """A ticket whose future is already resolved when the batch completes
+    (the deadline-expiry race shape) must not raise InvalidStateError on
+    the batcher thread — and the scheduler keeps serving afterward."""
+    gate = threading.Event()
+
+    class Gated:
+        def scan_batch(self, items):
+            assert gate.wait(timeout=10)
+            return [Secret(file_path=p) for p, _ in items]
+
+    sched = BatchScheduler(lambda: Gated(), ServeConfig(batch_window_ms=0.0))
+    try:
+        fut = sched.submit([("raced.txt", b"x")])
+        time.sleep(0.05)  # let the batch board the engine
+        fut.set_result("external")  # the race winner
+        gate.set()
+        assert fut.result(timeout=5) == "external"
+        # The loser's set_result hit InvalidStateError and was swallowed;
+        # the batcher thread is alive and the next request completes.
+        out = sched.submit([("after.txt", b"x")]).result(timeout=10)
+        assert [s.file_path for s in out] == ["after.txt"]
+        assert sched.stats.errors == 0
+    finally:
+        gate.set()
+        sched.drain(timeout=10)
+
+
+# -- device-engine seams (JAX path on the CPU backend) ----------------------
+
+
+def test_device_exec_seam_faults_then_recovers():
+    """The device.exec seam fires inside the real TPU engine's dispatch
+    (CPU backend); once the fault budget is spent, the same engine
+    produces findings identical to an unfaulted scan."""
+    from trivy_tpu.engine.device import TpuSecretEngine
+
+    # resident_chunks=0: the chunk cache would serve a repeat scan without
+    # touching the device at all, and the seam under test sits device-side.
+    eng = TpuSecretEngine(tile_len=512, resident_chunks=0)
+    items = [
+        ("creds.env", SECRET_LINE + b"filler = 1\n"),
+        ("plain.txt", b"nothing to see\n"),
+    ]
+    clean = eng.scan_batch(items)
+
+    faults.configure("device.exec:error@1x1")
+    with pytest.raises(faults.InjectedFault):
+        eng.scan_batch(items)
+    # Budget spent: the engine recovers with byte-identical output.
+    assert _flatten(eng.scan_batch(items)) == _flatten(clean)
+
+
+# -- rpc chaos: 20% connection resets, every request completes --------------
+
+
+def test_rpc_reset_chaos_all_requests_complete(tmp_path):
+    """rpc.serve reset@0.2: the in-process server drops ~1 in 5
+    connections mid-request; the client retry loop absorbs every one and
+    findings match a local scan."""
+    from trivy_tpu.cache.store import MemoryCache
+    from trivy_tpu.engine.hybrid import make_secret_engine
+    from trivy_tpu.rpc import client as rpc_client
+    from trivy_tpu.rpc.client import RemoteSecretEngine, RetryBudget
+    from trivy_tpu.rpc.server import start_background
+
+    local = make_secret_engine()
+    items = [
+        (f"f{i}.env", SECRET_LINE + f"pad_{i} = x\n".encode() * (i % 3 + 1))
+        for i in range(4)
+    ]
+    expected = _flatten(local.scan_batch(items))
+
+    httpd, _t = start_background("localhost:0", MemoryCache())
+    addr = f"{httpd.server_address[0]}:{httpd.server_address[1]}"
+    # A chaos profile earns more retries than steady-state traffic would:
+    # widen the budget floor so the test asserts retry CORRECTNESS, not
+    # budget policy (budget policy has its own tests).
+    rpc_client.reset_retry_budget(RetryBudget(min_floor=100))
+    remote = RemoteSecretEngine(addr)
+    # seed=1, not the default 0: Random(0)'s first ten draws all land
+    # >= 0.2 (a legal schedule with zero fires over ten requests), while
+    # Random(1) fires on the very first draw — the test needs the seam to
+    # actually trigger, and the whole point of seeding is pinning that.
+    faults.configure("rpc.serve:reset@0.2", seed=1)
+    try:
+        for _ in range(10):
+            assert _flatten(remote.scan_batch(items)) == expected
+        assert rpc_client.client_retries_total() >= 1, (
+            "reset@0.2 over 10 requests should have forced at least one "
+            "retry; the seam did not fire"
+        )
+    finally:
+        faults.clear()
+        rpc_client.reset_retry_budget()
+        httpd.shutdown()
+        httpd.server_close()

@@ -1,0 +1,176 @@
+"""Fault-injection plane (trivy_tpu/faults.py): spec parsing, determinism,
+the disabled fast path, and the fault exceptions' classifier contracts."""
+
+import json
+
+import pytest
+
+from trivy_tpu import faults
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.clear()
+
+
+# -- spec grammar -----------------------------------------------------------
+
+
+def test_parse_full_spec():
+    rules = faults.parse_spec(
+        "device.exec:oom@0.1,rpc.recv:reset@0.05,registry.load:corrupt@1"
+    )
+    assert [(r.seam, r.kind, r.rate) for r in rules] == [
+        ("device.exec", "oom", 0.1),
+        ("rpc.recv", "reset", 0.05),
+        ("registry.load", "corrupt", 1.0),
+    ]
+    assert all(r.max_fires == 0 for r in rules)
+
+
+def test_parse_max_fires_suffix():
+    (r,) = faults.parse_spec("sched.dispatch:error@1x8")
+    assert (r.seam, r.kind, r.rate, r.max_fires) == (
+        "sched.dispatch", "error", 1.0, 8,
+    )
+    assert r.spec() == "sched.dispatch:error@1x8"
+
+
+def test_parse_empty_entries_and_whitespace():
+    assert faults.parse_spec("") == []
+    assert faults.parse_spec(" , ,") == []
+    (r,) = faults.parse_spec("  device.put:error@0.5  ")
+    assert r.seam == "device.put"
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "nope.seam:error@1",          # unknown seam
+        "device.exec:frobnicate@1",   # unknown kind
+        "device.exec:error@1.5",      # rate out of range
+        "device.exec:error@-0.1",
+        "device.exec:error@abc",      # unparseable rate
+        "device.exec:error@1x-2",     # negative max_fires
+    ],
+)
+def test_parse_rejects_bad_specs(bad):
+    with pytest.raises(ValueError):
+        faults.parse_spec(bad)
+
+
+# -- deterministic schedule -------------------------------------------------
+
+
+def _schedule(spec, seed, n=200):
+    plane = faults.FaultPlane(faults.parse_spec(spec), seed=seed)
+    return [plane.decide("device.exec") for _ in range(n)]
+
+
+def test_same_seed_same_schedule():
+    assert _schedule("device.exec:oom@0.3", 7) == _schedule(
+        "device.exec:oom@0.3", 7
+    )
+
+
+def test_different_seed_different_schedule():
+    a = _schedule("device.exec:oom@0.3", 1)
+    b = _schedule("device.exec:oom@0.3", 2)
+    assert a != b  # 200 draws at 0.3: collision probability ~ 0
+
+
+def test_rate_one_always_fires_and_max_fires_stops():
+    plane = faults.FaultPlane(faults.parse_spec("device.exec:error@1x3"))
+    kinds = [plane.decide("device.exec") for _ in range(5)]
+    assert kinds == ["error", "error", "error", None, None]
+    snap = plane.snapshot()
+    assert snap["fired_total"] == 3
+    assert snap["rules"][0]["fired"] == 3
+
+
+def test_rate_zero_never_fires():
+    plane = faults.FaultPlane(faults.parse_spec("device.exec:error@0"))
+    assert all(plane.decide("device.exec") is None for _ in range(50))
+
+
+def test_other_seams_unaffected():
+    plane = faults.FaultPlane(faults.parse_spec("device.exec:error@1"))
+    assert plane.decide("device.put") is None
+    assert plane.decide("rpc.recv") is None
+
+
+# -- module-level arm/disarm ------------------------------------------------
+
+
+def test_disabled_is_noop_and_free():
+    faults.clear()
+    assert not faults.active()
+    assert faults.decide("device.exec") is None
+    faults.fire("device.exec")  # must not raise
+    assert faults.snapshot() == {
+        "enabled": False, "rules": [], "fired_total": 0,
+    }
+
+
+def test_configure_and_fire_raises_typed():
+    faults.configure("device.exec:oom@1")
+    assert faults.active()
+    with pytest.raises(faults.InjectedOom) as ei:
+        faults.fire("device.exec")
+    assert "RESOURCE_EXHAUSTED" in str(ei.value)
+    assert faults.is_oom(ei.value)
+
+
+def test_configure_empty_disarms():
+    faults.configure("sched.dispatch:error@1")
+    faults.configure("")
+    assert not faults.active()
+
+
+def test_configure_seed_env(monkeypatch):
+    monkeypatch.setenv("TRIVY_TPU_FAULTS_SEED", "42")
+    faults.configure("device.exec:oom@0.5")
+    assert faults.snapshot()["seed"] == 42
+
+
+# -- fault shapes -----------------------------------------------------------
+
+
+def test_make_fault_shapes():
+    assert isinstance(
+        faults.make_fault("rpc.recv", "reset"), ConnectionResetError
+    )
+    assert isinstance(
+        faults.make_fault("rpc.recv", "truncate"), json.JSONDecodeError
+    )
+    assert isinstance(
+        faults.make_fault("device.exec", "corrupt"), faults.InjectedFault
+    )
+    assert isinstance(
+        faults.make_fault("device.exec", "error"), faults.InjectedFault
+    )
+
+
+def test_is_oom_matches_real_and_injected():
+    assert faults.is_oom(RuntimeError("RESOURCE_EXHAUSTED: out of memory"))
+    assert faults.is_oom(MemoryError())
+    assert not faults.is_oom(RuntimeError("something else"))
+
+
+def test_latency_kind_sleeps_not_raises(monkeypatch):
+    monkeypatch.setenv("TRIVY_TPU_FAULTS_LATENCY_S", "0.001")
+    faults.configure("device.exec:latency@1")
+    assert faults.latency_s() == 0.001
+    faults.fire("device.exec")  # sleeps 1ms, returns
+
+
+def test_snapshot_reports_fired_counts():
+    faults.configure("device.exec:error@1x2,device.put:oom@0")
+    with pytest.raises(faults.InjectedFault):
+        faults.fire("device.exec")
+    snap = faults.snapshot()
+    assert snap["enabled"] and snap["fired_total"] == 1
+    by_seam = {r["seam"]: r for r in snap["rules"]}
+    assert by_seam["device.exec"]["fired"] == 1
+    assert by_seam["device.put"]["fired"] == 0

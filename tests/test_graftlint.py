@@ -28,7 +28,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(ROOT, "tools", "graftlint", "fixtures")
 ALL_RULES = (
     "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007", "GL008",
-    "GL009",
+    "GL009", "GL010",
 )
 
 
@@ -74,6 +74,7 @@ def test_deny_fixture_counts_stable():
         "GL007": 4,
         "GL008": 4,
         "GL009": 3,
+        "GL010": 4,
     }
 
 

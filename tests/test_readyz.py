@@ -1,0 +1,84 @@
+"""/readyz (readiness, distinct from /healthz liveness) and the
+/debug/breaker surface: ready flips on draining and on an open breaker
+while liveness stays green throughout."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from trivy_tpu import faults
+from trivy_tpu.cache.store import MemoryCache
+from trivy_tpu.rpc.server import start_background
+
+
+@pytest.fixture
+def server():
+    httpd, _t = start_background("localhost:0", MemoryCache())
+    addr = f"{httpd.server_address[0]}:{httpd.server_address[1]}"
+    yield addr, httpd.scan_server
+    faults.clear()
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def _get(addr, path):
+    try:
+        with urllib.request.urlopen(f"http://{addr}{path}") as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_readyz_ready_with_component_checks(server):
+    addr, _ = server
+    code, rep = _get(addr, "/readyz")
+    assert code == 200 and rep["ready"] is True
+    checks = rep["checks"]
+    assert checks["admitting"] is True
+    assert checks["breaker"] == "closed"
+    assert checks["hbm_state"] == "ok"
+    assert checks["draining"] is False
+    # Reported but not gated: engines build lazily on first dispatch.
+    assert checks["engine_warm"] is False
+    assert checks["pool_residents"] == 0
+
+
+def test_healthz_stays_alive_while_readyz_drains(server):
+    addr, scan_server = server
+    scan_server.draining = True
+    code, rep = _get(addr, "/readyz")
+    assert code == 503 and rep["ready"] is False
+    assert rep["checks"]["draining"] is True
+    # Liveness is a different question: kill-looping a clean drain is
+    # exactly what the /healthz–/readyz split prevents.
+    assert urllib.request.urlopen(f"http://{addr}/healthz").status == 200
+
+
+def test_readyz_503_while_breaker_open(server):
+    addr, scan_server = server
+    b = scan_server.scheduler.breaker
+    for _ in range(b.failure_threshold):
+        b.record_failure()
+    assert b.snapshot()["state"] == "open"
+    code, rep = _get(addr, "/readyz")
+    assert code == 503 and rep["ready"] is False
+    assert rep["checks"]["breaker"] == "open"
+    assert urllib.request.urlopen(f"http://{addr}/healthz").status == 200
+
+
+def test_debug_breaker_reports_domains_and_fault_plane(server):
+    addr, _ = server
+    code, rep = _get(addr, "/debug/breaker")
+    assert code == 200
+    assert rep["breaker"]["state"] == "closed"
+    assert rep["degraded_batches"] == 0
+    assert rep["shed_retries"] == 0
+    assert rep["batch_errors"] == 0
+    assert rep["faults"]["enabled"] is False
+
+    faults.configure("sched.dispatch:error@0.5x2")
+    _, rep = _get(addr, "/debug/breaker")
+    assert rep["faults"]["enabled"] is True
+    assert rep["faults"]["rules"][0]["spec"] == "sched.dispatch:error@0.5x2"

@@ -1,0 +1,187 @@
+"""RPC client retry-loop edge cases, driven through the rpc.recv fault
+seam (and faked transports where the seam can't express the case):
+Retry-After parsing, attempt-cap exhaustion carrying the last error,
+4xx fail-fast, connection resets, and the sliding-window retry budget."""
+
+import io
+import json
+import urllib.error
+
+import pytest
+
+from trivy_tpu import faults
+from trivy_tpu.rpc import client as rpc_client
+from trivy_tpu.rpc.client import (
+    RetryBudget,
+    RpcClient,
+    RpcError,
+    _parse_retry_after,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    rpc_client.reset_retry_budget(RetryBudget(min_floor=100))
+    yield
+    faults.clear()
+    rpc_client.reset_retry_budget()
+
+
+class _FakeResponse:
+    def __init__(self, payload):
+        self._raw = json.dumps(payload).encode()
+        self.headers = {}
+
+    def read(self):
+        return self._raw
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def _client(monkeypatch, responder, **kw):
+    """RpcClient whose transport is `responder()` and whose backoff sleeps
+    are recorded instead of slept."""
+    sleeps = []
+    monkeypatch.setattr(
+        "urllib.request.urlopen", lambda req, timeout: responder()
+    )
+    c = RpcClient("localhost:1", **kw)
+    monkeypatch.setattr(
+        RpcClient, "sleep", staticmethod(lambda s: sleeps.append(s))
+    )
+    return c, sleeps
+
+
+# -- the rpc.recv seam ------------------------------------------------------
+
+
+def test_reset_via_recv_seam_retries_then_succeeds(monkeypatch):
+    c, sleeps = _client(monkeypatch, lambda: _FakeResponse({"ok": 1}))
+    faults.configure("rpc.recv:reset@1x2")
+    assert c.call("/x", {}) == {"ok": 1}
+    assert len(sleeps) == 2  # two resets absorbed, third attempt clean
+    assert rpc_client.client_retries_total() == 2
+
+
+def test_truncated_body_via_recv_seam_is_retryable(monkeypatch):
+    c, sleeps = _client(monkeypatch, lambda: _FakeResponse({"ok": 1}))
+    faults.configure("rpc.recv:truncate@1x1")
+    assert c.call("/x", {}) == {"ok": 1}
+    assert len(sleeps) == 1
+
+
+def test_attempt_cap_exhaustion_raises_last_error(monkeypatch):
+    c, sleeps = _client(
+        monkeypatch, lambda: _FakeResponse({"ok": 1}), max_retries=3
+    )
+    faults.configure("rpc.recv:reset@1")  # unlimited: every attempt resets
+    with pytest.raises(RpcError) as ei:
+        c.call("/x", {})
+    msg = str(ei.value)
+    assert "retries exhausted after 3 attempts" in msg
+    assert "injected connection reset" in msg  # the LAST error travels
+    assert len(sleeps) == 2  # no sleep after the final attempt
+
+
+def test_latency_kind_delays_but_succeeds(monkeypatch):
+    c, sleeps = _client(monkeypatch, lambda: _FakeResponse({"ok": 1}))
+    faults.configure("rpc.recv:latency@1x1")
+    assert c.call("/x", {}) == {"ok": 1}
+    assert sleeps == []  # latency is not a retry
+
+
+# -- HTTP status handling ---------------------------------------------------
+
+
+def _http_error(code, headers=None, body=b"{}"):
+    def raiser():
+        raise urllib.error.HTTPError(
+            "http://localhost:1/x", code, "err", headers or {}, io.BytesIO(body)
+        )
+
+    return raiser
+
+
+def test_4xx_is_never_retried(monkeypatch):
+    c, sleeps = _client(monkeypatch, _http_error(404))
+    with pytest.raises(RpcError) as ei:
+        c.call("/x", {})
+    assert "HTTP 404" in str(ei.value)
+    assert sleeps == []
+    assert rpc_client.client_retries_total() == 0
+
+
+def test_429_retried_with_retry_after_floor(monkeypatch):
+    c, sleeps = _client(
+        monkeypatch, _http_error(429, {"Retry-After": "2.5"}), max_retries=2
+    )
+    with pytest.raises(RpcError) as ei:
+        c.call("/x", {})
+    assert "retries exhausted" in str(ei.value)
+    assert len(sleeps) == 1 and sleeps[0] >= 2.5  # hint floors the backoff
+
+
+def test_429_malformed_retry_after_still_retries(monkeypatch):
+    """A garbage Retry-After header must not crash the loop — it reads as
+    'no hint' and plain jittered backoff applies."""
+    c, sleeps = _client(
+        monkeypatch,
+        _http_error(429, {"Retry-After": "soon"}),
+        max_retries=2,
+    )
+    with pytest.raises(RpcError):
+        c.call("/x", {})
+    assert len(sleeps) == 1 and 0 < sleeps[0] < 2.5
+
+
+def test_parse_retry_after_forms():
+    assert _parse_retry_after("1.5") == 1.5
+    assert _parse_retry_after("0") == 0.0
+    assert _parse_retry_after("-3") == 0.0  # clamped
+    assert _parse_retry_after("soon") is None  # malformed
+    assert _parse_retry_after("") is None
+    assert _parse_retry_after(None) is None  # absent
+
+
+# -- the retry budget -------------------------------------------------------
+
+
+def test_budget_exhaustion_fails_fast_with_last_error(monkeypatch):
+    rpc_client.reset_retry_budget(RetryBudget(min_floor=0, ratio=0.0))
+    c, sleeps = _client(monkeypatch, lambda: _FakeResponse({"ok": 1}))
+    faults.configure("rpc.recv:reset@1")
+    with pytest.raises(RpcError) as ei:
+        c.call("/x", {})
+    msg = str(ei.value)
+    assert "retry budget exhausted" in msg
+    assert "injected connection reset" in msg
+    assert sleeps == []  # denied before any backoff
+    assert rpc_client.client_retry_budget_exhausted_total() == 1
+
+
+def test_budget_scales_with_request_volume():
+    clock = [0.0]
+    b = RetryBudget(
+        window_s=60.0, ratio=0.1, min_floor=1, clock=lambda: clock[0]
+    )
+    for _ in range(50):
+        b.note_request()
+    # cap = max(1, 0.1 * 50) = 5
+    assert [b.try_retry() for _ in range(6)] == [True] * 5 + [False]
+    snap = b.snapshot()
+    assert snap["client_retries_total"] == 5
+    assert snap["client_retry_budget_exhausted_total"] == 1
+    # The window slides: old spend expires and the budget refills.
+    clock[0] += 61.0
+    b.note_request()
+    assert b.try_retry()
+
+
+def test_budget_floor_keeps_quiet_processes_alive():
+    b = RetryBudget(ratio=0.1, min_floor=3, clock=lambda: 0.0)
+    b.note_request()  # one request: ratio alone would allow 0 retries
+    assert [b.try_retry() for _ in range(4)] == [True, True, True, False]

@@ -39,6 +39,7 @@ from tools.graftlint.core import Finding, lint_paths, load_waivers
 from tools.graftlint import (  # noqa: E402,F401
     rules_jax,
     rules_labels,
+    rules_robust,
     rules_threads,
     rules_time,
 )

@@ -176,6 +176,7 @@ def lint_module(mod: Module, rules: dict | None = None) -> list[Finding]:
     from tools.graftlint import (  # noqa: F401
         rules_jax,
         rules_labels,
+        rules_robust,
         rules_threads,
         rules_time,
     )

@@ -1,0 +1,47 @@
+"""GL010 allow fixture: broad excepts that record, re-raise, or declare."""
+
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def load(path):
+    return path
+
+
+def records_a_log(path):
+    try:
+        return load(path)
+    except Exception as e:
+        log.warning("load failed: %s", e)  # a call observes the failure
+    return None
+
+
+def reraises(path):
+    try:
+        return load(path)
+    except BaseException:
+        raise  # carried onward, not swallowed
+
+
+def wraps_and_raises(path):
+    try:
+        return load(path)
+    except Exception as e:
+        raise RuntimeError(f"load failed: {path}") from e
+
+
+def narrow_is_fine(path):
+    try:
+        return load(path)
+    except ValueError:
+        pass  # narrow except: deliberate, typed, out of GL010's scope
+    return None
+
+
+def annotated_swallow(path):
+    try:
+        return load(path)
+    except Exception:  # graftlint: swallow(best-effort cache warm; cold path retries)
+        pass
+    return None

@@ -1,0 +1,38 @@
+"""GL010 deny fixture: broad excepts that swallow failures silently."""
+
+
+def load(path):
+    return path
+
+
+def silent_pass(path):
+    try:
+        return load(path)
+    except Exception:  # GL010: nothing observes the failure
+        pass
+    return None
+
+
+def bare_except_assign(path):
+    result = None
+    try:
+        result = load(path)
+    except:  # noqa: E722  # GL010: bare except, assignment only
+        result = None
+    return result
+
+
+def tuple_with_broad(path):
+    try:
+        return load(path)
+    except (ValueError, Exception):  # GL010: tuple hides a broad member
+        pass
+    return None
+
+
+def empty_swallow_reason(path):
+    try:
+        return load(path)
+    except Exception:  # graftlint: swallow()
+        pass  # GL010: the reason is mandatory — swallow() alone is not a record
+    return None

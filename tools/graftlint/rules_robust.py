@@ -1,0 +1,94 @@
+"""Failure-domain hygiene.
+
+GL010: inside the runtime failure domains (``trivy_tpu/engine/`` and
+``trivy_tpu/serve/``), a broad exception handler (bare ``except``,
+``except Exception``, ``except BaseException``) must not swallow the
+failure silently.  These are exactly the packages where the scheduler's
+degradation ladder, the circuit breaker, and the chaos suite depend on
+failures being OBSERVED — a handler that neither calls anything (no log,
+no metric, no counter, no cleanup) nor re-raises turns an injected or
+real fault into dead air, and the fault plane can't prove the degraded
+path ran.
+
+A handler passes if its body contains any call or any raise — recording
+a metric, logging, failing a future, or re-raising all count as carrying
+the failure somewhere.  A deliberate swallow is annotated at the
+``except`` line with a reason:
+
+    except Exception:  # graftlint: swallow(listener must not poison routing)
+        pass
+
+The reason is mandatory (an empty ``swallow()`` does not pass): the
+annotation is the reviewable record of WHY dropping this failure is
+safe, the same contract as the waiver ledger but local to the line.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.graftlint.core import Finding, Module, rule
+
+_BROAD = ("Exception", "BaseException")
+_SCOPED_PREFIXES = ("trivy_tpu/engine/", "trivy_tpu/serve/")
+
+# Unlike the token directives (owner(role), holds(lock)), a swallow
+# reason is prose — parse it from the raw comment so spaces survive.
+_SWALLOW_RE = re.compile(r"graftlint:.*\bswallow\(([^)]*)\)")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    """Bare ``except``, Exception/BaseException, or a tuple holding one."""
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _BROAD for e in t.elts)
+    return False
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    """True when no statement in the handler body calls or raises —
+    nothing observable can have happened to the exception."""
+    for stmt in handler.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Call, ast.Raise)):
+                return False
+    return True
+
+
+def _in_scope(relpath: str) -> bool:
+    if relpath.startswith(_SCOPED_PREFIXES):
+        return True
+    base = relpath.rsplit("/", 1)[-1]
+    return base.startswith("gl010_")
+
+
+@rule("GL010")
+def check_silent_broad_except(mod: Module) -> list[Finding]:
+    if not _in_scope(mod.relpath):
+        return []
+    out: list[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad(node) or not _swallows(node):
+            continue
+        m = _SWALLOW_RE.search(mod.comments.get(node.lineno, ""))
+        if m and m.group(1).strip():
+            continue
+        out.append(
+            Finding(
+                "GL010",
+                mod.relpath,
+                node.lineno,
+                "broad except swallows the failure silently (no call, no "
+                "raise) inside a runtime failure domain; record it "
+                "(metric/log/fail-the-future) or annotate the except line "
+                "with `# graftlint: swallow(<reason>)`",
+            )
+        )
+    return out

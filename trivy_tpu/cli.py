@@ -749,6 +749,24 @@ def build_parser() -> argparse.ArgumentParser:
         "drops (0 disables)",
     )
     p_server.add_argument(
+        "--breaker-threshold", type=int,
+        default=_int_default("breaker-threshold", 3),
+        help="device-dispatch failures inside --breaker-window-s before "
+        "the circuit breaker opens and batches route straight to the "
+        "host DFA path",
+    )
+    p_server.add_argument(
+        "--breaker-window-s", type=float,
+        default=_float_default("breaker-window-s", 30.0),
+        help="sliding window the breaker counts dispatch failures over",
+    )
+    p_server.add_argument(
+        "--breaker-cooldown-s", type=float,
+        default=_float_default("breaker-cooldown-s", 5.0),
+        help="open -> half-open probe timer: after this long one probe "
+        "batch tests the device and success re-closes the breaker",
+    )
+    p_server.add_argument(
         "--profile-dir",
         default=_env_default("profile-dir", ""),
         help="default output directory for POST /admin/profile/start "
@@ -1037,6 +1055,9 @@ def main(argv: list[str] | None = None) -> int:
                 max_tenant_series=args.max_tenant_series,
                 hbm_soft_pct=args.hbm_soft_pct,
                 hbm_hard_pct=args.hbm_hard_pct,
+                breaker_threshold=args.breaker_threshold,
+                breaker_window_s=args.breaker_window_s,
+                breaker_cooldown_s=args.breaker_cooldown_s,
             ),
             secret_config=args.secret_config,
             rules_cache_dir=resolve_rules_cache_dir(args.rules_cache_dir),

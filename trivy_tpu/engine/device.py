@@ -29,6 +29,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from trivy_tpu import faults
 from trivy_tpu.ftypes import Secret
 from trivy_tpu.engine.grams import GramSet, build_gram_set
 from trivy_tpu.engine.oracle import OracleScanner
@@ -560,6 +561,7 @@ class TpuSecretEngine:
                     return (digest, hit, True, memwatch.NOOP_HANDLE)
             self._count_link(raw_n, buf.nbytes)
             with obs_trace.span("chunk.h2d", chunk=ci, bytes=buf.nbytes):
+                faults.fire("device.put")
                 dev = jax.device_put(buf)
             # Staging buffers live device-side for up to `depth` chunks;
             # the ledger entry rides the pipeline handle and releases at
@@ -574,6 +576,7 @@ class TpuSecretEngine:
                 return (digest, dev, True, mw)
             self.stats.device_dispatches += 1
             with obs_trace.span("chunk.exec", chunk=ci):
+                faults.fire("device.exec")
                 # traced runs take the per-kernel attributed path (fenced
                 # unpack/sieve-step sections); untraced runs keep the
                 # donated fused dispatch and full pipeline overlap
@@ -589,6 +592,7 @@ class TpuSecretEngine:
             mw.release()
             if not hit:
                 with obs_trace.span("chunk.fetch", chunk=ci):
+                    faults.fire("device.fetch")
                     ph = obs_metrics.device_phase("compact")
                     out = self._fetch_hits(out)
                     ph.done()
@@ -649,10 +653,13 @@ class TpuSecretEngine:
             # Split so the trace shows where a synchronous dispatch's time
             # lands (dispatch is async; the fetch span absorbs the wait).
             with obs_trace.span("chunk.h2d", bytes=buf.nbytes):
+                faults.fire("device.put")
                 dev = jnp.asarray(buf)
             with obs_trace.span("chunk.exec"):
+                faults.fire("device.exec")
                 out = self._exec_attributed(dev)
             with obs_trace.span("chunk.fetch"):
+                faults.fire("device.fetch")
                 ph = obs_metrics.device_phase("compact")
                 arr = self._fetch_hits(out)
                 ph.done()

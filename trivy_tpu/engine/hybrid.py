@@ -78,7 +78,7 @@ def _tpu_default_backend() -> bool:
         import jax
 
         return jax.default_backend() == "tpu"
-    except Exception:
+    except Exception:  # graftlint: swallow(any backend probe error reads as no-tpu)
         return False
 
 
@@ -137,7 +137,7 @@ def probe_link(size: int = 8 << 20, attempts: int = 3):  # graftlint: fetch-boun
                     size / max(best_dt - best_rtt, 1e-6) / 1e6,
                     best_rtt,
                 )
-            except Exception:
+            except Exception:  # graftlint: swallow(probe failure reads as a dead link)
                 result = (0.0, 1.0)
         _LINK_PROBE[override] = result
         return result
@@ -661,6 +661,29 @@ class HybridSecretEngine(TpuSecretEngine):
             deadline.check()
             self._finish_device(items, np.concatenate(dev_lanes), results)
         return results  # type: ignore[return-value]
+
+    def scan_batch_host(self, items: list[tuple[str, bytes]]) -> list[Secret]:
+        """Degraded re-run with the device verifier OUT of the loop: every
+        candidate lane verifies on the host DFA instead.  Byte-identical
+        to the device path by construction — both verifiers clip walk
+        windows with the same shared prefix bounds (see __init__), and
+        the final confirm is the same byte-exact oracle either way.  The
+        serve scheduler calls this after a device-engine failure (and for
+        every batch while the circuit breaker is open), so a sick device
+        costs latency, never correctness.
+
+        Runs on the engine-owner thread only (like scan_batch): the
+        verifier swap below is not concurrency-safe against a concurrent
+        scan_batch on the SAME engine, which the scheduler's single
+        dispatch thread already precludes."""
+        nfa = self._nfa_verifier
+        if nfa is None:
+            return self.scan_batch(items)  # already host-only
+        self._nfa_verifier = None
+        try:
+            return self.scan_batch(items)
+        finally:
+            self._nfa_verifier = nfa
 
     def _finish_chunk(
         self,

@@ -52,6 +52,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from trivy_tpu import faults
 from trivy_tpu.engine.redfa import compile_search_nfa64, compute_prefix_bounds
 from trivy_tpu.obs import memwatch
 from trivy_tpu.obs import metrics as obs_metrics
@@ -570,6 +571,7 @@ class NfaVerifier:
             tier_, lo_, hi_, out = in_flight.popleft()
             tf = _time.perf_counter()
             with obs_trace.span("verify.fetch", rows=hi_ - lo_):
+                faults.fire("nfa.fetch")
                 if compact_fetch:
                     packed, raw_b, got_b = link_mod.fetch_stream_packed(out)
                 else:
@@ -614,6 +616,7 @@ class NfaVerifier:
                             STREAM_BLOCK,
                         ).transpose(2, 3, 0, 1)
                     )
+                    faults.fire("nfa.dispatch")
                     bd = self._put_stream(bytes_t)
                     # traced runs fence each dispatch (per-kernel
                     # verify-stream attribution); untraced dispatch stays

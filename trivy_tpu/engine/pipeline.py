@@ -127,7 +127,7 @@ class ChunkPipeline:
                 for chunk, handle in inflight:
                     try:
                         self._cancel(chunk, handle)
-                    except Exception:
+                    except Exception:  # graftlint: swallow(best-effort cancel mid-drain; outer raise carries the cause)
                         pass
             inflight.clear()
             raise

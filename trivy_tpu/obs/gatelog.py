@@ -20,7 +20,9 @@ per-registry.  Consumers:
   incident shows the gate state that routed it.
 
 Reasons are a bounded enum (metric-label safe): `link-wide`,
-`link-narrow`, `no-device`, `forced`, `fallback`.
+`link-narrow`, `no-device`, `forced`, `fallback`, `breaker` (a runtime
+circuit-breaker transition re-routing batches — see engine/breaker.py
+and the serve scheduler's failure domains).
 """
 
 from __future__ import annotations

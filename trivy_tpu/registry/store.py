@@ -27,7 +27,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from trivy_tpu import __version__
+from trivy_tpu import __version__, faults
 from trivy_tpu.registry.digest import ruleset_digest
 from trivy_tpu.rules.model import RuleSet
 
@@ -362,6 +362,10 @@ def load_artifact(
     if not os.path.exists(mpath) or not os.path.exists(npath):
         return None
     try:
+        # Chaos seam: an injected `registry.load:corrupt` fault rides the
+        # SAME warn-and-recompile fallback a real truncated/tampered
+        # artifact takes — proving the fallback, not simulating one.
+        faults.fire("registry.load")
         with open(mpath, "rb") as f:
             manifest = json.loads(f.read().decode("utf-8"))
         if manifest.get("schema_version") != SCHEMA_VERSION:

@@ -6,6 +6,13 @@ pkg/rpc/retry.go.  The retry loop speaks the server's backpressure
 protocol: 429/503 responses (the serve scheduler's admission rejections)
 are retried with jittered exponential backoff floored by the server's
 Retry-After hint; other 4xx are deterministic and never retried.
+
+Retries are additionally metered by a process-wide sliding-window
+*retry budget* (~10% of recent request volume, floored so low-traffic
+processes can still retry): when the server is hard-down, per-call
+backoff alone still multiplies offered load by the attempt cap, and a
+fleet of clients doing that simultaneously is a retry storm.  A dry
+budget fails the call immediately with the last underlying error.
 """
 
 from __future__ import annotations
@@ -13,12 +20,15 @@ from __future__ import annotations
 import base64
 import json
 import random
+import threading
 import time
 import urllib.error
 import urllib.request
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Iterable
 
+from trivy_tpu import faults
 from trivy_tpu.atypes import ArtifactInfo, BlobInfo, _secret_from_json
 from trivy_tpu.cache.store import ArtifactCache
 from trivy_tpu.ftypes import Secret
@@ -31,9 +41,104 @@ MAX_RETRIES = 4
 BACKOFF_BASE_S = 0.2
 BACKOFF_CAP_S = 8.0
 
+RETRY_BUDGET_WINDOW_S = 60.0
+RETRY_BUDGET_RATIO = 0.1
+RETRY_BUDGET_MIN = 3
+
 
 class RpcError(RuntimeError):
     pass
+
+
+class RetryBudget:
+    """Sliding-window retry budget shared by every client in the process.
+
+    Retries in the last `window_s` seconds are capped at
+    ``max(min_floor, ratio * requests_in_window)`` — i.e. steady traffic
+    earns retry headroom proportional to its volume, while an outage
+    degrades to a bounded trickle instead of ``attempts × load``.  The
+    floor keeps a quiet process (one CLI scan) able to ride out a 429.
+    """
+
+    def __init__(
+        self,
+        window_s: float = RETRY_BUDGET_WINDOW_S,
+        ratio: float = RETRY_BUDGET_RATIO,
+        min_floor: int = RETRY_BUDGET_MIN,
+        clock=time.monotonic,
+    ):
+        self._lock = threading.Lock()
+        self.window_s = window_s
+        self.ratio = ratio
+        self.min_floor = min_floor
+        self._clock = clock
+        self._requests: deque[float] = deque()  # owner: _lock
+        self._retries: deque[float] = deque()  # owner: _lock
+        self.retries_total = 0  # owner: _lock (monotonic)
+        self.exhausted_total = 0  # owner: _lock (monotonic)
+
+    def _prune(self, now: float) -> None:  # graftlint: holds(_lock)
+        cutoff = now - self.window_s
+        while self._requests and self._requests[0] < cutoff:
+            self._requests.popleft()
+        while self._retries and self._retries[0] < cutoff:
+            self._retries.popleft()
+
+    def note_request(self) -> None:
+        """Count one logical call() toward the window's request volume."""
+        with self._lock:
+            now = self._clock()
+            self._prune(now)
+            self._requests.append(now)
+
+    def try_retry(self) -> bool:
+        """Spend one retry if the window allows it; False = budget dry
+        (the caller must fail fast with its last underlying error)."""
+        with self._lock:
+            now = self._clock()
+            self._prune(now)
+            cap = max(self.min_floor, int(self.ratio * len(self._requests)))
+            if len(self._retries) >= cap:
+                self.exhausted_total += 1
+                return False
+            self._retries.append(now)
+            self.retries_total += 1
+            return True
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            now = self._clock()
+            self._prune(now)
+            return {
+                "window_s": self.window_s,
+                "requests_in_window": len(self._requests),
+                "retries_in_window": len(self._retries),
+                "client_retries_total": self.retries_total,
+                "client_retry_budget_exhausted_total": self.exhausted_total,
+            }
+
+
+# The process-wide budget (a retry storm is a per-process phenomenon —
+# every RpcClient instance feeds the same socket pool and server).
+_BUDGET = RetryBudget()
+
+
+def retry_budget() -> RetryBudget:
+    return _BUDGET
+
+
+def client_retries_total() -> int:
+    return _BUDGET.snapshot()["client_retries_total"]
+
+
+def client_retry_budget_exhausted_total() -> int:
+    return _BUDGET.snapshot()["client_retry_budget_exhausted_total"]
+
+
+def reset_retry_budget(budget: RetryBudget | None = None) -> None:
+    """Swap in a fresh (or custom-clocked) budget — tests only."""
+    global _BUDGET
+    _BUDGET = budget if budget is not None else RetryBudget()
 
 
 def _parse_retry_after(value: str | None) -> float | None:
@@ -92,6 +197,7 @@ class RpcClient:
             ctype = "application/json"
         last: Exception | None = None
         attempts = max(1, self.max_retries)
+        _BUDGET.note_request()
         for attempt in range(attempts):
             req = urllib.request.Request(
                 url, data=body, headers={"Content-Type": ctype}
@@ -104,6 +210,11 @@ class RpcClient:
             try:
                 with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
                     raw = resp.read()
+                    # Chaos seam: client-side receive faults.  After the
+                    # read, before the decode, so reset/truncate kinds
+                    # land in exactly the retryable except clause below
+                    # that their real counterparts would hit.
+                    faults.fire("rpc.recv")
                     self.last_response_headers = dict(resp.headers.items())
                     if self.wire == "protobuf":
                         from trivy_tpu.rpc import protowire
@@ -126,6 +237,10 @@ class RpcClient:
                 # Connection reset / refused / truncated body: retryable.
                 last = e
             if attempt + 1 < attempts:
+                if not _BUDGET.try_retry():
+                    raise RpcError(
+                        f"{path}: retry budget exhausted: {last}"
+                    ) from last
                 self.sleep(_backoff_s(attempt, retry_after))
         raise RpcError(
             f"{path}: retries exhausted after {attempts} attempts: {last}"

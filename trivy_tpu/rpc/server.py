@@ -38,7 +38,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
-from trivy_tpu import __version__, deadline, lockcheck
+from trivy_tpu import __version__, deadline, faults, lockcheck
 from trivy_tpu.atypes import ArtifactInfo, _secret_to_json
 from trivy_tpu.cache.store import (
     ArtifactCache,
@@ -559,6 +559,33 @@ class ScanServer:
             }
         return report
 
+    def readiness(self) -> dict:
+        """The /readyz body: the scheduler's component checks (admitting,
+        breaker, HBM band, engine warmth, pool residency) plus this
+        server's SIGTERM draining flag.  Distinct from /healthz on
+        purpose — healthz answers "is the process alive" (a liveness
+        probe must stay true while draining, or the orchestrator
+        kill-loops a healthy drain), readyz answers "should a balancer
+        send this host traffic"."""
+        rep = self.scheduler.readiness()
+        rep["checks"]["draining"] = self.draining
+        rep["ready"] = bool(rep["ready"] and not self.draining)
+        return rep
+
+    def breaker_report(self) -> dict:
+        """The /debug/breaker body: breaker state + counters, the
+        failure-domain tallies, and the armed fault plane (if any) — the
+        one-stop surface for "why is this host degraded"."""
+        sched = self.scheduler
+        return {
+            "breaker": sched.breaker.snapshot(),
+            "degraded_batches": sched.stats.degraded_batches,
+            "shed_retries": sched.stats.shed_retries,
+            "shed_evicted_slots": sched.stats.shed_evicted_slots,
+            "batch_errors": sched.stats.errors,
+            "faults": faults.snapshot(),
+        }
+
     def push_ruleset(self, req: dict) -> dict:
         """POST /admin/ruleset/push: install a ruleset into the server's
         registry by digest.  Client-side-compiled pushes carry the YAML
@@ -654,6 +681,8 @@ DEBUG_SURFACES = {
     "cost-model inputs (?limit=N, newest first)",
     "/debug/memory": "device-memory ledger: per-device raw vs attributed "
     "bytes, watermarks, pressure state, pool estimate reconciliation",
+    "/debug/breaker": "device circuit-breaker state + failure-domain "
+    "tallies (degraded/shed batches) and the armed fault plane",
 }
 
 
@@ -696,6 +725,13 @@ def _make_handler(server: ScanServer):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif route == "/readyz":
+                # Readiness, distinct from liveness: 503 tells the load
+                # balancer to rotate this host out (draining, breaker
+                # open, HBM hard) while /healthz keeps answering 200 so
+                # the orchestrator doesn't kill a clean drain.
+                rep = server.readiness()
+                self._send(200 if rep["ready"] else 503, rep)
             elif route == "/version":
                 self._send(200, {"Version": __version__})
             elif route == "/metrics":
@@ -756,6 +792,10 @@ def _make_handler(server: ScanServer):
                 # Device-memory ledger: raw HBM truth vs attributed
                 # truth, watermarks, and the pool's estimate error.
                 self._send(200, server.memory_report())
+            elif route == "/debug/breaker":
+                # Failure-domain posture: breaker state machine,
+                # degraded/shed tallies, armed chaos faults.
+                self._send(200, server.breaker_report())
             elif route in ("/debug", "/debug/"):
                 # Index of every debug surface with its one-liner.
                 self._send(200, {"surfaces": DEBUG_SURFACES})
@@ -769,6 +809,38 @@ def _make_handler(server: ScanServer):
             finally:
                 server.metrics.exit()
 
+        def _inject_fault(self, kind: str) -> bool:
+            """Act out one injected rpc.serve fault.  True = the request
+            was consumed (no further handling); latency returns False so
+            the delayed request still completes normally."""
+            import time as _time
+
+            if kind == "latency":
+                _time.sleep(faults.latency_s())
+                return False
+            if kind == "reset":
+                # Drop the TCP conversation mid-request: the client sees
+                # a connection reset / remote disconnect, the retryable
+                # class its backoff loop exists for.
+                self.close_connection = True
+                self.connection.close()
+                return True
+            if kind == "truncate":
+                # A syntactically valid HTTP response whose JSON body is
+                # cut short — the client's json.loads raises, which its
+                # retry loop treats as a truncated-body network fault.
+                body = json.dumps({"error": "injected truncation-"}).encode()
+                half = body[: len(body) // 2]
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(half)))
+                self.end_headers()
+                self.wfile.write(half)
+                return True
+            # error/oom/corrupt: a retryable server-side 5xx.
+            self._send(500, {"error": f"injected fault ({kind})"})
+            return True
+
         def _do_POST(self):
             import time as _time
 
@@ -776,6 +848,14 @@ def _make_handler(server: ScanServer):
             # desynchronize if a response is sent with unread body bytes.
             length = int(self.headers.get("Content-Length", "0"))
             raw = self.rfile.read(length)
+            # Chaos seam: server-side wire faults (conn reset, truncated
+            # response body, injected latency), acted out at the HTTP
+            # layer so the client retry loop sees exactly what a real
+            # network failure produces.  After the body drain on purpose
+            # (keep-alive hygiene holds even under injection).
+            kind = faults.decide("rpc.serve")
+            if kind is not None and self._inject_fault(kind):
+                return
             method = _ROUTES.get(self.path)
             start = _time.monotonic()
             # Cross-boundary trace propagation: adopt the client's id (a

@@ -52,13 +52,14 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass, field
 
 from trivy_tpu import deadline as _deadline
-from trivy_tpu import lockcheck
+from trivy_tpu import faults, lockcheck
 from trivy_tpu.deadline import ScanTimeoutError
-from trivy_tpu.obs import memwatch
+from trivy_tpu.engine.breaker import CircuitBreaker
+from trivy_tpu.obs import gatelog, memwatch
 from trivy_tpu.obs import metrics as obs_metrics
 from trivy_tpu.obs import trace as obs_trace
 from trivy_tpu.obs.tenantmetrics import TenantMetrics
@@ -132,6 +133,10 @@ class ServeConfig:
     # -- device-memory watermarks (obs/memwatch.py), % of bytes_limit ----
     hbm_soft_pct: float = 85.0  # soft: LRU-evict pool toward target (0=off)
     hbm_hard_pct: float = 95.0  # hard: shed new admissions with 429 (0=off)
+    # -- device circuit breaker (engine/breaker.py) ----------------------
+    breaker_threshold: int = 3  # device failures in window before opening
+    breaker_window_s: float = 30.0  # failure-counting sliding window
+    breaker_cooldown_s: float = 5.0  # open -> half-open probe timer
 
     def default_quota(self) -> TenantQuota:
         return TenantQuota(
@@ -204,6 +209,9 @@ class SchedulerStats:
     fill_ratio_sum: float = 0.0  # sum over batches of bytes/max_batch_bytes
     wait_s_sum: float = 0.0  # enqueue -> dispatch, summed over tickets
     errors: int = 0  # batches failed by an engine exception
+    degraded_batches: int = 0  # re-run byte-identical on the host DFA
+    shed_retries: int = 0  # RESOURCE_EXHAUSTED evict-split-retry cycles
+    shed_evicted_slots: int = 0  # pool slots shed by OOM recovery
 
 
 class BatchScheduler:
@@ -277,6 +285,18 @@ class BatchScheduler:
         # HBM pressure state machine (ok/soft/hard), advanced by submit-
         # side watermark checks against memwatch.pressure().  owner: _lock
         self._hbm_state = "ok"
+        # Device circuit breaker: repeated device-engine failures flip
+        # batch routing to the host DFA path until a timed probe proves
+        # the device healthy again.  Transitions are audited through the
+        # gate decision log (reason "breaker") and promoted into the
+        # flight ring — the same trail a construction-time gate decision
+        # leaves.  All record_*/allow calls run on the owner thread.
+        self.breaker = CircuitBreaker(
+            failure_threshold=self.config.breaker_threshold,
+            window_s=self.config.breaker_window_s,
+            cooldown_s=self.config.breaker_cooldown_s,
+            on_transition=self._on_breaker_transition,
+        )
         self._register_metrics()
 
     def _register_metrics(self) -> None:
@@ -355,6 +375,28 @@ class BatchScheduler:
         self._m_errors = r.counter(
             "trivy_tpu_serve_batch_errors_total",
             "batches failed by an engine exception",
+        )
+        self._m_degraded = r.counter(
+            "trivy_tpu_serve_batch_degraded_total",
+            "batches re-run byte-identical on the host DFA after a device "
+            "failure (or while the breaker is open)",
+        )
+        self._m_shed = r.counter(
+            "trivy_tpu_serve_oom_shed_total",
+            "RESOURCE_EXHAUSTED shed-and-retry cycles (evict residents, "
+            "split the batch, retry once)",
+        )
+        self._m_breaker_state = r.gauge(
+            "trivy_tpu_device_breaker_state",
+            "device circuit breaker state (0=closed, 1=half-open, 2=open)",
+        )
+        self._m_breaker_opens = r.counter(
+            "trivy_tpu_device_breaker_opens_total",
+            "closed/half-open -> open transitions",
+        )
+        self._m_breaker_recloses = r.counter(
+            "trivy_tpu_device_breaker_recloses_total",
+            "half-open -> closed transitions (probe batch succeeded)",
         )
         self._m_epoch = r.gauge(
             "trivy_tpu_serve_ruleset_epoch",
@@ -585,10 +627,7 @@ class BatchScheduler:
                 lane.q.clear()
                 lane.nbytes = 0
         for t in stuck:
-            t.future.set_exception(
-                SchedulerClosedError("scheduler shut down")
-            )
-            self._release(t)
+            self._fail_ticket(t, SchedulerClosedError("scheduler shut down"))
 
     # -- engine-owner thread ---------------------------------------------
 
@@ -600,13 +639,99 @@ class BatchScheduler:
             else:
                 self._inflight[ticket.client_id] = n
 
+    def _fail_ticket(self, ticket: Ticket, exc: BaseException) -> None:
+        """Fail one ticket's future, tolerating a future that resolved
+        concurrently (an expiry racing the dispatch): a second
+        set_exception raises InvalidStateError INSIDE the engine-owner
+        thread, which would kill batching for every tenant."""
+        try:
+            ticket.future.set_exception(exc)
+        except InvalidStateError:
+            pass  # already resolved (deadline expiry won the race)
+        self._release(ticket)
+
+    def _resolve_ticket(self, ticket: Ticket, result) -> None:
+        """set_result with the same already-resolved guard."""
+        try:
+            ticket.future.set_result(result)
+        except InvalidStateError:
+            pass  # already resolved (deadline expiry won the race)
+        self._release(ticket)
+
+    def _scan_with_domains(self, engine, combined):  # graftlint: owner(serve-batcher)
+        """The failure-domain ladder around one device batch.
+
+        Routing: while the breaker is open (and not yet due a probe) the
+        device is not even attempted — straight to the byte-identical
+        host DFA re-run (`HybridSecretEngine.scan_batch_host`).  On a
+        device exception: RESOURCE_EXHAUSTED first tries shed-and-retry
+        (evict resident rulesets through the pool's LRU path, split the
+        batch in half, one retry), then any still-failing batch degrades
+        to the host path.  Every outcome feeds the breaker, so repeated
+        failures open it and a half-open probe's success re-closes it.
+
+        Returns (results, path) with path one of "device" (healthy),
+        "shed" (device succeeded after OOM recovery), "degraded" (host
+        re-run after a device failure), "breaker" (host run, device
+        skipped).  ScanTimeoutError is not a device failure — the
+        deadline fired — and propagates untouched."""
+        host_fn = getattr(engine, "scan_batch_host", None)
+        if host_fn is not None and not self.breaker.allow():
+            return host_fn(combined), "breaker"
+        try:
+            faults.fire("sched.dispatch")
+            results = engine.scan_batch(combined)
+        except ScanTimeoutError:
+            raise
+        except Exception as e:
+            if faults.is_oom(e):
+                results = self._shed_and_retry(engine, combined)
+                if results is not None:
+                    self.breaker.record_success()
+                    return results, "shed"
+            self.breaker.record_failure()
+            if host_fn is None:
+                raise  # no host path (pure-device engine): batch fails
+            return host_fn(combined), "degraded"
+        self.breaker.record_success()
+        return results, "device"
+
+    def _shed_and_retry(self, engine, combined):  # graftlint: owner(serve-batcher)
+        """RESOURCE_EXHAUSTED recovery: free device memory by LRU-evicting
+        resident rulesets (the PR-11 pool/memwatch path — eviction is
+        what actually returns HBM), then retry the batch in two halves so
+        the retry's peak footprint is roughly halved.  Returns stitched
+        results, or None to degrade to the host instead.  One retry
+        total: an OOM that survives eviction AND halving is a capacity
+        problem the host path absorbs better than a retry storm."""
+        self.stats.shed_retries += 1
+        self._m_shed.inc()
+        if self.pool is not None:
+            target = self.pool.accounted_bytes() // 2
+            evicted, _freed = self.pool.evict_to_bytes(target)
+            self.stats.shed_evicted_slots += evicted
+        halves = (
+            [combined[: len(combined) // 2], combined[len(combined) // 2 :]]
+            if len(combined) > 1
+            else [combined]
+        )
+        out: list = []
+        try:
+            for half in halves:
+                out.extend(engine.scan_batch(half))
+        except ScanTimeoutError:
+            raise
+        except Exception:  # graftlint: swallow(caller records + degrades to host)
+            return None
+        return out
+
     def _expire(self, ticket: Ticket) -> None:
         self.stats.expired += 1
         self._m_expired.inc()
-        ticket.future.set_exception(
-            ScanTimeoutError("request deadline expired before dispatch")
+        self._fail_ticket(
+            ticket,
+            ScanTimeoutError("request deadline expired before dispatch"),
         )
-        self._release(ticket)
         if self.flight is not None:
             # A deadline expiry IS the breach the flight recorder exists
             # for: capture here, at expiry time, so the scheduler snapshot
@@ -621,6 +746,27 @@ class BatchScheduler:
                 code=408,
                 elapsed_s=max(0.0, time.monotonic() - ticket.enqueued_at),
                 reason="deadline",
+            )
+
+    def _on_breaker_transition(self, old: str, new: str, why: str) -> None:
+        """Breaker state change: audit it everywhere an operator looks.
+        Runs synchronously on the owner thread (record_failure/allow call
+        it), outside every scheduler lock — the flight capture re-takes
+        them via snapshot_fn, which now embeds the breaker snapshot."""
+        gatelog.record(
+            requested="device",
+            backend="device" if new == "closed" else "dfa",
+            reason="breaker",
+            error=f"{old}->{new}: {why}",
+        )
+        if self.flight is not None:
+            self.flight.capture(
+                trace_id="",
+                method="breaker",
+                tenant="",
+                code=503 if new == "open" else 200,
+                elapsed_s=0.0,
+                reason="breaker",
             )
 
     def _pick_lane(self, ready: list[_Lane]) -> _Lane:  # graftlint: holds(_lock)
@@ -808,7 +954,12 @@ class BatchScheduler:
                 # register under this lane's ruleset, which is what the
                 # pool's measured-byte accounting reads back.
                 with memwatch.ruleset_digest(lane_digest or digest):
-                    results = engine.scan_batch(combined)
+                    results, engine_path = self._scan_with_domains(
+                        engine, combined
+                    )
+            if engine_path in ("degraded", "breaker"):
+                self.stats.degraded_batches += 1
+                self._m_degraded.inc()
             phase_deltas: dict[str, float] = {}
             if phases_before is not None:
                 # SieveStats accumulates across scan_batch calls; the
@@ -822,18 +973,30 @@ class BatchScheduler:
                         self.tenant_metrics.phase(lane_digest, phase, delta)
         except ScanTimeoutError:
             for t in batch:
-                t.future.set_exception(
-                    ScanTimeoutError("scan deadline exceeded in batch")
+                self._fail_ticket(
+                    t, ScanTimeoutError("scan deadline exceeded in batch")
                 )
-                self._release(t)
             return
-        except BaseException as e:
+        except Exception as e:
+            # Terminal batch failure: the device failed AND the degraded
+            # host re-run failed (or the engine has no host path).  Fail
+            # this batch's tickets; the owner thread survives to serve
+            # the next one.
             self.stats.errors += 1
             self._m_errors.inc()
             for t in batch:
-                t.future.set_exception(e)
-                self._release(t)
+                self._fail_ticket(t, e)
             return
+        except BaseException as e:
+            # KeyboardInterrupt/SystemExit must unwind the owner thread,
+            # but never with request threads left hanging on futures that
+            # would otherwise resolve on no one's schedule.
+            err = SchedulerClosedError(
+                f"scheduler interrupted by {type(e).__name__}"
+            )
+            for t in batch:
+                self._fail_ticket(t, err)
+            raise
         finally:
             _deadline.clear()
         batch_wall = time.monotonic() - t0
@@ -871,10 +1034,12 @@ class BatchScheduler:
                         "lane": lane_digest or "default",
                         "ruleset_digest": digest,
                         "ruleset_epoch": epoch,
+                        # which failure-domain path scanned this batch:
+                        # device | shed | degraded | breaker
+                        "engine_path": engine_path,
                     },
                 }
-            t.future.set_result(out)
-            self._release(t)
+            self._resolve_ticket(t, out)
 
     # -- hot reload ------------------------------------------------------
 
@@ -925,7 +1090,15 @@ class BatchScheduler:
             "inflight_per_client": inflight,
             "admitting": admitting,
             "hbm_state": hbm_state,
+            # Failure-domain posture: flight captures embed this snapshot,
+            # so every incident shows whether the breaker had the device
+            # out of rotation (and whether chaos faults were armed).
+            "breaker": self.breaker.snapshot(),
+            "degraded_batches": self.stats.degraded_batches,
+            "shed_retries": self.stats.shed_retries,
         }
+        if faults.active():
+            out["faults"] = faults.snapshot()
         if self.pool is not None:
             out["pool"] = [
                 {"digest": d, "epoch": e, "nbytes": n}
@@ -933,6 +1106,32 @@ class BatchScheduler:
             ]
         out["qos"] = self.qos.snapshot(now)
         return out
+
+    def readiness(self) -> dict:
+        """The /readyz verdict and its component checks.  Ready means "a
+        load balancer should send this host traffic": admitting (not
+        draining/closed), breaker not open (open = every batch pays the
+        degraded host path), and device memory below the hard watermark.
+        `engine_warm` is reported but NOT gated on — engines build lazily
+        on first dispatch, and a readiness probe that requires warmth
+        would keep a pull-through host out of rotation forever."""
+        with self._lock:
+            admitting = self._admitting
+            hbm_state = self._hbm_state
+        breaker = self.breaker.snapshot()
+        checks = {
+            "admitting": admitting,
+            "breaker": breaker["state"],
+            "hbm_state": hbm_state,
+            "engine_warm": self.manager.active is not None,
+            "pool_residents": (
+                len(self.pool.residents()) if self.pool is not None else 0
+            ),
+        }
+        ready = (
+            admitting and breaker["state"] != "open" and hbm_state != "hard"
+        )
+        return {"ready": ready, "checks": checks}
 
     def metrics_text(self) -> str:
         """Prometheus exposition for the serve subsystem.  When the server
@@ -950,6 +1149,10 @@ class BatchScheduler:
         self._m_inflight.set(self.inflight_tickets())
         self._m_epoch.set(self.manager.epoch)
         self._m_reloads.set_total(self.manager.reloads)
+        bs = self.breaker.snapshot()
+        self._m_breaker_state.set(bs["state_code"])
+        self._m_breaker_opens.set_total(bs["opened_total"])
+        self._m_breaker_recloses.set_total(bs["reclosed_total"])
         engine = self.manager.active
         stats = getattr(engine, "stats", None)
         if stats is None:
