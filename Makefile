@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test lint lint-changed lockcheck smoke serve-smoke obs-smoke slo-smoke tenancy-smoke mem-smoke chaos-smoke bench bench-link checks-corpus rules-cache perf-gate
+.PHONY: test lint lint-changed lockcheck smoke serve-smoke obs-smoke slo-smoke tenancy-smoke mem-smoke chaos-smoke bench bench-link bench-verify checks-corpus rules-cache perf-gate
 
 # Tier-1: the suite the driver holds the repo to (fast, CPU, no slow marks).
 # Lint runs first — a graftlint finding fails the build before pytest
@@ -155,6 +155,17 @@ bench-link:
 		BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 BENCH_IMAGE=0 \
 		BENCH_TENANT=0 BENCH_FAULT=0 BENCH_FILES=2000 BENCH_PARITY=sample \
 		$(PY) bench.py
+
+# Verify-backend economics only: the hit-dense corpus under host-DFA vs
+# legacy device-stream vs fused device-resident verify (bench.py
+# bench_verify_backends).  `--smoke` keeps the corpus small enough for
+# CPU CI; on TPU hosts drop it for the real device_vs_dfa / fused_vs_dfa
+# rows the perf-gate baseline tracks.
+bench-verify:
+	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_LINK=0 \
+		BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 BENCH_IMAGE=0 \
+		BENCH_TENANT=0 BENCH_MEM=0 BENCH_FAULT=0 \
+		$(PY) bench.py --smoke
 
 # Precompile the builtin ruleset into the registry cache (trivy_tpu/registry/)
 # so every later scan/server process warm-starts without compiling rules.

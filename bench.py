@@ -811,7 +811,7 @@ def bench_verify_backends(n_files: int) -> dict:
     }
     out["auto_resolves_to"] = HybridSecretEngine(verify="auto").verify
     results_by_mode = {}
-    for mode in ("dfa", "device"):
+    for mode in ("dfa", "device", "fused"):
         try:
             eng = HybridSecretEngine(verify=mode)
             eng.warmup()
@@ -828,7 +828,7 @@ def bench_verify_backends(n_files: int) -> dict:
         }
         if "device_pairs" in d:
             out[mode]["device_pairs"] = d["device_pairs"]
-        if mode == "device" and eng._nfa_verifier is not None:
+        if mode in ("device", "fused") and eng._nfa_verifier is not None:
             ss = getattr(eng._nfa_verifier, "stream_stats", None)
             if ss:
                 out[mode]["stream"] = {
@@ -844,20 +844,22 @@ def bench_verify_backends(n_files: int) -> dict:
                     ) * rtt
                     out[mode]["verify_link_floor_s"] = round(floor, 3)
         results_by_mode[mode] = (results, items)
-    if "device" in results_by_mode:
-        results, items = results_by_mode["device"]
-        out["device_parity_checked"], _ = assert_parity(
-            items, results, "sample"
-        )
-    if (
-        isinstance(out.get("dfa"), dict)
-        and isinstance(out.get("device"), dict)
-        and "files_per_sec" in out["dfa"]
-        and "files_per_sec" in out["device"]
-    ):
-        out["device_vs_dfa"] = round(
-            out["device"]["files_per_sec"] / out["dfa"]["files_per_sec"], 3
-        )
+    for mode in ("device", "fused"):
+        if mode in results_by_mode:
+            results, items = results_by_mode[mode]
+            out[f"{mode}_parity_checked"], _ = assert_parity(
+                items, results, "sample"
+            )
+    for mode in ("device", "fused"):
+        if (
+            isinstance(out.get("dfa"), dict)
+            and isinstance(out.get(mode), dict)
+            and "files_per_sec" in out["dfa"]
+            and "files_per_sec" in out[mode]
+        ):
+            out[f"{mode}_vs_dfa"] = round(
+                out[mode]["files_per_sec"] / out["dfa"]["files_per_sec"], 3
+            )
     return out
 
 
@@ -1489,7 +1491,9 @@ def _compact_detail(detail: dict) -> dict:
     vb = detail.get("verify_backend")
     if isinstance(vb, dict):
         vc = {
-            k: vb[k] for k in ("device_vs_dfa", "error") if k in vb
+            k: vb[k]
+            for k in ("device_vs_dfa", "fused_vs_dfa", "error")
+            if k in vb
         }
         dev = vb.get("device")
         if isinstance(dev, dict) and isinstance(dev.get("stream"), dict):
@@ -1499,6 +1503,18 @@ def _compact_detail(detail: dict) -> dict:
                 for k in (
                     "dispatches", "pipeline_depth", "h2d_overlap_s",
                     "assemble_s", "dispatch_s", "fetch_map_s",
+                )
+                if k in s
+            }
+        fus = vb.get("fused")
+        if isinstance(fus, dict) and isinstance(fus.get("stream"), dict):
+            s = fus["stream"]
+            vc["fused_stream"] = {
+                k: s[k]
+                for k in (
+                    "backend", "dispatches", "pipeline_depth",
+                    "assemble_s", "dispatch_s", "fetch_map_s",
+                    "fetch_bytes",
                 )
                 if k in s
             }

@@ -1,8 +1,9 @@
 """`GET /debug` index + the /debug/gate audit surface end to end.
 
 Boots the real server around a hybrid engine whose gate actually priced
-the link (narrow relay profile, so the decision is "link-narrow" and the
-scan safely stays on the host DFA), then asserts the acceptance loop:
+the link (a probed link narrow for every backend profile — fused
+included — so the decision is "link-narrow" and the scan safely stays
+on the host DFA), then asserts the acceptance loop:
 the same decision record — with the cost-model inputs it used — is
 readable from `GET /debug/gate`, lands inside the flight capture of a
 breached request, rides the `--explain` echo, and tallies into
@@ -33,11 +34,13 @@ SECRET_FILE = b"AWS_ACCESS_KEY_ID=AKIAQ6FAKEKEY1234567\n"
 
 @pytest.fixture
 def gate_server(monkeypatch, tmp_path):
-    # Price the gate for real: pretend a device exists, pin the narrow
-    # relay link profile.  auto -> link-narrow -> host DFA, so the scan
-    # itself never needs device kernels.
+    # Price the gate for real: pretend a device exists, pin a probed
+    # link too narrow for every profile (2 MB/s misses the eff bar even
+    # under the zero-reupload fused pricing; 500ms RTT misses the
+    # loosened fused RTT bar).  auto -> link-narrow -> host DFA, so the
+    # scan itself never needs device kernels.
     monkeypatch.setattr(hybrid, "_tpu_default_backend", lambda: True)
-    monkeypatch.setenv("TRIVY_TPU_LINK", "relay")
+    monkeypatch.setattr(hybrid, "probe_link", lambda *a, **k: (2.0, 0.5))
     gatelog.clear()
     obs_metrics.drain_device_phases()
     engine = HybridSecretEngine(verify="auto")
@@ -117,8 +120,8 @@ def test_debug_surfaces_end_to_end(gate_server):
     assert rec["requested"] == "auto"
     assert rec["backend"] == "dfa"
     assert rec["reason"] == "link-narrow"
-    assert rec["link"]["mb_per_sec"] == 50.0
-    assert rec["link"]["rtt_s"] == 0.1
+    assert rec["link"]["mb_per_sec"] == 2.0
+    assert rec["link"]["rtt_s"] == 0.5
     assert rec["link"]["eff_mb_per_sec"] < GATE_EFF_MB_S
     assert rec["thresholds"]["eff_mb_per_sec"] == GATE_EFF_MB_S
     assert rec["margin"] < 0
@@ -138,7 +141,7 @@ def test_debug_surfaces_end_to_end(gate_server):
     # -- and on the --explain echo ----------------------------------------
     exp = explained.get("Explain")
     assert exp and exp["gate"]["reason"] == "link-narrow"
-    assert exp["gate"]["link"]["mb_per_sec"] == 50.0
+    assert exp["gate"]["link"]["mb_per_sec"] == 2.0
 
     # -- /metrics: decision tallies + margin gauge ------------------------
     text = _get_text(addr, "/metrics")

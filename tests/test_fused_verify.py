@@ -1,0 +1,564 @@
+"""Fused device-resident sieve->verify (engine/device.py fused lane
+derivation + resident row store, engine/nfa_device.py fused verdict
+kernel, hybrid gate fused pricing, registry schema-3 rule stacks, and
+the serve scheduler's fused -> legacy-device -> host-DFA ladder).
+
+The binding CPU-CI contracts: fused-on vs fused-off vs oracle findings
+are byte-identical across every link-codec mode (including
+out-of-alphabet, NUL-heavy, exact-tile, and jumbo/overflow blobs), and
+`stream_stats["assemble_s"]` is timed directly (never negative under
+pipeline overlap — the old subtraction drift).
+"""
+
+import io
+import json
+import os
+import random
+
+import numpy as np
+import pytest
+
+ALNUM = (
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+    "abcdefghijklmnopqrstuvwxyz0123456789"
+)
+
+
+def _corpus(seed: int, tile_len: int) -> list[tuple[str, bytes]]:
+    """Fuzz corpus shaped like test_link_codec's, plus the fused-specific
+    hard cases: NUL-bracketed secrets (the stream span contains the dead
+    separator byte, forcing the overflow/padded path) and jumbo bodies."""
+    rng = random.Random(seed)
+    up = ALNUM[:26]
+
+    def pick(chars, n):
+        return "".join(rng.choice(chars) for _ in range(n)).encode()
+
+    secrets = [
+        lambda: b"ghp_" + pick(ALNUM, 36),
+        lambda: b'"AKIA' + pick(up + "0123456789", 16) + b'" ',
+        lambda: b"sk_live_" + pick("0123456789abcdefghij", 20),
+        lambda: b"glpat-" + pick(ALNUM, 20),
+        lambda: b"hf_" + pick(ALNUM, 39),
+    ]
+    out = []
+    for i in range(25):
+        kind = i % 5
+        if kind == 0:  # plain text with an embedded secret
+            body = pick(ALNUM + " \n", rng.randint(50, 800))
+            body += b"\nkey = " + rng.choice(secrets)() + b"\n"
+        elif kind == 1:  # out-of-alphabet binary noise around a secret
+            body = bytes(rng.randrange(128, 256) for _ in range(300))
+            if rng.random() < 0.7:
+                body += rng.choice(secrets)()
+            body += bytes(rng.randrange(128, 256) for _ in range(100))
+        elif kind == 2:  # NUL-heavy: class 0 must never match, and the
+            # NUL-containing span must overflow to the padded path
+            body = b"\x00" * rng.randint(100, 600)
+            if rng.random() < 0.6:
+                body += rng.choice(secrets)() + b"\x00" * 50
+        elif kind == 3:  # exactly one tile: the padding boundary case
+            sec = rng.choice(secrets)()
+            body = pick(ALNUM, tile_len - len(sec)) + sec
+            assert len(body) == tile_len
+        else:  # jumbo body, secret deep inside
+            body = (
+                pick(ALNUM + " \n", 4000)
+                + b"\ntoken " + rng.choice(secrets)() + b"\n"
+                + pick(ALNUM + " \n", 2000)
+            )
+        out.append((f"f{i:03d}.bin", body))
+    return out
+
+
+def _device_engine(codec_mode: str, fused: bool, tile_len: int = 512):
+    from trivy_tpu.engine.device import TpuSecretEngine
+
+    prev = os.environ.get("TRIVY_TPU_LINK_CODEC")
+    os.environ["TRIVY_TPU_LINK_CODEC"] = codec_mode
+    try:
+        return TpuSecretEngine(tile_len=tile_len, fused=fused)
+    finally:
+        if prev is None:
+            os.environ.pop("TRIVY_TPU_LINK_CODEC", None)
+        else:
+            os.environ["TRIVY_TPU_LINK_CODEC"] = prev
+
+
+# -- engine-level fuzz parity: fused lane derive vs host derive -----------
+
+
+def test_fused_engine_fuzz_parity_all_codec_modes():
+    """Fused on-device lane derivation produces byte-identical findings
+    to the host derive across every codec mode, and matches the oracle."""
+    from trivy_tpu.engine.oracle import OracleScanner
+    from trivy_tpu.registry.store import findings_fingerprint
+
+    tile_len = 512
+    corpus = _corpus(seed=42, tile_len=tile_len)
+    fps = {}
+    engines = {}
+    for mode in ("off", "auto", "4", "6"):
+        for fused in (False, True):
+            eng = _device_engine(mode, fused, tile_len)
+            assert eng._fused is fused
+            engines[(mode, fused)] = eng
+            fps[(mode, fused)] = findings_fingerprint(eng, corpus)
+    assert len(set(fps.values())) == 1, {
+        k: len(v) for k, v in fps.items()
+    }
+    oracle = OracleScanner()
+    for (path, content), dev in zip(
+        corpus, engines[("off", True)].scan_batch(corpus)
+    ):
+        ref = oracle.scan(path, content)
+        assert [
+            (f.rule_id, f.start_line, f.match) for f in dev.findings
+        ] == [(f.rule_id, f.start_line, f.match) for f in ref.findings], path
+
+
+def test_fused_engine_resident_rows_rescan():
+    """A rescan of identical content hits the resident row store: no
+    re-upload, the sieve result comes straight from the retained device
+    buffers, and the store's bytes are ledgered."""
+    corpus = _corpus(seed=7, tile_len=512)
+    eng = _device_engine("off", True, 512)
+    first = eng.scan_batch(corpus)
+    hits_before = eng.stats.resident_hits
+    store = eng._row_store
+    assert store is not None and len(store) > 0
+    assert store.nbytes() > 0
+    second = eng.scan_batch(corpus)
+    assert eng.stats.resident_hits > hits_before
+    flat = lambda res: [
+        (s.file_path, [(f.rule_id, f.start_line, f.match) for f in s.findings])
+        for s in res
+    ]
+    assert flat(first) == flat(second)
+
+
+def test_fused_env_default_and_override(monkeypatch):
+    monkeypatch.setenv("TRIVY_TPU_FUSED", "0")
+    eng = _device_engine("off", None, 512)
+    assert eng._fused is False
+    monkeypatch.setenv("TRIVY_TPU_FUSED", "1")
+    eng = _device_engine("off", None, 512)
+    assert eng._fused is True
+    # explicit param beats the env
+    eng = _device_engine("off", False, 512)
+    assert eng._fused is False
+
+
+# -- hybrid verify parity: fused vs legacy stream vs host DFA -------------
+
+
+def _hybrid_corpus() -> list[tuple[str, bytes]]:
+    rng = random.Random(11)
+    pick = lambda n: "".join(rng.choice(ALNUM) for _ in range(n)).encode()
+    sec = lambda: b"ghp_" + pick(36)
+    out = [
+        (f"src/a{i}.env", b"x = 1\nTOKEN = " + sec() + b"\n" + pick(200))
+        for i in range(8)
+    ]
+    # NUL-bracketed secret: the stream span contains the dead separator,
+    # so this lane MUST overflow to the padded path
+    out.append(("nul.bin", b"\x00" * 200 + sec() + b"\x00" * 50))
+    # jumbo: secret deep inside a large file (trim keeps it eligible)
+    out.append(("big.txt", pick(40000) + b"\nt " + sec() + b"\n" + pick(9000)))
+    out.append(("clean.md", b"prose, no secrets, " + pick(500)))
+    return out
+
+
+def test_hybrid_fused_parity_and_stream_stats_tags():
+    from trivy_tpu.engine.hybrid import HybridSecretEngine
+    from trivy_tpu.registry.store import findings_fingerprint
+
+    corpus = _hybrid_corpus()
+    engines = {
+        m: HybridSecretEngine(verify=m) for m in ("dfa", "device", "fused")
+    }
+    fps = {m: findings_fingerprint(e, corpus) for m, e in engines.items()}
+    assert len(set(fps.values())) == 1, {m: len(v) for m, v in fps.items()}
+
+    ss_fused = engines["fused"]._nfa_verifier.stream_stats
+    ss_legacy = engines["device"]._nfa_verifier.stream_stats
+    assert ss_fused["backend"] == "fused"
+    assert ss_legacy["backend"] == "stream"
+    # the NUL-bracketed lane took the padded path on both backends
+    assert ss_fused["overflow_lanes"] >= 1
+    assert ss_legacy["overflow_lanes"] >= 1
+    assert ss_fused["dispatches"] >= 1
+    # fused fetches packed keep-mask bits, not per-position flag maps
+    assert ss_fused["fetch_bytes"] <= ss_legacy["fetch_bytes"]
+
+
+@pytest.mark.parametrize("scan_mode", ["seq", "assoc"])
+def test_hybrid_fused_scan_modes_parity(monkeypatch, scan_mode):
+    """Both fused block-walk strategies (sequential carry and the affine
+    associative scan) produce findings identical to the host DFA."""
+    from trivy_tpu.engine.hybrid import HybridSecretEngine
+    from trivy_tpu.registry.store import findings_fingerprint
+
+    monkeypatch.setenv("TRIVY_TPU_FUSED_SCAN", scan_mode)
+    corpus = _hybrid_corpus()
+    fused = HybridSecretEngine(verify="fused")
+    dfa = HybridSecretEngine(verify="dfa")
+    assert findings_fingerprint(fused, corpus) == findings_fingerprint(
+        dfa, corpus
+    )
+
+
+def test_assemble_s_timed_directly_nonnegative(monkeypatch):
+    """stream_stats["assemble_s"] is measured with its own clock (paused
+    during flushes), so pipelined dispatch overlap can never drive it
+    negative — the old end-to-end-minus-dispatch subtraction could."""
+    import time as _time
+
+    from trivy_tpu.engine import nfa_device
+    from trivy_tpu.engine.hybrid import HybridSecretEngine
+
+    # Tiny group buckets + one span per row force multiple dispatches; a
+    # slowed h2d stage makes overlapped time >> assembly time, the
+    # regression's trigger shape.
+    monkeypatch.setattr(nfa_device, "STREAM_GROUP_BUCKETS", (1,))
+    rng = random.Random(2)
+    pick = lambda n: "".join(rng.choice(ALNUM) for _ in range(n)).encode()
+    sec = lambda: b"ghp_" + pick(36)
+    corpus = [
+        (
+            f"f{i}.env",
+            b"a = " + sec() + b"\n" + pick(350) + b"\nb = " + sec() + b"\n",
+        )
+        for i in range(80)
+    ]
+    for mode in ("device", "fused"):
+        eng = HybridSecretEngine(verify=mode)
+        nfa = eng._nfa_verifier
+        orig_put = nfa._put_stream
+
+        def slow_put(arr, _orig=orig_put):
+            _time.sleep(0.002)
+            return _orig(arr)
+
+        monkeypatch.setattr(nfa, "_put_stream", slow_put)
+        eng.scan_batch(corpus)
+        ss = nfa.stream_stats
+        assert ss["dispatches"] >= 2, mode
+        assert ss["assemble_s"] >= 0.0, (mode, ss)
+        assert ss["dispatch_s"] > 0.0, mode
+        # the direct clocks never overcount the stage wall either
+        assert ss["assemble_s"] < 60.0, (mode, ss)
+
+
+# -- fused kernel unit parity ---------------------------------------------
+
+
+def test_assoc_vs_seq_kernel_parity():
+    """The affine block-summary associative scan computes the same
+    per-rule flag maps as the sequential carry, on random automata."""
+    import jax.numpy as jnp
+
+    from trivy_tpu.engine.nfa_device import NfaVerifier
+
+    rng = np.random.default_rng(5)
+    rb, lo, g, bg = 3, 4, 2, 8
+    bytes_t = jnp.asarray(
+        rng.integers(0, 256, size=(lo, 32, g, bg), dtype=np.uint8)
+    )
+    follow = jnp.asarray(rng.random((rb, 64, 64)) < 0.05, jnp.float32)
+    accept_b = jnp.asarray(rng.random((rb, 256, 64)) < 0.02, jnp.float32)
+    first = jnp.asarray(rng.random((rb, 64)) < 0.2, jnp.float32)
+    last = jnp.asarray(rng.random((rb, 64)) < 0.2, jnp.float32)
+    seq = np.asarray(
+        NfaVerifier._stream_multi_impl(
+            bytes_t, follow, accept_b, first, last, False
+        )
+    )
+    assoc = np.asarray(
+        NfaVerifier._stream_assoc_impl(
+            bytes_t, follow, accept_b, first, last, False
+        )
+    )
+    assert np.array_equal(seq, assoc)
+
+
+def test_fused_scan_mode_env(monkeypatch):
+    from trivy_tpu.engine.nfa_device import fused_scan_mode
+
+    monkeypatch.delenv("TRIVY_TPU_FUSED_SCAN", raising=False)
+    assert fused_scan_mode() == "auto"
+    monkeypatch.setenv("TRIVY_TPU_FUSED_SCAN", "assoc")
+    assert fused_scan_mode() == "assoc"
+    monkeypatch.setenv("TRIVY_TPU_FUSED_SCAN", "SEQ")
+    assert fused_scan_mode() == "seq"
+    monkeypatch.setenv("TRIVY_TPU_FUSED_SCAN", "bogus")
+    assert fused_scan_mode() == "auto"
+
+
+# -- gate pricing ---------------------------------------------------------
+
+
+def test_gate_prices_fused_profile(monkeypatch):
+    """On a relay link (50 MB/s, 100ms RTT) the legacy stream loses the
+    gate but the fused profile clears it: verify rows stay resident
+    (zero re-upload), only the packed mask crosses back, and the O(1)
+    dispatch count loosens the RTT bar."""
+    from trivy_tpu.engine import hybrid
+
+    monkeypatch.setenv("TRIVY_TPU_LINK", "relay")
+    stream = hybrid.gate_terms(d2h_ratio=0.15)
+    assert stream["profile"] == "stream" and not stream["wide"]
+    from trivy_tpu.engine import link as link_mod
+
+    fused = hybrid.gate_terms(
+        d2h_ratio=link_mod.FUSED_MASK_D2H_RATIO, profile="fused"
+    )
+    assert fused["profile"] == "fused" and fused["wide"]
+    assert fused["rtt_threshold_s"] == hybrid.FUSED_GATE_RTT_S
+    assert fused["eff_mb_per_sec"] > stream["eff_mb_per_sec"]
+    assert fused["margin"] > 0 > stream["margin"]
+
+
+def test_auto_resolves_to_fused_on_relay(monkeypatch):
+    from trivy_tpu.engine import hybrid
+    from trivy_tpu.obs import gatelog
+
+    monkeypatch.setenv("TRIVY_TPU_LINK", "relay")
+    monkeypatch.setattr(hybrid, "_tpu_default_backend", lambda: True)
+    eng = hybrid.HybridSecretEngine(verify="auto")
+    assert eng.verify == "fused"
+    rec = eng.gate_decision
+    assert rec["backend"] == "fused" and rec["reason"] == "link-wide"
+    assert rec["thresholds"]["rtt_s"] == hybrid.FUSED_GATE_RTT_S
+    assert rec["margin"] > 0
+    assert gatelog.tallies().get(("fused", "link-wide"), 0) >= 1
+
+
+def test_gate_rejects_unknown_verify():
+    from trivy_tpu.engine.hybrid import HybridSecretEngine
+
+    with pytest.raises(ValueError):
+        HybridSecretEngine(verify="warp")
+
+
+# -- scheduler degraded ladder --------------------------------------------
+
+
+class _Breaker:
+    def __init__(self):
+        self.failures = 0
+        self.successes = 0
+
+    def allow(self):
+        return True
+
+    def record_failure(self):
+        self.failures += 1
+
+    def record_success(self):
+        self.successes += 1
+
+
+def _ladder_call(engine):
+    from types import SimpleNamespace
+
+    from trivy_tpu.serve.scheduler import BatchScheduler
+
+    fake = SimpleNamespace(breaker=_Breaker(), pool=None)
+    out = BatchScheduler._scan_with_domains(fake, engine, [("a", b"x")])
+    return out, fake.breaker
+
+
+def test_scheduler_fused_steps_down_to_legacy_device():
+    """A fused engine failure degrades ONE rung: the legacy device
+    stream absorbs the batch; the host path is never consulted."""
+    from types import SimpleNamespace
+
+    calls = []
+    engine = SimpleNamespace(
+        verify="fused",
+        scan_batch=lambda items: (_ for _ in ()).throw(ValueError("boom")),
+        scan_batch_device_legacy=lambda items: calls.append("legacy")
+        or ["legacy-result"],
+        scan_batch_host=lambda items: calls.append("host") or ["host-result"],
+    )
+    (results, path), breaker = _ladder_call(engine)
+    assert results == ["legacy-result"] and path == "degraded"
+    assert calls == ["legacy"]
+    assert breaker.failures == 1
+
+
+def test_scheduler_ladder_falls_through_to_host():
+    from types import SimpleNamespace
+
+    def boom(items):
+        raise ValueError("boom")
+
+    engine = SimpleNamespace(
+        verify="fused",
+        scan_batch=boom,
+        scan_batch_device_legacy=boom,
+        scan_batch_host=lambda items: ["host-result"],
+    )
+    (results, path), breaker = _ladder_call(engine)
+    assert results == ["host-result"] and path == "degraded"
+    assert breaker.failures == 2  # fused failure + legacy failure
+
+
+def test_scheduler_legacy_rung_skipped_for_non_fused():
+    from types import SimpleNamespace
+
+    calls = []
+    engine = SimpleNamespace(
+        verify="device",
+        scan_batch=lambda items: (_ for _ in ()).throw(ValueError("boom")),
+        scan_batch_device_legacy=lambda items: calls.append("legacy"),
+        scan_batch_host=lambda items: ["host-result"],
+    )
+    (results, path), _ = _ladder_call(engine)
+    assert results == ["host-result"] and path == "degraded"
+    assert calls == []  # the legacy rung is fused-only
+
+
+def test_scheduler_timeout_propagates_from_legacy_rung():
+    from types import SimpleNamespace
+
+    from trivy_tpu.deadline import ScanTimeoutError
+
+    def boom(items):
+        raise ValueError("boom")
+
+    def timeout(items):
+        raise ScanTimeoutError("deadline")
+
+    engine = SimpleNamespace(
+        verify="fused",
+        scan_batch=boom,
+        scan_batch_device_legacy=timeout,
+        scan_batch_host=lambda items: ["host-result"],
+    )
+    with pytest.raises(ScanTimeoutError):
+        _ladder_call(engine)
+
+
+def test_hybrid_scan_batch_device_legacy_restores_fused():
+    """The one-rung step-down runs the legacy stream and restores the
+    fused flag even if the legacy path raises."""
+    from trivy_tpu.engine.hybrid import HybridSecretEngine
+
+    eng = HybridSecretEngine(verify="fused")
+    corpus = _hybrid_corpus()
+    want = HybridSecretEngine(verify="device").scan_batch(corpus)
+    got = eng.scan_batch_device_legacy(corpus)
+    flat = lambda res: [
+        (s.file_path, [(f.rule_id, f.start_line, f.match) for f in s.findings])
+        for s in res
+    ]
+    assert flat(got) == flat(want)
+    assert eng._nfa_verifier.fused is True
+    assert eng._nfa_verifier.stream_stats["backend"] == "stream"
+
+
+# -- resident row store ---------------------------------------------------
+
+
+def test_resident_row_store_lru_and_ledger():
+    from trivy_tpu.engine.pipeline import ResidentRowStore
+    from trivy_tpu.obs import memwatch
+
+    store = ResidentRowStore(capacity=2)
+    a = (np.zeros((4, 8), np.uint8), np.ones((4, 2), np.uint32))
+    b = (np.zeros((2, 8), np.uint8), np.ones((2, 2), np.uint32))
+    c = (np.zeros((8, 8), np.uint8), np.ones((8, 2), np.uint32))
+    store.put_rows("da", *a)
+    store.put_rows("db", *b)
+    got = store.rows("da")  # refreshes LRU order
+    assert got[0] is a[0] and got[1] is a[1]
+    store.put_rows("dc", *c)  # evicts db (least recent)
+    assert store.rows("db") is None
+    assert store.rows("dc") is not None
+    assert len(store) == 2
+    assert store.nbytes() == sum(
+        memwatch.nbytes_of(v) for v in (a, c)
+    )
+    store.clear()
+    assert len(store) == 0 and store.nbytes() == 0
+
+
+# -- registry schema 3: stacked rule tensors ------------------------------
+
+
+def _roundtrip(tmp_path):
+    from trivy_tpu.registry import store as rstore
+    from trivy_tpu.rules.model import build_ruleset
+
+    rs = build_ruleset()
+    art = rstore.compile_ruleset(rs)
+    rstore.save_artifact(art, str(tmp_path))
+    return rs, art, rstore.load_artifact(str(tmp_path), art.digest)
+
+
+def test_vstack_roundtrip_seeds_verifier(tmp_path):
+    from trivy_tpu.engine.nfa_device import NfaVerifier
+
+    rs, art, loaded = _roundtrip(tmp_path)
+    assert loaded is not None
+    assert loaded.manifest["schema_version"] == 3
+    assert loaded.manifest["vstack"]["stream_rules"] > 0
+    for k, v in art.vstack.items():
+        assert np.array_equal(v, loaded.vstack[k]), k
+    fresh = NfaVerifier(rs.rules)
+    warm = NfaVerifier(rs.rules, rule_stack=loaded.vstack)
+    for r in range(fresh.num_rules):
+        if fresh._nfas[r] is None:
+            assert r not in warm._byte_tensor_cache
+            continue
+        got = warm._byte_tensor_cache.get(r)
+        assert got is not None, r  # warm start skipped the Python build
+        want = fresh._rule_byte_tensors(r)
+        assert all(
+            np.array_equal(a, b) for a, b in zip(want, got)
+        ), r
+
+
+def test_vstack_tamper_rejected(tmp_path):
+    """A stack whose byte-0x00 accept row is live (or any non-indicator
+    value) fails validation and the loader falls back to recompile."""
+    import hashlib
+
+    from trivy_tpu.registry import store as rstore
+
+    _, art, _ = _roundtrip(tmp_path)
+    dirp = tmp_path / art.digest
+    blob = (dirp / rstore.ARTIFACT_NPZ).read_bytes()
+    z = dict(np.load(io.BytesIO(blob)))
+    z["vstack_accept_b"][0, 0, 0] = 1  # byte 0x00 must stay dead
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **z)
+    nb = buf.getvalue()
+    man = json.loads((dirp / rstore.MANIFEST_JSON).read_text())
+    man["npz_sha256"] = hashlib.sha256(nb).hexdigest()
+    man["npz_bytes"] = len(nb)
+    (dirp / rstore.ARTIFACT_NPZ).write_bytes(nb)
+    (dirp / rstore.MANIFEST_JSON).write_text(json.dumps(man))
+    assert rstore.load_artifact(str(tmp_path), art.digest) is None
+
+
+def test_vstack_mismatched_stack_ignored():
+    """A rule stack whose rule count disagrees is ignored — the verifier
+    keeps its lazy per-rule build instead of mis-seeding."""
+    from trivy_tpu.engine.nfa_device import NfaVerifier
+    from trivy_tpu.rules.model import build_ruleset
+
+    rules = build_ruleset().rules
+    bad = {
+        "vstack_has": np.ones(1, np.uint8),
+        "vstack_follow": np.zeros((1, 64, 64), np.uint8),
+        "vstack_accept_b": np.zeros((1, 256, 64), np.uint8),
+        "vstack_first": np.zeros((1, 64), np.uint8),
+        "vstack_last": np.zeros((1, 64), np.uint8),
+    }
+    v = NfaVerifier(rules, rule_stack=bad)
+    assert not v._byte_tensor_cache
+    v2 = NfaVerifier(rules, rule_stack={"vstack_has": np.ones(1, np.uint8)})
+    assert not v2._byte_tensor_cache

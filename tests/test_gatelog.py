@@ -137,17 +137,37 @@ def test_auto_without_device_records_no_device(monkeypatch):
 
 def test_auto_narrow_link_records_cost_model_terms(monkeypatch):
     monkeypatch.setattr(hybrid, "_tpu_default_backend", lambda: True)
-    monkeypatch.setenv("TRIVY_TPU_LINK", "relay")
+    # Narrow for BOTH profiles: 2 MB/s cannot clear the eff bar even
+    # under the fused pricing (zero verify re-upload), and the 500ms RTT
+    # misses the loosened FUSED_GATE_RTT_S bar too — auto falls all the
+    # way through fused -> stream -> host DFA.
+    monkeypatch.setattr(hybrid, "probe_link", lambda *a, **k: (2.0, 0.5))
     eng = HybridSecretEngine(verify="auto")
     assert eng.verify == "dfa"
     gd = eng.gate_decision
     assert gd["reason"] == "link-narrow"
     assert gd["backend"] == "dfa"
-    assert gd["link"]["mb_per_sec"] == 50.0
-    assert gd["link"]["rtt_s"] == 0.1
+    assert gd["link"]["mb_per_sec"] == 2.0
+    assert gd["link"]["rtt_s"] == 0.5
     assert gd["link"]["eff_mb_per_sec"] < GATE_EFF_MB_S
     assert gd["thresholds"]["eff_mb_per_sec"] == GATE_EFF_MB_S
     assert gd["margin"] < 0
+
+
+def test_auto_relay_link_clears_the_fused_bar(monkeypatch):
+    """The fused cost model is the relay story: rows stay resident so
+    re-upload is ~zero and the O(1) dispatch count loosens the RTT bar —
+    a link too narrow for the legacy stream resolves fused, not dfa."""
+    monkeypatch.setattr(hybrid, "_tpu_default_backend", lambda: True)
+    monkeypatch.setenv("TRIVY_TPU_LINK", "relay")
+    eng = HybridSecretEngine(verify="auto")
+    assert eng.verify == "fused"
+    gd = eng.gate_decision
+    assert gd["reason"] == "link-wide"
+    assert gd["backend"] == "fused"
+    assert gd["link"]["mb_per_sec"] == 50.0
+    assert gd["thresholds"]["rtt_s"] == hybrid.FUSED_GATE_RTT_S
+    assert gd["margin"] > 0
 
 
 def test_auto_wide_link_records_device_decision(monkeypatch):
@@ -155,8 +175,10 @@ def test_auto_wide_link_records_device_decision(monkeypatch):
     monkeypatch.setenv("TRIVY_TPU_LINK", "wide")
     eng = HybridSecretEngine(verify="auto")
     gd = eng.gate_decision
-    if eng.verify == "device":
+    if eng.verify in ("device", "fused"):
+        # the fused profile is priced first, so a wide link lands fused
         assert gd["reason"] == "link-wide"
+        assert gd["backend"] == eng.verify
         assert gd["margin"] > 0
         assert gd["link"]["eff_mb_per_sec"] >= GATE_EFF_MB_S
     else:

@@ -159,6 +159,7 @@ class TpuSecretEngine:
         dedupe: bool = True,
         resident_chunks: int | None = None,
         compiled=None,
+        fused: bool | None = None,
     ):
         from trivy_tpu.engine.pipeline import (
             ResidentChunkCache,
@@ -188,6 +189,14 @@ class TpuSecretEngine:
         )
         self.dedupe = dedupe
         self._resident = ResidentChunkCache(resident_chunks)
+        # Fused sieve->verify residency (this PR's tentpole): staged rows
+        # and their hit words stay device-resident for the batch lifetime
+        # and candidate lanes derive ON-DEVICE (no d2h of the full hit
+        # matrix).  Resolved on the gram jax path below; native/lut keep
+        # the host derivation.
+        self._fused = False
+        self._fused_requested = fused
+        self._row_store = None
         self._sieve_donated = None
         self._mesh = mesh
         self._tile_buckets = TILE_BUCKETS
@@ -265,6 +274,18 @@ class TpuSecretEngine:
                 unpack = None
 
             on_tpu = jax.devices()[0].platform == "tpu"
+            # Fused default: on for TPU hosts (where killing the d2h of
+            # the hit matrix pays), opt-in elsewhere — explicit `fused=`
+            # or TRIVY_TPU_FUSED=1/0 overrides either way.  CPU CI holds
+            # the path to byte-parity via the fused-vs-legacy tests
+            # rather than running it by default.
+            _fenv = os.environ.get("TRIVY_TPU_FUSED", "")
+            if self._fused_requested is not None:
+                self._fused = bool(self._fused_requested)
+            elif _fenv:
+                self._fused = _fenv != "0"
+            else:
+                self._fused = on_tpu
             use_pallas = kernel == "pallas" or (kernel == "auto" and on_tpu)
             if use_pallas:
                 # Pallas kernel (production path): gram constants baked into
@@ -611,6 +632,203 @@ class TpuSecretEngine:
         self.stats.h2d_overlap_s += pipe.stats.h2d_overlap_s
         return np.concatenate(outs)[:total]
 
+    def _use_fused_derive(self) -> bool:
+        """Fused residency + on-device lane derive applies on the
+        un-meshed gram jax path only (the verdict matmuls would cross a
+        sharded gram axis) and never under sync-timing decomposition
+        (whose phase boundaries assume the serial host path)."""
+        return (
+            self._fused
+            and self.sieve == "gram"
+            and self._mesh is None
+            and self.gset.num_grams > 0
+            and not os.environ.get("TRIVY_TPU_SYNC_TIMING")
+        )
+
+    def _get_row_store(self):
+        if self._row_store is None:
+            from trivy_tpu.engine.pipeline import ResidentRowStore
+
+            self._row_store = ResidentRowStore()
+        return self._row_store
+
+    def _sieve_rows_fused(self, rows: np.ndarray):
+        """`_sieve_rows` with device residency: chunk hit words STAY on
+        device (the return is a [Tpad, W] device array — Tpad is the
+        bucket-padded row count, so downstream jit shapes stay bounded),
+        and each chunk's staged rows + hit words enter the
+        ResidentRowStore under the chunk digest, where the fused verify
+        walk (engine/nfa_device.py) and digest-identical rescans read
+        them back without re-crossing the link.  The exec path is the
+        NON-donated sieve: donation would hand the staged rows'
+        allocation back to XLA and invalidate the residency."""
+        import jax
+        import jax.numpy as jnp
+
+        from trivy_tpu.engine.pipeline import ChunkPipeline, chunk_digest
+
+        store = self._get_row_store()
+        buckets = self._buckets()
+        max_rows = buckets[-1]
+        total = len(rows)
+        fit = next((b for b in buckets if total <= b), None)
+        if fit is not None:
+            buf, raw_n = self._encode_chunk(self._pad_chunk(rows, 0, fit))
+            digest = chunk_digest(buf) + self._codec_tag
+            if store.capacity:
+                res = store.rows(digest)
+                if res is not None:
+                    self.stats.resident_hits += 1
+                    return res[1]
+            self.stats.device_dispatches += 1
+            self._count_link(raw_n, buf.nbytes)
+            with obs_trace.span("chunk.h2d", bytes=buf.nbytes):
+                faults.fire("device.put")
+                dev = jax.device_put(buf)
+            with obs_trace.span("chunk.exec"):
+                faults.fire("device.exec")
+                out = self._exec_attributed(dev)
+            if store.capacity:
+                store.put_rows(digest, dev, out)
+            return out
+        n_chunks = -(-total // max_rows)
+        outs: list = [None] * n_chunks
+
+        def stage(ci):
+            part = self._pad_chunk(rows, ci * max_rows, max_rows)
+            buf, raw_n = self._encode_chunk(part)
+            digest = chunk_digest(buf) + self._codec_tag
+            if store.capacity:
+                res = store.rows(digest)
+                if res is not None:
+                    return (digest, res[0], res[1], True)
+            self._count_link(raw_n, buf.nbytes)
+            with obs_trace.span("chunk.h2d", chunk=ci, bytes=buf.nbytes):
+                faults.fire("device.put")
+                dev = jax.device_put(buf)
+            return (digest, dev, None, False)
+
+        def execute(ci, staged):
+            digest, dev, out, hit = staged
+            if hit:
+                self.stats.resident_hits += 1
+                return staged
+            self.stats.device_dispatches += 1
+            with obs_trace.span("chunk.exec", chunk=ci):
+                faults.fire("device.exec")
+                out = self._exec_attributed(dev)
+            return (digest, dev, out, False)
+
+        def finish(ci, handle):
+            digest, dev, out, hit = handle
+            if not hit and store.capacity:
+                # residency bytes ledger through the store's memwatch
+                # component ("resident-rows"); capacity-0 stores keep the
+                # arrays only until `outs` is consumed
+                store.put_rows(digest, dev, out)
+            outs[ci] = out
+
+        pipe = ChunkPipeline(
+            stage, execute, finish, depth=self.pipeline_depth
+        )
+        pipe.run(range(n_chunks))
+        self.stats.h2d_overlap_s += pipe.stats.h2d_overlap_s
+        return jnp.concatenate(outs)
+
+    def _derive_fn(self):
+        """Jitted on-device candidate derivation, built once per engine:
+        hit words -> per-file gram intervals (cumsum + row-range
+        difference, mirroring DenseBatch.file_hits) -> window/probe
+        matmuls (GramSet.probe_hits_bool) -> gate/conjunct membership
+        matmuls (candidate_matrix_bool) -> [Fp, R] uint8 candidates.
+        All f32 — integer counts bounded far below 2^24, so the device
+        result is bit-identical to the host derivation."""
+        cached = getattr(self, "_derive_jit", None)
+        if cached is not None:
+            return cached
+        import jax
+        import jax.numpy as jnp
+
+        gset = self.gset
+        pallas_obj = getattr(self, "_pallas_obj", None)
+        if pallas_obj is not None and len(pallas_obj.gram_expand):
+            expand = jnp.asarray(
+                np.asarray(pallas_obj.gram_expand, dtype=np.int32)
+            )
+        else:
+            n = (
+                pallas_obj.num_distinct
+                if pallas_obj is not None
+                else gset.num_grams
+            )
+            expand = jnp.arange(n, dtype=jnp.int32)
+        wmember = jnp.asarray(gset._wmember)  # [G, W] f32 0/1
+        pmember = jnp.asarray(gset._pmember)  # [W, P] f32 0/1
+        pwindows = jnp.asarray(gset._pwindows)  # [P] f32 counts
+        nogram = jnp.asarray(~gset.probe_has_gram)  # [P] bool
+        gate_member = jnp.asarray(self._gate_member)  # [P, R]
+        conj_member = jnp.asarray(self._conj_member)  # [P, R*K]
+        gate_any = jnp.asarray(self._gate_any)  # [R] bool
+        conj_any = jnp.asarray(self._conj_any)  # [R, K] bool
+        r = len(self.pset.plans)
+        k = self._num_conjuncts
+
+        @jax.jit
+        def derive(hits, lo, hi, valid):
+            # hits [T, W] uint32 packed gram words; lo/hi [Fp] int32 row
+            # ranges (hi INCLUSIVE, packing.DenseBatch contract); valid
+            # [Fp] bool (False rows — padding, empty files — derive all
+            # zero gram hits, same as file_hits)
+            t = hits.shape[0]
+            bits = (
+                (hits[:, :, None] >> jnp.arange(32, dtype=jnp.uint32)) & 1
+            ).reshape(t, -1)[:, expand].astype(jnp.float32)  # [T, G]
+            cs = jnp.cumsum(bits, axis=0)
+            csz = jnp.concatenate(
+                [jnp.zeros((1, bits.shape[1]), jnp.float32), cs]
+            )
+            lo_c = jnp.clip(lo, 0, t)
+            hi_c = jnp.clip(hi + 1, 0, t)
+            gh = ((csz[hi_c] - csz[lo_c]) > 0) & valid[:, None]  # [Fp, G]
+            win = (gh.astype(jnp.float32) @ wmember) > 0
+            ph = (
+                (win.astype(jnp.float32) @ pmember) >= pwindows[None, :]
+            ) | nogram[None, :]
+            phf = ph.astype(jnp.float32)
+            gate_ok = (~gate_any[None, :]) | ((phf @ gate_member) > 0)
+            conj_hit = (phf @ conj_member).reshape(-1, r, k) > 0
+            conj_ok = (~conj_any[None] | conj_hit).all(-1)
+            return (gate_ok & conj_ok).astype(jnp.uint8)
+
+        self._derive_jit = derive
+        return derive
+
+    def _derive_candidates_device(self, batch, hits_dev) -> np.ndarray:
+        """Candidate lane derivation without the hit-matrix round-trip:
+        the sieve's device-resident hit words feed the jitted derivation
+        and only the (compacted) [F, R] candidate matrix crosses the
+        link.  File count pads to a power of two so the jit
+        specializations stay bounded at log2(F)."""
+        import jax.numpy as jnp
+
+        f = batch.num_files
+        if f == 0:
+            return np.zeros((0, len(self.pset.plans)), dtype=bool)
+        fp = max(8, 1 << (f - 1).bit_length())
+        lo = np.zeros(fp, np.int32)
+        hi = np.full(fp, -1, np.int32)  # padded files: hi < lo -> invalid
+        lo[:f] = batch.file_row_lo
+        hi[:f] = batch.file_row_hi
+        valid = hi >= lo
+        derive = self._derive_fn()
+        ph = obs_metrics.device_phase("lane.derive")
+        out = derive(
+            hits_dev, jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(valid)
+        )
+        ph.done(out)
+        arr = self._fetch_hits(out)  # compacted d2h + byte accounting
+        return arr[:f].astype(bool)
+
     def _exec_attributed(self, dev):
         """One sieve execution with per-kernel attribution.  When tracing
         is enabled the codec's device-side unpack stage and the match
@@ -705,6 +923,19 @@ class TpuSecretEngine:
                 .sum(axis=-1, dtype=np.uint32)
             )
         else:  # device gram sieve
+            if self._use_fused_derive():
+                # Fused path: hit words never leave the device — the
+                # sieve output feeds candidate derivation in place, and
+                # the only d2h of the whole sieve->candidate chain is
+                # the compacted [F, R] matrix.  Byte-identical to the
+                # host derivation below (same f32 matmul pipeline).
+                t0 = _time.perf_counter()
+                hits_dev = self._sieve_rows_fused(batch.rows)
+                self.stats.sieve_s += _time.perf_counter() - t0
+                t0 = _time.perf_counter()
+                cand = self._derive_candidates_device(batch, hits_dev)
+                self.stats.candidate_s += _time.perf_counter() - t0
+                return cand
             t0 = _time.perf_counter()
             word_hits = self._sieve_rows(batch.rows)  # [T, Gw] packed grams
             self.stats.sieve_s += _time.perf_counter() - t0

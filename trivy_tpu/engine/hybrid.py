@@ -147,29 +147,54 @@ def probe_link(size: int = 8 << 20, attempts: int = 3):  # graftlint: fetch-boun
 # link must clear before the gate routes verify to the device NFA.
 GATE_EFF_MB_S = 1000.0
 GATE_RTT_S = 0.01
+# The fused path's RTT bar is far looser: the whole batch resolves in
+# O(1) dispatches whose verify bytes never re-cross the link (resident
+# rows + one keep-mask bit per lane), so per-dispatch latency amortizes
+# over the batch instead of multiplying per round-trip.  Even a
+# relay-attached chip clears this unless a single dispatch costs a
+# visible fraction of a second.
+FUSED_GATE_RTT_S = 0.25
 
 
-def gate_terms(h2d_ratio: float = 1.0, d2h_ratio: float = 1.0) -> dict:
+def gate_terms(
+    h2d_ratio: float = 1.0, d2h_ratio: float = 1.0,
+    profile: str = "stream",
+) -> dict:
     """Measure the link and price it against the device-verify bar;
     returns every term the decision used (the gate-audit record body).
 
+    `profile` selects the backend cost model being priced: "stream" (the
+    legacy flag-map path — every verify byte re-crosses the link, d2h at
+    the compaction ratio) or "fused" (verify rows stay device-resident,
+    so the verify stage's marginal re-upload is ~zero —
+    link_mod.FUSED_REUPLOAD_RATIO — and the RTT bar loosens to
+    FUSED_GATE_RTT_S because the batch rides O(1) dispatches).
+
     `margin` is the signed distance from the flip point: the worse of
-    (effective rate vs GATE_EFF_MB_S) and (RTT vs GATE_RTT_S), each as a
-    fraction of its threshold.  Positive = the link cleared the bar."""
+    (effective rate vs GATE_EFF_MB_S) and (RTT vs the profile's RTT bar),
+    each as a fraction of its threshold.  Positive = the link cleared the
+    bar."""
     from trivy_tpu.engine import link as link_mod
 
     mb_s, rtt = probe_link()
-    eff = link_mod.effective_link_rate(mb_s, h2d_ratio, d2h_ratio)
-    wide = eff >= GATE_EFF_MB_S and rtt < GATE_RTT_S
-    margin = min(eff / GATE_EFF_MB_S - 1.0, 1.0 - rtt / GATE_RTT_S)
+    reupload = (
+        link_mod.FUSED_REUPLOAD_RATIO if profile == "fused" else 1.0
+    )
+    rtt_bar = FUSED_GATE_RTT_S if profile == "fused" else GATE_RTT_S
+    eff = link_mod.effective_link_rate(
+        mb_s, h2d_ratio, d2h_ratio, reupload_ratio=reupload
+    )
+    wide = eff >= GATE_EFF_MB_S and rtt < rtt_bar
+    margin = min(eff / GATE_EFF_MB_S - 1.0, 1.0 - rtt / rtt_bar)
     return {
+        "profile": profile,
         "link_mb_per_sec": mb_s,
         "link_rtt_s": rtt,
         "h2d_ratio": h2d_ratio,
         "d2h_ratio": d2h_ratio,
         "eff_mb_per_sec": eff,
         "eff_threshold_mb_per_sec": GATE_EFF_MB_S,
-        "rtt_threshold_s": GATE_RTT_S,
+        "rtt_threshold_s": rtt_bar,
         "codec": link_mod.codec_mode(),
         "wide": wide,
         "margin": margin,
@@ -253,7 +278,7 @@ class HybridSecretEngine(TpuSecretEngine):
             compiled=compiled,
         )
         self.chunk_bytes = chunk_bytes
-        if verify not in ("auto", "dfa", "none", "device"):
+        if verify not in ("auto", "dfa", "none", "device", "fused"):
             raise ValueError(f"unknown verify mode: {verify!r}")
         requested = verify
         if verify == "auto":
@@ -281,8 +306,21 @@ class HybridSecretEngine(TpuSecretEngine):
                     requested="auto", backend="dfa", reason="no-device",
                 )
             else:
-                terms = gate_terms(d2h_ratio=d2h_ratio)
-                verify = "device" if terms["wide"] else "dfa"
+                # Price the FUSED cost model first: rows stay resident so
+                # the verify stage re-uploads ~nothing and the RTT bar
+                # loosens — a link too narrow for the legacy stream can
+                # still clear the fused bar (that asymmetry is the point
+                # of this PR).  Fall back to the legacy stream pricing,
+                # then host DFA.
+                fterms = gate_terms(
+                    d2h_ratio=link_mod.FUSED_MASK_D2H_RATIO,
+                    profile="fused",
+                )
+                if fterms["wide"]:
+                    verify, terms = "fused", fterms
+                else:
+                    terms = gate_terms(d2h_ratio=d2h_ratio)
+                    verify = "device" if terms["wide"] else "dfa"
                 self.gate_decision = gatelog.record(
                     requested="auto",
                     backend=verify,
@@ -307,7 +345,7 @@ class HybridSecretEngine(TpuSecretEngine):
         self._nfa_verifier = None
         self._dfa_verifier = None
         bounds = None
-        if verify in ("dfa", "device"):
+        if verify in ("dfa", "device", "fused"):
             from trivy_tpu.engine.redfa import compute_prefix_bounds
 
             # One shared trim-bound array: host and device verifiers must
@@ -315,15 +353,17 @@ class HybridSecretEngine(TpuSecretEngine):
             bounds = compute_prefix_bounds(
                 self.ruleset.rules, self._trimmable_rules()
             )
-        if verify == "device":
+        if verify in ("device", "fused"):
             try:
                 from trivy_tpu.engine.nfa_device import NfaVerifier
 
                 self._nfa_verifier = NfaVerifier(
-                    self.ruleset.rules, mesh=mesh, prefix_bounds=bounds
+                    self.ruleset.rules, mesh=mesh, prefix_bounds=bounds,
+                    fused=(verify == "fused"),
+                    rule_stack=getattr(self._compiled, "vstack", None),
                 )
             except Exception as e:
-                if requested == "device":
+                if requested in ("device", "fused"):
                     raise NotImplementedError(
                         "device NFA verify stage is not available"
                     ) from e
@@ -332,7 +372,7 @@ class HybridSecretEngine(TpuSecretEngine):
                     requested=requested, backend="dfa", reason="fallback",
                     error=f"{type(e).__name__}: {e}",
                 )
-        if verify in ("dfa", "device"):
+        if verify in ("dfa", "device", "fused"):
             # In device mode the DFA still verifies pass-through lanes
             # (rules with no 64-position automaton, oversized windows).
             from trivy_tpu.engine.redfa import DfaVerifier
@@ -684,6 +724,30 @@ class HybridSecretEngine(TpuSecretEngine):
             return self.scan_batch(items)
         finally:
             self._nfa_verifier = nfa
+
+    def scan_batch_device_legacy(
+        self, items: list[tuple[str, bytes]]
+    ) -> list[Secret]:
+        """Degraded re-run one rung ABOVE scan_batch_host: keep the
+        device verifier but flip its fused mode off, so lane verdicts
+        resolve through the legacy flag-map stream instead of the fused
+        on-device path.  The serve scheduler's failure ladder tries this
+        first after a fused-engine failure (fused -> legacy-device ->
+        host-DFA) — a bug in the fused kernels costs one retry, not the
+        whole device.
+
+        Runs on the engine-owner thread only (like scan_batch_host): the
+        fused flag flip is not concurrency-safe against a concurrent
+        scan_batch on the SAME engine, which the scheduler's single
+        dispatch thread already precludes."""
+        nfa = self._nfa_verifier
+        if nfa is None or not getattr(nfa, "fused", False):
+            return self.scan_batch(items)  # no fused mode to step down
+        nfa.fused = False
+        try:
+            return self.scan_batch(items)
+        finally:
+            nfa.fused = True
 
     def _finish_chunk(
         self,

@@ -64,6 +64,20 @@ COMPACT_MAX_FRAC = 0.25
 D2H_SHARE = 0.5
 STREAM_D2H_RATIO = 0.15
 
+# Fused-path link terms (engine/nfa_device.py fused verify).  The fused
+# kernel resolves lane verdicts on-device and ships back ONE packed
+# keep-mask bit per lane instead of the legacy per-(row, block) flag map
+# — measured under 1% of the raw flag bytes on r05 shapes.
+FUSED_MASK_D2H_RATIO = 0.01
+# H2D re-upload fraction of the fused verify walk: span rows staged for
+# the sieve stay device-resident for the batch lifetime (ResidentRowStore,
+# engine/pipeline.py), and a rescan whose chunks digest identically reuses
+# them outright, so the verify stage's own marginal h2d is the lane table
+# (a few int32 per lane) — ~0 against the span bytes the legacy model
+# prices.  The cold-batch sieve upload is charged to the sieve stage, not
+# verify; gate_terms(profile="fused") therefore models zero re-upload.
+FUSED_REUPLOAD_RATIO = 0.0
+
 # 4-bit codec: 15 non-other classes (ids 1..15); 6-bit: 63 (ids 1..63).
 _CLASS_CAP = {4: 15, 6: 63}
 # auto only takes the merged (lossy-at-the-sieve) 4-bit codec when every
@@ -399,6 +413,19 @@ def _stream_lane_jit():
     return to_lanes
 
 
+def fetch_mask_packed(out, raw_bytes: int) -> tuple[np.ndarray, int, int]:  # graftlint: fetch-boundary
+    """Fetch the fused verify kernel's packed keep-mask — a uint8
+    bit-pack of per-lane verdicts, the fused path's ONLY d2h.  Returns
+    (bool lane mask, raw_bytes, fetched_bytes): `raw_bytes` is what the
+    legacy flag-map fetch for the same dispatch would have moved (the
+    caller computes it from the flag tensor shape), so the stream-stats
+    fetch accounting stays comparable across backends.  No bitmap
+    round-trip here: the mask is already 1 bit/lane, smaller than any
+    compaction header."""
+    packed = np.asarray(out)
+    return np.unpackbits(packed).astype(bool), int(raw_bytes), packed.nbytes
+
+
 def fetch_stream_packed(out) -> tuple[np.ndarray, int, int]:  # graftlint: fetch-boundary
     """Compacted fetch of the verify stream's packed flag tensor
     ([ceil(R/8), Lo, G, Bg] uint8): device-side transpose to lane-major
@@ -418,7 +445,10 @@ def fetch_stream_packed(out) -> tuple[np.ndarray, int, int]:  # graftlint: fetch
 
 
 def effective_link_rate(
-    mb_s: float, h2d_ratio: float = 1.0, d2h_ratio: float = 1.0
+    mb_s: float,
+    h2d_ratio: float = 1.0,
+    d2h_ratio: float = 1.0,
+    reupload_ratio: float = 1.0,
 ) -> float:
     """Post-codec effective link rate: the rate at which RAW payload
     bytes are serviced when h2d bytes shrink by `h2d_ratio` and d2h bytes
@@ -427,10 +457,15 @@ def effective_link_rate(
     physical link, so
 
         effective = mb_s * (1 + D2H_SHARE)
-                         / (h2d_ratio + D2H_SHARE * d2h_ratio)
+                / (reupload_ratio * h2d_ratio + D2H_SHARE * d2h_ratio)
 
-    With both ratios 1.0 this is `mb_s` exactly; compaction alone
+    With all ratios 1.0 this is `mb_s` exactly; compaction alone
     (d2h_ratio ~ 0.15) lifts a 750 MB/s link over the 1 GB/s device-
-    verify bar — codec availability can flip backend selection."""
-    denom = h2d_ratio + D2H_SHARE * d2h_ratio
+    verify bar — codec availability can flip backend selection.
+    `reupload_ratio` scales the h2d term for paths that reuse bytes
+    already device-resident (the fused verify walk gathers from the
+    sieve's staged rows, so its marginal h2d is ~FUSED_REUPLOAD_RATIO of
+    the legacy re-ship); the denominator floor keeps a fully-resident,
+    fully-compacted path finite rather than infinite."""
+    denom = reupload_ratio * h2d_ratio + D2H_SHARE * d2h_ratio
     return mb_s * (1.0 + D2H_SHARE) / max(denom, 1e-9)

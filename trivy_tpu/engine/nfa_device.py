@@ -47,6 +47,7 @@ forced-device measurement and the link probe that justifies the gate.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -87,11 +88,40 @@ RULE_STACK_BUCKETS = (4, 8, 16, 32)  # jit-stable per-dispatch rule counts
 STREAM_GROUP_BUCKETS = (1, 2, 4) + GROUP_BUCKETS
 PAD_CLASS = 63
 
+# Fused path (verify="fused", engine/hybrid.py): the SAME packed rows,
+# but lane verdicts resolve ON-DEVICE — the dispatch carries the lane
+# table (row, rule slot, first/last block) alongside the bytes, and the
+# only d2h is one packed keep-mask bit per lane (link.fetch_mask_packed)
+# instead of the [ceil(R/8), Lo, G, Bg] flag map.  The block walk can
+# additionally run as an associative scan over per-block affine
+# summaries (SURVEY §7.4's fused shape) — O(log Lo) depth at 64x the
+# per-block state, so "auto" only picks it when the summary tensors fit
+# the budget below.
+FUSED_ASSOC_BUDGET_BYTES = 64 << 20
+
+
+def fused_scan_mode() -> str:
+    """Fused-kernel block-walk strategy: "seq" carries NFA state across
+    32-byte blocks with the sequential lax.scan the legacy stream uses;
+    "assoc" folds each block into one affine summary ([64, 64] transfer
+    matrix + offset vector) and combines summaries with
+    jax.lax.associative_scan.  "auto" (default) picks assoc only when the
+    dispatch's summary tensors fit FUSED_ASSOC_BUDGET_BYTES.
+    TRIVY_TPU_FUSED_SCAN overrides."""
+    mode = os.environ.get("TRIVY_TPU_FUSED_SCAN", "auto").strip().lower()
+    return mode if mode in ("auto", "assoc", "seq") else "auto"
+
 
 class NfaVerifier:
-    def __init__(self, rules, mesh=None, trimmable=None, prefix_bounds=None):
+    def __init__(self, rules, mesh=None, trimmable=None, prefix_bounds=None,
+                 fused=False, rule_stack=None):
         self.mesh = mesh
         self.num_rules = len(rules)
+        # Fused mode: resolve lane verdicts on-device and fetch only the
+        # packed keep-mask.  Mutable — the serve scheduler's degraded
+        # ladder flips it off for a legacy-stream retry (see
+        # HybridSecretEngine.scan_batch_device_legacy).
+        self.fused = bool(fused)
         # Walk-window trim bound, shared with the host DfaVerifier (the
         # dfa_verify_pairs clip [first - bound, last + bound + 8]) —
         # refutation soundness requires both verifiers to clip identically,
@@ -143,6 +173,28 @@ class NfaVerifier:
                     self.last[i, q] = 1.0
             self.luts[i] = nfa.byte_class
         self._tensors_on_device = None
+        if rule_stack is not None:
+            self._seed_rule_stack(rule_stack)
+
+    def _seed_rule_stack(self, stack) -> None:
+        """Pre-seed the per-rule byte-tensor cache from a registry
+        artifact's stacked uint8 rule tensors (registry/store.py schema 3
+        `vstack_*` arrays, built by `build_rule_stack`), so warm starts
+        skip the per-rule Python tensor build.  A stack whose rule count
+        mismatches is ignored — the lazy per-rule path stays correct."""
+        try:
+            has = np.asarray(stack["vstack_has"]).astype(bool)
+            fol = np.asarray(stack["vstack_follow"], dtype=np.float32)
+            acc = np.asarray(stack["vstack_accept_b"], dtype=np.float32)
+            fst = np.asarray(stack["vstack_first"], dtype=np.float32)
+            lst = np.asarray(stack["vstack_last"], dtype=np.float32)
+        except (KeyError, TypeError):
+            return
+        if len(has) != self.num_rules:
+            return
+        for r in range(self.num_rules):
+            if has[r] and self._nfas[r] is not None:
+                self._byte_tensor_cache[r] = (fol[r], acc[r], fst[r], lst[r])
 
     # ------------------------------------------------------------------
 
@@ -235,6 +287,26 @@ class NfaVerifier:
                 self._run_stream_multi(
                     bd, zt(rb, 64, 64), zt(rb, 256, 64), zt(rb, 64),
                     zt(rb, 64),
+                ).block_until_ready()
+            if self.fused and self.mesh is None:
+                # the fused verdict shape big batches actually hit: large
+                # row tier, max group chunk, minimal lane table (lane
+                # counts pad to powers of two, so other widths are cheap
+                # incremental compiles)
+                bd = self._put_stream(
+                    np.zeros(
+                        (
+                            STREAM_TIERS[1] // STREAM_BLOCK, STREAM_BLOCK,
+                            GROUP_BUCKETS[-1], LANES_PER_GROUP,
+                        ),
+                        dtype=np.uint8,
+                    )
+                )
+                lane = jnp.zeros(8, jnp.int32)
+                self._run_fused(
+                    bd, zt(rb, 64, 64), zt(rb, 256, 64), zt(rb, 64),
+                    zt(rb, 64), lane, lane, lane, lane,
+                    onehot=True, assoc=False,
                 ).block_until_ready()
 
     @staticmethod
@@ -347,7 +419,12 @@ class NfaVerifier:
             return ys  # [Lo, G, Bg] uint8
 
         flags = jax.lax.map(per_rule, (follow, accept_b, first, last))
-        # pack 8 rule slots per byte: d2h shrinks R/ceil(R/8)-fold
+        return NfaVerifier._pack_rule_flags(flags)
+
+    @staticmethod
+    def _pack_rule_flags(flags):
+        """[R, Lo, G, Bg] uint8 -> [ceil(R/8), Lo, G, Bg] uint8, 8 rule
+        slots per byte: d2h shrinks R/ceil(R/8)-fold."""
         r = flags.shape[0]
         rp = -(-r // 8)
         pad = jnp.zeros((rp * 8 - r,) + flags.shape[1:], flags.dtype)
@@ -359,6 +436,163 @@ class NfaVerifier:
             "pk...,k->p...", grouped, w8,
             preferred_element_type=jnp.int32,
         ).astype(jnp.uint8)  # [ceil(R/8), Lo, G, Bg]
+
+    @staticmethod
+    def _stream_assoc_impl(bytes_t, follow, accept_b, first, last, onehot):
+        """Associative-scan variant of `_stream_multi_impl`: per 32-byte
+        block, fold the byte steps into one affine summary — transfer
+        matrix M [64, 64], offset v [64] (state contribution born inside
+        the block), plus hit detectors a [64] / b [] — then combine
+        summaries across the row's blocks with
+        ``jax.lax.associative_scan`` instead of a sequential carry.
+
+        Soundness: the per-byte step S' = min(min(S@F + first, 1) * cmask,
+        1) is affine in S over min-clamped {0,1} tensors (clamping is pure
+        normalization — positivity is what carries meaning), so byte maps
+        compose as (M, v) pairs and a block's hit test reduces to
+        (S_enter . a) + b > 0.  Byte-exact vs the sequential path in bf16:
+        every matmul partial sum is an integer bounded by 65 < 256.
+        Memory: one rule's summaries are [Lo, G, Bg, 64, 64] — 64x the
+        sequential block state — so dispatch sites budget-gate this path
+        (FUSED_ASSOC_BUDGET_BYTES)."""
+        dt = follow.dtype
+        one = dt.type(1)
+        _, _, g, bg = bytes_t.shape
+
+        def per_rule(tens):
+            f, a, fs, ls = tens  # [64,64] [256,64] [64] [64]
+            fsb = fs[None, None, :]
+
+            def block_summary(blk):  # [32, G, Bg]
+                m0 = jnp.broadcast_to(
+                    jnp.eye(64, dtype=dt), (g, bg, 64, 64)
+                )
+                v0 = jnp.zeros((g, bg, 64), dt)
+                a0 = jnp.zeros((g, bg, 64), dt)
+                b0 = jnp.zeros((g, bg), dt)
+
+                def inner(i, carry):
+                    m, v, av, bv = carry
+                    if onehot:
+                        oh = jax.nn.one_hot(blk[i], 256, dtype=dt)
+                        cmask = jnp.einsum(
+                            "gbc,cs->gbs", oh, a,
+                            preferred_element_type=dt,
+                        )
+                    else:
+                        cmask = a[blk[i]]  # [G, Bg, 64] gather
+                    m2 = jnp.minimum(
+                        jnp.einsum(
+                            "gbpr,rq->gbpq", m, f,
+                            preferred_element_type=dt,
+                        ) * cmask[:, :, None, :],
+                        one,
+                    )
+                    v2 = jnp.minimum(
+                        (jnp.einsum(
+                            "gbp,pq->gbq", v, f,
+                            preferred_element_type=dt,
+                        ) + fsb) * cmask,
+                        one,
+                    )
+                    av2 = jnp.minimum(
+                        av + jnp.einsum(
+                            "gbpq,q->gbp", m2, ls,
+                            preferred_element_type=dt,
+                        ),
+                        one,
+                    )
+                    bv2 = jnp.minimum(
+                        bv + jnp.einsum(
+                            "gbq,q->gb", v2, ls,
+                            preferred_element_type=dt,
+                        ),
+                        one,
+                    )
+                    return m2, v2, av2, bv2
+
+                return jax.lax.fori_loop(
+                    0, blk.shape[0], inner, (m0, v0, a0, b0)
+                )
+
+            summ_m, summ_v, det_a, det_b = jax.vmap(block_summary)(bytes_t)
+
+            def compose(x, y):
+                m1, v1 = x
+                m2, v2 = y
+                return (
+                    jnp.minimum(
+                        jnp.einsum(
+                            "...pr,...rq->...pq", m1, m2,
+                            preferred_element_type=dt,
+                        ),
+                        one,
+                    ),
+                    jnp.minimum(
+                        jnp.einsum(
+                            "...p,...pq->...q", v1, m2,
+                            preferred_element_type=dt,
+                        ) + v2,
+                        one,
+                    ),
+                )
+
+            _m_incl, v_incl = jax.lax.associative_scan(
+                compose, (summ_m, summ_v), axis=0
+            )
+            # entering state of block j = composed offset of blocks < j
+            # applied to the zero init state (exclusive shift)
+            enter = jnp.concatenate(
+                [jnp.zeros_like(v_incl[:1]), v_incl[:-1]], axis=0
+            )
+            hit = (
+                jnp.einsum(
+                    "lgbp,lgbp->lgb", enter, det_a,
+                    preferred_element_type=dt,
+                ) + det_b
+            ) > 0
+            return hit.astype(jnp.uint8)  # [Lo, G, Bg]
+
+        flags = jax.lax.map(per_rule, (follow, accept_b, first, last))
+        return NfaVerifier._pack_rule_flags(flags)
+
+    @staticmethod
+    @functools.partial(jax.jit, static_argnames=("onehot", "assoc"))
+    def _run_fused(bytes_t, follow, accept_b, first, last,
+                   lane_row, lane_slot, lane_b0, lane_b1,
+                   onehot, assoc):
+        """The fused verify dispatch: bytes_t [Lo, 32, G, Bg] raw bytes x
+        stacked rule tensors x a lane table (lane_* [N] int32: packed row,
+        rule slot, first/exclusive-last 32-block of the lane's window) ->
+        packed keep-mask uint8 [N/8].
+
+        Block flags compute exactly as the legacy stream kernel (or its
+        associative-scan variant), but the per-lane verdict — "any hit
+        block in [b0, b1)" — resolves HERE, on device, via a cumulative
+        block-sum gather, so the only d2h is one bit per lane.  Padded
+        lane-table entries (row = slot = b0 = b1 = 0) resolve False by
+        construction (empty block range)."""
+        if assoc:
+            flags = NfaVerifier._stream_assoc_impl(
+                bytes_t, follow, accept_b, first, last, onehot
+            )
+        else:
+            flags = NfaVerifier._stream_multi_impl(
+                bytes_t, follow, accept_b, first, last, onehot
+            )
+        rp, lo, g, bg = flags.shape
+        # [P, Lo, G, Bg] -> [P, rows, Lo]; per lane: its slot's bit plane
+        # of its row, block-cumsum, then the [b0, b1) interval test
+        h = flags.transpose(0, 2, 3, 1).reshape(rp, g * bg, lo)
+        bits = (
+            h[lane_slot // 8, lane_row].astype(jnp.int32)
+            >> (lane_slot % 8)[:, None]
+        ) & 1  # [N, Lo]
+        cs = jnp.cumsum(bits, axis=1)
+        csz = jnp.pad(cs, ((0, 0), (1, 0)))  # [N, Lo+1], csz[:, 0] = 0
+        ar = jnp.arange(lane_row.shape[0])
+        keep = csz[ar, lane_b1] > csz[ar, lane_b0]
+        return jnp.packbits(keep)
 
     # ------------------------------------------------------------------
 
@@ -487,6 +721,11 @@ class NfaVerifier:
 
         depth = default_depth()
         tiers = STREAM_TIERS
+        # Fused mode resolves lane verdicts on-device (one keep-mask bit
+        # per lane crosses the link); meshed runs keep the legacy flag-map
+        # path — the verdict gather would cross the sharded G axis.
+        fused = bool(self.fused) and self.mesh is None
+        scan_mode = fused_scan_mode() if fused else "seq"
         st = self.stream_stats = {
             "lanes": int(len(s_idx)), "span_bytes": 0,
             "rows": [0] * len(tiers),
@@ -494,6 +733,7 @@ class NfaVerifier:
             "assemble_s": 0.0, "dispatch_s": 0.0, "fetch_map_s": 0.0,
             "pipeline_depth": depth, "h2d_overlap_s": 0.0,
             "fetch_bytes_raw": 0, "fetch_bytes": 0,
+            "backend": "fused" if fused else "stream",
         }
         # D2H compaction (engine/link.py): the packed flag tensor is
         # almost entirely zero lanes (r05: 400 real pairs in 60k lanes,
@@ -503,6 +743,11 @@ class NfaVerifier:
 
         compact_fetch = link_mod.d2h_compaction_enabled()
         t0 = _time.perf_counter()
+        # assemble_s is timed DIRECTLY: the assembly clock pauses while a
+        # flush (dispatch + bounded fetch) runs and resumes after — the
+        # old end-minus-dispatch subtraction went negative whenever a
+        # dispatch overlapped assembly under pipeline_depth >= 2.
+        asm_mark = t0
         overflow: list[int] = []  # lanes for the padded path
 
         # distinct rules on the stream, most-claimed first; rules beyond
@@ -568,26 +813,46 @@ class NfaVerifier:
             )
 
         def _fetch_one():  # graftlint: fetch-boundary
-            tier_, lo_, hi_, out = in_flight.popleft()
+            ent = in_flight.popleft()
             tf = _time.perf_counter()
-            with obs_trace.span("verify.fetch", rows=hi_ - lo_):
-                faults.fire("nfa.fetch")
-                if compact_fetch:
-                    packed, raw_b, got_b = link_mod.fetch_stream_packed(out)
-                else:
-                    packed = np.asarray(out)
-                    raw_b = got_b = packed.nbytes
-            st["fetch_bytes_raw"] += raw_b
-            st["fetch_bytes"] += got_b
+            if ent[0] == "fused":
+                # fused dispatch: the d2h is the packed keep-mask; lane
+                # verdicts apply immediately (no host remap pass)
+                _, lane_ids, out, raw_b = ent
+                with obs_trace.span("verify.fetch", lanes=len(lane_ids)):
+                    faults.fire("nfa.fetch")
+                    mask, raw_b, got_b = link_mod.fetch_mask_packed(
+                        out, raw_b
+                    )
+                keep[lane_ids[mask[: len(lane_ids)]]] = True
+                st["fetch_bytes_raw"] += raw_b
+                st["fetch_bytes"] += got_b
+            else:
+                _, tier_, lo_, hi_, out = ent
+                with obs_trace.span("verify.fetch", rows=hi_ - lo_):
+                    faults.fire("nfa.fetch")
+                    if compact_fetch:
+                        packed, raw_b, got_b = link_mod.fetch_stream_packed(
+                            out
+                        )
+                    else:
+                        packed = np.asarray(out)
+                        raw_b = got_b = packed.nbytes
+                st["fetch_bytes_raw"] += raw_b
+                st["fetch_bytes"] += got_b
+                fetched.append((tier_, lo_, hi_, packed))
             dtf = _time.perf_counter() - tf
             st["fetch_map_s"] += dtf
             if in_flight:  # later dispatches were in flight while we waited
                 st["h2d_overlap_s"] += dtf
-            fetched.append((tier_, lo_, hi_, packed))
 
         def _flush_range(tier, row_lo, row_hi):
             """Dispatch rows [row_lo, row_hi) of `tier` in group-bucket
-            chunks, fetching oldest results once `depth` are in flight."""
+            chunks, fetching oldest results once `depth` are in flight.
+            Fused mode attaches each chunk's lane table to the dispatch so
+            verdicts resolve on-device."""
+            nonlocal asm_mark
+            st["assemble_s"] += _time.perf_counter() - asm_mark
             td = _time.perf_counter()
             with obs_trace.span(
                 "verify.dispatch", tier=tier, rows=row_hi - row_lo
@@ -595,6 +860,7 @@ class NfaVerifier:
                 if tens is None:
                     _build_tensors()
                 length = tiers[tier]
+                lo_blocks = length // STREAM_BLOCK
                 gi = row_lo
                 while gi < row_hi:
                     remaining = -(-(row_hi - gi) // LANES_PER_GROUP)
@@ -616,19 +882,69 @@ class NfaVerifier:
                             STREAM_BLOCK,
                         ).transpose(2, 3, 0, 1)
                     )
-                    faults.fire("nfa.dispatch")
-                    bd = self._put_stream(bytes_t)
-                    # traced runs fence each dispatch (per-kernel
-                    # verify-stream attribution); untraced dispatch stays
-                    # async and overlaps with the bounded fetch queue
-                    ph = obs_metrics.device_phase("verify-stream")
-                    out = run(bd, *tens)
-                    ph.done(out)
-                    in_flight.append((tier, lo, hi, out))
+                    if fused:
+                        # this chunk's lanes: rows append in order, so a
+                        # monotone cursor per tier suffices
+                        fb = fl_buf[tier]
+                        p = fl_ptr[tier]
+                        q = p
+                        while q < len(fb[1]) and fb[1][q] < hi:
+                            q += 1
+                        fl_ptr[tier] = q
+                        if q == p:
+                            continue  # rows carried no lanes
+                        n_l = q - p
+                        npad = max(8, 1 << (n_l - 1).bit_length())
+                        lrow = np.zeros(npad, np.int32)
+                        lslot = np.zeros(npad, np.int32)
+                        lb0 = np.zeros(npad, np.int32)
+                        lb1 = np.zeros(npad, np.int32)
+                        lrow[:n_l] = np.asarray(fb[1][p:q], np.int32) - lo
+                        lslot[:n_l] = fb[2][p:q]
+                        lb0[:n_l] = fb[3][p:q]
+                        lb1[:n_l] = fb[4][p:q]
+                        lane_ids = np.asarray(fb[0][p:q], np.int64)
+                        itemsize = jnp.dtype(jdt).itemsize
+                        est = (
+                            lo_blocks * gcap * LANES_PER_GROUP
+                            * 64 * 64 * itemsize
+                        )
+                        assoc = scan_mode == "assoc" or (
+                            scan_mode == "auto"
+                            and est <= FUSED_ASSOC_BUDGET_BYTES
+                        )
+                        faults.fire("nfa.dispatch")
+                        bd = self._put_stream(bytes_t)
+                        ph = obs_metrics.device_phase("verify.fused")
+                        out = self._run_fused(
+                            bd, *tens,
+                            jnp.asarray(lrow), jnp.asarray(lslot),
+                            jnp.asarray(lb0), jnp.asarray(lb1),
+                            onehot=(jdt == jnp.bfloat16), assoc=assoc,
+                        )
+                        ph.done(out)
+                        # what the legacy flag-map fetch would have moved
+                        raw_b = (
+                            -(-tens[0].shape[0] // 8)
+                            * lo_blocks * gcap * LANES_PER_GROUP
+                        )
+                        in_flight.append(("fused", lane_ids, out, raw_b))
+                    else:
+                        faults.fire("nfa.dispatch")
+                        bd = self._put_stream(bytes_t)
+                        # traced runs fence each dispatch (per-kernel
+                        # verify-stream attribution); untraced dispatch
+                        # stays async and overlaps with the bounded
+                        # fetch queue
+                        ph = obs_metrics.device_phase("verify-stream")
+                        out = run(bd, *tens)
+                        ph.done(out)
+                        in_flight.append(("stream", tier, lo, hi, out))
                     st["dispatches"] += 1
                     while len(in_flight) > depth:
                         _fetch_one()
             st["dispatch_s"] += _time.perf_counter() - td
+            asm_mark = _time.perf_counter()
 
         # flat per-lane placement (vectorized verdict resolution):
         # lane id, tier, row, rule slot, first/last 32-block of its window
@@ -638,6 +954,13 @@ class NfaVerifier:
         lv_slot: list[int] = []
         lv_b0: list[int] = []
         lv_b1: list[int] = []
+        # fused mode keeps per-TIER lane tables instead (lane, row, slot,
+        # b0, b1) — consumed chunk-by-chunk via fl_ptr in _flush_range,
+        # shipped with the dispatch, never resolved on host
+        fl_buf: list[tuple[list, list, list, list, list]] = [
+            ([], [], [], [], []) for _ in tiers
+        ]
+        fl_ptr = [0] * len(tiers)
         open_row = [(-1, ln + 1) for ln in tiers]
         pos = 0
         while pos < len(order):
@@ -681,12 +1004,20 @@ class NfaVerifier:
             for li in lanes_f:
                 rs0 = cpos + int(start[li]) - s
                 rs1 = cpos + int(stop[li]) - s
-                lv_lane.append(li)
-                lv_tier.append(tier)
-                lv_row.append(cur)
-                lv_slot.append(rule_slot[int(pairs[li, 1])])
-                lv_b0.append(rs0 // STREAM_BLOCK)
-                lv_b1.append(-(-rs1 // STREAM_BLOCK))
+                if fused:
+                    fb = fl_buf[tier]
+                    fb[0].append(li)
+                    fb[1].append(cur)
+                    fb[2].append(rule_slot[int(pairs[li, 1])])
+                    fb[3].append(rs0 // STREAM_BLOCK)
+                    fb[4].append(-(-rs1 // STREAM_BLOCK))
+                else:
+                    lv_lane.append(li)
+                    lv_tier.append(tier)
+                    lv_row.append(cur)
+                    lv_slot.append(rule_slot[int(pairs[li, 1])])
+                    lv_b0.append(rs0 // STREAM_BLOCK)
+                    lv_b1.append(-(-rs1 // STREAM_BLOCK))
             # one 0x00 separator byte between spans
             open_row[tier] = (cur, cpos + len(span) + 1)
             st["span_bytes"] += len(span)
@@ -698,8 +1029,9 @@ class NfaVerifier:
                 flushed[tier] += flush_rows
         st["rows"] = [len(b) for b in rows_buf]
         st["overflow_lanes"] = len(overflow)
-        # in-assembly flush time is dispatch time, not assembly time
-        st["assemble_s"] = (_time.perf_counter() - t0) - st["dispatch_s"]
+        # close the final assembly segment (flushes paused the clock)
+        st["assemble_s"] += _time.perf_counter() - asm_mark
+        asm_mark = _time.perf_counter()
 
         if not any(rows_buf) and not overflow:
             return
@@ -838,3 +1170,32 @@ class NfaVerifier:
             matched = np.asarray(out)
             for g, lane_arr in enumerate(chunk):
                 keep[lane_arr] = matched[g, : len(lane_arr)]
+
+
+def build_rule_stack(verifier: NfaVerifier) -> dict[str, np.ndarray]:
+    """Stacked uint8 per-rule byte tensors for the registry artifact
+    (registry/store.py schema 3 `vstack_*` arrays): every stream-eligible
+    rule's raw-byte automaton in one dense stack, so warm starts seed
+    `NfaVerifier(rule_stack=...)` and skip the per-rule Python tensor
+    build, and `aot_warmup` can pre-lower the fused verify shapes against
+    real tensor shapes.  All values are {0, 1}; `vstack_accept_b[:, 0, :]`
+    is all-zero (byte 0x00 is the stream's dead separator) — the unpack
+    side validates both."""
+    r = verifier.num_rules
+    out = {
+        "vstack_has": np.zeros(r, np.uint8),
+        "vstack_follow": np.zeros((r, 64, 64), np.uint8),
+        "vstack_accept_b": np.zeros((r, 256, 64), np.uint8),
+        "vstack_first": np.zeros((r, 64), np.uint8),
+        "vstack_last": np.zeros((r, 64), np.uint8),
+    }
+    for i in range(r):
+        if verifier._nfas[i] is None:
+            continue
+        fol, acc, fst, lst = verifier._rule_byte_tensors(i)
+        out["vstack_has"][i] = 1
+        out["vstack_follow"][i] = fol.astype(np.uint8)
+        out["vstack_accept_b"][i] = acc.astype(np.uint8)
+        out["vstack_first"][i] = fst.astype(np.uint8)
+        out["vstack_last"][i] = lst.astype(np.uint8)
+    return out

@@ -26,6 +26,14 @@ exception propagates.
 sieve results keyed by packed-chunk content digest (interface mirrors
 `trivy_tpu/cache/store.py::ArtifactCache.missing_blobs`), so a rescan of a
 mostly-unchanged corpus ships only changed rows across the link.
+
+`ResidentRowStore` is the fused-pipeline extension of the same idea: it
+keeps the STAGED row buffers themselves (plus their sieve hit words)
+device-resident under the same digest-keyed LRU discipline, so the fused
+sieve→verify path (engine/device.py `_sieve_rows_fused`, the lane-derive
+kernel, engine/nfa_device.py's fused verify) reads from residency instead
+of paying a host round-trip — the zero-re-upload assumption the hybrid
+gate prices (engine/link.py FUSED_REUPLOAD_RATIO).
 """
 
 from __future__ import annotations
@@ -219,3 +227,36 @@ class ResidentChunkCache:
         for mw in self._mw.values():
             mw.release()
         self._mw.clear()
+
+    def nbytes(self) -> int:
+        """Total resident bytes across live entries (ledger cross-check)."""
+        return sum(memwatch.nbytes_of(v) for v in self._lru.values())
+
+
+class ResidentRowStore(ResidentChunkCache):
+    """Digest-keyed LRU of STAGED row buffers + their sieve hit words,
+    both kept as device arrays for the fused sieve→verify pipeline.
+
+    Where ResidentChunkCache memoises only the sieve OUTPUT (hit words,
+    so a duplicate chunk skips the dispatch), this store also retains the
+    sieve INPUT rows on device so the fused verify walk can gather its
+    windows in place — the re-upload the legacy path pays per verify
+    dispatch never happens.  Entries are `(rows_dev, hits_dev)` tuples;
+    eviction follows the same LRU + memwatch discipline as the parent
+    (component "resident-rows", capacity TRIVY_TPU_RESIDENT_CHUNKS).
+
+    Entry invariant: `rows_dev` is the uint8 staged row block exactly as
+    shipped (coded or raw per the chunk's codec tag — callers key the
+    digest with the tag, mirroring `_sieve_rows`'s resident-LRU key), and
+    `hits_dev` the matching [rows, n_words] uint32 hit bitmap.
+    """
+
+    def __init__(self, capacity: int | None = None):
+        super().__init__(capacity, component="resident-rows")
+
+    def put_rows(self, digest: str, rows_dev, hits_dev) -> None:
+        self.put(digest, (rows_dev, hits_dev))
+
+    def rows(self, digest: str):
+        """Resident (rows_dev, hits_dev) or None; refreshes LRU order."""
+        return self.get(digest)

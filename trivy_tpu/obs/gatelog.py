@@ -23,6 +23,15 @@ Reasons are a bounded enum (metric-label safe): `link-wide`,
 `link-narrow`, `no-device`, `forced`, `fallback`, `breaker` (a runtime
 circuit-breaker transition re-routing batches — see engine/breaker.py
 and the serve scheduler's failure domains).
+
+Backends are likewise bounded: `dfa`, `device` (legacy flag-map
+stream), `fused` (device-resident verify — lane verdicts resolve
+on-device and only a packed keep-mask crosses the link; see
+engine/nfa_device.py), `none`, `auto`.  A `fused` record whose terms
+carry `profile: "fused"` was priced against the fused cost model
+(zero re-upload, FUSED_GATE_RTT_S bar — engine/hybrid.py gate_terms);
+the serve scheduler's degraded ladder steps fused -> legacy-device ->
+host-DFA, each rung visible here and in `/debug/gate`.
 """
 
 from __future__ import annotations

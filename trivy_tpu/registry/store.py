@@ -33,7 +33,7 @@ from trivy_tpu.rules.model import RuleSet
 
 logger = logging.getLogger("trivy_tpu.registry")
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 ARTIFACT_NPZ = "artifact.npz"
 MANIFEST_JSON = "manifest.json"
 # The ruleset SOURCE (secret-config YAML; empty file = builtin rules only).
@@ -87,6 +87,11 @@ class CompiledArtifact:
     gset: object  # engine.grams.GramSet
     manifest: dict
     alphabet: object = None  # engine.link.LinkAlphabet (schema >= 2)
+    # Stacked per-rule verify tensors (engine.nfa_device.build_rule_stack,
+    # schema >= 3): warm starts seed NfaVerifier(rule_stack=...) from these
+    # instead of re-deriving 64-position byte tensors rule by rule in
+    # Python, and aot_warmup pre-lowers the fused verify against them.
+    vstack: dict | None = None
 
 
 def compile_ruleset(ruleset: RuleSet, digest: str | None = None) -> CompiledArtifact:
@@ -96,11 +101,16 @@ def compile_ruleset(ruleset: RuleSet, digest: str | None = None) -> CompiledArti
     from trivy_tpu.engine.nfa import compile_rules
     from trivy_tpu.engine.probes import build_probe_set
 
+    from trivy_tpu.engine.nfa_device import NfaVerifier, build_rule_stack
+
     if digest is None:
         digest = ruleset_digest(ruleset)
     nfa = compile_rules(ruleset.rules)
     pset = build_probe_set(ruleset.rules)
     gset = build_gram_set(pset)
+    # Rule-stack tensors are part of the cold compile (schema 3): the warm
+    # path must never pay the per-rule Python byte-tensor build again.
+    vstack = build_rule_stack(NfaVerifier(ruleset.rules))
     return CompiledArtifact(
         digest=digest,
         nfa=nfa,
@@ -108,6 +118,7 @@ def compile_ruleset(ruleset: RuleSet, digest: str | None = None) -> CompiledArti
         gset=gset,
         manifest={},
         alphabet=derive_alphabet(gset),
+        vstack=vstack,
     )
 
 
@@ -133,6 +144,18 @@ def _pack_arrays(art: CompiledArtifact) -> dict[str, np.ndarray]:
     alpha = art.alphabet
     if alpha is None:
         alpha = derive_alphabet(gset)
+    vstack = art.vstack
+    if vstack is None:
+        # All-zero `has` column: the loaded verifier simply keeps its lazy
+        # per-rule tensor build, so a stack-less save stays correct.
+        nr = len(nfa.rule_ids)
+        vstack = {
+            "vstack_has": np.zeros(nr, np.uint8),
+            "vstack_follow": np.zeros((nr, 64, 64), np.uint8),
+            "vstack_accept_b": np.zeros((nr, 256, 64), np.uint8),
+            "vstack_first": np.zeros((nr, 64), np.uint8),
+            "vstack_last": np.zeros((nr, 64), np.uint8),
+        }
     probe_lens = np.array(
         [len(p.classes) for p in pset.probes], dtype=np.int32
     )
@@ -179,6 +202,13 @@ def _pack_arrays(art: CompiledArtifact) -> dict[str, np.ndarray]:
         "gset_probe_has_gram": gset.probe_has_gram,
         "link_values": np.asarray(alpha.values, dtype=np.uint8),
         "link_class_map": np.asarray(alpha.class_map, dtype=np.uint8),
+        "vstack_has": np.asarray(vstack["vstack_has"], dtype=np.uint8),
+        "vstack_follow": np.asarray(vstack["vstack_follow"], dtype=np.uint8),
+        "vstack_accept_b": np.asarray(
+            vstack["vstack_accept_b"], dtype=np.uint8
+        ),
+        "vstack_first": np.asarray(vstack["vstack_first"], dtype=np.uint8),
+        "vstack_last": np.asarray(vstack["vstack_last"], dtype=np.uint8),
     }
 
 
@@ -208,6 +238,9 @@ def _build_manifest(art: CompiledArtifact, arrays: dict) -> dict:
             "num_probes": int(gset.num_probes),
         },
         "link": {"alphabet_size": int(len(arrays["link_values"]))},
+        # Stream-eligible rule count in the stacked verify tensors (schema
+        # 3): how many rules the fused/stream verifier can walk on-device.
+        "vstack": {"stream_rules": int(arrays["vstack_has"].sum())},
         "shapes": {k: list(v.shape) for k, v in arrays.items()},
         "dtypes": {k: str(v.dtype) for k, v in arrays.items()},
         # Row-batch shape buckets the step kernels specialize on; the AOT
@@ -299,6 +332,35 @@ def _unpack_artifact(manifest: dict, z) -> CompiledArtifact:
             "stored link class map does not match the gram tensors "
             "(corrupt or tampered)"
         )
+    # Stacked verify tensors (schema 3).  Same trust posture as the class
+    # map: shapes/dtypes were pinned above, but the VALUES feed the device
+    # verifier's matmuls directly, so enforce the automaton invariants a
+    # valid build_rule_stack output always satisfies — every entry is a
+    # 0/1 indicator and byte 0x00 (the stream's dead separator) accepts
+    # nowhere.  A stack that fails is corrupt, not merely stale.
+    vstack = {
+        k: np.asarray(z[k])
+        for k in (
+            "vstack_has",
+            "vstack_follow",
+            "vstack_accept_b",
+            "vstack_first",
+            "vstack_last",
+        )
+    }
+    for k, arr in vstack.items():
+        if arr.size and int(arr.max(initial=0)) > 1:
+            raise ValueError(
+                f"rule-stack tensor {k!r} has non-indicator values "
+                "(corrupt or tampered)"
+            )
+    if vstack["vstack_accept_b"].size and vstack["vstack_accept_b"][
+        :, 0, :
+    ].any():
+        raise ValueError(
+            "rule-stack accept tensor marks byte 0x00 live (corrupt or "
+            "tampered)"
+        )
     return CompiledArtifact(
         digest=manifest["ruleset_digest"],
         nfa=nfa,
@@ -306,6 +368,7 @@ def _unpack_artifact(manifest: dict, z) -> CompiledArtifact:
         gset=gset,
         manifest=manifest,
         alphabet=LinkAlphabet(values=stored_vals, class_map=stored_map),
+        vstack=vstack,
     )
 
 
@@ -628,6 +691,16 @@ def aot_warmup(engine) -> dict:
             jax.jit(lambda t: fn(t)).lower(spec).compile()  # graftlint: jit-cached
             out["buckets"].append(rows)
             out["compiled"] += 1
+        # Verify-side warmup: when the engine carries a device verifier
+        # (hybrid auto/device/fused), pre-compile its bulk jit shapes too
+        # — including the fused verdict kernel, whose rule tensors the
+        # schema-3 vstack arrays provide without a per-rule Python build.
+        nfa = getattr(engine, "_nfa_verifier", None)
+        if nfa is not None:
+            nfa.warmup(compile_buckets=True)
+            out["verify"] = (
+                "fused" if getattr(nfa, "fused", False) else "stream"
+            )
     except Exception as e:  # AOT is best-effort by contract
         out["skipped"] = f"{type(e).__name__}: {e}"
         logger.warning("AOT warmup incomplete: %s", e)

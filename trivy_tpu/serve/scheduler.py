@@ -666,15 +666,20 @@ class BatchScheduler:
         host DFA re-run (`HybridSecretEngine.scan_batch_host`).  On a
         device exception: RESOURCE_EXHAUSTED first tries shed-and-retry
         (evict resident rulesets through the pool's LRU path, split the
-        batch in half, one retry), then any still-failing batch degrades
-        to the host path.  Every outcome feeds the breaker, so repeated
-        failures open it and a half-open probe's success re-closes it.
+        batch in half, one retry); a fused-verify engine then steps down
+        ONE rung to the legacy device stream
+        (`scan_batch_device_legacy` — fused kernels out of the loop,
+        device still in), and only then does any still-failing batch
+        degrade to the host path.  Every outcome feeds the breaker, so
+        repeated failures open it and a half-open probe's success
+        re-closes it.
 
         Returns (results, path) with path one of "device" (healthy),
-        "shed" (device succeeded after OOM recovery), "degraded" (host
-        re-run after a device failure), "breaker" (host run, device
-        skipped).  ScanTimeoutError is not a device failure — the
-        deadline fired — and propagates untouched."""
+        "shed" (device succeeded after OOM recovery), "degraded" (a
+        lower rung — legacy device or host — absorbed a failure),
+        "breaker" (host run, device skipped).  ScanTimeoutError is not
+        a device failure — the deadline fired — and propagates
+        untouched."""
         host_fn = getattr(engine, "scan_batch_host", None)
         if host_fn is not None and not self.breaker.allow():
             return host_fn(combined), "breaker"
@@ -690,6 +695,14 @@ class BatchScheduler:
                     self.breaker.record_success()
                     return results, "shed"
             self.breaker.record_failure()
+            legacy_fn = getattr(engine, "scan_batch_device_legacy", None)
+            if legacy_fn is not None and getattr(engine, "verify", "") == "fused":
+                try:
+                    return legacy_fn(combined), "degraded"
+                except ScanTimeoutError:
+                    raise
+                except Exception:
+                    self.breaker.record_failure()
             if host_fn is None:
                 raise  # no host path (pure-device engine): batch fails
             return host_fn(combined), "degraded"
