@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test lint lint-changed lockcheck smoke serve-smoke obs-smoke slo-smoke tenancy-smoke mem-smoke chaos-smoke bench bench-link bench-verify checks-corpus rules-cache perf-gate
+.PHONY: test lint lint-changed lockcheck smoke serve-smoke obs-smoke slo-smoke tenancy-smoke mem-smoke chaos-smoke mesh-smoke bench bench-link bench-verify checks-corpus rules-cache perf-gate
 
 # Tier-1: the suite the driver holds the repo to (fast, CPU, no slow marks).
 # Lint runs first — a graftlint finding fails the build before pytest
@@ -13,9 +13,10 @@ test: lint
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
 	$(MAKE) chaos-smoke
+	$(MAKE) mesh-smoke
 	$(MAKE) perf-gate
 
-# Static analysis: graftlint (project rules GL001-GL010, always available)
+# Static analysis: graftlint (project rules GL001-GL011, always available)
 # plus ruff + mypy when the environment has them (the pinned CI container
 # may not; config lives in pyproject.toml either way).
 lint:
@@ -45,6 +46,7 @@ lockcheck:
 	TRIVY_TPU_LOCKCHECK=1 JAX_PLATFORMS=cpu $(PY) -m pytest \
 		tests/test_serve_scheduler.py tests/test_serve_reload.py \
 		tests/test_chunk_pipeline.py tests/test_tenancy.py \
+		tests/test_mesh.py \
 		-q -m 'not slow' -p no:cacheprovider
 
 # CI smoke: tiny-corpus bench.py --smoke on CPU (pipeline depth 2) via the
@@ -76,7 +78,7 @@ obs-smoke:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
 		BENCH_IMAGE=0 BENCH_TENANT=0 BENCH_MEM=0 BENCH_FAULT=0 \
-		$(PY) bench.py --smoke
+		BENCH_MULTICHIP=0 $(PY) bench.py --smoke
 
 # SLO / flight-recorder smoke: boot the server with a deliberately tight
 # latency objective, drive mixed-tenant traffic with one induced breach,
@@ -100,7 +102,7 @@ tenancy-smoke:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
 		BENCH_IMAGE=0 BENCH_OBS=0 BENCH_MEM=0 BENCH_FAULT=0 \
-		$(PY) bench.py --smoke
+		BENCH_MULTICHIP=0 $(PY) bench.py --smoke
 
 # Device-memory observatory smoke: memwatch ledger units, pool
 # estimate-vs-measured reconciliation, pressure watermark e2e
@@ -113,7 +115,7 @@ mem-smoke:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
 		BENCH_IMAGE=0 BENCH_TENANT=0 BENCH_OBS=0 BENCH_FAULT=0 \
-		$(PY) bench.py --smoke
+		BENCH_MULTICHIP=0 $(PY) bench.py --smoke
 
 # Chaos smoke: the fault-injection serve suite (tests/test_chaos_serve.py,
 # -m chaos).  Arms the in-repo fault plane on the dispatch/device/rpc
@@ -124,6 +126,13 @@ mem-smoke:
 chaos-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos \
 		-p no:cacheprovider
+
+# Mesh execution plane smoke (trivy_tpu/mesh/): topology/plan units plus
+# the 1/2/4/8-device byte-parity fuzz — tests/conftest.py forces 8 XLA
+# host devices, so the CPU run exercises real 8-way sharding.
+mesh-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_mesh.py \
+		-m mesh_smoke -q -p no:cacheprovider
 
 # Performance regression gate: one smoke bench run (heavy sections off,
 # primary corpus only) appends to a throwaway ledger, then
@@ -153,7 +162,8 @@ bench:
 bench-link:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 BENCH_IMAGE=0 \
-		BENCH_TENANT=0 BENCH_FAULT=0 BENCH_FILES=2000 BENCH_PARITY=sample \
+		BENCH_TENANT=0 BENCH_FAULT=0 BENCH_MULTICHIP=0 \
+		BENCH_FILES=2000 BENCH_PARITY=sample \
 		$(PY) bench.py
 
 # Verify-backend economics only: the hit-dense corpus under host-DFA vs
@@ -164,7 +174,7 @@ bench-link:
 bench-verify:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_LINK=0 \
 		BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 BENCH_IMAGE=0 \
-		BENCH_TENANT=0 BENCH_MEM=0 BENCH_FAULT=0 \
+		BENCH_TENANT=0 BENCH_MEM=0 BENCH_FAULT=0 BENCH_MULTICHIP=0 \
 		$(PY) bench.py --smoke
 
 # Precompile the builtin ruleset into the registry cache (trivy_tpu/registry/)

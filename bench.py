@@ -1414,6 +1414,112 @@ def bench_fault(engine) -> dict:
     return out
 
 
+def bench_multichip() -> dict:
+    """BENCH_MULTICHIP: data-parallel scan scaling over the mesh plane
+    (trivy_tpu/mesh/).
+
+    One fresh subprocess per device count n in (1, 2, 4, 8): the child
+    gets TRIVY_TPU_MESH=n plus n XLA forced host devices (the same
+    virtual-mesh vehicle as tests/conftest.py — on a real multi-chip TPU
+    the forced flag is inert and the real chips shard), scans the same
+    seeded corpus through the full device-engine path under the
+    partition plan, and prints one JSON line with files/s, a findings
+    fingerprint, and the per-device occupancy ledger.  The parent gates
+    on findings byte-identity at every device count (fingerprint
+    equality vs n=1) and per-chip scaling EFFICIENCY — work-share
+    balance across shards, from the occupancy ledger.  Wall-clock
+    cannot scale on a 1-core CI host; work distribution can, and on a
+    real mesh balanced shards ARE the speedup.
+    """
+    import subprocess
+
+    counts = (1, 2, 4, 8)
+    n_files = 400 if SMOKE else 4000
+    repo = os.path.dirname(os.path.abspath(__file__))
+    out: dict = {"device_counts": list(counts), "files": n_files, "runs": {}}
+    for n in counts:
+        env = dict(os.environ)
+        flags = " ".join(
+            f
+            for f in env.get("XLA_FLAGS", "").split()
+            if not f.startswith("--xla_force_host_platform_device_count")
+        )
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+        env["JAX_PLATFORMS"] = "cpu"
+        env["TRIVY_TPU_MESH"] = str(n)
+        # An accelerator-plugin sitecustomize on PYTHONPATH can pin jax
+        # to the real chip at interpreter start; the virtual-mesh child
+        # must not inherit it (same hygiene as dryrun_multichip).
+        env.pop("PYTHONPATH", None)
+        code = (
+            "import sys; sys.path.insert(0, sys.argv[1]); import bench; "
+            "bench._multichip_child(int(sys.argv[2]), int(sys.argv[3]))"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code, repo, str(n), str(n_files)],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=600,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"multichip child n={n} failed (rc={proc.returncode}):\n"
+                f"{proc.stdout[-1000:]}\n{proc.stderr[-2000:]}"
+            )
+        out["runs"][str(n)] = json.loads(proc.stdout.strip().splitlines()[-1])
+    fp1 = out["runs"]["1"]["fingerprint"]
+    out["parity_identical"] = (
+        1 if all(r["fingerprint"] == fp1 for r in out["runs"].values()) else 0
+    )
+    out["findings"] = out["runs"]["1"]["findings"]
+    out["files_per_sec"] = {
+        k: r["files_per_sec"] for k, r in out["runs"].items()
+    }
+    out["scaling_efficiency_8"] = out["runs"]["8"]["efficiency"]
+    return out
+
+
+def _multichip_child(n: int, n_files: int) -> None:
+    """Child half of bench_multichip (fresh process; TRIVY_TPU_MESH and
+    the forced-host-device flag already pinned in env): scan the seeded
+    corpus under the mesh partition plan, print one JSON line."""
+    import hashlib
+
+    from trivy_tpu.engine.device import TpuSecretEngine
+    from trivy_tpu.mesh import topology as mesh_topology
+
+    mesh = mesh_topology.get_mesh()
+    assert mesh_topology.mesh_device_count(mesh) == n, (mesh, n)
+    corpus = bench_corpus.make_monorepo_corpus(n_files)
+    engine = TpuSecretEngine(mesh=mesh, tile_len=512)
+    engine.warmup()
+    mesh_topology.reset_occupancy()  # ledger the timed window only
+    t0 = time.perf_counter()
+    results = engine.scan_batch(list(corpus))
+    wall = time.perf_counter() - t0
+    blob = json.dumps(
+        [
+            [s.file_path, [f.to_json() for f in s.findings]]
+            for s in results
+        ],
+        sort_keys=True,
+    ).encode()
+    payload = {
+        "devices": n,
+        "files": len(corpus),
+        "wall_s": round(wall, 3),
+        "files_per_sec": round(len(corpus) / max(wall, 1e-9), 1),
+        "findings": sum(len(s.findings) for s in results),
+        "fingerprint": hashlib.sha256(blob).hexdigest(),
+        "efficiency": round(mesh_topology.occupancy_efficiency(), 4),
+        "occupancy": mesh_topology.occupancy_snapshot(),
+    }
+    print(json.dumps(payload, separators=(",", ":")))
+
+
 def _compact_detail(detail: dict) -> dict:
     """Headline subset of `detail` small enough for the tail-captured
     stdout line; the full structure lives in the side file."""
@@ -1487,6 +1593,16 @@ def _compact_detail(detail: dict) -> dict:
                 "error",
             )
             if k in ft
+        }
+    mc = detail.get("multichip")
+    if isinstance(mc, dict):
+        c["multichip"] = {
+            k: mc[k]
+            for k in (
+                "parity_identical", "scaling_efficiency_8",
+                "files_per_sec", "findings", "error",
+            )
+            if k in mc
         }
     vb = detail.get("verify_backend")
     if isinstance(vb, dict):
@@ -1738,6 +1854,15 @@ def main() -> None:
             detail["fault"] = bench_fault(engine)
         except Exception as e:
             detail["fault"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if os.environ.get("BENCH_MULTICHIP", "1") == "1":
+        # Mesh execution plane (trivy_tpu/mesh/): files/s at 1/2/4/8
+        # devices, findings byte-identity across device counts, and the
+        # per-chip work-share scaling efficiency at 8 devices.
+        try:
+            detail["multichip"] = bench_multichip()
+        except Exception as e:
+            detail["multichip"] = {"error": f"{type(e).__name__}: {e}"}
 
     if os.environ.get("BENCH_COLDSTART", "1") == "1":
         # Registry cold-compile vs warm-load economics (trivy_tpu/registry/).

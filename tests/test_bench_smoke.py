@@ -95,6 +95,10 @@ def test_bench_smoke_subprocess(tmp_path):
     env = dict(os.environ)
     env["BENCH_DETAIL_FILE"] = str(tmp_path / "detail.json")
     env.pop("JAX_PLATFORMS", None)  # --smoke pins cpu itself
+    # The multichip section spawns 4 jax-booting subprocesses and has its
+    # own gate (make perf-gate detail.multichip.* rows); keep this smoke
+    # focused on the single-process contract.
+    env["BENCH_MULTICHIP"] = "0"
     proc = subprocess.run(
         [sys.executable, "bench.py", "--smoke"],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=1500,

@@ -5,9 +5,9 @@ returns a shared no-op handle — one predicate, no span, no fence, no
 sample — so the pipelined engines keep their async overlap and the
 BENCH_OBS <2% bound.  Enabled: each handle opens a `kernel.<name>` span
 nested under the ambient chunk span, fences on the section's output
-arrays at `.done()`, and queues a (kernel, seconds) sample for the
-server's collect hook to drain into
-`trivy_tpu_device_phase_seconds{kernel}`.
+arrays at `.done()`, and queues a (kernel, device, seconds) sample for
+the server's collect hook to drain into
+`trivy_tpu_device_phase_seconds{kernel,device}`.
 """
 
 import pytest
@@ -43,8 +43,9 @@ def test_enabled_records_sample_and_span():
     assert dt >= 0.0
     samples = obs_metrics.drain_device_phases()
     assert len(samples) == 1
-    kernel, seconds = samples[0]
+    kernel, device, seconds = samples[0]
     assert kernel == "compact"
+    assert device == ""  # no output arrays -> unknown-device series
     assert seconds == dt
     names = [s.name for s in obs_trace.snapshot()]
     assert "kernel.compact" in names
@@ -81,8 +82,8 @@ def test_pending_queue_is_bounded():
     samples = obs_metrics.drain_device_phases()
     assert len(samples) == cap
     # oldest dropped, newest kept
-    assert samples[-1][1] == float(cap + 99)
-    assert samples[0][1] == 100.0
+    assert samples[-1][2] == float(cap + 99)
+    assert samples[0][2] == 100.0
 
 
 def test_device_engine_attributes_kernels_when_traced():
@@ -103,11 +104,11 @@ def test_device_engine_attributes_kernels_when_traced():
     obs_trace.disable()
 
     assert any(len(r.findings) for r in results)
-    kernels = {k for k, _ in samples}
+    kernels = {k for k, _, _ in samples}
     assert kernels, "traced run must attribute at least one kernel section"
     assert kernels <= set(obs_metrics.DEVICE_PHASE_KERNELS)
     assert "sieve-step" in kernels
-    assert all(s >= 0.0 for _, s in samples)
+    assert all(s >= 0.0 for _, _, s in samples)
 
 
 def test_hybrid_device_verify_stream_attributed(monkeypatch):
@@ -125,4 +126,4 @@ def test_hybrid_device_verify_stream_attributed(monkeypatch):
     eng.scan_batch(list(items))
     samples = obs_metrics.drain_device_phases()
     obs_trace.disable()
-    assert any(k == "verify-stream" for k, _ in samples)
+    assert any(k == "verify-stream" for k, _, _ in samples)

@@ -1,0 +1,377 @@
+"""Mesh execution plane (trivy_tpu/mesh/): topology, plan, parity.
+
+Covers the PR-14 tentpole contracts: the `TRIVY_TPU_MESH` grammar and its
+fail-fast on typos, auto-discovery that refuses to mesh a forced-host-device
+CPU backend (tier-1 safety: 8 virtual devices must NOT silently shard every
+test), the partition-plan table (rows shard over "data", constants
+replicate), per-device staging-lane occupancy accounting, and the headline
+acceptance bar: findings byte-identical at 1/2/4/8 devices — against each
+other AND the host oracle — over a corpus with NUL-heavy, exact-tile and
+jumbo blobs, across link-codec modes, with per-chip scaling efficiency
+>= 0.7 at 8 forced host devices.
+
+conftest.py forces ``--xla_force_host_platform_device_count=8``, so the
+8-way runs exercise real sharding on CPU.  `make mesh-smoke` selects the
+``mesh_smoke`` marks; the whole file also runs under `make lockcheck`.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from trivy_tpu.mesh import plan as mesh_plan
+from trivy_tpu.mesh import topology as mesh_topology
+
+pytestmark = pytest.mark.mesh_smoke
+
+
+@pytest.fixture(autouse=True)
+def _fresh_topology(monkeypatch):
+    """Meshes built here must not leak into the rest of the session: a
+    cached 8-device mesh would flip capacity_hint() for every scheduler
+    test that runs after this file."""
+    monkeypatch.delenv("TRIVY_TPU_MESH", raising=False)
+    mesh_topology.clear_cache()
+    yield
+    mesh_topology.clear_cache()
+
+
+# -- topology ----------------------------------------------------------------
+
+
+def test_parse_spec_grammar():
+    assert mesh_topology.parse_spec("") is None
+    assert mesh_topology.parse_spec(None) is None
+    assert mesh_topology.parse_spec("auto") is None
+    for unmeshed in ("none", "off", "0", "NONE"):
+        assert mesh_topology.parse_spec(unmeshed) == 1
+    assert mesh_topology.parse_spec("4") == 4
+    assert mesh_topology.parse_spec("2x4") == 8
+    assert mesh_topology.parse_spec(" 2X2 ") == 4
+
+
+@pytest.mark.parametrize("bad", ["garbage", "2x", "x4", "-1", "0x2", "1.5"])
+def test_parse_spec_rejects_typos(bad):
+    """A typo'd topology must fail fast, never silently single-device."""
+    with pytest.raises(ValueError):
+        mesh_topology.parse_spec(bad)
+
+
+def test_auto_stays_single_device_on_cpu():
+    """8 forced host devices are still a CPU backend: auto-discovery must
+    NOT mesh them, or every tier-1 test would silently shard."""
+    assert mesh_topology.get_mesh() is None
+    assert mesh_topology.capacity_hint() == 1
+    assert mesh_topology.mesh_device_count(None) == 1
+    assert mesh_topology.mesh_devices(None) == []
+    desc = mesh_topology.describe()
+    assert desc["enabled"] is False
+    assert desc["devices"] == 1
+
+
+def test_explicit_spec_builds_and_memoizes_mesh():
+    mesh = mesh_topology.get_mesh(override="8")
+    assert mesh is not None
+    assert mesh_topology.mesh_device_count(mesh) == 8
+    assert mesh.axis_names == (mesh_topology.DATA_AXIS,)
+    # memoised: the same spec returns the same object, no rebuild
+    assert mesh_topology.get_mesh(override="8") is mesh
+    # NxM factors to the same device count
+    assert mesh_topology.mesh_device_count(
+        mesh_topology.get_mesh(override="2x4")
+    ) == 8
+    tags = [mesh_topology.device_tag(d) for d in mesh_topology.mesh_devices(mesh)]
+    assert len(tags) == 8 and len(set(tags)) == 8
+    assert all(t.startswith("cpu:") for t in tags)
+    desc = mesh_topology.describe(mesh=mesh)
+    assert desc["enabled"] is True and desc["devices"] == 8
+    assert mesh_topology.capacity_hint() == 8
+
+
+def test_explicit_one_and_overcapacity():
+    assert mesh_topology.get_mesh(override="none") is None
+    assert mesh_topology.get_mesh(override="1") is None
+    with pytest.raises(ValueError):
+        mesh_topology.get_mesh(override="64")
+
+
+def test_capacity_hint_reads_env_without_booting_jax(monkeypatch):
+    monkeypatch.setenv("TRIVY_TPU_MESH", "2x2")
+    assert mesh_topology.capacity_hint() == 4
+    monkeypatch.setenv("TRIVY_TPU_MESH", "bogus")
+    assert mesh_topology.capacity_hint() == 1  # never raises in sizing paths
+
+
+def test_occupancy_ledger_math():
+    mesh_topology.reset_occupancy()
+    assert mesh_topology.occupancy_snapshot() == {}
+    assert mesh_topology.occupancy_efficiency() == 1.0
+    mesh_topology.record_occupancy("cpu:0", 100, 1000)
+    mesh_topology.record_occupancy("cpu:1", 50, 500)
+    snap = mesh_topology.occupancy_snapshot()
+    assert snap["cpu:0"]["rows"] == 100 and snap["cpu:1"]["rows"] == 50
+    # balance = total work / (devices x max-loaded device)
+    assert mesh_topology.occupancy_efficiency() == pytest.approx(
+        150 / (2 * 100)
+    )
+    mesh_topology.record_occupancy("cpu:1", 50, 500)
+    assert mesh_topology.occupancy_efficiency() == pytest.approx(1.0)
+    mesh_topology.reset_occupancy()
+    assert mesh_topology.occupancy_snapshot() == {}
+
+
+# -- partition plan ----------------------------------------------------------
+
+
+def test_plan_rows_shard_constants_replicate():
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    for family, template in mesh_plan.PLAN.items():
+        spec = mesh_plan.spec_for(family)
+        assert isinstance(spec, PartitionSpec)
+        if family in mesh_plan.CONSTANT_FAMILIES:
+            assert mesh_topology.DATA_AXIS not in template
+        else:
+            assert mesh_topology.DATA_AXIS in template
+    table = mesh_plan.plan_table()
+    assert set(table) == set(mesh_plan.PLAN)
+    for family, row in table.items():
+        assert row["replicated"] == (family in mesh_plan.CONSTANT_FAMILIES)
+    # no mesh -> no sharding: callers pass the value through unplaced
+    assert mesh_plan.sharding_for(None, "coded_rows") is None
+    mesh = mesh_topology.get_mesh(override="4")
+    sh = mesh_plan.sharding_for(mesh, "coded_rows")
+    assert isinstance(sh, NamedSharding)
+    assert sh.spec[0] == mesh_topology.DATA_AXIS
+    rep = mesh_plan.sharding_for(mesh, "gram_constants")
+    assert isinstance(rep, NamedSharding) and tuple(rep.spec) == ()
+
+
+def test_plan_unknown_family_raises():
+    with pytest.raises(KeyError):
+        mesh_plan.spec_for("no_such_family")
+
+
+# -- staging lanes -----------------------------------------------------------
+
+
+def test_staging_lanes_split_rows_and_record_occupancy():
+    from trivy_tpu.engine.pipeline import stage_rows
+
+    mesh = mesh_topology.get_mesh(override="4")
+    mesh_topology.reset_occupancy()
+    buf = np.zeros((8, 128), dtype=np.uint8)
+    dev, handles = stage_rows(buf, mesh=mesh, real_rows=6, track=False)
+    assert dev.shape == (8, 128)
+    shards = list(dev.addressable_shards)
+    assert len(shards) == 4  # one staging lane per device
+    np.testing.assert_array_equal(np.asarray(dev), buf)
+    snap = mesh_topology.occupancy_snapshot()
+    assert len(snap) == 4
+    # 6 real rows over 4 lanes of 2: [2, 2, 2, 0]
+    assert sorted(d["rows"] for d in snap.values()) == [0, 2, 2, 2]
+    handles.release()
+
+
+def test_staging_unaligned_falls_back_unsharded():
+    from trivy_tpu.engine.pipeline import stage_rows
+
+    mesh = mesh_topology.get_mesh(override="4")
+    buf = np.zeros((5, 64), dtype=np.uint8)  # 5 rows don't split 4 ways
+    dev, handles = stage_rows(buf, mesh=mesh, real_rows=5, track=False)
+    assert len(list(dev.addressable_shards)) == 1
+    handles.release()
+
+
+# -- the parity acceptance bar ----------------------------------------------
+
+
+def _mesh_corpus(n_files=400, tile=512):
+    """Adversarial shapes for the padding/demux path: NUL-heavy blobs
+    (binary-ish bytes through the codec), exact-tile-length files (zero
+    padding), a jumbo multi-tile blob, and planted secrets throughout."""
+    rng = np.random.RandomState(7)
+    corpus = []
+    for i in range(n_files):
+        size = int(rng.randint(20, 900))
+        body = bytes(
+            rng.randint(32, 127, size=size, dtype=np.int32).astype(np.uint8)
+        )
+        if i % 13 == 0:
+            body += b'\ntoken = "ghp_' + bytes([97 + i % 26]) * 36 + b'"\n'
+        if i % 17 == 0:
+            body += b"\nAKIA" + (b"%016d" % i).replace(b"0", b"Z") + b"\n"
+        if i % 11 == 0:
+            body = b"\x00" * int(rng.randint(1, 400)) + body
+        if i % 23 == 0:
+            body = body.ljust(tile, b"A")[:tile]  # exactly one tile
+        corpus.append((f"m{i}.py", body))
+    jumbo = bytes(
+        rng.randint(32, 127, size=17 * tile, dtype=np.int32).astype(np.uint8)
+    )
+    corpus.append(("jumbo.txt", jumbo + b'\nkey = "ghp_' + b"q" * 36 + b'"\n'))
+    return corpus
+
+
+def _fingerprint(results):
+    return json.dumps(
+        [[s.file_path, [f.to_json() for f in s.findings]] for s in results],
+        sort_keys=True,
+    )
+
+
+def _scan_at(n, corpus, tile=512):
+    from trivy_tpu.engine.device import TpuSecretEngine
+
+    mesh_topology.clear_cache()
+    mesh = mesh_topology.get_mesh(override=str(n))
+    assert mesh_topology.mesh_device_count(mesh) == max(n, 1)
+    engine = TpuSecretEngine(mesh=mesh, tile_len=tile)
+    mesh_topology.reset_occupancy()
+    return engine.scan_batch(list(corpus))
+
+
+def test_parity_1_2_4_8_devices_vs_oracle():
+    """The headline bar: byte-identical findings at every device count,
+    each oracle-identical, with >= 0.7 work-balance efficiency and all 8
+    lanes actually fed at 8 devices.
+
+    The corpus is smoke-bench sized on purpose: scaling efficiency is
+    real-rows work share, and a batch much smaller than the tile bucket
+    measures padding, not balance."""
+    from trivy_tpu.engine.oracle import OracleScanner
+
+    corpus = _mesh_corpus()
+    prints = {}
+    for n in (1, 2, 4, 8):
+        results = prints[n] = _scan_at(n, corpus)
+        if n == 8:
+            snap = mesh_topology.occupancy_snapshot()
+            assert len(snap) == 8, "every device must own a staging lane"
+            assert mesh_topology.occupancy_efficiency() >= 0.7
+        prints[n] = _fingerprint(results)
+    assert prints[1] == prints[2] == prints[4] == prints[8]
+
+    oracle = OracleScanner()
+    results = json.loads(prints[1])
+    assert sum(len(f) for _, f in results) >= 10, "corpus must plant hits"
+    for (path, content), (_, got) in zip(corpus, results):
+        want = oracle.scan(path, content)
+        assert got == [f.to_json() for f in want.findings], path
+
+
+def test_parity_across_codec_modes_at_8(monkeypatch):
+    """The per-shard h2d + packbits keep-mask d2h demux must be
+    transparent to every link-codec mode."""
+    prints = {}
+    corpus = _mesh_corpus(n_files=60)
+    for mode in ("off", "auto", "4", "6"):
+        monkeypatch.setenv("TRIVY_TPU_LINK_CODEC", mode)
+        prints[mode] = _fingerprint(_scan_at(8, corpus))
+    assert len(set(prints.values())) == 1, sorted(prints)
+
+
+def test_uneven_batch_pads_to_device_multiple():
+    """A batch whose row count doesn't divide the device count exercises
+    the devices x TILE_BUCKET padding; parity must hold."""
+    from trivy_tpu.engine.oracle import OracleScanner
+
+    corpus = _mesh_corpus(n_files=13)
+    got = _scan_at(8, corpus)
+    oracle = OracleScanner()
+    for (path, content), res in zip(corpus, got):
+        want = oracle.scan(path, content)
+        assert [f.to_json() for f in res.findings] == [
+            f.to_json() for f in want.findings
+        ], path
+
+
+# -- integration seams -------------------------------------------------------
+
+
+def test_scheduler_snapshot_reports_mesh():
+    from trivy_tpu.ftypes import Secret
+    from trivy_tpu.serve import BatchScheduler, ServeConfig
+
+    class _Stub:
+        def scan_batch(self, items):
+            return [Secret(file_path=p) for p, _ in items]
+
+    mesh_topology.reset_occupancy()
+    sched = BatchScheduler(lambda: _Stub(), ServeConfig(batch_window_ms=0.0))
+    try:
+        sched.submit([("a.txt", b"hi")]).result(timeout=10)
+        snap = sched.snapshot()
+        assert snap["mesh"]["devices"] == 1  # unmeshed CPU process
+        assert isinstance(snap["mesh"]["occupancy"], dict)
+    finally:
+        sched.close()
+
+
+def test_gate_prices_mesh_profile(monkeypatch):
+    from trivy_tpu.engine import hybrid
+
+    monkeypatch.setenv("TRIVY_TPU_LINK", "wide")
+    fused = hybrid.gate_terms(profile="fused", devices=1)
+    meshy = hybrid.gate_terms(profile="mesh", devices=8)
+    assert meshy["devices"] == 8
+    # aggregate rate: per-link effective rate x device count
+    assert meshy["eff_mb_per_sec"] == pytest.approx(
+        fused["eff_mb_per_sec"] * 8
+    )
+    # pricing a mesh never tightens the fused RTT bar
+    assert meshy["rtt_threshold_s"] == fused["rtt_threshold_s"]
+    single = hybrid.gate_terms(profile="mesh", devices=1)
+    assert single["eff_mb_per_sec"] == pytest.approx(fused["eff_mb_per_sec"])
+
+
+def test_debug_mesh_surface_and_gauge():
+    from trivy_tpu.cache.store import MemoryCache
+    from trivy_tpu.ftypes import Secret
+    from trivy_tpu.rpc.server import start_background
+
+    class _Stub:
+        def scan_batch(self, items):
+            return [Secret(file_path=p) for p, _ in items]
+
+    httpd, _ = start_background(
+        "localhost:0", MemoryCache(), secret_engine_factory=lambda: _Stub()
+    )
+    try:
+        addr = f"{httpd.server_address[0]}:{httpd.server_address[1]}"
+        with urllib.request.urlopen(
+            f"http://{addr}/debug/mesh", timeout=10
+        ) as r:
+            report = json.loads(r.read())
+        assert report["enabled"] is False and report["devices"] == 1
+        assert set(report["plan"]) == set(mesh_plan.PLAN)
+        assert "occupancy" in report and "resident_bytes" in report
+        assert 0.0 <= report["scaling_efficiency"] <= 1.0
+        with urllib.request.urlopen(f"http://{addr}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert "trivy_tpu_mesh_devices 1" in text
+    finally:
+        httpd.scan_server.scheduler.close()
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_cli_mesh_flag_validates_spec(capsys):
+    """--mesh seats TRIVY_TPU_MESH; a typo is a usage error (exit 2)."""
+    import os
+
+    from trivy_tpu import cli
+
+    assert threading.current_thread() is threading.main_thread()
+    prev = os.environ.pop("TRIVY_TPU_MESH", None)
+    try:
+        rc = cli.main(["fs", "--mesh", "2y2", "."])
+        assert rc == 2
+        assert "mesh" in capsys.readouterr().err
+        assert "TRIVY_TPU_MESH" not in os.environ
+    finally:
+        if prev is not None:
+            os.environ["TRIVY_TPU_MESH"] = prev

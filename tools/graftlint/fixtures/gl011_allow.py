@@ -1,0 +1,32 @@
+"""GL011 allow fixture: cached sharded factories and plan-conformant
+placements."""
+
+import functools
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from trivy_tpu.ops.sieve import make_sharded_sieve
+
+SIEVE = make_sharded_sieve(None)  # module level: once per import
+
+
+@functools.lru_cache(maxsize=4)
+def _sieve_for(mesh):
+    return make_sharded_sieve(mesh)  # one construction per mesh key
+
+
+class Engine:
+    def __init__(self, mesh):
+        # built once, cached for the object's lifetime
+        self._sieve_fn = make_sharded_sieve(mesh)
+
+
+def put_rows(mesh, coded_rows):
+    # rows are a sharded family: the data-axis spec IS the plan
+    return jax.device_put(coded_rows, NamedSharding(mesh, P("data", None)))
+
+
+def put_vstack(mesh, vstack_rules):
+    # constants replicate: the empty spec is plan-conformant
+    return jax.device_put(vstack_rules, NamedSharding(mesh, P()))

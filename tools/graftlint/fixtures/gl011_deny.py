@@ -1,0 +1,29 @@
+"""GL011 deny fixture: per-dispatch sharded-callable rebuilds and
+partitioned placements of plan-constant tensors."""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from trivy_tpu.ops.sieve import make_sharded_sieve
+
+
+def scan_batches(mesh, batches, lut):
+    for rows in batches:
+        fn = make_sharded_sieve(mesh)  # GL011: re-lowered every batch
+        yield fn(rows, lut)
+
+
+def sieve_once(mesh, rows, lut):
+    fn = make_sharded_sieve(mesh)  # GL011: uncached per-call factory
+    return fn(rows, lut)
+
+
+def put_vstack(mesh, vstack_rules):
+    # GL011: constants replicate; a data split strands rows per device
+    return jax.device_put(vstack_rules, NamedSharding(mesh, P("data")))
+
+
+def put_gram(mesh, gram_constants):
+    return jax.device_put(  # GL011
+        gram_constants, NamedSharding(mesh, P("data", None))
+    )

@@ -588,3 +588,141 @@ def check_resident_device_put(mod: Module) -> list[Finding]:
             )
         )
     return out
+
+
+# -- GL011: mesh execution-plane hazards ------------------------------------
+
+_SHARDED_FACTORY_PREFIX = "make_sharded"
+# Name fragments of the partition plan's constant families (the authority
+# is mesh/plan.CONSTANT_FAMILIES): tensors whose names carry these are
+# replicated by contract, never split across the data axis.
+_PLAN_CONSTANT_HINTS = ("vstack", "gram_const", "probe_const")
+
+
+def _is_sharded_factory_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = dotted_name(node.func)
+    return bool(d) and d.split(".")[-1].startswith(_SHARDED_FACTORY_PREFIX)
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    out: set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            out.add(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            out.add(sub.attr)
+    return out
+
+
+def _partitioned_sharding(node: ast.AST) -> bool:
+    """A ``NamedSharding(mesh, P(...))`` anywhere in the expression whose
+    PartitionSpec names a real axis (any non-None argument): the tensor it
+    places gets split across devices."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        d = dotted_name(sub.func)
+        if not (d == "NamedSharding" or d.endswith(".NamedSharding")):
+            continue
+        for inner in ast.walk(sub):
+            if not isinstance(inner, ast.Call):
+                continue
+            dn = dotted_name(inner.func)
+            if dn in ("P", "PartitionSpec") or dn.endswith(".PartitionSpec"):
+                if any(
+                    not (isinstance(a, ast.Constant) and a.value is None)
+                    for a in inner.args
+                ):
+                    return True
+    return False
+
+
+@rule("GL011")
+def check_mesh_plan(mod: Module) -> list[Finding]:
+    """Mesh execution-plane hazards.
+
+    (a) A ``make_sharded_*`` factory (ops/sieve.py, ops/gram_sieve.py,
+    ops/gram_sieve_pallas.py) wraps its kernel in pjit/shard_map: calling
+    it per batch re-traces and re-lowers the whole sharded program every
+    dispatch.  Same escape hatches as GL001 — cache on self, lru_cache the
+    factory, memoize in a module global, or annotate ``jit-cached``.
+
+    (b) A plan-constant tensor (vstack rules, gram constants, probe
+    constants — mesh/plan.CONSTANT_FAMILIES) placed under a partitioned
+    NamedSharding: the plan replicates constants, and a data-axis split
+    hands each device a fragment of a table every lane needs whole.
+    """
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        line = node.lineno
+        # -- arm (a): per-dispatch sharded-callable construction
+        if _is_sharded_factory_call(node):
+            if mod.has_directive(line, "jit-cached"):
+                continue
+            fname = dotted_name(node.func).split(".")[-1]
+            if mod.in_loop(node):
+                out.append(
+                    Finding(
+                        "GL011",
+                        mod.relpath,
+                        line,
+                        f"{fname}() constructed inside a loop re-lowers the "
+                        "sharded program every iteration; hoist it out or "
+                        "cache by mesh",
+                    )
+                )
+                continue
+            fn = mod.enclosing_function(node)
+            if fn is None:
+                continue  # module-level: one construction per import
+            if mod.has_directive(fn.lineno, "jit-cached"):
+                continue
+            chain = mod.function_chain(node)
+            if any(_decorator_names(f) & _CACHE_DECORATORS for f in chain):
+                continue
+            if any(_self_attr_assigned(f) for f in chain):
+                continue
+            if _assigned_to_global(mod, node, fn):
+                continue
+            out.append(
+                Finding(
+                    "GL011",
+                    mod.relpath,
+                    line,
+                    f"{fname}() constructed inside {fn.name}() with no "
+                    "caching; every call re-traces and re-lowers the "
+                    "sharded program (cache on self, lru_cache, or "
+                    "annotate jit-cached)",
+                )
+            )
+            continue
+        # -- arm (b): partitioned placement of a plan-constant tensor
+        d = dotted_name(node.func)
+        if not (d == "device_put" or d.endswith(".device_put")):
+            continue
+        if len(node.args) < 2:
+            continue
+        hinted = sorted(
+            n
+            for n in _names_in(node.args[0])
+            if any(h in n for h in _PLAN_CONSTANT_HINTS)
+        )
+        if not hinted:
+            continue
+        if _partitioned_sharding(node.args[1]):
+            out.append(
+                Finding(
+                    "GL011",
+                    mod.relpath,
+                    line,
+                    f"plan-constant tensor {hinted[0]!r} placed under a "
+                    "partitioned NamedSharding; the partition plan "
+                    "(trivy_tpu/mesh/plan.py) replicates constant "
+                    "families — use the empty PartitionSpec",
+                )
+            )
+    return out

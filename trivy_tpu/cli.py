@@ -219,6 +219,12 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
         "the host-device link entirely (default 32; "
         "TRIVY_TPU_RESIDENT_CHUNKS)",
     )
+    p.add_argument(
+        "--mesh", default=_env_default("mesh", ""),
+        help="device mesh for data-parallel scans: N or NxM devices, "
+        "'auto' (mesh only on multi-chip TPU), 'none' to force "
+        "single-device (default auto; TRIVY_TPU_MESH)",
+    )
     p.add_argument("--ignorefile", default=_env_default("ignorefile", ".trivyignore"))
     p.add_argument(
         "--debug", action="store_true", default=_bool_default("debug")
@@ -735,6 +741,12 @@ def build_parser() -> argparse.ArgumentParser:
         "(default 32; TRIVY_TPU_RESIDENT_CHUNKS)",
     )
     p_server.add_argument(
+        "--mesh", default=_env_default("mesh", ""),
+        help="device mesh for the server's engines: N or NxM devices, "
+        "'auto' (mesh only on multi-chip TPU), 'none' to force "
+        "single-device (default auto; TRIVY_TPU_MESH)",
+    )
+    p_server.add_argument(
         "--hbm-soft-pct", type=float,
         default=_float_default("hbm-soft-pct", 85.0),
         help="device-memory soft watermark as %% of the HBM bytes_limit: "
@@ -1004,6 +1016,21 @@ def main(argv: list[str] | None = None) -> int:
     if args.command in (None, "version"):
         print(f"trivy-tpu version {__version__}")
         return 0
+
+    # --mesh seats the topology override where every engine (scan or
+    # server, built now or at a hot reload) resolves it: the env var
+    # mesh/topology.get_mesh reads.  Validated here so a typo'd spec is
+    # a usage error, not a mid-scan ValueError.
+    mesh_spec = getattr(args, "mesh", "")
+    if mesh_spec:
+        from trivy_tpu.mesh import topology as mesh_topology
+
+        try:
+            mesh_topology.parse_spec(mesh_spec)
+        except ValueError as e:
+            print(f"trivy-tpu: {e}", file=sys.stderr)
+            return 2
+        os.environ["TRIVY_TPU_MESH"] = mesh_spec
 
     if args.command == "plugin":
         return _plugin_command(args)

@@ -200,9 +200,9 @@ class TpuSecretEngine:
         self._sieve_donated = None
         self._mesh = mesh
         self._tile_buckets = TILE_BUCKETS
-        self._tile_align = (
-            int(np.prod([mesh.shape[a] for a in mesh.axis_names])) if mesh else 1
-        )
+        # Resolved against the unified topology below (native never
+        # touches a device, so it keeps the trivial alignment).
+        self._tile_align = 1
 
         self._gate, self._gate_any, self._conj, self._conj_any = self.pset.gate_masks()
         self._build_member_matrices()
@@ -231,6 +231,19 @@ class TpuSecretEngine:
         enable_compilation_cache()
 
         import jax.numpy as jnp
+
+        from trivy_tpu.mesh import topology as mesh_topology
+
+        if mesh is None:
+            # Unified mesh selection (mesh/topology.py): sieve, lane
+            # derive, and fused verify all see this one mesh instead of
+            # probing jax.devices() per site.  None on single-device
+            # hosts — every consumer takes its unsharded path.
+            mesh = mesh_topology.get_mesh()
+            self._mesh = mesh
+        # Batches pad to devices x TILE_BUCKET so every shard gets whole
+        # rows (the Pallas branch further multiplies by block_rows).
+        self._tile_align = mesh_topology.mesh_device_count(mesh)
 
         if sieve == "gram":
             import jax
@@ -273,7 +286,7 @@ class TpuSecretEngine:
                 cmasks, cvals = self.gset.masks, self.gset.vals
                 unpack = None
 
-            on_tpu = jax.devices()[0].platform == "tpu"
+            on_tpu = mesh_topology.is_tpu()
             # Fused default: on for TPU hosts (where killing the d2h of
             # the hit matrix pays), opt-in elsewhere — explicit `fused=`
             # or TRIVY_TPU_FUSED=1/0 overrides either way.  CPU CI holds
@@ -464,8 +477,10 @@ class TpuSecretEngine:
         if self._sieve_donated is None:
             import jax
 
+            from trivy_tpu.mesh import topology as mesh_topology
+
             fn = self._sieve_fn
-            if jax.default_backend() == "tpu":
+            if mesh_topology.backend_is_tpu():
                 fn = jax.jit(lambda r: self._sieve_fn(r), donate_argnums=0)
             self._sieve_donated = fn
         return self._sieve_donated
@@ -507,7 +522,9 @@ class TpuSecretEngine:
         self.stats.d2h_bytes += got_b
         return arr
 
-    def _resident_dispatch(self, part: np.ndarray) -> np.ndarray:
+    def _resident_dispatch(
+        self, part: np.ndarray, real_rows: int | None = None
+    ) -> np.ndarray:
         """One synchronous dispatch through the resident-chunk LRU: a
         digest-identical chunk never re-crosses the link.  The digest is
         taken over the CODED buffer and suffixed with the codec id, so a
@@ -529,7 +546,7 @@ class TpuSecretEngine:
                 return hit
         self.stats.device_dispatches += 1
         self._count_link(raw_n, buf.nbytes)
-        out = self._dispatch_rows(buf)
+        out = self._dispatch_rows(buf, real_rows=real_rows)
         if digest is not None:
             self._resident.put(digest, out)
         return out
@@ -537,18 +554,20 @@ class TpuSecretEngine:
     def _sieve_rows(self, rows: np.ndarray) -> np.ndarray:
         """Run the device sieve over fixed-shape row chunks; returns the
         per-row packed hit words [T, W]."""
-        import jax
-
-        from trivy_tpu.engine.pipeline import ChunkPipeline, chunk_digest
+        from trivy_tpu.engine.pipeline import (
+            ChunkPipeline,
+            chunk_digest,
+            stage_rows,
+        )
 
         buckets = self._buckets()
         max_rows = buckets[-1]
         total = len(rows)
         fit = next((b for b in buckets if total <= b), None)
         if fit is not None:
-            return self._resident_dispatch(self._pad_chunk(rows, 0, fit))[
-                :total
-            ]
+            return self._resident_dispatch(
+                self._pad_chunk(rows, 0, fit), real_rows=total
+            )[:total]
         if os.environ.get("TRIVY_TPU_SYNC_TIMING"):
             # Forced-sync decomposition (bench's h2d/exec split): serial by
             # design so the phase boundary stays measurable.
@@ -583,11 +602,16 @@ class TpuSecretEngine:
             self._count_link(raw_n, buf.nbytes)
             with obs_trace.span("chunk.h2d", chunk=ci, bytes=buf.nbytes):
                 faults.fire("device.put")
-                dev = jax.device_put(buf)
-            # Staging buffers live device-side for up to `depth` chunks;
-            # the ledger entry rides the pipeline handle and releases at
-            # finish (or cancel on a drained pipeline).
-            mw = memwatch.track("pipeline-staging", buf.nbytes)
+                # Staging buffers live device-side for up to `depth`
+                # chunks; the per-device ledger handles ride the pipeline
+                # handle and release at finish (or cancel on a drained
+                # pipeline).  Meshed engines split the chunk into one
+                # staging lane per device here.
+                dev, mw = stage_rows(
+                    buf,
+                    self._mesh,
+                    real_rows=max(0, min(max_rows, total - ci * max_rows)),
+                )
             return (digest, dev, False, mw)
 
         def execute(ci, staged):
@@ -633,14 +657,15 @@ class TpuSecretEngine:
         return np.concatenate(outs)[:total]
 
     def _use_fused_derive(self) -> bool:
-        """Fused residency + on-device lane derive applies on the
-        un-meshed gram jax path only (the verdict matmuls would cross a
-        sharded gram axis) and never under sync-timing decomposition
-        (whose phase boundaries assume the serial host path)."""
+        """Fused residency + on-device lane derive applies on the gram
+        jax path — meshed included: the derivation runs under the
+        partition plan (row tensors sharded, membership matmul constants
+        replicated; GSPMD keeps the cross-shard cumsum exact) — and never
+        under sync-timing decomposition (whose phase boundaries assume
+        the serial host path)."""
         return (
             self._fused
             and self.sieve == "gram"
-            and self._mesh is None
             and self.gset.num_grams > 0
             and not os.environ.get("TRIVY_TPU_SYNC_TIMING")
         )
@@ -662,10 +687,13 @@ class TpuSecretEngine:
         them back without re-crossing the link.  The exec path is the
         NON-donated sieve: donation would hand the staged rows'
         allocation back to XLA and invalidate the residency."""
-        import jax
         import jax.numpy as jnp
 
-        from trivy_tpu.engine.pipeline import ChunkPipeline, chunk_digest
+        from trivy_tpu.engine.pipeline import (
+            ChunkPipeline,
+            chunk_digest,
+            stage_rows,
+        )
 
         store = self._get_row_store()
         buckets = self._buckets()
@@ -684,7 +712,11 @@ class TpuSecretEngine:
             self._count_link(raw_n, buf.nbytes)
             with obs_trace.span("chunk.h2d", bytes=buf.nbytes):
                 faults.fire("device.put")
-                dev = jax.device_put(buf)
+                # Residency owns the ledger entry (store.put_rows tracks
+                # per device); staging itself stays untracked here.
+                dev, _mw = stage_rows(
+                    buf, self._mesh, real_rows=total, track=False
+                )
             with obs_trace.span("chunk.exec"):
                 faults.fire("device.exec")
                 out = self._exec_attributed(dev)
@@ -705,7 +737,12 @@ class TpuSecretEngine:
             self._count_link(raw_n, buf.nbytes)
             with obs_trace.span("chunk.h2d", chunk=ci, bytes=buf.nbytes):
                 faults.fire("device.put")
-                dev = jax.device_put(buf)
+                dev, _mw = stage_rows(
+                    buf,
+                    self._mesh,
+                    real_rows=max(0, min(max_rows, total - ci * max_rows)),
+                    track=False,
+                )
             return (digest, dev, None, False)
 
         def execute(ci, staged):
@@ -854,7 +891,9 @@ class TpuSecretEngine:
         ph.done(out)
         return out
 
-    def _dispatch_rows(self, buf: np.ndarray) -> np.ndarray:
+    def _dispatch_rows(
+        self, buf: np.ndarray, real_rows: int | None = None
+    ) -> np.ndarray:
         """One sieve dispatch over an already-staged (possibly coded)
         buffer.  Under TRIVY_TPU_SYNC_TIMING=1 the h2d transfer is forced
         to complete (a 1-element fetch round-trip — block_until_ready
@@ -872,7 +911,14 @@ class TpuSecretEngine:
             # lands (dispatch is async; the fetch span absorbs the wait).
             with obs_trace.span("chunk.h2d", bytes=buf.nbytes):
                 faults.fire("device.put")
-                dev = jnp.asarray(buf)
+                if self._mesh is not None:
+                    from trivy_tpu.engine.pipeline import stage_rows
+
+                    dev, _mw = stage_rows(
+                        buf, self._mesh, real_rows=real_rows, track=False
+                    )
+                else:
+                    dev = jnp.asarray(buf)
             with obs_trace.span("chunk.exec"):
                 faults.fire("device.exec")
                 out = self._exec_attributed(dev)

@@ -42,6 +42,7 @@ import numpy as np
 from trivy_tpu import lockcheck
 from trivy_tpu.engine.device import SieveStats, TpuSecretEngine
 from trivy_tpu.ftypes import Secret
+from trivy_tpu.mesh import topology as mesh_topology
 from trivy_tpu.obs import gatelog
 from trivy_tpu.obs import trace as obs_trace
 
@@ -158,17 +159,21 @@ FUSED_GATE_RTT_S = 0.25
 
 def gate_terms(
     h2d_ratio: float = 1.0, d2h_ratio: float = 1.0,
-    profile: str = "stream",
+    profile: str = "stream", devices: int = 1,
 ) -> dict:
     """Measure the link and price it against the device-verify bar;
     returns every term the decision used (the gate-audit record body).
 
     `profile` selects the backend cost model being priced: "stream" (the
     legacy flag-map path — every verify byte re-crosses the link, d2h at
-    the compaction ratio) or "fused" (verify rows stay device-resident,
+    the compaction ratio), "fused" (verify rows stay device-resident,
     so the verify stage's marginal re-upload is ~zero —
     link_mod.FUSED_REUPLOAD_RATIO — and the RTT bar loosens to
-    FUSED_GATE_RTT_S because the batch rides O(1) dispatches).
+    FUSED_GATE_RTT_S because the batch rides O(1) dispatches), or "mesh"
+    (the fused cost model at `devices` chips: each device has its own
+    staging lane, per-shard h2d and the per-shard keep-mask d2h overlap
+    across chips, so the effective aggregate rate is the per-link rate x
+    device count — the whole reason a mesh can win where one chip loses).
 
     `margin` is the signed distance from the flip point: the worse of
     (effective rate vs GATE_EFF_MB_S) and (RTT vs the profile's RTT bar),
@@ -177,17 +182,20 @@ def gate_terms(
     from trivy_tpu.engine import link as link_mod
 
     mb_s, rtt = probe_link()
-    reupload = (
-        link_mod.FUSED_REUPLOAD_RATIO if profile == "fused" else 1.0
-    )
-    rtt_bar = FUSED_GATE_RTT_S if profile == "fused" else GATE_RTT_S
+    devices = max(int(devices), 1)
+    fused_model = profile in ("fused", "mesh")
+    reupload = link_mod.FUSED_REUPLOAD_RATIO if fused_model else 1.0
+    rtt_bar = FUSED_GATE_RTT_S if fused_model else GATE_RTT_S
     eff = link_mod.effective_link_rate(
         mb_s, h2d_ratio, d2h_ratio, reupload_ratio=reupload
     )
+    if profile == "mesh":
+        eff *= devices
     wide = eff >= GATE_EFF_MB_S and rtt < rtt_bar
     margin = min(eff / GATE_EFF_MB_S - 1.0, 1.0 - rtt / rtt_bar)
     return {
         "profile": profile,
+        "devices": devices,
         "link_mb_per_sec": mb_s,
         "link_rtt_s": rtt,
         "h2d_ratio": h2d_ratio,
@@ -306,25 +314,40 @@ class HybridSecretEngine(TpuSecretEngine):
                     requested="auto", backend="dfa", reason="no-device",
                 )
             else:
-                # Price the FUSED cost model first: rows stay resident so
-                # the verify stage re-uploads ~nothing and the RTT bar
-                # loosens — a link too narrow for the legacy stream can
-                # still clear the fused bar (that asymmetry is the point
-                # of this PR).  Fall back to the legacy stream pricing,
-                # then host DFA.
+                # Price the MESH cost model first when a multi-device
+                # partition plan is in play (fused economics at N chips:
+                # per-device staging lanes overlap h2d/d2h across chips,
+                # so the effective aggregate rate scales by the device
+                # count), else the single-chip FUSED model: rows stay
+                # resident so the verify stage re-uploads ~nothing and
+                # the RTT bar loosens — a link too narrow for the legacy
+                # stream can still clear the fused bar.  Fall back to
+                # the legacy stream pricing, then host DFA.
+                n_dev = (
+                    mesh_topology.mesh_device_count(mesh)
+                    if mesh is not None
+                    else mesh_topology.capacity_hint()
+                )
                 fterms = gate_terms(
                     d2h_ratio=link_mod.FUSED_MASK_D2H_RATIO,
-                    profile="fused",
+                    profile="mesh" if n_dev > 1 else "fused",
+                    devices=n_dev,
                 )
                 if fterms["wide"]:
                     verify, terms = "fused", fterms
                 else:
                     terms = gate_terms(d2h_ratio=d2h_ratio)
                     verify = "device" if terms["wide"] else "dfa"
+                if terms["wide"] and terms["profile"] == "mesh":
+                    reason = "mesh-wide"
+                else:
+                    reason = "link-wide" if terms["wide"] else "link-narrow"
                 self.gate_decision = gatelog.record(
                     requested="auto",
                     backend=verify,
-                    reason="link-wide" if terms["wide"] else "link-narrow",
+                    reason=reason,
+                    profile=terms["profile"],
+                    devices=terms["devices"],
                     link_mb_per_sec=terms["link_mb_per_sec"],
                     link_rtt_s=terms["link_rtt_s"],
                     h2d_ratio=terms["h2d_ratio"],
@@ -354,6 +377,11 @@ class HybridSecretEngine(TpuSecretEngine):
                 self.ruleset.rules, self._trimmable_rules()
             )
         if verify in ("device", "fused"):
+            # One mesh for the whole device path: the verifier joins the
+            # same partition plan the sieve resolved (topology.get_mesh
+            # is memoised, so this never disagrees with the engine's).
+            if mesh is None:
+                mesh = mesh_topology.get_mesh()
             try:
                 from trivy_tpu.engine.nfa_device import NfaVerifier
 

@@ -413,6 +413,24 @@ def _stream_lane_jit():
     return to_lanes
 
 
+def _demux_shards(out) -> np.ndarray:  # graftlint: fetch-boundary
+    """Host demux of a (possibly) multi-device array: each device ships
+    only its own slice (per-shard d2h — no cross-device gather before the
+    link), and the host reassembles slices at their global indices.
+    Shard order is index order, so the reassembled buffer is byte-
+    identical to a single-device `np.asarray(out)` — meshed and unmeshed
+    verdicts demux to the same lane order, which is what keeps finding
+    order byte-identical at every device count.  Replicated and
+    single-device arrays take the plain fetch."""
+    shards = getattr(out, "addressable_shards", None)
+    if not shards or len(shards) <= 1:
+        return np.asarray(out)
+    host = np.zeros(tuple(out.shape), dtype=out.dtype)
+    for s in shards:
+        host[s.index] = np.asarray(s.data)
+    return host
+
+
 def fetch_mask_packed(out, raw_bytes: int) -> tuple[np.ndarray, int, int]:  # graftlint: fetch-boundary
     """Fetch the fused verify kernel's packed keep-mask — a uint8
     bit-pack of per-lane verdicts, the fused path's ONLY d2h.  Returns
@@ -421,8 +439,9 @@ def fetch_mask_packed(out, raw_bytes: int) -> tuple[np.ndarray, int, int]:  # gr
     caller computes it from the flag tensor shape), so the stream-stats
     fetch accounting stays comparable across backends.  No bitmap
     round-trip here: the mask is already 1 bit/lane, smaller than any
-    compaction header."""
-    packed = np.asarray(out)
+    compaction header.  Meshed dispatches fetch per shard and demux on
+    host (see _demux_shards) — lane order is preserved exactly."""
+    packed = _demux_shards(out)
     return np.unpackbits(packed).astype(bool), int(raw_bytes), packed.nbytes
 
 

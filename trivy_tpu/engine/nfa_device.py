@@ -199,21 +199,22 @@ class NfaVerifier:
     # ------------------------------------------------------------------
 
     def _shardings(self):
-        """(group-sharded [L,G,Bg], gid-sharded [G], replicated) specs, or
-        Nones without a mesh."""
+        """(group-sharded [L,G,Bg], gid-sharded [G], replicated) specs
+        from the partition plan (mesh/plan.py), or Nones without a mesh."""
         if self.mesh is None:
             return None, None, None
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from trivy_tpu.mesh import plan as mesh_plan
 
-        axes = tuple(self.mesh.axis_names)
         return (
-            NamedSharding(self.mesh, P(None, axes, None)),
-            NamedSharding(self.mesh, P(axes)),
-            NamedSharding(self.mesh, P()),
+            mesh_plan.sharding_for(self.mesh, "padded_classes"),
+            mesh_plan.sharding_for(self.mesh, "lane_tables"),
+            mesh_plan.sharding_for(self.mesh, "vstack_rules"),
         )
 
     def _compute_dtype(self):
-        return jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+        from trivy_tpu.mesh import topology as mesh_topology
+
+        return jnp.bfloat16 if mesh_topology.backend_is_tpu() else jnp.float32
 
     def _device_tensors(self):
         if self._tensors_on_device is None:
@@ -288,11 +289,13 @@ class NfaVerifier:
                     bd, zt(rb, 64, 64), zt(rb, 256, 64), zt(rb, 64),
                     zt(rb, 64),
                 ).block_until_ready()
-            if self.fused and self.mesh is None:
+            if self.fused:
                 # the fused verdict shape big batches actually hit: large
                 # row tier, max group chunk, minimal lane table (lane
                 # counts pad to powers of two, so other widths are cheap
-                # incremental compiles)
+                # incremental compiles); lane tables take their plan
+                # placement so the meshed specialization is the one
+                # production dispatches hit
                 bd = self._put_stream(
                     np.zeros(
                         (
@@ -302,7 +305,7 @@ class NfaVerifier:
                         dtype=np.uint8,
                     )
                 )
-                lane = jnp.zeros(8, jnp.int32)
+                lane = self._put_lanes(np.zeros(8, np.int32))
                 self._run_fused(
                     bd, zt(rb, 64, 64), zt(rb, 256, 64), zt(rb, 64),
                     zt(rb, 64), lane, lane, lane, lane,
@@ -722,9 +725,12 @@ class NfaVerifier:
         depth = default_depth()
         tiers = STREAM_TIERS
         # Fused mode resolves lane verdicts on-device (one keep-mask bit
-        # per lane crosses the link); meshed runs keep the legacy flag-map
-        # path — the verdict gather would cross the sharded G axis.
-        fused = bool(self.fused) and self.mesh is None
+        # per lane crosses the link).  Meshed runs fuse too: lane tables
+        # shard row-wise per the plan, the verdict gather crosses the
+        # sharded G axis under GSPMD's inserted collectives, and the d2h
+        # is one packed keep-mask per shard (link.fetch_mask_packed's
+        # host demux reassembles them in lane order).
+        fused = bool(self.fused)
         scan_mode = fused_scan_mode() if fused else "seq"
         st = self.stream_stats = {
             "lanes": int(len(s_idx)), "span_bytes": 0,
@@ -918,8 +924,8 @@ class NfaVerifier:
                         ph = obs_metrics.device_phase("verify.fused")
                         out = self._run_fused(
                             bd, *tens,
-                            jnp.asarray(lrow), jnp.asarray(lslot),
-                            jnp.asarray(lb0), jnp.asarray(lb1),
+                            self._put_lanes(lrow), self._put_lanes(lslot),
+                            self._put_lanes(lb0), self._put_lanes(lb1),
                             onehot=(jdt == jnp.bfloat16), assoc=assoc,
                         )
                         ph.done(out)
@@ -1097,14 +1103,25 @@ class NfaVerifier:
 
     def _put_stream(self, bytes_t: np.ndarray):
         """Device placement for the 4D stream operand ([Lo, 32, G, Bg]:
-        G is the sharded axis)."""
+        G is the sharded axis per the plan)."""
         if self.mesh is None:
             return jnp.asarray(bytes_t)
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from trivy_tpu.mesh import plan as mesh_plan
 
-        axes = tuple(self.mesh.axis_names)
         return jax.device_put(
-            bytes_t, NamedSharding(self.mesh, P(None, None, axes, None))
+            bytes_t, mesh_plan.sharding_for(self.mesh, "stream_bytes")
+        )
+
+    def _put_lanes(self, arr: np.ndarray):
+        """Fused lane-table placement: the lane axis shards row-wise per
+        the plan (lane counts pad to powers of two >= 8, so any mesh up
+        to 8 devices divides them)."""
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        from trivy_tpu.mesh import plan as mesh_plan
+
+        return jax.device_put(
+            arr, mesh_plan.sharding_for(self.mesh, "lane_tables")
         )
 
     def _verify_padded(

@@ -157,6 +157,97 @@ def chunk_digest(buf) -> str:
     return hashlib.blake2b(memoryview(buf), digest_size=16).hexdigest()
 
 
+class StagingHandles:
+    """Release-once group over the per-shard memwatch handles of one
+    staged chunk — the pipeline's finish/cancel sites hold exactly one
+    handle per chunk regardless of how many devices it landed on."""
+
+    __slots__ = ("_handles",)
+
+    def __init__(self, handles):
+        self._handles = tuple(handles)
+
+    def release(self) -> None:
+        for h in self._handles:
+            h.release()
+        self._handles = ()
+
+
+def stage_rows(buf, mesh=None, real_rows=None,
+               component: str = "pipeline-staging", track: bool = True):
+    """H2d-stage one packed row chunk; returns (device_array, handles).
+
+    Unmeshed: one async `jax.device_put`, exactly the staging the
+    pipeline always did.  Meshed: the chunk splits row-wise into one
+    shard per device — each device gets its own double-buffered staging
+    lane, every shard `device_put` on its own chip so the transfers
+    overlap ACROSS chips as well as against exec — and the shards
+    assemble into one global array laid out per the partition plan
+    ("coded_rows").  Per-shard bytes are memwatch-ledgered per device,
+    and the topology occupancy ledger records each device's REAL row
+    share (`real_rows` excludes bucket padding), which is what
+    `/debug/mesh` and the MULTICHIP bench's scaling efficiency read.
+    """
+    import jax
+
+    if mesh is not None:
+        from trivy_tpu.mesh import plan as mesh_plan
+        from trivy_tpu.mesh import topology as mesh_topology
+
+        devices = mesh_topology.mesh_devices(mesh)
+        n = len(devices)
+        rows = buf.shape[0]
+        if n > 1 and rows % n == 0:
+            if real_rows is None:
+                real_rows = rows
+            rpd = rows // n
+            shards, handles = [], []
+            for i, d in enumerate(devices):
+                part = buf[i * rpd : (i + 1) * rpd]
+                shards.append(jax.device_put(part, d))
+                tag = mesh_topology.device_tag(d)
+                real = max(0, min(rpd, real_rows - i * rpd))
+                mesh_topology.record_occupancy(tag, real, part.nbytes)
+                if track:
+                    handles.append(
+                        memwatch.track(component, part.nbytes, device=tag)
+                    )
+            dev = jax.make_array_from_single_device_arrays(
+                buf.shape, mesh_plan.sharding_for(mesh, "coded_rows"), shards
+            )
+            return dev, StagingHandles(handles)
+        # Engine buckets are device-aligned; an unaligned chunk (or a
+        # degenerate 1-device mesh) stages unsharded rather than crash.
+    dev = jax.device_put(buf)
+    handles = [memwatch.track(component, buf.nbytes)] if track else []
+    return dev, StagingHandles(handles)
+
+
+def shard_nbytes(value) -> dict[str, int]:
+    """Per-device byte map for (tuples of) multi-device jax arrays; {}
+    when nothing in `value` spans more than one device (numpy buffers,
+    single-device arrays — the aggregate ledger path covers those)."""
+    out: dict[str, int] = {}
+
+    def walk(v) -> None:
+        if isinstance(v, (tuple, list)):
+            for x in v:
+                walk(x)
+            return
+        shards = getattr(v, "addressable_shards", None)
+        if not shards or len(shards) <= 1:
+            return
+        for s in shards:
+            d = s.device
+            tag = f"{d.platform}:{getattr(d, 'id', 0)}"
+            out[tag] = out.get(tag, 0) + int(
+                getattr(s.data, "nbytes", 0) or 0
+            )
+
+    walk(value)
+    return out
+
+
 class ResidentChunkCache:
     """Bounded LRU of per-chunk sieve results keyed by chunk digest.
 
@@ -200,6 +291,23 @@ class ResidentChunkCache:
         self.hits += 1
         return val
 
+    def _track(self, value) -> StagingHandles:
+        """Ledger one entry's bytes: sharded device values get one handle
+        per device (the shard layout the entry carries), anything else a
+        single aggregate handle; any unsharded remainder of a mixed tuple
+        is ledgered on the default device so sums stay exact."""
+        per_dev = shard_nbytes(value)
+        handles = [
+            memwatch.track(self._component, nb, device=dev, owner=self)
+            for dev, nb in sorted(per_dev.items())
+        ]
+        rest = memwatch.nbytes_of(value) - sum(per_dev.values())
+        if rest > 0 or not handles:
+            handles.append(
+                memwatch.track(self._component, rest, owner=self)
+            )
+        return StagingHandles(handles)
+
     def put(self, digest: str, value) -> None:
         if self.capacity == 0:
             return
@@ -208,9 +316,7 @@ class ResidentChunkCache:
             old.release()
         self._lru[digest] = value
         self._lru.move_to_end(digest)
-        self._mw[digest] = memwatch.track(
-            self._component, memwatch.nbytes_of(value), owner=self
-        )
+        self._mw[digest] = self._track(value)
         while len(self._lru) > self.capacity:
             evicted, _ = self._lru.popitem(last=False)
             mw = self._mw.pop(evicted, None)

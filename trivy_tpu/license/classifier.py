@@ -239,7 +239,9 @@ class FullTextClassifier:
         try:
             import jax
 
-            if jax.devices()[0].platform not in ("cpu",):
+            from trivy_tpu.mesh import topology as mesh_topology
+
+            if mesh_topology.platform() not in ("cpu",):
                 return np.asarray(
                     _device_dot()(
                         jax.numpy.asarray(fps),

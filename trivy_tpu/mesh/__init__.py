@@ -1,0 +1,15 @@
+"""Mesh execution plane: one shared answer to "which devices, and how
+is each engine tensor laid out across them".
+
+`topology.py` owns mesh DISCOVERY — the single `get_mesh()` every layer
+(sieve constructors, lane derive, fused verify, the serve scheduler's
+capacity sizing) consults, so the whole device path agrees on one mesh
+instead of probing `jax.devices()` per call site.  `plan.py` owns the
+PARTITION PLAN — the tensor-family -> PartitionSpec table (rows shard
+over the `data` axis, constants replicate) that the kernels' in/out
+shardings are built from.
+"""
+
+from trivy_tpu.mesh import plan, topology
+
+__all__ = ["plan", "topology"]

@@ -1,0 +1,85 @@
+"""The partition plan: engine tensor family -> PartitionSpec.
+
+One table answers "how does this tensor lie across the mesh" for every
+family the device path moves, instead of each kernel hand-rolling its
+own specs (the shape of SNIPPETS.md's `match_partition_rules`, keyed by
+family name rather than regex because the engine's tensor families are
+a closed set):
+
+  coded_rows     [T, L] packed content tiles / class-id rows — shard the
+                 row axis; tiles never span devices, so the sieve needs
+                 no collectives.
+  hit_bitmaps    [T, Pw] sieve output — same row sharding as its input.
+  lane_tables    [N] per-lane dispatch vectors (lane_row/slot/b0/b1 of
+                 the fused verify) — shard the lane axis.
+  stream_bytes   [rows, pipe, G, block] verify stream bytes — shard the
+                 group axis (matches NfaVerifier._shardings).
+  padded_classes [L, G, Bg] padded-path class tensors — group axis
+                 shards, length/lane axes stay whole.
+  vstack_rules   stacked per-rule NFA tensors — replicate; they are the
+                 "model state" every shard matches against.
+  gram_constants sieve masks/vals — replicate.
+  probe_constants LUT/probe tables — replicate.
+
+`CONSTANT_FAMILIES` is the authority graftlint GL011 enforces: passing a
+non-replicated spec for one of these is a lint error, not a runtime
+surprise (GSPMD would "helpfully" insert an all-gather per batch).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from trivy_tpu.mesh.topology import DATA_AXIS
+
+# family -> spec template; DATA_AXIS entries are substituted with the
+# actual mesh axis names when a sharding is built.
+PLAN: dict[str, tuple[Any, ...]] = {
+    "coded_rows": (DATA_AXIS, None),
+    "hit_bitmaps": (DATA_AXIS, None),
+    "lane_tables": (DATA_AXIS,),
+    "stream_bytes": (None, None, DATA_AXIS, None),
+    "padded_classes": (None, DATA_AXIS, None),
+    "vstack_rules": (),
+    "gram_constants": (),
+    "probe_constants": (),
+}
+
+CONSTANT_FAMILIES = frozenset(
+    {"vstack_rules", "gram_constants", "probe_constants"}
+)
+
+
+def spec_for(family: str, mesh=None):
+    """PartitionSpec for `family`; hand-built meshes keep their own axis
+    names (every DATA_AXIS slot maps to the mesh's full axis tuple)."""
+    from jax.sharding import PartitionSpec
+
+    template = PLAN[family]
+    if mesh is not None and tuple(mesh.axis_names) != (DATA_AXIS,):
+        axes = tuple(mesh.axis_names)
+        template = tuple(
+            axes if t == DATA_AXIS else t for t in template
+        )
+    return PartitionSpec(*template)
+
+
+def sharding_for(mesh, family: str):
+    """NamedSharding placing `family` on `mesh` (None mesh -> None: the
+    unmeshed path passes plain arrays)."""
+    if mesh is None:
+        return None
+    from jax.sharding import NamedSharding
+
+    return NamedSharding(mesh, spec_for(family, mesh))
+
+
+def plan_table(mesh=None) -> dict[str, dict[str, Any]]:
+    """JSON-able plan for `GET /debug/mesh`: family -> spec + role."""
+    out: dict[str, dict[str, Any]] = {}
+    for family, template in PLAN.items():
+        out[family] = {
+            "spec": list(template),
+            "replicated": family in CONSTANT_FAMILIES,
+        }
+    return out

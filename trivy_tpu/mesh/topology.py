@@ -1,0 +1,252 @@
+"""Mesh discovery: one `get_mesh()` for the whole device path.
+
+Before this module, mesh selection had drifted into per-site probes:
+`engine/device.py` consulted the mesh for the sieve step only, the fused
+verify excluded meshes outright, and four call sites asked
+`jax.devices()` / `jax.default_backend()` independently.  Everything now
+funnels through here so sieve, lane derive, fused verify, the serve
+scheduler's capacity sizing, and `/debug/mesh` agree on exactly one
+answer.
+
+Policy (the "honest single-device fallback"):
+
+  * `TRIVY_TPU_MESH=8` / `=2x4` (or the `--mesh` flag, threaded in as
+    `override`) builds a 1-D ``("data",)`` mesh over the first N local
+    devices.  An ``NxM`` spec names the physical slice shape but
+    flattens to N*M — the partition plan (mesh/plan.py) is pure data
+    parallelism, so one axis is all the engine shards over.
+  * Unset / ``auto``: a mesh is auto-built only on a real multi-chip
+    TPU backend.  CPU hosts are *not* auto-meshed even when XLA fakes
+    8 host devices (the tests' forced-host-device vehicle) — an 8-way
+    CPU "mesh" is a test rig you opt into, not a topology you have.
+  * ``none`` / ``off`` / ``1`` / a single-device host: no mesh (None),
+    and every consumer takes its unsharded path.
+
+Mesh construction is memoised per spec so repeated engine constructions
+reuse the identical `Mesh` object (identity matters: jitted sharded
+callables are cached against it).
+
+The module also owns the per-device OCCUPANCY ledger: the staging path
+records how many real rows/bytes each device received per batch, and
+`serve.scheduler.snapshot()` / `GET /debug/mesh` read it back.  That is
+what the MULTICHIP bench's per-chip scaling efficiency is computed from.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from trivy_tpu import lockcheck
+
+DATA_AXIS = "data"
+
+_LOCK = lockcheck.make_lock("mesh.topology")
+_MESH_CACHE: dict[str, Any] = {}  # owner: _LOCK (spec key -> Mesh | None)
+_ACTIVE_DEVICES = 1  # owner: _LOCK (device count of the widest mesh built)
+_OCCUPANCY: dict[str, dict[str, int]] = {}  # owner: _LOCK
+
+
+def parse_spec(spec: str | None) -> int | None:
+    """`TRIVY_TPU_MESH` grammar -> device count.
+
+    ``""``/``auto`` -> None (discover), ``none``/``off``/``0`` -> 1
+    (explicitly unmeshed), ``N`` -> N, ``NxM`` -> N*M.  Raises
+    ValueError on anything else — a typo'd topology must not silently
+    fall back to single-device.
+    """
+    if spec is None:
+        return None
+    s = str(spec).strip().lower()
+    if s in ("", "auto"):
+        return None
+    if s in ("none", "off", "0"):
+        return 1
+    try:
+        dims = [int(p) for p in s.split("x")]
+    except ValueError:
+        raise ValueError(f"bad mesh spec {spec!r}: want N, NxM, auto or none")
+    if not dims or any(d <= 0 for d in dims):
+        raise ValueError(f"bad mesh spec {spec!r}: dims must be positive")
+    n = 1
+    for d in dims:
+        n *= d
+    return n
+
+
+def get_mesh(override: str | None = None):
+    """The process's scan mesh, or None for the single-device path.
+
+    `override` (the `--mesh` flag) wins over `TRIVY_TPU_MESH`; both win
+    over auto-discovery.  Requesting more devices than the backend has
+    raises — see the module docstring for the full policy.
+    """
+    spec = override if override not in (None, "") else os.environ.get(
+        "TRIVY_TPU_MESH", ""
+    )
+    want = parse_spec(spec)
+    key = "auto" if want is None else str(want)
+    with _LOCK:
+        if key in _MESH_CACHE:
+            return _MESH_CACHE[key]
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if want is None:
+        # Auto: only a real multi-chip accelerator earns a mesh.
+        want = len(devices) if devices[0].platform == "tpu" else 1
+    if want > len(devices):
+        raise ValueError(
+            f"mesh spec {spec!r} wants {want} devices, backend has "
+            f"{len(devices)}"
+        )
+    mesh = None
+    if want > 1:
+        mesh = Mesh(np.asarray(devices[:want]), axis_names=(DATA_AXIS,))
+    with _LOCK:
+        _MESH_CACHE[key] = mesh
+        if mesh is not None:
+            global _ACTIVE_DEVICES
+            _ACTIVE_DEVICES = max(_ACTIVE_DEVICES, want)
+    return mesh
+
+
+def clear_cache() -> None:
+    """Forget memoised meshes + occupancy (tests that flip TRIVY_TPU_MESH)."""
+    global _ACTIVE_DEVICES
+    with _LOCK:
+        _MESH_CACHE.clear()
+        _OCCUPANCY.clear()
+        _ACTIVE_DEVICES = 1
+
+
+def mesh_device_count(mesh) -> int:
+    """Total devices in `mesh` (1 for None — the unmeshed path)."""
+    if mesh is None:
+        return 1
+    n = 1
+    for ax in mesh.axis_names:
+        n *= int(mesh.shape[ax])
+    return n
+
+
+def mesh_devices(mesh) -> list:
+    """The mesh's devices in data-axis order ([] for None)."""
+    if mesh is None:
+        return []
+    return [d for d in mesh.devices.flat]
+
+
+def device_tag(device) -> str:
+    """"platform:id" — the same key shape obs/memwatch uses, so the
+    occupancy ledger and the HBM ledger join on device."""
+    return f"{device.platform}:{getattr(device, 'id', 0)}"
+
+
+def capacity_hint() -> int:
+    """Device-count multiplier for batch sizing, WITHOUT booting JAX.
+
+    The serve scheduler calls this on every batch sweep; it must stay
+    cheap and must not initialise a backend at server construction.  It
+    reports the widest mesh actually built this process, else the
+    explicit TRIVY_TPU_MESH spec (a pure string parse), else 1.
+    """
+    with _LOCK:
+        if _ACTIVE_DEVICES > 1:
+            return _ACTIVE_DEVICES
+    try:
+        want = parse_spec(os.environ.get("TRIVY_TPU_MESH", ""))
+    except ValueError:
+        return 1
+    return want if want and want > 1 else 1
+
+
+# -- centralised platform probes --------------------------------------------
+# The per-site `jax.devices()[0].platform` / `jax.default_backend()`
+# probes these replace were exactly the drift the mesh plane exists to
+# remove: every consumer now asks the same module the mesh came from.
+
+
+def platform() -> str:
+    """Backend platform of device 0 ("cpu", "tpu", "gpu")."""
+    import jax
+
+    return jax.devices()[0].platform
+
+
+def is_tpu() -> bool:
+    return platform() == "tpu"
+
+
+def backend_is_tpu() -> bool:
+    """Default-backend check (donation/dtype gates key off this)."""
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+# -- per-device occupancy ----------------------------------------------------
+
+
+def record_occupancy(device: str, rows: int, nbytes: int) -> None:
+    """Ledger one staged shard: `rows` real rows / `nbytes` on `device`."""
+    with _LOCK:
+        d = _OCCUPANCY.setdefault(
+            device, {"rows": 0, "nbytes": 0, "batches": 0}
+        )
+        d["rows"] += int(rows)
+        d["nbytes"] += int(nbytes)
+        d["batches"] += 1
+
+
+def reset_occupancy() -> None:
+    """Zero the occupancy ledger only (bench timed windows, tests) —
+    memoised meshes survive, so jitted sharded callables stay cached."""
+    with _LOCK:
+        _OCCUPANCY.clear()
+
+
+def occupancy_snapshot() -> dict[str, dict[str, int]]:
+    """Cumulative per-device staging occupancy since process start."""
+    with _LOCK:
+        return {dev: dict(d) for dev, d in _OCCUPANCY.items()}
+
+
+def occupancy_efficiency() -> float:
+    """Work-share balance across devices: total_rows / (n * max_rows).
+
+    1.0 = perfectly balanced shards; padding or skew pulls it down.
+    This is the per-chip scaling efficiency BENCH_MULTICHIP gates on
+    (wall-clock can't scale on a single-core CI host, work share can).
+    """
+    snap = occupancy_snapshot()
+    if not snap:
+        return 1.0
+    rows = [d["rows"] for d in snap.values()]
+    peak = max(rows)
+    if peak <= 0:
+        return 1.0
+    return sum(rows) / (len(rows) * peak)
+
+
+def describe(mesh=None, spec: str | None = None) -> dict:
+    """JSON-able topology block for `GET /debug/mesh` and the bench."""
+    if mesh is None and (spec or os.environ.get("TRIVY_TPU_MESH")):
+        try:
+            mesh = get_mesh(spec)
+        except Exception:  # bad spec or a backend that can't boot
+            mesh = None
+    n = mesh_device_count(mesh)
+    body: dict[str, Any] = {
+        "enabled": mesh is not None,
+        "devices": n,
+        "spec": spec or os.environ.get("TRIVY_TPU_MESH", ""),
+        "axis_names": list(mesh.axis_names) if mesh is not None else [],
+        "device_tags": [device_tag(d) for d in mesh_devices(mesh)],
+    }
+    if mesh is not None:
+        body["platform"] = mesh_devices(mesh)[0].platform
+    return body

@@ -20,9 +20,11 @@ per-registry.  Consumers:
   incident shows the gate state that routed it.
 
 Reasons are a bounded enum (metric-label safe): `link-wide`,
-`link-narrow`, `no-device`, `forced`, `fallback`, `breaker` (a runtime
-circuit-breaker transition re-routing batches — see engine/breaker.py
-and the serve scheduler's failure domains).
+`link-narrow`, `mesh-wide` (the multi-device mesh profile cleared the
+bar — the record carries `profile`/`devices` so the aggregate-rate
+pricing is auditable), `no-device`, `forced`, `fallback`, `breaker` (a
+runtime circuit-breaker transition re-routing batches — see
+engine/breaker.py and the serve scheduler's failure domains).
 
 Backends are likewise bounded: `dfa`, `device` (legacy flag-map
 stream), `fused` (device-resident verify — lane verdicts resolve
@@ -54,6 +56,8 @@ def record(
     requested: str,
     backend: str,
     reason: str,
+    profile: str | None = None,
+    devices: int | None = None,
     link_mb_per_sec: float | None = None,
     link_rtt_s: float | None = None,
     h2d_ratio: float | None = None,
@@ -79,6 +83,10 @@ def record(
         "reason": reason,
         "margin": margin,
     }
+    if profile is not None:
+        rec["profile"] = profile
+    if devices is not None:
+        rec["devices"] = devices
     if link_mb_per_sec is not None:
         rec["link"] = {
             "mb_per_sec": link_mb_per_sec,

@@ -404,24 +404,31 @@ DEVICE_PHASE_BUCKETS = (
 )
 
 _DEVICE_PHASE_LOCK = lockcheck.make_lock("obs.metrics.device_phase")
-_DEVICE_PHASE_PENDING: list[tuple[str, float]] = []  # owner: _DEVICE_PHASE_LOCK
+_DEVICE_PHASE_PENDING: list[tuple[str, str, float]] = []  # owner: _DEVICE_PHASE_LOCK
 # Tracing on with nothing scraping (CLI scans) must not grow unbounded:
 # beyond the cap the oldest samples drop — the scrape path is best-effort
 # by design, the span tree keeps the full record.
 _DEVICE_PHASE_MAX_PENDING = 4096
 
 
-def record_device_phase(kernel: str, seconds: float) -> None:
-    """Queue one per-kernel fenced timing for the next scrape drain."""
+def record_device_phase(kernel: str, seconds: float, device: str = "") -> None:
+    """Queue one per-kernel fenced timing for the next scrape drain.
+
+    `device` is the bounded device label ("cpu:0", "tpu:3", "mesh[8]" for
+    a sharded dispatch, "" when unknown) — bounded by construction: values
+    come only from the mesh topology's device tags plus the one mesh[N]
+    aggregate, the cardinality-governor shape.  Positional callers predate
+    the label and land in the "" series."""
     with _DEVICE_PHASE_LOCK:
-        _DEVICE_PHASE_PENDING.append((kernel, seconds))
+        _DEVICE_PHASE_PENDING.append((kernel, device, seconds))
         overflow = len(_DEVICE_PHASE_PENDING) - _DEVICE_PHASE_MAX_PENDING
         if overflow > 0:
             del _DEVICE_PHASE_PENDING[:overflow]
 
 
-def drain_device_phases() -> list[tuple[str, float]]:
-    """Take every pending (kernel, seconds) sample (collect-hook seat)."""
+def drain_device_phases() -> list[tuple[str, str, float]]:
+    """Take every pending (kernel, device, seconds) sample (collect-hook
+    seat)."""
     with _DEVICE_PHASE_LOCK:
         out = list(_DEVICE_PHASE_PENDING)
         _DEVICE_PHASE_PENDING.clear()
@@ -436,6 +443,27 @@ class _NoopPhase:
 
 
 _NOOP_PHASE = _NoopPhase()
+
+
+def _phase_device_label(arrays) -> str:
+    """Device label for a fenced section, from its first output that
+    knows where it lives: one device -> its "platform:id" tag, a sharded
+    array -> "mesh[N]" (one aggregate series per mesh size, never one per
+    device-set permutation — that keeps the label bounded)."""
+    for a in arrays:
+        devs = getattr(a, "devices", None)
+        if devs is None:
+            continue
+        try:
+            ds = list(devs()) if callable(devs) else list(devs)
+        except Exception:  # graftlint: swallow(labeling never degrades the scan)
+            continue
+        if len(ds) == 1:
+            d = ds[0]
+            return f"{d.platform}:{d.id}"
+        if len(ds) > 1:
+            return f"mesh[{len(ds)}]"
+    return ""
 
 
 class _DevicePhase:
@@ -472,7 +500,7 @@ class _DevicePhase:
                     # a failed fence degrades the timing, never the scan
                     pass
         dt = time.perf_counter() - self._t0
-        record_device_phase(self.kernel, dt)
+        record_device_phase(self.kernel, dt, device=_phase_device_label(flat))
         self._span.__exit__(None, None, None)
         return dt
 

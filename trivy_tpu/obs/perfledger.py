@@ -61,9 +61,9 @@ def _git_sha() -> str:
 
 def _platform() -> str:
     try:
-        import jax
+        from trivy_tpu.mesh import topology as mesh_topology
 
-        return str(jax.devices()[0].platform)
+        return mesh_topology.platform()
     except Exception:
         return sys.platform
 

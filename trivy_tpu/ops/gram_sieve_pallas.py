@@ -355,7 +355,11 @@ class PallasGramSieve:
             )
         self.block_rows = block_rows
         if interpret is None:
-            interpret = jax.devices()[0].platform != "tpu"
+            # One platform probe for the whole device path (mesh/topology
+            # owns it) — per-site jax.devices() calls drift.
+            from trivy_tpu.mesh import topology as mesh_topology
+
+            interpret = not mesh_topology.is_tpu()
         self.interpret = interpret
         self._weights: dict[int, tuple[jax.Array, jax.Array]] = {}
 

@@ -47,6 +47,8 @@ from trivy_tpu.cache.store import (
     MemoryCache,
 )
 from trivy_tpu.deadline import ScanTimeoutError
+from trivy_tpu.mesh import plan as mesh_plan
+from trivy_tpu.mesh import topology as mesh_topology
 from trivy_tpu.obs import flight as obs_flight
 from trivy_tpu.obs import gatelog
 from trivy_tpu.obs import memwatch as obs_memwatch
@@ -204,10 +206,20 @@ class ScanServer:
         self._m_device_phase = self.registry.histogram(
             "trivy_tpu_device_phase_seconds",
             "fenced per-kernel device sections (tracing-enabled runs only)",
-            ("kernel",),
+            ("kernel", "device"),
             buckets=obs_metrics.DEVICE_PHASE_BUCKETS,
         )
         self.registry.add_collect_hook(self._collect_device_phases)
+        # Mesh posture: how many devices the partition plan spans (1 =
+        # unmeshed).  Refreshed each scrape from the topology so a
+        # late-built engine's mesh shows up without a server restart.
+        self._m_mesh_devices = self.registry.gauge(
+            "trivy_tpu_mesh_devices",
+            "device count of the active mesh partition plan (1 = unmeshed)",
+        )
+        self.registry.add_collect_hook(
+            lambda: self._m_mesh_devices.set(mesh_topology.capacity_hint())
+        )
         # Build/ruleset identity: one series per RESIDENT ruleset, rebuilt
         # from live state at each scrape (clear + re-set), so evicted
         # digests stop scraping instead of pinning stale 1s forever.
@@ -497,12 +509,15 @@ class ScanServer:
 
     def _collect_device_phases(self) -> None:
         """Registry collect hook: drain pending fenced per-kernel samples
-        into trivy_tpu_device_phase_seconds{kernel}.  Samples only exist
-        while tracing is enabled; the drain is destructive, so exactly one
-        scraping server observes each sample."""
-        for kernel, seconds in obs_metrics.drain_device_phases():
+        into trivy_tpu_device_phase_seconds{kernel,device}.  Samples only
+        exist while tracing is enabled; the drain is destructive, so
+        exactly one scraping server observes each sample.  Both labels
+        are bounded by construction (the kernel enum, plus device tags
+        from the topology and the one mesh[N] aggregate) — the governor
+        pattern GL007 asks for."""
+        for kernel, device, seconds in obs_metrics.drain_device_phases():
             self._m_device_phase.labels(  # graftlint: ignore[GL007]
-                kernel=kernel
+                kernel=kernel, device=device
             ).observe(seconds)
 
     def _collect_build_info(self) -> None:
@@ -557,6 +572,25 @@ class ScanServer:
                     (meas - est) / est if est > 0 else 0.0
                 ),
             }
+        return report
+
+    def mesh_report(self) -> dict:
+        """The /debug/mesh body: the mesh plane's full posture — topology
+        (device tags, spec, platform), the partition-plan table (tensor
+        family -> spec + replicated/sharded role), per-device occupancy
+        (rows/bytes/batches each staging lane absorbed, plus the scaling
+        efficiency that summarizes the skew), and each device's resident
+        attributed bytes from the memory ledger.  Answers sane JSON on an
+        unmeshed host too: enabled=false, devices=1, empty occupancy."""
+        report = mesh_topology.describe()
+        report["plan"] = mesh_plan.plan_table()
+        report["occupancy"] = mesh_topology.occupancy_snapshot()
+        report["scaling_efficiency"] = mesh_topology.occupancy_efficiency()
+        mem = obs_memwatch.snapshot()
+        report["resident_bytes"] = {
+            dev: info.get("attributed_bytes", 0)
+            for dev, info in mem.get("devices", {}).items()
+        }
         return report
 
     def readiness(self) -> dict:
@@ -683,6 +717,8 @@ DEBUG_SURFACES = {
     "bytes, watermarks, pressure state, pool estimate reconciliation",
     "/debug/breaker": "device circuit-breaker state + failure-domain "
     "tallies (degraded/shed batches) and the armed fault plane",
+    "/debug/mesh": "mesh execution plane: topology, partition-plan table, "
+    "per-device occupancy and resident bytes, scaling efficiency",
 }
 
 
@@ -796,6 +832,10 @@ def _make_handler(server: ScanServer):
                 # Failure-domain posture: breaker state machine,
                 # degraded/shed tallies, armed chaos faults.
                 self._send(200, server.breaker_report())
+            elif route == "/debug/mesh":
+                # Mesh plane posture: topology + plan table + per-device
+                # occupancy/resident bytes (sane body when unmeshed).
+                self._send(200, server.mesh_report())
             elif route in ("/debug", "/debug/"):
                 # Index of every debug surface with its one-liner.
                 self._send(200, {"surfaces": DEBUG_SURFACES})

@@ -59,6 +59,7 @@ from trivy_tpu import deadline as _deadline
 from trivy_tpu import faults, lockcheck
 from trivy_tpu.deadline import ScanTimeoutError
 from trivy_tpu.engine.breaker import CircuitBreaker
+from trivy_tpu.mesh import topology as mesh_topology
 from trivy_tpu.obs import gatelog, memwatch
 from trivy_tpu.obs import metrics as obs_metrics
 from trivy_tpu.obs import trace as obs_trace
@@ -813,6 +814,11 @@ class BatchScheduler:
             done = False
             with self._not_empty:
                 now = time.monotonic()
+                # Fill-or-timeout sizes to mesh capacity: an N-device
+                # partition plan wants N shards' worth of rows per
+                # dispatch, so both the readiness threshold and the
+                # take cap scale by the device count (1 off-mesh).
+                cap_bytes = cfg.max_batch_bytes * mesh_topology.capacity_hint()
                 # Sweep expired tickets out of every lane first, so a
                 # doomed ticket never boards a batch and never holds a
                 # lane's window open.  Futures resolve after the lock
@@ -833,7 +839,7 @@ class BatchScheduler:
                     for lane in self._lanes.values()
                     if lane.q
                     and (
-                        lane.nbytes >= cfg.max_batch_bytes
+                        lane.nbytes >= cap_bytes
                         or now >= lane.opened_at + window_s
                     )
                 ]
@@ -841,7 +847,7 @@ class BatchScheduler:
                     lane = self._pick_lane(ready)
                     batch = []
                     while lane.q and (
-                        not batch or nbytes < cfg.max_batch_bytes
+                        not batch or nbytes < cap_bytes
                     ):
                         t = lane.q.popleft()
                         batch.append(t)
@@ -1109,6 +1115,13 @@ class BatchScheduler:
             "breaker": self.breaker.snapshot(),
             "degraded_batches": self.stats.degraded_batches,
             "shed_retries": self.stats.shed_retries,
+            # Mesh posture: how many devices batches are sized for, and
+            # what each one has actually absorbed (rows/bytes/batches per
+            # device tag) — the skew here is the scaling-efficiency story.
+            "mesh": {
+                "devices": mesh_topology.capacity_hint(),
+                "occupancy": mesh_topology.occupancy_snapshot(),
+            },
         }
         if faults.active():
             out["faults"] = faults.snapshot()
