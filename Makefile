@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test lint lint-changed lockcheck smoke serve-smoke obs-smoke slo-smoke tenancy-smoke mem-smoke chaos-smoke mesh-smoke bench bench-link bench-verify checks-corpus rules-cache perf-gate
+.PHONY: test lint lint-changed lockcheck smoke serve-smoke obs-smoke slo-smoke tenancy-smoke mem-smoke chaos-smoke mesh-smoke cache-smoke bench bench-link bench-verify checks-corpus rules-cache perf-gate
 
 # Tier-1: the suite the driver holds the repo to (fast, CPU, no slow marks).
 # Lint runs first — a graftlint finding fails the build before pytest
@@ -14,6 +14,7 @@ test: lint
 		--continue-on-collection-errors -p no:cacheprovider
 	$(MAKE) chaos-smoke
 	$(MAKE) mesh-smoke
+	$(MAKE) cache-smoke
 	$(MAKE) perf-gate
 
 # Static analysis: graftlint (project rules GL001-GL011, always available)
@@ -78,7 +79,7 @@ obs-smoke:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
 		BENCH_IMAGE=0 BENCH_TENANT=0 BENCH_MEM=0 BENCH_FAULT=0 \
-		BENCH_MULTICHIP=0 $(PY) bench.py --smoke
+		BENCH_MULTICHIP=0 BENCH_CACHE=0 $(PY) bench.py --smoke
 
 # SLO / flight-recorder smoke: boot the server with a deliberately tight
 # latency objective, drive mixed-tenant traffic with one induced breach,
@@ -102,7 +103,7 @@ tenancy-smoke:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
 		BENCH_IMAGE=0 BENCH_OBS=0 BENCH_MEM=0 BENCH_FAULT=0 \
-		BENCH_MULTICHIP=0 $(PY) bench.py --smoke
+		BENCH_MULTICHIP=0 BENCH_CACHE=0 $(PY) bench.py --smoke
 
 # Device-memory observatory smoke: memwatch ledger units, pool
 # estimate-vs-measured reconciliation, pressure watermark e2e
@@ -115,7 +116,7 @@ mem-smoke:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
 		BENCH_IMAGE=0 BENCH_TENANT=0 BENCH_OBS=0 BENCH_FAULT=0 \
-		BENCH_MULTICHIP=0 $(PY) bench.py --smoke
+		BENCH_MULTICHIP=0 BENCH_CACHE=0 $(PY) bench.py --smoke
 
 # Chaos smoke: the fault-injection serve suite (tests/test_chaos_serve.py,
 # -m chaos).  Arms the in-repo fault plane on the dispatch/device/rpc
@@ -133,6 +134,20 @@ chaos-smoke:
 mesh-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_mesh.py \
 		-m mesh_smoke -q -p no:cacheprovider
+
+# Fleet result-cache smoke (trivy_tpu/cache/): the cold->warm image
+# re-scan must do zero device dispatches and zero analyzer re-runs with
+# byte-identical findings, and a ruleset-digest change must invalidate
+# exactly the affected entries (-m cache_smoke) — then a BENCH_CACHE-only
+# bench run (warm hit rate 1.0, zero-dispatch warm pass, cold/warm report
+# parity, wall speedup on the single-JSON-line contract).
+cache-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_cache_tiered.py \
+		-m cache_smoke -q -p no:cacheprovider && \
+	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
+		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
+		BENCH_IMAGE=0 BENCH_TENANT=0 BENCH_OBS=0 BENCH_MEM=0 \
+		BENCH_FAULT=0 BENCH_MULTICHIP=0 $(PY) bench.py --smoke
 
 # Performance regression gate: one smoke bench run (heavy sections off,
 # primary corpus only) appends to a throwaway ledger, then
@@ -162,7 +177,7 @@ bench:
 bench-link:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 BENCH_IMAGE=0 \
-		BENCH_TENANT=0 BENCH_FAULT=0 BENCH_MULTICHIP=0 \
+		BENCH_TENANT=0 BENCH_FAULT=0 BENCH_MULTICHIP=0 BENCH_CACHE=0 \
 		BENCH_FILES=2000 BENCH_PARITY=sample \
 		$(PY) bench.py
 
@@ -175,7 +190,7 @@ bench-verify:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_LINK=0 \
 		BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 BENCH_IMAGE=0 \
 		BENCH_TENANT=0 BENCH_MEM=0 BENCH_FAULT=0 BENCH_MULTICHIP=0 \
-		$(PY) bench.py --smoke
+		BENCH_CACHE=0 $(PY) bench.py --smoke
 
 # Precompile the builtin ruleset into the registry cache (trivy_tpu/registry/)
 # so every later scan/server process warm-starts without compiling rules.

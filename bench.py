@@ -577,20 +577,18 @@ def bench_license(n_files: int = 2000, n_license: int = 300) -> dict:
     }
 
 
-def bench_image(n_layers: int = 20, files_per_layer: int = 50) -> dict:
-    """BASELINE config #2 shape: the container-image path — docker-archive
-    load, per-layer unpack, applier squash (whiteouts/opaque), analyzer
-    batch, secret scan — over ~n_layers x files_per_layer blobs."""
+def _synth_docker_archive(
+    td: str, n_layers: int, files_per_layer: int, seed: int = 11
+) -> tuple[str, int]:
+    """Synthesize a docker-archive tar (config + manifest + per-layer
+    tars, AWS keys sparsely planted) under `td`; returns (path, planted).
+    Shared by bench_image and bench_cache."""
     import hashlib
     import io
     import json as _json
     import tarfile
-    import tempfile
 
-    from trivy_tpu.cli import Options
-    from trivy_tpu.commands.run import run as run_cmd
-
-    rng = np.random.default_rng(11)
+    rng = np.random.default_rng(seed)
 
     def layer_tar(files: dict[str, bytes]) -> bytes:
         buf = io.BytesIO()
@@ -635,16 +633,30 @@ def bench_image(n_layers: int = 20, files_per_layer: int = 50) -> dict:
             "Layers": [f"l{i}/layer.tar" for i in range(n_layers)],
         }
     ]
+    path = os.path.join(td, "image.tar")
+    with tarfile.open(path, "w") as tf:
+        for name, data in [
+            (config_name, raw_config),
+            ("manifest.json", _json.dumps(manifest).encode()),
+        ] + [(f"l{i}/layer.tar", l) for i, l in enumerate(layers)]:
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    return path, planted
+
+
+def bench_image(n_layers: int = 20, files_per_layer: int = 50) -> dict:
+    """BASELINE config #2 shape: the container-image path — docker-archive
+    load, per-layer unpack, applier squash (whiteouts/opaque), analyzer
+    batch, secret scan — over ~n_layers x files_per_layer blobs."""
+    import json as _json
+    import tempfile
+
+    from trivy_tpu.cli import Options
+    from trivy_tpu.commands.run import run as run_cmd
+
     with tempfile.TemporaryDirectory() as td:
-        path = os.path.join(td, "image.tar")
-        with tarfile.open(path, "w") as tf:
-            for name, data in [
-                (config_name, raw_config),
-                ("manifest.json", _json.dumps(manifest).encode()),
-            ] + [(f"l{i}/layer.tar", l) for i, l in enumerate(layers)]:
-                info = tarfile.TarInfo(name)
-                info.size = len(data)
-                tf.addfile(info, io.BytesIO(data))
+        path, planted = _synth_docker_archive(td, n_layers, files_per_layer)
         out_path = os.path.join(td, "report.json")
         best = float("inf")
         for _ in range(2):
@@ -671,6 +683,78 @@ def bench_image(n_layers: int = 20, files_per_layer: int = 50) -> dict:
         "findings": findings,
         "wall_s": round(best, 3),
         "blobs_per_sec": round(blobs / best, 1),
+    }
+
+
+def bench_cache(n_layers: int = 12, files_per_layer: int = 40) -> dict:
+    """Fleet result cache (trivy_tpu/cache/): cold vs warm image re-scan
+    through the memory->fs tier chain.  The warm pass must serve every
+    blob verdict from the result cache — artifact-plane hit rate 1.0,
+    zero layer/config analyzer runs, zero device dispatches — with a
+    report identical to the cold scan; the cold/warm wall ratio is the
+    fleet economics the cache exists for."""
+    import json as _json
+    import tempfile
+
+    from trivy_tpu.cache import stats as cache_stats
+    from trivy_tpu.cli import Options
+    from trivy_tpu.commands.run import run as run_cmd
+
+    with tempfile.TemporaryDirectory() as td:
+        path, planted = _synth_docker_archive(td, n_layers, files_per_layer)
+        cache_dir = os.path.join(td, "cache")
+
+        def scan(tag: str) -> tuple[float, dict]:
+            out_path = os.path.join(td, f"report-{tag}.json")
+            opts = Options(
+                target=path,
+                scanners=["secret"],
+                format="json",
+                output=out_path,
+                cache_backend="fs",
+                cache_dir=cache_dir,
+            )
+            t0 = time.perf_counter()
+            code = run_cmd(opts, "image")
+            wall = time.perf_counter() - t0
+            assert code == 0, code
+            return wall, _json.loads(open(out_path).read())
+
+        cache_stats.clear()
+        cold_wall, cold_report = scan("cold")
+        cold_events = cache_stats.events()
+
+        cache_stats.clear()
+        warm_wall, warm_report = scan("warm")
+        warm_events = cache_stats.events()
+        tallies = cache_stats.request_tallies()
+
+    a_hit = tallies.get(("artifact", "hit"), 0)
+    a_miss = tallies.get(("artifact", "miss"), 0)
+    findings = sum(
+        len(r.get("Secrets") or []) for r in cold_report.get("Results") or []
+    )
+    assert findings >= planted, (findings, planted)
+    return {
+        "layers": n_layers,
+        "blobs": n_layers * files_per_layer,
+        "planted": planted,
+        "findings": findings,
+        "cold_wall_s": round(cold_wall, 3),
+        "warm_wall_s": round(warm_wall, 3),
+        "speedup": round(cold_wall / warm_wall, 2) if warm_wall else None,
+        "cold_layer_analysis": cold_events.get("layer_analysis", 0),
+        "warm_hit_rate": (
+            round(a_hit / (a_hit + a_miss), 3) if a_hit + a_miss else None
+        ),
+        "warm_zero_dispatch": int(warm_events.get("device_dispatch", 0) == 0),
+        "warm_zero_analysis": int(
+            warm_events.get("layer_analysis", 0) == 0
+            and warm_events.get("config_analysis", 0) == 0
+        ),
+        "parity_identical": int(
+            cold_report.get("Results") == warm_report.get("Results")
+        ),
     }
 
 
@@ -1604,6 +1688,16 @@ def _compact_detail(detail: dict) -> dict:
             )
             if k in mc
         }
+    ca = detail.get("cache")
+    if isinstance(ca, dict):
+        c["cache"] = {
+            k: ca[k]
+            for k in (
+                "warm_hit_rate", "warm_zero_dispatch", "warm_zero_analysis",
+                "parity_identical", "speedup", "error",
+            )
+            if k in ca
+        }
     vb = detail.get("verify_backend")
     if isinstance(vb, dict):
         vc = {
@@ -1884,6 +1978,15 @@ def main() -> None:
             detail["image"] = bench_image()
         except Exception as e:
             detail["image"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if os.environ.get("BENCH_CACHE", "1") == "1":
+        # Fleet result cache (trivy_tpu/cache/): cold vs warm image
+        # re-scan — warm hit rate, zero-dispatch/zero-analyzer warm pass,
+        # cold/warm report parity, wall speedup.
+        try:
+            detail["cache"] = bench_cache(6, 25) if SMOKE else bench_cache()
+        except Exception as e:
+            detail["cache"] = {"error": f"{type(e).__name__}: {e}"}
 
     try:
         import resource

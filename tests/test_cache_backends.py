@@ -208,17 +208,39 @@ def test_s3_cache_roundtrip(s3_cache):
     )
 
 
-def test_cache_backend_selection(redis_url):
+def test_cache_backend_selection(redis_url, tmp_path):
+    from trivy_tpu.cache.store import FSCache, MemoryCache
+    from trivy_tpu.cache.tiered import TieredCache
     from trivy_tpu.commands.run import Options, init_cache
 
+    # Remote backends sit behind local tiers now: memory first (FS too
+    # when --cache-dir is set), the remote last.
     cache = init_cache(Options(cache_backend=redis_url))
-    assert isinstance(cache, RedisCache)
+    assert isinstance(cache, TieredCache)
+    backends = [t.backend for t in cache.tiers]
+    assert isinstance(backends[0], MemoryCache)
+    assert isinstance(backends[-1], RedisCache)
     cache.close()
-    from trivy_tpu.cache.store import MemoryCache
+
+    cache = init_cache(
+        Options(cache_backend=redis_url, cache_dir=str(tmp_path))
+    )
+    assert [type(t.backend) for t in cache.tiers] == [
+        MemoryCache, FSCache, RedisCache,
+    ]
+    cache.close()
 
     assert isinstance(
         init_cache(Options(cache_backend="memory")), MemoryCache
     )
+    fs_tiers = init_cache(
+        Options(cache_backend="fs", cache_dir=str(tmp_path))
+    )
+    assert isinstance(fs_tiers, TieredCache)
+    assert [type(t.backend) for t in fs_tiers.tiers] == [
+        MemoryCache, FSCache,
+    ]
+    fs_tiers.close()
 
 
 def test_scan_through_redis_cache(redis_url, tmp_path):

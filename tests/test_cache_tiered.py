@@ -1,0 +1,534 @@
+"""Fleet result cache tests: the tiered chain's degrade ladder, the
+FSCache hardening satellites (injective keys, self-heal, exists fast
+path), the per-blob ScanResultCache keying, and the cold->warm image
+cache-smoke that pins the headline claim — a fully-warm re-scan performs
+zero device dispatches and zero analyzer re-runs with byte-identical
+findings (ISSUE 15; Trivy's pkg/fanal/cache split).
+
+`make cache-smoke` runs the `cache_smoke`-marked tests; the chaos-marked
+seam test rides `make chaos-smoke` with the rest of the fault plane.
+"""
+
+import json
+import socketserver
+import threading
+import time
+
+import pytest
+
+from trivy_tpu import faults
+from trivy_tpu.atypes import BLOB_JSON_SCHEMA_VERSION, ArtifactInfo, BlobInfo
+from trivy_tpu.cache import (
+    FSCache,
+    MemoryCache,
+    ScanResultCache,
+    TieredCache,
+    content_digest,
+    result_key,
+)
+from trivy_tpu.cache import stats as cache_stats
+from trivy_tpu.ftypes import Secret
+
+from test_cache_backends import _MiniRedisHandler
+
+
+@pytest.fixture()
+def redis_url():
+    _MiniRedisHandler.store = {}
+    srv = socketserver.ThreadingTCPServer(
+        ("127.0.0.1", 0), _MiniRedisHandler
+    )
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"redis://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_stats():
+    cache_stats.clear()
+    yield
+    cache_stats.clear()
+
+
+def _blob(diff_id="sha256:abc") -> BlobInfo:
+    return BlobInfo(diff_id=diff_id)
+
+
+# ---------------------------------------------------------------------------
+# FSCache hardening satellites
+# ---------------------------------------------------------------------------
+
+
+def test_safe_key_collision_regression(tmp_path):
+    """`a/b` and `a:b` used to flatten onto the same file (silent
+    cross-contamination); the injective mapping keeps them apart."""
+    cache = FSCache(str(tmp_path))
+    cache.put_blob("a/b", _blob("sha256:slash"))
+    cache.put_blob("a:b", _blob("sha256:colon"))
+    assert cache.get_blob("a/b").diff_id == "sha256:slash"
+    assert cache.get_blob("a:b").diff_id == "sha256:colon"
+    # sha256 keys file under the bare hex digest (readable layout)
+    digest_key = "sha256:" + "ab" * 32
+    cache.put_blob(digest_key, _blob())
+    assert cache._path("blob", digest_key).endswith(("ab" * 32) + ".json")
+
+
+def test_safe_key_legacy_fallback_read(tmp_path):
+    """Entries written by older processes under the flattened name stay
+    readable without a migration."""
+    cache = FSCache(str(tmp_path))
+    legacy = cache._legacy_path("blob", "sha256:deadbeef")
+    with open(legacy, "w", encoding="utf-8") as f:
+        json.dump(_blob("sha256:legacy").to_json(), f)
+    assert cache.get_blob("sha256:deadbeef").diff_id == "sha256:legacy"
+    assert cache.exists("sha256:deadbeef")
+    cache.delete_blobs(["sha256:deadbeef"])
+    assert cache.get_blob("sha256:deadbeef") is None
+
+
+def test_fs_self_heal_corrupt_entry(tmp_path):
+    """A truncated/corrupt JSON file is deleted on first read (otherwise
+    it is a permanent re-miss) and counted as an eviction."""
+    cache = FSCache(str(tmp_path))
+    cache.put_blob("sha256:" + "aa" * 32, _blob())
+    path = cache._path("blob", "sha256:" + "aa" * 32)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("{not json")
+    assert cache.get_blob("sha256:" + "aa" * 32) is None
+    import os
+
+    assert not os.path.exists(path)
+    assert cache_stats.eviction_tallies().get("corrupt", 0) == 1
+
+
+def test_fs_self_heal_stale_schema(tmp_path):
+    """A stale-schema entry is reaped so exists() stops vouching for a
+    blob get_blob will never serve."""
+    cache = FSCache(str(tmp_path))
+    key = "sha256:" + "bb" * 32
+    doc = _blob().to_json()
+    doc["SchemaVersion"] = BLOB_JSON_SCHEMA_VERSION + 1
+    with open(cache._path("blob", key), "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    assert cache.exists(key)  # stat-only probe can't see the staleness
+    assert cache.get_blob(key) is None  # ...but the read self-heals
+    assert not cache.exists(key)
+    assert cache_stats.eviction_tallies().get("stale-schema", 0) == 1
+
+
+def test_exists_fast_path_drives_missing_blobs(tmp_path):
+    cache = FSCache(str(tmp_path))
+    cache.put_artifact("art", ArtifactInfo())
+    cache.put_blob("b1", _blob())
+    assert cache.exists("b1") and not cache.exists("b2")
+    missing_artifact, missing = cache.missing_blobs("art", ["b1", "b2"])
+    assert missing_artifact is False
+    assert missing == ["b2"]
+    mem = MemoryCache()
+    mem.put_blob("b1", _blob())
+    assert mem.exists("b1") and not mem.exists("nope")
+
+
+# ---------------------------------------------------------------------------
+# RESP pipeline + SigV4 vector
+# ---------------------------------------------------------------------------
+
+
+def test_resp_pipeline_roundtrip(redis_url):
+    from trivy_tpu.cache.redis import RespClient
+
+    c = RespClient(redis_url)
+    replies = c.pipeline(
+        [("SET", "k", "v"), ("GET", "k"), ("EXISTS", "k"), ("EXISTS", "nope")]
+    )
+    assert replies == ["OK", b"v", 1, 0]
+    c.close()
+
+
+def test_redis_pipelined_exists_missing_blobs(redis_url):
+    from trivy_tpu.cache.redis import RedisCache
+
+    cache = RedisCache(redis_url)
+    cache.put_artifact("art", ArtifactInfo())
+    cache.put_blob("b1", _blob())
+    assert cache.exists("b1") and not cache.exists("b9")
+    # One pipelined round trip for N blobs + the artifact probe.
+    missing_artifact, missing = cache.missing_blobs(
+        "art", ["b1", "b2", "b3"]
+    )
+    assert missing_artifact is False
+    assert missing == ["b2", "b3"]
+    cache.close()
+
+
+def test_sigv4_signing_vector():
+    """AWS's published SigV4 key-derivation vector (the docs' canonical
+    example): the chained HMAC in s3.py must reproduce it exactly."""
+    from trivy_tpu.cache.s3 import _sign
+
+    k = _sign(b"AWS4" + b"wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY", "20120215")
+    k = _sign(k, "us-east-1")
+    k = _sign(k, "iam")
+    k = _sign(k, "aws4_request")
+    assert k.hex() == (
+        "f4780e2d9f65fa895f9c67b32ce1baf0b0d8a43505a000a1a9e090d414db404d"
+    )
+
+
+# ---------------------------------------------------------------------------
+# TieredCache: promotion, degrade-on-error parity, negative TTL,
+# single-flight, write-behind
+# ---------------------------------------------------------------------------
+
+
+class _FlakyCache(MemoryCache):
+    """Backend whose reads/writes fail on demand (a remote tier outage)."""
+
+    cache_tier_name = "remote"
+
+    def __init__(self):
+        super().__init__()
+        self.failing = False
+        self.calls = 0
+
+    def _maybe_fail(self):
+        self.calls += 1
+        if self.failing:
+            raise ConnectionError("injected outage")
+
+    def get_blob(self, blob_id):
+        self._maybe_fail()
+        return super().get_blob(blob_id)
+
+    def put_blob(self, blob_id, info):
+        self._maybe_fail()
+        super().put_blob(blob_id, info)
+
+    def exists(self, blob_id):
+        self._maybe_fail()
+        return super().exists(blob_id)
+
+
+def test_tiered_promotes_hits_inward(tmp_path):
+    mem = MemoryCache()
+    fs = FSCache(str(tmp_path))
+    tc = TieredCache([mem, fs], write_behind=False)
+    fs.put_blob("sha256:" + "cc" * 32, _blob("sha256:fs"))
+    got = tc.get_blob("sha256:" + "cc" * 32)
+    assert got.diff_id == "sha256:fs"
+    # The hit was copied into the memory tier in front of it.
+    assert mem.get_blob("sha256:" + "cc" * 32).diff_id == "sha256:fs"
+    tallies = cache_stats.request_tallies()
+    assert tallies[("memory", "miss")] == 1
+    assert tallies[("fs", "hit")] == 1
+    tc.close()
+
+
+def test_tier_degrade_on_error_parity():
+    """A failing remote tier must cost outcomes nothing: same verdicts
+    as a healthy chain, errors eat the budget, and once over budget the
+    tier drops out of the walk entirely."""
+    flaky = _FlakyCache()
+    tc = TieredCache(
+        [MemoryCache(), flaky], error_budget=3, write_behind=False,
+        negative_ttl_s=0,
+    )
+    tc.put_blob("b1", _blob("sha256:v1"))
+    assert tc.get_blob("b1").diff_id == "sha256:v1"
+
+    flaky.failing = True
+    # Reads degrade to the healthy tier, never raise.
+    assert tc.get_blob("b1").diff_id == "sha256:v1"
+    assert tc.get_blob("missing") is None
+    # Writes land on the healthy tier too.
+    tc.put_blob("b2", _blob("sha256:v2"))
+    assert tc.get_blob("b2").diff_id == "sha256:v2"
+
+    # Burn the rest of the budget; the tier degrades out of the walk.
+    for _ in range(4):
+        tc.get_blob("missing")
+    snap = tc.snapshot()
+    remote = next(t for t in snap["tiers"] if t["name"] == "remote")
+    assert remote["degraded"] is True
+    assert remote["errors"] >= 3
+    assert "injected outage" in remote["last_error"]
+    calls_when_degraded = flaky.calls
+    tc.get_blob("b1")  # degraded tier is skipped, not retried
+    assert flaky.calls == calls_when_degraded
+    assert cache_stats.request_tallies()[("remote", "error")] >= 3
+    tc.close()
+
+
+def test_negative_entry_ttl():
+    inner = _FlakyCache()
+    tc = TieredCache([inner], negative_ttl_s=0.1, write_behind=False)
+    assert tc.get_blob("nope") is None
+    calls = inner.calls
+    assert tc.get_blob("nope") is None  # negative entry short-circuits
+    assert inner.calls == calls
+    assert cache_stats.request_tallies()[("results", "negative")] == 1
+    time.sleep(0.12)
+    assert tc.get_blob("nope") is None  # expired: backend consulted again
+    assert inner.calls > calls
+    assert cache_stats.eviction_tallies()["negative-expired"] == 1
+    # A put clears the negative entry immediately (no stale miss window).
+    tc.put_blob("nope", _blob("sha256:now"))
+    assert tc.get_blob("nope").diff_id == "sha256:now"
+    tc.close()
+
+
+def test_single_flight_dedups_concurrent_misses():
+    tc = TieredCache([MemoryCache()], write_behind=False)
+    calls = []
+    started = threading.Event()
+    release = threading.Event()
+
+    def slow_fn():
+        calls.append(1)
+        started.set()
+        release.wait(timeout=5)
+        return "verdict"
+
+    results = []
+
+    def leader():
+        results.append(tc.single_flight("k", slow_fn))
+
+    t1 = threading.Thread(target=leader)
+    t1.start()
+    started.wait(timeout=5)
+    followers = [
+        threading.Thread(
+            target=lambda: results.append(tc.single_flight("k", slow_fn))
+        )
+        for _ in range(3)
+    ]
+    for t in followers:
+        t.start()
+    time.sleep(0.05)  # let followers park on the flight
+    release.set()
+    t1.join(timeout=5)
+    for t in followers:
+        t.join(timeout=5)
+    assert results == ["verdict"] * 4
+    assert len(calls) == 1
+    assert tc.snapshot()["single_flight_dedup"] == 3
+    tc.close()
+
+
+def test_write_behind_flush_reaches_remote_tier():
+    remote = _FlakyCache()
+    tc = TieredCache([MemoryCache(), remote])
+    assert tc.snapshot()["write_behind"]["enabled"]
+    tc.put_blob("b1", _blob("sha256:wb"))
+    # The local tier is written synchronously; the remote write rides
+    # the daemon thread and lands by flush().
+    assert tc.flush(timeout_s=5.0)
+    assert remote.get_blob("b1").diff_id == "sha256:wb"
+    assert cache_stats.events().get("write_behind_flush", 0) == 1
+    tc.close()
+
+
+# ---------------------------------------------------------------------------
+# ScanResultCache keying
+# ---------------------------------------------------------------------------
+
+
+def test_result_key_components_all_matter():
+    k = result_key("sha256:blob", "sha256:rules", 1)
+    assert k != result_key("sha256:blob2", "sha256:rules", 1)
+    assert k != result_key("sha256:blob", "sha256:rules2", 1)
+    assert k != result_key("sha256:blob", "sha256:rules", 2)
+    assert k.startswith("sha256:")
+
+
+def test_ruleset_digest_change_invalidates_exactly_affected(tmp_path):
+    """A rules push (new digest) misses old entries; entries under the
+    old digest survive untouched for anything still pinning it."""
+    rc = ScanResultCache(TieredCache([MemoryCache()], write_behind=False))
+    blob = content_digest(b"layer bytes")
+    rc.put(blob, "sha256:rules-v1", Secret(file_path="a", findings=[]))
+    assert rc.get(blob, "sha256:rules-v1", "a") is not None
+    assert rc.get(blob, "sha256:rules-v2", "a") is None  # invalidated
+    assert rc.get(blob, "sha256:rules-v1", "a") is not None  # v1 intact
+    rc.close()
+
+
+def test_result_cache_hit_rehydrates_under_requester_path():
+    rc = ScanResultCache(MemoryCache())
+    blob = content_digest(b"same bytes")
+    rc.put(blob, "sha256:r", Secret(file_path="first/name.py", findings=[]))
+    hit = rc.get(blob, "sha256:r", "second/name.py")
+    assert hit is not None and hit.file_path == "second/name.py"
+    assert hit.findings == []
+    # no digest -> no key -> never serves (and never stores)
+    assert rc.get(blob, "", "x") is None
+    rc.close()
+
+
+def test_get_or_scan_single_flight_across_threads():
+    rc = ScanResultCache(TieredCache([MemoryCache()], write_behind=False))
+    blob = content_digest(b"contended")
+    scans = []
+    gate = threading.Event()
+
+    def scan_fn():
+        scans.append(1)
+        time.sleep(0.05)
+        return Secret(file_path="p", findings=[])
+
+    out = []
+
+    def worker(path):
+        gate.wait(timeout=5)
+        out.append(rc.get_or_scan(blob, "sha256:r", path, scan_fn))
+
+    threads = [
+        threading.Thread(target=worker, args=(f"p{i}",)) for i in range(4)
+    ]
+    for t in threads:
+        t.start()
+    gate.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert len(out) == 4 and all(s.findings == [] for s in out)
+    assert len(scans) == 1  # one scan across all concurrent callers
+    rc.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos: the cache.get/cache.put seams degrade, never fail the scan
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_cache_seam_faults_degrade_not_fail():
+    """With every cache read AND write erroring, get_or_scan still
+    produces the cold-scan verdict — the cache plane can only ever cost
+    time, never correctness (`make chaos-smoke` rides this)."""
+    rc = ScanResultCache(
+        TieredCache([MemoryCache()], error_budget=10_000, write_behind=False)
+    )
+    blob = content_digest(b"chaos bytes")
+
+    def scan_fn():
+        return Secret(file_path="c", findings=[])
+
+    faults.configure("cache.get:error@1,cache.put:error@1")
+    try:
+        for _ in range(5):
+            verdict = rc.get_or_scan(blob, "sha256:r", "c", scan_fn)
+            assert verdict.file_path == "c" and verdict.findings == []
+    finally:
+        faults.clear()
+    # Faults cleared: the chain heals and the next put/get round-trips.
+    verdict = rc.get_or_scan(blob, "sha256:r", "c2", scan_fn)
+    assert verdict.file_path == "c2"
+    assert rc.get(blob, "sha256:r", "c3") is not None
+    assert cache_stats.request_tallies().get(("memory", "error"), 0) >= 5
+    rc.close()
+
+
+# ---------------------------------------------------------------------------
+# cache-smoke: cold -> warm image walk, zero device work on warm
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.cache_smoke
+def test_cold_then_warm_image_scan_zero_device_work(tmp_path):
+    """The acceptance headline: a warm re-scan of an image performs zero
+    device dispatches and zero analyzer re-runs, with byte-identical
+    findings (`make cache-smoke`)."""
+    from test_image import GH_PAT, SECRET, _layer_tar, make_docker_archive
+    from trivy_tpu.commands.run import Options, run
+
+    layers = [
+        _layer_tar(
+            {"app/creds.env": SECRET, "etc/os-release": b"ID=alpine\n"}
+        ),
+        _layer_tar({"home/gh.cfg": GH_PAT}),
+    ]
+    archive = str(tmp_path / "image.tar")
+    make_docker_archive(archive, layers)
+    cache_dir = str(tmp_path / "cache")
+
+    def scan(out_name):
+        out = tmp_path / out_name
+        code = run(
+            Options(
+                target=archive, scanners=["secret"], format="json",
+                output=str(out), secret_backend="cpu",
+                cache_backend="fs", cache_dir=cache_dir,
+            ),
+            "image",
+        )
+        assert code == 0
+        return json.loads(out.read_text())
+
+    cold = scan("cold.json")
+    cold_events = dict(cache_stats.events())
+    assert cold_events.get("layer_analysis", 0) > 0  # the cold pass worked
+
+    cache_stats.clear()
+    warm = scan("warm.json")
+    warm_events = dict(cache_stats.events())
+
+    # Zero analyzer re-runs, zero device dispatches, hit rate 1.0 at the
+    # artifact plane (inner tiers legitimately record a memory-tier miss
+    # before the FS tier serves the promoted read).
+    assert warm_events.get("layer_analysis", 0) == 0
+    assert warm_events.get("config_analysis", 0) == 0
+    assert warm_events.get("device_dispatch", 0) == 0
+    tallies = cache_stats.request_tallies()
+    assert tallies.get(("artifact", "miss"), 0) == 0
+    assert tallies.get(("artifact", "hit"), 0) > 0
+
+    # Byte-identical findings.
+    assert cold["Results"] == warm["Results"]
+
+
+@pytest.mark.cache_smoke
+def test_warm_scan_invalidated_by_ruleset_change(tmp_path):
+    """`rules push` economics: changing the secret ruleset digest turns
+    the warm pass cold again — exactly the affected entries re-scan."""
+    from test_image import SECRET, _layer_tar, make_docker_archive
+    from trivy_tpu.commands.run import Options, run
+
+    archive = str(tmp_path / "image.tar")
+    make_docker_archive(
+        archive, [_layer_tar({"app/creds.env": SECRET})]
+    )
+    cache_dir = str(tmp_path / "cache")
+
+    def scan(out_name, **kw):
+        out = tmp_path / out_name
+        code = run(
+            Options(
+                target=archive, scanners=["secret"], format="json",
+                output=str(out), secret_backend="cpu",
+                cache_backend="fs", cache_dir=cache_dir, **kw,
+            ),
+            "image",
+        )
+        assert code == 0
+        return json.loads(out.read_text())
+
+    scan("cold.json")
+    cache_stats.clear()
+
+    # A custom ruleset (different digest) must not reuse default-digest
+    # layer verdicts.
+    cfg = tmp_path / "secret.yaml"
+    cfg.write_text(
+        "rules:\n"
+        "  - id: custom-marker\n"
+        "    category: custom\n"
+        "    title: custom marker\n"
+        "    severity: low\n"
+        "    regex: ZZYZX-[0-9]{4}\n"
+        "    keywords: [ZZYZX-]\n"
+    )
+    scan("recold.json", secret_config=str(cfg))
+    events = dict(cache_stats.events())
+    assert events.get("layer_analysis", 0) > 0  # re-scanned under new rules

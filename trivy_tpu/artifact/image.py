@@ -29,6 +29,7 @@ logger = logging.getLogger(__name__)
 
 from trivy_tpu.analyzer.core import AnalyzerGroup, AnalyzerOptions
 from trivy_tpu.atypes import ArtifactInfo, ArtifactReference, BlobInfo
+from trivy_tpu.cache import stats as cache_stats
 from trivy_tpu.cache.store import ArtifactCache
 from trivy_tpu.ftypes import ArtifactType
 from trivy_tpu.walker.layer_tar import walk_layer_tar
@@ -164,6 +165,10 @@ def guess_base_layers(diff_ids: list[str], config: dict) -> list[str]:
 class ImageArtifact:
     """artifact/image/image.go Artifact."""
 
+    # Class-level default: _layer_key must work on partially-constructed
+    # instances too (tests build them via __new__ to probe key math).
+    _secret_digest: str | None = None
+
     def __init__(
         self,
         target: str,
@@ -177,6 +182,36 @@ class ImageArtifact:
         # `source` lets the daemon/registry chain (trivy_tpu/image) hand in
         # an already-resolved image; plain paths load as archives/layouts.
         self.source = source if source is not None else load_image(target)
+        self._secret_digest: str | None = None
+        # Hit/miss accounting of the last inspect() (Explain.cache, bench).
+        self.last_cache_stats: dict = {}
+
+    def _secret_ruleset_digest(self) -> str:
+        """Digest of the ruleset the secret analyzer would scan with —
+        derived from config alone (registry/digest.py), never by building
+        the engine: on a fully-warm inspect the engine must not be
+        constructed at all.  Part of every secret-enabled layer key, so a
+        `rules push` invalidates exactly the entries whose verdicts the
+        new rules could change."""
+        if self._secret_digest is not None:
+            return self._secret_digest
+        digest = ""
+        if any(a.type() == "secret" for a in self.group.analyzers):
+            from trivy_tpu.registry.digest import (
+                default_ruleset_digest,
+                ruleset_digest,
+            )
+
+            opt = self.group.options.secret_scanner_option
+            config_path = getattr(opt, "config_path", "")
+            if config_path:
+                from trivy_tpu.rules.model import build_ruleset, load_config
+
+                digest = ruleset_digest(build_ruleset(load_config(config_path)))
+            else:
+                digest = default_ruleset_digest()
+        self._secret_digest = digest
+        return digest
 
     def _layer_key(self, diff_id: str, disabled: tuple[str, ...] = ()) -> str:
         h = hashlib.sha256()
@@ -186,6 +221,8 @@ class ImageArtifact:
         # Per-layer disabled analyzers change the blob's contents, so they
         # are part of the key (image.go calcCacheKey includes them).
         h.update(json.dumps(sorted(disabled)).encode())
+        if "secret" not in disabled:
+            h.update(self._secret_ruleset_digest().encode())
         return "sha256:" + h.hexdigest()
 
     def _artifact_key(self) -> str:
@@ -243,12 +280,26 @@ class ImageArtifact:
         ]
         artifact_key = self._artifact_key()
 
+        # The imgconf blob holds a secret scan of the config JSON, so its
+        # key carries the ruleset digest too (rules push invalidates it).
         config_key = "sha256:" + hashlib.sha256(
-            (artifact_key + ":imgconf").encode()
+            (artifact_key + ":imgconf:" + self._secret_ruleset_digest()).encode()
         ).hexdigest()
         missing_artifact, missing = self.cache.missing_blobs(
             artifact_key, layer_keys + [config_key]
         )
+        total_blobs = len(layer_keys) + 1
+        cache_stats.record_request("artifact", "miss", len(missing))
+        cache_stats.record_request(
+            "artifact", "hit", total_blobs - len(missing)
+        )
+        self.last_cache_stats = {
+            "blobs": total_blobs,
+            "hits": total_blobs - len(missing),
+            "misses": len(missing),
+            "artifact_hit": not missing_artifact,
+            "ruleset_digest": self._secret_ruleset_digest(),
+        }
 
         history = [
             h for h in (src.config.get("history") or []) if not h.get("empty_layer")
@@ -300,6 +351,7 @@ class ImageArtifact:
         disabled: set[str] | None = None,
     ) -> None:
         """image.go:242 inspectLayer."""
+        cache_stats.event("layer_analysis")
         with self.source.layers[index]() as f:
             # Entries read lazily through the open tar; analysis happens
             # inside the `with` so only claimed files materialize.
@@ -333,6 +385,7 @@ class ImageArtifact:
         client/server split.  Each sub-analysis only runs when its analyzer
         is enabled; the blob is cache-gated like layer blobs (always put,
         possibly empty, so missing_blobs stays accurate)."""
+        cache_stats.event("config_analysis")
         from trivy_tpu.analyzer.imgconf import (
             scan_config_misconfig,
             scan_config_secrets,

@@ -1,3 +1,57 @@
 from trivy_tpu.cache.store import ArtifactCache, FSCache, MemoryCache
+from trivy_tpu.cache.tiered import TieredCache
+from trivy_tpu.cache.results import ScanResultCache, content_digest, result_key
 
-__all__ = ["ArtifactCache", "FSCache", "MemoryCache"]
+
+def build_cache(
+    backend: str = "", cache_dir: str = "", ttl_seconds: int = 0
+) -> ArtifactCache:
+    """Construct the artifact-cache chain a backend spec names — the ONE
+    place the CLI scan path and the server agree on what `--cache-backend`
+    means.  Remote specs (redis://, s3://) sit behind local tiers (memory
+    always, FS when a cache dir is configured): reads promote inward,
+    remote writes ride the write-behind thread, and remote errors degrade
+    to the local tiers instead of failing the scan.  "" picks FS when a
+    cache dir exists, else memory.  Raises ValueError on an unknown spec
+    (callers wrap it in their own error type)."""
+    if backend.startswith(("redis://", "rediss://")):
+        from trivy_tpu.cache.redis import RedisCache
+
+        local: list[ArtifactCache] = [MemoryCache()]
+        if cache_dir:
+            local.append(FSCache(cache_dir))
+        return TieredCache(
+            local
+            + [RedisCache(backend, ttl_seconds=ttl_seconds, timeout=5.0)]
+        )
+    if backend.startswith("s3://"):
+        from trivy_tpu.cache.s3 import S3Cache
+
+        local = [MemoryCache()]
+        if cache_dir:
+            local.append(FSCache(cache_dir))
+        return TieredCache(local + [S3Cache(backend, timeout=10.0)])
+    if backend == "fs":
+        if not cache_dir:
+            raise ValueError("cache backend 'fs' requires a cache dir")
+        return TieredCache([MemoryCache(), FSCache(cache_dir)])
+    if backend == "memory":
+        return MemoryCache()
+    if backend == "":
+        return FSCache(cache_dir) if cache_dir else MemoryCache()
+    raise ValueError(
+        f"unknown cache backend {backend!r} "
+        "(memory | fs | redis://... | s3://...)"
+    )
+
+
+__all__ = [
+    "ArtifactCache",
+    "FSCache",
+    "MemoryCache",
+    "TieredCache",
+    "ScanResultCache",
+    "build_cache",
+    "content_digest",
+    "result_key",
+]

@@ -63,13 +63,26 @@ class RespClient:
 
     # -- wire format -------------------------------------------------------
 
-    def command(self, *parts: str | bytes):
+    @staticmethod
+    def _encode(parts: tuple[str | bytes, ...]) -> bytes:
         out = [b"*%d\r\n" % len(parts)]
         for p in parts:
             b = p if isinstance(p, bytes) else str(p).encode()
             out.append(b"$%d\r\n%s\r\n" % (len(b), b))
-        self._sock.sendall(b"".join(out))
+        return b"".join(out)
+
+    def command(self, *parts: str | bytes):
+        self._sock.sendall(self._encode(parts))
         return self._read_reply()
+
+    def pipeline(self, commands: list[tuple[str | bytes, ...]]) -> list:
+        """Send N commands in one write, then read N replies — one
+        network round trip instead of N (the MissingBlobs diff probes
+        every layer of an image with EXISTS)."""
+        if not commands:
+            return []
+        self._sock.sendall(b"".join(self._encode(c) for c in commands))
+        return [self._read_reply() for _ in commands]
 
     def _read_line(self) -> bytes:
         while b"\r\n" not in self._buf:
@@ -114,8 +127,8 @@ class RespClient:
 class RedisCache(ArtifactCache):
     """redis.go RedisCache over the RESP client."""
 
-    def __init__(self, url: str, ttl_seconds: int = 0):
-        self._client = RespClient(url)
+    def __init__(self, url: str, ttl_seconds: int = 0, timeout: float = 30.0):
+        self._client = RespClient(url, timeout=timeout)
         self._ttl = ttl_seconds
         self._client.command("PING")
 
@@ -149,18 +162,20 @@ class RedisCache(ArtifactCache):
         doc = self._get(BLOB_PREFIX + blob_id)
         return BlobInfo.from_json(doc) if doc else None
 
+    def exists(self, blob_id: str) -> bool:
+        return bool(self._client.command("EXISTS", BLOB_PREFIX + blob_id))
+
     def missing_blobs(
         self, artifact_id: str, blob_ids: Iterable[str]
     ) -> tuple[bool, list[str]]:
-        missing = [
-            bid
-            for bid in blob_ids
-            if not self._client.command("EXISTS", BLOB_PREFIX + bid)
-        ]
-        missing_artifact = not self._client.command(
-            "EXISTS", ARTIFACT_PREFIX + artifact_id
+        # One pipelined round trip: N blob EXISTS + the artifact EXISTS.
+        ids = list(blob_ids)
+        replies = self._client.pipeline(
+            [("EXISTS", BLOB_PREFIX + bid) for bid in ids]
+            + [("EXISTS", ARTIFACT_PREFIX + artifact_id)]
         )
-        return missing_artifact, missing
+        missing = [bid for bid, present in zip(ids, replies) if not present]
+        return not replies[-1], missing
 
     def delete_blobs(self, blob_ids: Iterable[str]) -> None:
         ids = [BLOB_PREFIX + b for b in blob_ids]

@@ -1,0 +1,147 @@
+"""Per-blob scan-result cache: the fleet's scan-once-per-layer plane.
+
+At registry scale most image layers are shared, so millions of scans
+collapse to a small set of novel blobs (ISSUE 15 / ROADMAP open item 3,
+mirroring the economics of Trivy's ArtifactCache split in
+`pkg/fanal/cache/`).  This module stores the *device scan verdict* for a
+single content blob, keyed by everything that could change it:
+
+    result key = sha256(blob_digest \\x00 ruleset_digest \\x00 schema)
+
+- `blob_digest` is sha256 over the exact bytes the engine scanned, so
+  identical content hits regardless of path or image;
+- `ruleset_digest` comes from the PR 4 registry (registry/digest.py) —
+  a `rules push` changes the digest and naturally invalidates exactly
+  the entries scanned under the old rules, nothing else;
+- `engine_schema_version` (RESULT_SCHEMA_VERSION here) versions the
+  finding encoding itself, so a wire-format change never rehydrates
+  garbage.
+
+Values ride the existing BlobInfo JSON document (atypes.py secret
+round-trip) through any ArtifactCache backend — memory, FS, Redis, S3,
+or the TieredCache chain — with the cached Secret's path stripped at
+put time and the *requester's* path restored at hit time, so a hit is
+byte-identical to a cold scan of the same bytes under any name.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from trivy_tpu.atypes import BlobInfo
+from trivy_tpu.cache import stats as cache_stats
+from trivy_tpu.cache.store import ArtifactCache
+from trivy_tpu.cache.tiered import TieredCache
+from trivy_tpu.ftypes import Secret
+
+# Version of the cached-finding encoding (the third key component).
+# Bump on any change to SecretFinding/Code/Layer JSON shape.
+RESULT_SCHEMA_VERSION = 1
+
+
+def content_digest(data: bytes) -> str:
+    """Canonical digest of the exact bytes handed to the engine."""
+    return "sha256:" + hashlib.sha256(data).hexdigest()
+
+
+def result_key(
+    blob_digest: str,
+    ruleset_digest: str,
+    schema_version: int = RESULT_SCHEMA_VERSION,
+) -> str:
+    """The composite content-addressed key (itself `sha256:<hex>` so the
+    FS backend files it under the plain hex digest)."""
+    h = hashlib.sha256()
+    h.update(blob_digest.encode("utf-8"))
+    h.update(b"\x00")
+    h.update(ruleset_digest.encode("utf-8"))
+    h.update(b"\x00")
+    h.update(str(schema_version).encode("ascii"))
+    return "sha256:" + h.hexdigest()
+
+
+class ScanResultCache:
+    """Get/put of per-blob Secret verdicts over an ArtifactCache backend.
+
+    The backend is typically a TieredCache; any ArtifactCache works
+    (tests use MemoryCache).  A *hit with zero findings* is a first-class
+    verdict — clean blobs are the common case and exactly what the warm
+    path must not re-scan.
+    """
+
+    def __init__(self, backend: ArtifactCache):
+        self.backend = backend
+
+    def get(
+        self, blob_digest: str, ruleset_digest: str, path: str = ""
+    ) -> Secret | None:
+        """The cached verdict rehydrated under `path`, or None on miss.
+        A non-None return with empty findings means "scanned clean"."""
+        if not ruleset_digest:
+            # No digest, no key: an engine that can't identify its rules
+            # must not serve stale verdicts.
+            cache_stats.record_request("results", "miss")
+            return None
+        key = result_key(blob_digest, ruleset_digest)
+        blob = self.backend.get_blob(key)
+        if blob is None:
+            cache_stats.record_request("results", "miss")
+            return None
+        cache_stats.record_request("results", "hit")
+        findings = list(blob.secrets[0].findings) if blob.secrets else []
+        return Secret(file_path=path, findings=findings)
+
+    def put(
+        self, blob_digest: str, ruleset_digest: str, secret: Secret
+    ) -> None:
+        """Store the verdict for one blob (path stripped: the key is the
+        content, not the name it was scanned under)."""
+        if not ruleset_digest:
+            return
+        key = result_key(blob_digest, ruleset_digest)
+        secrets = (
+            [Secret(file_path="", findings=list(secret.findings))]
+            if secret.findings
+            else []
+        )
+        self.backend.put_blob(key, BlobInfo(secrets=secrets))
+
+    def get_or_scan(
+        self,
+        blob_digest: str,
+        ruleset_digest: str,
+        path: str,
+        scan_fn,
+    ) -> Secret:
+        """Hit path, or run `scan_fn()` exactly once per key across
+        concurrent callers (single-flight when the backend is tiered)
+        and remember its verdict."""
+        hit = self.get(blob_digest, ruleset_digest, path)
+        if hit is not None:
+            return hit
+
+        def _miss() -> Secret:
+            verdict = scan_fn()
+            self.put(blob_digest, ruleset_digest, verdict)
+            return verdict
+
+        if isinstance(self.backend, TieredCache):
+            key = result_key(blob_digest, ruleset_digest)
+            result = self.backend.single_flight(key, _miss)
+            # The leader's verdict carries the leader's path; re-serve
+            # under ours if they differ (shared findings are immutable).
+            if isinstance(result, Secret) and result.file_path != path:
+                return Secret(file_path=path, findings=list(result.findings))
+            return result  # type: ignore[return-value]
+        return _miss()
+
+    def snapshot(self) -> dict:
+        inner = getattr(self.backend, "snapshot", None)
+        return {
+            "schema_version": RESULT_SCHEMA_VERSION,
+            "backend": type(self.backend).__name__,
+            "tiers": inner() if callable(inner) else None,
+        }
+
+    def close(self) -> None:
+        self.backend.close()

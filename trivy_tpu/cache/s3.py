@@ -48,9 +48,11 @@ class S3Client:
         secret_key: str = "",
         session_token: str = "",
         service: str = "s3",
+        timeout: float = 60.0,
     ):
         self.bucket = bucket
         self.service = service
+        self.timeout = timeout
         self.region = region or os.environ.get("AWS_REGION", "us-east-1")
         self.endpoint = (
             endpoint
@@ -138,7 +140,7 @@ class S3Client:
             headers=headers, method=method,
         )
         try:
-            with urllib.request.urlopen(req, timeout=60) as resp:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 return resp.status, resp.read()
         except urllib.error.HTTPError as e:
             return e.code, e.read()
@@ -210,6 +212,9 @@ class S3Cache(ArtifactCache):
             self.client.get_object(self._key("blob", blob_id))
         )
         return BlobInfo.from_json(doc) if doc else None
+
+    def exists(self, blob_id: str) -> bool:
+        return self.client.head_object(self._key("blob", blob_id))
 
     def missing_blobs(
         self, artifact_id: str, blob_ids: Iterable[str]

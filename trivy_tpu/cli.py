@@ -262,7 +262,14 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
     p.add_argument(
         "--cache-backend",
         default=_env_default("cache-backend", "memory"),
-        help="memory | fs | redis://host:port[/db] | s3://bucket/prefix",
+        help="memory | fs | redis://host:port[/db] | s3://bucket/prefix "
+        "(fs/redis/s3 run as a tiered chain: memory -> fs -> remote, "
+        "remote errors degrade to local tiers)",
+    )
+    p.add_argument(
+        "--cache-ttl", type=int, default=int(_env_default("cache-ttl", "0")),
+        help="remote cache tier entry TTL in seconds (0 = keep forever; "
+        "redis/s3 backends only)",
     )
     p.add_argument(
         "--server", default=_env_default("server", ""),
@@ -378,6 +385,7 @@ def _options_from_args(args: argparse.Namespace) -> Options:
         exit_code=args.exit_code,
         cache_dir=args.cache_dir,
         cache_backend=args.cache_backend,
+        cache_ttl=getattr(args, "cache_ttl", 0),
         skip_files=args.skip_files,
         skip_dirs=args.skip_dirs,
         file_patterns=list(getattr(args, "file_patterns", []) or []),
@@ -631,6 +639,16 @@ def build_parser() -> argparse.ArgumentParser:
     p_server = sub.add_parser("server", help="run the scan server")
     p_server.add_argument("--listen", default="localhost:4954")
     p_server.add_argument("--cache-dir", default="")
+    p_server.add_argument(
+        "--cache-backend", default=_env_default("cache-backend", ""),
+        help="server artifact/result cache: fs | redis://host:port | "
+        "s3://bucket/prefix ('' = fs when --cache-dir is set, else memory); "
+        "non-memory backends run as a tiered chain with degrade-on-error",
+    )
+    p_server.add_argument(
+        "--cache-ttl", type=int, default=int(_env_default("cache-ttl", "0")),
+        help="remote cache tier entry TTL seconds (redis/s3 backends)",
+    )
     p_server.add_argument("--token", default="")
     p_server.add_argument("--db-dir", default="")
     # Continuous cross-request batcher knobs (trivy_tpu/serve/); each binds
@@ -1066,6 +1084,8 @@ def main(argv: list[str] | None = None) -> int:
         serve(
             args.listen,
             cache_dir=args.cache_dir,
+            cache_backend=args.cache_backend,
+            cache_ttl=args.cache_ttl,
             token=args.token,
             db_dir=args.db_dir,
             serve_config=ServeConfig(

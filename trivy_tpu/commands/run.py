@@ -52,6 +52,9 @@ class Options:
     exit_code: int = 0
     cache_dir: str = ""
     cache_backend: str = "memory"
+    # Remote-tier entry TTL seconds (0 = keep forever); only meaningful
+    # for redis/s3 backends, where a fleet shares the cache.
+    cache_ttl: int = 0
     skip_files: list[str] = field(default_factory=list)
     skip_dirs: list[str] = field(default_factory=list)
     file_patterns: list[str] = field(default_factory=list)  # type:regex
@@ -107,27 +110,17 @@ def init_cache(options: Options) -> ArtifactCache:
         from trivy_tpu.rpc.client import RemoteCache
 
         return RemoteCache(options.server_addr, options.token, wire=options.server_wire)
-    backend = options.cache_backend
-    if backend.startswith(("redis://", "rediss://")):
-        from trivy_tpu.cache.redis import RedisCache
+    from trivy_tpu.cache import build_cache
 
-        return RedisCache(backend)
-    if backend.startswith("s3://"):
-        from trivy_tpu.cache.s3 import S3Cache
-
-        return S3Cache(backend)
-    if backend == "fs":
-        if not options.cache_dir:
-            raise CacheConfigError(
-                "--cache-backend fs requires --cache-dir"
-            )
-        return FSCache(options.cache_dir)
-    if backend != "memory":
-        raise CacheConfigError(
-            f"unknown cache backend {backend!r} "
-            "(memory | fs | redis://... | s3://...)"
+    # One backend grammar shared with the server path (cache/__init__.py):
+    # remote specs sit behind local tiers with write-behind and the
+    # degrade-don't-fail error budget.
+    try:
+        return build_cache(
+            options.cache_backend, options.cache_dir, options.cache_ttl
         )
-    return MemoryCache()
+    except ValueError as e:
+        raise CacheConfigError(str(e)) from None
 
 
 def _parse_file_patterns(raw: list[str]) -> dict:

@@ -34,6 +34,8 @@ Seams (grep for ``faults.fire`` / ``faults.decide``):
     rpc.serve       rpc/server.py        server request handling
     sched.dispatch  serve/scheduler.py   batch dispatch (device boundary
                                          on host-only builds)
+    cache.get       cache/tiered.py      tiered result-cache read (per tier)
+    cache.put       cache/tiered.py      tiered result-cache write (per tier)
 
 Kinds: ``error`` (generic InjectedFault), ``oom`` (InjectedOom — its
 message carries RESOURCE_EXHAUSTED so the scheduler's shed-and-retry
@@ -69,6 +71,8 @@ SEAMS = (
     "rpc.recv",
     "rpc.serve",
     "sched.dispatch",
+    "cache.get",
+    "cache.put",
 )
 
 KINDS = ("error", "oom", "corrupt", "reset", "truncate", "latency")

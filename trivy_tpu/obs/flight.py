@@ -64,6 +64,7 @@ class FlightRecorder:
         gate_fn: Callable[[], list] | None = None,
         registry=None,
         memory_fn: Callable[[], dict] | None = None,
+        cache_fn: Callable[[], dict] | None = None,
     ):
         self._lock = lockcheck.make_lock("obs.flight")
         self._ring: deque[dict] = deque(maxlen=max(1, int(capacity)))  # owner: _lock
@@ -71,6 +72,7 @@ class FlightRecorder:
         self._snapshot_fn = snapshot_fn
         self._gate_fn = gate_fn
         self._memory_fn = memory_fn
+        self._cache_fn = cache_fn
         self.out_path = out_path
         # 0 disables the cap; the bookkeeping below is all owner: _lock.
         self.out_max_bytes = int(max(0.0, out_max_mb) * (1 << 20))
@@ -148,6 +150,14 @@ class FlightRecorder:
         except Exception as e:
             return {"error": f"{type(e).__name__}: {e}"}
 
+    def _cache_state(self) -> dict:
+        if self._cache_fn is None:
+            return {}
+        try:
+            return dict(self._cache_fn())
+        except Exception as e:
+            return {"error": f"{type(e).__name__}: {e}"}
+
     def capture(
         self,
         *,
@@ -173,6 +183,7 @@ class FlightRecorder:
             "scheduler": self._scheduler_state(),
             "gate": self._gate_state(),
             "memory": self._memory_state(),
+            "cache": self._cache_state(),
         }
         dropped = 0
         with self._lock:

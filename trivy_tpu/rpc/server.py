@@ -40,6 +40,9 @@ from urllib.parse import parse_qs, urlparse
 
 from trivy_tpu import __version__, deadline, faults, lockcheck
 from trivy_tpu.atypes import ArtifactInfo, _secret_to_json
+from trivy_tpu.cache import build_cache
+from trivy_tpu.cache import stats as cache_stats
+from trivy_tpu.cache.results import ScanResultCache
 from trivy_tpu.cache.store import (
     ArtifactCache,
     BlobNotFoundError,
@@ -120,11 +123,17 @@ class ScanServer:
         slo_config: str = "",
         flight_out: str = "",
         flight_out_max_mb: float = obs_flight.DEFAULT_OUT_MAX_MB,
+        result_cache: ScanResultCache | None = None,
     ):
         from trivy_tpu.scanner.vuln import init_vuln_scanner
 
         self.cache = cache
         self.token = token
+        # Fleet result cache (cache/results.py): per-blob verdicts the
+        # scheduler probes before ticketing — warm fleet traffic demuxes
+        # straight to futures with zero device dispatches.  None = off
+        # (the seed behavior; --cache-backend opts the daemon in).
+        self.result_cache = result_cache
         # One registry per server: _Metrics' request families and the
         # scheduler's serve/engine families render as one /metrics body.
         self.registry = obs_metrics.Registry()
@@ -159,6 +168,7 @@ class ScanServer:
             ruleset_loader=(
                 self._load_ruleset_engine if rules_cache_dir else None
             ),
+            result_cache=result_cache,
         )
         # SLO tracking + breach capture: the tracker classifies every RPC
         # observation against its (default or --slo-config) objective;
@@ -183,6 +193,10 @@ class ScanServer:
             # ... and the device-memory snapshot, so hbm-pressure (and any
             # other) incidents name who held HBM at breach time.
             memory_fn=lambda: obs_memwatch.snapshot(top=5),
+            # ... and the result-cache posture (tier degrade state + hit
+            # economics), so a latency incident shows whether the fleet
+            # cache was cold or a remote tier was eating its error budget.
+            cache_fn=self.cache_report,
         )
         # The scheduler captures deadline expiries itself (at expiry time,
         # when the snapshot still shows the queue that starved the ticket).
@@ -203,6 +217,22 @@ class ScanServer:
         )
         self._gate_exported: dict[tuple[str, str], int] = {}
         self.registry.add_collect_hook(self._collect_gate)
+        # Cache-plane families, folded from the process-global tallies
+        # (cache/stats.py) with the same delta-export discipline as the
+        # gate hook — every tier in the chain reports through these two.
+        self._m_cache_requests = self.registry.counter(
+            "trivy_tpu_cache_requests_total",
+            "cache lookups by tier and outcome",
+            ("tier", "outcome"),
+        )
+        self._m_cache_evictions = self.registry.counter(
+            "trivy_tpu_cache_evictions_total",
+            "cache entries evicted by reason (self-heal, TTL, capacity)",
+            ("reason",),
+        )
+        self._cache_req_exported: dict[tuple[str, str], int] = {}
+        self._cache_evict_exported: dict[str, int] = {}
+        self.registry.add_collect_hook(self._collect_cache)
         self._m_device_phase = self.registry.histogram(
             "trivy_tpu_device_phase_seconds",
             "fenced per-kernel device sections (tracing-enabled runs only)",
@@ -507,6 +537,51 @@ class ScanServer:
         if margin is not None:
             self._m_gate_margin.set(margin)
 
+    def _collect_cache(self) -> None:
+        """Registry collect hook: fold the process-global cache tallies
+        (cache/stats.py) into this server's families by delta, so several
+        in-process servers (tests) converge without double counting.
+        tier/outcome/reason are bounded enums (stats.TIERS/OUTCOMES/
+        EVICTION_REASONS), never request-controlled identities."""
+        for (tier, outcome), total in cache_stats.request_tallies().items():
+            key = (tier, outcome)
+            delta = total - self._cache_req_exported.get(key, 0)
+            if delta > 0:
+                self._m_cache_requests.labels(  # graftlint: ignore[GL007]
+                    tier=tier, outcome=outcome
+                ).inc(delta)
+                self._cache_req_exported[key] = total
+        for reason, total in cache_stats.eviction_tallies().items():
+            delta = total - self._cache_evict_exported.get(reason, 0)
+            if delta > 0:
+                self._m_cache_evictions.labels(  # graftlint: ignore[GL007]
+                    reason=reason
+                ).inc(delta)
+                self._cache_evict_exported[reason] = total
+
+    def cache_report(self) -> dict:
+        """GET /debug/cache: the fleet result cache's full posture — the
+        process-global request/eviction tallies, the tier chain's degrade
+        state (error budgets, write-behind queue), and the scheduler's
+        hit economics.  A sane body with caching off: the tallies still
+        cover the artifact-cache plane ImageArtifact drives."""
+        rep: dict = {
+            "stats": cache_stats.snapshot(),
+            "backend": type(self.cache).__name__,
+            "result_cache_enabled": self.result_cache is not None,
+        }
+        tiers = getattr(self.cache, "snapshot", None)
+        if callable(tiers):
+            rep["tiers"] = tiers()
+        if self.result_cache is not None:
+            rep["results"] = self.result_cache.snapshot()
+            rep["scheduler"] = {
+                "hits": self.scheduler.stats.cache_hits,
+                "misses": self.scheduler.stats.cache_misses,
+                "resolved_requests": self.scheduler.stats.cache_resolved,
+            }
+        return rep
+
     def _collect_device_phases(self) -> None:
         """Registry collect hook: drain pending fenced per-kernel samples
         into trivy_tpu_device_phase_seconds{kernel,device}.  Samples only
@@ -719,6 +794,9 @@ DEBUG_SURFACES = {
     "tallies (degraded/shed batches) and the armed fault plane",
     "/debug/mesh": "mesh execution plane: topology, partition-plan table, "
     "per-device occupancy and resident bytes, scaling efficiency",
+    "/debug/cache": "fleet result cache: per-tier request/eviction "
+    "tallies, tier degrade state and write-behind queue, scheduler hit "
+    "economics",
 }
 
 
@@ -836,6 +914,10 @@ def _make_handler(server: ScanServer):
                 # Mesh plane posture: topology + plan table + per-device
                 # occupancy/resident bytes (sane body when unmeshed).
                 self._send(200, server.mesh_report())
+            elif route == "/debug/cache":
+                # Fleet result cache posture: tier chain health + hit
+                # economics (sane body with caching off).
+                self._send(200, server.cache_report())
             elif route in ("/debug", "/debug/"):
                 # Index of every debug surface with its one-liner.
                 self._send(200, {"surfaces": DEBUG_SURFACES})
@@ -1078,6 +1160,7 @@ def make_http_server(
     slo_config: str = "",
     flight_out: str = "",
     flight_out_max_mb: float = obs_flight.DEFAULT_OUT_MAX_MB,
+    result_cache: ScanResultCache | None = None,
 ) -> ThreadingHTTPServer:
     host, _, port = addr.rpartition(":")
     scan_server = ScanServer(
@@ -1092,6 +1175,7 @@ def make_http_server(
         slo_config=slo_config,
         flight_out=flight_out,
         flight_out_max_mb=flight_out_max_mb,
+        result_cache=result_cache,
     )
     httpd = ThreadingHTTPServer(
         (host or "localhost", int(port)), _make_handler(scan_server)
@@ -1114,6 +1198,8 @@ def serve(
     slo_config: str = "",
     flight_out: str = "",
     flight_out_max_mb: float = obs_flight.DEFAULT_OUT_MAX_MB,
+    cache_backend: str = "",
+    cache_ttl: int = 0,
 ) -> None:
     """pkg/rpc/server/listen.go ListenAndServe, with graceful SIGTERM
     drain: stop admitting (503 + Retry-After), finish the batches already
@@ -1127,13 +1213,20 @@ def serve(
     # and embedders opt in explicitly via obs_trace.enable() so that
     # in-process servers never flip tracing globally.
     obs_trace.enable()
-    cache = FSCache(cache_dir) if cache_dir else MemoryCache()
+    # The backend spec shares the CLI scan path's grammar ("" = FS when a
+    # cache dir exists, else memory).  An EXPLICIT --cache-backend also
+    # turns on the fleet result cache: the scheduler then probes per-blob
+    # verdicts before ticketing, so warm fleet traffic never touches the
+    # device.  Unset keeps the seed behavior (no result caching).
+    cache = build_cache(cache_backend, cache_dir, cache_ttl)
+    result_cache = ScanResultCache(cache) if cache_backend else None
     httpd = make_http_server(
         addr, cache, token, db_dir, cache_dir, serve_config=serve_config,
         secret_config=secret_config, rules_cache_dir=rules_cache_dir,
         pipeline_depth=pipeline_depth, resident_chunks=resident_chunks,
         profile_dir=profile_dir, slo_config=slo_config,
         flight_out=flight_out, flight_out_max_mb=flight_out_max_mb,
+        result_cache=result_cache,
     )
     scan_server: ScanServer = httpd.scan_server
 
@@ -1173,6 +1266,7 @@ def start_background(
     serve_config: ServeConfig | None = None, secret_engine_factory=None,
     secret_config: str = "", rules_cache_dir: str | None = None,
     profile_dir: str = "", slo_config: str = "", flight_out: str = "",
+    result_cache: ScanResultCache | None = None,
 ) -> tuple[ThreadingHTTPServer, threading.Thread]:
     """In-process server for tests (the §4 'multi-node without a cluster'
     pattern: integration_test.go:77-103 binds a real server on a free port)."""
@@ -1185,6 +1279,7 @@ def start_background(
         profile_dir=profile_dir,
         slo_config=slo_config,
         flight_out=flight_out,
+        result_cache=result_cache,
     )
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()

@@ -57,6 +57,7 @@ from dataclasses import dataclass, field
 
 from trivy_tpu import deadline as _deadline
 from trivy_tpu import faults, lockcheck
+from trivy_tpu.cache.results import content_digest
 from trivy_tpu.deadline import ScanTimeoutError
 from trivy_tpu.engine.breaker import CircuitBreaker
 from trivy_tpu.mesh import topology as mesh_topology
@@ -168,6 +169,11 @@ class Ticket:
     trace_id: str = ""  # X-Trivy-Trace-Id from the request, "" = untraced
     ruleset_digest: str = ""  # lane key; "" = the default ruleset
     explain: bool = False  # attach the per-phase breakdown to the result
+    # Result-cache partial hit: original index -> cached Secret.  When
+    # set, `items` holds only the misses and `total_items` the original
+    # request length; demux re-interleaves positionally at dispatch.
+    cache_hits: dict | None = None
+    total_items: int = 0  # 0 = len(items) (no cache probe ran)
 
 
 class _Lane:
@@ -213,6 +219,9 @@ class SchedulerStats:
     degraded_batches: int = 0  # re-run byte-identical on the host DFA
     shed_retries: int = 0  # RESOURCE_EXHAUSTED evict-split-retry cycles
     shed_evicted_slots: int = 0  # pool slots shed by OOM recovery
+    cache_hits: int = 0  # items served from the result cache
+    cache_misses: int = 0  # items that had to ride a device batch
+    cache_resolved: int = 0  # requests resolved wholly from cache (no ticket)
 
 
 class BatchScheduler:
@@ -235,9 +244,16 @@ class BatchScheduler:
         config: ServeConfig | None = None,
         registry: obs_metrics.Registry | None = None,
         ruleset_loader=None,
+        result_cache=None,
     ):
         self.config = config or ServeConfig()
         self._engine_factory = engine_factory
+        # Fleet result cache (cache/results.py): per-blob verdicts keyed
+        # by (content digest, ruleset digest, schema).  Submit probes it
+        # before ticketing — full hits demux straight to futures with
+        # zero device dispatches, partial hits ride the batch with only
+        # their misses.  None = caching off (the seed behavior).
+        self.result_cache = result_cache
         # The manager owns the DEFAULT lane's active/staged engine pair;
         # only _dispatch (owner thread) installs, so swaps land exactly at
         # batch boundaries and in-flight batches finish on the engine they
@@ -330,6 +346,17 @@ class BatchScheduler:
         self._m_expired = r.counter(
             "trivy_tpu_serve_expired_total",
             "tickets cancelled at their deadline before dispatch",
+        )
+        self._m_cache_items = r.counter(
+            "trivy_tpu_serve_cache_items_total",
+            "result-cache probe outcomes for submitted items",
+            labelnames=("outcome",),
+        )
+        for outcome in ("hit", "miss"):
+            self._m_cache_items.labels(outcome=outcome)
+        self._m_cache_resolved = r.counter(
+            "trivy_tpu_serve_cache_resolved_total",
+            "requests resolved wholly from the result cache (no batch)",
         )
         self._m_batches = r.counter(
             "trivy_tpu_serve_batches_total", "dispatched device batches"
@@ -476,6 +503,35 @@ class BatchScheduler:
                     "ruleset registry (start with --rules-cache-dir)"
                 )
             self.pool.ensure(ruleset_digest)
+        # Result-cache probe, AFTER QoS/HBM/residency so rate limits and
+        # lane validation still apply to warm traffic.  Full hits demux
+        # straight to the future — no ticket, no lane, no device batch.
+        # Partial hits shrink the ticket to its misses before any queue
+        # accounting sees it; demux re-interleaves at dispatch.  The key
+        # digest must be knowable WITHOUT building an engine: digest lanes
+        # carry it, the default lane reads the manager's active digest
+        # ("" until the first cold dispatch installs one — cold behavior).
+        if self.result_cache is not None and ticket.items:
+            key_digest = ruleset_digest or self.manager.active_digest
+            if key_digest:
+                hits, misses = self._probe_result_cache(
+                    ticket.items, key_digest
+                )
+                self.stats.cache_hits += len(hits)
+                self.stats.cache_misses += len(misses)
+                if hits:
+                    self._m_cache_items.labels(outcome="hit").inc(len(hits))
+                if misses:
+                    self._m_cache_items.labels(outcome="miss").inc(
+                        len(misses)
+                    )
+                if not misses:
+                    return self._resolve_from_cache(ticket, hits, key_digest)
+                if hits:
+                    ticket.cache_hits = hits
+                    ticket.total_items = len(ticket.items)
+                    ticket.items = misses
+                    ticket.nbytes = sum(len(c) for _, c in misses)
         with self._not_empty:
             if not self._admitting:
                 self.stats.rejected_closed += 1
@@ -524,6 +580,68 @@ class BatchScheduler:
                 self._thread.start()
             self._not_empty.notify()
         return ticket.future
+
+    def _probe_result_cache(
+        self, items: list[tuple[str, bytes]], key_digest: str
+    ) -> tuple[dict, list[tuple[str, bytes]]]:
+        """Per-item result-cache lookup (request thread — tier timeouts
+        and the degrade ladder live inside the TieredCache, so a remote
+        outage costs latency here, never an exception).  Returns
+        (original index -> rehydrated Secret, miss items in order)."""
+        hits: dict[int, object] = {}
+        misses: list[tuple[str, bytes]] = []
+        for i, (path, data) in enumerate(items):
+            sec = self.result_cache.get(
+                content_digest(data), key_digest, path
+            )
+            if sec is not None:
+                hits[i] = sec
+            else:
+                misses.append((path, data))
+        return hits, misses
+
+    def _resolve_from_cache(
+        self, ticket: Ticket, hits: dict, key_digest: str
+    ) -> Future:
+        """Resolve a fully-warm request on the submit thread: the demux a
+        cold batch would have done, minus the device.  The ticket never
+        entered a lane, so no inflight/queue accounting to unwind."""
+        out = SecretBatch([hits[i] for i in range(len(hits))])
+        out.ruleset_digest = key_digest
+        out.ruleset_epoch = self._epoch_for(ticket.ruleset_digest)
+        if ticket.explain:
+            out.explain = {
+                "trace_id": ticket.trace_id,
+                "queue_wait_ms": 0.0,
+                "batch_wall_ms": 0.0,
+                "phases_ms": {},
+                "cache": {
+                    "hits": len(hits),
+                    "misses": 0,
+                    "ruleset_digest": key_digest,
+                    "resolved_from_cache": True,
+                },
+            }
+        self.stats.cache_resolved += 1
+        self._m_cache_resolved.inc()
+        try:
+            ticket.future.set_result(out)
+        except InvalidStateError:
+            pass  # caller-side cancellation raced us
+        return ticket.future
+
+    def _epoch_for(self, lane_digest: str) -> int:
+        """The epoch a dispatch on this lane would report (default lane:
+        the manager's; digest lanes: the resident slot's; 0 if evicted
+        between ensure and here — the verdict is digest-keyed, so epoch
+        is attribution, not correctness)."""
+        if not lane_digest:
+            return self.manager.epoch
+        if self.pool is not None:
+            for d, epoch, _ in self.pool.residents():
+                if d == lane_digest:
+                    return epoch
+        return 0
 
     def _check_hbm(self, ticket: Ticket) -> None:
         """Advance the HBM pressure state machine and act on it.
@@ -1019,8 +1137,26 @@ class BatchScheduler:
         finally:
             _deadline.clear()
         batch_wall = time.monotonic() - t0
+        if self.result_cache is not None and digest:
+            # Remember every scanned item's verdict under the digest that
+            # ACTUALLY scanned it (which the dispatch boundary just
+            # resolved — a staged swap between probe and dispatch keys
+            # the new entries correctly).  Tier errors degrade inside the
+            # cache; they never fail a batch that already scanned.
+            for (_, data), sec in zip(combined, results):
+                self.result_cache.put(content_digest(data), digest, sec)
         for t, (lo, hi), wait in zip(batch, spans, waits):
-            out = SecretBatch(results[lo:hi])
+            scanned = results[lo:hi]
+            if t.cache_hits:
+                # Partial hit: re-interleave cached verdicts with the
+                # scanned misses at their original request positions.
+                it = iter(scanned)
+                out = SecretBatch(
+                    t.cache_hits[i] if i in t.cache_hits else next(it)
+                    for i in range(t.total_items)
+                )
+            else:
+                out = SecretBatch(scanned)
             out.ruleset_digest = digest
             out.ruleset_epoch = epoch
             if t.explain:
@@ -1043,6 +1179,14 @@ class BatchScheduler:
                     "memory": {
                         **memwatch.explain_block(),
                         "state": self._hbm_state,
+                    },
+                    # result-cache outcome for this ticket: how many of
+                    # its items rode in warm vs. paid for device time
+                    "cache": {
+                        "hits": len(t.cache_hits or ()),
+                        "misses": hi - lo,
+                        "ruleset_digest": digest,
+                        "resolved_from_cache": False,
                     },
                     "batch": {
                         "tickets": len(batch),
@@ -1125,6 +1269,15 @@ class BatchScheduler:
         }
         if faults.active():
             out["faults"] = faults.snapshot()
+        if self.result_cache is not None:
+            # Result-cache posture: per-tier degrade state + this
+            # scheduler's hit economics (items warm vs. device-paid).
+            out["cache"] = {
+                "hits": self.stats.cache_hits,
+                "misses": self.stats.cache_misses,
+                "resolved_requests": self.stats.cache_resolved,
+                "results": self.result_cache.snapshot(),
+            }
         if self.pool is not None:
             out["pool"] = [
                 {"digest": d, "epoch": e, "nbytes": n}
