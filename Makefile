@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test lint lint-changed lockcheck smoke serve-smoke obs-smoke slo-smoke tenancy-smoke mem-smoke chaos-smoke mesh-smoke cache-smoke bench bench-link bench-verify checks-corpus rules-cache perf-gate
+.PHONY: test lint lint-changed lockcheck smoke serve-smoke obs-smoke slo-smoke tenancy-smoke mem-smoke chaos-smoke mesh-smoke cache-smoke kernel-smoke bench bench-link bench-verify checks-corpus rules-cache perf-gate
 
 # Tier-1: the suite the driver holds the repo to (fast, CPU, no slow marks).
 # Lint runs first — a graftlint finding fails the build before pytest
@@ -15,9 +15,10 @@ test: lint
 	$(MAKE) chaos-smoke
 	$(MAKE) mesh-smoke
 	$(MAKE) cache-smoke
+	$(MAKE) kernel-smoke
 	$(MAKE) perf-gate
 
-# Static analysis: graftlint (project rules GL001-GL011, always available)
+# Static analysis: graftlint (project rules GL001-GL012, always available)
 # plus ruff + mypy when the environment has them (the pinned CI container
 # may not; config lives in pyproject.toml either way).
 lint:
@@ -148,6 +149,16 @@ cache-smoke:
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
 		BENCH_IMAGE=0 BENCH_TENANT=0 BENCH_OBS=0 BENCH_MEM=0 \
 		BENCH_FAULT=0 BENCH_MULTICHIP=0 $(PY) bench.py --smoke
+
+# Megakernel smoke (ops/megakernel.py + registry/aotcache.py): parity
+# fuzz of the one-dispatch MXU kernel vs the staged fused pipeline vs
+# the host oracle across codec modes and forced-host-device counts,
+# plus the AOT executable store's compile-once assertion (a warm
+# registry start performs ZERO kernel compiles) and the scheduler's
+# megakernel -> staged-sieve step-down rung.
+kernel-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_megakernel.py \
+		-m kernel_smoke -q -p no:cacheprovider
 
 # Performance regression gate: one smoke bench run (heavy sections off,
 # primary corpus only) appends to a throwaway ledger, then

@@ -1,0 +1,493 @@
+"""MXU-native megakernel (ops/megakernel.py): the one-Pallas-dispatch
+fusion of codec unpack -> gram sieve -> window/probe/gate derive ->
+packed verdict mask, plus its AOT executable store
+(registry/aotcache.py), mesh sharding (mega_rowfile family), and the
+serve scheduler's megakernel -> staged-sieve step-down rung.
+
+The binding CPU-CI contracts: megakernel findings are byte-identical to
+the staged fused pipeline and to the host oracle across every link
+codec mode and every forced-host-device count, and a warm AOT registry
+start performs ZERO kernel compiles (asserted against
+aotcache.stats()["compiles"] with a hermetic serializer; the real
+serialize_executable round-trip is TPU-only — the CPU backend does not
+persist jit symbols, which the never-trust loader counts as a reject
+and absorbs by recompiling).
+"""
+
+import json
+import os
+import random
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.kernel_smoke
+
+ALNUM = (
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+    "abcdefghijklmnopqrstuvwxyz0123456789"
+)
+
+
+def _corpus(seed: int, tile_len: int) -> list[tuple[str, bytes]]:
+    """The megakernel hard cases: NUL-heavy blobs (class 0 dead), an
+    exact-tile body (padding boundary), binary noise (out-of-alphabet
+    under sym codecs), a jumbo body (multi-tile file intervals), and an
+    empty file (invalid lane column)."""
+    rng = random.Random(seed)
+    up = ALNUM[:26]
+
+    def pick(chars, n):
+        return "".join(rng.choice(chars) for _ in range(n)).encode()
+
+    secrets = [
+        lambda: b"ghp_" + pick(ALNUM, 36),
+        lambda: b'"AKIA' + pick(up + "0123456789", 16) + b'" ',
+        lambda: b"sk_live_" + pick("0123456789abcdefghij", 20),
+        lambda: b"glpat-" + pick(ALNUM, 20),
+    ]
+    out = []
+    for i in range(10):
+        kind = i % 5
+        if kind == 0:
+            body = pick(ALNUM + " \n", rng.randint(50, 700))
+            body += b"\nkey = " + rng.choice(secrets)() + b"\n"
+        elif kind == 1:
+            body = bytes(rng.randrange(128, 256) for _ in range(250))
+            body += rng.choice(secrets)()
+        elif kind == 2:
+            body = b"\x00" * rng.randint(100, 500)
+            body += rng.choice(secrets)() + b"\x00" * 40
+        elif kind == 3:
+            sec = rng.choice(secrets)()
+            body = pick(ALNUM, tile_len - len(sec)) + sec
+            assert len(body) == tile_len
+        else:
+            body = (
+                pick(ALNUM + " \n", 3000)
+                + b"\ntoken " + rng.choice(secrets)() + b"\n"
+                + pick(ALNUM + " \n", 1500)
+            )
+        out.append((f"f{i:03d}.bin", body))
+    out.append(("empty.txt", b""))
+    return out
+
+
+def _engine(codec_mode: str, megakernel, tile_len: int = 512, mesh=None):
+    from trivy_tpu.engine.device import TpuSecretEngine
+
+    prev = os.environ.get("TRIVY_TPU_LINK_CODEC")
+    os.environ["TRIVY_TPU_LINK_CODEC"] = codec_mode
+    try:
+        return TpuSecretEngine(
+            kernel="pallas", fused=True, megakernel=megakernel,
+            tile_len=tile_len, mesh=mesh,
+        )
+    finally:
+        if prev is None:
+            os.environ.pop("TRIVY_TPU_LINK_CODEC", None)
+        else:
+            os.environ["TRIVY_TPU_LINK_CODEC"] = prev
+
+
+# -- parity fuzz: megakernel vs staged fused vs host oracle ---------------
+
+
+def test_megakernel_fuzz_parity_all_codec_modes():
+    """One-dispatch megakernel findings are byte-identical to the staged
+    fused pipeline across every codec mode, and match the oracle."""
+    from trivy_tpu.engine.oracle import OracleScanner
+    from trivy_tpu.registry.store import findings_fingerprint
+
+    tile_len = 512
+    corpus = _corpus(seed=42, tile_len=tile_len)
+    fps = {}
+    mega_engines = {}
+    for mode in ("off", "auto", "4", "6"):
+        for mega in (False, True):
+            eng = _engine(mode, mega, tile_len)
+            assert eng.megakernel_active is mega, (mode, mega)
+            if mega:
+                mega_engines[mode] = eng
+            fps[(mode, mega)] = findings_fingerprint(eng, corpus)
+    assert len(set(fps.values())) == 1, {k: len(v) for k, v in fps.items()}
+    # the mega engines actually took the one-dispatch path
+    for mode, eng in mega_engines.items():
+        assert eng.stats.d2h_bytes > 0, mode
+    oracle = OracleScanner()
+    for (path, content), dev in zip(
+        corpus, mega_engines["off"].scan_batch(corpus)
+    ):
+        ref = oracle.scan(path, content)
+        assert [
+            (f.rule_id, f.start_line, f.match) for f in dev.findings
+        ] == [(f.rule_id, f.start_line, f.match) for f in ref.findings], path
+
+
+def test_megakernel_mesh_parity_1_2_4_8_devices():
+    """Byte-identical findings at every forced-host-device count; the
+    meshed path psums pre-threshold partial counts (mega_rowfile plan
+    family), so window membership never splits across shards."""
+    from trivy_tpu.mesh import topology as mesh_topology
+    from trivy_tpu.registry.store import findings_fingerprint
+
+    corpus = _corpus(seed=7, tile_len=512)
+    prints = {}
+    for n in (1, 2, 4, 8):
+        mesh_topology.clear_cache()
+        mesh = mesh_topology.get_mesh(override=str(n))
+        eng = _engine("off", True, 512, mesh=mesh)
+        assert eng.megakernel_active
+        assert (eng._mega_fn is not None) == (n > 1)
+        prints[n] = findings_fingerprint(eng, corpus)
+    mesh_topology.clear_cache()
+    staged = _engine("off", False, 512)
+    prints["staged"] = findings_fingerprint(staged, corpus)
+    assert len(set(prints.values())) == 1, {
+        k: len(v) for k, v in prints.items()
+    }
+
+
+def test_megakernel_staged_sieve_fallback_parity():
+    """scan_batch_staged_sieve (the scheduler's step-down rung) disables
+    the one-dispatch path for the call and restores it after, producing
+    identical findings."""
+    corpus = _corpus(seed=3, tile_len=512)
+    eng = _engine("off", True, 512)
+    flat = lambda res: [
+        (s.file_path, [(f.rule_id, f.start_line, f.match) for f in s.findings])
+        for s in res
+    ]
+    want = flat(eng.scan_batch(corpus))
+    got = flat(eng.scan_batch_staged_sieve(corpus))
+    assert got == want
+    assert eng.megakernel_active  # restored after the rung
+
+
+def test_mega_store_digest_keyed_by_file_intervals():
+    """Identical row bytes under a different file split must not alias
+    in the resident row store: the mega digest folds in the file
+    interval table."""
+    eng = _engine("off", True, 512)
+    body = b"x = 1\n" + b"A" * 500
+    one = eng.scan_batch([("a.txt", body + body)])
+    hits = eng.stats.resident_hits
+    two = eng.scan_batch([("a.txt", body), ("b.txt", body)])
+    # same packed rows, different intervals -> no resident hit
+    assert eng.stats.resident_hits == hits
+    assert len(one) == 1 and len(two) == 2
+
+
+# -- unit: verdict bit packing --------------------------------------------
+
+
+def test_pack_mask_bits_matches_numpy_packbits():
+    import jax
+
+    from trivy_tpu.ops.megakernel import pack_mask_bits
+
+    rng = np.random.default_rng(11)
+    for r in (1, 7, 8, 86, 129):
+        cand = rng.integers(0, 2, size=(5, r)).astype(bool)
+        got = np.asarray(jax.jit(pack_mask_bits)(cand))
+        want = np.packbits(cand, axis=1)
+        assert np.array_equal(got, want), r
+        back = np.unpackbits(got, axis=1)[:, :r].astype(bool)
+        assert np.array_equal(back, cand), r
+
+
+# -- AOT executable store (registry/aotcache.py) --------------------------
+
+
+class _FakeExe:
+    """Stands in for a compiled executable under the hermetic serializer
+    (the CPU backend cannot round-trip real jit symbols)."""
+
+    def __init__(self, tag):
+        self.tag = tag
+
+    def __call__(self, *a):
+        return self.tag
+
+
+def _fake_serializer(monkeypatch):
+    from jax.experimental import serialize_executable as se
+
+    monkeypatch.setattr(
+        se, "serialize",
+        lambda exe: (
+            json.dumps(getattr(exe, "tag", "opaque")).encode(), "it", "ot"
+        ),
+    )
+    monkeypatch.setattr(
+        se, "deserialize_and_load",
+        lambda payload, it, ot: _FakeExe(json.loads(payload.decode())),
+    )
+
+
+def test_aot_roundtrip_compile_once(tmp_path, monkeypatch):
+    from trivy_tpu.registry import aotcache
+
+    _fake_serializer(monkeypatch)
+    aotcache.reset_stats()
+    key = dict(
+        platform="tpu", ruleset_digest="rd01", kernel_id="kid01",
+        shape=(4096, 8),
+    )
+    exe = aotcache.get_or_compile(
+        str(tmp_path), **key, lower_fn=lambda: _FakeExe("v1")
+    )
+    assert exe.tag == "v1"
+    assert aotcache.stats() == {
+        "compiles": 1, "hits": 0, "misses": 1, "rejects": 0
+    }
+    aotcache.reset_stats()
+    warm = aotcache.get_or_compile(
+        str(tmp_path), **key,
+        lower_fn=lambda: pytest.fail("warm start must not compile"),
+    )
+    assert warm.tag == "v1"
+    assert aotcache.stats()["compiles"] == 0
+    assert aotcache.stats()["hits"] == 1
+
+
+def test_aot_tamper_rejected(tmp_path, monkeypatch):
+    """A flipped payload byte fails the sha256 check: reject, then a
+    fresh compile replaces the entry (never-trust, never-wrong)."""
+    from trivy_tpu.registry import aotcache
+
+    _fake_serializer(monkeypatch)
+    key = dict(
+        platform="tpu", ruleset_digest="rd01", kernel_id="kid01",
+        shape=(4096, 8),
+    )
+    aotcache.get_or_compile(
+        str(tmp_path), **key, lower_fn=lambda: _FakeExe("v1")
+    )
+    (bin_path,) = [
+        p for p in tmp_path.iterdir() if p.suffix == ".bin"
+    ]
+    blob = bytearray(bin_path.read_bytes())
+    blob[0] ^= 0xFF
+    bin_path.write_bytes(bytes(blob))
+    aotcache.reset_stats()
+    exe = aotcache.get_or_compile(
+        str(tmp_path), **key, lower_fn=lambda: _FakeExe("v2")
+    )
+    assert exe.tag == "v2"
+    assert aotcache.stats()["rejects"] == 1
+    assert aotcache.stats()["compiles"] == 1
+
+
+def test_aot_jax_version_mismatch_rejected(tmp_path, monkeypatch):
+    """An entry recorded under a different jax version is rejected even
+    when the payload hash is intact."""
+    from trivy_tpu.registry import aotcache
+
+    _fake_serializer(monkeypatch)
+    key = dict(
+        platform="tpu", ruleset_digest="rd01", kernel_id="kid01",
+        shape=(4096, 8),
+    )
+    aotcache.get_or_compile(
+        str(tmp_path), **key, lower_fn=lambda: _FakeExe("v1")
+    )
+    (man_path,) = [
+        p for p in tmp_path.iterdir() if p.suffix == ".json"
+    ]
+    man = json.loads(man_path.read_text())
+    man["jax_version"] = "0.0.0-stale"
+    man_path.write_text(json.dumps(man))
+    aotcache.reset_stats()
+    exe = aotcache.get_or_compile(
+        str(tmp_path), **key, lower_fn=lambda: _FakeExe("v2")
+    )
+    assert exe.tag == "v2"
+    assert aotcache.stats()["rejects"] == 1
+
+
+def test_aot_kernel_id_changes_key(tmp_path, monkeypatch):
+    """A rebaked ruleset (new kernel id) misses rather than aliasing the
+    stale executable."""
+    from trivy_tpu.registry import aotcache
+
+    _fake_serializer(monkeypatch)
+    base = dict(platform="tpu", ruleset_digest="rd01", shape=(4096, 8))
+    aotcache.get_or_compile(
+        str(tmp_path), **base, kernel_id="kid01",
+        lower_fn=lambda: _FakeExe("v1"),
+    )
+    aotcache.reset_stats()
+    exe = aotcache.get_or_compile(
+        str(tmp_path), **base, kernel_id="kid02",
+        lower_fn=lambda: _FakeExe("v2"),
+    )
+    assert exe.tag == "v2"
+    assert aotcache.stats()["misses"] == 1
+    assert aotcache.stats()["rejects"] == 0
+
+
+def test_warm_registry_start_zero_compiles(tmp_path, monkeypatch):
+    """The acceptance bar: a second engine over a warm AOT cache dir
+    performs zero kernel compiles — the executable deserializes from the
+    registry artifact store (hermetic serializer; on real TPUs the same
+    assertion holds with serialize_executable)."""
+    from trivy_tpu.registry import aotcache
+
+    _fake_serializer(monkeypatch)
+
+    def fake_fused_fn():
+        return SimpleNamespace(
+            lower=lambda *a: SimpleNamespace(
+                compile=lambda: _FakeExe("mega-exe")
+            )
+        )
+
+    cold = _engine("off", True, 512)
+    cold._aot_dir = str(tmp_path)
+    monkeypatch.setattr(cold._mega, "fused_fn", fake_fused_fn)
+    rows = cold._buckets()[0]
+    # cold start: one compile, persisted
+    aotcache.reset_stats()
+    fn1 = cold._mega_exec(rows, 8)
+    assert isinstance(fn1, _FakeExe)
+    assert aotcache.stats()["compiles"] == 1
+    # warm start: a fresh engine over the same ruleset + cache dir
+    warm = _engine("off", True, 512)
+    warm._aot_dir = str(tmp_path)
+    monkeypatch.setattr(warm._mega, "fused_fn", fake_fused_fn)
+    assert warm._mega.kernel_id == cold._mega.kernel_id
+    aotcache.reset_stats()
+    fn2 = warm._mega_exec(rows, 8)
+    assert aotcache.stats()["compiles"] == 0, aotcache.stats()
+    assert aotcache.stats()["hits"] == 1
+    assert isinstance(fn2, _FakeExe)
+
+
+def test_aot_cpu_backend_degrades_to_recompile(tmp_path):
+    """Without the hermetic serializer the CPU backend cannot reload its
+    own executables (jit symbols are not serialized) — the loader counts
+    a reject and the engine falls back to a working fresh compile."""
+    from trivy_tpu.registry import aotcache
+
+    eng = _engine("off", True, 512)
+    eng._aot_dir = str(tmp_path)
+    rows = eng._buckets()[0]
+    aotcache.reset_stats()
+    eng._mega_exec(rows, 8)
+    assert aotcache.stats()["compiles"] == 1
+    eng2 = _engine("off", True, 512)
+    eng2._aot_dir = str(tmp_path)
+    aotcache.reset_stats()
+    fn = eng2._mega_exec(rows, 8)
+    assert fn is not None
+    st = aotcache.stats()
+    assert st["hits"] + st["rejects"] + st["compiles"] >= 1
+
+
+# -- gate pricing: the mega profile ---------------------------------------
+
+
+def test_gate_mega_profile_prices_exec_rate(monkeypatch):
+    """The mega gate profile layers a measured-exec-rate bar on top of
+    the fused link terms: a fast kernel clears it, a slow one narrows
+    the decision even on a wide link."""
+    from trivy_tpu.engine import hybrid
+    from trivy_tpu.engine import link as link_mod
+
+    monkeypatch.setenv("TRIVY_TPU_LINK", "colo")
+    fast = hybrid.gate_terms(
+        d2h_ratio=link_mod.FUSED_MASK_D2H_RATIO, profile="mega",
+        exec_mb_s=hybrid.MEGA_GATE_EXEC_MB_S * 4,
+    )
+    assert fast["wide"]
+    assert fast["exec_threshold_mb_per_sec"] == hybrid.MEGA_GATE_EXEC_MB_S
+    slow = hybrid.gate_terms(
+        d2h_ratio=link_mod.FUSED_MASK_D2H_RATIO, profile="mega",
+        exec_mb_s=hybrid.MEGA_GATE_EXEC_MB_S / 4,
+    )
+    assert not slow["wide"]
+    assert slow["margin"] < 0
+
+
+# -- scheduler: megakernel -> staged-sieve step-down rung -----------------
+
+
+class _Breaker:
+    def __init__(self):
+        self.failures = 0
+        self.successes = 0
+
+    def allow(self):
+        return True
+
+    def record_failure(self):
+        self.failures += 1
+
+    def record_success(self):
+        self.successes += 1
+
+
+def _ladder_call(engine):
+    from trivy_tpu.serve.scheduler import BatchScheduler
+
+    fake = SimpleNamespace(breaker=_Breaker(), pool=None)
+    out = BatchScheduler._scan_with_domains(fake, engine, [("a", b"x")])
+    return out, fake.breaker
+
+
+def test_scheduler_megakernel_steps_down_to_staged_sieve():
+    """A megakernel failure degrades ONE rung: the staged fused sieve
+    absorbs the batch; legacy device and host are never consulted."""
+    calls = []
+    engine = SimpleNamespace(
+        verify="fused",
+        megakernel_active=True,
+        scan_batch=lambda items: (_ for _ in ()).throw(ValueError("boom")),
+        scan_batch_staged_sieve=lambda items: calls.append("staged")
+        or ["staged-result"],
+        scan_batch_device_legacy=lambda items: calls.append("legacy"),
+        scan_batch_host=lambda items: calls.append("host"),
+    )
+    (results, path), breaker = _ladder_call(engine)
+    assert results == ["staged-result"] and path == "degraded"
+    assert calls == ["staged"]
+    assert breaker.failures == 1
+
+
+def test_scheduler_mega_rung_skipped_when_inactive():
+    """With the megakernel gated off, the ladder goes straight to the
+    fused engine's legacy rung."""
+    calls = []
+    engine = SimpleNamespace(
+        verify="fused",
+        megakernel_active=False,
+        scan_batch=lambda items: (_ for _ in ()).throw(ValueError("boom")),
+        scan_batch_staged_sieve=lambda items: calls.append("staged"),
+        scan_batch_device_legacy=lambda items: calls.append("legacy")
+        or ["legacy-result"],
+        scan_batch_host=lambda items: calls.append("host"),
+    )
+    (results, path), breaker = _ladder_call(engine)
+    assert results == ["legacy-result"] and path == "degraded"
+    assert calls == ["legacy"]
+
+
+def test_scheduler_mega_failure_falls_to_next_rung():
+    """Staged-sieve failure keeps descending the ladder and feeds the
+    breaker at each rung."""
+    def boom(items):
+        raise ValueError("boom")
+
+    engine = SimpleNamespace(
+        verify="fused",
+        megakernel_active=True,
+        scan_batch=boom,
+        scan_batch_staged_sieve=boom,
+        scan_batch_device_legacy=boom,
+        scan_batch_host=lambda items: ["host-result"],
+    )
+    (results, path), breaker = _ladder_call(engine)
+    assert results == ["host-result"] and path == "degraded"
+    assert breaker.failures == 3

@@ -24,6 +24,15 @@ Rule catalogue (each with allow/deny fixtures under fixtures/):
   GL009  unledgered residency: device_put results stored on self.*/module
          globals without a memwatch registration (or `# graftlint:
          transient` annotation)
+  GL010  silent broad excepts: bare/broad handlers that swallow without a
+         `# graftlint: swallow(reason)` annotation
+  GL011  mesh execution-plane hazards: per-dispatch sharded-callable
+         rebuilds; plan-constant tensors placed under partitioned
+         shardings
+  GL012  Pallas kernel hygiene: pallas_call / make_*_kernel construction
+         in per-batch hot paths (must be jit-held, lru_cached, or
+         registry-warmed); non-pow2 literal VMEM block dims in BlockSpec
+         shapes
 
 The runtime complement is trivy_tpu/lockcheck.py (TRIVY_TPU_LOCKCHECK=1
 lock-order + owner-role sanitizer); graftlint checks what must hold by

@@ -1,0 +1,42 @@
+"""GL012 deny fixture: per-batch Pallas program construction and
+non-pow2 literal VMEM block dims."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from trivy_tpu.ops.gram_sieve_pallas import _make_window_kernel
+
+
+def scan_batches(batches, kernel, shape):
+    for rows in batches:
+        yield pl.pallas_call(  # GL012: re-lowered every batch
+            kernel, out_shape=shape, grid=(8,)
+        )(rows)
+
+
+def sieve_once(rows, kernel, shape):
+    fn = pl.pallas_call(kernel, out_shape=shape, grid=(8,))  # GL012
+    return fn(rows)
+
+
+def rebuild_kernel_per_dispatch(masks, vals, rows):
+    kernel = _make_window_kernel(masks, vals, 4)  # GL012: uncached factory
+    return kernel
+
+
+def odd_block_shape(kernel, shape):
+    return pl.pallas_call(  # graftlint: jit-cached
+        kernel,
+        out_shape=shape,
+        grid=(8,),
+        in_specs=[
+            pl.BlockSpec(  # GL012: 96 fragments the VMEM tiling
+                (96, 384), lambda i: (i, 0), memory_space=pltpu.VMEM
+            )
+        ],
+        out_specs=pl.BlockSpec(
+            (64, 128), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+    )

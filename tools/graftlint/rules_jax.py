@@ -726,3 +726,150 @@ def check_mesh_plan(mod: Module) -> list[Finding]:
                 )
             )
     return out
+
+
+# -- GL012: Pallas kernel-construction + VMEM block-shape hygiene -----------
+
+_KERNEL_FACTORY_RE_SUFFIX = "_kernel"
+_KERNEL_FACTORY_PREFIXES = ("make_", "_make_")
+
+
+def _is_pallas_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = dotted_name(node.func)
+    return d == "pallas_call" or d.endswith(".pallas_call")
+
+
+def _is_kernel_factory_call(node: ast.AST) -> bool:
+    """``make_*_kernel(...)`` / ``_make_*_kernel(...)``: a factory that
+    closes kernel constants into a fresh Pallas kernel callable."""
+    if not isinstance(node, ast.Call):
+        return False
+    d = dotted_name(node.func)
+    if not d:
+        return False
+    leaf = d.split(".")[-1]
+    return leaf.endswith(_KERNEL_FACTORY_RE_SUFFIX) and leaf.startswith(
+        _KERNEL_FACTORY_PREFIXES
+    )
+
+
+def _jit_decorated(fn: ast.FunctionDef) -> bool:
+    """@jax.jit (or functools.partial(jax.jit, ...)) on the def: the jit
+    cache makes any inner kernel construction once-per-trace, not
+    per-call."""
+    for dec in fn.decorator_list:
+        d = dotted_name(dec)
+        if d in _JIT_NAMES:
+            return True
+        if isinstance(dec, ast.Call):
+            dn = dotted_name(dec.func)
+            if dn in _JIT_NAMES:
+                return True
+            if dn in ("functools.partial", "partial") and any(
+                dotted_name(a) in _JIT_NAMES for a in dec.args
+            ):
+                return True
+    return False
+
+
+def _pow2(v: int) -> bool:
+    return v >= 1 and (v & (v - 1)) == 0
+
+
+@rule("GL012")
+def check_pallas_kernel_hygiene(mod: Module) -> list[Finding]:
+    """Pallas megakernel hazards (ops/gram_sieve_pallas.py,
+    ops/megakernel.py).
+
+    (a) ``pl.pallas_call`` (or a ``make_*_kernel`` factory) constructed
+    in a per-batch hot path: every call re-traces, re-lowers, and
+    re-compiles the whole Pallas program — seconds per dispatch on a
+    real TPU.  Escape hatches match GL001: construct under an enclosing
+    @jax.jit (the trace cache holds it), lru_cache the factory, store
+    the callable on self / a module global, or annotate ``# graftlint:
+    jit-cached`` when every caller is itself a cached jit (the
+    registry-warmed megakernel discipline).
+
+    (b) A literal VMEM block dimension in a ``BlockSpec`` shape that is
+    not a power of two: the Mosaic lowering tiles VMEM in 8x128 lanes
+    and the engine's row buckets (TILE_BUCKETS_PALLAS) are pow2-aligned,
+    so a non-pow2 literal block dim fragments the tiling and silently
+    pads every block.  Derived sizes belong in named constants, where
+    the alignment is asserted at build time, not in shape literals.
+    """
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        line = node.lineno
+        # -- arm (a): per-call kernel construction
+        if _is_pallas_call(node) or _is_kernel_factory_call(node):
+            if mod.has_directive(line, "jit-cached"):
+                continue
+            leaf = dotted_name(node.func).split(".")[-1]
+            if mod.in_loop(node):
+                out.append(
+                    Finding(
+                        "GL012",
+                        mod.relpath,
+                        line,
+                        f"{leaf}() constructed inside a loop re-lowers "
+                        "the Pallas program every iteration; hoist it "
+                        "out or cache by static key",
+                    )
+                )
+                continue
+            fn = mod.enclosing_function(node)
+            if fn is None:
+                continue  # module level: one construction per import
+            chain = mod.function_chain(node)
+            if any(mod.has_directive(f.lineno, "jit-cached") for f in chain):
+                continue
+            if any(_jit_decorated(f) for f in chain):
+                continue  # the jit trace cache holds the construction
+            if any(_decorator_names(f) & _CACHE_DECORATORS for f in chain):
+                continue
+            if any(_self_attr_assigned(f) for f in chain):
+                continue
+            if _assigned_to_global(mod, node, fn):
+                continue
+            out.append(
+                Finding(
+                    "GL012",
+                    mod.relpath,
+                    line,
+                    f"{leaf}() constructed inside {fn.name}() with no "
+                    "caching; every call re-traces and re-compiles the "
+                    "Pallas program (construct under jit, lru_cache, "
+                    "cache on self, or annotate jit-cached)",
+                )
+            )
+            continue
+        # -- arm (b): non-pow2 literal block dims in a BlockSpec shape
+        d = dotted_name(node.func)
+        if not (d == "BlockSpec" or d.endswith(".BlockSpec")):
+            continue
+        if not node.args or not isinstance(node.args[0], ast.Tuple):
+            continue
+        bad = [
+            e.value
+            for e in node.args[0].elts
+            if isinstance(e, ast.Constant)
+            and isinstance(e.value, int)
+            and not _pow2(e.value)
+        ]
+        if bad:
+            out.append(
+                Finding(
+                    "GL012",
+                    mod.relpath,
+                    line,
+                    f"BlockSpec literal block dim {bad[0]} is not a "
+                    "power of two; non-pow2 blocks fragment the VMEM "
+                    "tiling (TILE_BUCKETS_PALLAS alignment) — use a "
+                    "named, build-time-asserted constant",
+                )
+            )
+    return out

@@ -161,6 +161,8 @@ class TpuSecretEngine:
         resident_chunks: int | None = None,
         compiled=None,
         fused: bool | None = None,
+        megakernel: bool | None = None,
+        aot_cache_dir: str | None = None,
     ):
         from trivy_tpu.engine.pipeline import (
             ResidentChunkCache,
@@ -199,6 +201,18 @@ class TpuSecretEngine:
         self._fused_requested = fused
         self._row_store = None
         self._sieve_donated = None
+        # Megakernel state (ops/megakernel.py): the one-dispatch fusion of
+        # unpack->sieve->derive->verdict.  Built on the Pallas gram path
+        # below; `_mega_on` is the runtime switch the gate pricing and the
+        # scheduler's step-down rung flip without rebuilding the program.
+        self._mega = None
+        self._mega_on = False
+        self._mega_requested = megakernel
+        self._mega_fn = None  # meshed fused callable (shard_map + psum)
+        self._kernel_tag = ""
+        self._aot_dir = aot_cache_dir or os.environ.get(
+            "TRIVY_TPU_AOT_CACHE"
+        ) or None
         self._mesh = mesh
         self._tile_buckets = TILE_BUCKETS
         # Resolved against the unified topology below (native never
@@ -341,6 +355,57 @@ class TpuSecretEngine:
                     # An explicit caller cap (memory bound) is respected:
                     # buckets are min-capped in _buckets().
                     self.max_batch_tiles = self._tile_buckets[-1]
+                # Megakernel: same opt-in ladder as fused (explicit ctor
+                # arg > TRIVY_TPU_MEGAKERNEL env > on-TPU default); rides
+                # on the fused contract (it produces what the fused path
+                # produces, one dispatch earlier), so fused-off disables
+                # it outright.  Auto-mode TPU starts additionally pass
+                # through the measured-rate gate in warmup().
+                _menv = os.environ.get("TRIVY_TPU_MEGAKERNEL", "")
+                if self._mega_requested is not None:
+                    want_mega = bool(self._mega_requested)
+                elif _menv:
+                    want_mega = _menv != "0"
+                else:
+                    want_mega = self._fused and on_tpu
+                if (
+                    want_mega
+                    and self._fused
+                    and self.gset.num_grams > 0
+                    and tile_len >= 256
+                    and tile_len & (tile_len - 1) == 0
+                ):
+                    from trivy_tpu.ops.megakernel import (
+                        MegaGramSieve,
+                        make_sharded_megakernel,
+                    )
+
+                    self._mega = MegaGramSieve(
+                        cmasks, cvals,
+                        wmember=self.gset._wmember,
+                        pmember=self.gset._pmember,
+                        pwindows=self.gset._pwindows,
+                        probe_has_gram=self.gset.probe_has_gram,
+                        gate_member=self._gate_member,
+                        gate_any=self._gate_any,
+                        conj_member=self._conj_member,
+                        conj_any=self._conj_any,
+                        num_conjuncts=self._num_conjuncts,
+                        row_len=tile_len,
+                        sym_bits=(
+                            self._link.sym_bits
+                            if self._link is not None else None
+                        ),
+                    )
+                    # Resident-row store keys carry the kernel id: a
+                    # ruleset/codec change re-bakes the constants, and a
+                    # stale fused verdict must never alias the new program.
+                    self._kernel_tag = ":" + self._mega.kernel_id
+                    if mesh is not None:
+                        self._mega_fn = make_sharded_megakernel(
+                            mesh, self._mega
+                        )
+                    self._mega_on = True
             else:
                 masks, vals = gs_mod.pad_grams(cmasks, cvals)
                 self._masks = jnp.asarray(masks)
@@ -423,6 +488,73 @@ class TpuSecretEngine:
             # bucket's compiled shape is the CODED row width.
             batch = jnp.zeros((rows, self._staged_cols), dtype=jnp.uint8)
             jax.block_until_ready(self._sieve_fn(batch))
+        if self._mega is not None and self._mega_on:
+            # Compile (or AOT-load) the megakernel at the smallest
+            # bucket x minimum file pad — the shape the gate pricing
+            # dispatch uses; other (rows, fp) shapes compile on first
+            # use and land in the same AOT store.
+            rows0 = self._buckets()[0]
+            fn = (
+                self._mega_fn if self._mega_fn is not None
+                else self._mega_exec(rows0, 8)
+            )
+            args = (
+                jnp.zeros((rows0, self._staged_cols), jnp.uint8),
+                jnp.zeros((1, 8), jnp.int32),
+                jnp.full((1, 8), -1, jnp.int32),
+                jnp.zeros((8, 1), jnp.int8),
+            )
+            jax.block_until_ready(fn(*args))
+            if self._mega_requested is None and not os.environ.get(
+                "TRIVY_TPU_MEGAKERNEL", ""
+            ):
+                # Auto mode only: explicit ctor/env choices are never
+                # second-guessed by the gate (tests and operators pin).
+                self._price_mega_gate(fn, args, rows0)
+
+    def _price_mega_gate(self, fn, args, rows: int) -> None:
+        """Price the megakernel gate from a MEASURED warm dispatch: the
+        fused program must clear both the fused link bar and an absolute
+        exec-rate floor (hybrid.MEGA_GATE_EXEC_MB_S) — a chip whose fused
+        dispatch crawls should keep the staged path, whose stages pipeline
+        across chunks.  Records the decision in the gate audit log."""
+        import time as _time
+
+        import jax
+
+        from trivy_tpu.engine import link as link_mod
+        from trivy_tpu.engine.hybrid import gate_terms
+        from trivy_tpu.mesh import topology as mesh_topology
+        from trivy_tpu.obs import gatelog
+
+        t0 = _time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        dt = max(_time.perf_counter() - t0, 1e-9)
+        rate = rows * self.tile_len / dt / 1e6  # raw MB/s through the sieve
+        terms = gate_terms(
+            h2d_ratio=self._link.ratio if self._link is not None else 1.0,
+            d2h_ratio=link_mod.FUSED_MASK_D2H_RATIO,
+            profile="mega",
+            devices=mesh_topology.mesh_device_count(self._mesh),
+            exec_mb_s=rate,
+        )
+        self._mega_on = bool(terms["wide"])
+        gatelog.record(
+            requested="auto",
+            backend="fused",
+            reason="mega-wide" if self._mega_on else "mega-narrow",
+            profile=terms["profile"],
+            devices=terms["devices"],
+            link_mb_per_sec=terms["link_mb_per_sec"],
+            link_rtt_s=terms["link_rtt_s"],
+            h2d_ratio=terms["h2d_ratio"],
+            d2h_ratio=terms["d2h_ratio"],
+            eff_mb_per_sec=terms["eff_mb_per_sec"],
+            eff_threshold_mb_per_sec=terms["eff_threshold_mb_per_sec"],
+            rtt_threshold_s=terms["rtt_threshold_s"],
+            codec=terms["codec"],
+            margin=terms["margin"],
+        )
 
     def _build_member_matrices(self) -> None:
         """Dense probe->rule membership for the matmul-form candidate
@@ -783,11 +915,16 @@ class TpuSecretEngine:
     def _derive_fn(self):
         """Jitted on-device candidate derivation, built once per engine:
         hit words -> per-file gram intervals (cumsum + row-range
-        difference, mirroring DenseBatch.file_hits) -> window/probe
-        matmuls (GramSet.probe_hits_bool) -> gate/conjunct membership
-        matmuls (candidate_matrix_bool) -> [Fp, R] uint8 candidates.
-        All f32 — integer counts bounded far below 2^24, so the device
-        result is bit-identical to the host derivation."""
+        difference, mirroring DenseBatch.file_hits) -> window/probe/gate
+        membership resolution as int8 MXU contractions -> [Fp, R] uint8
+        candidates.  The membership matmuls run int8 x int8 -> int32
+        `dot_general` against baked 0/1 constant matrices (the MXU-native
+        form — the PR 5 class-space alphabet bounds every operand to a
+        membership bit), and the interval cumsum stays int32; every value
+        is an exact small-integer count, so the device result is
+        bit-identical to the host f32 derivation it replaced (integer
+        thresholds on integer counts — see ops/megakernel.py module doc
+        for the bound argument)."""
         cached = getattr(self, "_derive_jit", None)
         if cached is not None:
             return cached
@@ -807,16 +944,23 @@ class TpuSecretEngine:
                 else gset.num_grams
             )
             expand = jnp.arange(n, dtype=jnp.int32)
-        wmember = jnp.asarray(gset._wmember)  # [G, W] f32 0/1
-        pmember = jnp.asarray(gset._pmember)  # [W, P] f32 0/1
-        pwindows = jnp.asarray(gset._pwindows)  # [P] f32 counts
+        wmember = np.asarray(gset._wmember).astype(np.int8)  # [G, W] 0/1
+        pmember = np.asarray(gset._pmember).astype(np.int8)  # [W, P] 0/1
+        pwindows = np.asarray(gset._pwindows).astype(np.int32)  # [P]
         nogram = jnp.asarray(~gset.probe_has_gram)  # [P] bool
-        gate_member = jnp.asarray(self._gate_member)  # [P, R]
-        conj_member = jnp.asarray(self._conj_member)  # [P, R*K]
+        gate_member = np.asarray(self._gate_member).astype(np.int8)
+        conj_member = np.asarray(self._conj_member).astype(np.int8)
         gate_any = jnp.asarray(self._gate_any)  # [R] bool
         conj_any = jnp.asarray(self._conj_any)  # [R, K] bool
         r = len(self.pset.plans)
         k = self._num_conjuncts
+
+        def idot(a, b):
+            return jax.lax.dot_general(
+                a.astype(jnp.int8), jnp.asarray(b),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
 
         @jax.jit
         def derive(hits, lo, hi, valid):
@@ -827,21 +971,18 @@ class TpuSecretEngine:
             t = hits.shape[0]
             bits = (
                 (hits[:, :, None] >> jnp.arange(32, dtype=jnp.uint32)) & 1
-            ).reshape(t, -1)[:, expand].astype(jnp.float32)  # [T, G]
+            ).reshape(t, -1)[:, expand].astype(jnp.int32)  # [T, G]
             cs = jnp.cumsum(bits, axis=0)
             csz = jnp.concatenate(
-                [jnp.zeros((1, bits.shape[1]), jnp.float32), cs]
+                [jnp.zeros((1, bits.shape[1]), jnp.int32), cs]
             )
             lo_c = jnp.clip(lo, 0, t)
             hi_c = jnp.clip(hi + 1, 0, t)
             gh = ((csz[hi_c] - csz[lo_c]) > 0) & valid[:, None]  # [Fp, G]
-            win = (gh.astype(jnp.float32) @ wmember) > 0
-            ph = (
-                (win.astype(jnp.float32) @ pmember) >= pwindows[None, :]
-            ) | nogram[None, :]
-            phf = ph.astype(jnp.float32)
-            gate_ok = (~gate_any[None, :]) | ((phf @ gate_member) > 0)
-            conj_hit = (phf @ conj_member).reshape(-1, r, k) > 0
+            win = idot(gh, wmember) > 0
+            ph = (idot(win, pmember) >= pwindows[None, :]) | nogram[None, :]
+            gate_ok = (~gate_any[None, :]) | (idot(ph, gate_member) > 0)
+            conj_hit = idot(ph, conj_member).reshape(-1, r, k) > 0
             conj_ok = (~conj_any[None] | conj_hit).all(-1)
             return (gate_ok & conj_ok).astype(jnp.uint8)
 
@@ -873,6 +1014,152 @@ class TpuSecretEngine:
         ph.done(out)
         arr = self._fetch_hits(out)  # compacted d2h + byte accounting
         return arr[:f].astype(bool)
+
+    @property
+    def megakernel_active(self) -> bool:
+        """True when the fused one-dispatch program is built and enabled
+        (the scheduler's step-down rung keys on this)."""
+        return self._mega is not None and self._mega_on
+
+    def _use_megakernel(self) -> bool:
+        return self.megakernel_active and self._use_fused_derive()
+
+    def _mega_exec(self, rows: int, fp: int):
+        """Compiled megakernel executable for the (rows, fp) shape pair,
+        engine-cached.  With an AOT cache dir configured, executables
+        persist in the registry artifact store keyed (platform, jax
+        version, ruleset digest, kernel id, shape) — a warm fleet start
+        deserializes instead of compiling (registry/aotcache.py; any
+        validation failure falls back to a fresh compile)."""
+        cache = getattr(self, "_mega_exec_cache", None)
+        if cache is None:
+            cache = self._mega_exec_cache = {}
+        key = (rows, fp)
+        fn = cache.get(key)
+        if fn is not None:
+            return fn
+        mega = self._mega
+        fused = mega.fused_fn()
+        fn = fused
+        if self._aot_dir:
+            import jax
+
+            from trivy_tpu.registry import aotcache
+
+            exe = aotcache.get_or_compile(
+                self._aot_dir,
+                platform=jax.devices()[0].platform,
+                ruleset_digest=self.ruleset_digest,
+                kernel_id=mega.kernel_id,
+                shape=key,
+                lower_fn=lambda: fused.lower(
+                    *mega.aot_specs(rows, fp)
+                ).compile(),
+            )
+            if exe is not None:
+                fn = exe
+        cache[key] = fn
+        return fn
+
+    def _mega_candidates(self, batch) -> np.ndarray | None:
+        """One fused dispatch from packed bytes to verdict bits: stage
+        the coded rows, run the megakernel (unpack/sieve/derive live in
+        VMEM — no intermediate ever lands in HBM), fetch the packed
+        1-bit-per-lane mask.  Returns the [F, R] bool candidate matrix,
+        or None when the batch exceeds the single-dispatch envelope
+        (multi-chunk row counts, > MEGA_MAX_FILES files) — the staged
+        fused path takes over, byte-identically."""
+        import hashlib as _hashlib
+
+        from trivy_tpu.engine import link as link_mod
+        from trivy_tpu.engine.pipeline import chunk_digest, stage_rows
+        from trivy_tpu.ops.megakernel import MEGA_MAX_FILES
+
+        import jax.numpy as jnp
+        import time as _time
+
+        f = batch.num_files
+        if f == 0:
+            return np.zeros((0, len(self.pset.plans)), dtype=bool)
+        total = len(batch.rows)
+        fit = next((b for b in self._buckets() if total <= b), None)
+        if fit is None or f > MEGA_MAX_FILES:
+            return None
+        fp = max(8, 1 << (f - 1).bit_length())
+        lo = np.zeros((1, fp), np.int32)
+        hi = np.full((1, fp), -1, np.int32)  # padding: hi < lo -> invalid
+        lo[0, :f] = batch.file_row_lo
+        hi[0, :f] = batch.file_row_hi
+        valid = (hi >= lo).astype(np.int8).reshape(fp, 1)
+
+        t0 = _time.perf_counter()
+        buf, raw_n = self._encode_chunk(self._pad_chunk(batch.rows, 0, fit))
+        # Store key: chunk digest + codec + KERNEL id + the file-interval
+        # digest — identical row bytes under a different file split (or a
+        # re-baked program) must never alias a cached verdict mask.
+        digest = (
+            chunk_digest(buf) + self._codec_tag + self._kernel_tag + ":"
+            + _hashlib.blake2b(
+                lo.tobytes() + hi.tobytes(), digest_size=8
+            ).hexdigest()
+        )
+        store = self._get_row_store()
+        mask_dev = None
+        if store.capacity:
+            res = store.rows(digest)
+            if res is not None:
+                self.stats.resident_hits += 1
+                mask_dev = res[1]
+        if mask_dev is None:
+            self._note_dispatch()
+            self._count_link(raw_n, buf.nbytes)
+            with obs_trace.span("chunk.h2d", bytes=buf.nbytes):
+                faults.fire("device.put")
+                dev, _mw = stage_rows(
+                    buf, self._mesh, real_rows=total, track=False
+                )
+            lo_d = jnp.asarray(lo)
+            hi_d = jnp.asarray(hi)
+            v_d = jnp.asarray(valid)
+            with obs_trace.span("sieve.megakernel", rows=fit, files=f):
+                faults.fire("device.exec")
+                ph = obs_metrics.device_phase("sieve.megakernel")
+                fn = (
+                    self._mega_fn if self._mega_fn is not None
+                    else self._mega_exec(fit, fp)
+                )
+                mask_dev = fn(dev, lo_d, hi_d, v_d)
+                ph.done(mask_dev)
+            if store.capacity:
+                store.put_rows(digest, dev, mask_dev)
+        self.stats.sieve_s += _time.perf_counter() - t0
+
+        t0 = _time.perf_counter()
+        with obs_trace.span("chunk.fetch"):
+            faults.fire("device.fetch")
+            r = len(self.pset.plans)
+            # raw_bytes: what the staged path's [Fp, R] uint8 candidate
+            # fetch would have moved for the same derive.
+            lanes, raw_b, got_b = link_mod.fetch_mask_packed(
+                mask_dev, fp * r
+            )
+            self.stats.d2h_bytes_raw += raw_b
+            self.stats.d2h_bytes += got_b
+        cand = lanes.reshape(fp, self._mega.mask_bytes * 8)[:f, :r]
+        self.stats.candidate_s += _time.perf_counter() - t0
+        return cand
+
+    def scan_batch_staged_sieve(self, items: list[tuple[str, bytes]]):
+        """scan_batch with the megakernel held off for this call — the
+        serve scheduler's one-rung step-down when the fused dispatch
+        raises; the staged fused path (whose own legacy/host rungs sit
+        below) scans the batch instead."""
+        prev = self._mega_on
+        self._mega_on = False
+        try:
+            return self.scan_batch(items)
+        finally:
+            self._mega_on = prev
 
     def _exec_attributed(self, dev):
         """One sieve execution with per-kernel attribution.  When tracing
@@ -977,12 +1264,20 @@ class TpuSecretEngine:
                 .sum(axis=-1, dtype=np.uint32)
             )
         else:  # device gram sieve
+            if self._use_megakernel():
+                # Megakernel: the whole sieve->candidate chain is ONE
+                # dispatch whose only d2h is the packed verdict mask.
+                # None = batch outside the single-dispatch envelope;
+                # fall through to the staged fused path below.
+                cand = self._mega_candidates(batch)
+                if cand is not None:
+                    return cand
             if self._use_fused_derive():
                 # Fused path: hit words never leave the device — the
                 # sieve output feeds candidate derivation in place, and
                 # the only d2h of the whole sieve->candidate chain is
                 # the compacted [F, R] matrix.  Byte-identical to the
-                # host derivation below (same f32 matmul pipeline).
+                # host derivation below (same int-exact matmul pipeline).
                 t0 = _time.perf_counter()
                 hits_dev = self._sieve_rows_fused(batch.rows)
                 self.stats.sieve_s += _time.perf_counter() - t0

@@ -155,11 +155,18 @@ GATE_RTT_S = 0.01
 # relay-attached chip clears this unless a single dispatch costs a
 # visible fraction of a second.
 FUSED_GATE_RTT_S = 0.25
+# Megakernel exec floor (raw MB/s through the fused sieve): the one-rung
+# fusion only beats the staged fused path when the single dispatch also
+# EXECUTES fast — a chip whose fused program crawls is better served by
+# the staged pipeline, whose chunk stages overlap transfer with compute.
+# Priced from a MEASURED warm dispatch (device.py warmup), not a model.
+MEGA_GATE_EXEC_MB_S = 500.0
 
 
 def gate_terms(
     h2d_ratio: float = 1.0, d2h_ratio: float = 1.0,
     profile: str = "stream", devices: int = 1,
+    exec_mb_s: float | None = None,
 ) -> dict:
     """Measure the link and price it against the device-verify bar;
     returns every term the decision used (the gate-audit record body).
@@ -169,31 +176,36 @@ def gate_terms(
     the compaction ratio), "fused" (verify rows stay device-resident,
     so the verify stage's marginal re-upload is ~zero —
     link_mod.FUSED_REUPLOAD_RATIO — and the RTT bar loosens to
-    FUSED_GATE_RTT_S because the batch rides O(1) dispatches), or "mesh"
+    FUSED_GATE_RTT_S because the batch rides O(1) dispatches), "mesh"
     (the fused cost model at `devices` chips: each device has its own
     staging lane, per-shard h2d and the per-shard keep-mask d2h overlap
     across chips, so the effective aggregate rate is the per-link rate x
-    device count — the whole reason a mesh can win where one chip loses).
+    device count — the whole reason a mesh can win where one chip loses),
+    or "mega" (the megakernel's one-dispatch fusion: the fused link model
+    at `devices` chips PLUS an absolute exec-rate floor — pass the
+    measured `exec_mb_s` and the decision additionally requires it to
+    clear MEGA_GATE_EXEC_MB_S, folding the worse of the two distances
+    into `margin`).
 
     `margin` is the signed distance from the flip point: the worse of
-    (effective rate vs GATE_EFF_MB_S) and (RTT vs the profile's RTT bar),
-    each as a fraction of its threshold.  Positive = the link cleared the
-    bar."""
+    (effective rate vs GATE_EFF_MB_S) and (RTT vs the profile's RTT bar)
+    — and, under "mega", (exec rate vs MEGA_GATE_EXEC_MB_S) — each as a
+    fraction of its threshold.  Positive = the link cleared the bar."""
     from trivy_tpu.engine import link as link_mod
 
     mb_s, rtt = probe_link()
     devices = max(int(devices), 1)
-    fused_model = profile in ("fused", "mesh")
+    fused_model = profile in ("fused", "mesh", "mega")
     reupload = link_mod.FUSED_REUPLOAD_RATIO if fused_model else 1.0
     rtt_bar = FUSED_GATE_RTT_S if fused_model else GATE_RTT_S
     eff = link_mod.effective_link_rate(
         mb_s, h2d_ratio, d2h_ratio, reupload_ratio=reupload
     )
-    if profile == "mesh":
+    if profile in ("mesh", "mega"):
         eff *= devices
     wide = eff >= GATE_EFF_MB_S and rtt < rtt_bar
     margin = min(eff / GATE_EFF_MB_S - 1.0, 1.0 - rtt / rtt_bar)
-    return {
+    out = {
         "profile": profile,
         "devices": devices,
         "link_mb_per_sec": mb_s,
@@ -207,6 +219,12 @@ def gate_terms(
         "wide": wide,
         "margin": margin,
     }
+    if profile == "mega" and exec_mb_s is not None:
+        out["exec_mb_per_sec"] = exec_mb_s
+        out["exec_threshold_mb_per_sec"] = MEGA_GATE_EXEC_MB_S
+        out["wide"] = wide and exec_mb_s >= MEGA_GATE_EXEC_MB_S
+        out["margin"] = min(margin, exec_mb_s / MEGA_GATE_EXEC_MB_S - 1.0)
+    return out
 
 
 def _link_is_wide(h2d_ratio: float = 1.0, d2h_ratio: float = 1.0) -> bool:

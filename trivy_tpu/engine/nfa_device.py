@@ -114,7 +114,7 @@ def fused_scan_mode() -> str:
 
 class NfaVerifier:
     def __init__(self, rules, mesh=None, trimmable=None, prefix_bounds=None,
-                 fused=False, rule_stack=None):
+                 fused=False, rule_stack=None, sieve_kernel_id=""):
         self.mesh = mesh
         self.num_rules = len(rules)
         # Fused mode: resolve lane verdicts on-device and fetch only the
@@ -122,6 +122,14 @@ class NfaVerifier:
         # ladder flips it off for a legacy-stream retry (see
         # HybridSecretEngine.scan_batch_device_legacy).
         self.fused = bool(fused)
+        # Provenance label for the sieve program that produced the
+        # candidate lanes this verifier walks (the megakernel's kernel id
+        # when the one-dispatch fused sieve fed them — ops/megakernel.py;
+        # empty for host/native sieves).  Surfaced in stream_stats so
+        # /debug and merged profiles attribute verify work to the kernel
+        # generation that routed it; registry aot_warmup threads it from
+        # the engine's built program.
+        self.sieve_kernel_id = str(sieve_kernel_id)
         # Walk-window trim bound, shared with the host DfaVerifier (the
         # dfa_verify_pairs clip [first - bound, last + bound + 8]) —
         # refutation soundness requires both verifiers to clip identically,
@@ -740,6 +748,7 @@ class NfaVerifier:
             "pipeline_depth": depth, "h2d_overlap_s": 0.0,
             "fetch_bytes_raw": 0, "fetch_bytes": 0,
             "backend": "fused" if fused else "stream",
+            "sieve_kernel": self.sieve_kernel_id,
         }
         # D2H compaction (engine/link.py): the packed flag tensor is
         # almost entirely zero lanes (r05: 400 real pairs in 60k lanes,

@@ -355,6 +355,14 @@ class ResidentRowStore(ResidentChunkCache):
     shipped (coded or raw per the chunk's codec tag — callers key the
     digest with the tag, mirroring `_sieve_rows`'s resident-LRU key), and
     `hits_dev` the matching [rows, n_words] uint32 hit bitmap.
+
+    Megakernel entries (engine/device.py `_mega_candidates`) reuse the
+    same store with `(rows_dev, mask_dev)` tuples — the packed verdict
+    mask instead of hit words — under digests additionally suffixed with
+    the KERNEL id and the batch's file-interval digest.  The kernel id
+    changes whenever the fused program's baked constants change (ruleset
+    or codec rebake), so staged-path hit words and fused verdict masks
+    can never alias each other or a stale program's output.
     """
 
     def __init__(self, capacity: int | None = None):

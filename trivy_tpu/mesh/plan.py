@@ -20,6 +20,13 @@ a closed set):
                  "model state" every shard matches against.
   gram_constants sieve masks/vals — replicate.
   probe_constants LUT/probe tables — replicate.
+  mega_rowfile   [Fp, Dg] megakernel partial per-file gram counts — the
+                 fused one-dispatch program shards its row axis exactly
+                 like coded_rows (each shard accumulates against global
+                 row ids) and the partial count matrices psum BEFORE any
+                 threshold; this family names the pre-psum partials so
+                 the fused kernel shards row-wise like its staged
+                 ancestors (ops/megakernel.make_sharded_megakernel).
 
 `CONSTANT_FAMILIES` is the authority graftlint GL011 enforces: passing a
 non-replicated spec for one of these is a lint error, not a runtime
@@ -43,6 +50,7 @@ PLAN: dict[str, tuple[Any, ...]] = {
     "vstack_rules": (),
     "gram_constants": (),
     "probe_constants": (),
+    "mega_rowfile": (DATA_AXIS, None),
 }
 
 CONSTANT_FAMILIES = frozenset(

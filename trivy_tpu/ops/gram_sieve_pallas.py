@@ -17,7 +17,13 @@ The bit transpose itself rides the MXU: a SWAR nibble gather
 exact bfloat16 matmul against a constant selection matrix packs 8
 nibble-lanes into each u32 of 32 position bits (all values <= 65535 —
 bf16/f32 arithmetic is exact, verified bit-for-bit against the numpy
-reference).  Measured steady-state exec on the v5e bench host (resident
+reference).  The megakernel's derivation stage (`ops/megakernel.py`)
+makes the same exactness argument one step further down: its
+window-membership / probe-score / gate contractions run as int8 MXU
+`dot_general`s where every operand element is 0 or 1 and accumulation
+is int32, so each dot is a sum of at most `coded_cols` ones — far
+below 2^31 — and the MXU result is bit-identical to the integer
+reference by construction, with no rounding mode to argue about.  Measured steady-state exec on the v5e bench host (resident
 buffers, dispatch amortized with an on-device fori_loop, long-run slope):
 ~30 GB/s vs ~6.5 GB/s for the windowed kernel — the windowed kernel is
 VPU-roofline-bound at 198 distinct grams x 3 ops (~600 lane-ops/byte,

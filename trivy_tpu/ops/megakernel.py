@@ -1,0 +1,592 @@
+"""The megakernel: one Pallas pass from packed link bytes to verdict bits.
+
+The staged device path (engine/device.py) runs unpack -> sieve ->
+lane-derive as separate device programs with the [T, Dw] hit words
+materialized in HBM between them; this module fuses the whole chain into
+one multi-step Pallas program.  Per row block the kernel
+
+  1. unpacks the link codec's packed class ids in-register (the same
+     shift/mask algebra as LinkCodec.make_unpack, kept 2-D: for both
+     codec widths a u32 lane's 4 symbols come from a fixed byte group,
+     so the unpack is strided slices + shifts, no gather),
+  2. runs the bit-sliced gram match (the bitplane machinery of
+     gram_sieve_pallas.py: SWAR casefold, nibble multiply-shift gather,
+     two exact bf16 matmuls per plane, shared byte tests),
+  3. folds per-row distinct-gram hits into per-FILE hit counts with an
+     int8 MXU contraction: an interval-membership matrix [B, Fp]
+     (row r belongs to file f iff lo_f <= r <= hi_f — rows may span
+     several files, DenseBatch contract) contracted against the row-hit
+     booleans [B, D] accumulates [Fp, D] int32 counts in VMEM scratch
+     that persists across the sequential grid, and
+  4. on the final grid step derives candidates entirely on the MXU:
+     window membership, probe scoring, and gate/conjunct resolution are
+     small int8 `dot_general`s against baked constant matrices
+     (`derive_counts_to_mask`), and the [Fp, R] candidate booleans pack
+     to the 1-bit-per-lane verdict mask [Fp, ceil(R/8)] uint8 — the
+     ONLY tensor that leaves the device (engine/link.py's
+     fetch_mask_packed d2h contract).
+
+int8 exactness: every matmul operand is 0/1 (membership bits) or a 0/1
+one-interval indicator, so each MXU partial product is 0 or 1 and each
+accumulation is a count bounded by its contraction length — at most the
+row-block height (<= 64) per grid step, at most the total row count
+(<= 32768) across the batch, and at most max(D, W, P) (a few hundred)
+in the derive stage — all orders of magnitude below 2^31, hence exact
+in int8 x int8 -> int32 MXU arithmetic.  The derive thresholds compare
+those integer counts, so the fused verdicts are bit-identical to the
+staged f32 derivation and to the host numpy reference.
+
+Mesh: `make_sharded_megakernel` shards the row axis (plan family
+`coded_rows` / `mega_rowfile`); each shard runs the kernel in
+`emit="acc"` mode (partial [Fp, Dg] counts, global row offsets via
+axis_index) and the partials `psum` BEFORE any window-AND threshold —
+a file's two windows may land on different shards, so thresholding
+per-shard would drop cross-shard conjunctions.  The replicated epilogue
+then derives + packs exactly as the single-chip kernel does.
+
+Lowering notes: the kernel sticks to 2-D arrays, static strided slices
+and dot_general — the subset the interpret path (CPU CI) executes
+bit-exactly and Mosaic lowers on TPU.  Row length must be a power of
+two >= 256 (bitplane transpose constraint, same as the staged kernel).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from trivy_tpu.ops.gram_sieve_pallas import (
+    DEFAULT_BLOCK_ROWS,
+    _byte_tests,
+    _pack_weights,
+    _tree_or,
+    dedupe_grams,
+)
+
+# Per-batch file cap: the [Fp, Dg] int32 accumulator lives in VMEM for
+# the grid's lifetime (2048 x ~256 x 4B = 2MB against the ~16MB budget
+# alongside the ~4MB byte-test working set).  Bigger batches fall back
+# to the staged fused path — a capacity gate, not a correctness one.
+MEGA_MAX_FILES = 2048
+
+
+def derive_counts_to_mask(acc, valid_col, dw, pm, pw, ng, gm, ga, cm, ca, k):
+    """Per-file gram-hit counts -> candidate booleans, all-integer.
+
+    acc [Fp, Dg] int32 counts; valid_col [Fp, 1] int8 (0 = padding or
+    empty file); dw [Dg, W] distinct-gram->window membership (the
+    caller's gram_expand folded in: an OR over duplicate grams is exact
+    because the window threshold is count > 0); pm [W, P]; pw [1, P]
+    int32 required-window counts; ng [1, P] probes without grams
+    (always hit); gm [P, R] gate membership; ga [1, R] gate-any; cm
+    [P, R*K] conjunct membership; ca [K, R] conjunct-any.  Returns
+    [Fp, R] bool.  Runs identically inside the Pallas kernel (refs
+    loaded) and as the meshed post-psum epilogue.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def idot(a, b):
+        return jax.lax.dot_general(
+            a.astype(jnp.int8), jnp.asarray(b),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+
+    gh = ((acc > 0) & (valid_col > 0)).astype(jnp.int8)  # [Fp, Dg]
+    win = (idot(gh, dw) > 0).astype(jnp.int8)  # [Fp, W]
+    ph = ((idot(win, pm) >= pw) | (ng > 0)).astype(jnp.int8)  # [Fp, P]
+    gate_ok = (ga == 0) | (idot(ph, gm) > 0)  # [Fp, R]
+    cj = idot(ph, cm)  # [Fp, R*K]
+    conj_ok = jnp.ones_like(gate_ok)
+    for kk in range(k):
+        conj_ok = conj_ok & ((ca[kk : kk + 1, :] == 0) | (cj[:, kk::k] > 0))
+    return gate_ok & conj_ok & (valid_col > 0)
+
+
+def pack_mask_bits(cand):
+    """[Fp, R] bool -> [Fp, ceil(R/8)] uint8, np.unpackbits bit order
+    (MSB-first within each byte, matching jnp/np.packbits and the
+    fetch_mask_packed d2h contract)."""
+    import jax.numpy as jnp
+
+    fp, r = cand.shape
+    rb = -(-r // 8)
+    pad = rb * 8 - r
+    c = cand.astype(jnp.int32)
+    if pad:
+        c = jnp.concatenate([c, jnp.zeros((fp, pad), jnp.int32)], axis=1)
+    packed = jnp.zeros((fp, rb), jnp.int32)
+    for b in range(8):
+        packed = packed | (c[:, b::8] << (7 - b))
+    return packed.astype(jnp.uint8)
+
+
+def _unpack_to_lanes(coded, sym_bits, row_len):
+    """Packed codec bytes [B, Cc] -> u32 symbol lanes [B, L/4], fused
+    in-register.  Both codec widths group 4 consecutive symbols into a
+    fixed set of source bytes, so each lane assembles from static
+    strided slices (2-D throughout, no gathers):
+
+      4-bit: lane q = lo[2q] | hi[2q]<<8 | lo[2q+1]<<16 | hi[2q+1]<<24
+      6-bit: 3 bytes -> 4 symbols, exactly one u32 lane
+      raw:   little-endian byte pack (matches bitcast_convert_type)
+    """
+    import jax.numpy as jnp
+
+    u32 = lambda x: x.astype(jnp.uint32)
+    if sym_bits == 4:
+        lo = coded & jnp.uint8(0x0F)
+        hi = coded >> 4
+        return (
+            u32(lo[:, 0::2])
+            | (u32(hi[:, 0::2]) << 8)
+            | (u32(lo[:, 1::2]) << 16)
+            | (u32(hi[:, 1::2]) << 24)
+        )
+    if sym_bits == 6:
+        b0, b1, b2 = coded[:, 0::3], coded[:, 1::3], coded[:, 2::3]
+        s0 = b0 & jnp.uint8(0x3F)
+        s1 = (b0 >> 6) | ((b1 & jnp.uint8(0x0F)) << 2)
+        s2 = (b1 >> 4) | ((b2 & jnp.uint8(0x03)) << 4)
+        s3 = b2 >> 2
+        return u32(s0) | (u32(s1) << 8) | (u32(s2) << 16) | (u32(s3) << 24)
+    # raw bytes: SWAR casefold applies downstream exactly as the staged
+    # bitplane kernel does; class ids (<= 63) never fold, so the coded
+    # paths skip it.
+    return (
+        u32(coded[:, 0::4])
+        | (u32(coded[:, 1::4]) << 8)
+        | (u32(coded[:, 2::4]) << 16)
+        | (u32(coded[:, 3::4]) << 24)
+    )
+
+
+class MegaGramSieve:
+    """The fused unpack->sieve->derive->verdict Pallas program.
+
+    `__call__(coded, lo, hi, valid)` -> packed verdict mask
+    [Fp, mask_bytes] uint8.  `coded` is the staged (codec-packed or
+    raw) row buffer [T, coded_cols] with T a multiple of block_rows;
+    lo/hi are [1, Fp] int32 inclusive file row ranges (DenseBatch
+    contract, hi < lo for padding/empty files); valid is [Fp, 1] int8.
+
+    `kernel_id` digests every constant baked into the program (gram
+    pairs, codec width, derive matrices) — resident-row store keys and
+    the AOT executable cache key on it so a ruleset or codec change can
+    never alias a cached result or executable.
+    """
+
+    def __init__(
+        self,
+        masks: np.ndarray,
+        vals: np.ndarray,
+        *,
+        wmember: np.ndarray,
+        pmember: np.ndarray,
+        pwindows: np.ndarray,
+        probe_has_gram: np.ndarray,
+        gate_member: np.ndarray,
+        gate_any: np.ndarray,
+        conj_member: np.ndarray,
+        conj_any: np.ndarray,
+        num_conjuncts: int,
+        row_len: int,
+        sym_bits: int | None = None,
+        block_rows: int | None = None,
+        interpret: bool | None = None,
+    ):
+        if row_len < 256 or row_len & (row_len - 1):
+            raise ValueError(
+                f"megakernel row length must be a power of two >= 256, "
+                f"got {row_len}"
+            )
+        if sym_bits not in (None, 4, 6):
+            raise ValueError(f"unsupported codec width: {sym_bits}")
+        masks = np.asarray(masks, dtype=np.uint32)
+        vals = np.asarray(vals, dtype=np.uint32)
+        dmasks, dvals, self.gram_expand = dedupe_grams(masks, vals)
+        self.num_distinct = len(dmasks)
+        if self.num_distinct == 0:
+            raise ValueError("megakernel needs at least one gram")
+        self._masks_tuple = tuple(int(m) for m in dmasks)
+        self._vals_tuple = tuple(int(v) for v in dvals)
+        self.row_len = row_len
+        self.sym_bits = sym_bits
+        self.coded_cols = (
+            row_len if sym_bits is None
+            else row_len // 2 if sym_bits == 4
+            else row_len // 4 * 3
+        )
+        self.block_rows = block_rows or DEFAULT_BLOCK_ROWS
+        if interpret is None:
+            from trivy_tpu.mesh import topology as mesh_topology
+
+            interpret = not mesh_topology.is_tpu()
+        self.interpret = interpret
+
+        # Derive constants, int8/int32 (exactness argument: module doc).
+        # gram_expand folds into the window membership so the kernel's
+        # distinct-gram counts map straight to windows.
+        g, w = np.asarray(wmember).shape
+        dw = np.zeros((self.num_distinct, max(w, 1)), np.int8)
+        for gi in range(g):
+            di = int(self.gram_expand[gi]) if len(self.gram_expand) else gi
+            np.maximum(dw[di], wmember[gi].astype(np.int8), out=dw[di])
+        p = np.asarray(pmember).shape[1]
+        r = np.asarray(gate_member).shape[1]
+        k = max(int(num_conjuncts), 1)
+        self._dw = dw
+        self._pm = np.asarray(pmember).astype(np.int8)
+        self._pw = np.asarray(pwindows).astype(np.int32).reshape(1, p)
+        self._ng = (~np.asarray(probe_has_gram)).astype(np.int8).reshape(1, p)
+        self._gm = np.asarray(gate_member).astype(np.int8)
+        self._ga = np.asarray(gate_any).astype(np.int8).reshape(1, r)
+        cm = np.asarray(conj_member)
+        if cm.size:
+            self._cm = cm.astype(np.int8)
+            self._ca = np.ascontiguousarray(
+                np.asarray(conj_any).astype(np.int8).T
+            )  # [K, R]
+        else:
+            self._cm = np.zeros((p, r * k), np.int8)
+            self._ca = np.zeros((k, r), np.int8)
+        self._k = k
+        self.num_rules = r
+        self.mask_bytes = -(-r // 8)
+
+        h = hashlib.blake2b(digest_size=8)
+        h.update(b"mega1")
+        h.update(np.uint32(row_len).tobytes())
+        h.update(np.int32(-1 if sym_bits is None else sym_bits).tobytes())
+        h.update(np.uint32(self.block_rows).tobytes())
+        for arr in (
+            dmasks, dvals, self._dw, self._pm, self._pw, self._ng,
+            self._gm, self._ga, self._cm, self._ca,
+        ):
+            h.update(np.ascontiguousarray(arr).tobytes())
+        self.kernel_id = h.hexdigest()
+        self._weights: dict[int, tuple] = {}
+        self._call_jit = None
+
+    # -- constant operands -------------------------------------------------
+
+    def _pack_w(self, length: int):
+        if length not in self._weights:
+            import ml_dtypes
+
+            # numpy bf16 (not jnp): __call__ may trace under an outer
+            # jit; numpy operands fold to constants per trace instead of
+            # leaking a tracer into the cache (same discipline as
+            # PallasGramSieve._pack_w).
+            wlo, whi = _pack_weights(length)
+            self._weights[length] = (
+                wlo.astype(ml_dtypes.bfloat16),
+                whi.astype(ml_dtypes.bfloat16),
+            )
+        return self._weights[length]
+
+    # -- the Pallas program ------------------------------------------------
+
+    def _invoke(self, coded, lo, hi, valid, base, emit):  # graftlint: jit-cached
+        """Build + run the fused program for this trace's shapes.
+
+        emit="mask": full fusion, returns the packed verdict mask
+        [Fp, mask_bytes] uint8 (the epilogue runs in-kernel on the last
+        grid step).  emit="acc": returns the raw [Fp, Dg] int32 counts
+        — the meshed per-shard mode, whose partials must psum before
+        thresholding.  `base` [1, 1] int32 is the shard's global row
+        offset (zeros unmeshed).
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        t, cc = coded.shape
+        if cc != self.coded_cols:
+            raise ValueError(f"staged width {cc} != {self.coded_cols}")
+        if t % self.block_rows:
+            raise ValueError(f"rows {t} not a multiple of {self.block_rows}")
+        fp = lo.shape[1]
+        d = self.num_distinct
+        length = self.row_len
+        block_rows = self.block_rows
+        sym_bits = self.sym_bits
+        n_lanes = length // 4
+        wlo, whi = self._pack_w(length)
+        tests, gram_tests = _byte_tests(
+            np.array(self._masks_tuple, dtype=np.uint32),
+            np.array(self._vals_tuple, dtype=np.uint32),
+        )
+        mask_mode = emit == "mask"
+        dwc, pmc, pwc, ngc = self._dw, self._pm, self._pw, self._ng
+        gmc, gac, cmc, cac, kc = self._gm, self._ga, self._cm, self._ca, self._k
+
+        def body(coded_blk, lo_row, hi_row, base00, wlo_c, whi_c, step):
+            p32 = _unpack_to_lanes(coded_blk, sym_bits, length)
+            b_rows = p32.shape[0]
+            if sym_bits is None:
+                # SWAR casefold A-Z -> a-z (raw bytes only; class ids
+                # are <= 63 and never fold)
+                u = p32 & jnp.uint32(0x7F7F7F7F)
+                ge = (u + jnp.uint32(0x3F3F3F3F)) & jnp.uint32(0x80808080)
+                le = (~(u + jnp.uint32(0x25252525))) & jnp.uint32(0x80808080)
+                asc = (~p32) & jnp.uint32(0x80808080)
+                p32 = p32 | ((ge & le & asc) >> 2)
+
+            planes = []
+            for j in range(8):
+                e = (p32 >> j) & jnp.uint32(0x01010101)
+                nib = ((e * jnp.uint32(0x01020408)) >> 24) & jnp.uint32(0xF)
+                nb = nib.astype(jnp.int32).astype(jnp.bfloat16)
+                plo = jax.lax.dot_general(
+                    nb, wlo_c, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                phi = jax.lax.dot_general(
+                    nb, whi_c, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                planes.append(
+                    plo.astype(jnp.int32).astype(jnp.uint32)
+                    | (phi.astype(jnp.int32).astype(jnp.uint32) << 16)
+                )
+
+            def lane_next(x):
+                return jnp.concatenate([x[:, 1:], x[:, :1]], axis=1)
+
+            shifted = [[None] * 8 for _ in range(4)]
+            for j in range(8):
+                x = planes[j]
+                nxt = lane_next(x)
+                shifted[0][j] = x
+                for kk in (1, 2, 3):
+                    shifted[kk][j] = (x >> kk) | (nxt << (32 - kk))
+            comp = [[~shifted[kk][j] for j in range(8)] for kk in range(4)]
+
+            test_arr = [None] * len(tests)
+            for (kk, v), idx in tests.items():
+                acc = None
+                for j in range(8):
+                    tt = shifted[kk][j] if (v >> j) & 1 else comp[kk][j]
+                    acc = tt if acc is None else (acc & tt)
+                test_arr[idx] = acc
+
+            cols = []
+            for gi in range(d):
+                lst = gram_tests[gi]
+                acc = test_arr[tests[lst[0]]]
+                for kb in lst[1:]:
+                    acc = acc & test_arr[tests[kb]]
+                cols.append((_tree_or(acc) != 0).astype(jnp.int8))
+            rowhit = jnp.concatenate(cols, axis=1)  # [B, D] int8
+
+            # interval membership [B, Fp]: global row id vs file ranges
+            rid = (
+                base00
+                + step * block_rows
+                + jax.lax.broadcasted_iota(jnp.int32, (b_rows, fp), 0)
+            )
+            member = ((rid >= lo_row) & (rid <= hi_row)).astype(jnp.int8)
+            # int8 MXU contraction over the row axis: per-file counts
+            return jax.lax.dot_general(
+                member, rowhit, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )  # [Fp, D]
+
+        if mask_mode:
+            # The derive matrices ride as kernel operands (a Pallas
+            # kernel may not capture array constants) — constant
+            # index_map (0, 0) keeps each resident in VMEM for the
+            # grid's lifetime.
+
+            def kernel(
+                coded_ref, lo_ref, hi_ref, valid_ref, base_ref,
+                wlo_ref, whi_ref, dw_ref, pm_ref, pw_ref, ng_ref,
+                gm_ref, ga_ref, cm_ref, ca_ref, out_ref, acc_ref,
+            ):
+                i = pl.program_id(0)
+                contrib = body(
+                    coded_ref[:], lo_ref[:], hi_ref[:], base_ref[0, 0],
+                    wlo_ref[:], whi_ref[:], i,
+                )
+
+                @pl.when(i == 0)
+                def _init():
+                    acc_ref[:] = contrib
+
+                @pl.when(i != 0)
+                def _accum():
+                    acc_ref[:] = acc_ref[:] + contrib
+
+                @pl.when(i == pl.num_programs(0) - 1)
+                def _epilogue():
+                    cand = derive_counts_to_mask(
+                        acc_ref[:], valid_ref[:],
+                        dw_ref[:], pm_ref[:], pw_ref[:], ng_ref[:],
+                        gm_ref[:], ga_ref[:], cm_ref[:], ca_ref[:], kc,
+                    )
+                    out_ref[:] = pack_mask_bits(cand)
+
+            grid = t // block_rows
+            vmem = lambda shape: pl.BlockSpec(
+                shape, lambda i: (0, 0), memory_space=pltpu.VMEM
+            )
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct(
+                    (fp, self.mask_bytes), jnp.uint8
+                ),
+                grid=(grid,),
+                in_specs=[
+                    pl.BlockSpec(
+                        (block_rows, cc), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM,
+                    ),
+                    vmem((1, fp)), vmem((1, fp)), vmem((fp, 1)),
+                    vmem((1, 1)),
+                    vmem((n_lanes, length // 32)),
+                    vmem((n_lanes, length // 32)),
+                    vmem(dwc.shape), vmem(pmc.shape), vmem(pwc.shape),
+                    vmem(ngc.shape), vmem(gmc.shape), vmem(gac.shape),
+                    vmem(cmc.shape), vmem(cac.shape),
+                ],
+                out_specs=vmem((fp, self.mask_bytes)),
+                scratch_shapes=[pltpu.VMEM((fp, d), jnp.int32)],
+                interpret=self.interpret,
+            )(
+                coded, lo, hi, valid, base, wlo, whi,
+                dwc, pmc, pwc, ngc, gmc, gac, cmc, cac,
+            )
+
+        def kernel_acc(
+            coded_ref, lo_ref, hi_ref, base_ref, wlo_ref, whi_ref, out_ref
+        ):
+            i = pl.program_id(0)
+            contrib = body(
+                coded_ref[:], lo_ref[:], hi_ref[:], base_ref[0, 0],
+                wlo_ref[:], whi_ref[:], i,
+            )
+
+            @pl.when(i == 0)
+            def _init():
+                out_ref[:] = contrib
+
+            @pl.when(i != 0)
+            def _accum():
+                out_ref[:] = out_ref[:] + contrib
+
+        grid = t // block_rows
+        vmem = lambda shape: pl.BlockSpec(
+            shape, lambda i: (0, 0), memory_space=pltpu.VMEM
+        )
+        return pl.pallas_call(
+            kernel_acc,
+            out_shape=jax.ShapeDtypeStruct((fp, d), jnp.int32),
+            grid=(grid,),
+            in_specs=[
+                pl.BlockSpec(
+                    (block_rows, cc), lambda i: (i, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                vmem((1, fp)), vmem((1, fp)), vmem((1, 1)),
+                vmem((n_lanes, length // 32)),
+                vmem((n_lanes, length // 32)),
+            ],
+            out_specs=vmem((fp, d)),
+            interpret=self.interpret,
+        )(coded, lo, hi, base, wlo, whi)
+
+    def epilogue(self, acc, valid):
+        """Post-psum derive + pack for the meshed path (traced under the
+        caller's jit; constants fold per trace)."""
+        cand = derive_counts_to_mask(
+            acc, valid,
+            self._dw, self._pm, self._pw, self._ng,
+            self._gm, self._ga, self._cm, self._ca, self._k,
+        )
+        return pack_mask_bits(cand)
+
+    def fused_fn(self):
+        """The jitted end-to-end callable (coded, lo, hi, valid) ->
+        packed mask; built once per sieve (per-shape retraces land in
+        jax's own cache)."""
+        if self._call_jit is None:
+            import jax
+            import jax.numpy as jnp
+
+            zero = np.zeros((1, 1), np.int32)
+            self._call_jit = jax.jit(  # graftlint: jit-cached
+                lambda c, lo, hi, v: self._invoke(
+                    c, lo, hi, v, jnp.asarray(zero), "mask"
+                )
+            )
+        return self._call_jit
+
+    def __call__(self, coded, lo, hi, valid):
+        return self.fused_fn()(coded, lo, hi, valid)
+
+    def aot_specs(self, rows: int, fp: int):
+        """ShapeDtypeStructs for AOT lowering at (rows, fp) — the shape
+        key the registry executable cache stores under."""
+        import jax
+        import jax.numpy as jnp
+
+        return (
+            jax.ShapeDtypeStruct((rows, self.coded_cols), jnp.uint8),
+            jax.ShapeDtypeStruct((1, fp), jnp.int32),
+            jax.ShapeDtypeStruct((1, fp), jnp.int32),
+            jax.ShapeDtypeStruct((fp, 1), jnp.int8),
+        )
+
+
+def make_sharded_megakernel(mesh, mega: MegaGramSieve):
+    """The megakernel over a device mesh: rows shard across the 'data'
+    axis (plan.py `coded_rows` / `mega_rowfile` families), each shard
+    accumulates partial per-file counts against GLOBAL row ids (its
+    axis_index times its local row count offsets the interval
+    membership), and the partials psum BEFORE the window-AND threshold
+    — the cross-shard soundness condition (module doc).  The derive +
+    pack epilogue runs replicated; the returned mask is byte-identical
+    to the single-device kernel at every device count."""
+    import inspect
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map as _shard_map
+    except ImportError:  # older jax: experimental namespace
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+    params = inspect.signature(_shard_map).parameters
+    if "check_vma" in params:
+        extra = {"check_vma": False}
+    elif "check_rep" in params:
+        extra = {"check_rep": False}
+    else:
+        extra = {}
+
+    def local(coded, lo, hi):
+        t_loc = coded.shape[0]
+        base = (jax.lax.axis_index("data") * t_loc).astype(jnp.int32)
+        acc = mega._invoke(
+            coded, lo, hi, None, base.reshape(1, 1), "acc"
+        )
+        # psum BEFORE thresholding: counts are additive across shards,
+        # booleans are not (a file's windows may split across shards).
+        return jax.lax.psum(acc, "data")
+
+    smap = _shard_map(
+        local, mesh=mesh,
+        in_specs=(P("data", None), P(None, None), P(None, None)),
+        out_specs=P(None, None),
+        **extra,
+    )
+
+    @jax.jit
+    def fused(coded, lo, hi, valid):
+        return mega.epilogue(smap(coded, lo, hi), valid)
+
+    return fused

@@ -1,0 +1,198 @@
+"""AOT-lowered executable store: compile once per ruleset, fleet-wide.
+
+The megakernel (ops/megakernel.py) bakes the whole ruleset into one
+Pallas program; its compile costs seconds and repeats identically on
+every cold fleet node.  This store persists the serialized executable
+(jax.experimental.serialize_executable) in the registry artifact
+directory keyed by everything that could change the program:
+
+    (platform, jax version, ruleset digest, kernel id, shapes)
+
+Validation is never-trust, mirroring registry/store.py's artifact
+discipline: the manifest's key fields must match the requesting engine
+exactly AND the payload must match its recorded sha256 — any mismatch,
+missing file, or deserialize error counts a reject and falls back to a
+fresh compile (a corrupt or stale cache can cost time, never
+correctness).  Writes are atomic-ish: the payload lands fully before
+the manifest that makes it visible, and both go through os.replace.
+
+`stats()` exposes compile/hit/miss/reject counters; the kernel-smoke
+suite asserts `compiles == 0` across a warm-registry engine start.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+
+_SCHEMA = 1
+
+_STATS = {"compiles": 0, "hits": 0, "misses": 0, "rejects": 0}
+
+
+def stats() -> dict:
+    return dict(_STATS)
+
+
+def reset_stats() -> None:
+    for k in _STATS:
+        _STATS[k] = 0
+
+
+def _jax_version() -> str:
+    import jax
+
+    return jax.__version__
+
+
+def _key_name(
+    platform: str, ruleset_digest: str, kernel_id: str, shape
+) -> str:
+    h = hashlib.blake2b(digest_size=12)
+    h.update(
+        json.dumps(
+            [platform, _jax_version(), ruleset_digest, kernel_id,
+             list(shape)],
+            sort_keys=True,
+        ).encode()
+    )
+    return "aot-" + h.hexdigest()
+
+
+def _paths(cache_dir: str, name: str) -> tuple[str, str]:
+    base = os.path.join(cache_dir, name)
+    return base + ".bin", base + ".json"
+
+
+def save_executable(
+    cache_dir: str,
+    *,
+    platform: str,
+    ruleset_digest: str,
+    kernel_id: str,
+    shape,
+    compiled,
+) -> bool:
+    """Serialize + persist one compiled executable; best-effort (an
+    unwritable cache dir degrades to compile-every-start, silently)."""
+    try:
+        from jax.experimental.serialize_executable import serialize
+
+        payload, in_tree, out_tree = serialize(compiled)
+        blob = pickle.dumps((payload, in_tree, out_tree))
+        name = _key_name(platform, ruleset_digest, kernel_id, shape)
+        bin_path, man_path = _paths(cache_dir, name)
+        os.makedirs(cache_dir, exist_ok=True)
+        tmp = bin_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, bin_path)
+        manifest = {
+            "schema": _SCHEMA,
+            "platform": platform,
+            "jax_version": _jax_version(),
+            "ruleset_digest": ruleset_digest,
+            "kernel_id": kernel_id,
+            "shape": list(shape),
+            "sha256": hashlib.sha256(blob).hexdigest(),
+            "nbytes": len(blob),
+        }
+        tmp = man_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, man_path)
+        return True
+    except Exception:  # graftlint: swallow(cache write failure degrades to recompile)
+        return False
+
+
+def load_executable(
+    cache_dir: str,
+    *,
+    platform: str,
+    ruleset_digest: str,
+    kernel_id: str,
+    shape,
+):
+    """Deserialize a cached executable, never-trust: every manifest key
+    field is re-checked against the request and the payload hash against
+    the manifest before jax sees a byte.  None on any mismatch (reject)
+    or absence (miss)."""
+    name = _key_name(platform, ruleset_digest, kernel_id, shape)
+    bin_path, man_path = _paths(cache_dir, name)
+    if not (os.path.exists(bin_path) and os.path.exists(man_path)):
+        _STATS["misses"] += 1
+        return None
+    try:
+        with open(man_path) as f:
+            manifest = json.load(f)
+        expect = {
+            "schema": _SCHEMA,
+            "platform": platform,
+            "jax_version": _jax_version(),
+            "ruleset_digest": ruleset_digest,
+            "kernel_id": kernel_id,
+            "shape": list(shape),
+        }
+        for key, want in expect.items():
+            if manifest.get(key) != want:
+                _STATS["rejects"] += 1
+                return None
+        with open(bin_path, "rb") as f:
+            blob = f.read()
+        if (
+            len(blob) != manifest.get("nbytes")
+            or hashlib.sha256(blob).hexdigest() != manifest.get("sha256")
+        ):
+            _STATS["rejects"] += 1
+            return None
+        from jax.experimental.serialize_executable import (
+            deserialize_and_load,
+        )
+
+        payload, in_tree, out_tree = pickle.loads(blob)
+        exe = deserialize_and_load(payload, in_tree, out_tree)
+        _STATS["hits"] += 1
+        return exe
+    except Exception:  # graftlint: swallow(corrupt cache entry degrades to recompile)
+        _STATS["rejects"] += 1
+        return None
+
+
+def get_or_compile(
+    cache_dir: str,
+    *,
+    platform: str,
+    ruleset_digest: str,
+    kernel_id: str,
+    shape,
+    lower_fn,
+):
+    """Cached executable if valid, else `lower_fn()` (counted as a
+    compile) persisted for the next start.  Returns None only when the
+    compile itself fails — callers keep their plain jitted path."""
+    exe = load_executable(
+        cache_dir,
+        platform=platform,
+        ruleset_digest=ruleset_digest,
+        kernel_id=kernel_id,
+        shape=shape,
+    )
+    if exe is not None:
+        return exe
+    try:
+        _STATS["compiles"] += 1
+        compiled = lower_fn()
+    except Exception:  # graftlint: swallow(AOT lowering unsupported on this backend)
+        return None
+    save_executable(
+        cache_dir,
+        platform=platform,
+        ruleset_digest=ruleset_digest,
+        kernel_id=kernel_id,
+        shape=shape,
+        compiled=compiled,
+    )
+    return compiled

@@ -664,12 +664,18 @@ def list_artifacts(cache_dir: str | None = None) -> list[dict]:
 # ---------------------------------------------------------------------------
 
 
-def aot_warmup(engine) -> dict:
+def aot_warmup(engine, cache_dir: str | None = None) -> dict:
     """Pre-lower/compile the engine's sieve step for each configured row
     bucket (jax.jit(...).lower(...).compile()), landing the executables in
     the persistent compilation cache so the first real batch pays neither
     trace nor compile.  Native/C++ engines have nothing to lower; every
-    failure is non-fatal (warmup is an optimization, never a gate)."""
+    failure is non-fatal (warmup is an optimization, never a gate).
+
+    `cache_dir` additionally persists the engine's megakernel executables
+    in the registry AOT store (registry/aotcache.py) keyed (platform, jax
+    version, ruleset digest, kernel id, shape) — the next process start
+    deserializes instead of compiling (validated never-trust; any
+    mismatch recompiles)."""
     out = {"buckets": [], "compiled": 0, "skipped": ""}
     fn = getattr(engine, "_sieve_fn", None)
     if fn is None:
@@ -691,12 +697,28 @@ def aot_warmup(engine) -> dict:
             jax.jit(lambda t: fn(t)).lower(spec).compile()  # graftlint: jit-cached
             out["buckets"].append(rows)
             out["compiled"] += 1
+        if cache_dir and getattr(engine, "_mega", None) is not None:
+            # Megakernel AOT: route through the engine's executable cache
+            # (engine/device.py _mega_exec) with the store dir pinned, so
+            # the lowered program lands on disk under its full key.
+            engine._aot_dir = cache_dir
+            mega_rows = engine._buckets()[0]
+            engine._mega_exec(mega_rows, 8)
+            out["megakernel"] = {
+                "kernel_id": engine._mega.kernel_id,
+                "shape": [mega_rows, 8],
+            }
         # Verify-side warmup: when the engine carries a device verifier
         # (hybrid auto/device/fused), pre-compile its bulk jit shapes too
         # — including the fused verdict kernel, whose rule tensors the
         # schema-3 vstack arrays provide without a per-rule Python build.
         nfa = getattr(engine, "_nfa_verifier", None)
         if nfa is not None:
+            mega = getattr(engine, "_mega", None)
+            if mega is not None and not nfa.sieve_kernel_id:
+                # Thread the sieve program's identity into the verifier's
+                # stream stats (lane provenance in /debug and profiles).
+                nfa.sieve_kernel_id = mega.kernel_id
             nfa.warmup(compile_buckets=True)
             out["verify"] = (
                 "fused" if getattr(nfa, "fused", False) else "stream"

@@ -785,8 +785,11 @@ class BatchScheduler:
         host DFA re-run (`HybridSecretEngine.scan_batch_host`).  On a
         device exception: RESOURCE_EXHAUSTED first tries shed-and-retry
         (evict resident rulesets through the pool's LRU path, split the
-        batch in half, one retry); a fused-verify engine then steps down
-        ONE rung to the legacy device stream
+        batch in half, one retry); a megakernel engine then steps down
+        ONE rung to the staged fused pipeline
+        (`scan_batch_staged_sieve` — the one-dispatch fusion out of the
+        loop, fused residency still in); a fused-verify engine steps
+        down to the legacy device stream
         (`scan_batch_device_legacy` — fused kernels out of the loop,
         device still in), and only then does any still-failing batch
         degrade to the host path.  Every outcome feeds the breaker, so
@@ -814,6 +817,16 @@ class BatchScheduler:
                     self.breaker.record_success()
                     return results, "shed"
             self.breaker.record_failure()
+            mega_fn = getattr(engine, "scan_batch_staged_sieve", None)
+            if mega_fn is not None and getattr(
+                engine, "megakernel_active", False
+            ):
+                try:
+                    return mega_fn(combined), "degraded"
+                except ScanTimeoutError:
+                    raise
+                except Exception:
+                    self.breaker.record_failure()
             legacy_fn = getattr(engine, "scan_batch_device_legacy", None)
             if legacy_fn is not None and getattr(engine, "verify", "") == "fused":
                 try:
