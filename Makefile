@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test lint lint-changed lockcheck smoke serve-smoke obs-smoke slo-smoke tenancy-smoke mem-smoke chaos-smoke mesh-smoke cache-smoke kernel-smoke bench bench-link bench-verify checks-corpus rules-cache perf-gate
+.PHONY: test lint lint-changed lockcheck smoke serve-smoke obs-smoke slo-smoke tenancy-smoke mem-smoke chaos-smoke mesh-smoke cache-smoke kernel-smoke fleet-smoke bench bench-link bench-verify checks-corpus rules-cache perf-gate
 
 # Tier-1: the suite the driver holds the repo to (fast, CPU, no slow marks).
 # Lint runs first — a graftlint finding fails the build before pytest
@@ -16,9 +16,10 @@ test: lint
 	$(MAKE) mesh-smoke
 	$(MAKE) cache-smoke
 	$(MAKE) kernel-smoke
+	$(MAKE) fleet-smoke
 	$(MAKE) perf-gate
 
-# Static analysis: graftlint (project rules GL001-GL012, always available)
+# Static analysis: graftlint (project rules GL001-GL013, always available)
 # plus ruff + mypy when the environment has them (the pinned CI container
 # may not; config lives in pyproject.toml either way).
 lint:
@@ -80,7 +81,7 @@ obs-smoke:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
 		BENCH_IMAGE=0 BENCH_TENANT=0 BENCH_MEM=0 BENCH_FAULT=0 \
-		BENCH_MULTICHIP=0 BENCH_CACHE=0 $(PY) bench.py --smoke
+		BENCH_MULTICHIP=0 BENCH_CACHE=0 BENCH_FLEET=0 $(PY) bench.py --smoke
 
 # SLO / flight-recorder smoke: boot the server with a deliberately tight
 # latency objective, drive mixed-tenant traffic with one induced breach,
@@ -104,7 +105,7 @@ tenancy-smoke:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
 		BENCH_IMAGE=0 BENCH_OBS=0 BENCH_MEM=0 BENCH_FAULT=0 \
-		BENCH_MULTICHIP=0 BENCH_CACHE=0 $(PY) bench.py --smoke
+		BENCH_MULTICHIP=0 BENCH_CACHE=0 BENCH_FLEET=0 $(PY) bench.py --smoke
 
 # Device-memory observatory smoke: memwatch ledger units, pool
 # estimate-vs-measured reconciliation, pressure watermark e2e
@@ -117,7 +118,7 @@ mem-smoke:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
 		BENCH_IMAGE=0 BENCH_TENANT=0 BENCH_OBS=0 BENCH_FAULT=0 \
-		BENCH_MULTICHIP=0 BENCH_CACHE=0 $(PY) bench.py --smoke
+		BENCH_MULTICHIP=0 BENCH_CACHE=0 BENCH_FLEET=0 $(PY) bench.py --smoke
 
 # Chaos smoke: the fault-injection serve suite (tests/test_chaos_serve.py,
 # -m chaos).  Arms the in-repo fault plane on the dispatch/device/rpc
@@ -148,7 +149,7 @@ cache-smoke:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
 		BENCH_IMAGE=0 BENCH_TENANT=0 BENCH_OBS=0 BENCH_MEM=0 \
-		BENCH_FAULT=0 BENCH_MULTICHIP=0 $(PY) bench.py --smoke
+		BENCH_FAULT=0 BENCH_MULTICHIP=0 BENCH_FLEET=0 $(PY) bench.py --smoke
 
 # Megakernel smoke (ops/megakernel.py + registry/aotcache.py): parity
 # fuzz of the one-dispatch MXU kernel vs the staged fused pipeline vs
@@ -159,6 +160,21 @@ cache-smoke:
 kernel-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_megakernel.py \
 		-m kernel_smoke -q -p no:cacheprovider
+
+# Fleet plane smoke (trivy_tpu/fleet/): ring determinism pins, the member
+# health machine, router spill policy, keep-alive transport regression,
+# and the 2-member in-process e2e (affinity convergence, drain failover
+# with zero dropped requests, byte parity vs a single host) — then a
+# BENCH_FLEET-only bench run (2-process aggregate throughput, affinity
+# hit rate, SIGTERM failover with zero dropped tickets on the
+# single-JSON-line contract).
+fleet-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet.py \
+		-q -p no:cacheprovider && \
+	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
+		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
+		BENCH_IMAGE=0 BENCH_TENANT=0 BENCH_OBS=0 BENCH_MEM=0 \
+		BENCH_FAULT=0 BENCH_MULTICHIP=0 BENCH_CACHE=0 $(PY) bench.py --smoke
 
 # Performance regression gate: one smoke bench run (heavy sections off,
 # primary corpus only) appends to a throwaway ledger, then
@@ -189,7 +205,7 @@ bench-link:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 BENCH_IMAGE=0 \
 		BENCH_TENANT=0 BENCH_FAULT=0 BENCH_MULTICHIP=0 BENCH_CACHE=0 \
-		BENCH_FILES=2000 BENCH_PARITY=sample \
+		BENCH_FLEET=0 BENCH_FILES=2000 BENCH_PARITY=sample \
 		$(PY) bench.py
 
 # Verify-backend economics only: the hit-dense corpus under host-DFA vs
@@ -201,7 +217,7 @@ bench-verify:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_LINK=0 \
 		BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 BENCH_IMAGE=0 \
 		BENCH_TENANT=0 BENCH_MEM=0 BENCH_FAULT=0 BENCH_MULTICHIP=0 \
-		BENCH_CACHE=0 $(PY) bench.py --smoke
+		BENCH_CACHE=0 BENCH_FLEET=0 $(PY) bench.py --smoke
 
 # Precompile the builtin ruleset into the registry cache (trivy_tpu/registry/)
 # so every later scan/server process warm-starts without compiling rules.

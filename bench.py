@@ -1716,6 +1716,254 @@ def _multichip_child(n: int, n_files: int) -> None:
     print(json.dumps(payload, separators=(",", ":")))
 
 
+def bench_fleet(n_members: int = 2) -> dict:
+    """BENCH_FLEET: multi-host serving behind digest-affine routing
+    (trivy_tpu/fleet/).
+
+    Boots n_members real server processes (`trivy-tpu server
+    --fleet-config`) sharing one fleet YAML, pushes a handful of
+    distinct rulesets to every member through the router's broadcast,
+    then drives the same digest-keyed workload three ways: a
+    single-host baseline through one member (the byte-parity oracle),
+    the full fleet through FleetRouter (aggregate files/s + affinity
+    hit rate, read from the members' X-Trivy-Fleet-Affinity headers),
+    and one more round after SIGTERM-killing the busiest member
+    mid-load — every request must still be served by a survivor with
+    identical bytes; failover_dropped_tickets counts the ones that
+    weren't.  On a 1-core CI box aggregate wall-clock cannot scale with
+    member count; placement, affinity, and loss-free failover can, and
+    those are what the perf baseline pins.
+    """
+    import hashlib
+    import signal
+    import socket
+    import subprocess
+    import tempfile
+    import textwrap
+    import urllib.request
+
+    from trivy_tpu.fleet import decisions as fleet_decisions
+    from trivy_tpu.fleet.membership import FleetMembership, load_fleet_config
+    from trivy_tpu.fleet.router import FleetRouter
+    from trivy_tpu.rpc.client import RpcClient
+
+    n_rulesets = 3 if SMOKE else 4
+    files_per_req = 4
+    reqs_per_digest = 5 if SMOKE else 20
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def ruleset_yaml(i: int) -> str:
+        return textwrap.dedent(
+            f"""
+            rules:
+              - id: fleet-tok-{i}
+                category: custom
+                title: Fleet token {i}
+                severity: critical
+                regex: FLEETTOK{i}-[a-f0-9]{{8}}
+                keywords: [FLEETTOK{i}-]
+            """
+        )
+
+    def workload(i: int, j: int) -> list:
+        # Deterministic per (ruleset, request): the same items replay in
+        # every phase, so response fingerprints are directly comparable.
+        return [
+            (
+                f"r{i}/req{j}/f{k}.env",
+                f"token = FLEETTOK{i}-deadbe{k:02x}\npad = {j}\n".encode(),
+            )
+            for k in range(files_per_req)
+        ]
+
+    tmp = tempfile.mkdtemp(prefix="trivy-tpu-fleet-bench-")
+    ports = [free_port() for _ in range(n_members)]
+    names = [f"m{i}" for i in range(n_members)]
+    cfg_path = os.path.join(tmp, "fleet.yaml")
+    with open(cfg_path, "w") as f:
+        json.dump(  # YAML is a JSON superset; safe_load reads this fine
+            {
+                "members": [
+                    {"name": nm, "endpoint": f"127.0.0.1:{pt}"}
+                    for nm, pt in zip(names, ports)
+                ]
+            },
+            f,
+        )
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TRIVY_TPU_LINK"] = "relay"
+    # Same hygiene as bench_multichip: an accelerator-plugin
+    # sitecustomize on PYTHONPATH can pin jax to real hardware.
+    env.pop("PYTHONPATH", None)
+
+    procs: dict[str, subprocess.Popen] = {}
+    logs: dict[str, str] = {}
+    router = None
+    try:
+        for nm, pt in zip(names, ports):
+            logs[nm] = os.path.join(tmp, f"{nm}.log")
+            lf = open(logs[nm], "w")
+            procs[nm] = subprocess.Popen(
+                [
+                    sys.executable, "-m", "trivy_tpu.cli", "server",
+                    "--listen", f"127.0.0.1:{pt}",
+                    "--fleet-config", cfg_path,
+                    "--fleet-member", nm,
+                    "--rules-cache-dir", os.path.join(tmp, f"{nm}-rules"),
+                    "--batch-window-ms", "5",
+                ],
+                cwd=repo,
+                env=env,
+                stdout=lf,
+                stderr=subprocess.STDOUT,
+            )
+            lf.close()
+
+        deadline = time.monotonic() + 240.0
+        for nm, pt in zip(names, ports):
+            while True:
+                if procs[nm].poll() is not None or time.monotonic() > deadline:
+                    tail = ""
+                    try:
+                        with open(logs[nm]) as f:
+                            tail = f.read()[-2000:]
+                    except OSError:
+                        pass
+                    raise RuntimeError(
+                        f"fleet member {nm} never became ready "
+                        f"(rc={procs[nm].poll()}):\n{tail}"
+                    )
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{pt}/readyz", timeout=2.0
+                    ) as resp:
+                        if resp.status == 200:
+                            break
+                except Exception:
+                    pass
+                time.sleep(0.5)
+
+        router = FleetRouter(
+            FleetMembership.from_config(load_fleet_config(cfg_path)),
+            timeout_s=120.0,
+        )
+        digests = []
+        for i in range(n_rulesets):
+            out = router.push_ruleset(rules_yaml=ruleset_yaml(i))
+            assert all(v == "ok" for v in out["FleetPush"].values()), out
+            digests.append(out["RulesetDigest"])
+
+        def run_phase(scan):
+            fp = hashlib.sha256()
+            findings = 0
+            dropped = 0
+            t0 = time.perf_counter()
+            for j in range(reqs_per_digest):
+                for i, dig in enumerate(digests):
+                    try:
+                        resp = scan(workload(i, j), dig)
+                    except Exception:
+                        dropped += 1
+                        continue
+                    fp.update(
+                        json.dumps(
+                            resp.get("Secrets"), sort_keys=True
+                        ).encode()
+                    )
+                    findings += sum(
+                        len(s.get("Findings") or [])
+                        for s in (resp.get("Secrets") or [])
+                    )
+            wall = time.perf_counter() - t0
+            n_req = reqs_per_digest * len(digests)
+            return {
+                "wall_s": round(wall, 3),
+                "files_per_sec": round(
+                    (n_req - dropped) * files_per_req / max(wall, 1e-9), 1
+                ),
+                "findings": findings,
+                "dropped": dropped,
+                "fingerprint": fp.hexdigest(),
+            }
+
+        # Phase 1: single-host oracle through member 0's endpoint alone.
+        solo = RpcClient(f"127.0.0.1:{ports[0]}", timeout_s=120.0)
+        base = run_phase(
+            lambda items, dig: solo.scan_secrets(items, ruleset_digest=dig)
+        )
+        solo.close()
+
+        # Phase 2: the fleet behind the router.
+        fleet_decisions.clear()
+        fleet = run_phase(
+            lambda items, dig: router.scan_secrets(items, ruleset_digest=dig)
+        )
+        aff = fleet_decisions.affinity_tallies()
+        share: dict[str, int] = {}
+        for (member, _reason), n in fleet_decisions.tallies().items():
+            share[member] = share.get(member, 0) + n
+
+        # Phase 3: SIGTERM the busiest member mid-load, replay the round.
+        served = {m: n for m, n in share.items() if m in procs}
+        victim = max(served, key=lambda m: served[m]) if served else names[0]
+        kill_after = (reqs_per_digest * len(digests)) // 4
+        state = {"sent": 0}
+
+        def scan_with_kill(items, dig):
+            if state["sent"] == kill_after:
+                procs[victim].send_signal(signal.SIGTERM)
+                procs[victim].wait(timeout=30)
+            state["sent"] += 1
+            return router.scan_secrets(items, ruleset_digest=dig)
+
+        failover = run_phase(scan_with_kill)
+
+        return {
+            "members": n_members,
+            "rulesets": n_rulesets,
+            "files_per_req": files_per_req,
+            "requests_per_phase": reqs_per_digest * len(digests),
+            "files_per_sec_1p": base["files_per_sec"],
+            "aggregate_files_per_sec_2p": fleet["files_per_sec"],
+            "speedup_2p": round(
+                fleet["files_per_sec"] / max(base["files_per_sec"], 1e-9), 2
+            ),
+            "findings": fleet["findings"],
+            "parity_identical": (
+                1 if fleet["fingerprint"] == base["fingerprint"] else 0
+            ),
+            "affinity_hit_rate": fleet_decisions.affinity_hit_rate(),
+            "affinity": aff,
+            "member_share": share,
+            "failover_killed": victim,
+            "failover_dropped_tickets": failover["dropped"],
+            "parity_after_failover": (
+                1 if failover["fingerprint"] == base["fingerprint"] else 0
+            ),
+            "failover_files_per_sec": failover["files_per_sec"],
+        }
+    finally:
+        if router is not None:
+            router.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=15)
+            except Exception:
+                p.kill()
+
+
 def _compact_detail(detail: dict) -> dict:
     """Headline subset of `detail` small enough for the tail-captured
     stdout line; the full structure lives in the side file."""
@@ -1809,6 +2057,17 @@ def _compact_detail(detail: dict) -> dict:
                 "parity_identical", "speedup", "error",
             )
             if k in ca
+        }
+    fl = detail.get("fleet")
+    if isinstance(fl, dict):
+        c["fleet"] = {
+            k: fl[k]
+            for k in (
+                "aggregate_files_per_sec_2p", "affinity_hit_rate",
+                "failover_dropped_tickets", "parity_identical",
+                "parity_after_failover", "speedup_2p", "error",
+            )
+            if k in fl
         }
     vb = detail.get("verify_backend")
     if isinstance(vb, dict):
@@ -2099,6 +2358,16 @@ def main() -> None:
             detail["cache"] = bench_cache(6, 25) if SMOKE else bench_cache()
         except Exception as e:
             detail["cache"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if os.environ.get("BENCH_FLEET", "1") == "1":
+        # Fleet plane (trivy_tpu/fleet/): two real server processes
+        # behind digest-affine routing — aggregate files/s, affinity hit
+        # rate, byte parity vs a single host, and SIGTERM failover with
+        # zero dropped tickets.
+        try:
+            detail["fleet"] = bench_fleet()
+        except Exception as e:
+            detail["fleet"] = {"error": f"{type(e).__name__}: {e}"}
 
     try:
         import resource

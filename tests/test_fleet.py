@@ -1,0 +1,590 @@
+"""Fleet plane: rendezvous ring determinism, member health transitions,
+router spill policy, keep-alive transport, and the 2-member in-process
+fleet end-to-end (affinity, drain failover, byte parity vs single host).
+
+The ring tests pin exact placements: rendezvous hashing is a pure
+function of (member name, weight, digest), so placements must survive
+process restarts byte-for-byte — a fleet where two clients disagree on
+a digest's primary has no affinity story at all.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from trivy_tpu.fleet import decisions, ring
+from trivy_tpu.fleet.membership import (
+    FleetConfig,
+    FleetConfigError,
+    FleetMembership,
+    FleetSelf,
+    Member,
+    MemberHealth,
+    parse_fleet_config,
+)
+from trivy_tpu.fleet.router import FleetExhaustedError, FleetRouter
+from trivy_tpu.atypes import _secret_to_json
+from trivy_tpu.ftypes import Code, Secret, SecretFinding
+from trivy_tpu.rpc import client as rpc_client
+from trivy_tpu.rpc.client import RetryBudget, RpcClient, RpcError
+from trivy_tpu.rpc.server import start_background
+from trivy_tpu.serve import ServeConfig
+
+MEMBERS = [
+    Member("alpha", "h1:1"),
+    Member("beta", "h2:1"),
+    Member("gamma", "h3:1"),
+]
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    decisions.clear()
+    rpc_client.reset_retry_budget(RetryBudget(min_floor=100))
+    yield
+    decisions.clear()
+    rpc_client.reset_retry_budget()
+
+
+# -- the rendezvous ring ----------------------------------------------------
+
+
+def test_ring_placements_are_restart_stable():
+    """Hardcoded expected orders: any change here means every deployed
+    client would disagree with every deployed server about placement."""
+    expect = {
+        "default": ["beta", "alpha", "gamma"],
+        "sha256:aaaa": ["alpha", "beta", "gamma"],
+        "sha256:bbbb": ["beta", "alpha", "gamma"],
+        "sha256:cccc": ["beta", "gamma", "alpha"],
+        "deadbeef": ["gamma", "beta", "alpha"],
+        "feedface": ["gamma", "alpha", "beta"],
+    }
+    for digest, order in expect.items():
+        assert [m.name for m in ring.candidates(digest, MEMBERS)] == order
+        assert ring.primary(digest, MEMBERS).name == order[0]
+
+
+def test_ring_candidates_cover_all_members_once():
+    for digest in ("a", "b", "c", "x" * 64):
+        names = [m.name for m in ring.candidates(digest, MEMBERS)]
+        assert sorted(names) == ["alpha", "beta", "gamma"]
+
+
+def test_ring_join_moves_about_one_over_n():
+    """Adding a 4th member must move ~1/4 of the digest space — and
+    ONLY digests whose new primary IS the joiner (no collateral
+    reshuffling, the property rendezvous hashing exists for)."""
+    digests = [f"d{i:04d}" for i in range(1000)]
+    grown = MEMBERS + [Member("delta", "h4:1")]
+    moved = 0
+    for d in digests:
+        before = ring.primary(d, MEMBERS).name
+        after = ring.primary(d, grown).name
+        if before != after:
+            moved += 1
+            assert after == "delta"  # only the joiner gains digests
+    assert 100 <= moved <= 450  # ~250 expected for 1/4
+
+
+def test_ring_leave_moves_only_the_leavers_digests():
+    digests = [f"d{i:04d}" for i in range(500)]
+    shrunk = [m for m in MEMBERS if m.name != "gamma"]
+    for d in digests:
+        before = ring.primary(d, MEMBERS).name
+        after = ring.primary(d, shrunk).name
+        if before != "gamma":
+            assert after == before  # survivors keep their digests
+        else:
+            assert after in ("alpha", "beta")
+
+
+def test_ring_weight_scales_share():
+    digests = [f"d{i:04d}" for i in range(1000)]
+    weighted = [
+        Member("a", "h1:1", 1.0),
+        Member("b", "h2:1", 2.0),
+        Member("c", "h3:1", 1.0),
+    ]
+    share = {"a": 0, "b": 0, "c": 0}
+    for d in digests:
+        share[ring.primary(d, weighted).name] += 1
+    # b holds weight 2 of 4 total: expect ~500 of 1000 (observed 498).
+    assert 400 <= share["b"] <= 600
+    assert share["a"] > 150 and share["c"] > 150
+
+
+def test_ring_zero_weight_member_never_primary():
+    members = MEMBERS + [Member("idle", "h9:1", 0.0)]
+    for d in (f"d{i}" for i in range(200)):
+        order = [m.name for m in ring.candidates(d, members)]
+        assert order[-1] == "idle"  # sorts behind every weighted member
+
+
+# -- fleet config -----------------------------------------------------------
+
+
+def test_parse_fleet_config_roundtrip_and_nesting():
+    doc = {
+        "members": [
+            {"name": "a", "endpoint": "h1:1", "weight": 2},
+            {"name": "b", "endpoint": "h2:1"},
+        ],
+        "self": "b",
+    }
+    for wrapped in (doc, {"fleet": doc}):
+        cfg = parse_fleet_config(wrapped)
+        assert [m.name for m in cfg.members] == ["a", "b"]
+        assert cfg.members[0].weight == 2.0
+        assert cfg.self_name == "b"
+        assert cfg.member("a").endpoint == "h1:1"
+
+
+@pytest.mark.parametrize(
+    "doc",
+    [
+        {},
+        {"members": []},
+        {"members": [{"name": "a"}]},  # no endpoint
+        {"members": [{"endpoint": "h:1"}]},  # no name
+        {"members": [{"name": "a", "endpoint": "h:1"}] * 2},  # dup
+        {"members": [{"name": "a", "endpoint": "h:1", "weight": "x"}]},
+        {"members": [{"name": "a", "endpoint": "h:1", "weight": -1}]},
+        {"members": [{"name": "a", "endpoint": "h:1"}], "self": "ghost"},
+    ],
+)
+def test_parse_fleet_config_rejects(doc):
+    with pytest.raises(FleetConfigError):
+        parse_fleet_config(doc)
+
+
+# -- member health ----------------------------------------------------------
+
+
+def _health(clock, threshold=3, cooldown=5.0):
+    return MemberHealth(
+        failure_threshold=threshold,
+        window_s=30.0,
+        cooldown_s=cooldown,
+        clock=lambda: clock[0],
+    )
+
+
+def test_health_threshold_failures_mark_down_then_probe_recovers():
+    clock = [0.0]
+    h = _health(clock)
+    assert h.admit()
+    h.note_failure()
+    h.note_failure()
+    assert h.state == "up"  # under threshold
+    h.note_failure()
+    assert h.state == "down"
+    assert not h.admit()  # cooling down
+    clock[0] = 5.1
+    assert h.admit()  # exactly one probe
+    assert h.state == "probing"
+    assert not h.admit()  # second request refused behind the probe
+    h.note_success()
+    assert h.state == "up"
+    assert h.recoveries_total == 1
+
+
+def test_health_probe_failure_restarts_cooldown():
+    clock = [0.0]
+    h = _health(clock)
+    for _ in range(3):
+        h.note_failure()
+    clock[0] = 5.1
+    assert h.admit()
+    h.note_failure()  # probe failed
+    assert h.state == "down"
+    clock[0] = 10.0
+    assert not h.admit()  # 5.1 + 5.0 cooldown not elapsed
+    clock[0] = 10.2
+    assert h.admit()
+
+
+def test_health_drain_honors_retry_after_and_never_counts_down():
+    clock = [0.0]
+    h = _health(clock)
+    h.note_drain(2.0)
+    assert h.state == "draining"
+    assert h.marked_down_total == 0  # a 503 is protocol, not failure
+    assert not h.admit()
+    clock[0] = 2.1
+    assert h.admit()  # Retry-After elapsed -> probe
+    assert h.state == "probing"
+
+
+def test_membership_probe_folds_prober_outcomes():
+    outcomes = {"alpha": (True, None), "beta": (False, 3.0), "gamma": (None, None)}
+
+    def prober(endpoint):
+        name = {"h1:1": "alpha", "h2:1": "beta", "h3:1": "gamma"}[endpoint]
+        return outcomes[name]
+
+    m = FleetMembership(MEMBERS, prober=prober)
+    states = m.probe_all()
+    assert states["alpha"] == "up"
+    assert states["beta"] == "draining"
+    assert states["gamma"] == "up"  # one failure is under the threshold
+    snap = m.snapshot()
+    assert snap["beta"]["retry_in_s"] > 0
+    assert snap["gamma"]["failures_in_window"] == 1
+
+
+# -- FleetSelf --------------------------------------------------------------
+
+
+def test_fleet_self_requires_membership():
+    cfg = FleetConfig(members=tuple(MEMBERS))
+    with pytest.raises(FleetConfigError):
+        FleetSelf(cfg)  # no self: and no override
+    with pytest.raises(FleetConfigError):
+        FleetSelf(cfg, self_name="ghost")
+    assert FleetSelf(cfg, self_name="beta").name == "beta"
+
+
+def test_fleet_self_affinity_first_touch_miss_then_hits():
+    cfg = FleetConfig(members=tuple(MEMBERS), self_name="alpha")
+    fs = FleetSelf(cfg)
+    assert fs.note_scan("sha256:aaaa") == "miss"
+    assert fs.note_scan("sha256:aaaa") == "hit"
+    assert fs.note_scan("", resident_hint=True) == "hit"  # warm default
+    aff = fs.affinity()
+    assert aff == {"hits": 2, "misses": 1, "hit_rate": 2 / 3}
+    assert fs.seen_digests() == ["default", "sha256:aaaa"]
+    brief = fs.brief()
+    assert brief["member"] == "alpha" and brief["members"] == 3
+    rep = fs.report()
+    assert rep["self"] == "alpha" and set(rep["members"]) == {
+        "alpha", "beta", "gamma",
+    }
+
+
+# -- the router (faked clients) --------------------------------------------
+
+
+class _FakeClient:
+    """Scripted RpcClient stand-in: each scan pops the next outcome for
+    its endpoint — "ok", ("reject", status, retry_after), or an exception
+    class to raise as a connection failure."""
+
+    def __init__(self, endpoint, script):
+        self.endpoint = endpoint
+        self.script = script
+        self.headers = {}
+        self.last_response_headers = {}
+        self.last_error_status = 0
+        self.last_error_retry_after = None
+        self.calls = 0
+
+    def scan_secrets(self, items, **kw):
+        self.calls += 1
+        step = self.script.pop(0) if self.script else "ok"
+        if step == "ok":
+            self.last_error_status = 0
+            self.last_response_headers = {
+                "X-Trivy-Fleet-Member": self.endpoint,
+                "X-Trivy-Fleet-Affinity": "hit",
+            }
+            return {"Secrets": [], "RulesetDigest": kw.get("ruleset_digest", "")}
+        if isinstance(step, tuple):
+            _, status, retry_after = step
+            self.last_error_status = status
+            self.last_error_retry_after = retry_after
+            raise RpcError(f"/scan: HTTP {status}")
+        self.last_error_status = None
+        self.last_error_retry_after = None
+        raise RpcError("/scan: conn") from step()
+
+    def push_ruleset(self, **kw):
+        return {"RulesetDigest": "d", "Resident": True}
+
+    def close(self):
+        pass
+
+
+def _router(scripts, **kw):
+    membership = FleetMembership(MEMBERS)
+    clients = {}
+
+    def factory(endpoint):
+        clients[endpoint] = _FakeClient(endpoint, scripts.get(endpoint, []))
+        return clients[endpoint]
+
+    r = FleetRouter(membership, client_factory=factory, **kw)
+    r.sleep = lambda s: None
+    return r, clients
+
+
+def test_router_primary_serves_and_attributes():
+    # "deadbeef" order: gamma, beta, alpha (pinned above).
+    r, clients = _router({})
+    r.scan_secrets([("a", b"x")], ruleset_digest="deadbeef")
+    assert clients["h3:1"].calls == 1  # gamma is primary
+    assert "h2:1" not in clients  # no spill
+    rec = decisions.last()
+    assert rec["member"] == "h3:1" and rec["reason"] == "primary"
+    assert rec["outcome"] == "ok" and rec["affinity"] == "hit"
+    assert r.last_affinity == "hit"
+
+
+def test_router_503_drains_member_and_spills():
+    r, clients = _router({"h3:1": [("reject", 503, 2.0)]})
+    r.scan_secrets([("a", b"x")], ruleset_digest="deadbeef")
+    assert clients["h3:1"].calls == 1
+    assert clients["h2:1"].calls == 1  # spilled to beta
+    assert r.membership.state("gamma") == "draining"
+    rec = decisions.last()
+    assert rec["reason"] == "spill-reject" and rec["outcome"] == "ok"
+    # The NEXT request for the digest skips the draining primary without
+    # sending anything (admit() refuses until Retry-After elapses).
+    r.scan_secrets([("a", b"x")], ruleset_digest="deadbeef")
+    assert clients["h3:1"].calls == 1
+
+
+def test_router_connect_failures_mark_down_and_spill():
+    r, clients = _router(
+        {"h3:1": [ConnectionRefusedError] * 5}  # gamma hard down
+    )
+    for _ in range(3):
+        r.scan_secrets([("a", b"x")], ruleset_digest="deadbeef")
+    assert r.membership.state("gamma") == "down"
+    assert clients["h3:1"].calls == 3  # threshold reached, then skipped
+    r.scan_secrets([("a", b"x")], ruleset_digest="deadbeef")
+    assert clients["h3:1"].calls == 3  # down member got no request
+    tallies = decisions.tallies()
+    assert tallies[("h2:1", "spill-error")] >= 1
+    # Once down, the primary is skipped (attributed by member name) and
+    # the survivor serves under the spill-health reason.
+    assert tallies[("gamma", "primary")] >= 1
+    assert tallies[("h2:1", "spill-health")] >= 1
+
+
+def test_router_deterministic_4xx_never_spills():
+    r, clients = _router({"h3:1": [("reject", 404, None)]})
+    with pytest.raises(RpcError):
+        r.scan_secrets([("a", b"x")], ruleset_digest="deadbeef")
+    assert "h2:1" not in clients  # a 404 fails the same everywhere
+
+
+def test_router_short_429_waits_on_affine_member():
+    r, clients = _router({"h3:1": [("reject", 429, 0.5), "ok"]})
+    naps = []
+    r.sleep = naps.append
+    r.scan_secrets([("a", b"x")], ruleset_digest="deadbeef")
+    assert clients["h3:1"].calls == 2  # waited and retried SAME member
+    assert naps == [0.5]
+    assert "h2:1" not in clients
+
+
+def test_router_long_429_spills():
+    r, clients = _router({"h3:1": [("reject", 429, 30.0)]})
+    r.scan_secrets([("a", b"x")], ruleset_digest="deadbeef")
+    assert clients["h3:1"].calls == 1
+    assert clients["h2:1"].calls == 1
+
+
+def test_router_all_down_raises_exhausted():
+    scripts = {
+        ep: [ConnectionRefusedError] * 10 for ep in ("h1:1", "h2:1", "h3:1")
+    }
+    r, _ = _router(scripts)
+    with pytest.raises(FleetExhaustedError):
+        r.scan_secrets([("a", b"x")], ruleset_digest="deadbeef")
+
+
+def test_router_spills_metered_by_retry_budget():
+    rpc_client.reset_retry_budget(RetryBudget(min_floor=0, ratio=0.0))
+    scripts = {
+        ep: [ConnectionRefusedError] * 10 for ep in ("h1:1", "h2:1", "h3:1")
+    }
+    r, clients = _router(scripts)
+    with pytest.raises(FleetExhaustedError) as ei:
+        r.scan_secrets([("a", b"x")], ruleset_digest="deadbeef")
+    assert "budget" in str(ei.value)
+    # Primary attempt is free; the dry budget stopped the first spill.
+    assert sum(c.calls for c in clients.values()) == 1
+
+
+def test_router_push_reaches_every_member():
+    r, clients = _router({})
+    out = r.push_ruleset(rules_yaml="rules: []")
+    assert set(out["FleetPush"]) == {"alpha", "beta", "gamma"}
+    assert all(v == "ok" for v in out["FleetPush"].values())
+    assert len(clients) == 3
+
+
+def test_router_report_shape():
+    r, _ = _router({})
+    r.scan_secrets([("a", b"x")], ruleset_digest="deadbeef")
+    rep = r.report()
+    assert set(rep["members"]) == {"alpha", "beta", "gamma"}
+    assert rep["affinity_hit_rate"] == 1.0
+    assert rep["decisions"][0]["outcome"] == "ok"
+
+
+# -- live servers: keep-alive, Retry-After, /debug/fleet, 2-member e2e ------
+
+SECRET_FILE = b"AWS_ACCESS_KEY_ID=AKIAQ6FAKEKEY1234567\n"
+
+
+class _EchoEngine:
+    """Deterministic engine: flags any item containing the AKIA marker.
+    Thread-safe and build-free, so each in-process server can own one."""
+
+    def scan_batch(self, items):
+        out = []
+        for path, content in items:
+            s = Secret(file_path=path)
+            if b"AKIA" in content:
+                s.findings = [
+                    SecretFinding(
+                        rule_id="aws-access-key-id",
+                        category="AWS",
+                        severity="CRITICAL",
+                        title="AWS Access Key ID",
+                        start_line=1,
+                        end_line=1,
+                        code=Code(),
+                        match="AKIA********",
+                    )
+                ]
+            out.append(s)
+        return out
+
+
+def _fleet_pair():
+    """Two real in-process servers sharing one fleet config."""
+    servers = []
+    members = []
+    for name in ("a", "b"):
+        httpd, _ = start_background(
+            "localhost:0",
+            __import__("trivy_tpu.cache.store", fromlist=["MemoryCache"]).MemoryCache(),
+            serve_config=ServeConfig(batch_window_ms=0.0),
+            secret_engine_factory=_EchoEngine,
+        )
+        servers.append(httpd)
+        members.append(
+            Member(name, f"localhost:{httpd.server_address[1]}")
+        )
+    cfg = FleetConfig(members=tuple(members))
+    # Fleet identity attaches post-bind (ports are dynamic in tests; real
+    # deployments pass --fleet-config at startup).
+    from trivy_tpu.fleet.membership import FleetSelf as _FS
+
+    for httpd, m in zip(servers, members):
+        httpd.scan_server.fleet = _FS(cfg, self_name=m.name)
+    return servers, cfg
+
+
+def _close_all(servers):
+    for httpd in servers:
+        httpd.scan_server.scheduler.close()
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_client_keepalive_reuses_one_connection():
+    """The keep-alive satellite's regression test: N sequential calls on
+    one client ride ONE TCP connection (the router multiplies request
+    count — per-call connects would tax every spill and probe)."""
+    servers, _ = _fleet_pair()
+    try:
+        addr = f"localhost:{servers[0].server_address[1]}"
+        c = RpcClient(addr)
+        for _ in range(5):
+            c.scan_secrets([("x.txt", SECRET_FILE)])
+        assert c.connects_total == 1
+        c.close()
+        c.scan_secrets([("x.txt", SECRET_FILE)])
+        assert c.connects_total == 2  # close() drops the socket
+    finally:
+        _close_all(servers)
+
+
+def test_readyz_503_carries_retry_after():
+    servers, _ = _fleet_pair()
+    try:
+        scan_server = servers[0].scan_server
+        addr = f"localhost:{servers[0].server_address[1]}"
+        # Open the breaker: Retry-After must reflect its cooldown.
+        breaker = scan_server.scheduler.breaker
+        for _ in range(breaker.failure_threshold):
+            breaker.record_failure()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://{addr}/readyz")
+        e = ei.value
+        body = json.load(e)
+        e.close()
+        assert e.code == 503
+        hint = int(e.headers["Retry-After"])
+        assert 1 <= hint <= int(breaker.cooldown_s) + 1
+        assert body["checks"]["breaker"] == "open"
+        assert body["retry_after_s"] > 0
+    finally:
+        _close_all(servers)
+
+
+def test_debug_fleet_surface_and_member_header():
+    servers, _ = _fleet_pair()
+    try:
+        addr = f"localhost:{servers[1].server_address[1]}"
+        with urllib.request.urlopen(f"http://{addr}/debug/fleet") as resp:
+            rep = json.load(resp)
+            assert resp.headers["X-Trivy-Fleet-Member"] == "b"
+        assert rep["enabled"] is True
+        assert rep["self"] == "b"
+        assert set(rep["members"]) == {"a", "b"}
+        assert rep["affinity"]["hits"] == 0
+    finally:
+        _close_all(servers)
+
+
+@pytest.mark.fleet_smoke
+def test_two_member_fleet_affinity_failover_and_parity():
+    """The acceptance path in-process: a 2-member fleet serves
+    byte-identical findings to a single host, affinity converges (every
+    digest after its first touch is a hit), and draining one member
+    mid-run drops zero requests."""
+    servers, cfg = _fleet_pair()
+    try:
+        router = FleetRouter(FleetMembership.from_config(cfg))
+        items = [
+            [(f"r{i}/creds.env", SECRET_FILE + f"# {i}\n".encode()),
+             (f"r{i}/plain.txt", b"nothing here\n")]
+            for i in range(8)
+        ]
+        # Parity oracle: the same engine class, locally.
+        local = _EchoEngine()
+        expected = [
+            [json.loads(json.dumps(_secret_to_json(s))) for s in local.scan_batch(batch)]
+            for batch in items
+        ]
+        got = [router.scan_secrets(batch) for batch in items]
+        for resp, want in zip(got, expected):
+            assert resp["Secrets"] == want  # byte parity
+        # Everything used the default lane -> one member serves it all,
+        # and after the first touch every response is an affinity hit.
+        members_seen = {r["member"] for r in decisions.records()}
+        assert len(members_seen) == 1
+        aff = decisions.affinity_tallies()
+        assert aff["hit"] == len(items) - 1 and aff["miss"] == 1
+        # Failover: drain the serving member; every further request must
+        # still succeed (spilling to the survivor), zero dropped.
+        serving = next(iter(members_seen))
+        for httpd in servers:
+            if httpd.scan_server.fleet.name == serving:
+                httpd.scan_server.draining = True
+        for batch in items:
+            resp = router.scan_secrets(batch)
+            assert resp["Secrets"]  # served, not dropped
+        assert router.last_member != serving
+        assert router.membership.state(serving) == "draining"
+    finally:
+        _close_all(servers)

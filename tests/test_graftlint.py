@@ -28,7 +28,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(ROOT, "tools", "graftlint", "fixtures")
 ALL_RULES = (
     "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007", "GL008",
-    "GL009", "GL010", "GL011", "GL012",
+    "GL009", "GL010", "GL011", "GL012", "GL013",
 )
 
 
@@ -77,6 +77,7 @@ def test_deny_fixture_counts_stable():
         "GL010": 4,
         "GL011": 4,
         "GL012": 4,
+        "GL013": 3,
     }
 
 

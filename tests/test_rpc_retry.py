@@ -3,9 +3,7 @@ seam (and faked transports where the seam can't express the case):
 Retry-After parsing, attempt-cap exhaustion carrying the last error,
 4xx fail-fast, connection resets, and the sliding-window retry budget."""
 
-import io
 import json
-import urllib.error
 
 import pytest
 
@@ -27,27 +25,19 @@ def _clean():
     rpc_client.reset_retry_budget()
 
 
-class _FakeResponse:
-    def __init__(self, payload):
-        self._raw = json.dumps(payload).encode()
-        self.headers = {}
-
-    def read(self):
-        return self._raw
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        return False
+def _ok(payload):
+    """A responder for one successful exchange."""
+    raw = json.dumps(payload).encode()
+    return lambda: (200, {}, raw)
 
 
 def _client(monkeypatch, responder, **kw):
-    """RpcClient whose transport is `responder()` and whose backoff sleeps
-    are recorded instead of slept."""
+    """RpcClient whose transport is `responder()` — a callable returning a
+    (status, headers, body) triple — and whose backoff sleeps are recorded
+    instead of slept."""
     sleeps = []
     monkeypatch.setattr(
-        "urllib.request.urlopen", lambda req, timeout: responder()
+        RpcClient, "_transport", lambda self, url, body, headers: responder()
     )
     c = RpcClient("localhost:1", **kw)
     monkeypatch.setattr(
@@ -60,7 +50,7 @@ def _client(monkeypatch, responder, **kw):
 
 
 def test_reset_via_recv_seam_retries_then_succeeds(monkeypatch):
-    c, sleeps = _client(monkeypatch, lambda: _FakeResponse({"ok": 1}))
+    c, sleeps = _client(monkeypatch, _ok({"ok": 1}))
     faults.configure("rpc.recv:reset@1x2")
     assert c.call("/x", {}) == {"ok": 1}
     assert len(sleeps) == 2  # two resets absorbed, third attempt clean
@@ -68,16 +58,14 @@ def test_reset_via_recv_seam_retries_then_succeeds(monkeypatch):
 
 
 def test_truncated_body_via_recv_seam_is_retryable(monkeypatch):
-    c, sleeps = _client(monkeypatch, lambda: _FakeResponse({"ok": 1}))
+    c, sleeps = _client(monkeypatch, _ok({"ok": 1}))
     faults.configure("rpc.recv:truncate@1x1")
     assert c.call("/x", {}) == {"ok": 1}
     assert len(sleeps) == 1
 
 
 def test_attempt_cap_exhaustion_raises_last_error(monkeypatch):
-    c, sleeps = _client(
-        monkeypatch, lambda: _FakeResponse({"ok": 1}), max_retries=3
-    )
+    c, sleeps = _client(monkeypatch, _ok({"ok": 1}), max_retries=3)
     faults.configure("rpc.recv:reset@1")  # unlimited: every attempt resets
     with pytest.raises(RpcError) as ei:
         c.call("/x", {})
@@ -88,7 +76,7 @@ def test_attempt_cap_exhaustion_raises_last_error(monkeypatch):
 
 
 def test_latency_kind_delays_but_succeeds(monkeypatch):
-    c, sleeps = _client(monkeypatch, lambda: _FakeResponse({"ok": 1}))
+    c, sleeps = _client(monkeypatch, _ok({"ok": 1}))
     faults.configure("rpc.recv:latency@1x1")
     assert c.call("/x", {}) == {"ok": 1}
     assert sleeps == []  # latency is not a retry
@@ -98,12 +86,7 @@ def test_latency_kind_delays_but_succeeds(monkeypatch):
 
 
 def _http_error(code, headers=None, body=b"{}"):
-    def raiser():
-        raise urllib.error.HTTPError(
-            "http://localhost:1/x", code, "err", headers or {}, io.BytesIO(body)
-        )
-
-    return raiser
+    return lambda: (code, headers or {}, body)
 
 
 def test_4xx_is_never_retried(monkeypatch):
@@ -152,7 +135,7 @@ def test_parse_retry_after_forms():
 
 def test_budget_exhaustion_fails_fast_with_last_error(monkeypatch):
     rpc_client.reset_retry_budget(RetryBudget(min_floor=0, ratio=0.0))
-    c, sleeps = _client(monkeypatch, lambda: _FakeResponse({"ok": 1}))
+    c, sleeps = _client(monkeypatch, _ok({"ok": 1}))
     faults.configure("rpc.recv:reset@1")
     with pytest.raises(RpcError) as ei:
         c.call("/x", {})

@@ -33,6 +33,10 @@ Rule catalogue (each with allow/deny fixtures under fixtures/):
          in per-batch hot paths (must be jit-held, lru_cached, or
          registry-warmed); non-pow2 literal VMEM block dims in BlockSpec
          shapes
+  GL013  fleet routing seam: direct RpcClient(...) construction in
+         engine//serve/ bypassing FleetRouter placement and health
+         gating (annotate deliberate sites with `# graftlint:
+         router-seam(reason)`)
 
 The runtime complement is trivy_tpu/lockcheck.py (TRIVY_TPU_LOCKCHECK=1
 lock-order + owner-role sanitizer); graftlint checks what must hold by
@@ -46,6 +50,7 @@ from tools.graftlint.core import Finding, lint_paths, load_waivers
 # importing the rule modules registers them; anything importing the
 # package (CLI, tests) sees the full registry
 from tools.graftlint import (  # noqa: E402,F401
+    rules_fleet,
     rules_jax,
     rules_labels,
     rules_robust,

@@ -174,6 +174,7 @@ def rule(rule_id: str):
 def lint_module(mod: Module, rules: dict | None = None) -> list[Finding]:
     # import for side effect: rule registration
     from tools.graftlint import (  # noqa: F401
+        rules_fleet,
         rules_jax,
         rules_labels,
         rules_robust,

@@ -1,0 +1,29 @@
+"""GL013 allow fixture: routed, injected, or annotated remote clients."""
+
+from trivy_tpu.fleet.membership import FleetMembership, Member
+from trivy_tpu.fleet.router import FleetRouter
+from trivy_tpu.rpc.client import RpcClient
+
+
+def routed(cfg, token):
+    # The seam: the router owns endpoint choice and health gating.
+    return FleetRouter(FleetMembership.from_config(cfg), token=token)
+
+
+def injected(client):
+    # A caller-supplied client: the construction decision happened at a
+    # layer the rule already checked.
+    return client.scan_secrets([("a", b"x")])
+
+
+def annotated_probe(member: Member):
+    client = RpcClient(member.endpoint)  # graftlint: router-seam(probe one known member)
+    return client
+
+
+def unrelated_constructor(addr):
+    class NotAnRpcClient:
+        def __init__(self, a):
+            self.a = a
+
+    return NotAnRpcClient(addr)

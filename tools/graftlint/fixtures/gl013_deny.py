@@ -1,0 +1,18 @@
+"""GL013 deny fixture: direct RpcClient construction off the router seam."""
+
+from trivy_tpu.rpc import client as rpc_client
+from trivy_tpu.rpc.client import RpcClient
+
+
+def pins_one_endpoint(addr, token):
+    c = RpcClient(addr, token)  # GL013: bypasses placement + health gating
+    return c
+
+
+def module_qualified(addr):
+    return rpc_client.RpcClient(addr)  # GL013: same bypass, dotted form
+
+
+def empty_seam_reason(addr):
+    c = RpcClient(addr)  # graftlint: router-seam()
+    return c  # GL013: the reason is mandatory — router-seam() alone fails

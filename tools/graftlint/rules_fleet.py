@@ -1,0 +1,76 @@
+"""Fleet routing-seam hygiene.
+
+GL013: inside the scan-side runtime packages (``trivy_tpu/engine/`` and
+``trivy_tpu/serve/``), remote calls must not construct ``RpcClient``
+directly.  The fleet plane (``trivy_tpu/fleet/``) owns endpoint choice:
+the router applies rendezvous placement, health-gated admission, and
+spill attribution — a hand-built ``RpcClient`` pins one endpoint and
+silently bypasses all three, so a fleet deployment routes every request
+from that call site to whatever host the literal address names,
+invisible to /debug/fleet and the decision ring.
+
+The seam is ``FleetRouter`` (RpcClient-compatible) or an injected
+client; the one legitimate direct construction is the router's own
+member-client factory, which lives in ``trivy_tpu/fleet/`` and is out
+of scope by construction.  A deliberate direct client elsewhere (a
+health probe against one known member, a test harness) is annotated at
+the call line:
+
+    client = RpcClient(addr, token)  # graftlint: router-seam(probe one member)
+
+The reason is mandatory — the annotation is the reviewable record of
+why this call site may bypass placement and health gating.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.graftlint.core import Finding, Module, rule
+
+_SCOPED_PREFIXES = ("trivy_tpu/engine/", "trivy_tpu/serve/")
+
+_SEAM_RE = re.compile(r"graftlint:.*\brouter-seam\(([^)]*)\)")
+
+
+def _is_rpc_client_call(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id == "RpcClient"
+    if isinstance(f, ast.Attribute):
+        return f.attr == "RpcClient"
+    return False
+
+
+def _in_scope(relpath: str) -> bool:
+    if relpath.startswith(_SCOPED_PREFIXES):
+        return True
+    base = relpath.rsplit("/", 1)[-1]
+    return base.startswith("gl013_")
+
+
+@rule("GL013")
+def check_direct_rpc_client(mod: Module) -> list[Finding]:
+    if not _in_scope(mod.relpath):
+        return []
+    out: list[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or not _is_rpc_client_call(node):
+            continue
+        m = _SEAM_RE.search(mod.comments.get(node.lineno, ""))
+        if m and m.group(1).strip():
+            continue
+        out.append(
+            Finding(
+                "GL013",
+                mod.relpath,
+                node.lineno,
+                "direct RpcClient(...) construction bypasses the fleet "
+                "router seam (placement, health gating, decision "
+                "attribution); route through FleetRouter / an injected "
+                "client, or annotate the call line with `# graftlint: "
+                "router-seam(<reason>)`",
+            )
+        )
+    return out

@@ -70,6 +70,7 @@ class SecretAnalyzer(BatchAnalyzer):
         self._config_skip_paths: frozenset[str] = frozenset()
         self._backend = "auto"
         self._server_addr = ""
+        self._fleet_config = ""
         self._server_token = ""
         self._timeout_s = 0.0
         self._rules_cache_dir = ""
@@ -82,6 +83,7 @@ class SecretAnalyzer(BatchAnalyzer):
         self._config_path = opt.config_path
         self._backend = opt.backend
         self._server_addr = getattr(opt, "server_addr", "")
+        self._fleet_config = getattr(opt, "fleet_config", "")
         self._server_token = getattr(opt, "server_token", "")
         self._timeout_s = getattr(opt, "timeout_s", 0.0)
         self._rules_cache_dir = getattr(opt, "rules_cache_dir", "")
@@ -123,9 +125,28 @@ class SecretAnalyzer(BatchAnalyzer):
                 # concurrent client processes share one device batch.
                 from trivy_tpu.rpc.client import RemoteSecretEngine
 
-                if not self._server_addr:
+                if not self._server_addr and not self._fleet_config:
                     raise ValueError(
-                        "--secret-backend server requires --server"
+                        "--secret-backend server requires --server "
+                        "or --fleet-config"
+                    )
+                router = None
+                if self._fleet_config:
+                    # Fleet mode: batches route across the member table
+                    # by ruleset digest with health-aware failover
+                    # instead of pinning to one address.
+                    from trivy_tpu.fleet import FleetRouter
+                    from trivy_tpu.fleet.membership import (
+                        FleetMembership,
+                        load_fleet_config,
+                    )
+
+                    router = FleetRouter(
+                        FleetMembership.from_config(
+                            load_fleet_config(self._fleet_config)
+                        ),
+                        token=self._server_token,
+                        timeout_s=self._timeout_s or 300.0,
                     )
                 self._engine = RemoteSecretEngine(
                     self._server_addr,
@@ -133,6 +154,7 @@ class SecretAnalyzer(BatchAnalyzer):
                     timeout_s=self._timeout_s,
                     ruleset_select=self._ruleset_select,
                     explain=self._explain,
+                    router=router,
                 )
             else:
                 # All local backends go through the factory, which maps the

@@ -192,7 +192,7 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
         "native = C++ host sieve via the device engine flow, "
         "cpu = oracle engine, "
         "server = ship raw items to the scan server's continuous "
-        "cross-request batcher (requires --server)",
+        "cross-request batcher (requires --server or --fleet-config)",
     )
     p.add_argument(
         "--ruleset",
@@ -274,6 +274,12 @@ def _add_scan_flags(p: argparse.ArgumentParser, default_scanners: str) -> None:
     p.add_argument(
         "--server", default=_env_default("server", ""),
         help="server address (client mode)",
+    )
+    p.add_argument(
+        "--fleet-config", default=_env_default("fleet-config", ""),
+        help="fleet member YAML (client mode): route scans across the "
+        "fleet by ruleset digest with health-aware failover instead of "
+        "pinning to one --server address",
     )
     p.add_argument(
         "--token", default=_env_default("token", ""),
@@ -397,6 +403,7 @@ def _options_from_args(args: argparse.Namespace) -> Options:
         resident_chunks=getattr(args, "resident_chunks", None),
         ignore_file=args.ignorefile if os.path.exists(args.ignorefile) else "",
         server_addr=args.server,
+        fleet_config=getattr(args, "fleet_config", ""),
         username=getattr(args, "username", ""),
         password=getattr(args, "password", ""),
         server_wire=getattr(args, "server_wire", "json"),
@@ -797,6 +804,19 @@ def build_parser() -> argparse.ArgumentParser:
         "batch tests the device and success re-closes the breaker",
     )
     p_server.add_argument(
+        "--fleet-config", default=_env_default("fleet-config", ""),
+        help="fleet member YAML shared by every host in the fleet; "
+        "turns on GET /debug/fleet, X-Trivy-Fleet-* response headers, "
+        "and affinity accounting (requires this host to appear in the "
+        "members list — see --fleet-member)",
+    )
+    p_server.add_argument(
+        "--fleet-member", default=_env_default("fleet-member", ""),
+        help="which member of --fleet-config THIS process answers as "
+        "(overrides the YAML's `self:` so one shared file serves the "
+        "whole fleet)",
+    )
+    p_server.add_argument(
         "--profile-dir",
         default=_env_default("profile-dir", ""),
         help="default output directory for POST /admin/profile/start "
@@ -1114,6 +1134,8 @@ def main(argv: list[str] | None = None) -> int:
             slo_config=args.slo_config,
             flight_out=args.flight_out,
             flight_out_max_mb=args.flight_out_max_mb,
+            fleet_config=args.fleet_config,
+            fleet_member=args.fleet_member,
         )
         return 0
 

@@ -74,6 +74,9 @@ class Options:
     ignore_file: str = ""
     disabled_analyzers: list[str] = field(default_factory=list)
     server_addr: str = ""  # non-empty => client mode (remote driver)
+    # --fleet-config: member YAML for digest-affine multi-host routing
+    # of ScanSecrets batches ("" = single --server endpoint).
+    fleet_config: str = ""
     server_wire: str = "json"  # Twirp wire format: json | protobuf
     token: str = ""
     db_dir: str = ""  # vulnerability DB directory (trivy-db analogue)
@@ -210,6 +213,7 @@ def _analyzer_options(options: Options, target_kind: str) -> AnalyzerOptions:
             backend=options.secret_backend,
             ruleset_select=getattr(options, "ruleset_select", ""),
             server_addr=options.server_addr,
+            fleet_config=getattr(options, "fleet_config", ""),
             server_token=options.token,
             timeout_s=options.timeout,
             rules_cache_dir=getattr(options, "rules_cache_dir", ""),

@@ -136,6 +136,22 @@ class CircuitBreaker:
                 "failure_threshold": self.failure_threshold,
                 "window_s": self.window_s,
                 "cooldown_s": self.cooldown_s,
+                # How long until an open breaker admits its half-open
+                # probe (0 when closed, or already probe-eligible).  The
+                # readiness surface turns this into a Retry-After hint.
+                "cooldown_remaining_s": (
+                    round(
+                        max(
+                            0.0,
+                            self._opened_at
+                            + self.cooldown_s
+                            - self._clock(),
+                        ),
+                        3,
+                    )
+                    if self.state == "open"
+                    else 0.0
+                ),
                 "opened_total": self.opened_total,
                 "reclosed_total": self.reclosed_total,
                 "probes_total": self.probes_total,

@@ -65,6 +65,7 @@ class FlightRecorder:
         registry=None,
         memory_fn: Callable[[], dict] | None = None,
         cache_fn: Callable[[], dict] | None = None,
+        fleet_fn: Callable[[], dict] | None = None,
     ):
         self._lock = lockcheck.make_lock("obs.flight")
         self._ring: deque[dict] = deque(maxlen=max(1, int(capacity)))  # owner: _lock
@@ -73,6 +74,7 @@ class FlightRecorder:
         self._gate_fn = gate_fn
         self._memory_fn = memory_fn
         self._cache_fn = cache_fn
+        self._fleet_fn = fleet_fn
         self.out_path = out_path
         # 0 disables the cap; the bookkeeping below is all owner: _lock.
         self.out_max_bytes = int(max(0.0, out_max_mb) * (1 << 20))
@@ -158,6 +160,14 @@ class FlightRecorder:
         except Exception as e:
             return {"error": f"{type(e).__name__}: {e}"}
 
+    def _fleet_state(self) -> dict:
+        if self._fleet_fn is None:
+            return {}
+        try:
+            return dict(self._fleet_fn())
+        except Exception as e:
+            return {"error": f"{type(e).__name__}: {e}"}
+
     def capture(
         self,
         *,
@@ -185,6 +195,12 @@ class FlightRecorder:
             "memory": self._memory_state(),
             "cache": self._cache_state(),
         }
+        fleet = self._fleet_state()
+        if fleet:
+            # Fleeted hosts only: which member this was and its affinity
+            # posture at breach time (omitted entirely when unfleeted,
+            # keeping existing record shapes byte-stable).
+            rec["fleet"] = fleet
         dropped = 0
         with self._lock:
             self._seq += 1

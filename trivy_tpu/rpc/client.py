@@ -13,17 +13,25 @@ processes can still retry): when the server is hard-down, per-call
 backoff alone still multiplies offered load by the attempt cap, and a
 fleet of clients doing that simultaneously is a retry storm.  A dry
 budget fails the call immediately with the last underlying error.
+
+Transport: one keep-alive HTTP/1.1 connection per (client, thread),
+reused across calls — the fleet router multiplies request count across
+member endpoints, and a fresh TCP handshake per request is pure connect
+tax.  A reused socket the server closed while idle gets one transparent
+reconnect-and-resend; anything that fails mid-exchange poisons the
+framing and drops the socket, so the next attempt starts clean.
 """
 
 from __future__ import annotations
 
 import base64
+import http.client
 import json
 import random
 import threading
 import time
 import urllib.error
-import urllib.request
+import urllib.parse
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Iterable
@@ -176,15 +184,96 @@ class RpcClient:
     # Response headers of the last successful call (trace correlation:
     # the server echoes X-Trivy-Trace-Id here).
     last_response_headers: dict[str, str] = field(default_factory=dict)
+    # Classification of the last call() failure, for policies layered on
+    # top (the fleet router picks its spill rung from these): the HTTP
+    # status, None for connection-level failures, 0 for no failure.
+    last_error_status: int | None = 0
+    last_error_retry_after: float | None = None
+    # New TCP connections this client opened — the keep-alive regression
+    # observable (sequential calls must not grow it).
+    connects_total: int = 0
+    _local: threading.local = field(
+        default_factory=threading.local, repr=False, compare=False
+    )
     sleep = staticmethod(time.sleep)  # test seam
 
-    def call(self, path: str, payload: dict) -> dict:
+    def _base_url(self) -> str:
         # Accept both bare "host:port" and full "http(s)://host:port" forms
         # (the reference's --server flag takes a URL).
         base = self.addr.rstrip("/")
         if not base.startswith(("http://", "https://")):
             base = f"http://{base}"
-        url = f"{base}{path}"
+        return base
+
+    def _connect(self, scheme: str, netloc: str) -> http.client.HTTPConnection:
+        cls = (
+            http.client.HTTPSConnection
+            if scheme == "https"
+            else http.client.HTTPConnection
+        )
+        conn = cls(netloc, timeout=self.timeout_s)
+        self.connects_total += 1
+        return conn
+
+    def _drop_connection(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        self._local.conn = None
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        """Drop this thread's keep-alive socket (other threads' sockets
+        die with their threads)."""
+        self._drop_connection()
+
+    def _transport(
+        self, url: str, body: bytes, headers: dict[str, str]
+    ) -> tuple[int, dict[str, str], bytes]:
+        """POST over this thread's persistent connection; returns
+        (status, headers, body).  The one transparent resend covers the
+        keep-alive race — a reused socket the server closed between
+        requests — and only that: a fresh connection's failure, or any
+        error after bytes started flowing, propagates to the retry loop.
+        """
+        parts = urllib.parse.urlsplit(url)
+        conn = getattr(self._local, "conn", None)
+        fresh = conn is None
+        if fresh:
+            conn = self._connect(parts.scheme, parts.netloc)
+            self._local.conn = conn
+        path = parts.path + (f"?{parts.query}" if parts.query else "")
+        try:
+            try:
+                conn.request("POST", path, body=body, headers=headers)
+                resp = conn.getresponse()
+            except (
+                http.client.CannotSendRequest,
+                http.client.RemoteDisconnected,
+                ConnectionResetError,
+                BrokenPipeError,
+            ):
+                self._drop_connection()
+                if fresh:
+                    raise
+                conn = self._connect(parts.scheme, parts.netloc)
+                self._local.conn = conn
+                conn.request("POST", path, body=body, headers=headers)
+                resp = conn.getresponse()
+            raw = resp.read()
+        except BaseException:
+            # Mid-exchange failure: the framing is unknown — reconnect
+            # on the next attempt rather than desynchronize.
+            self._drop_connection()
+            raise
+        if resp.will_close:
+            self._drop_connection()
+        return resp.status, dict(resp.getheaders()), raw
+
+    def call(self, path: str, payload: dict) -> dict:
+        url = f"{self._base_url()}{path}"
         if self.wire == "protobuf":
             from trivy_tpu.rpc import protowire
 
@@ -198,44 +287,64 @@ class RpcClient:
         last: Exception | None = None
         attempts = max(1, self.max_retries)
         _BUDGET.note_request()
+        self.last_error_status = 0
+        self.last_error_retry_after = None
         for attempt in range(attempts):
-            req = urllib.request.Request(
-                url, data=body, headers={"Content-Type": ctype}
-            )
+            headers = {"Content-Type": ctype}
             if self.token:
-                req.add_header(TOKEN_HEADER, self.token)
-            for k, v in self.headers.items():
-                req.add_header(k, v)
+                headers[TOKEN_HEADER] = self.token
+            headers.update(self.headers)
             retry_after: float | None = None
             try:
-                with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-                    raw = resp.read()
+                status, rhdrs, raw = self._transport(url, body, headers)
+                if 200 <= status < 300:
                     # Chaos seam: client-side receive faults.  After the
                     # read, before the decode, so reset/truncate kinds
                     # land in exactly the retryable except clause below
                     # that their real counterparts would hit.
                     faults.fire("rpc.recv")
-                    self.last_response_headers = dict(resp.headers.items())
+                    self.last_response_headers = rhdrs
+                    self.last_error_status = 0
+                    self.last_error_retry_after = None
                     if self.wire == "protobuf":
                         from trivy_tpu.rpc import protowire
 
                         return protowire.decode_response(path, raw)
                     return json.loads(raw)
-            except urllib.error.HTTPError as e:
-                if e.code in (429, 503):
+                if status in (429, 503):
                     # Backpressure (queue full / client cap / draining):
                     # retryable, honoring the server's Retry-After floor.
                     retry_after = _parse_retry_after(
-                        e.headers.get("Retry-After")
+                        next(
+                            (
+                                v
+                                for k, v in rhdrs.items()
+                                if k.lower() == "retry-after"
+                            ),
+                            None,
+                        )
                     )
-                    last = RpcError(f"{path}: HTTP {e.code}: {e.read()!r}")
-                elif 400 <= e.code < 500:  # deterministic; non-retryable
-                    raise RpcError(f"{path}: HTTP {e.code}: {e.read()!r}") from e
+                    self.last_error_status = status
+                    self.last_error_retry_after = retry_after
+                    last = RpcError(f"{path}: HTTP {status}: {raw!r}")
+                elif 400 <= status < 500:  # deterministic; non-retryable
+                    self.last_error_status = status
+                    self.last_error_retry_after = None
+                    raise RpcError(f"{path}: HTTP {status}: {raw!r}")
                 else:
-                    last = e
-            except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
+                    self.last_error_status = status
+                    self.last_error_retry_after = None
+                    last = RpcError(f"{path}: HTTP {status}: {raw!r}")
+            except (
+                urllib.error.URLError,
+                http.client.HTTPException,
+                OSError,
+                json.JSONDecodeError,
+            ) as e:
                 # Connection reset / refused / truncated body: retryable.
                 last = e
+                self.last_error_status = None
+                self.last_error_retry_after = None
             if attempt + 1 < attempts:
                 if not _BUDGET.try_retry():
                     raise RpcError(
@@ -391,8 +500,14 @@ class RemoteSecretEngine:
         client_id: str = "",
         ruleset_select: str = "",
         explain: bool = False,
+        router=None,
     ):
-        self.client = RpcClient(addr, token)
+        # The fleet seam (trivy_tpu/fleet/): a FleetRouter is
+        # RpcClient-compatible on the scan path (scan_secrets, .headers,
+        # .last_response_headers) and replaces the single-endpoint
+        # client — requests then follow digest-affine routing with
+        # health-aware spillover instead of pinning to `addr`.
+        self.client = router if router is not None else RpcClient(addr, token)
         self.timeout_s = timeout_s
         self.client_id = client_id
         # Digest of a pushed ruleset every batch should scan under ("" =

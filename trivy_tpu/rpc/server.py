@@ -124,6 +124,8 @@ class ScanServer:
         flight_out: str = "",
         flight_out_max_mb: float = obs_flight.DEFAULT_OUT_MAX_MB,
         result_cache: ScanResultCache | None = None,
+        fleet_config=None,
+        fleet_member: str = "",
     ):
         from trivy_tpu.scanner.vuln import init_vuln_scanner
 
@@ -134,6 +136,26 @@ class ScanServer:
         # straight to futures with zero device dispatches.  None = off
         # (the seed behavior; --cache-backend opts the daemon in).
         self.result_cache = result_cache
+        # Fleet plane (trivy_tpu/fleet/): this host's identity inside a
+        # multi-host fleet.  `fleet_config` is a YAML path or an already
+        # parsed FleetConfig; `fleet_member` names which member THIS
+        # process answers as (overriding the YAML's `self:`, so one
+        # shared file serves the whole fleet).  None/"" = not fleeted:
+        # no fleet headers, /debug/fleet answers {"enabled": false}.
+        self.fleet = None
+        if fleet_config:
+            from trivy_tpu.fleet.membership import (
+                FleetConfig,
+                FleetSelf,
+                load_fleet_config,
+            )
+
+            cfg = (
+                fleet_config
+                if isinstance(fleet_config, FleetConfig)
+                else load_fleet_config(str(fleet_config))
+            )
+            self.fleet = FleetSelf(cfg, self_name=fleet_member)
         # One registry per server: _Metrics' request families and the
         # scheduler's serve/engine families render as one /metrics body.
         self.registry = obs_metrics.Registry()
@@ -197,10 +219,20 @@ class ScanServer:
             # economics), so a latency incident shows whether the fleet
             # cache was cold or a remote tier was eating its error budget.
             cache_fn=self.cache_report,
+            # ... and the fleet posture (member identity, affinity
+            # economics), so a breach on a fleeted host names which
+            # member it was and whether its traffic was affine.
+            fleet_fn=(
+                self.fleet.brief if self.fleet is not None else None
+            ),
         )
         # The scheduler captures deadline expiries itself (at expiry time,
         # when the snapshot still shows the queue that starved the ticket).
         self.scheduler.flight = self.flight
+        # ... and its snapshot() gains a fleet block the same way the
+        # flight recorder does (None = unfleeted, block omitted).
+        if self.fleet is not None:
+            self.scheduler.fleet = self.fleet.brief
         # Hybrid-gate decision audit + per-kernel device-phase sections:
         # both sources are process-level (engines are built on scheduler /
         # reload threads and own no registry), so collect hooks fold them
@@ -263,6 +295,30 @@ class ScanServer:
         # bytes, peak, pressure) rebuilt from the process-global ledger at
         # each scrape — same seat as the gate/device-phase hooks above.
         obs_memwatch.register_collectors(self.registry)
+        # Fleet families (fleeted hosts only): the member-count gauge,
+        # per-outcome affinity counters folded by delta from FleetSelf's
+        # tallies, and the routing-decision counters from the process
+        # decision ring (non-empty only when this process also runs a
+        # FleetRouter — e.g. embedded clients and tests).
+        if self.fleet is not None:
+            self._m_fleet_members = self.registry.gauge(
+                "trivy_tpu_fleet_members",
+                "member count of the configured fleet",
+            )
+            self._m_fleet_affinity = self.registry.counter(
+                "trivy_tpu_fleet_affinity_total",
+                "scan requests on this host by digest-affinity outcome",
+                ("outcome",),
+            )
+            self._m_fleet_route = self.registry.counter(
+                "trivy_tpu_fleet_route_total",
+                "fleet routing decisions by member and reason "
+                "(this process's router, when it runs one)",
+                ("member", "reason"),
+            )
+            self._fleet_aff_exported = {"hit": 0, "miss": 0}
+            self._fleet_route_exported: dict[tuple[str, str], int] = {}
+            self.registry.add_collect_hook(self._collect_fleet)
         self.draining = False  # SIGTERM: reject new work with 503
         # Live-profiling window (POST /admin/profile/start|stop): default
         # output dir from --profile-dir, overridable per start request.
@@ -389,6 +445,14 @@ class ScanServer:
         if digest and digest == self.ruleset_digest():
             digest = ""
         explain = bool(req.get("Explain") or req.get("_explain"))
+        # Fleet affinity: sample residency BEFORE submitting (the scan
+        # itself warms the digest — arrival order is what the router's
+        # placement quality is measured by).
+        fleet_hint = (
+            self._fleet_resident_hint(digest)
+            if self.fleet is not None
+            else False
+        )
         fut = self.scheduler.submit(
             items,
             client_id=str(req.get("ClientID") or req.get("_client") or ""),
@@ -429,6 +493,13 @@ class ScanServer:
             # Per-phase breakdown the dispatch attached (same timing the
             # span tree carries); only the asking request pays the bytes.
             out["Explain"] = getattr(secrets, "explain", None) or {}
+        if self.fleet is not None:
+            # Attribute the completed scan and stash the outcome for the
+            # handler's X-Trivy-Fleet-Affinity header (popped before the
+            # body ships — underscore keys never reach the wire).
+            out["_FleetAffinity"] = self.fleet.note_scan(
+                digest, resident_hint=fleet_hint
+            )
         return out
 
     # -- ruleset registry -------------------------------------------------
@@ -582,6 +653,57 @@ class ScanServer:
             }
         return rep
 
+    def _collect_fleet(self) -> None:
+        """Registry collect hook (fleeted hosts only): refresh the member
+        gauge and fold FleetSelf's affinity tallies plus the process's
+        routing-decision tallies into counters by delta.  All labels are
+        bounded enums — outcome is hit/miss, member names come from the
+        static fleet config, reasons from the decisions module's enum —
+        so GL007's governor requirement does not apply."""
+        from trivy_tpu.fleet import decisions as fleet_decisions
+
+        self._m_fleet_members.set(len(self.fleet.config.members))
+        aff = self.fleet.affinity()
+        for outcome, total in (("hit", aff["hits"]), ("miss", aff["misses"])):
+            delta = total - self._fleet_aff_exported[outcome]
+            if delta > 0:
+                self._m_fleet_affinity.labels(  # graftlint: ignore[GL007]
+                    outcome=outcome
+                ).inc(delta)
+                self._fleet_aff_exported[outcome] = total
+        for (member, reason), total in fleet_decisions.tallies().items():
+            key = (member, reason)
+            delta = total - self._fleet_route_exported.get(key, 0)
+            if delta > 0:
+                self._m_fleet_route.labels(  # graftlint: ignore[GL007]
+                    member=member, reason=reason
+                ).inc(delta)
+                self._fleet_route_exported[key] = total
+
+    def fleet_report(self, probe: bool = False) -> dict:
+        """GET /debug/fleet: this host's fleet posture — membership table
+        with live peer health (actively refreshed when `probe`), this
+        member's identity, its resident-digest history, and affinity
+        economics.  A sane body on an unfleeted host: enabled=false."""
+        if self.fleet is None:
+            return {"enabled": False}
+        rep = self.fleet.report(probe=probe)
+        rep["enabled"] = True
+        return rep
+
+    def _fleet_resident_hint(self, digest: str) -> bool:
+        """Was `digest`'s engine already warm on this host BEFORE the
+        current request (pool-resident, or the active default engine for
+        the default lane)?  Feeds FleetSelf.note_scan: a router that
+        sends warm traffic where warmth lives scores affinity hits."""
+        if digest:
+            pool = self.scheduler.pool
+            if pool is None:
+                return False
+            return any(d == digest for d, _, _ in pool.residents())
+        # "" = the default lane: warm once the default engine exists.
+        return bool(self.scheduler.active_ruleset_digest())
+
     def _collect_device_phases(self) -> None:
         """Registry collect hook: drain pending fenced per-kernel samples
         into trivy_tpu_device_phase_seconds{kernel,device}.  Samples only
@@ -679,6 +801,13 @@ class ScanServer:
         rep = self.scheduler.readiness()
         rep["checks"]["draining"] = self.draining
         rep["ready"] = bool(rep["ready"] and not self.draining)
+        if self.draining:
+            # Draining dominates the hint: the same 5s floor the POST
+            # plane's 503 advertises (the drain window, not a breaker
+            # cooldown, decides when to come back).
+            rep["retry_after_s"] = max(
+                float(rep.get("retry_after_s") or 0.0), 5.0
+            )
         return rep
 
     def breaker_report(self) -> dict:
@@ -797,6 +926,9 @@ DEBUG_SURFACES = {
     "/debug/cache": "fleet result cache: per-tier request/eviction "
     "tallies, tier degrade state and write-behind queue, scheduler hit "
     "economics",
+    "/debug/fleet": "fleet plane: membership table with per-member "
+    "health, this host's identity and resident-digest set, affinity "
+    "economics (?probe=1 actively probes peers' /readyz first)",
 }
 
 
@@ -824,6 +956,13 @@ def _make_handler(server: ScanServer):
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if server.fleet is not None:
+                # Every response from a fleeted host names which member
+                # answered — the router's ground truth for attribution
+                # (and a human's, when curling through a balancer).
+                self.send_header(
+                    "X-Trivy-Fleet-Member", server.fleet.name
+                )
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
             self.end_headers()
@@ -844,8 +983,22 @@ def _make_handler(server: ScanServer):
                 # balancer to rotate this host out (draining, breaker
                 # open, HBM hard) while /healthz keeps answering 200 so
                 # the orchestrator doesn't kill a clean drain.
+                # A not-ready host says WHEN to re-probe: Retry-After
+                # derives from the reason (breaker cooldown remaining,
+                # drain window), so fleet peers and balancers back off
+                # for the right duration instead of a guessed constant.
                 rep = server.readiness()
-                self._send(200 if rep["ready"] else 503, rep)
+                headers = None
+                if not rep["ready"]:
+                    headers = {
+                        "Retry-After": str(
+                            max(
+                                1,
+                                int(round(rep.get("retry_after_s") or 5.0)),
+                            )
+                        )
+                    }
+                self._send(200 if rep["ready"] else 503, rep, headers)
             elif route == "/version":
                 self._send(200, {"Version": __version__})
             elif route == "/metrics":
@@ -918,6 +1071,15 @@ def _make_handler(server: ScanServer):
                 # Fleet result cache posture: tier chain health + hit
                 # economics (sane body with caching off).
                 self._send(200, server.cache_report())
+            elif route == "/debug/fleet":
+                # Fleet plane posture: membership + health, identity,
+                # resident digests, affinity (sane body unfleeted).
+                # ?probe=1 actively probes every peer's /readyz first —
+                # opt-in, so the default scrape stays request-free.
+                probe = parse_qs(parsed.query).get("probe", ["0"])[
+                    0
+                ].lower() in ("1", "true", "yes")
+                self._send(200, server.fleet_report(probe=probe))
             elif route in ("/debug", "/debug/"):
                 # Index of every debug surface with its one-liner.
                 self._send(200, {"surfaces": DEBUG_SURFACES})
@@ -1109,7 +1271,13 @@ def _make_handler(server: ScanServer):
                 if method in ("scan", "scan_secrets"):
                     # Every scan response states which ruleset produced it.
                     dig = out.get("RulesetDigest") or server.ruleset_digest()
-                    send(200, out, {"X-Trivy-Ruleset": dig})
+                    hdrs = {"X-Trivy-Ruleset": dig}
+                    # ... and, on a fleeted host, whether the digest was
+                    # already warm here (the router's affinity signal).
+                    affinity = out.pop("_FleetAffinity", "")
+                    if affinity:
+                        hdrs["X-Trivy-Fleet-Affinity"] = affinity
+                    send(200, out, hdrs)
                 else:
                     send(200, out)
             except AdmissionError as e:
@@ -1161,6 +1329,8 @@ def make_http_server(
     flight_out: str = "",
     flight_out_max_mb: float = obs_flight.DEFAULT_OUT_MAX_MB,
     result_cache: ScanResultCache | None = None,
+    fleet_config=None,
+    fleet_member: str = "",
 ) -> ThreadingHTTPServer:
     host, _, port = addr.rpartition(":")
     scan_server = ScanServer(
@@ -1176,6 +1346,8 @@ def make_http_server(
         flight_out=flight_out,
         flight_out_max_mb=flight_out_max_mb,
         result_cache=result_cache,
+        fleet_config=fleet_config,
+        fleet_member=fleet_member,
     )
     httpd = ThreadingHTTPServer(
         (host or "localhost", int(port)), _make_handler(scan_server)
@@ -1200,6 +1372,8 @@ def serve(
     flight_out_max_mb: float = obs_flight.DEFAULT_OUT_MAX_MB,
     cache_backend: str = "",
     cache_ttl: int = 0,
+    fleet_config: str = "",
+    fleet_member: str = "",
 ) -> None:
     """pkg/rpc/server/listen.go ListenAndServe, with graceful SIGTERM
     drain: stop admitting (503 + Retry-After), finish the batches already
@@ -1227,6 +1401,7 @@ def serve(
         profile_dir=profile_dir, slo_config=slo_config,
         flight_out=flight_out, flight_out_max_mb=flight_out_max_mb,
         result_cache=result_cache,
+        fleet_config=fleet_config, fleet_member=fleet_member,
     )
     scan_server: ScanServer = httpd.scan_server
 
@@ -1267,6 +1442,7 @@ def start_background(
     secret_config: str = "", rules_cache_dir: str | None = None,
     profile_dir: str = "", slo_config: str = "", flight_out: str = "",
     result_cache: ScanResultCache | None = None,
+    fleet_config=None, fleet_member: str = "",
 ) -> tuple[ThreadingHTTPServer, threading.Thread]:
     """In-process server for tests (the §4 'multi-node without a cluster'
     pattern: integration_test.go:77-103 binds a real server on a free port)."""
@@ -1280,6 +1456,8 @@ def start_background(
         slo_config=slo_config,
         flight_out=flight_out,
         result_cache=result_cache,
+        fleet_config=fleet_config,
+        fleet_member=fleet_member,
     )
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()

@@ -299,6 +299,10 @@ class BatchScheduler:
         # recorder so deadline expiries captured here land in the same ring
         # as RPC-side breaches.  None = recording off (standalone use).
         self.flight = None
+        # Fleet posture callable (trivy_tpu/fleet/ FleetSelf.brief): the
+        # server attaches it on fleeted hosts so snapshot() states which
+        # member this scheduler serves as.  None = unfleeted.
+        self.fleet = None
         # HBM pressure state machine (ok/soft/hard), advanced by submit-
         # side watermark checks against memwatch.pressure().  owner: _lock
         self._hbm_state = "ok"
@@ -1296,6 +1300,16 @@ class BatchScheduler:
                 {"digest": d, "epoch": e, "nbytes": n}
                 for d, e, n in self.pool.residents()
             ]
+        if self.fleet is not None:
+            # Fleet posture: which member this host is, fleet size, and
+            # its affinity economics — a flight capture on a fleeted
+            # host then names the member without a /debug/fleet round
+            # trip.  A failing posture callable must not poison
+            # capture (snapshots run on breach paths).
+            try:
+                out["fleet"] = self.fleet()
+            except Exception:  # graftlint: swallow(posture is best-effort on capture paths)
+                pass
         out["qos"] = self.qos.snapshot(now)
         return out
 
@@ -1323,7 +1337,19 @@ class BatchScheduler:
         ready = (
             admitting and breaker["state"] != "open" and hbm_state != "hard"
         )
-        return {"ready": ready, "checks": checks}
+        out = {"ready": ready, "checks": checks}
+        if not ready:
+            # When to re-probe: an open breaker knows its cooldown
+            # remainder exactly; the other not-ready reasons (HBM hard,
+            # not admitting) have no clock, so advertise a short
+            # constant.  /readyz turns this into a Retry-After header.
+            if breaker["state"] == "open":
+                out["retry_after_s"] = max(
+                    1.0, float(breaker.get("cooldown_remaining_s") or 0.0)
+                )
+            else:
+                out["retry_after_s"] = 5.0
+        return out
 
     def metrics_text(self) -> str:
         """Prometheus exposition for the serve subsystem.  When the server
