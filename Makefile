@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test lint lint-changed lockcheck smoke serve-smoke obs-smoke slo-smoke tenancy-smoke mem-smoke chaos-smoke mesh-smoke cache-smoke kernel-smoke fleet-smoke bench bench-link bench-verify checks-corpus rules-cache perf-gate
+.PHONY: test lint lint-changed lockcheck smoke serve-smoke obs-smoke slo-smoke tenancy-smoke mem-smoke chaos-smoke mesh-smoke cache-smoke kernel-smoke fleet-smoke program-smoke bench bench-link bench-verify checks-corpus rules-cache perf-gate
 
 # Tier-1: the suite the driver holds the repo to (fast, CPU, no slow marks).
 # Lint runs first — a graftlint finding fails the build before pytest
@@ -17,9 +17,10 @@ test: lint
 	$(MAKE) cache-smoke
 	$(MAKE) kernel-smoke
 	$(MAKE) fleet-smoke
+	$(MAKE) program-smoke
 	$(MAKE) perf-gate
 
-# Static analysis: graftlint (project rules GL001-GL013, always available)
+# Static analysis: graftlint (project rules GL001-GL014, always available)
 # plus ruff + mypy when the environment has them (the pinned CI container
 # may not; config lives in pyproject.toml either way).
 lint:
@@ -81,7 +82,8 @@ obs-smoke:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
 		BENCH_IMAGE=0 BENCH_TENANT=0 BENCH_MEM=0 BENCH_FAULT=0 \
-		BENCH_MULTICHIP=0 BENCH_CACHE=0 BENCH_FLEET=0 $(PY) bench.py --smoke
+		BENCH_MULTICHIP=0 BENCH_CACHE=0 BENCH_FLEET=0 BENCH_PROGRAMS=0 \
+		$(PY) bench.py --smoke
 
 # SLO / flight-recorder smoke: boot the server with a deliberately tight
 # latency objective, drive mixed-tenant traffic with one induced breach,
@@ -105,7 +107,8 @@ tenancy-smoke:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
 		BENCH_IMAGE=0 BENCH_OBS=0 BENCH_MEM=0 BENCH_FAULT=0 \
-		BENCH_MULTICHIP=0 BENCH_CACHE=0 BENCH_FLEET=0 $(PY) bench.py --smoke
+		BENCH_MULTICHIP=0 BENCH_CACHE=0 BENCH_FLEET=0 BENCH_PROGRAMS=0 \
+		$(PY) bench.py --smoke
 
 # Device-memory observatory smoke: memwatch ledger units, pool
 # estimate-vs-measured reconciliation, pressure watermark e2e
@@ -118,7 +121,8 @@ mem-smoke:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
 		BENCH_IMAGE=0 BENCH_TENANT=0 BENCH_OBS=0 BENCH_FAULT=0 \
-		BENCH_MULTICHIP=0 BENCH_CACHE=0 BENCH_FLEET=0 $(PY) bench.py --smoke
+		BENCH_MULTICHIP=0 BENCH_CACHE=0 BENCH_FLEET=0 BENCH_PROGRAMS=0 \
+		$(PY) bench.py --smoke
 
 # Chaos smoke: the fault-injection serve suite (tests/test_chaos_serve.py,
 # -m chaos).  Arms the in-repo fault plane on the dispatch/device/rpc
@@ -149,7 +153,8 @@ cache-smoke:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
 		BENCH_IMAGE=0 BENCH_TENANT=0 BENCH_OBS=0 BENCH_MEM=0 \
-		BENCH_FAULT=0 BENCH_MULTICHIP=0 BENCH_FLEET=0 $(PY) bench.py --smoke
+		BENCH_FAULT=0 BENCH_MULTICHIP=0 BENCH_FLEET=0 BENCH_PROGRAMS=0 \
+		$(PY) bench.py --smoke
 
 # Megakernel smoke (ops/megakernel.py + registry/aotcache.py): parity
 # fuzz of the one-dispatch MXU kernel vs the staged fused pipeline vs
@@ -174,7 +179,18 @@ fleet-smoke:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
 		BENCH_IMAGE=0 BENCH_TENANT=0 BENCH_OBS=0 BENCH_MEM=0 \
-		BENCH_FAULT=0 BENCH_MULTICHIP=0 BENCH_CACHE=0 $(PY) bench.py --smoke
+		BENCH_FAULT=0 BENCH_MULTICHIP=0 BENCH_CACHE=0 BENCH_PROGRAMS=0 \
+		$(PY) bench.py --smoke
+
+# Device scan-program smoke (trivy_tpu/programs/): the multi-program
+# demux parity fuzz — secret + license verdicts from ONE sieve pass,
+# byte-identical to the single-program engines across codec modes and
+# 1/2/4/8 forced host devices on NUL-heavy/exact-tile/jumbo blobs —
+# plus the warm-registry zero-recompile and compile-time anchor-coverage
+# contracts.
+program-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_programs.py \
+		-m program_smoke -q -p no:cacheprovider
 
 # Performance regression gate: one smoke bench run (heavy sections off,
 # primary corpus only) appends to a throwaway ledger, then
@@ -205,7 +221,7 @@ bench-link:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 BENCH_IMAGE=0 \
 		BENCH_TENANT=0 BENCH_FAULT=0 BENCH_MULTICHIP=0 BENCH_CACHE=0 \
-		BENCH_FLEET=0 BENCH_FILES=2000 BENCH_PARITY=sample \
+		BENCH_FLEET=0 BENCH_PROGRAMS=0 BENCH_FILES=2000 BENCH_PARITY=sample \
 		$(PY) bench.py
 
 # Verify-backend economics only: the hit-dense corpus under host-DFA vs
@@ -217,7 +233,7 @@ bench-verify:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_LINK=0 \
 		BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 BENCH_IMAGE=0 \
 		BENCH_TENANT=0 BENCH_MEM=0 BENCH_FAULT=0 BENCH_MULTICHIP=0 \
-		BENCH_CACHE=0 BENCH_FLEET=0 $(PY) bench.py --smoke
+		BENCH_CACHE=0 BENCH_FLEET=0 BENCH_PROGRAMS=0 $(PY) bench.py --smoke
 
 # Precompile the builtin ruleset into the registry cache (trivy_tpu/registry/)
 # so every later scan/server process warm-starts without compiling rules.

@@ -1,0 +1,315 @@
+"""Device scan programs (trivy_tpu/programs/): one sieve pass, many
+verdicts.
+
+The binding contracts this file pins:
+
+- demux parity: on a mixed corpus the combined secret+license pass
+  returns secret verdicts BYTE-identical to a secret-only engine and
+  license verdicts identical to the host decision tree
+  (license/decide.py) over every file — across every link codec mode
+  (off/auto/4/6) and every forced-host-device count (1/2/4/8), on the
+  sieve's hard blob shapes (NUL-heavy, exact-tile, jumbo, binary,
+  empty);
+- demux ordering: verdicts come back keyed per program in table order,
+  and `only=` restricts resolution without changing what resolves;
+- warm registry: rebuilding the program engine against a populated
+  cache performs ZERO ruleset recompiles, with artifacts keyed under
+  programs/<id>/ (the bare secret layout is preserved);
+- compile-time anchor coverage: a phrase-table entry whose anchor
+  cannot imply a sieve hit fails ruleset construction loudly
+  (ProgramCompileError), never as a silent device/host divergence.
+
+Run via `make program-smoke` (-m program_smoke); also tier-1.
+"""
+
+import importlib.resources as ir
+import json
+import os
+import random
+
+import pytest
+
+pytestmark = pytest.mark.program_smoke
+
+TILE = 4096  # scanner/packing.py DEFAULT_TILE_LEN — the pack-tile boundary
+ALNUM = (
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+    "abcdefghijklmnopqrstuvwxyz0123456789"
+)
+
+_MIT_HEADER = (
+    "Permission is hereby granted, free of charge, to any person "
+    "obtaining a copy of this software and associated documentation "
+    'files, to deal in the Software without restriction. '
+    'THE SOFTWARE IS PROVIDED "AS IS", WITHOUT WARRANTY OF ANY KIND.'
+)
+
+
+def _corpus_text(name: str) -> str:
+    from trivy_tpu.license import corpus as corpus_pkg
+
+    return (ir.files(corpus_pkg) / f"{name}.txt").read_text(errors="replace")
+
+
+def _mixed_corpus(seed: int) -> list[tuple[str, bytes]]:
+    """Secrets + license texts + the sieve's hard shapes in one batch."""
+    rng = random.Random(seed)
+
+    def pick(chars, n):
+        return "".join(rng.choice(chars) for _ in range(n)).encode()
+
+    apache = _corpus_text("Apache-2.0").encode("utf-8")
+    mit = _MIT_HEADER.encode("utf-8")
+    exact = mit + b" " + pick(ALNUM + " ", TILE - len(mit) - 1)
+    assert len(exact) == TILE
+    out = [
+        ("src/main.py", pick(ALNUM + " \n", 900)),
+        ("src/token.py", b"key = 'ghp_" + pick(ALNUM, 36) + b"'\n"),
+        ("third_party/a/LICENSE", apache),
+        ("pkg/b/COPYING.nul", b"\x00" * 300 + mit + b"\x00" * 100),
+        ("pkg/c/exact_tile.txt", exact),
+        (
+            "pkg/d/jumbo.js",
+            pick(ALNUM + " \n", 9000)
+            + b"\n// " + mit + b"\n"
+            + pick(ALNUM + " \n", 7000),
+        ),
+        ("build/blob.o", bytes(rng.randrange(0, 256) for _ in range(600))),
+        ("empty.txt", b""),
+        (
+            "deploy/creds.env",
+            b"AWS_ACCESS_KEY_ID=AKIA"
+            + pick(ALNUM[:26] + "0123456789", 16) + b"\n",
+        ),
+        ("docs/readme.rst", pick(ALNUM + " \n", 400)),
+    ]
+    return out
+
+
+@pytest.fixture(scope="module")
+def compiled_table():
+    """One merged compile shared by the parity fuzz (engine construction
+    per codec/mesh combination stays cheap)."""
+    from trivy_tpu.programs import build_program_table, default_programs
+    from trivy_tpu.registry import store as rstore
+
+    table = build_program_table(default_programs())
+    art = rstore.compile_ruleset(table.merged_ruleset())
+    secret_prog = table.slices()[0][0]
+    secret_art = rstore.compile_ruleset(secret_prog.ruleset())
+    return table, art, secret_prog, secret_art
+
+
+def _engine(table, art, codec: str = "off", mesh=None):
+    from trivy_tpu.programs import make_program_engine
+
+    prev = os.environ.get("TRIVY_TPU_LINK_CODEC")
+    os.environ["TRIVY_TPU_LINK_CODEC"] = codec
+    try:
+        return make_program_engine(table, compiled=art, mesh=mesh)
+    finally:
+        if prev is None:
+            os.environ.pop("TRIVY_TPU_LINK_CODEC", None)
+        else:
+            os.environ["TRIVY_TPU_LINK_CODEC"] = prev
+
+
+def _fingerprint(res: dict) -> str:
+    """Canonical serialization of a scan_programs result, both programs."""
+    from trivy_tpu.atypes import _secret_to_json
+
+    doc = {
+        "secret": [_secret_to_json(s) for s in res["secret"]],
+        "license": [
+            [(f.name, f.confidence, f.category) for f in findings]
+            for findings in res["license"]
+        ],
+    }
+    return json.dumps(doc, sort_keys=True, separators=(",", ":"))
+
+
+def _host_license(items) -> list[list]:
+    from trivy_tpu.license.decide import decide_findings
+
+    return decide_findings(
+        [c.decode("utf-8", errors="replace") for _, c in items]
+    )
+
+
+# -- parity fuzz ------------------------------------------------------------
+
+
+def test_program_parity_all_codec_modes(compiled_table):
+    """Both programs' verdicts are byte-identical across every link
+    codec mode, and the license demux matches the host tree exactly."""
+    table, art, _, _ = compiled_table
+    items = _mixed_corpus(seed=42)
+    fps = {}
+    last = None
+    for mode in ("off", "auto", "4", "6"):
+        eng = _engine(table, art, codec=mode)
+        last = eng.scan_programs(items)
+        fps[mode] = _fingerprint(last)
+    assert len(set(fps.values())) == 1, {k: len(v) for k, v in fps.items()}
+    assert last["license"] == _host_license(items)
+    # the planted license texts actually resolved
+    assert [f[0].name for f in last["license"] if f].count("Apache-2.0") == 1
+    assert any(f and f[0].name == "MIT" for f in last["license"])
+
+
+def test_program_parity_1_2_4_8_devices(compiled_table):
+    """Byte-identical demux at every forced-host-device count (the
+    conftest pins 8 XLA host devices, so 8 is a real 8-way shard)."""
+    from trivy_tpu.mesh import topology as mesh_topology
+
+    table, art, _, _ = compiled_table
+    items = _mixed_corpus(seed=7)
+    prints = {}
+    try:
+        for n in (1, 2, 4, 8):
+            mesh_topology.clear_cache()
+            mesh = mesh_topology.get_mesh(override=str(n))
+            eng = _engine(table, art, mesh=mesh)
+            prints[n] = _fingerprint(eng.scan_programs(items))
+    finally:
+        mesh_topology.clear_cache()
+    assert len(set(prints.values())) == 1, {
+        k: len(v) for k, v in prints.items()
+    }
+
+
+# -- demux ordering + scan_batch routing ------------------------------------
+
+
+def test_mixed_demux_matches_single_program_engines(compiled_table):
+    """The combined pass changes NOTHING about either verdict stream:
+    secret output is byte-identical to a secret-only engine, license
+    output to the host decision tree, and verdicts come back in table
+    order."""
+    from trivy_tpu.atypes import _secret_to_json
+    from trivy_tpu.engine.hybrid import make_secret_engine
+
+    table, art, secret_prog, secret_art = compiled_table
+    items = _mixed_corpus(seed=3)
+    eng = _engine(table, art)
+    res = eng.scan_programs(items)
+    assert list(res) == ["secret", "license"]
+
+    solo = make_secret_engine(
+        ruleset=secret_prog.ruleset(), backend="auto", compiled=secret_art
+    )
+    want = [_secret_to_json(s) for s in solo.scan_batch(items)]
+    assert [_secret_to_json(s) for s in res["secret"]] == want
+    # the secret stream found the planted ghp_ and AKIA credentials
+    assert sum(1 for s in res["secret"] if s.findings) == 2
+
+    assert res["license"] == _host_license(items)
+
+    # `only=` restricts which programs resolve, not what they resolve to
+    lic_only = eng.scan_programs(items, only=("license",))
+    assert list(lic_only) == ["license"]
+    assert lic_only["license"] == res["license"]
+
+    # scan_batch on a program engine routes through the table and stays
+    # the plain secret surface
+    assert [_secret_to_json(s) for s in eng.scan_batch(items)] == want
+
+
+def test_programs_snapshot_counters(compiled_table):
+    table, art, _, _ = compiled_table
+    eng = _engine(table, art)
+    items = _mixed_corpus(seed=11)
+    eng.scan_programs(items)
+    snap = eng.programs_snapshot()
+    assert snap["enabled"] is True
+    assert snap["table"] == "secret+license"
+    by_id = {p["id"]: p for p in snap["programs"]}
+    assert by_id["secret"]["files"] == len(items)
+    assert by_id["license"]["files"] == len(items)
+    assert by_id["license"]["verdicts"] >= 2
+    assert by_id["license"]["resolve_s"] >= 0
+
+
+# -- warm registry ----------------------------------------------------------
+
+
+def test_warm_registry_zero_program_recompiles(tmp_path, monkeypatch):
+    """A second engine build against the populated cache loads every
+    artifact warm — zero compile_ruleset calls — and the store keys
+    non-secret programs under programs/<id>/ while the secret program
+    keeps the bare-digest layout old caches already use."""
+    from trivy_tpu.programs import SecretScanProgram, make_program_engine
+    from trivy_tpu.registry import store as rstore
+    from trivy_tpu.registry.digest import ruleset_digest
+
+    cache = str(tmp_path / "rulesets")
+    make_program_engine(rules_cache_dir=cache)
+
+    secret_digest = ruleset_digest(SecretScanProgram().ruleset())
+    assert os.path.isdir(os.path.join(cache, secret_digest))
+    assert os.path.isdir(os.path.join(cache, "programs", "license"))
+    assert os.path.isdir(os.path.join(cache, "programs", "secret+license"))
+
+    calls = []
+    real_compile = rstore.compile_ruleset
+    monkeypatch.setattr(
+        rstore,
+        "compile_ruleset",
+        lambda *a, **kw: calls.append(1) or real_compile(*a, **kw),
+    )
+    eng = make_program_engine(rules_cache_dir=cache)
+    assert calls == [], "warm program-engine start recompiled a ruleset"
+    assert eng.program_table.table_id == "secret+license"
+
+
+def test_program_id_keyed_artifacts_do_not_alias(tmp_path):
+    """The same ruleset stored under two program ids round-trips from
+    two distinct directories, and a load under the wrong id refuses."""
+    from trivy_tpu.programs import LicenseScanProgram
+    from trivy_tpu.registry import store as rstore
+    from trivy_tpu.registry.digest import ruleset_digest
+
+    cache = str(tmp_path / "rulesets")
+    rs = LicenseScanProgram().ruleset()
+    digest = ruleset_digest(rs)
+    _, s1 = rstore.get_or_compile(rs, cache_dir=cache, program_id="license")
+    _, s2 = rstore.get_or_compile(rs, cache_dir=cache, program_id="license")
+    assert (s1, s2) == ("cold", "warm")
+
+    lic_dir = rstore.program_cache_dir(cache, "license")
+    art = rstore.load_artifact(lic_dir, digest, program_id="license")
+    assert art is not None and art.program_id == "license"
+    # a load under the wrong program id is a cache MISS, never an alias
+    assert rstore.load_artifact(lic_dir, digest, program_id="misconf") is None
+
+
+# -- compile-time anchor coverage -------------------------------------------
+
+
+def test_anchor_coverage_missing_anchor_fails(monkeypatch):
+    from trivy_tpu.license import phrases
+    from trivy_tpu.programs import LicenseScanProgram, ProgramCompileError
+
+    monkeypatch.delitem(phrases._PHRASE_ANCHORS, "Apache-2.0")
+    with pytest.raises(ProgramCompileError, match="no anchor token"):
+        LicenseScanProgram().ruleset()
+
+
+def test_anchor_coverage_non_substring_anchor_fails(monkeypatch):
+    from trivy_tpu.license import phrases
+    from trivy_tpu.programs import LicenseScanProgram, ProgramCompileError
+
+    monkeypatch.setitem(phrases._PHRASE_ANCHORS, "Apache-2.0", "walrus")
+    with pytest.raises(ProgramCompileError, match="not a substring"):
+        LicenseScanProgram().ruleset()
+
+
+def test_table_rejects_secret_not_first():
+    from trivy_tpu.programs import (
+        LicenseScanProgram,
+        SecretScanProgram,
+        build_program_table,
+    )
+
+    with pytest.raises(ValueError, match="first"):
+        build_program_table([LicenseScanProgram(), SecretScanProgram()])

@@ -37,6 +37,11 @@ Rule catalogue (each with allow/deny fixtures under fixtures/):
          engine//serve/ bypassing FleetRouter placement and health
          gating (annotate deliberate sites with `# graftlint:
          router-seam(reason)`)
+  GL014  program compile seam: compile_ruleset(...) called outside
+         trivy_tpu/registry/ (must ride get_or_compile's program-id-
+         keyed store), or ProgramTable/build_program_table/
+         make_program_engine constructed inside a loop (annotate
+         deliberate sites with `# graftlint: program-seam(reason)`)
 
 The runtime complement is trivy_tpu/lockcheck.py (TRIVY_TPU_LOCKCHECK=1
 lock-order + owner-role sanitizer); graftlint checks what must hold by
@@ -53,6 +58,7 @@ from tools.graftlint import (  # noqa: E402,F401
     rules_fleet,
     rules_jax,
     rules_labels,
+    rules_programs,
     rules_robust,
     rules_threads,
     rules_time,

@@ -1,0 +1,31 @@
+"""GL014 allow fixture: seam-routed compiles and hoisted tables."""
+
+from trivy_tpu.programs import build_program_table, make_program_engine
+from trivy_tpu.registry.store import get_or_compile
+
+
+def through_the_seam(ruleset, cache_dir):
+    # The seam: program-id-keyed warm path, artifact persisted for the
+    # next process.
+    art, source = get_or_compile(
+        ruleset, cache_dir=cache_dir, program_id="license"
+    )
+    return art, source
+
+
+def annotated_verify_diff(ruleset, rstore):
+    fresh = rstore.compile_ruleset(ruleset)  # graftlint: program-seam(verify diff against stored artifact)
+    return fresh
+
+
+def table_hoisted(batches, programs):
+    table = build_program_table(programs)
+    out = []
+    for batch in batches:
+        out.append((table, batch))
+    return out
+
+
+def engine_hoisted(jobs):
+    eng = make_program_engine(backend="auto")
+    return [eng.scan_programs(job) for job in jobs]

@@ -1,0 +1,31 @@
+"""GL014 deny fixture: program compiles off the registry seam and
+per-iteration table rebuilds."""
+
+from trivy_tpu.programs import build_program_table, make_program_engine
+from trivy_tpu.registry import store as rstore
+
+
+def cold_compiles_every_start(ruleset):
+    art = rstore.compile_ruleset(ruleset)  # GL014: bypasses the store
+    return art
+
+
+def empty_seam_reason(ruleset):
+    art = rstore.compile_ruleset(ruleset)  # graftlint: program-seam()
+    return art  # GL014: the reason is mandatory — program-seam() alone fails
+
+
+def table_per_call(batches, programs):
+    out = []
+    for batch in batches:
+        table = build_program_table(programs)  # GL014: hoist out of the loop
+        out.append((table, batch))
+    return out
+
+
+def engine_per_iteration(jobs):
+    results = []
+    for job in jobs:
+        eng = make_program_engine(backend="auto")  # GL014: engine per job
+        results.append(eng.scan_programs(job))
+    return results

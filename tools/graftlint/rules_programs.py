@@ -1,0 +1,132 @@
+"""Scan-program compile-seam hygiene.
+
+GL014: scan programs (``trivy_tpu/programs/``) ride ONE compile seam —
+``registry.store.get_or_compile(..., program_id=...)``.  Two hazards
+break it:
+
+1. A direct ``compile_ruleset(...)`` call outside ``trivy_tpu/registry/``
+   skips the program-id-keyed artifact store entirely: the process pays
+   the full Glushkov/probe/gram/vstack compile every start, the artifact
+   never lands on disk for the next process, and the warm-registry
+   "zero program recompiles" startup invariant silently rots.
+
+2. ``ProgramTable(...)`` / ``build_program_table(...)`` /
+   ``make_program_engine(...)`` constructed inside a ``for``/``while``
+   loop rebuilds the table (and with it every program's ruleset, and at
+   worst the engine) per iteration.  Tables are process-lifetime
+   objects: build once per config change, never per call — the program
+   analogue of GL001's jit-in-loop hazard.
+
+A deliberate out-of-seam compile (the ``rules verify`` command
+recompiling on purpose to diff against a stored artifact) is annotated
+at the call line with a mandatory reason:
+
+    fresh = rstore.compile_ruleset(rs)  # graftlint: program-seam(verify diff)
+
+The reason is the reviewable record of why this site may bypass the
+store.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.graftlint.core import Finding, Module, rule
+
+# The runtime surface: everything under trivy_tpu/ EXCEPT the registry
+# itself (the seam's home implements the seam).  bench/tools stay out of
+# scope like GL013's — harnesses monkeypatch the compile symbol to count
+# it, which is measurement, not construction.
+_SCOPE_PREFIX = "trivy_tpu/"
+_EXEMPT_PREFIX = "trivy_tpu/registry/"
+
+_SEAM_RE = re.compile(r"graftlint:.*\bprogram-seam\(([^)]*)\)")
+
+_LOOP_HOISTED = ("ProgramTable", "build_program_table", "make_program_engine")
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _in_scope(relpath: str) -> bool:
+    if relpath.startswith(_SCOPE_PREFIX) and not relpath.startswith(
+        _EXEMPT_PREFIX
+    ):
+        return True
+    base = relpath.rsplit("/", 1)[-1]
+    return base.startswith("gl014_")
+
+
+def _annotated(mod: Module, lineno: int) -> bool:
+    m = _SEAM_RE.search(mod.comments.get(lineno, ""))
+    return bool(m and m.group(1).strip())
+
+
+@rule("GL014")
+def check_program_compile_seam(mod: Module) -> list[Finding]:
+    if not _in_scope(mod.relpath):
+        return []
+    out: list[Finding] = []
+    # (1) compile_ruleset calls outside the registry seam.
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _call_name(node) != "compile_ruleset":
+            continue
+        if _annotated(mod, node.lineno):
+            continue
+        out.append(
+            Finding(
+                "GL014",
+                mod.relpath,
+                node.lineno,
+                "direct compile_ruleset(...) outside trivy_tpu/registry/ "
+                "bypasses the program-id-keyed artifact store (cold "
+                "compile every process, nothing persisted); go through "
+                "registry.store.get_or_compile(..., program_id=...), or "
+                "annotate the call line with `# graftlint: "
+                "program-seam(<reason>)`",
+            )
+        )
+    # (2) program-table/engine construction inside loops.
+    for loop in ast.walk(mod.tree):
+        if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
+            continue
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            if name not in _LOOP_HOISTED:
+                continue
+            if _annotated(mod, node.lineno):
+                continue
+            out.append(
+                Finding(
+                    "GL014",
+                    mod.relpath,
+                    node.lineno,
+                    f"{name}(...) inside a loop rebuilds the program "
+                    "table (rulesets, probe sets, at worst the engine) "
+                    "per iteration; tables are process-lifetime — hoist "
+                    "construction out of the loop, or annotate with "
+                    "`# graftlint: program-seam(<reason>)`",
+                )
+            )
+    # A call can't be double-reported by both passes (different names),
+    # but a loop nested in a loop would re-walk inner calls — dedupe.
+    seen: set[tuple[int, str]] = set()
+    deduped: list[Finding] = []
+    for f in out:
+        key = (f.line, f.message[:40])
+        if key in seen:
+            continue
+        seen.add(key)
+        deduped.append(f)
+    return deduped

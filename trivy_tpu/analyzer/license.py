@@ -4,13 +4,30 @@ Mirrors pkg/fanal/analyzer/licensing/ (license-file analyzer) and
 pkg/licensing/classifier.go with a two-tier design: the primary
 classifier is the batched full-text similarity matmul in
 trivy_tpu/license/classifier.py (the licenseclassifier analogue), and
-the distinctive-phrase sieve below is the fallback for texts under the
-confidence threshold plus the corpus-blind veto for licenses the
-full-text corpus cannot represent (e.g. AGPL-3.0 vs GPL-3.0).
+the distinctive-phrase sieve (trivy_tpu/license/phrases.py) is the
+fallback for texts under the confidence threshold plus the corpus-blind
+veto for licenses the full-text corpus cannot represent (AGPL-3.0 vs
+GPL-3.0).  The decision tree itself lives in trivy_tpu/license/decide.py
+so the device license scan program (trivy_tpu/programs/license.py)
+shares it verbatim.
+
+Backend selection (TRIVY_TPU_LICENSE_BACKEND):
+  auto    (default) device license program when it builds, host otherwise
+  device  force the device program (fails back to host with a warning)
+  host    the direct classifier path, no sieve
+
+The device backend runs the anchor-token gram sieve over every claimed
+file and classifies only sieve candidates — on real scans virtually no
+claimed file is a license text, so the ~3-20ms/text host fingerprint is
+paid for the handful of true candidates instead of every COPYING-shaped
+path.  Verdicts are byte-identical to the host path (the program's
+necessary-condition contract; see programs/license.py).
 """
 
 from __future__ import annotations
 
+import logging
+import os
 import re
 
 from trivy_tpu.analyzer.core import (
@@ -20,7 +37,20 @@ from trivy_tpu.analyzer.core import (
     BatchAnalyzer,
     register_analyzer,
 )
-from trivy_tpu.ltypes import LICENSE_TYPE_FILE, LicenseFile, LicenseFinding
+from trivy_tpu.license.decide import decide_findings
+
+# Phrase-sieve surface re-exported for compatibility: the sieve moved to
+# trivy_tpu/license/phrases.py so the device program can import it
+# without pulling the analyzer registry in.
+from trivy_tpu.license.phrases import (  # noqa: F401  (re-exports)
+    _PHRASES,
+    classify,
+    classify_text,
+    normalize,
+)
+from trivy_tpu.ltypes import LICENSE_TYPE_FILE, LicenseFile
+
+logger = logging.getLogger(__name__)
 
 # Filenames the license-file analyzer claims
 # (pkg/fanal/analyzer/licensing/license.go requiredFiles + patterns).
@@ -29,67 +59,65 @@ _LICENSE_FILE_RE = re.compile(
 )
 SKIP_DIRS = {"node_modules", ".git", "vendor"}
 
-# Distinctive phrases over normalized text (lowercase, collapsed whitespace).
-# Each entry: (SPDX id, [phrases — ALL must appear]).
-_PHRASES: list[tuple[str, list[str]]] = [
-    ("Apache-2.0", ["apache license", "version 2.0"]),
-    # "remote network interaction" is AGPL-3.0's own section 13 heading;
-    # the license NAME appears in GPL-3.0 section 13 and MPL-2.0's
-    # Secondary Licenses clause, so it cannot distinguish on its own.
-    ("AGPL-3.0", ["gnu affero general public license", "remote network interaction"]),
-    ("LGPL-3.0", ["gnu lesser general public license", "version 3"]),
-    ("LGPL-2.1", ["gnu lesser general public license", "version 2.1"]),
-    ("GPL-3.0", ["gnu general public license", "version 3"]),
-    ("GPL-2.0", ["gnu general public license", "version 2"]),
-    ("MPL-2.0", ["mozilla public license", "version 2.0"]),
-    ("EPL-2.0", ["eclipse public license", "v 2.0"]),
-    (
-        "BSD-3-Clause",
-        [
-            "redistribution and use in source and binary forms",
-            "neither the name",
-        ],
-    ),
-    (
-        "BSD-2-Clause",
-        ["redistribution and use in source and binary forms"],
-    ),
-    (
-        "MIT",
-        [
-            "permission is hereby granted, free of charge",
-            "the software is provided \"as is\"",
-        ],
-    ),
-    (
-        "ISC",
-        [
-            "permission to use, copy, modify, and/or distribute this software",
-        ],
-    ),
-    ("Unlicense", ["this is free and unencumbered software"]),
-    ("CC0-1.0", ["cc0 1.0"]),
-    ("Zlib", ["this software is provided 'as-is'", "zlib"]),
-]
+_BACKEND_ENV = "TRIVY_TPU_LICENSE_BACKEND"
+
+# Lazy singleton license-program engine for the device backend; False
+# marks a failed build/scan so the fallback is paid once, not per batch.
+_program_engine = None
 
 
-def normalize(text: str) -> str:
-    return re.sub(r"\s+", " ", text.lower())
+def _device_engine():
+    """The shared license-only program engine, or None (host fallback).
+    One anchor-ruleset compile per process; a build failure pins the
+    host path permanently with a single warning."""
+    global _program_engine
+    if _program_engine is False:
+        return None
+    if _program_engine is None:
+        try:
+            from trivy_tpu.programs import (
+                LicenseScanProgram,
+                make_program_engine,
+            )
+
+            _program_engine = make_program_engine([LicenseScanProgram()])
+        except Exception as e:
+            logger.warning(
+                "device license program unavailable (%s); using the host "
+                "classifier path",
+                e,
+            )
+            _program_engine = False
+            return None
+    return _program_engine
 
 
-def classify_text(text: str) -> list[LicenseFinding]:
-    """pkg/licensing/classifier.go Classify, phrase-based."""
-    text = normalize(text)
-    findings = []
-    for spdx_id, phrases in _PHRASES:
-        if all(p in text for p in phrases):
-            findings.append(LicenseFinding.of(spdx_id, confidence=0.9))
-            break  # first (most specific) match wins
-    return findings
-
-
-def classify(content: bytes) -> list[LicenseFinding]:
-    return classify_text(content.decode("utf-8", errors="replace"))
+def _decide_batch(paths: list[str], texts: list[str]) -> list[list]:
+    """Per-file findings via the selected backend.  Device and host run
+    the same decision tree (license/decide.py); the device backend just
+    prunes non-candidates with the anchor sieve first."""
+    global _program_engine
+    backend = os.environ.get(_BACKEND_ENV, "auto").strip().lower() or "auto"
+    if backend not in ("auto", "device", "host"):
+        logger.warning("unknown %s=%r; using auto", _BACKEND_ENV, backend)
+        backend = "auto"
+    if backend != "host":
+        eng = _device_engine()
+        if eng is not None:
+            try:
+                items = [
+                    (p, t.encode("utf-8", errors="replace"))
+                    for p, t in zip(paths, texts)
+                ]
+                return eng.scan_programs(items)["license"]
+            except Exception as e:
+                logger.warning(
+                    "device license scan failed (%s); using the host "
+                    "classifier path",
+                    e,
+                )
+                _program_engine = False
+    return decide_findings(texts)
 
 
 class LicenseFileAnalyzer(BatchAnalyzer):
@@ -99,7 +127,9 @@ class LicenseFileAnalyzer(BatchAnalyzer):
     hashed-trigram similarity matmul (trivy_tpu/license/classifier.py) —
     the full-text analogue of google/licenseclassifier — with the phrase
     sieve as fallback for texts below the confidence threshold (heavily
-    edited or truncated license files)."""
+    edited or truncated license files).  On the device backend the
+    anchor-token gram sieve prunes the batch first (see module
+    docstring)."""
 
     def type(self) -> str:
         return "license-file"
@@ -123,43 +153,12 @@ class LicenseFileAnalyzer(BatchAnalyzer):
     def analyze_batch(self, inputs: list) -> AnalysisResult | None:
         if not inputs:
             return None
-        from trivy_tpu.license import shared_classifier
-
-        clf = shared_classifier()
         texts = [
             inp.content.decode("utf-8", errors="replace") for inp in inputs
         ]
-        matches = clf.classify_batch(texts)
+        paths = [inp.file_path for inp in inputs]
         licenses = []
-        for inp, text, match in zip(inputs, texts, matches):
-            if match is not None and match.confidence >= 0.99:
-                # Essentially-exact corpus match: the phrase sieve can
-                # add nothing (a verbatim corpus text merely MENTIONING
-                # another license must not be vetoed) — skip its pass.
-                findings = [
-                    LicenseFinding.of(match.license, confidence=match.confidence)
-                ]
-            else:
-                phrase = classify_text(text)
-                if match is None:
-                    findings = phrase
-                # Corpus-blind veto: licenses absent from the full-text
-                # corpus score high against near-identical relatives
-                # (AGPL-3.0 vs GPL-3.0 is ~0.98 cosine).  When the phrase
-                # sieve names a license the corpus cannot represent, its
-                # more specific answer wins.
-                elif (
-                    phrase
-                    and phrase[0].name != match.license
-                    and phrase[0].name not in clf.names
-                ):
-                    findings = phrase
-                else:
-                    findings = [
-                        LicenseFinding.of(
-                            match.license, confidence=match.confidence
-                        )
-                    ]
+        for inp, findings in zip(inputs, _decide_batch(paths, texts)):
             if not findings:
                 continue
             licenses.append(
@@ -201,6 +200,8 @@ class DpkgLicenseAnalyzer(Analyzer):
             licenses = [f.name for f in findings]
         if not licenses:
             return None
+        from trivy_tpu.ltypes import LicenseFinding
+
         return AnalysisResult(
             licenses=[
                 LicenseFile(

@@ -6,7 +6,8 @@ mirroring the economics of Trivy's ArtifactCache split in
 `pkg/fanal/cache/`).  This module stores the *device scan verdict* for a
 single content blob, keyed by everything that could change it:
 
-    result key = sha256(blob_digest \\x00 ruleset_digest \\x00 schema)
+    result key = sha256(blob_digest \\x00 ruleset_digest \\x00 schema
+                        \\x00 program_id)
 
 - `blob_digest` is sha256 over the exact bytes the engine scanned, so
   identical content hits regardless of path or image;
@@ -15,7 +16,13 @@ single content blob, keyed by everything that could change it:
   the entries scanned under the old rules, nothing else;
 - `engine_schema_version` (RESULT_SCHEMA_VERSION here) versions the
   finding encoding itself, so a wire-format change never rehydrates
-  garbage.
+  garbage;
+- `program_id` names which scan program's verdict this is
+  (programs/base.py): one device pass now yields several per-blob
+  verdicts, and a license verdict must never answer a secret lookup.
+  For license entries pass the program's `verdict_digest()` as
+  `ruleset_digest` — the classifier corpus is part of the verdict
+  identity there, not just the anchor ruleset.
 
 Values ride the existing BlobInfo JSON document (atypes.py secret
 round-trip) through any ArtifactCache backend — memory, FS, Redis, S3,
@@ -35,8 +42,10 @@ from trivy_tpu.cache.tiered import TieredCache
 from trivy_tpu.ftypes import Secret
 
 # Version of the cached-finding encoding (the third key component).
-# Bump on any change to SecretFinding/Code/Layer JSON shape.
-RESULT_SCHEMA_VERSION = 1
+# Bump on any change to SecretFinding/Code/Layer JSON shape — and on any
+# change to the key derivation itself (v2 added the program_id
+# component; v1 keys must never alias v2 entries).
+RESULT_SCHEMA_VERSION = 2
 
 
 def content_digest(data: bytes) -> str:
@@ -48,6 +57,7 @@ def result_key(
     blob_digest: str,
     ruleset_digest: str,
     schema_version: int = RESULT_SCHEMA_VERSION,
+    program_id: str = "secret",
 ) -> str:
     """The composite content-addressed key (itself `sha256:<hex>` so the
     FS backend files it under the plain hex digest)."""
@@ -57,6 +67,8 @@ def result_key(
     h.update(ruleset_digest.encode("utf-8"))
     h.update(b"\x00")
     h.update(str(schema_version).encode("ascii"))
+    h.update(b"\x00")
+    h.update(program_id.encode("utf-8"))
     return "sha256:" + h.hexdigest()
 
 
@@ -73,7 +85,11 @@ class ScanResultCache:
         self.backend = backend
 
     def get(
-        self, blob_digest: str, ruleset_digest: str, path: str = ""
+        self,
+        blob_digest: str,
+        ruleset_digest: str,
+        path: str = "",
+        program_id: str = "secret",
     ) -> Secret | None:
         """The cached verdict rehydrated under `path`, or None on miss.
         A non-None return with empty findings means "scanned clean"."""
@@ -82,7 +98,7 @@ class ScanResultCache:
             # must not serve stale verdicts.
             cache_stats.record_request("results", "miss")
             return None
-        key = result_key(blob_digest, ruleset_digest)
+        key = result_key(blob_digest, ruleset_digest, program_id=program_id)
         blob = self.backend.get_blob(key)
         if blob is None:
             cache_stats.record_request("results", "miss")
@@ -92,13 +108,17 @@ class ScanResultCache:
         return Secret(file_path=path, findings=findings)
 
     def put(
-        self, blob_digest: str, ruleset_digest: str, secret: Secret
+        self,
+        blob_digest: str,
+        ruleset_digest: str,
+        secret: Secret,
+        program_id: str = "secret",
     ) -> None:
         """Store the verdict for one blob (path stripped: the key is the
         content, not the name it was scanned under)."""
         if not ruleset_digest:
             return
-        key = result_key(blob_digest, ruleset_digest)
+        key = result_key(blob_digest, ruleset_digest, program_id=program_id)
         secrets = (
             [Secret(file_path="", findings=list(secret.findings))]
             if secret.findings
@@ -112,21 +132,22 @@ class ScanResultCache:
         ruleset_digest: str,
         path: str,
         scan_fn,
+        program_id: str = "secret",
     ) -> Secret:
         """Hit path, or run `scan_fn()` exactly once per key across
         concurrent callers (single-flight when the backend is tiered)
         and remember its verdict."""
-        hit = self.get(blob_digest, ruleset_digest, path)
+        hit = self.get(blob_digest, ruleset_digest, path, program_id)
         if hit is not None:
             return hit
 
         def _miss() -> Secret:
             verdict = scan_fn()
-            self.put(blob_digest, ruleset_digest, verdict)
+            self.put(blob_digest, ruleset_digest, verdict, program_id)
             return verdict
 
         if isinstance(self.backend, TieredCache):
-            key = result_key(blob_digest, ruleset_digest)
+            key = result_key(blob_digest, ruleset_digest, program_id=program_id)
             result = self.backend.single_flight(key, _miss)
             # The leader's verdict carries the leader's path; re-serve
             # under ours if they differ (shared findings are immutable).

@@ -163,6 +163,7 @@ class TpuSecretEngine:
         fused: bool | None = None,
         megakernel: bool | None = None,
         aot_cache_dir: str | None = None,
+        program_table=None,
     ):
         from trivy_tpu.engine.pipeline import (
             ResidentChunkCache,
@@ -191,6 +192,12 @@ class TpuSecretEngine:
             pipeline_depth if pipeline_depth is not None else default_depth()
         )
         self.dedupe = dedupe
+        # Multi-program demux (programs/base.py): when set, `ruleset` is
+        # the table's merged ruleset and scan_programs slices the shared
+        # candidate matrix per program.  scan_batch stays the secret-only
+        # facade (it routes through the table so one engine serves both).
+        self.program_table = program_table
+        self.program_stats: dict[str, dict] = {}
         self._resident = ResidentChunkCache(resident_chunks)
         # Fused sieve->verify residency (this PR's tentpole): staged rows
         # and their hit words stay device-resident for the batch lifetime
@@ -1354,6 +1361,15 @@ class TpuSecretEngine:
         """Scan (path, content) blobs; returns per-file Secret results."""
         import time as _time
 
+        if self.program_table is not None:
+            # Multi-program engine: the rule axis is the merged table, so
+            # the single-program confirm below would hand the oracle
+            # foreign rule indices.  Route through the demux (secret
+            # program's slice keeps indices 0..N-1, so results are
+            # byte-identical to a secret-only engine).
+            return self.scan_programs(items, only=("secret",)).get(
+                "secret", [Secret() for _ in items]
+            )
         if not items:
             return []
         self.stats.files += len(items)
@@ -1409,3 +1425,105 @@ class TpuSecretEngine:
 
     def scan(self, file_path: str, content: bytes) -> Secret:
         return self.scan_batch([(file_path, content)])[0]
+
+    def scan_programs(
+        self,
+        items: list[tuple[str, bytes]],
+        only: tuple[str, ...] | None = None,
+    ) -> dict[str, list]:
+        """One device pass, per-program verdicts.
+
+        Sieves the merged rule axis exactly like scan_batch (same pack,
+        dedupe, candidate derivation), applies the host-DFA claim-killer
+        only to columns whose program opted in (verify=True), then slices
+        the candidate matrix per program and hands each slice to that
+        program's resolve hook.  Returns {program_id: [verdict per item,
+        in item order]}.  `only` restricts which programs RESOLVE — the
+        device pass is one either way; skipping a resolve just skips its
+        host-side confirm cost.
+        """
+        import time as _time
+
+        table = self.program_table
+        if table is None:
+            raise RuntimeError(
+                "scan_programs needs an engine built with a program_table "
+                "(programs.make_program_engine)"
+            )
+        wanted = [
+            (p, sl)
+            for p, sl in table.slices()
+            if only is None or p.program_id in only
+        ]
+        if not items:
+            return {p.program_id: [] for p, _ in wanted}
+        self.stats.files += len(items)
+        self.stats.bytes += sum(len(c) for _, c in items)
+        self.stats.pipeline_depth = self.pipeline_depth
+
+        # Same dedupe-in-front-of-the-link as scan_batch: one sieve over
+        # distinct blobs, candidates fan back out to every alias.
+        contents = [c for _, c in items]
+        scan_items = items
+        dd = None
+        if self.dedupe and len(items) > 1:
+            t0 = _time.perf_counter()
+            dd = dedupe_blobs(contents)
+            self.stats.pack_s += _time.perf_counter() - t0
+            if dd.any_duplicates():
+                self.stats.dedupe_saved_bytes += dd.saved_bytes
+                scan_items = [items[int(i)] for i in dd.unique_index]
+                contents = [c for _, c in scan_items]
+            else:
+                dd = None
+
+        cand = self._candidates(contents)
+        vmask = table.verify_column_mask(cand.shape[1])
+        if vmask.any():
+            # The claim-killer refutes (file, rule) pairs by exact DFA
+            # match — only sound for columns whose program opted in.
+            # Zero the opt-out columns going in, splice their raw
+            # candidacy back after (np.where keeps cand's dtype/shape).
+            verified = self._verify_candidates(scan_items, cand & vmask[None, :])
+            cand = np.where(vmask[None, :], verified, cand)
+        if dd is not None:
+            cand = cand[dd.inverse]
+
+        out: dict[str, list] = {}
+        for prog, sl in wanted:
+            pslice = cand[:, sl]
+            t0 = _time.perf_counter()
+            verdicts = prog.resolve(self, items, pslice, sl.start)
+            resolve_s = _time.perf_counter() - t0
+            if len(verdicts) != len(items):
+                raise RuntimeError(
+                    f"program {prog.program_id!r} returned "
+                    f"{len(verdicts)} verdicts for {len(items)} items"
+                )
+            st = self.program_stats.setdefault(
+                prog.program_id,
+                {
+                    "files": 0,
+                    "candidate_files": 0,
+                    "candidate_pairs": 0,
+                    "verdicts": 0,
+                    "resolve_s": 0.0,
+                },
+            )
+            st["files"] += len(items)
+            st["candidate_files"] += int(pslice.any(axis=1).sum())
+            st["candidate_pairs"] += int(pslice.sum())
+            st["verdicts"] += prog.verdict_count(verdicts)
+            st["resolve_s"] = round(st["resolve_s"] + resolve_s, 6)
+            out[prog.program_id] = verdicts
+        return out
+
+    def programs_snapshot(self) -> dict:
+        """Program-table attribution for /debug/programs and Explain."""
+        if self.program_table is None:
+            return {"enabled": False}
+        snap = self.program_table.snapshot()
+        for p in snap["programs"]:
+            p.update(self.program_stats.get(p["id"], {}))
+        snap["enabled"] = True
+        return snap

@@ -293,6 +293,7 @@ class HybridSecretEngine(TpuSecretEngine):
         dedupe: bool = True,
         resident_chunks: int | None = None,
         compiled=None,
+        program_table=None,
     ):
         super().__init__(
             ruleset=ruleset,
@@ -302,6 +303,7 @@ class HybridSecretEngine(TpuSecretEngine):
             dedupe=dedupe,
             resident_chunks=resident_chunks,
             compiled=compiled,
+            program_table=program_table,
         )
         self.chunk_bytes = chunk_bytes
         if verify not in ("auto", "dfa", "none", "device", "fused"):
@@ -657,6 +659,14 @@ class HybridSecretEngine(TpuSecretEngine):
         return out
 
     def scan_batch(self, items: list[tuple[str, bytes]]) -> list[Secret]:
+        if self.program_table is not None:
+            # Multi-program table: route through the shared demux (the
+            # merged rule axis would feed the chunked confirm below
+            # foreign rule indices).  TpuSecretEngine.scan_programs runs
+            # on this engine's native sieve via _candidates.
+            return self.scan_programs(items, only=("secret",)).get(
+                "secret", [Secret() for _ in items]
+            )
         if not items:
             return []
         if not self._native_ok:
@@ -980,6 +990,11 @@ def make_secret_engine(
     supplies the probe/gram/NFA tensors (warm start, no compile), and a
     miss compiles once and persists for the next process.  None (the
     default) leaves the registry out entirely.
+
+    A `program_table` kwarg (programs/base.py) turns the engine
+    multi-program: `ruleset` must then be the table's merged ruleset —
+    use `programs.make_program_engine`, which also warms the registry
+    program-id-keyed, instead of threading the table by hand.
     """
     backend = {"tpu": "device", "cpu": "oracle"}.get(backend, backend)
     if backend == "oracle":

@@ -200,6 +200,7 @@ class FullTextClassifier:
                 except OSError:
                     continue
         corpus.update(extra or {})
+        self._corpus = corpus
         self.names = sorted(corpus)
         self.matrix = np.stack(
             [_fingerprint(normalize_tokens(corpus[n])) for n in self.names]
@@ -210,6 +211,11 @@ class FullTextClassifier:
         for n in self.names:
             digest = zlib.crc32(corpus[n].encode(), zlib.crc32(n.encode(), digest))
         self.corpus_digest = digest
+
+    def corpus_text(self, name: str) -> str:
+        """Raw corpus text for `name` ("" if absent).  The device license
+        program audits anchor-token coverage against it at compile time."""
+        return self._corpus.get(name, "")
 
     def classify_batch(
         self,

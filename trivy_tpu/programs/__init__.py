@@ -1,0 +1,30 @@
+"""Device scan programs: one device pass, many verdicts.
+
+See programs/base.py for the model.  Public surface:
+
+- ScanProgram / ProgramTable / build_program_table — the abstraction
+- SecretScanProgram — the refactored secret path
+- LicenseScanProgram — SPDX license classification on the gram sieve
+- make_program_engine — registry-seamed construction (GL014 holds it)
+"""
+
+from trivy_tpu.programs.base import (
+    ProgramCompileError,
+    ProgramTable,
+    ScanProgram,
+    build_program_table,
+)
+from trivy_tpu.programs.factory import default_programs, make_program_engine
+from trivy_tpu.programs.license import LicenseScanProgram
+from trivy_tpu.programs.secret import SecretScanProgram
+
+__all__ = [
+    "LicenseScanProgram",
+    "ProgramCompileError",
+    "ProgramTable",
+    "ScanProgram",
+    "SecretScanProgram",
+    "build_program_table",
+    "default_programs",
+    "make_program_engine",
+]

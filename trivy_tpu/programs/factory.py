@@ -1,0 +1,82 @@
+"""Program-engine construction through the registry seam.
+
+`make_program_engine` is to multi-program scanning what
+`make_secret_engine` is to secrets: the ONE place a program table turns
+into an engine.  Construction rides the compiled-artifact registry when
+a cache dir is given — the merged table artifact AND each member
+program's own artifact are stored program-id-keyed
+(`get_or_compile(..., program_id=...)`), so a warm registry start
+performs zero program recompiles (asserted by tests/test_programs.py and
+the BENCH_PROGRAMS section).  graftlint GL014 pins this seam: compiling
+a program ruleset outside the registry, or rebuilding a program table
+per call in a loop, is a finding.
+"""
+
+from __future__ import annotations
+
+from trivy_tpu.programs.base import ProgramTable, build_program_table
+from trivy_tpu.programs.license import LicenseScanProgram
+from trivy_tpu.programs.secret import SecretScanProgram
+
+
+def default_programs(config=None) -> list:
+    """The stock table: the builtin secret ruleset plus the SPDX license
+    program, one device pass for both."""
+    return [SecretScanProgram(config=config), LicenseScanProgram()]
+
+
+def make_program_engine(
+    programs: list | ProgramTable | None = None,
+    *,
+    config=None,
+    backend: str = "auto",
+    mesh=None,
+    rules_cache_dir: str | None = None,
+    **kw,
+):
+    """Build a multi-program engine over one merged sieve pass.
+
+    `programs` is a list of ScanPrograms (or a prebuilt ProgramTable);
+    None = `default_programs(config)`.  `backend` accepts the
+    make_secret_engine engine backends (auto/device/native/hybrid) —
+    the oracle backend has no sieve and therefore no program demux.
+    `rules_cache_dir` routes every compile through the registry's
+    program-id-keyed warm path.
+    """
+    if programs is None:
+        programs = default_programs(config)
+    table = (
+        programs
+        if isinstance(programs, ProgramTable)
+        else build_program_table(programs)
+    )
+    backend = {"tpu": "device"}.get(backend, backend)
+    if backend in ("oracle", "cpu"):
+        raise ValueError(
+            "the oracle backend has no device pass to demux programs from"
+        )
+    merged = table.merged_ruleset()
+    if rules_cache_dir is not None and "compiled" not in kw:
+        from trivy_tpu.registry.store import get_or_compile
+
+        kw["compiled"], _ = get_or_compile(
+            merged, cache_dir=rules_cache_dir, program_id=table.table_id
+        )
+        # Warm each member program's own artifact too: standalone engines
+        # for any member (a secret-only server, a license-only analyzer)
+        # then start warm from the same store.
+        for prog in table.programs:
+            get_or_compile(
+                prog.ruleset(),
+                cache_dir=rules_cache_dir,
+                program_id=prog.program_id,
+            )
+    from trivy_tpu.engine.hybrid import make_secret_engine
+
+    return make_secret_engine(
+        ruleset=merged,
+        backend=backend,
+        mesh=mesh,
+        program_table=table,
+        **kw,
+    )

@@ -1,0 +1,67 @@
+"""The secret path as a ScanProgram — the refactor that proves the shape.
+
+Resolve is the exact confirm loop `TpuSecretEngine.scan_batch` runs: the
+oracle restricted to candidate rule indices, with the reference's
+allow-path result shape preserved for candidate-free files.  Rule
+indices translate local -> merged by `offset`; the table pins the secret
+program first (offset 0), so the oracle sees the same indices a
+secret-only engine would — findings are byte-identical by construction.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from trivy_tpu.ftypes import Secret
+from trivy_tpu.obs import trace as obs_trace
+from trivy_tpu.programs.base import ScanProgram
+from trivy_tpu.rules.model import RuleSet, SecretConfig, build_ruleset
+
+
+class SecretScanProgram(ScanProgram):
+    program_id = "secret"
+    verify = True  # secret rules carry real regexes: DFA refutation is sound
+
+    def __init__(
+        self,
+        ruleset: RuleSet | None = None,
+        config: SecretConfig | None = None,
+    ):
+        super().__init__()
+        self._ruleset = (
+            ruleset if ruleset is not None else build_ruleset(config)
+        )
+
+    def build_ruleset(self) -> RuleSet:
+        return self._ruleset
+
+    def resolve(self, engine, items, cand, offset: int) -> list[Secret]:
+        import time as _time
+
+        t0 = _time.perf_counter()
+        results: list[Secret] = []
+        with obs_trace.span("confirm", files=len(items)):
+            for fi, (path, content) in enumerate(items):
+                idxs = np.flatnonzero(cand[fi])
+                if len(idxs) == 0:
+                    # Preserve the reference's allow-path result shape
+                    # (scanner.go:375-380) even when the sieve lets us
+                    # skip the oracle entirely.
+                    if engine.oracle.allow_path(path):
+                        results.append(Secret(file_path=path))
+                    else:
+                        results.append(Secret())
+                    continue
+                engine.stats.candidate_pairs += len(idxs)
+                res = engine.oracle.scan(
+                    path,
+                    content,
+                    rule_indices=[int(i) + offset for i in idxs],
+                )
+                engine.stats.confirmed_findings += len(res.findings)
+                results.append(res)
+        engine.stats.confirm_s += _time.perf_counter() - t0
+        return results
+
+    def verdict_count(self, verdicts: list) -> int:
+        return sum(1 for s in verdicts if s.findings)

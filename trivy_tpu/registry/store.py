@@ -92,9 +92,17 @@ class CompiledArtifact:
     # instead of re-deriving 64-position byte tensors rule by rule in
     # Python, and aot_warmup pre-lowers the fused verify against them.
     vstack: dict | None = None
+    # Which scan program (programs/base.py) this artifact compiles.
+    # "secret" keeps the historical bare-<digest> store layout; any other
+    # id stores (and validates) under <cache>/programs/<id>/<digest>.
+    program_id: str = "secret"
 
 
-def compile_ruleset(ruleset: RuleSet, digest: str | None = None) -> CompiledArtifact:
+def compile_ruleset(
+    ruleset: RuleSet,
+    digest: str | None = None,
+    program_id: str = "secret",
+) -> CompiledArtifact:
     """The cold path: Glushkov union NFA + probe set + gram constants."""
     from trivy_tpu.engine.grams import build_gram_set
     from trivy_tpu.engine.link import derive_alphabet
@@ -119,6 +127,7 @@ def compile_ruleset(ruleset: RuleSet, digest: str | None = None) -> CompiledArti
         manifest={},
         alphabet=derive_alphabet(gset),
         vstack=vstack,
+        program_id=program_id,
     )
 
 
@@ -219,6 +228,9 @@ def _build_manifest(art: CompiledArtifact, arrays: dict) -> dict:
     return {
         "schema_version": SCHEMA_VERSION,
         "ruleset_digest": art.digest,
+        # Additive (schema stays 3): pre-program artifacts lack the key
+        # and read back as "secret", which is what they all were.
+        "program_id": getattr(art, "program_id", "secret") or "secret",
         "created_at": time.time(),
         "trivy_tpu_version": __version__,
         "jax_version": _jax_version(),
@@ -413,12 +425,16 @@ def save_artifact(art: CompiledArtifact, cache_dir: str) -> str:
 
 
 def load_artifact(
-    cache_dir: str, digest: str, strict_versions: bool = True
+    cache_dir: str,
+    digest: str,
+    strict_versions: bool = True,
+    program_id: str = "secret",
 ) -> CompiledArtifact | None:
     """Load and validate; ANY failure (missing, truncated, checksum or
-    version mismatch, foreign digest) logs a warning and returns None — the
-    caller recompiles.  `strict_versions=False` skips the producing-version
-    pin (used by `rules verify` to inspect foreign artifacts)."""
+    version mismatch, foreign digest, foreign program) logs a warning and
+    returns None — the caller recompiles.  `strict_versions=False` skips
+    the producing-version pin (used by `rules verify` to inspect foreign
+    artifacts)."""
     dirp = os.path.join(cache_dir, digest)
     mpath = os.path.join(dirp, MANIFEST_JSON)
     npath = os.path.join(dirp, ARTIFACT_NPZ)
@@ -440,6 +456,12 @@ def load_artifact(
             raise ValueError(
                 f"manifest digest {manifest.get('ruleset_digest')!r} does "
                 f"not match directory {digest!r}"
+            )
+        if manifest.get("program_id", "secret") != program_id:
+            raise ValueError(
+                f"artifact compiles program "
+                f"{manifest.get('program_id', 'secret')!r}, caller wants "
+                f"{program_id!r}"
             )
         if strict_versions:
             if manifest.get("trivy_tpu_version") != __version__:
@@ -466,7 +488,9 @@ def load_artifact(
         import io
 
         with np.load(io.BytesIO(blob), allow_pickle=False) as z:
-            return _unpack_artifact(manifest, z)
+            art = _unpack_artifact(manifest, z)
+        art.program_id = manifest.get("program_id", "secret")
+        return art
     except Exception as e:
         logger.warning(
             "ruleset artifact %s unusable (%s); falling back to a fresh "
@@ -477,22 +501,37 @@ def load_artifact(
         return None
 
 
+def program_cache_dir(cache_dir: str, program_id: str) -> str:
+    """Program-id-keyed store layout: the secret program keeps the
+    historical bare-<digest> directories (every pre-program artifact on
+    disk stays warm); any other program nests under programs/<id>/ so
+    digests can never collide across programs with different resolve
+    semantics."""
+    if program_id == "secret":
+        return cache_dir
+    return os.path.join(cache_dir, "programs", program_id)
+
+
 def get_or_compile(
     ruleset: RuleSet,
     cache_dir: str | None = None,
     save: bool = True,
+    program_id: str = "secret",
 ) -> tuple[CompiledArtifact, str]:
     """The engine-construction entry point: returns (artifact, source) with
     source "warm" (loaded from the store) or "cold" (freshly compiled, and
     saved back unless the store is unwritable — a read-only cache never
-    fails a scan)."""
+    fails a scan).  `program_id` keys the store layout and the manifest
+    pin (see program_cache_dir) — this function is the ONE compile seam
+    scan programs ride (graftlint GL014)."""
     if cache_dir is None:
         cache_dir = default_cache_dir()
+    cache_dir = program_cache_dir(cache_dir, program_id)
     digest = ruleset_digest(ruleset)
-    art = load_artifact(cache_dir, digest)
+    art = load_artifact(cache_dir, digest, program_id=program_id)
     if art is not None:
         return art, "warm"
-    art = compile_ruleset(ruleset, digest=digest)
+    art = compile_ruleset(ruleset, digest=digest, program_id=program_id)
     if save:
         try:
             save_artifact(art, cache_dir)

@@ -653,6 +653,29 @@ class ScanServer:
             }
         return rep
 
+    def programs_report(self) -> dict:
+        """GET /debug/programs: the scan-program table sharing the device
+        pass and each program's cumulative demux counters, from the
+        scheduler's last batch boundary.  A sane body on a secret-only
+        server: enabled=false (the table only exists on multi-program
+        engines)."""
+        snap = getattr(self.scheduler, "_last_programs", None)
+        if snap is None:
+            # No multi-program batch yet — ask the active engine
+            # directly so a freshly-started program server still reports
+            # its table before the first dispatch.
+            engine = getattr(self.scheduler, "engine", None)
+            psnap = getattr(engine, "programs_snapshot", None)
+            if psnap is not None and getattr(
+                engine, "program_table", None
+            ) is not None:
+                snap = psnap()
+        if snap is None:
+            return {"enabled": False}
+        rep = dict(snap)
+        rep["enabled"] = True
+        return rep
+
     def _collect_fleet(self) -> None:
         """Registry collect hook (fleeted hosts only): refresh the member
         gauge and fold FleetSelf's affinity tallies plus the process's
@@ -929,6 +952,9 @@ DEBUG_SURFACES = {
     "/debug/fleet": "fleet plane: membership table with per-member "
     "health, this host's identity and resident-digest set, affinity "
     "economics (?probe=1 actively probes peers' /readyz first)",
+    "/debug/programs": "device scan programs: program table sharing the "
+    "device pass, per-program demux counters (candidates/verdicts) at "
+    "the last batch boundary",
 }
 
 
@@ -1080,6 +1106,11 @@ def _make_handler(server: ScanServer):
                     0
                 ].lower() in ("1", "true", "yes")
                 self._send(200, server.fleet_report(probe=probe))
+            elif route == "/debug/programs":
+                # Program-table posture: which scan programs share the
+                # device pass + demux counters (sane body when the
+                # engine is secret-only: enabled=false).
+                self._send(200, server.programs_report())
             elif route in ("/debug", "/debug/"):
                 # Index of every debug surface with its one-liner.
                 self._send(200, {"surfaces": DEBUG_SURFACES})

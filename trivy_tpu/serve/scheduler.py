@@ -306,6 +306,10 @@ class BatchScheduler:
         # HBM pressure state machine (ok/soft/hard), advanced by submit-
         # side watermark checks against memwatch.pressure().  owner: _lock
         self._hbm_state = "ok"
+        # Latest program-table attribution (engine.programs_snapshot at a
+        # batch boundary); None until a multi-program engine dispatches.
+        # Written on the owner thread, read by snapshot()/debug surfaces.
+        self._last_programs = None
         # Device circuit breaker: repeated device-engine failures flip
         # batch routing to the host DFA path until a timed probe proves
         # the device healthy again.  Transitions are audited through the
@@ -1162,6 +1166,12 @@ class BatchScheduler:
             # cache; they never fail a batch that already scanned.
             for (_, data), sec in zip(combined, results):
                 self.result_cache.put(content_digest(data), digest, sec)
+        # Multi-program attribution: when this batch's engine demuxes a
+        # program table, snapshot per-program counters at the batch
+        # boundary — explain rides it below, /debug/programs reads the
+        # latest one (plain assignment; read under _lock elsewhere).
+        if getattr(engine, "program_table", None) is not None:
+            self._last_programs = engine.programs_snapshot()
         for t, (lo, hi), wait in zip(batch, spans, waits):
             scanned = results[lo:hi]
             if t.cache_hits:
@@ -1219,6 +1229,11 @@ class BatchScheduler:
                         "engine_path": engine_path,
                     },
                 }
+                # Which programs shared this batch's device pass and what
+                # each contributed (programs/base.py demux).  Absent on
+                # secret-only engines — the key's presence IS the signal.
+                if getattr(engine, "program_table", None) is not None:
+                    out.explain["programs"] = self._last_programs
             self._resolve_ticket(t, out)
 
     # -- hot reload ------------------------------------------------------
@@ -1284,6 +1299,10 @@ class BatchScheduler:
                 "occupancy": mesh_topology.occupancy_snapshot(),
             },
         }
+        if self._last_programs is not None:
+            # Program-table posture: which programs share the device pass
+            # and their cumulative demux counters (last batch boundary).
+            out["programs"] = self._last_programs
         if faults.active():
             out["faults"] = faults.snapshot()
         if self.result_cache is not None:

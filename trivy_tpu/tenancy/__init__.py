@@ -22,6 +22,8 @@ from trivy_tpu.tenancy.pool import (
     PoolStats,
     ResidentRulesetPool,
     UnknownRulesetError,
+    slot_key,
+    split_slot_key,
 )
 from trivy_tpu.tenancy.qos import (
     QosStats,
@@ -38,4 +40,6 @@ __all__ = [
     "TenantQuota",
     "TokenBucket",
     "UnknownRulesetError",
+    "slot_key",
+    "split_slot_key",
 ]

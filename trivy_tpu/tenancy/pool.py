@@ -50,6 +50,29 @@ class UnknownRulesetError(RuntimeError):
     never retried."""
 
 
+def slot_key(digest: str, program: str = "secret") -> str:
+    """Pool-slot identity for (program table, ruleset digest).
+
+    The secret program keeps the bare digest — every existing loader,
+    metric label, and memwatch attribution line stays byte-identical.
+    Other program lanes prefix `<program>+` so one tenant's secret engine
+    and its multi-program engine over the SAME ruleset digest occupy
+    distinct slots (different merged rule axes, different device bytes).
+    """
+    if program == "secret":
+        return digest
+    return f"{program}+{digest}"
+
+
+def split_slot_key(key: str) -> tuple[str, str]:
+    """Inverse of slot_key: (program, digest).  Digests are hex/`sha256:`
+    strings, so the first "+" is unambiguous."""
+    if "+" in key:
+        program, digest = key.split("+", 1)
+        return program, digest
+    return "secret", key
+
+
 @dataclass
 class PoolStats:
     """Monotonic counters (mutated under the pool lock; read freely)."""
@@ -105,10 +128,19 @@ class ResidentRulesetPool:
 
     # -- admission (request threads) --------------------------------------
 
-    def ensure(self, digest: str, timeout_s: float = 300.0) -> None:
+    def ensure(
+        self,
+        digest: str,
+        timeout_s: float = 300.0,
+        program: str = "secret",
+    ) -> None:
         """Make `digest` resident (or raise UnknownRulesetError).  The
         expensive build runs outside the pool lock; concurrent callers for
-        the same digest block on the builder's Future."""
+        the same digest block on the builder's Future.  `program` selects
+        the program-table lane (slot_key): non-secret lanes reach the
+        loader with the composite key — split_slot_key recovers the pair.
+        """
+        digest = slot_key(digest, program)
         with self._lock:
             slot = self._slots.get(digest)
             if slot is not None:
@@ -222,11 +254,15 @@ class ResidentRulesetPool:
 
     # -- dispatch (engine-owner thread) -----------------------------------
 
-    def engine_for_dispatch(self, digest: str) -> tuple[object, str, int]:
+    def engine_for_dispatch(
+        self, digest: str, program: str = "secret"
+    ) -> tuple[object, str, int]:
         """Resolve (engine, digest, epoch) for a batch.  Installs anything
         the slot's manager has staged — this IS the batch boundary.  If the
         digest was evicted after admission (budget pressure from other
-        tenants), re-admit it here via the loader's warm path."""
+        tenants), re-admit it here via the loader's warm path.  `program`
+        selects the slot lane exactly as in ensure()."""
+        digest = slot_key(digest, program)
         with self._lock:
             slot = self._slots.get(digest)
             if slot is not None:
