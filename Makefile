@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test lint lint-changed lockcheck smoke serve-smoke obs-smoke slo-smoke tenancy-smoke mem-smoke chaos-smoke mesh-smoke cache-smoke kernel-smoke fleet-smoke program-smoke bench bench-link bench-verify checks-corpus rules-cache perf-gate
+.PHONY: test lint lint-changed lockcheck smoke serve-smoke obs-smoke slo-smoke tenancy-smoke mem-smoke chaos-smoke mesh-smoke cache-smoke kernel-smoke fleet-smoke program-smoke watch-smoke bench bench-link bench-verify checks-corpus rules-cache perf-gate
 
 # Tier-1: the suite the driver holds the repo to (fast, CPU, no slow marks).
 # Lint runs first — a graftlint finding fails the build before pytest
@@ -18,9 +18,10 @@ test: lint
 	$(MAKE) kernel-smoke
 	$(MAKE) fleet-smoke
 	$(MAKE) program-smoke
+	$(MAKE) watch-smoke
 	$(MAKE) perf-gate
 
-# Static analysis: graftlint (project rules GL001-GL014, always available)
+# Static analysis: graftlint (project rules GL001-GL015, always available)
 # plus ruff + mypy when the environment has them (the pinned CI container
 # may not; config lives in pyproject.toml either way).
 lint:
@@ -82,7 +83,7 @@ obs-smoke:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
 		BENCH_IMAGE=0 BENCH_TENANT=0 BENCH_MEM=0 BENCH_FAULT=0 \
-		BENCH_MULTICHIP=0 BENCH_CACHE=0 BENCH_FLEET=0 BENCH_PROGRAMS=0 \
+		BENCH_MULTICHIP=0 BENCH_CACHE=0 BENCH_FLEET=0 BENCH_PROGRAMS=0 BENCH_DELTA=0 \
 		$(PY) bench.py --smoke
 
 # SLO / flight-recorder smoke: boot the server with a deliberately tight
@@ -107,7 +108,7 @@ tenancy-smoke:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
 		BENCH_IMAGE=0 BENCH_OBS=0 BENCH_MEM=0 BENCH_FAULT=0 \
-		BENCH_MULTICHIP=0 BENCH_CACHE=0 BENCH_FLEET=0 BENCH_PROGRAMS=0 \
+		BENCH_MULTICHIP=0 BENCH_CACHE=0 BENCH_FLEET=0 BENCH_PROGRAMS=0 BENCH_DELTA=0 \
 		$(PY) bench.py --smoke
 
 # Device-memory observatory smoke: memwatch ledger units, pool
@@ -121,7 +122,7 @@ mem-smoke:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
 		BENCH_IMAGE=0 BENCH_TENANT=0 BENCH_OBS=0 BENCH_FAULT=0 \
-		BENCH_MULTICHIP=0 BENCH_CACHE=0 BENCH_FLEET=0 BENCH_PROGRAMS=0 \
+		BENCH_MULTICHIP=0 BENCH_CACHE=0 BENCH_FLEET=0 BENCH_PROGRAMS=0 BENCH_DELTA=0 \
 		$(PY) bench.py --smoke
 
 # Chaos smoke: the fault-injection serve suite (tests/test_chaos_serve.py,
@@ -153,7 +154,7 @@ cache-smoke:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
 		BENCH_IMAGE=0 BENCH_TENANT=0 BENCH_OBS=0 BENCH_MEM=0 \
-		BENCH_FAULT=0 BENCH_MULTICHIP=0 BENCH_FLEET=0 BENCH_PROGRAMS=0 \
+		BENCH_FAULT=0 BENCH_MULTICHIP=0 BENCH_FLEET=0 BENCH_PROGRAMS=0 BENCH_DELTA=0 \
 		$(PY) bench.py --smoke
 
 # Megakernel smoke (ops/megakernel.py + registry/aotcache.py): parity
@@ -179,7 +180,7 @@ fleet-smoke:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
 		BENCH_IMAGE=0 BENCH_TENANT=0 BENCH_OBS=0 BENCH_MEM=0 \
-		BENCH_FAULT=0 BENCH_MULTICHIP=0 BENCH_CACHE=0 BENCH_PROGRAMS=0 \
+		BENCH_FAULT=0 BENCH_MULTICHIP=0 BENCH_CACHE=0 BENCH_PROGRAMS=0 BENCH_DELTA=0 \
 		$(PY) bench.py --smoke
 
 # Device scan-program smoke (trivy_tpu/programs/): the multi-program
@@ -191,6 +192,22 @@ fleet-smoke:
 program-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_programs.py \
 		-m program_smoke -q -p no:cacheprovider
+
+# Continuous-scanning-plane smoke (trivy_tpu/watch/): poller dedupe,
+# zero-dispatch planning on a re-pushed identical image, the
+# re-verification sweep touching only invalidated verdicts, webhook
+# at-least-once under injected rpc.recv/watch.poll faults, JSONL
+# ordering — then a BENCH_DELTA-only bench run (warm_dispatches 0,
+# sweep_touched_ratio 0.5, byte-identical re-verdicts on the
+# single-JSON-line contract).
+watch-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_watch.py \
+		-q -p no:cacheprovider && \
+	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
+		BENCH_LINK=0 BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 \
+		BENCH_IMAGE=0 BENCH_TENANT=0 BENCH_OBS=0 BENCH_MEM=0 \
+		BENCH_FAULT=0 BENCH_MULTICHIP=0 BENCH_CACHE=0 BENCH_FLEET=0 \
+		BENCH_PROGRAMS=0 $(PY) bench.py --smoke
 
 # Performance regression gate: one smoke bench run (heavy sections off,
 # primary corpus only) appends to a throwaway ledger, then
@@ -221,7 +238,7 @@ bench-link:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_HITDENSE=0 \
 		BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 BENCH_IMAGE=0 \
 		BENCH_TENANT=0 BENCH_FAULT=0 BENCH_MULTICHIP=0 BENCH_CACHE=0 \
-		BENCH_FLEET=0 BENCH_PROGRAMS=0 BENCH_FILES=2000 BENCH_PARITY=sample \
+		BENCH_FLEET=0 BENCH_PROGRAMS=0 BENCH_DELTA=0 BENCH_FILES=2000 BENCH_PARITY=sample \
 		$(PY) bench.py
 
 # Verify-backend economics only: the hit-dense corpus under host-DFA vs
@@ -233,7 +250,7 @@ bench-verify:
 	BENCH_KERNEL=0 BENCH_RULE_SCALING=0 BENCH_DEVICE=0 BENCH_LINK=0 \
 		BENCH_SERVE=0 BENCH_COLDSTART=0 BENCH_LICENSE=0 BENCH_IMAGE=0 \
 		BENCH_TENANT=0 BENCH_MEM=0 BENCH_FAULT=0 BENCH_MULTICHIP=0 \
-		BENCH_CACHE=0 BENCH_FLEET=0 BENCH_PROGRAMS=0 $(PY) bench.py --smoke
+		BENCH_CACHE=0 BENCH_FLEET=0 BENCH_PROGRAMS=0 BENCH_DELTA=0 $(PY) bench.py --smoke
 
 # Precompile the builtin ruleset into the registry cache (trivy_tpu/registry/)
 # so every later scan/server process warm-starts without compiling rules.

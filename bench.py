@@ -1043,6 +1043,153 @@ def bench_cache(n_layers: int = 12, files_per_layer: int = 40) -> dict:
     }
 
 
+def bench_delta(n_blobs: int = 24, plant_every: int = 5) -> dict:
+    """Continuous scanning plane (trivy_tpu/watch/): delta-dispatch and
+    re-verification sweep economics.  A re-pushed byte-identical image
+    must cost zero fetches and zero device dispatches — the planner
+    proves every blob's verdict already exists before any bytes move —
+    and a ruleset push must re-scan only its own invalidated verdicts
+    (touched ratio 0.5 on a corpus where half the entries sit under a
+    pinned digest), with each re-verdict byte-identical to a cold scan
+    of the same bytes."""
+    from trivy_tpu.cache import MemoryCache
+    from trivy_tpu.cache.results import ScanResultCache, content_digest
+    from trivy_tpu.cache.tiered import TieredCache
+    from trivy_tpu.engine.hybrid import make_secret_engine
+    from trivy_tpu.registry.digest import engine_digest
+    from trivy_tpu.watch import (
+        ChangeRecord,
+        ContentStore,
+        DeltaPlanner,
+        ReverifySweeper,
+    )
+
+    engine = make_secret_engine(backend="auto")
+    active_digest = engine_digest(engine)
+
+    # Synthetic layer blobs: mostly clean config text, a planted AWS key
+    # every `plant_every` blobs (same idiom as _synth_docker_archive).
+    blobs: list[tuple[str, bytes]] = []
+    planted = 0
+    for i in range(n_blobs):
+        body = (b"# layer %d\n" % i) + b"key = value\n" * 40
+        if i % plant_every == 0:
+            body += (
+                b"\nAWS_ACCESS_KEY_ID=AKIA"
+                + (b"%016d" % i).replace(b"0", b"Q")
+                + b"\n"
+            )
+            planted += 1
+        blobs.append((content_digest(body), body))
+    by_digest = dict(blobs)
+
+    counters = {"scan_calls": 0, "scan_items": 0, "fetches": 0}
+
+    def scan_fn(items):
+        counters["scan_calls"] += 1
+        counters["scan_items"] += len(items)
+        return engine.scan_batch(items)
+
+    def _fetch(digest: str) -> bytes:
+        counters["fetches"] += 1
+        return by_digest[digest]
+
+    def resolve_fn(record):
+        return [(d, lambda d=d: _fetch(d)) for d, _ in blobs]
+
+    result_cache = ScanResultCache(
+        TieredCache([MemoryCache()], write_behind=False)
+    )
+    store = ContentStore(max_bytes=64 << 20)
+    verdicts: dict[str, object] = {}
+    planner = DeltaPlanner(
+        result_cache,
+        scan_fn=scan_fn,
+        ruleset_digest_fn=lambda: active_digest,
+        resolve_fn=resolve_fn,
+        content_store=store,
+        on_verdict=lambda rec, blob, v: verdicts.__setitem__(blob, v),
+    )
+
+    # Cold push: every blob is novel — one fetch + one dispatch each.
+    t0 = time.perf_counter()
+    cold = planner.handle(
+        ChangeRecord("reg.local/app", "v1", "sha256:manifest-v1", "bench")
+    )
+    cold_wall = time.perf_counter() - t0
+    assert cold["dispatched"] == n_blobs, cold
+    findings = sum(len(v.findings) for v in verdicts.values())
+    assert findings >= planted, (findings, planted)
+
+    # Identical re-push under a new tag/manifest: N existence probes,
+    # zero fetches, zero scans, zero dispatches.
+    scans_before, fetches_before = counters["scan_calls"], counters["fetches"]
+    t0 = time.perf_counter()
+    warm = planner.handle(
+        ChangeRecord("reg.local/app", "v2", "sha256:manifest-v2", "bench")
+    )
+    warm_wall = time.perf_counter() - t0
+    warm_scans = counters["scan_calls"] - scans_before
+    warm_fetches = counters["fetches"] - fetches_before
+
+    # Mixed corpus for the sweep: the same verdicts also cached under a
+    # pinned ruleset digest (another tenant's pinned rules) that a push
+    # of the active ruleset must never touch.
+    pinned_digest = "sha256:" + "ee" * 32
+    for blob_digest, verdict in verdicts.items():
+        result_cache.put(blob_digest, pinned_digest, verdict)
+    corpus_total = len(result_cache.indexed_blobs(active_digest)) + len(
+        result_cache.indexed_blobs(pinned_digest)
+    )
+
+    new_digest = "sha256:" + "ff" * 32
+    sweeper = ReverifySweeper(
+        result_cache,
+        scan_fn=lambda items, _digest: scan_fn(items),
+        content_store=store,
+    )
+    t0 = time.perf_counter()
+    summary = sweeper.sweep(active_digest, new_digest)
+    sweep_wall = time.perf_counter() - t0
+    assert summary["failures"] == 0, summary
+    pinned_intact = int(
+        len(result_cache.indexed_blobs(pinned_digest)) == n_blobs
+        and len(result_cache.indexed_blobs(active_digest)) == 0
+    )
+
+    # Parity: every swept verdict byte-identical to a direct cold scan
+    # of the same blob bytes.
+    parity = 1
+    for blob_digest, data in blobs:
+        swept = result_cache.get(
+            blob_digest, new_digest, path=blob_digest
+        )
+        direct = engine.scan_batch([(blob_digest, data)])[0]
+        if swept is None or [f.to_json() for f in swept.findings] != [
+            f.to_json() for f in direct.findings
+        ]:
+            parity = 0
+
+    return {
+        "blobs": n_blobs,
+        "planted": planted,
+        "findings": findings,
+        "cold_dispatches": cold["dispatched"],
+        "cold_wall_s": round(cold_wall, 3),
+        "warm_dispatches": warm["dispatched"],
+        "warm_scan_calls": warm_scans,
+        "warm_fetches": warm_fetches,
+        "warm_wall_s": round(warm_wall, 3),
+        "planner_hit_rate": round(planner.snapshot()["hit_rate"] or 0.0, 3),
+        "sweep_touched": summary["touched"],
+        "sweep_corpus": corpus_total,
+        "sweep_touched_ratio": round(summary["touched"] / corpus_total, 3),
+        "sweep_wall_s": round(sweep_wall, 3),
+        "pinned_intact": pinned_intact,
+        "parity_identical": parity,
+    }
+
+
 def bench_device_engine(
     n_files: int = 10000, max_batch_tiles: int | None = None
 ) -> dict:
@@ -2262,6 +2409,17 @@ def _compact_detail(detail: dict) -> dict:
             )
             if k in pg
         }
+    dl = detail.get("delta")
+    if isinstance(dl, dict):
+        c["delta"] = {
+            k: dl[k]
+            for k in (
+                "warm_dispatches", "warm_scan_calls", "warm_fetches",
+                "sweep_touched_ratio", "pinned_intact",
+                "parity_identical", "planner_hit_rate", "error",
+            )
+            if k in dl
+        }
     vb = detail.get("verify_backend")
     if isinstance(vb, dict):
         vc = {
@@ -2575,6 +2733,18 @@ def main() -> None:
             detail["fleet"] = bench_fleet()
         except Exception as e:
             detail["fleet"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if os.environ.get("BENCH_DELTA", "1") == "1":
+        # Continuous scanning plane (trivy_tpu/watch/): re-pushed
+        # identical image -> zero dispatches/fetches; ruleset push ->
+        # sweep touches only invalidated verdicts, byte-identical
+        # re-verdicts (perf-gate rows detail.delta.*).
+        try:
+            detail["delta"] = (
+                bench_delta(n_blobs=12) if SMOKE else bench_delta()
+            )
+        except Exception as e:
+            detail["delta"] = {"error": f"{type(e).__name__}: {e}"}
 
     try:
         import resource

@@ -278,6 +278,27 @@ def test_negative_entry_ttl():
     tc.close()
 
 
+def test_exists_memory_hit_never_touches_remote_tier():
+    """Watch-planner novelty probes come in bulk; an exists() answered
+    by the memory tier must short-circuit — zero remote I/O, so bulk
+    probing can never burn a flaky remote tier's error budget."""
+    remote = _FlakyCache()
+    tc = TieredCache(
+        [MemoryCache(), remote], write_behind=False, negative_ttl_s=0
+    )
+    tc.put_blob("k1", _blob("sha256:warm"))
+    calls_after_put = remote.calls
+    for _ in range(5):
+        assert tc.exists("k1") is True
+    assert remote.calls == calls_after_put  # short-circuited every probe
+    assert cache_stats.request_tallies()[("memory", "hit")] == 5
+    # A genuine miss still walks outward to the remote tier.
+    assert tc.exists("k-missing") is False
+    assert remote.calls == calls_after_put + 1
+    assert cache_stats.request_tallies()[("remote", "miss")] == 1
+    tc.close()
+
+
 def test_single_flight_dedups_concurrent_misses():
     tc = TieredCache([MemoryCache()], write_behind=False)
     calls = []

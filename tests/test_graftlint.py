@@ -28,7 +28,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(ROOT, "tools", "graftlint", "fixtures")
 ALL_RULES = (
     "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007", "GL008",
-    "GL009", "GL010", "GL011", "GL012", "GL013", "GL014",
+    "GL009", "GL010", "GL011", "GL012", "GL013", "GL014", "GL015",
 )
 
 
@@ -79,6 +79,7 @@ def test_deny_fixture_counts_stable():
         "GL012": 4,
         "GL013": 3,
         "GL014": 4,
+        "GL015": 5,
     }
 
 

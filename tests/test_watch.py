@@ -1,0 +1,612 @@
+"""Continuous scanning plane (trivy_tpu/watch/): event-source dedupe,
+the delta planner's zero-dispatch warm path, re-verification sweeps
+that touch exactly the invalidated verdicts, the result-cache reverse
+index (including its negative-entry interaction), and the verdict-delta
+stream's ordering + at-least-once webhook delivery under injected
+faults.
+
+`make watch-smoke` runs this file; it is all in-process (fake sources,
+fake resolvers, deterministic scan functions) — the real-engine parity
+ride lives in bench.py's BENCH_DELTA section.
+"""
+
+import json
+import threading
+
+import pytest
+
+from trivy_tpu import faults
+from trivy_tpu.cache import (
+    MemoryCache,
+    ScanResultCache,
+    TieredCache,
+    content_digest,
+)
+from trivy_tpu.cache.results import index_key, result_key
+from trivy_tpu.ftypes import Code, Secret, SecretFinding
+from trivy_tpu.rpc.client import RpcClient
+from trivy_tpu.watch import (
+    ChangeRecord,
+    ContentStore,
+    DeltaPlanner,
+    FeedTailer,
+    RegistryTagPoller,
+    ReverifySweeper,
+    VerdictDeltaStream,
+    WatchConfigError,
+    WatchService,
+    WebhookEmitter,
+    diff_findings,
+    parse_watch_config,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _finding(rule_id: str, line: int = 1, match: str = "m") -> SecretFinding:
+    return SecretFinding(
+        rule_id=rule_id,
+        category="general",
+        severity="CRITICAL",
+        title=rule_id,
+        start_line=line,
+        end_line=line,
+        code=Code(),
+        match=match,
+    )
+
+
+def _result_cache() -> ScanResultCache:
+    return ScanResultCache(TieredCache([MemoryCache()], write_behind=False))
+
+
+def _fake_scan(items, ruleset="sha256:rules-v1"):
+    """Deterministic fake engine: one finding per blob derived from the
+    content digest and the ruleset — byte-identical for equal inputs."""
+    return [
+        Secret(
+            file_path=path,
+            findings=[
+                _finding(f"r-{content_digest(data)[7:15]}-{ruleset[-2:]}")
+            ],
+        )
+        for path, data in items
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Event sources
+# ---------------------------------------------------------------------------
+
+
+class _FakeRegistry:
+    """RegistryClient stand-in: tags dict drives list_tags/subject_digest."""
+
+    def __init__(self, tags: dict):
+        self.tags = dict(tags)
+
+    def list_tags(self, ref):
+        return sorted(self.tags)
+
+    def subject_digest(self, ref):
+        return self.tags[ref.tag]
+
+
+def test_tag_poller_dedupes_unchanged_tags():
+    client = _FakeRegistry({"v1": "sha256:" + "aa" * 32})
+    src = RegistryTagPoller("localhost:5000/team/app", client=client)
+    first = src.poll()
+    assert [(r.repo, r.tag, r.digest) for r in first] == [
+        ("localhost:5000/team/app", "v1", "sha256:" + "aa" * 32)
+    ]
+    # Unchanged tag list: zero records, dedupe counted.
+    assert src.poll() == []
+    assert src.deduped == 1
+    # Re-push under the same tag (new digest) surfaces exactly once.
+    client.tags["v1"] = "sha256:" + "bb" * 32
+    again = src.poll()
+    assert [r.digest for r in again] == ["sha256:" + "bb" * 32]
+    assert src.poll() == []
+    assert src.snapshot()["emitted"] == 2
+
+
+def test_poll_fault_emits_nothing_and_advances_nothing():
+    """A faulted poll must not mark changes as seen — the next healthy
+    poll re-surfaces them (the at-least-once root)."""
+    client = _FakeRegistry({"v1": "sha256:" + "cc" * 32})
+    src = RegistryTagPoller("localhost:5000/team/app", client=client)
+    faults.configure("watch.poll:error@1x2")
+    assert src.poll() == []
+    assert src.poll() == []
+    assert src.errors == 2 and "injected" in src.last_error
+    # Third poll is healthy: the change arrives late, not never.
+    assert [r.tag for r in src.poll()] == ["v1"]
+
+
+def test_feed_tailer_tails_only_complete_lines(tmp_path):
+    feed = tmp_path / "events.jsonl"
+    rec = {"repo": "reg.local/app", "tag": "v1", "digest": "sha256:" + "dd" * 32}
+    feed.write_text(json.dumps(rec) + "\n" + "not json\n")
+    src = FeedTailer(str(feed))
+    out = src.poll()
+    assert [(r.repo, r.tag, r.digest) for r in out] == [
+        ("reg.local/app", "v1", rec["digest"])
+    ]
+    assert src.malformed == 1
+    # A torn (unterminated) line stays unconsumed until its newline lands.
+    with open(feed, "a", encoding="utf-8") as f:
+        f.write('{"repo": "reg.local/app", "tag": "v2"')
+    assert src.poll() == []
+    with open(feed, "a", encoding="utf-8") as f:
+        f.write(', "digest": "sha256:' + "ee" * 32 + '"}\n')
+    assert [r.tag for r in src.poll()] == ["v2"]
+
+
+# ---------------------------------------------------------------------------
+# Delta planner
+# ---------------------------------------------------------------------------
+
+
+def _resolver(layers: dict, fetches: list):
+    """resolve_fn over a {blob_digest: bytes} image; records fetches."""
+
+    def resolve(record):
+        def fetch(d):
+            fetches.append(d)
+            return layers[d]
+
+        return [(d, lambda d=d: fetch(d)) for d in sorted(layers)]
+
+    return resolve
+
+
+def test_planner_repush_identical_image_zero_dispatches():
+    """The headline economics: a re-pushed identical image costs
+    existence probes only — no fetches, no dispatches, no analyzer
+    runs."""
+    rc = _result_cache()
+    layers = {
+        content_digest(b"layer one bytes"): b"layer one bytes",
+        content_digest(b"layer two bytes"): b"layer two bytes",
+    }
+    fetches: list = []
+    dispatched: list = []
+
+    def scan_fn(items):
+        dispatched.extend(p for p, _ in items)
+        return _fake_scan(items)
+
+    planner = DeltaPlanner(
+        rc,
+        scan_fn,
+        lambda: "sha256:rules-v1",
+        _resolver(layers, fetches),
+        content_store=ContentStore(1 << 20),
+    )
+    cold = planner.handle(
+        ChangeRecord("reg.local/app", "v1", "sha256:" + "11" * 32)
+    )
+    assert cold["dispatched"] == 2 and cold["novel"] == 2
+    assert len(fetches) == 2 and len(dispatched) == 2
+    # Same image re-pushed under a new tag: all blobs already verdicted.
+    warm = planner.handle(
+        ChangeRecord("reg.local/app", "v2", "sha256:" + "22" * 32)
+    )
+    assert warm["dispatched"] == 0 and warm["cached"] == 2
+    assert len(fetches) == 2 and len(dispatched) == 2  # unchanged
+    snap = planner.snapshot()
+    assert snap["blobs_cached"] == 2 and snap["hit_rate"] == 0.5
+
+
+def test_planner_ruleset_change_makes_blobs_novel_again():
+    rc = _result_cache()
+    layers = {content_digest(b"blob"): b"blob"}
+    fetches: list = []
+    active = ["sha256:rules-v1"]
+    planner = DeltaPlanner(
+        rc, _fake_scan, lambda: active[0], _resolver(layers, fetches)
+    )
+    rec = ChangeRecord("reg.local/app", "v1", "sha256:" + "33" * 32)
+    assert planner.handle(rec)["dispatched"] == 1
+    active[0] = "sha256:rules-v2"  # rules push: verdicts keyed elsewhere
+    rec2 = ChangeRecord("reg.local/app", "v1", "sha256:" + "44" * 32)
+    assert planner.handle(rec2)["dispatched"] == 1
+
+
+def test_planner_resolve_error_is_absorbed():
+    rc = _result_cache()
+
+    def bad_resolve(record):
+        raise ConnectionError("registry down")
+
+    planner = DeltaPlanner(rc, _fake_scan, lambda: "sha256:r", bad_resolve)
+    out = planner.handle(ChangeRecord("x", "v1", "sha256:" + "55" * 32))
+    assert out["errors"] == 1 and planner.snapshot()["resolve_errors"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Result-cache reverse index (satellite: per-(ruleset, program) key index)
+# ---------------------------------------------------------------------------
+
+
+def test_result_index_tracks_puts_and_removes():
+    rc = _result_cache()
+    b1, b2 = content_digest(b"one"), content_digest(b"two")
+    rc.put(b1, "sha256:rv1", Secret(file_path=b1))
+    rc.put(b2, "sha256:rv1", Secret(file_path=b2))
+    rc.put(b1, "sha256:rv2", Secret(file_path=b1))
+    assert rc.indexed_blobs("sha256:rv1") == sorted([b1, b2])
+    assert rc.indexed_blobs("sha256:rv2") == [b1]
+    rc.remove(b1, "sha256:rv1")
+    assert rc.indexed_blobs("sha256:rv1") == [b2]
+    assert rc.get(b1, "sha256:rv1", b1) is None  # entry gone too
+    assert rc.get(b1, "sha256:rv2", b1) is not None  # other digest intact
+    assert index_key("sha256:rv1") != result_key(b1, "sha256:rv1")
+
+
+def test_result_index_negative_entry_does_not_mask_sweep():
+    """A miss-probe plants a negative entry for the result AND index
+    keys; the subsequent put must pop both so the sweep enumerates the
+    blob (a negative entry masking indexed_blobs would silently skip
+    re-verification)."""
+    rc = ScanResultCache(
+        TieredCache([MemoryCache()], write_behind=False, negative_ttl_s=60)
+    )
+    blob = content_digest(b"probed before put")
+    # Plant negatives: verdict probe + index read both miss.
+    assert rc.exists(blob, "sha256:rv1") is False
+    assert rc.get(blob, "sha256:rv1", blob) is None
+    assert rc.indexed_blobs("sha256:rv1") == []
+    rc.put(blob, "sha256:rv1", Secret(file_path=blob))
+    assert rc.exists(blob, "sha256:rv1") is True
+    assert rc.indexed_blobs("sha256:rv1") == [blob]
+
+
+# ---------------------------------------------------------------------------
+# Re-verification sweeper
+# ---------------------------------------------------------------------------
+
+
+def _seed_corpus(rc, store, digests_to_blobs):
+    """Store verdicts + content for {ruleset_digest: {blob: data}}."""
+    for rd, blobs in digests_to_blobs.items():
+        for blob, data in blobs.items():
+            store.put(blob, data)
+            rc.put(blob, rd, _fake_scan([(blob, data)], rd)[0])
+
+
+def test_sweep_touches_only_invalidated_blobs_byte_identical():
+    rc = _result_cache()
+    store = ContentStore(1 << 20)
+    old_blobs = {
+        content_digest(b"app layer a"): b"app layer a",
+        content_digest(b"app layer b"): b"app layer b",
+    }
+    pinned_blobs = {content_digest(b"tenant pin"): b"tenant pin"}
+    _seed_corpus(
+        rc, store,
+        {"sha256:rv1": old_blobs, "sha256:pinned": pinned_blobs},
+    )
+    scanned: list = []
+
+    def sweep_scan(items, ruleset_digest):
+        scanned.extend(p for p, _ in items)
+        return _fake_scan(items, ruleset_digest)
+
+    deltas: list = []
+    sweeper = ReverifySweeper(
+        rc, sweep_scan, store,
+        on_verdict=lambda b, old, new: deltas.append((b, old, new)),
+    )
+    summary = sweeper.sweep("sha256:rv1", "sha256:rv2")
+    # Exactly the invalidated corpus was re-scanned.
+    assert summary["touched"] == 2 and summary["failures"] == 0
+    assert sorted(scanned) == sorted(old_blobs)
+    assert summary["touched_ratio"] == 1.0
+    # Old entries retired, new entries live, pinned digest untouched.
+    assert rc.indexed_blobs("sha256:rv1") == []
+    assert rc.indexed_blobs("sha256:rv2") == sorted(old_blobs)
+    assert rc.indexed_blobs("sha256:pinned") == sorted(pinned_blobs)
+    # Byte-identical to a cold scan of the same bytes under the new rules.
+    for blob, data in old_blobs.items():
+        swept = rc.get(blob, "sha256:rv2", blob)
+        cold = _fake_scan([(blob, data)], "sha256:rv2")[0]
+        assert [f.to_json() for f in swept.findings] == [
+            f.to_json() for f in cold.findings
+        ]
+    assert len(deltas) == 2
+
+
+def test_sweep_missing_content_drops_stale_entry():
+    rc = _result_cache()
+    store = ContentStore(1 << 20)
+    blob = content_digest(b"evicted bytes")
+    rc.put(blob, "sha256:rv1", Secret(file_path=blob))  # content never stored
+    sweeper = ReverifySweeper(
+        rc, lambda items, d: _fake_scan(items, d), store
+    )
+    summary = sweeper.sweep("sha256:rv1", "sha256:rv2")
+    assert summary["missing_content"] == 1 and summary["touched"] == 0
+    # The stale old-ruleset verdict is dropped, not kept: the blob will
+    # re-scan as novel on its next change event.
+    assert rc.indexed_blobs("sha256:rv1") == []
+    assert rc.exists(blob, "sha256:rv1") is False
+
+
+def test_sweep_skips_blobs_already_reverdicted():
+    rc = _result_cache()
+    store = ContentStore(1 << 20)
+    blob = content_digest(b"raced")
+    store.put(blob, b"raced")
+    rc.put(blob, "sha256:rv1", Secret(file_path=blob))
+    rc.put(blob, "sha256:rv2", Secret(file_path=blob))  # a scan raced us
+    sweeper = ReverifySweeper(
+        rc, lambda items, d: _fake_scan(items, d), store
+    )
+    summary = sweeper.sweep("sha256:rv1", "sha256:rv2")
+    assert summary["skipped_current"] == 1 and summary["touched"] == 0
+    assert summary["touched_ratio"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Verdict-delta stream
+# ---------------------------------------------------------------------------
+
+
+def test_diff_findings_added_removed_changed():
+    old = Secret(findings=[_finding("A"), _finding("B", line=2)])
+    new = Secret(
+        findings=[_finding("A", match="moved"), _finding("C", line=3)]
+    )
+    added, removed, changed = diff_findings(old, new)
+    assert [f["RuleID"] for f in added] == ["C"]
+    assert [f["RuleID"] for f in removed] == ["B"]
+    assert [f["RuleID"] for f in changed] == ["A"]
+
+
+def test_stream_jsonl_order_is_seq_order(tmp_path):
+    path = tmp_path / "deltas.jsonl"
+    stream = VerdictDeltaStream(jsonl_path=str(path))
+    blobs = [content_digest(f"blob {i}".encode()) for i in range(24)]
+
+    def publish(i):
+        stream.publish(
+            f"reg.local/app:v{i}", blobs[i],
+            Secret(findings=[_finding(f"r{i}")]),
+        )
+
+    threads = [
+        threading.Thread(target=publish, args=(i,)) for i in range(24)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert [ln["seq"] for ln in lines] == list(range(1, 25))
+    assert stream.snapshot()["published"] == 24
+
+
+def test_stream_unchanged_verdict_is_not_news(tmp_path):
+    stream = VerdictDeltaStream(jsonl_path=str(tmp_path / "d.jsonl"))
+    blob = content_digest(b"stable")
+    v = Secret(findings=[_finding("A")])
+    first = stream.publish("img:v1", blob, v)
+    assert first is not None and [f["RuleID"] for f in first["added"]] == ["A"]
+    # Re-verdict with identical findings: no event, no seq burn.
+    assert stream.publish("img:v2", blob, Secret(findings=[_finding("A")])) is None
+    assert stream.snapshot()["unchanged"] == 1
+    # A finding disappearing IS news.
+    third = stream.publish("img:v3", blob, Secret(findings=[]))
+    assert third is not None and [f["RuleID"] for f in third["removed"]] == ["A"]
+    assert third["seq"] == 2
+
+
+def test_webhook_at_least_once_under_recv_faults(monkeypatch):
+    """Injected rpc.recv resets must cost retries, never events: every
+    published event lands at the endpoint despite two resets per call
+    budgeted across the inner RpcClient loop."""
+    received: list = []
+
+    def transport(self, url, body, headers):
+        faults.fire("rpc.recv")
+        received.append(json.loads(body))
+        return 200, {}, b"{}"
+
+    monkeypatch.setattr(RpcClient, "_transport", transport)
+    monkeypatch.setattr(RpcClient, "sleep", staticmethod(lambda s: None))
+    monkeypatch.setattr(WebhookEmitter, "sleep", staticmethod(lambda s: None))
+    faults.configure("rpc.recv:reset@1x4")
+    emitter = WebhookEmitter("http://hooks.local:9000/trivy")
+    stream = VerdictDeltaStream(emitter=emitter)
+    for i in range(3):
+        stream.publish(
+            "img:v1", content_digest(f"b{i}".encode()),
+            Secret(findings=[_finding(f"r{i}")]),
+        )
+    assert stream.flush(timeout_s=10.0)
+    snap = emitter.snapshot()
+    assert snap["delivered"] == 3 and snap["dropped_failed"] == 0
+    assert [e["seq"] for e in received] == [1, 2, 3]
+    stream.close()
+
+
+def test_webhook_outer_budget_survives_full_call_failures(monkeypatch):
+    """When every RpcClient.call fails outright (reset storm past the
+    inner retry cap), the emitter's outer attempt budget re-runs the
+    call and still lands the event."""
+    calls = {"n": 0}
+
+    def flaky_call(self, path, payload):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise ConnectionResetError("endpoint flapping")
+        return {}
+
+    monkeypatch.setattr(RpcClient, "call", flaky_call)
+    monkeypatch.setattr(WebhookEmitter, "sleep", staticmethod(lambda s: None))
+    emitter = WebhookEmitter("hooks.local:9000/trivy", attempts=5)
+    assert emitter.emit({"seq": 1})
+    emitter.flush(timeout_s=10.0)
+    snap = emitter.snapshot()
+    assert snap["delivered"] == 1 and snap["retried"] == 2
+    assert snap["dropped_failed"] == 0
+    emitter.close()
+
+
+def test_webhook_drops_only_after_budget_exhausts(monkeypatch):
+    monkeypatch.setattr(
+        RpcClient, "call",
+        lambda self, path, payload: (_ for _ in ()).throw(
+            ConnectionResetError("dead endpoint")
+        ),
+    )
+    monkeypatch.setattr(WebhookEmitter, "sleep", staticmethod(lambda s: None))
+
+    class _Flight:
+        def __init__(self):
+            self.reasons = []
+
+        def capture(self, **kw):
+            self.reasons.append(kw["reason"])
+
+    flight = _Flight()
+    emitter = WebhookEmitter("hooks.local:9000/t", attempts=3, flight=flight)
+    emitter.emit({"seq": 1})
+    emitter.flush(timeout_s=10.0)
+    snap = emitter.snapshot()
+    assert snap["dropped_failed"] == 1 and snap["retried"] == 3
+    assert any(r.startswith("watch-emit-failed") for r in flight.reasons)
+    emitter.close()
+
+
+# ---------------------------------------------------------------------------
+# WatchService + config
+# ---------------------------------------------------------------------------
+
+
+class _ListSource:
+    def __init__(self, batches):
+        self.batches = list(batches)
+        self.name, self.kind = "fake", "fake"
+
+    def poll(self):
+        return self.batches.pop(0) if self.batches else []
+
+    def snapshot(self):
+        return {"name": self.name, "emitted": 0, "errors": 0}
+
+
+def test_service_poll_once_and_metrics_families():
+    from trivy_tpu.obs.metrics import Registry
+
+    rc = _result_cache()
+    layers = {content_digest(b"svc blob"): b"svc blob"}
+    fetches: list = []
+    store = ContentStore(1 << 20)
+    stream = VerdictDeltaStream()
+    planner = DeltaPlanner(
+        rc, _fake_scan, lambda: "sha256:rv1", _resolver(layers, fetches),
+        content_store=store,
+        on_verdict=lambda rec, b, v: stream.publish(rec.image, b, v),
+    )
+    sweeper = ReverifySweeper(
+        rc, lambda items, d: _fake_scan(items, d), store
+    )
+    rec = ChangeRecord("reg.local/app", "v1", "sha256:" + "66" * 32)
+    svc = WatchService(
+        [_ListSource([[rec], []])], planner, sweeper, stream,
+        content_store=store, poll_interval_s=0.01,
+    )
+    cycle = svc.poll_once()
+    assert cycle["dispatched"] == 1 and cycle["records"] == 1
+    assert svc.poll_once()["records"] == 0
+    registry = Registry()
+    svc.register_collectors(registry)
+    text = registry.render()
+    assert 'trivy_tpu_watch_blobs_total{outcome="novel"} 1' in text
+    assert "trivy_tpu_watch_poll_lag_seconds" in text
+    assert "trivy_tpu_watch_sweep_progress 1" in text
+    snap = svc.snapshot()
+    assert snap["enabled"] and snap["cycles"] == 2
+    assert snap["stream"]["published"] == 1
+    # schedule_sweep refuses no-op transitions.
+    assert svc.schedule_sweep("", "sha256:x") is False
+    assert svc.schedule_sweep("sha256:x", "sha256:x") is False
+    svc.close()
+
+
+def test_parse_watch_config_validates():
+    cfg = parse_watch_config(
+        {
+            "watch": {
+                "poll_interval_s": 5,
+                "sources": [
+                    {"type": "registry", "reference": "r.local/app",
+                     "insecure": True},
+                    {"type": "feed", "path": "/tmp/feed.jsonl"},
+                ],
+                "stream": {"jsonl": "/tmp/d.jsonl",
+                           "webhook": "http://h:1/x"},
+            }
+        }
+    )
+    assert len(cfg.sources) == 2 and cfg.sources[0].insecure
+    assert cfg.stream.webhook_url == "http://h:1/x"
+    assert cfg.poll_interval_s == 5.0
+    with pytest.raises(WatchConfigError):
+        parse_watch_config({"sources": []})
+    with pytest.raises(WatchConfigError):
+        parse_watch_config({"sources": [{"type": "registry"}]})
+    with pytest.raises(WatchConfigError):
+        parse_watch_config({"sources": [{"type": "nope", "path": "x"}]})
+    with pytest.raises(WatchConfigError):
+        parse_watch_config(
+            {"sources": [{"type": "feed", "path": "x"}],
+             "poll_interval_s": 0}
+        )
+
+
+def test_server_embeds_watch_plane(tmp_path):
+    """--watch-config on a server: /debug/watch answers, rules push
+    schedules a sweep, and an unconfigured server reports disabled."""
+    from trivy_tpu.watch.config import (
+        SourceConfig, StreamConfig, WatchConfig,
+    )
+    from trivy_tpu.rpc.server import ScanServer
+
+    feed = tmp_path / "feed.jsonl"
+    feed.write_text("")
+    cfg = WatchConfig(
+        sources=(SourceConfig(kind="feed", path=str(feed)),),
+        stream=StreamConfig(),
+        poll_interval_s=60.0,
+    )
+    cache = TieredCache([MemoryCache()], write_behind=False)
+    srv = ScanServer(
+        cache, result_cache=ScanResultCache(cache), watch_config=cfg
+    )
+    try:
+        report = srv.watch_report()
+        assert report["enabled"] is True
+        assert report["running"] is False  # serve() owns the loop
+        assert report["sources"][0]["kind"] == "feed"
+    finally:
+        srv.watch.close()
+        srv.scheduler.close()
+    # Unconfigured: the debug surface answers with enabled=False.
+    cache2 = TieredCache([MemoryCache()], write_behind=False)
+    srv2 = ScanServer(cache2)
+    try:
+        assert srv2.watch_report() == {"enabled": False}
+    finally:
+        srv2.scheduler.close()
+    # Watch without a result cache is a config error, not a late crash.
+    cache3 = TieredCache([MemoryCache()], write_behind=False)
+    with pytest.raises(ValueError):
+        ScanServer(cache3, watch_config=cfg)

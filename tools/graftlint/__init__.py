@@ -42,6 +42,11 @@ Rule catalogue (each with allow/deny fixtures under fixtures/):
          keyed store), or ProgramTable/build_program_table/
          make_program_engine constructed inside a loop (annotate
          deliberate sites with `# graftlint: program-seam(reason)`)
+  GL015  watch-plane seam: RegistryTagPoller/FeedTailer/WebhookEmitter
+         constructed (or .list_tags called) in engine//serve//rpc/
+         code instead of assembling through watch.build_watch_service
+         (annotate deliberate sites with `# graftlint:
+         watch-seam(reason)`)
 
 The runtime complement is trivy_tpu/lockcheck.py (TRIVY_TPU_LOCKCHECK=1
 lock-order + owner-role sanitizer); graftlint checks what must hold by
@@ -62,6 +67,7 @@ from tools.graftlint import (  # noqa: E402,F401
     rules_robust,
     rules_threads,
     rules_time,
+    rules_watch,
 )
 
 __all__ = ["Finding", "lint_paths", "load_waivers"]

@@ -1,0 +1,24 @@
+"""GL015 allow fixture: the plane assembled through its seam, and
+annotated deliberate sites."""
+
+from trivy_tpu.watch import build_watch_service
+
+
+def through_the_seam(config, result_cache, scan_fn, digest_fn):
+    # The seam: sources/emitter are constructed inside trivy_tpu/watch/,
+    # polls stay on the plane's own loop behind the watch.poll fault seam.
+    service = build_watch_service(
+        config, result_cache, scan_fn=scan_fn, ruleset_digest_fn=digest_fn
+    )
+    return service
+
+
+def annotated_admin_probe(client, ref):
+    tags = client.list_tags(ref)  # graftlint: watch-seam(one-shot admin tag probe, not a poll loop)
+    return tags
+
+
+def injected_source_is_fine(service):
+    # Consuming the plane (polling the assembled service) is the intended
+    # API — only constructing its I/O primitives out-of-plane is the hazard.
+    return service.poll_once()

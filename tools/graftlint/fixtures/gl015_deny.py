@@ -1,0 +1,28 @@
+"""GL015 deny fixture: watch-plane I/O constructed on scan-path code."""
+
+from trivy_tpu.watch import FeedTailer, RegistryTagPoller, WebhookEmitter
+
+
+def poller_on_scheduler_thread(reference):
+    src = RegistryTagPoller(reference)  # GL015: poll I/O off the plane
+    return src.poll()
+
+
+def tailer_inline(path):
+    src = FeedTailer(path)  # GL015: dedupe state fragments per call site
+    return src.poll()
+
+
+def emitter_per_request(event):
+    hook = WebhookEmitter("http://alerts:9000/x")  # GL015: delivery off-plane
+    return hook.emit(event)
+
+
+def empty_seam_reason(client, ref):
+    return client.list_tags(ref)  # graftlint: watch-seam()
+    # GL015: the reason is mandatory — watch-seam() alone fails
+
+
+def enumerate_registry_in_scan_path(client, ref):
+    tags = client.list_tags(ref)  # GL015: polling primitive in a scan path
+    return tags

@@ -1,0 +1,113 @@
+"""Watch-plane seam hygiene.
+
+GL015: the continuous-scanning plane (``trivy_tpu/watch/``) owns event-
+source I/O and webhook emission.  Inside the scan-side runtime packages
+(``trivy_tpu/engine/``, ``trivy_tpu/serve/``, ``trivy_tpu/rpc/``), two
+hazards re-open that boundary:
+
+1. Constructing ``RegistryTagPoller`` / ``FeedTailer`` /
+   ``WebhookEmitter`` directly puts registry polling or HTTP delivery
+   on a scheduler/engine thread: polls bypass the ``watch.poll`` fault
+   seam's accounting, dedupe state fragments across call sites, and a
+   slow registry stalls the dispatch path it was constructed on.  The
+   seam is ``build_watch_service`` (config-driven, sources injectable),
+   which keeps every poll on the watch plane's own loop.
+
+2. Calling ``.list_tags(...)`` outside the watch plane turns a scan
+   path into an unbounded registry enumerator — tag listing is a
+   polling primitive, not a scan primitive, and belongs behind an
+   event source's dedupe map.
+
+A deliberate out-of-plane use (a one-shot admin probe, a test harness)
+is annotated at the call line with a mandatory reason:
+
+    tags = client.list_tags(ref)  # graftlint: watch-seam(one-shot admin probe)
+
+The reason is the reviewable record of why this site may bypass the
+plane.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.graftlint.core import Finding, Module, rule
+
+# The scan-side runtime: engine/serve/rpc.  trivy_tpu/watch/ itself is
+# out of scope by construction (the seam's home implements the seam);
+# commands/ and tests stay out like GL013's scope — the CLI enters
+# through build_watch_service anyway.
+_SCOPED_PREFIXES = (
+    "trivy_tpu/engine/",
+    "trivy_tpu/serve/",
+    "trivy_tpu/rpc/",
+)
+
+_SEAM_RE = re.compile(r"graftlint:.*\bwatch-seam\(([^)]*)\)")
+
+_PLANE_CONSTRUCTORS = ("RegistryTagPoller", "FeedTailer", "WebhookEmitter")
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _in_scope(relpath: str) -> bool:
+    if relpath.startswith(_SCOPED_PREFIXES):
+        return True
+    base = relpath.rsplit("/", 1)[-1]
+    return base.startswith("gl015_")
+
+
+def _annotated(mod: Module, lineno: int) -> bool:
+    m = _SEAM_RE.search(mod.comments.get(lineno, ""))
+    return bool(m and m.group(1).strip())
+
+
+@rule("GL015")
+def check_watch_seam(mod: Module) -> list[Finding]:
+    if not _in_scope(mod.relpath):
+        return []
+    out: list[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name in _PLANE_CONSTRUCTORS:
+            if _annotated(mod, node.lineno):
+                continue
+            out.append(
+                Finding(
+                    "GL015",
+                    mod.relpath,
+                    node.lineno,
+                    f"direct {name}(...) construction outside "
+                    "trivy_tpu/watch/ puts event-source I/O / webhook "
+                    "delivery on a scan-path thread and fragments the "
+                    "plane's dedupe + delivery accounting; assemble "
+                    "through watch.build_watch_service, or annotate the "
+                    "call line with `# graftlint: watch-seam(<reason>)`",
+                )
+            )
+        elif name == "list_tags" and isinstance(node.func, ast.Attribute):
+            if _annotated(mod, node.lineno):
+                continue
+            out.append(
+                Finding(
+                    "GL015",
+                    mod.relpath,
+                    node.lineno,
+                    "list_tags(...) outside trivy_tpu/watch/ turns a "
+                    "scan path into a registry enumerator; tag listing "
+                    "is a polling primitive that belongs behind an "
+                    "event source's dedupe map (RegistryTagPoller), or "
+                    "annotate with `# graftlint: watch-seam(<reason>)`",
+                )
+            )
+    return out

@@ -34,6 +34,7 @@ byte-identical to a cold scan of the same bytes under any name.
 from __future__ import annotations
 
 import hashlib
+import threading
 
 from trivy_tpu.atypes import BlobInfo
 from trivy_tpu.cache import stats as cache_stats
@@ -72,6 +73,31 @@ def result_key(
     return "sha256:" + h.hexdigest()
 
 
+# Marker distinguishing an index document from a verdict document; also
+# the CustomResources entry kind the index rides under.
+INDEX_KIND = "trivy-tpu/result-index"
+
+
+def index_key(
+    ruleset_digest: str,
+    program_id: str = "secret",
+    schema_version: int = RESULT_SCHEMA_VERSION,
+) -> str:
+    """Key of the per-(ruleset digest, program id) reverse index — the
+    set of blob digests holding cached verdicts under that digest.  The
+    leading INDEX_KIND component keeps it disjoint from every
+    result_key (those start with a blob digest, never the marker)."""
+    h = hashlib.sha256()
+    h.update(INDEX_KIND.encode("utf-8"))
+    h.update(b"\x00")
+    h.update(ruleset_digest.encode("utf-8"))
+    h.update(b"\x00")
+    h.update(str(schema_version).encode("ascii"))
+    h.update(b"\x00")
+    h.update(program_id.encode("utf-8"))
+    return "sha256:" + h.hexdigest()
+
+
 class ScanResultCache:
     """Get/put of per-blob Secret verdicts over an ArtifactCache backend.
 
@@ -83,6 +109,11 @@ class ScanResultCache:
 
     def __init__(self, backend: ArtifactCache):
         self.backend = backend
+        # Reverse-index write path: _indexed mirrors (index key, blob
+        # digest) pairs already persisted so the steady state (same blob
+        # re-verdicted under the same digest) skips the read-merge-write.
+        self._index_lock = threading.Lock()
+        self._indexed: set[tuple[str, str]] = set()
 
     def get(
         self,
@@ -125,6 +156,96 @@ class ScanResultCache:
             else []
         )
         self.backend.put_blob(key, BlobInfo(secrets=secrets))
+        self._index_add(blob_digest, ruleset_digest, program_id)
+
+    def exists(
+        self,
+        blob_digest: str,
+        ruleset_digest: str,
+        program_id: str = "secret",
+    ) -> bool:
+        """Pure existence probe (no rehydration): does a cached verdict
+        for this (blob, ruleset, program) exist in any tier?  The watch
+        planner's novelty test — cheap by design (FS backends stat, the
+        tiered chain short-circuits on its first hit)."""
+        if not ruleset_digest:
+            return False
+        key = result_key(blob_digest, ruleset_digest, program_id=program_id)
+        return self.backend.exists(key)
+
+    def indexed_blobs(
+        self,
+        ruleset_digest: str,
+        program_id: str = "secret",
+    ) -> list[str]:
+        """Blob digests holding cached verdicts under (ruleset digest,
+        program id), from the persisted reverse index.  This is what lets
+        the re-verification sweeper enumerate exactly the entries an old
+        ruleset digest invalidated without a full tier walk."""
+        if not ruleset_digest:
+            return []
+        blob = self.backend.get_blob(index_key(ruleset_digest, program_id))
+        return sorted(self._index_entries(blob))
+
+    def remove(
+        self,
+        blob_digest: str,
+        ruleset_digest: str,
+        program_id: str = "secret",
+    ) -> None:
+        """Drop one verdict and its reverse-index entry (sweeper cleanup
+        after re-verdicting a blob under a new digest)."""
+        if not ruleset_digest:
+            return
+        key = result_key(blob_digest, ruleset_digest, program_id=program_id)
+        self.backend.delete_blobs([key])
+        ikey = index_key(ruleset_digest, program_id)
+        with self._index_lock:
+            self._indexed.discard((ikey, blob_digest))
+            entries = self._index_entries(self.backend.get_blob(ikey))
+            if blob_digest not in entries:
+                return
+            entries.discard(blob_digest)
+            if entries:
+                self.backend.put_blob(ikey, self._index_doc(entries))
+            else:
+                self.backend.delete_blobs([ikey])
+
+    def _index_add(
+        self, blob_digest: str, ruleset_digest: str, program_id: str
+    ) -> None:
+        """Read-merge-write the reverse index under the instance lock.
+        Persisting through put_blob means a TieredCache backend pops any
+        negative entry for the index key on write, so a fresh verdict is
+        always enumerable by the next sweep — a remembered miss never
+        masks a re-scan."""
+        ikey = index_key(ruleset_digest, program_id)
+        pair = (ikey, blob_digest)
+        with self._index_lock:
+            if pair in self._indexed:
+                return
+            entries = self._index_entries(self.backend.get_blob(ikey))
+            if blob_digest not in entries:
+                entries.add(blob_digest)
+                self.backend.put_blob(ikey, self._index_doc(entries))
+            self._indexed.add(pair)
+
+    @staticmethod
+    def _index_doc(entries: set[str]) -> BlobInfo:
+        return BlobInfo(
+            custom_resources=[
+                {"Kind": INDEX_KIND, "Blobs": sorted(entries)}
+            ]
+        )
+
+    @staticmethod
+    def _index_entries(blob: BlobInfo | None) -> set[str]:
+        if blob is None:
+            return set()
+        for res in blob.custom_resources:
+            if isinstance(res, dict) and res.get("Kind") == INDEX_KIND:
+                return {str(b) for b in res.get("Blobs") or []}
+        return set()
 
     def get_or_scan(
         self,

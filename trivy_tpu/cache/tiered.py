@@ -299,14 +299,21 @@ class TieredCache(ArtifactCache):
 
     def exists(self, blob_id: str) -> bool:
         if self._negative_hit("b::" + blob_id):
+            cache_stats.record_request("results", "negative")
             return False
+        # Short-circuit on the first tier that answers: a memory-tier hit
+        # must never touch remote tiers — watch-planner novelty probes
+        # come in bulk, and letting them fall through to a flaky redis
+        # tier burns its error budget on pure existence checks.
         for tier in self._live_tiers():
             try:
                 faults.fire("cache.get")
                 with tier.io_lock:
                     present = tier.backend.exists(blob_id)
                 if present:
+                    cache_stats.record_request(tier.name, "hit")
                     return True
+                cache_stats.record_request(tier.name, "miss")
             except Exception as e:
                 self._tier_error(tier, "exists", e)
         return False

@@ -828,6 +828,56 @@ def build_parser() -> argparse.ArgumentParser:
         help="log line format: console (default) or one JSON object per "
         "line with trace_id correlation",
     )
+    p_server.add_argument(
+        "--watch-config", default=_env_default("watch-config", ""),
+        help="continuous-scanning plane YAML (event sources + verdict-"
+        "delta stream); requires --cache-backend so the delta planner "
+        "can probe cached verdicts — see GET /debug/watch",
+    )
+
+    # Continuous scanning without a server: poll sources with a local
+    # engine (the watch plane's CLI entry; the server embeds the same
+    # plane via --watch-config).
+    p_watch = sub.add_parser(
+        "watch",
+        help="continuously scan registry/feed changes with a local engine",
+    )
+    p_watch.add_argument(
+        "--watch-config", default=_env_default("watch-config", ""),
+        help="watch-plane YAML: event sources, poll interval, verdict-"
+        "delta stream sinks (required)",
+    )
+    p_watch.add_argument(
+        "--once", action="store_true", default=_bool_default("once"),
+        help="run one poll cycle, print the JSON summary, and exit "
+        "(smoke tests / cron)",
+    )
+    p_watch.add_argument("--cache-dir", default=_env_default("cache-dir", ""))
+    p_watch.add_argument(
+        "--cache-backend", default=_env_default("cache-backend", ""),
+        help="result-cache backend: memory | fs | redis://… | s3://… "
+        "('' = fs when --cache-dir is set, else memory)",
+    )
+    p_watch.add_argument(
+        "--cache-ttl", type=int, default=int(_env_default("cache-ttl", "0")),
+        help="remote cache tier entry TTL seconds (redis/s3 backends)",
+    )
+    p_watch.add_argument(
+        "--secret-config", default=_env_default("secret-config", ""),
+        help="secret-config YAML the local engine scans with",
+    )
+    p_watch.add_argument(
+        "--rules-cache-dir", default=_env_default("rules-cache-dir", ""),
+        help="compiled-ruleset registry directory (default "
+        "~/.cache/trivy-tpu/rulesets; 'off' disables warm starts)",
+    )
+    p_watch.add_argument(
+        "--log-format", choices=("console", "json"),
+        default=_env_default("log-format", "console"),
+    )
+    p_watch.add_argument(
+        "--debug", action="store_true", default=_bool_default("debug")
+    )
 
     # Ruleset registry maintenance: precompile, list, verify artifacts.
     p_rules = sub.add_parser(
@@ -1096,6 +1146,11 @@ def main(argv: list[str] | None = None) -> int:
 
         return run_perf(args)
 
+    if args.command == "watch":
+        from trivy_tpu.commands.watch import run_watch
+
+        return run_watch(args)
+
     if args.command == "server":
         from trivy_tpu.registry.store import resolve_rules_cache_dir
         from trivy_tpu.rpc.server import serve
@@ -1136,6 +1191,7 @@ def main(argv: list[str] | None = None) -> int:
             flight_out_max_mb=args.flight_out_max_mb,
             fleet_config=args.fleet_config,
             fleet_member=args.fleet_member,
+            watch_config=args.watch_config,
         )
         return 0
 

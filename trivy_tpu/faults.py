@@ -36,6 +36,8 @@ Seams (grep for ``faults.fire`` / ``faults.decide``):
                                          on host-only builds)
     cache.get       cache/tiered.py      tiered result-cache read (per tier)
     cache.put       cache/tiered.py      tiered result-cache write (per tier)
+    watch.poll      watch/sources.py     event-source poll (registry tag
+                                         list / feed tail)
 
 Kinds: ``error`` (generic InjectedFault), ``oom`` (InjectedOom — its
 message carries RESOURCE_EXHAUSTED so the scheduler's shed-and-retry
@@ -73,6 +75,7 @@ SEAMS = (
     "sched.dispatch",
     "cache.get",
     "cache.put",
+    "watch.poll",
 )
 
 KINDS = ("error", "oom", "corrupt", "reset", "truncate", "latency")

@@ -300,6 +300,22 @@ class RegistryClient:
                 logger.warning("OCI-referrer SBOM unusable: %s", e)
         return None
 
+    def list_tags(self, ref: Reference) -> list[str]:
+        """All tags in the reference's repository (GET /v2/<name>/tags/list),
+        sorted.  The watch plane's registry poller diffs successive calls
+        against its last-seen digests to synthesize change events; sorted
+        output keeps that diff deterministic across registries that page
+        or reorder."""
+        base = f"{self._scheme(ref.registry)}://{ref.registry}/v2/{ref.repository}"
+        raw, _ = self._request(f"{base}/tags/list", {}, ref.repository)
+        try:
+            doc = json.loads(raw)
+        except ValueError as e:
+            raise RegistryError(
+                f"registry: bad tags/list body for {ref.repository}"
+            ) from e
+        return sorted(str(t) for t in (doc.get("tags") or []))
+
     def subject_digest(self, ref: Reference) -> str:
         """The digest SBOM referrers attach to: the user-supplied digest,
         or the digest of whatever the tag resolves to FIRST (the index for

@@ -126,6 +126,7 @@ class ScanServer:
         result_cache: ScanResultCache | None = None,
         fleet_config=None,
         fleet_member: str = "",
+        watch_config=None,
     ):
         from trivy_tpu.scanner.vuln import init_vuln_scanner
 
@@ -319,6 +320,41 @@ class ScanServer:
             self._fleet_aff_exported = {"hit": 0, "miss": 0}
             self._fleet_route_exported: dict[tuple[str, str], int] = {}
             self.registry.add_collect_hook(self._collect_fleet)
+        # Continuous-scanning plane (trivy_tpu/watch/): event sources +
+        # delta planner + re-verification sweeper + verdict-delta stream.
+        # `watch_config` is a YAML path or a parsed WatchConfig; requires
+        # the result cache (novelty probes ARE the plane's economics).
+        # None = off: /debug/watch answers {"enabled": false}.  The poll
+        # loop does NOT start here — serve() owns that (in-process test
+        # servers drive poll_once() directly).
+        self.watch = None
+        if watch_config:
+            if self.result_cache is None:
+                raise ValueError(
+                    "watch config requires the result cache "
+                    "(start with --cache-backend)"
+                )
+            from trivy_tpu.watch import (
+                WatchConfig,
+                build_watch_service,
+                load_watch_config,
+            )
+
+            wcfg = (
+                watch_config
+                if isinstance(watch_config, WatchConfig)
+                else load_watch_config(str(watch_config))
+            )
+            self.watch = build_watch_service(
+                wcfg,
+                self.result_cache,
+                scan_fn=self._watch_scan,
+                ruleset_digest_fn=self.ruleset_digest,
+                artifact_cache=self.cache,
+                flight=self.flight,
+                sweep_scan_fn=self._watch_sweep_scan,
+            )
+            self.watch.register_collectors(self.registry)
         self.draining = False  # SIGTERM: reject new work with 503
         # Live-profiling window (POST /admin/profile/start|stop): default
         # output dir from --profile-dir, overridable per start request.
@@ -502,6 +538,39 @@ class ScanServer:
             )
         return out
 
+    # -- watch plane ------------------------------------------------------
+
+    def _watch_scan(self, items: list[tuple[str, bytes]]) -> list:
+        """The watch planner's scan seam: novel blobs ride the normal
+        scheduler path (same batching, admission, result-cache puts as
+        any client's ScanSecrets) under the default ruleset lane."""
+        return self.scheduler.submit(items, client_id="watch").result(
+            timeout=300.0
+        )
+
+    def _watch_sweep_scan(
+        self, items: list[tuple[str, bytes]], ruleset_digest: str
+    ) -> list:
+        """The sweeper's scan seam: re-verdicts must run under the NEW
+        ruleset's lane.  The server's own active digest collapses to the
+        default lane (the scan_secrets convention: pinning what already
+        runs costs no residency slot)."""
+        digest = ruleset_digest
+        if digest and digest == self.ruleset_digest():
+            digest = ""
+        return self.scheduler.submit(
+            items, client_id="watch", ruleset_digest=digest
+        ).result(timeout=300.0)
+
+    def watch_report(self) -> dict:
+        """GET /debug/watch: the continuous-scanning plane's posture —
+        per-source poll/dedupe stats and lag, planner hit economics,
+        sweep progress, stream/webhook delivery counters.  A sane body
+        when unwatched: enabled=false."""
+        if self.watch is None:
+            return {"enabled": False}
+        return self.watch.snapshot()
+
     # -- ruleset registry -------------------------------------------------
 
     def reload_ruleset(self, req: dict) -> dict:
@@ -509,12 +578,17 @@ class ScanServer:
         handler thread (optionally from a new SecretConfigPath), stage it,
         and return the staged digest.  The swap itself happens at the next
         batch boundary on the scheduler's owner thread; in-flight requests
-        finish on the old ruleset."""
+        finish on the old ruleset.  On a watching server, a digest change
+        also schedules the re-verification sweep (the old digest's cached
+        verdicts are now stale — exactly those, nothing else)."""
         path = (req or {}).get("SecretConfigPath", "")
         if path:
             self.secret_config = path
             self._config_digest = None
+        old_digest = self.scheduler.active_ruleset_digest()
         digest = self.scheduler.reload()
+        if self.watch is not None:
+            self.watch.schedule_sweep(old_digest, digest)
         return {
             "RulesetDigest": digest,
             "Epoch": self.scheduler.ruleset_epoch(),
@@ -885,6 +959,13 @@ class ScanServer:
         if req.get("Admit", True) and pool is not None:
             pool.ensure(digest)
             resident = True
+        if self.watch is not None:
+            # A pushed ruleset supersedes the currently active one for
+            # the watch plane: re-verify the active digest's cached
+            # verdicts under the pushed digest's lane.
+            self.watch.schedule_sweep(
+                self.scheduler.active_ruleset_digest(), digest
+            )
         return {
             "RulesetDigest": digest,
             "Source": source,
@@ -955,6 +1036,9 @@ DEBUG_SURFACES = {
     "/debug/programs": "device scan programs: program table sharing the "
     "device pass, per-program demux counters (candidates/verdicts) at "
     "the last batch boundary",
+    "/debug/watch": "continuous-scanning plane: per-source poll/dedupe "
+    "stats and lag, delta-planner hit economics, re-verification sweep "
+    "progress, verdict-delta stream and webhook delivery counters",
 }
 
 
@@ -1111,6 +1195,11 @@ def _make_handler(server: ScanServer):
                 # device pass + demux counters (sane body when the
                 # engine is secret-only: enabled=false).
                 self._send(200, server.programs_report())
+            elif route == "/debug/watch":
+                # Continuous-scanning posture: sources, lag, planner hit
+                # rates, sweep progress, stream delivery (sane body when
+                # unwatched: enabled=false).
+                self._send(200, server.watch_report())
             elif route in ("/debug", "/debug/"):
                 # Index of every debug surface with its one-liner.
                 self._send(200, {"surfaces": DEBUG_SURFACES})
@@ -1362,6 +1451,7 @@ def make_http_server(
     result_cache: ScanResultCache | None = None,
     fleet_config=None,
     fleet_member: str = "",
+    watch_config=None,
 ) -> ThreadingHTTPServer:
     host, _, port = addr.rpartition(":")
     scan_server = ScanServer(
@@ -1379,6 +1469,7 @@ def make_http_server(
         result_cache=result_cache,
         fleet_config=fleet_config,
         fleet_member=fleet_member,
+        watch_config=watch_config,
     )
     httpd = ThreadingHTTPServer(
         (host or "localhost", int(port)), _make_handler(scan_server)
@@ -1405,6 +1496,7 @@ def serve(
     cache_ttl: int = 0,
     fleet_config: str = "",
     fleet_member: str = "",
+    watch_config: str = "",
 ) -> None:
     """pkg/rpc/server/listen.go ListenAndServe, with graceful SIGTERM
     drain: stop admitting (503 + Retry-After), finish the batches already
@@ -1425,6 +1517,11 @@ def serve(
     # device.  Unset keeps the seed behavior (no result caching).
     cache = build_cache(cache_backend, cache_dir, cache_ttl)
     result_cache = ScanResultCache(cache) if cache_backend else None
+    if watch_config and result_cache is None:
+        raise ValueError(
+            "--watch-config requires --cache-backend: the delta planner "
+            "probes the result cache to prove blobs novel"
+        )
     httpd = make_http_server(
         addr, cache, token, db_dir, cache_dir, serve_config=serve_config,
         secret_config=secret_config, rules_cache_dir=rules_cache_dir,
@@ -1433,8 +1530,11 @@ def serve(
         flight_out=flight_out, flight_out_max_mb=flight_out_max_mb,
         result_cache=result_cache,
         fleet_config=fleet_config, fleet_member=fleet_member,
+        watch_config=watch_config or None,
     )
     scan_server: ScanServer = httpd.scan_server
+    if scan_server.watch is not None:
+        scan_server.watch.start()
 
     def _drain_and_stop() -> None:
         scan_server.draining = True
@@ -1463,6 +1563,8 @@ def serve(
     except KeyboardInterrupt:
         pass
     finally:
+        if scan_server.watch is not None:
+            scan_server.watch.close()
         scan_server.scheduler.close()
         httpd.server_close()
 
@@ -1474,6 +1576,7 @@ def start_background(
     profile_dir: str = "", slo_config: str = "", flight_out: str = "",
     result_cache: ScanResultCache | None = None,
     fleet_config=None, fleet_member: str = "",
+    watch_config=None,
 ) -> tuple[ThreadingHTTPServer, threading.Thread]:
     """In-process server for tests (the §4 'multi-node without a cluster'
     pattern: integration_test.go:77-103 binds a real server on a free port)."""
@@ -1489,6 +1592,7 @@ def start_background(
         result_cache=result_cache,
         fleet_config=fleet_config,
         fleet_member=fleet_member,
+        watch_config=watch_config,
     )
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()
